@@ -1,0 +1,46 @@
+"""spark_rapids_jni_tpu: a TPU-native re-architecture of spark-rapids-jni.
+
+The reference library (/root/reference, NVIDIA spark-rapids-jni) is the native
+CUDA/C++/JNI support layer for the RAPIDS Accelerator for Apache Spark: Spark-exact
+columnar compute kernels, a multi-tenant device-memory governance state machine, and
+observability/chaos tooling.  This package provides the same capabilities designed
+TPU-first: columns are Arrow-layout pytrees of JAX arrays resident in HBM, kernels are
+vectorized XLA/Pallas programs (SIMD-over-lanes rather than SIMT), multi-chip scaling
+uses `jax.sharding` meshes with ICI/DCN collectives, and the memory arbiter governs
+batch admission into HBM rather than intercepting `malloc`.
+
+Layer map (mirrors SURVEY.md §1, re-drawn for TPU):
+
+    L5  Python public API    spark_rapids_jni_tpu.ops / .mem / .profiler
+    L4  dispatch seam        ops.dispatch (fault injection + tracing hook point)
+    L3  op library           vectorized jnp/Pallas kernels over Column pytrees
+    L2  columnar data model  spark_rapids_jni_tpu.columnar (Arrow layout in HBM)
+    L1  JAX/XLA runtime      jit, sharding, collectives, profiler
+"""
+
+import os
+
+import jax
+
+# 64-bit integer support is required framework-wide: xxhash64, decimal128 limb math,
+# JCUDF row offsets and timestamp micros are all 64-bit.  TPUs execute 64-bit integer
+# ops as pairs of 32-bit ops; this is the standard JAX switch for it.
+if os.environ.get("SPARK_RAPIDS_TPU_NO_X64") != "1":  # escape hatch for embedders
+    jax.config.update("jax_enable_x64", True)
+
+__version__ = "26.08.0"
+
+from spark_rapids_jni_tpu.columnar import (  # noqa: E402
+    Column,
+    Decimal128Column,
+    StringColumn,
+    DType,
+)
+
+__all__ = [
+    "Column",
+    "Decimal128Column",
+    "StringColumn",
+    "DType",
+    "__version__",
+]

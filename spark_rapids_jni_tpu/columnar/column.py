@@ -1,0 +1,339 @@
+"""Device-resident Arrow-layout columns as JAX pytrees.
+
+The reference's data model is cuDF's column (device buffer + validity bitmask +
+offsets for strings); see SURVEY.md §1 L2.  On TPU, a column is a pytree of JAX
+arrays living in HBM:
+
+- fixed-width: ``data[n]`` plus optional ``validity[n]`` (bool; None == all valid).
+- strings: ``chars[total_bytes]`` (uint8) + ``offsets[n+1]`` (int32), Arrow layout.
+- decimal128: two's-complement pair ``(hi int64, lo uint64)`` per row.  TPUs have no
+  native int128; limb form keeps the math in vectorizable 64-bit ops.
+- list/struct: offsets + child columns, enough for nested hashing and the timezone
+  transition tables.
+
+Validity is an *unpacked* bool vector rather than Arrow's packed bits: the VPU
+operates on lanes, and packed-bit twiddling per element would serialize.  Packing
+to/from Arrow bitmasks for interchange lives in utils.bitmask.
+
+Vectorized string kernels consume a *padded view*: ``bytes[n, max_len]`` + lengths.
+That trades memory for a dense rectangular layout the VPU can sweep; ops chunk rows
+to bound the padding cost.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar import dtypes
+from spark_rapids_jni_tpu.columnar.dtypes import DType, Kind
+
+
+def _register(cls, data_fields, meta_fields):
+    jax.tree_util.register_dataclass(cls, data_fields, meta_fields)
+    return cls
+
+
+@dataclasses.dataclass
+class Column:
+    """Fixed-width column: data[n] with optional validity[n] (True == valid)."""
+
+    data: jnp.ndarray
+    validity: Optional[jnp.ndarray]
+    dtype: DType
+
+    def __len__(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def size(self) -> int:
+        return self.data.shape[0]
+
+    def is_valid(self) -> jnp.ndarray:
+        if self.validity is None:
+            return jnp.ones((self.size,), dtype=jnp.bool_)
+        return self.validity
+
+    def null_count(self) -> int:
+        if self.validity is None:
+            return 0
+        return int(jnp.sum(~self.validity))
+
+    def to_list(self):
+        """Host materialization with None for nulls (test/oracle use)."""
+        data = np.asarray(self.data)
+        if self.dtype.kind == Kind.BOOL:
+            vals = [bool(v) for v in data]
+        elif self.dtype.kind == Kind.FLOAT64:
+            vals = [float(v) for v in data.view(np.float64)]
+        elif self.dtype.is_floating:
+            vals = [float(v) for v in data]
+        else:
+            vals = [int(v) for v in data]
+        return _apply_nulls(vals, self.validity)
+
+
+@dataclasses.dataclass
+class Decimal128Column:
+    """DECIMAL128 column as two's-complement (hi, lo) 64-bit limb pairs."""
+
+    hi: jnp.ndarray  # int64
+    lo: jnp.ndarray  # uint64
+    validity: Optional[jnp.ndarray]
+    dtype: DType  # kind == DECIMAL128, carries precision/scale
+
+    def __len__(self) -> int:
+        return self.hi.shape[0]
+
+    @property
+    def size(self) -> int:
+        return self.hi.shape[0]
+
+    def is_valid(self) -> jnp.ndarray:
+        if self.validity is None:
+            return jnp.ones((self.size,), dtype=jnp.bool_)
+        return self.validity
+
+    def unscaled_to_list(self):
+        """Unscaled int128 values (or None), reconstructed on host."""
+        hi = np.asarray(self.hi).astype(np.int64)
+        lo = np.asarray(self.lo).astype(np.uint64)
+        vals = [int(h) * (1 << 64) + int(l) for h, l in zip(hi, lo)]
+        return _apply_nulls(vals, self.validity)
+
+    def to_list(self):
+        """Decimal values as python fractions of 10**scale (None for nulls)."""
+        import decimal as pydec
+
+        scale = self.dtype.scale
+        out = []
+        for v in self.unscaled_to_list():
+            out.append(None if v is None else pydec.Decimal(v).scaleb(-scale))
+        return out
+
+
+@dataclasses.dataclass
+class StringColumn:
+    """UTF-8 string column: Arrow chars+offsets layout."""
+
+    chars: jnp.ndarray  # uint8[total_bytes]
+    offsets: jnp.ndarray  # int32[n+1]
+    validity: Optional[jnp.ndarray]
+
+    dtype: DType = dtypes.STRING
+
+    def __len__(self) -> int:
+        return self.offsets.shape[0] - 1
+
+    @property
+    def size(self) -> int:
+        return self.offsets.shape[0] - 1
+
+    def is_valid(self) -> jnp.ndarray:
+        if self.validity is None:
+            return jnp.ones((self.size,), dtype=jnp.bool_)
+        return self.validity
+
+    def lengths(self) -> jnp.ndarray:
+        return self.offsets[1:] - self.offsets[:-1]
+
+    def max_len(self) -> int:
+        """Host-side max byte length (concrete; call outside jit)."""
+        if self.size == 0:
+            return 0
+        return int(jnp.max(self.lengths()))
+
+    def padded(self, max_len: Optional[int] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Dense ``(bytes[n, max_len] uint8, lengths[n] int32)`` view.
+
+        Rows are right-padded with zeros.  ``max_len`` must be static under jit;
+        when omitted it is computed on host from the offsets.
+        """
+        if max_len is None:
+            max_len = max(self.max_len(), 1)
+        starts = self.offsets[:-1]
+        lens = self.lengths()
+        idx = starts[:, None] + jnp.arange(max_len, dtype=jnp.int32)[None, :]
+        in_bounds = jnp.arange(max_len, dtype=jnp.int32)[None, :] < lens[:, None]
+        idx = jnp.clip(idx, 0, max(int(self.chars.shape[0]) - 1, 0))
+        if self.chars.shape[0] == 0:
+            gathered = jnp.zeros((self.size, max_len), dtype=jnp.uint8)
+        else:
+            gathered = self.chars[idx]
+        return jnp.where(in_bounds, gathered, jnp.uint8(0)), lens
+
+    def to_list(self):
+        chars = np.asarray(self.chars)
+        offs = np.asarray(self.offsets)
+        vals = [
+            bytes(chars[offs[i] : offs[i + 1]]).decode("utf-8", errors="surrogatepass")
+            for i in range(self.size)
+        ]
+        return _apply_nulls(vals, self.validity)
+
+
+@dataclasses.dataclass
+class ListColumn:
+    """LIST column: offsets[n+1] into a child column."""
+
+    offsets: jnp.ndarray  # int32[n+1]
+    child: Any
+    validity: Optional[jnp.ndarray]
+    dtype: DType = DType(Kind.LIST)
+
+    def __len__(self) -> int:
+        return self.offsets.shape[0] - 1
+
+    @property
+    def size(self) -> int:
+        return self.offsets.shape[0] - 1
+
+    def is_valid(self) -> jnp.ndarray:
+        if self.validity is None:
+            return jnp.ones((self.size,), dtype=jnp.bool_)
+        return self.validity
+
+
+@dataclasses.dataclass
+class StructColumn:
+    """STRUCT column: tuple of equal-length children."""
+
+    children: Tuple[Any, ...]
+    validity: Optional[jnp.ndarray]
+    dtype: DType = DType(Kind.STRUCT)
+
+    def __len__(self) -> int:
+        return self.children[0].size
+
+    @property
+    def size(self) -> int:
+        return self.children[0].size
+
+    def is_valid(self) -> jnp.ndarray:
+        if self.validity is None:
+            return jnp.ones((self.size,), dtype=jnp.bool_)
+        return self.validity
+
+
+_register(Column, ("data", "validity"), ("dtype",))
+_register(Decimal128Column, ("hi", "lo", "validity"), ("dtype",))
+_register(StringColumn, ("chars", "offsets", "validity"), ("dtype",))
+_register(ListColumn, ("offsets", "child", "validity"), ("dtype",))
+_register(StructColumn, ("children", "validity"), ("dtype",))
+
+
+def _apply_nulls(vals, validity):
+    if validity is None:
+        return vals
+    mask = np.asarray(validity)
+    return [v if m else None for v, m in zip(vals, mask)]
+
+
+def _validity_from(values: Sequence) -> Optional[jnp.ndarray]:
+    if any(v is None for v in values):
+        return jnp.asarray(np.array([v is not None for v in values], dtype=bool))
+    return None
+
+
+def column(values: Sequence, dtype: DType) -> Column:
+    """Build a fixed-width Column from a python sequence (None == null).
+
+    FLOAT64 columns are stored as their IEEE-754 bit pattern in int64: TPUs
+    emulate f64 as float32 pairs (not bit-exact binary64), so Spark-exact double
+    semantics are implemented as integer ops over the exact bits.  int64 IS
+    exact on TPU (pair-of-u32 emulation via the XLA x64 rewrite).
+    """
+    zero = False if dtype.kind == Kind.BOOL else 0
+    if dtype.kind == Kind.FLOAT64:
+        filled = np.array(
+            [zero if v is None else v for v in values], dtype=np.float64
+        ).view(np.int64)
+    else:
+        filled = np.array(
+            [zero if v is None else v for v in values], dtype=np.dtype(dtype.jnp_dtype)
+        )
+    return Column(jnp.asarray(filled), _validity_from(values), dtype)
+
+
+def decimal128_column(
+    unscaled: Sequence, precision: int, scale: int
+) -> Decimal128Column:
+    """Build a Decimal128Column from python-int unscaled values (None == null)."""
+    hi = np.zeros(len(unscaled), dtype=np.int64)
+    lo = np.zeros(len(unscaled), dtype=np.uint64)
+    for i, v in enumerate(unscaled):
+        if v is None:
+            continue
+        v128 = v & ((1 << 128) - 1)  # two's complement
+        hi[i] = np.int64(np.uint64((v128 >> 64) & 0xFFFFFFFFFFFFFFFF).astype(np.int64))
+        lo[i] = np.uint64(v128 & 0xFFFFFFFFFFFFFFFF)
+    return Decimal128Column(
+        jnp.asarray(hi),
+        jnp.asarray(lo),
+        _validity_from(unscaled),
+        DType(Kind.DECIMAL128, precision, scale),
+    )
+
+
+def strings_column(values: Sequence[Optional[str]]) -> StringColumn:
+    """Build a StringColumn from python strings (None == null).
+
+    Non-BMP/unpaired-surrogate content is encoded with surrogatepass to match the
+    JVM's permissive UTF-8 handling in the reference tests.
+    """
+    bufs = []
+    offsets = [0]
+    for v in values:
+        b = b"" if v is None else v.encode("utf-8", errors="surrogatepass")
+        bufs.append(b)
+        offsets.append(offsets[-1] + len(b))
+    chars = np.frombuffer(b"".join(bufs), dtype=np.uint8).copy()
+    return StringColumn(
+        jnp.asarray(chars),
+        jnp.asarray(np.array(offsets, dtype=np.int32)),
+        _validity_from(values),
+    )
+
+
+def strings_from_bytes(values: Sequence[Optional[bytes]]) -> StringColumn:
+    """Build a StringColumn from raw byte strings (None == null)."""
+    bufs = []
+    offsets = [0]
+    for v in values:
+        b = b"" if v is None else v
+        bufs.append(b)
+        offsets.append(offsets[-1] + len(b))
+    chars = np.frombuffer(b"".join(bufs), dtype=np.uint8).copy()
+    return StringColumn(
+        jnp.asarray(chars),
+        jnp.asarray(np.array(offsets, dtype=np.int32)),
+        _validity_from(values),
+    )
+
+
+def strings_from_padded(
+    padded: jnp.ndarray, lengths: jnp.ndarray, validity=None
+) -> StringColumn:
+    """Rebuild Arrow layout from a dense padded view (inverse of .padded()).
+
+    Output chars are compacted host-side-free via a jittable gather: positions are
+    assigned by an exclusive scan of lengths.
+    """
+    n, max_len = padded.shape
+    lengths = lengths.astype(jnp.int32)
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(lengths, dtype=jnp.int32)]
+    )
+    total = int(offsets[-1])  # concrete only outside jit; see note below
+    flat_idx = offsets[:-1, None] + jnp.arange(max_len, dtype=jnp.int32)[None, :]
+    in_bounds = jnp.arange(max_len, dtype=jnp.int32)[None, :] < lengths[:, None]
+    chars = jnp.zeros((max(total, 1),), dtype=jnp.uint8)
+    chars = chars.at[jnp.where(in_bounds, flat_idx, total)].set(
+        padded, mode="drop", unique_indices=False
+    )
+    chars = chars[:total]
+    return StringColumn(chars, offsets, validity)

@@ -1,0 +1,11 @@
+from spark_rapids_jni_tpu.ops.hashing import (
+    murmur_hash32,
+    xxhash64,
+    DEFAULT_XXHASH64_SEED,
+)
+
+__all__ = [
+    "murmur_hash32",
+    "xxhash64",
+    "DEFAULT_XXHASH64_SEED",
+]

@@ -1,0 +1,422 @@
+"""Spark-exact row hashing: murmur3-32 and xxhash64.
+
+Re-architecture of the reference's `murmur_hash.cu` + `xxhash64.cu` + `hash.cuh`
+(spark-rapids-jni, src/main/cpp/src).  Spark's conventions, which both share
+(murmur_hash.cu:36-57 documents them):
+
+- the running hash is the *seed* for the next column (serial chaining);
+- a null element contributes nothing: the seed passes through;
+- floats/doubles normalize NaN -> canonical quiet NaN and -0.0 -> +0.0
+  (hash.cuh:34-52 normalize_nans_and_zeros);
+- DECIMAL32/64 hash their unscaled value as an 8-byte long; DECIMAL128 hashes the
+  *minimal* big-endian two's-complement byte string of the unscaled value, exactly
+  java.math.BigDecimal.unscaledValue().toByteArray() (hash.cuh:56-104);
+- Spark murmur differs from canonical murmur3 in tail processing: each trailing
+  byte (< 4) is sign-extended to int and run through the full mixK1/mixH1 round.
+
+GPU reference parallelizes one thread per row; here each hash step is a dense
+vector op over all rows (VPU lanes), and variable-length byte streams are walked
+with a `lax.scan` over the padded byte matrix — rows advance in lockstep, masked
+by their true lengths.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu.columnar import (
+    Column,
+    Decimal128Column,
+    ListColumn,
+    StringColumn,
+    StructColumn,
+)
+from spark_rapids_jni_tpu.columnar.dtypes import DType, Kind
+
+DEFAULT_XXHASH64_SEED = 42  # hash.cuh:29
+
+_U32 = jnp.uint32
+_U64 = jnp.uint64
+
+# murmur3 constants (Spark Murmur3_x86_32)
+_MM_C1 = _U32(0xCC9E2D51)
+_MM_C2 = _U32(0x1B873593)
+
+# xxhash64 primes (xxhash64.cu:188-192)
+_XX_P1 = _U64(0x9E3779B185EBCA87)
+_XX_P2 = _U64(0xC2B2AE3D27D4EB4F)
+_XX_P3 = _U64(0x165667B19E3779F9)
+_XX_P4 = _U64(0x85EBCA77C2B2AE63)
+_XX_P5 = _U64(0x27D4EB2F165667C5)
+
+
+def _rotl32(x, r: int):
+    return (x << _U32(r)) | (x >> _U32(32 - r))
+
+
+def _rotl64(x, r: int):
+    return (x << _U64(r)) | (x >> _U64(64 - r))
+
+
+# ---------------------------------------------------------------------------
+# murmur3-32 primitives (operating on uint32 vectors)
+# ---------------------------------------------------------------------------
+
+
+def _mm_mix_k1(k1):
+    k1 = k1 * _MM_C1
+    k1 = _rotl32(k1, 15)
+    return k1 * _MM_C2
+
+
+def _mm_mix_h1(h1, k1):
+    h1 = h1 ^ k1
+    h1 = _rotl32(h1, 13)
+    return h1 * _U32(5) + _U32(0xE6546B64)
+
+
+def _mm_fmix(h, length):
+    h = h ^ length
+    h = h ^ (h >> _U32(16))
+    h = h * _U32(0x85EBCA6B)
+    h = h ^ (h >> _U32(13))
+    h = h * _U32(0xC2B2AE35)
+    return h ^ (h >> _U32(16))
+
+
+def _mm_hash_int(v_i32, h):
+    """Spark Murmur3.hashInt: one mix round + fmix(4)."""
+    return _mm_fmix(_mm_mix_h1(h, _mm_mix_k1(v_i32.astype(_U32))), _U32(4))
+
+
+def _mm_hash_long(v_i64, h):
+    v = v_i64.astype(_U64)
+    low = (v & _U64(0xFFFFFFFF)).astype(_U32)
+    high = (v >> _U64(32)).astype(_U32)
+    h = _mm_mix_h1(h, _mm_mix_k1(low))
+    h = _mm_mix_h1(h, _mm_mix_k1(high))
+    return _mm_fmix(h, _U32(8))
+
+
+def _mm_hash_bytes(padded: jnp.ndarray, lens: jnp.ndarray, h):
+    """Spark Murmur3.hashUnsafeBytes over a dense [n, L] byte matrix.
+
+    Aligned 4-byte little-endian words get the standard round; the <=3 tail bytes
+    are each sign-extended and given a full round (the Spark deviation).
+    """
+    n, max_len = padded.shape
+    pad = (-max_len) % 4
+    if pad:
+        padded = jnp.pad(padded, ((0, 0), (0, pad)))
+    nwords_max = padded.shape[1] // 4
+    lens = lens.astype(jnp.int32)
+    nwords = lens // 4
+
+    b = padded.astype(_U32).reshape(n, nwords_max, 4)
+    words = b[:, :, 0] | (b[:, :, 1] << _U32(8)) | (b[:, :, 2] << _U32(16)) | (
+        b[:, :, 3] << _U32(24)
+    )
+
+    def word_step(hc, w_idx):
+        w = words[:, w_idx]
+        upd = _mm_mix_h1(hc, _mm_mix_k1(w))
+        return jnp.where(w_idx < nwords, upd, hc), None
+
+    if nwords_max:
+        h, _ = jax.lax.scan(word_step, h, jnp.arange(nwords_max))
+
+    tail_start = nwords * 4
+    for j in range(3):
+        idx = jnp.clip(tail_start + j, 0, padded.shape[1] - 1)
+        byte = jnp.take_along_axis(padded, idx[:, None], axis=1)[:, 0]
+        sbyte = byte.astype(jnp.int8).astype(jnp.int32).astype(_U32)
+        upd = _mm_mix_h1(h, _mm_mix_k1(sbyte))
+        h = jnp.where(tail_start + j < lens, upd, h)
+
+    return _mm_fmix(h, lens.astype(_U32))
+
+
+# ---------------------------------------------------------------------------
+# xxhash64 primitives (operating on uint64 vectors)
+# ---------------------------------------------------------------------------
+
+
+def _xx_round4(h64, w32_u64):
+    h64 = h64 ^ (w32_u64 * _XX_P1)
+    return _rotl64(h64, 23) * _XX_P2 + _XX_P3
+
+
+def _xx_round8(h64, w64):
+    k1 = w64 * _XX_P2
+    k1 = _rotl64(k1, 31) * _XX_P1
+    h64 = h64 ^ k1
+    return _rotl64(h64, 27) * _XX_P1 + _XX_P4
+
+
+def _xx_finalize(h):
+    h = h ^ (h >> _U64(33))
+    h = h * _XX_P2
+    h = h ^ (h >> _U64(29))
+    h = h * _XX_P3
+    h = h ^ (h >> _U64(32))
+    return h
+
+
+def _xx_hash_fixed4(v_u32, seed):
+    h64 = seed + _XX_P5 + _U64(4)
+    return _xx_finalize(_xx_round4(h64, v_u32.astype(_U64) & _U64(0xFFFFFFFF)))
+
+
+def _xx_hash_fixed8(v_u64, seed):
+    h64 = seed + _XX_P5 + _U64(8)
+    return _xx_finalize(_xx_round8(h64, v_u64))
+
+
+def _xx_hash_bytes(padded: jnp.ndarray, lens: jnp.ndarray, seed):
+    """XXH64 over a dense [n, L] byte matrix with per-row lengths (xxhash64.cu:110-177)."""
+    n, max_len = padded.shape
+    pad = (-max_len) % 32
+    if pad:
+        padded = jnp.pad(padded, ((0, 0), (0, pad)))
+    lens = lens.astype(jnp.int64)
+    l_padded = padded.shape[1]
+
+    b = padded.astype(_U64).reshape(n, l_padded // 8, 8)
+    shifts = (_U64(8) * jnp.arange(8, dtype=_U64))[None, None, :]
+    words64 = jnp.sum(b << shifts, axis=2, dtype=_U64)  # little-endian u64 lanes
+    b32 = padded.astype(_U32).reshape(n, l_padded // 4, 4)
+    shifts32 = (_U32(8) * jnp.arange(4, dtype=_U32))[None, None, :]
+    words32 = jnp.sum(b32 << shifts32, axis=2, dtype=_U32)
+
+    nstripes = (lens // 32).astype(jnp.int32)
+    max_stripes = l_padded // 32
+
+    def stripe_step(carry, s_idx):
+        v1, v2, v3, v4 = carry
+        active = s_idx < nstripes
+
+        def lane(v, lane_idx):
+            w = words64[:, s_idx * 4 + lane_idx]
+            nv = _rotl64(v + w * _XX_P2, 31) * _XX_P1
+            return jnp.where(active, nv, v)
+
+        return (lane(v1, 0), lane(v2, 1), lane(v3, 2), lane(v4, 3)), None
+
+    v1 = seed + _XX_P1 + _XX_P2
+    v2 = seed + _XX_P2
+    v3 = seed + _U64(0)
+    v4 = seed - _XX_P1
+    ones = jnp.ones((n,), _U64)
+    carry = (v1 * ones, v2 * ones, v3 * ones, v4 * ones)
+    if max_stripes:
+        carry, _ = jax.lax.scan(stripe_step, carry, jnp.arange(max_stripes))
+    v1, v2, v3, v4 = carry
+
+    merged = _rotl64(v1, 1) + _rotl64(v2, 7) + _rotl64(v3, 12) + _rotl64(v4, 18)
+    for v in (v1, v2, v3, v4):
+        vk = _rotl64(v * _XX_P2, 31) * _XX_P1
+        merged = (merged ^ vk) * _XX_P1 + _XX_P4
+
+    h64 = jnp.where(lens >= 32, merged, (seed + _XX_P5) * ones)
+    h64 = h64 + lens.astype(_U64)
+
+    # Tail: up to three 8-byte chunks, one 4-byte chunk, three single bytes.
+    offset_w64 = nstripes * 4  # 8-byte word index of first tail byte
+    rem = (lens % 32).astype(jnp.int32)
+    n8 = rem // 8
+    for j in range(3):
+        idx = jnp.clip(offset_w64 + j, 0, words64.shape[1] - 1)
+        w = jnp.take_along_axis(words64, idx[:, None], axis=1)[:, 0]
+        h64 = jnp.where(j < n8, _xx_round8(h64, w), h64)
+
+    has4 = (rem % 8) >= 4
+    idx32 = jnp.clip(nstripes * 8 + n8 * 2, 0, words32.shape[1] - 1)
+    w32 = jnp.take_along_axis(words32, idx32[:, None], axis=1)[:, 0]
+    h64 = jnp.where(has4, _xx_round4(h64, w32.astype(_U64)), h64)
+
+    byte_start = nstripes * 32 + n8 * 8 + jnp.where(has4, 4, 0)
+    for j in range(3):
+        idx = jnp.clip(byte_start + j, 0, padded.shape[1] - 1)
+        byte = jnp.take_along_axis(padded, idx[:, None], axis=1)[:, 0].astype(_U64)
+        upd = _rotl64(h64 ^ (byte * _XX_P5), 11) * _XX_P1
+        h64 = jnp.where(byte_start + j < lens, upd, h64)
+
+    return _xx_finalize(h64)
+
+
+# ---------------------------------------------------------------------------
+# shared element handling
+# ---------------------------------------------------------------------------
+
+
+def _normalize_float_bits(col: Column):
+    """NaN -> canonical quiet NaN, -0.0 -> +0.0, as integer bit patterns.
+
+    FLOAT64 columns already store exact binary64 bits in int64 (TPUs have no
+    bit-exact f64), so the double path is pure integer tests on the bits.
+    """
+    if col.dtype.kind == Kind.FLOAT32:
+        bits = jax.lax.bitcast_convert_type(col.data, jnp.int32)
+        qnan = jnp.int32(0x7FC00000)
+        bits = jnp.where(jnp.isnan(col.data), qnan, bits)
+        bits = jnp.where(col.data == 0.0, jnp.int32(0), bits)
+        return bits
+    bits = col.data.astype(jnp.uint64)
+    mag = bits & _U64(0x7FFFFFFFFFFFFFFF)
+    is_nan = mag > _U64(0x7FF0000000000000)
+    is_zero = mag == _U64(0)
+    bits = jnp.where(is_nan, _U64(0x7FF8000000000000), bits)
+    bits = jnp.where(is_zero, _U64(0), bits)
+    return bits.astype(jnp.int64)
+
+
+def _decimal128_java_bytes(col: Decimal128Column):
+    """Minimal big-endian two's-complement bytes of the unscaled value.
+
+    Mirrors hash.cuh:56-104 (to_java_bigdecimal): drop leading sign bytes, keep at
+    least one byte, re-add one byte if the sign bit of the top remaining byte
+    disagrees with the value's sign.  Returns ([n,16] big-endian padded bytes, lens).
+    """
+    n = col.size
+    hi_u = col.hi.astype(_U64)
+    lo_u = col.lo.astype(_U64)
+    # little-endian byte expansion: bytes 0..7 from lo, 8..15 from hi
+    shifts = (_U64(8) * jnp.arange(8, dtype=_U64))[None, :]
+    le_lo = ((lo_u[:, None] >> shifts) & _U64(0xFF)).astype(jnp.uint8)
+    le_hi = ((hi_u[:, None] >> shifts) & _U64(0xFF)).astype(jnp.uint8)
+    le = jnp.concatenate([le_lo, le_hi], axis=1)  # [n,16]
+
+    is_neg = col.hi < 0
+    zero_byte = jnp.where(is_neg, jnp.uint8(0xFF), jnp.uint8(0x00))
+    # length = index of highest byte that differs from the sign filler, plus 1
+    differs = le != zero_byte[:, None]  # [n,16]
+    pos = jnp.arange(16, dtype=jnp.int32)[None, :]
+    top = jnp.max(jnp.where(differs, pos, -1), axis=1)
+    length = jnp.maximum(top + 1, 1)
+    # sign-preservation: add a byte back if top byte's high bit mismatches the sign
+    top_byte = jnp.take_along_axis(le, jnp.maximum(length - 1, 0)[:, None], axis=1)[:, 0]
+    msb = (top_byte & jnp.uint8(0x80)) != 0
+    length = jnp.where((length < 16) & (is_neg ^ msb), length + 1, length)
+
+    # big-endian: be[p] = le[length-1-p] for p < length
+    p = jnp.arange(16, dtype=jnp.int32)[None, :]
+    src = jnp.clip(length[:, None] - 1 - p, 0, 15)
+    be = jnp.take_along_axis(le, src, axis=1)
+    be = jnp.where(p < length[:, None], be, jnp.uint8(0))
+    return be, length
+
+
+def _hash_element(col, h, *, mm: bool):
+    """One column's contribution: h' per row, ignoring validity (caller masks)."""
+    if isinstance(col, StringColumn):
+        padded, lens = col.padded()
+        return _mm_hash_bytes(padded, lens, h) if mm else _xx_hash_bytes(padded, lens, h)
+    if isinstance(col, Decimal128Column):
+        be, lens = _decimal128_java_bytes(col)
+        return _mm_hash_bytes(be, lens, h) if mm else _xx_hash_bytes(be, lens, h)
+
+    kind = col.dtype.kind
+    if kind in (Kind.FLOAT32, Kind.FLOAT64):
+        bits = _normalize_float_bits(col)
+        if kind == Kind.FLOAT32:
+            return _mm_hash_int(bits, h) if mm else _xx_hash_fixed4(bits.astype(_U32), h)
+        return _mm_hash_long(bits, h) if mm else _xx_hash_fixed8(bits.astype(_U64), h)
+    if kind == Kind.BOOL:
+        v = col.data.astype(jnp.int32)
+        return _mm_hash_int(v, h) if mm else _xx_hash_fixed4(v.astype(_U32), h)
+    if kind in (Kind.INT8, Kind.INT16, Kind.INT32, Kind.DATE32):
+        v = col.data.astype(jnp.int32)  # sign-extend to 4 bytes
+        return _mm_hash_int(v, h) if mm else _xx_hash_fixed4(v.astype(_U32), h)
+    if kind in (Kind.INT64, Kind.TIMESTAMP_MICROS):
+        v = col.data.astype(jnp.int64)
+        return _mm_hash_long(v, h) if mm else _xx_hash_fixed8(v.astype(_U64), h)
+    if kind in (Kind.DECIMAL32, Kind.DECIMAL64):
+        # unscaled value hashed as an 8-byte long (both hashes; xxhash64.cu:248-260)
+        v = col.data.astype(jnp.int64)
+        return _mm_hash_long(v, h) if mm else _xx_hash_fixed8(v.astype(_U64), h)
+    raise NotImplementedError(f"hash of {col.dtype}")
+
+
+def _hash_column(col, h, *, mm: bool):
+    """Chain one column into the running hash, with Spark null/nesting rules."""
+    if isinstance(col, StructColumn):
+        # Structs decompose into their children in order (murmur_hash.cu:117-131);
+        # a null struct row masks out all of its children's contributions.
+        valid = col.is_valid()
+        h_in = h
+        for child in col.children:
+            h = _hash_column(child, h, mm=mm)
+        return jnp.where(valid, h, h_in)
+    if isinstance(col, ListColumn):
+        return _hash_list(col, h, mm=mm)
+    upd = _hash_element(col, h, mm=mm)
+    if col.validity is None:
+        return upd
+    return jnp.where(col.validity, upd, h)
+
+
+def _hash_list(col: ListColumn, h, *, mm: bool):
+    """Serial element hashing of LIST rows, lockstep across rows.
+
+    Each row walks its own elements; rows shorter than the longest list stop
+    contributing (mask).  Null elements pass the seed through, like top-level
+    nulls (murmur_hash.cu:50-56).
+    """
+    child = col.child
+    if isinstance(child, (ListColumn, StructColumn)):
+        raise NotImplementedError("hash of nested list-of-nested not yet supported")
+    starts = col.offsets[:-1]
+    lens = col.offsets[1:] - col.offsets[:-1]
+    max_elems = int(jnp.max(lens)) if col.size else 0
+    row_valid = col.is_valid()
+
+    child_valid = child.is_valid()
+    if isinstance(child, StringColumn):
+        child_padded, child_lens = child.padded()
+    for j in range(max_elems):
+        idx = jnp.clip(starts + j, 0, max(child.size - 1, 0))
+        active = row_valid & (j < lens)
+        if isinstance(child, StringColumn):
+            upd = (
+                _mm_hash_bytes(child_padded[idx], child_lens[idx], h)
+                if mm
+                else _xx_hash_bytes(child_padded[idx], child_lens[idx], h)
+            )
+        else:
+            gathered = Column(child.data[idx], None, child.dtype)
+            upd = _hash_element(gathered, h, mm=mm)
+        elem_ok = active & child_valid[jnp.clip(idx, 0, max(child.size - 1, 0))]
+        h = jnp.where(elem_ok, upd, h)
+    return h
+
+
+# ---------------------------------------------------------------------------
+# public API (mirrors Hash.java:40-91)
+# ---------------------------------------------------------------------------
+
+HashInput = Union[Column, StringColumn, Decimal128Column, StructColumn, ListColumn]
+
+
+def murmur_hash32(columns: Sequence[HashInput], seed: int = 0) -> Column:
+    """Spark-exact Murmur3-32 row hash of the given columns (Hash.java:40-56)."""
+    if not columns:
+        raise ValueError("murmur_hash32 requires at least one column")
+    n = columns[0].size
+    h = jnp.full((n,), jnp.uint32(seed & 0xFFFFFFFF), dtype=_U32)
+    for col in columns:
+        h = _hash_column(col, h, mm=True)
+    return Column(h.astype(jnp.int32), None, DType(Kind.INT32))
+
+
+def xxhash64(columns: Sequence[HashInput], seed: int = DEFAULT_XXHASH64_SEED) -> Column:
+    """Spark-exact xxhash64 row hash of the given columns (Hash.java:58-91)."""
+    if not columns:
+        raise ValueError("xxhash64 requires at least one column")
+    n = columns[0].size
+    h = jnp.full((n,), jnp.uint64(seed & 0xFFFFFFFFFFFFFFFF), dtype=_U64)
+    for col in columns:
+        h = _hash_column(col, h, mm=False)
+    return Column(h.astype(jnp.int64), None, DType(Kind.INT64))

@@ -1,0 +1,3 @@
+from spark_rapids_jni_tpu.utils import bitmask
+
+__all__ = ["bitmask"]

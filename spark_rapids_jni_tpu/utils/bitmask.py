@@ -1,0 +1,41 @@
+"""Arrow validity-bitmask pack/unpack and bitwise utilities.
+
+The reference keeps validity as packed bits (cudf) and provides
+`bitmask_bitwise_or` (utilities.cu:24-72) for merging.  On TPU we keep validity
+unpacked (bool lanes) inside ops and pack only at interchange boundaries
+(JCUDF rows, serialized bloom filters, Arrow IPC).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def pack_bits(mask: jnp.ndarray) -> jnp.ndarray:
+    """bool[n] -> uint8[ceil(n/8)], LSB-first (Arrow order)."""
+    n = mask.shape[0]
+    pad = (-n) % 8
+    m = jnp.pad(mask.astype(jnp.uint8), (0, pad)).reshape(-1, 8)
+    weights = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))[None, :]
+    return jnp.sum(m * weights, axis=1, dtype=jnp.uint8)
+
+
+def unpack_bits(packed: jnp.ndarray, n: int) -> jnp.ndarray:
+    """uint8[ceil(n/8)] -> bool[n], LSB-first."""
+    bits = (packed[:, None] >> jnp.arange(8, dtype=jnp.uint8)[None, :]) & jnp.uint8(1)
+    return bits.reshape(-1)[:n].astype(jnp.bool_)
+
+
+def bitmask_or(masks) -> jnp.ndarray:
+    """Bitwise OR of equal-length packed masks (utilities.hpp:33-40 analog)."""
+    out = masks[0]
+    for m in masks[1:]:
+        out = out | m
+    return out
+
+
+def bitmask_and(masks) -> jnp.ndarray:
+    out = masks[0]
+    for m in masks[1:]:
+        out = out & m
+    return out

@@ -1,0 +1,156 @@
+"""Pure-python oracles implementing Spark semantics, for cross-checking kernels.
+
+These mirror Apache Spark's Murmur3_x86_32 / XXH64 (as re-specified by the
+reference's murmur_hash.cu / xxhash64.cu) in plain host python.  Used only by
+tests on randomized inputs; fixed vectors extracted from the reference JUnit
+suites pin the oracles themselves to Spark ground truth.
+"""
+
+import struct
+
+M32 = 0xFFFFFFFF
+M64 = 0xFFFFFFFFFFFFFFFF
+
+
+def _rotl32(x, r):
+    return ((x << r) | (x >> (32 - r))) & M32
+
+
+def _rotl64(x, r):
+    return ((x << r) | (x >> (64 - r))) & M64
+
+
+def mm_mix_k1(k1):
+    k1 = (k1 * 0xCC9E2D51) & M32
+    k1 = _rotl32(k1, 15)
+    return (k1 * 0x1B873593) & M32
+
+
+def mm_mix_h1(h1, k1):
+    h1 ^= k1
+    h1 = _rotl32(h1, 13)
+    return (h1 * 5 + 0xE6546B64) & M32
+
+
+def mm_fmix(h, length):
+    h = (h ^ length) & M32
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & M32
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & M32
+    h ^= h >> 16
+    return h
+
+
+def murmur32_int(v, seed):
+    return mm_fmix(mm_mix_h1(seed & M32, mm_mix_k1(v & M32)), 4)
+
+
+def murmur32_long(v, seed):
+    v &= M64
+    h = mm_mix_h1(seed & M32, mm_mix_k1(v & M32))
+    h = mm_mix_h1(h, mm_mix_k1((v >> 32) & M32))
+    return mm_fmix(h, 8)
+
+
+def murmur32_bytes(data: bytes, seed):
+    h = seed & M32
+    n = len(data)
+    for i in range(0, n - n % 4, 4):
+        (w,) = struct.unpack_from("<I", data, i)
+        h = mm_mix_h1(h, mm_mix_k1(w))
+    for i in range(n - n % 4, n):
+        b = data[i]
+        if b >= 128:
+            b -= 256  # sign extension: Spark's tail deviation
+        h = mm_mix_h1(h, mm_mix_k1(b & M32))
+    return mm_fmix(h, n)
+
+
+XX_P1 = 0x9E3779B185EBCA87
+XX_P2 = 0xC2B2AE3D27D4EB4F
+XX_P3 = 0x165667B19E3779F9
+XX_P4 = 0x85EBCA77C2B2AE63
+XX_P5 = 0x27D4EB2F165667C5
+
+
+def _xx_finalize(h):
+    h ^= h >> 33
+    h = (h * XX_P2) & M64
+    h ^= h >> 29
+    h = (h * XX_P3) & M64
+    h ^= h >> 32
+    return h
+
+
+def xxh64_bytes(data: bytes, seed):
+    seed &= M64
+    n = len(data)
+    offset = 0
+    if n >= 32:
+        v1 = (seed + XX_P1 + XX_P2) & M64
+        v2 = (seed + XX_P2) & M64
+        v3 = seed
+        v4 = (seed - XX_P1) & M64
+        while offset <= n - 32:
+            for i, v in enumerate((v1, v2, v3, v4)):
+                (w,) = struct.unpack_from("<Q", data, offset + 8 * i)
+                v = (v + w * XX_P2) & M64
+                v = (_rotl64(v, 31) * XX_P1) & M64
+                if i == 0:
+                    v1 = v
+                elif i == 1:
+                    v2 = v
+                elif i == 2:
+                    v3 = v
+                else:
+                    v4 = v
+            offset += 32
+        h = (_rotl64(v1, 1) + _rotl64(v2, 7) + _rotl64(v3, 12) + _rotl64(v4, 18)) & M64
+        for v in (v1, v2, v3, v4):
+            vk = (_rotl64((v * XX_P2) & M64, 31) * XX_P1) & M64
+            h = ((h ^ vk) * XX_P1 + XX_P4) & M64
+    else:
+        h = (seed + XX_P5) & M64
+    h = (h + n) & M64
+    while offset + 8 <= n:
+        (w,) = struct.unpack_from("<Q", data, offset)
+        k1 = (_rotl64((w * XX_P2) & M64, 31) * XX_P1) & M64
+        h = (_rotl64(h ^ k1, 27) * XX_P1 + XX_P4) & M64
+        offset += 8
+    if offset + 4 <= n:
+        (w,) = struct.unpack_from("<I", data, offset)
+        h = (_rotl64(h ^ ((w * XX_P1) & M64), 23) * XX_P2 + XX_P3) & M64
+        offset += 4
+    while offset < n:
+        h = (_rotl64(h ^ ((data[offset] * XX_P5) & M64), 11) * XX_P1) & M64
+        offset += 1
+    return _xx_finalize(h)
+
+
+def xxh64_int(v, seed):
+    return xxh64_bytes(struct.pack("<i", v), seed)
+
+
+def xxh64_long(v, seed):
+    return xxh64_bytes(struct.pack("<q", v), seed)
+
+
+def to_signed32(v):
+    v &= M32
+    return v - (1 << 32) if v >= (1 << 31) else v
+
+
+def to_signed64(v):
+    v &= M64
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def java_bigdecimal_bytes(unscaled: int) -> bytes:
+    """java.math.BigDecimal.unscaledValue().toByteArray(): minimal big-endian
+    two's complement (hash.cuh:56-104)."""
+    if unscaled >= 0:
+        nbytes = unscaled.bit_length() // 8 + 1  # leading sign bit must be 0
+    else:
+        nbytes = (unscaled + 1).bit_length() // 8 + 1
+    return unscaled.to_bytes(nbytes, "big", signed=True)

@@ -1,0 +1,47 @@
+"""Column data model tests."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu import columnar as c
+from spark_rapids_jni_tpu.utils import bitmask
+
+
+def test_fixed_width_roundtrip():
+    col = c.column([1, None, 3, -4], c.INT32)
+    assert col.size == 4
+    assert col.null_count() == 1
+    assert col.to_list() == [1, None, 3, -4]
+
+
+def test_strings_roundtrip():
+    vals = ["", "abc", None, "héllo", "Ā휠"]
+    col = c.strings_column(vals)
+    assert col.to_list() == vals
+    padded, lens = col.padded()
+    assert padded.shape[0] == 5
+    assert list(np.asarray(lens)) == [0, 3, 0, 6, 5]
+
+
+def test_strings_padded_roundtrip():
+    vals = [b"", b"abc", b"0123456789" * 5, b"x"]
+    col = c.strings_from_bytes(vals)
+    padded, lens = col.padded()
+    back = c.strings_from_padded(padded, lens)
+    assert [v for v in back.to_list()] == [v.decode() for v in vals]
+
+
+def test_decimal128_roundtrip():
+    vals = [0, 1, -1, (1 << 127) - 1, -(1 << 127), None, 10**30]
+    col = c.decimal128_column(vals, 38, 10)
+    assert col.unscaled_to_list() == vals
+
+
+def test_bitmask_pack_unpack():
+    rng = np.random.RandomState(0)
+    for n in (0, 1, 7, 8, 9, 63, 64, 100):
+        mask = jnp.asarray(rng.rand(n) > 0.5)
+        packed = bitmask.pack_bits(mask)
+        assert packed.shape[0] == (n + 7) // 8
+        back = bitmask.unpack_bits(packed, n)
+        np.testing.assert_array_equal(np.asarray(back), np.asarray(mask))

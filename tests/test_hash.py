@@ -1,0 +1,357 @@
+"""Hash op tests.
+
+Fixed expected values are extracted from the reference's JUnit suite
+(/root/reference/src/test/java/com/nvidia/spark/rapids/jni/HashTest.java), which in
+turn derived them from Apache Spark — they are Spark ground truth.  Randomized
+cases cross-check the device kernels against the pure-python oracles.
+"""
+
+import random
+import struct
+
+import numpy as np
+import pytest
+
+import spark_rapids_jni_tpu as srt
+from spark_rapids_jni_tpu import columnar as c
+from spark_rapids_jni_tpu.ops import murmur_hash32, xxhash64
+
+import spark_oracles as oracle
+
+LONG_STR = (
+    "A very long (greater than 128 bytes/char string) to test a multi hash-step data point "
+    "in the MD5 hash function. This string needed to be longer.A 60 character string to "
+    "test MD5's message padding algorithm"
+)
+MIXED_LONG_STR = (
+    "A very long (greater than 128 bytes/char string) to test a multi hash-step data point "
+    "in the MD5 hash function. This string needed to be longer."
+)
+
+F32_NAN_POS_LO = struct.unpack("<f", struct.pack("<I", 0x7F800001))[0]
+F32_NAN_POS_HI = struct.unpack("<f", struct.pack("<I", 0x7FFFFFFF))[0]
+F32_NAN_NEG_LO = struct.unpack("<f", struct.pack("<I", 0xFF800001))[0]
+F32_NAN_NEG_HI = struct.unpack("<f", struct.pack("<I", 0xFFFFFFFF))[0]
+F64_NAN_POS_LO = struct.unpack("<d", struct.pack("<Q", 0x7FF0000000000001))[0]
+F64_NAN_POS_HI = struct.unpack("<d", struct.pack("<Q", 0x7FFFFFFFFFFFFFFF))[0]
+F64_NAN_NEG_LO = struct.unpack("<d", struct.pack("<Q", 0xFFF0000000000001))[0]
+F64_NAN_NEG_HI = struct.unpack("<d", struct.pack("<Q", 0xFFFFFFFFFFFFFFFF))[0]
+
+F32_MIN_NORMAL = struct.unpack("<f", struct.pack("<I", 0x00800000))[0]
+F32_MAX = struct.unpack("<f", struct.pack("<I", 0x7F7FFFFF))[0]
+F64_MIN_NORMAL = struct.unpack("<d", struct.pack("<Q", 0x0010000000000000))[0]
+F64_MAX = struct.unpack("<d", struct.pack("<Q", 0x7FEFFFFFFFFFFFFF))[0]
+
+
+# --- murmur3-32 vectors (HashTest.java:47-151) -----------------------------------
+
+
+def test_murmur_strings():
+    col = c.strings_column(
+        ["a", "B\nc", "dE\"Ā\tā 휠휡\\Fg2'", LONG_STR,
+         "hiJ휠휡휠휡", None]
+    )
+    out = murmur_hash32([col], seed=42)
+    assert out.to_list() == [1485273170, 1709559900, 1423943036, 176121990, 1199621434, 42]
+
+
+def test_murmur_ints_two_columns():
+    v0 = c.column([0, 100, None, None, -(2**31), None], c.INT32)
+    v1 = c.column([0, None, -100, None, None, 2**31 - 1], c.INT32)
+    out = murmur_hash32([v0, v1], seed=42)
+    assert out.to_list() == [59727262, 751823303, -1080202046, 42, 723455942, 133916647]
+
+
+def test_murmur_doubles():
+    col = c.column(
+        [0.0, None, 100.0, -100.0, F64_MIN_NORMAL, F64_MAX,
+         F64_NAN_POS_HI, F64_NAN_POS_LO, F64_NAN_NEG_HI, F64_NAN_NEG_LO,
+         float("inf"), float("-inf")],
+        c.FLOAT64,
+    )
+    out = murmur_hash32([col], seed=0)
+    assert out.to_list() == [
+        1669671676, 0, -544903190, -1831674681, 150502665, 474144502,
+        1428788237, 1428788237, 1428788237, 1428788237, 420913893, 1915664072,
+    ]
+
+
+def test_murmur_timestamps():
+    col = c.column(
+        [0, None, 100, -100, 0x123456789ABCDEF, None, -0x123456789ABCDEF],
+        c.TIMESTAMP_MICROS,
+    )
+    out = murmur_hash32([col], seed=42)
+    assert out.to_list() == [-1670924195, 42, 1114849490, 904948192, 657182333, 42, -57193045]
+
+
+def test_murmur_decimal64():
+    col = c.column([0, 100, -100, 0x123456789ABCDEF, -0x123456789ABCDEF],
+                   c.decimal(18, 7))
+    out = murmur_hash32([col], seed=42)
+    assert out.to_list() == [-1670924195, 1114849490, 904948192, 657182333, -57193045]
+
+
+def test_murmur_decimal32():
+    col = c.column([0, 100, -100, 0x12345678, -0x12345678], c.decimal(9, 3))
+    out = murmur_hash32([col], seed=42)
+    assert out.to_list() == [-1670924195, 1114849490, 904948192, -958054811, -1447702630]
+
+
+def test_murmur_dates():
+    col = c.column([0, None, 100, -100, 0x12345678, None, -0x12345678], c.DATE32)
+    out = murmur_hash32([col], seed=42)
+    assert out.to_list() == [933211791, 42, 751823303, -1080202046, -1721170160, 42, 1852996993]
+
+
+def test_murmur_floats():
+    col = c.column(
+        [0.0, 100.0, -100.0, F32_MIN_NORMAL, F32_MAX, None,
+         F32_NAN_POS_LO, F32_NAN_POS_HI, F32_NAN_NEG_LO, F32_NAN_NEG_HI,
+         float("inf"), float("-inf")],
+        c.FLOAT32,
+    )
+    out = murmur_hash32([col], seed=411)
+    assert out.to_list() == [
+        -235179434, 1812056886, 2028471189, 1775092689, -1531511762, 411,
+        -1053523253, -1053523253, -1053523253, -1053523253, -1526256646, 930080402,
+    ]
+
+
+def test_murmur_bools():
+    v0 = c.column([None, True, False, True, None, False], c.BOOL)
+    v1 = c.column([None, True, False, None, False, True], c.BOOL)
+    out = murmur_hash32([v0, v1], seed=0)
+    assert out.to_list() == [0, -1589400010, -239939054, -68075478, 593689054, -1194558265]
+
+
+def _mixed_columns():
+    strings = c.strings_column(
+        ["a", "B\n", "dE\"Ā\tā 휠휡", MIXED_LONG_STR, None, None]
+    )
+    integers = c.column([0, 100, -100, -(2**31), 2**31 - 1, None], c.INT32)
+    doubles = c.column(
+        [0.0, 100.0, -100.0, F64_NAN_POS_LO, F64_NAN_POS_HI, None], c.FLOAT64
+    )
+    floats = c.column(
+        [0.0, 100.0, -100.0, F32_NAN_NEG_LO, F32_NAN_NEG_HI, None], c.FLOAT32
+    )
+    bools = c.column([True, False, None, False, True, None], c.BOOL)
+    return strings, integers, doubles, floats, bools
+
+
+def test_murmur_mixed():
+    cols = _mixed_columns()
+    out = murmur_hash32(list(cols), seed=1868)
+    assert out.to_list() == [1936985022, 720652989, 339312041, 1400354989, 769988643, 1868]
+
+
+def test_murmur_struct_matches_flat():
+    cols = _mixed_columns()
+    struct_col = c.StructColumn(children=tuple(cols), validity=None)
+    flat = murmur_hash32(list(cols), seed=1868)
+    nested = murmur_hash32([struct_col], seed=1868)
+    assert flat.to_list() == nested.to_list()
+
+
+def test_murmur_nested_struct_matches_flat():
+    strings, integers, doubles, floats, bools = _mixed_columns()
+    s1 = c.StructColumn((strings, integers), None)
+    s2 = c.StructColumn((s1, doubles), None)
+    s3 = c.StructColumn((bools,), None)
+    top = c.StructColumn((s2, floats, s3), None)
+    flat = murmur_hash32([strings, integers, doubles, floats, bools], seed=1868)
+    nested = murmur_hash32([top], seed=1868)
+    assert flat.to_list() == nested.to_list()
+
+
+def test_murmur_int_lists():
+    # intListCV from HashTest.java:225-240: serial element hashing == transposed columns
+    child = c.column([0, -2, 3, 2**31 - 1, 5, -6, None, -(2**31)], c.INT32)
+    offsets = np.array([0, 0, 3, 4, 7, 8, 8], dtype=np.int32)
+    validity = np.array([False, True, True, True, True, False])
+    lst = c.ListColumn(
+        offsets=np.asarray(offsets), child=child, validity=np.asarray(validity)
+    )
+    i1 = c.column([None, 0, None, 5, -(2**31), None], c.INT32)
+    i2 = c.column([None, -2, 2**31 - 1, None, None, None], c.INT32)
+    i3 = c.column([None, 3, None, -6, None, None], c.INT32)
+    expected = murmur_hash32([i1, i2, i3], seed=1868)
+    result = murmur_hash32([lst], seed=1868)
+    assert result.to_list() == expected.to_list()
+
+
+def test_murmur_string_lists():
+    strs = [None, "a", "B\n", "", "dE\"Ā\tā", " 휠휡",
+            "A very long (greater than 128 bytes/char string) to test a multi"
+            " hash-step data point in the Murmur3 hash function. This string needed to be longer.",
+            ""]
+    child = c.strings_column(strs)
+    offsets = np.array([0, 2, 4, 6, 7, 8, 8], dtype=np.int32)
+    validity = np.array([True, True, True, True, True, False])
+    lst = c.ListColumn(np.asarray(offsets), child, np.asarray(validity))
+    s1 = c.strings_column(["a", "B\n", "dE\"Ā\tā",
+                           strs[6], None, None])
+    s2 = c.strings_column([None, "", " 휠휡", None, "", None])
+    expected = murmur_hash32([c.StructColumn((s1, s2), None)], seed=1868)
+    result = murmur_hash32([lst], seed=1868)
+    assert result.to_list() == expected.to_list()
+
+
+# --- xxhash64 vectors (HashTest.java:266-430) ------------------------------------
+
+
+def test_xxhash64_strings():
+    col = c.strings_column(
+        ["a", "B\nc", "dE\"Ā\tā 휠휡\\Fg2'", LONG_STR,
+         "hiJ휠휡휠휡", None]
+    )
+    out = xxhash64([col])
+    assert out.to_list() == [
+        -8582455328737087284, 2221214721321197934, 5798966295358745941,
+        -4834097201550955483, -3782648123388245694, 42,
+    ]
+
+
+def test_xxhash64_ints():
+    v0 = c.column([0, 100, None, None, -(2**31), None], c.INT32)
+    v1 = c.column([0, None, -100, None, None, 2**31 - 1], c.INT32)
+    out = xxhash64([v0, v1])
+    assert out.to_list() == [
+        1151812168208346021, -7987742665087449293, 8990748234399402673,
+        42, 2073849959933241805, 1508894993788531228,
+    ]
+
+
+def test_xxhash64_doubles():
+    col = c.column(
+        [0.0, None, 100.0, -100.0, F64_MIN_NORMAL, F64_MAX,
+         F64_NAN_POS_HI, F64_NAN_POS_LO, F64_NAN_NEG_HI, F64_NAN_NEG_LO,
+         float("inf"), float("-inf")],
+        c.FLOAT64,
+    )
+    out = xxhash64([col])
+    assert out.to_list() == [
+        -5252525462095825812, 42, -7996023612001835843, 5695175288042369293,
+        6181148431538304986, -4222314252576420879, -3127944061524951246,
+        -3127944061524951246, -3127944061524951246, -3127944061524951246,
+        5810986238603807492, 5326262080505358431,
+    ]
+
+
+def test_xxhash64_timestamps():
+    col = c.column(
+        [0, None, 100, -100, 0x123456789ABCDEF, None, -0x123456789ABCDEF],
+        c.TIMESTAMP_MICROS,
+    )
+    out = xxhash64([col])
+    assert out.to_list() == [
+        -5252525462095825812, 42, 8713583529807266080, 5675770457807661948,
+        1941233597257011502, 42, -1318946533059658749,
+    ]
+
+
+def test_xxhash64_decimal64():
+    col = c.column([0, 100, -100, 0x123456789ABCDEF, -0x123456789ABCDEF],
+                   c.decimal(18, 7))
+    out = xxhash64([col])
+    assert out.to_list() == [
+        -5252525462095825812, 8713583529807266080, 5675770457807661948,
+        1941233597257011502, -1318946533059658749,
+    ]
+
+
+def test_xxhash64_decimal32():
+    col = c.column([0, 100, -100, 0x12345678, -0x12345678], c.decimal(9, 3))
+    out = xxhash64([col])
+    assert out.to_list() == [
+        -5252525462095825812, 8713583529807266080, 5675770457807661948,
+        -7728554078125612835, 3142315292375031143,
+    ]
+
+
+def test_xxhash64_dates():
+    col = c.column([0, None, 100, -100, 0x12345678, None, -0x12345678], c.DATE32)
+    out = xxhash64([col])
+    assert out.to_list() == [
+        3614696996920510707, 42, -7987742665087449293, 8990748234399402673,
+        6954428822481665164, 42, -4294222333805341278,
+    ]
+
+
+def test_xxhash64_floats():
+    col = c.column(
+        [0.0, 100.0, -100.0, F32_MIN_NORMAL, F32_MAX, None,
+         F32_NAN_POS_LO, F32_NAN_POS_HI, F32_NAN_NEG_LO, F32_NAN_NEG_HI,
+         float("inf"), float("-inf")],
+        c.FLOAT32,
+    )
+    out = xxhash64([col])
+    assert out.to_list() == [
+        3614696996920510707, -8232251799677946044, -6625719127870404449,
+        -6699704595004115126, -1065250890878313112, 42, 2692338816207849720,
+        2692338816207849720, 2692338816207849720, 2692338816207849720,
+        -5940311692336719973, -7580553461823983095,
+    ]
+
+
+def test_xxhash64_bools():
+    v0 = c.column([None, True, False, True, None, False], c.BOOL)
+    v1 = c.column([None, True, False, None, False, True], c.BOOL)
+    out = xxhash64([v0, v1])
+    assert out.to_list() == [
+        42, 9083826852238114423, 1151812168208346021, -6698625589789238999,
+        3614696996920510707, 7945966957015589024,
+    ]
+
+
+def test_xxhash64_mixed():
+    cols = _mixed_columns()
+    out = xxhash64(list(cols))
+    assert out.to_list() == [
+        7451748878409563026, 6024043102550151964, 3380664624738534402,
+        8444697026100086329, -5888679192448042852, 42,
+    ]
+
+
+# --- decimal128 (bigdecimal byte path) vs oracle ---------------------------------
+
+
+def test_decimal128_hash_vs_oracle():
+    vals = [0, 1, -1, 255, -255, 10**20, -(10**20), (1 << 127) - 1, -(1 << 127),
+            0x00FF, 0x7F, -0x80, -0x100, 12345678901234567890123456789012345678]
+    col = c.decimal128_column(vals, 38, 2)
+    mm = murmur_hash32([col], seed=42).to_list()
+    xx = xxhash64([col]).to_list()
+    for i, v in enumerate(vals):
+        b = oracle.java_bigdecimal_bytes(v)
+        assert mm[i] == oracle.to_signed32(oracle.murmur32_bytes(b, 42)), f"mm row {i}"
+        assert xx[i] == oracle.to_signed64(oracle.xxh64_bytes(b, 42)), f"xx row {i}"
+
+
+# --- randomized cross-checks vs oracle -------------------------------------------
+
+
+def test_random_strings_vs_oracle():
+    rng = random.Random(1234)
+    strs = []
+    for _ in range(100):
+        n = rng.randrange(0, 200)
+        strs.append(bytes(rng.randrange(256) for _ in range(n)))
+    col = c.strings_from_bytes(strs)
+    mm = murmur_hash32([col], seed=7).to_list()
+    xx = xxhash64([col], seed=99).to_list()
+    for i, s in enumerate(strs):
+        assert mm[i] == oracle.to_signed32(oracle.murmur32_bytes(s, 7)), f"mm row {i} len {len(s)}"
+        assert xx[i] == oracle.to_signed64(oracle.xxh64_bytes(s, 99)), f"xx row {i} len {len(s)}"
+
+
+def test_random_longs_vs_oracle():
+    rng = random.Random(99)
+    vals = [rng.randrange(-(2**63), 2**63) for _ in range(256)]
+    col = c.column(vals, c.INT64)
+    mm = murmur_hash32([col], seed=3).to_list()
+    xx = xxhash64([col], seed=3).to_list()
+    for i, v in enumerate(vals):
+        assert mm[i] == oracle.to_signed32(oracle.murmur32_long(v, 3))
+        assert xx[i] == oracle.to_signed64(oracle.xxh64_long(v, 3))
