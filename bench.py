@@ -1,0 +1,62 @@
+"""Headline benchmark: Spark-exact murmur3-32 over a single INT32 column.
+
+This is BASELINE.md staged config 1 ("Hash.murmurHash32 on a single INT32
+ColumnVector").  The reference publishes no absolute numbers (BASELINE.md:3-16,
+nvbench infra only); `vs_baseline` is therefore reported against a nominal
+1.0 Grows/s — the order of magnitude an A100/H100-class GPU achieves on this
+memory-bound elementwise kernel (4B in / 4B out per row at ~TB/s HBM).
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+NOMINAL_BASELINE_ROWS_PER_S = 1.0e9
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_rapids_jni_tpu.columnar import Column, INT32
+    from spark_rapids_jni_tpu.ops import murmur_hash32
+
+    n = int(os.environ.get("BENCH_ROWS", 1 << 24))  # 16M rows
+    rng = np.random.RandomState(42)
+    data = jnp.asarray(rng.randint(-(2**31), 2**31, size=n).astype(np.int32))
+
+    @jax.jit
+    def hash_col(d):
+        return murmur_hash32([Column(d, None, INT32)], seed=42).data
+
+    out = hash_col(data)
+    out.block_until_ready()  # compile + warm
+
+    iters = int(os.environ.get("BENCH_ITERS", 50))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = hash_col(data)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+
+    rows_per_s = n / dt
+    print(
+        json.dumps(
+            {
+                "metric": "murmur3_32_int32_throughput",
+                "value": round(rows_per_s / 1e9, 4),
+                "unit": "Grows/s",
+                "vs_baseline": round(rows_per_s / NOMINAL_BASELINE_ROWS_PER_S, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

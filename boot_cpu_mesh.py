@@ -1,0 +1,26 @@
+"""pytest boot plugin: re-exec onto a virtual 8-device CPU mesh.
+
+In the interactive axon environment a sitecustomize registers the TPU platform
+at interpreter startup, before any conftest can set JAX env vars.  This plugin
+is loaded via ``-p boot_cpu_mesh`` (pyproject addopts), which happens during
+pytest config parsing — *before* global output capture — so an execve here
+keeps stdout intact.  No-op outside axon (e.g. the driver's CI env) and when
+SRT_TEST_TPU=1 (run the suite on the real chip).
+"""
+
+import os
+import sys
+
+if (
+    os.environ.get("SRT_TEST_TPU") != "1"
+    and os.environ.get("SRT_REEXECED") != "1"
+    and os.environ.get("PALLAS_AXON_POOL_IPS")
+):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env["SRT_REEXECED"] = "1"
+    os.execve(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], env)

@@ -1,0 +1,15 @@
+from spark_rapids_jni_tpu.models.nds import (
+    QueryStepConfig,
+    QueryStepOut,
+    local_query_step,
+    make_distributed_query_step,
+    make_example_batch,
+)
+
+__all__ = [
+    "QueryStepConfig",
+    "QueryStepOut",
+    "local_query_step",
+    "make_distributed_query_step",
+    "make_example_batch",
+]

@@ -394,6 +394,23 @@ def _hash_list(col: ListColumn, h, *, mm: bool):
 
 
 # ---------------------------------------------------------------------------
+# raw-array entry points (for shuffle partitioning / shard_map pipelines)
+# ---------------------------------------------------------------------------
+
+
+def murmur3_raw_int64(data: jnp.ndarray, seed: int = 0) -> jnp.ndarray:
+    """Spark murmur3-32 of an int64 vector, as uint32 (no Column wrapper)."""
+    h = jnp.full(data.shape, jnp.uint32(seed & 0xFFFFFFFF), dtype=_U32)
+    return _mm_hash_long(data.astype(jnp.int64), h)
+
+
+def xxhash64_raw_int64(data: jnp.ndarray, seed: int = DEFAULT_XXHASH64_SEED) -> jnp.ndarray:
+    """xxhash64 of an int64 vector, as uint64 (no Column wrapper)."""
+    s = jnp.full(data.shape, jnp.uint64(seed & 0xFFFFFFFFFFFFFFFF), dtype=_U64)
+    return _xx_hash_fixed8(data.astype(jnp.int64).astype(_U64), s)
+
+
+# ---------------------------------------------------------------------------
 # public API (mirrors Hash.java:40-91)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,25 @@
+from spark_rapids_jni_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    make_mesh,
+    data_sharding,
+    model_sharding,
+    replicated,
+)
+from spark_rapids_jni_tpu.parallel.shuffle import (
+    ShuffleResult,
+    all_to_all_shuffle,
+    bucket_by_partition,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "make_mesh",
+    "data_sharding",
+    "model_sharding",
+    "replicated",
+    "ShuffleResult",
+    "all_to_all_shuffle",
+    "bucket_by_partition",
+]

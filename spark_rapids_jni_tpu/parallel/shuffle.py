@@ -1,0 +1,92 @@
+"""Columnar hash-repartition (shuffle) over the device mesh.
+
+The reference's shuffle transport is UCX in the host Spark plugin; this module is
+its TPU-native replacement (SURVEY.md §2.3 planning note): rows move between
+devices with a single dense `all_to_all` over ICI/DCN instead of point-to-point
+RDMA.  XLA requires static shapes, so the exchange uses fixed-capacity buckets:
+
+    local rows --bucket by hash % ndev--> [ndev, capacity] padded send buffer
+              --all_to_all--> [ndev, capacity] receive buffer + slot-valid mask
+
+Capacity defaults to the local row count (no row can ever be dropped); callers
+with bounded skew can pass a smaller capacity and check `dropped` (a per-shard
+count of rows that exceeded a destination bucket, analogous to a shuffle spill
+that the caller must retry with a bigger capacity).
+
+All functions here run *inside* `shard_map` (they use axis names), composing
+with the query-step pipelines in models/.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS
+
+
+class ShuffleResult(NamedTuple):
+    columns: Dict[str, jnp.ndarray]  # [ndev * capacity] received rows (padded)
+    valid: jnp.ndarray  # bool[ndev * capacity] slot occupancy
+    dropped: jnp.ndarray  # int32 scalar: rows lost to capacity overflow (local)
+
+
+def bucket_by_partition(part: jnp.ndarray, n_parts: int, capacity: int):
+    """Assign each local row a slot in a [n_parts, capacity] send layout.
+
+    Returns (slot index [n], in_capacity mask [n], per-bucket counts [n_parts]).
+    Rows overflowing a bucket get mask False.
+    """
+    n = part.shape[0]
+    # rank of each row within its partition = number of earlier rows with same part
+    # computed stably via sort: order rows by partition, rank = position - start.
+    order = jnp.argsort(part, stable=True)
+    sorted_part = part[order]
+    counts = jnp.bincount(part, length=n_parts).astype(jnp.int32)
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts, dtype=jnp.int32)[:-1]]
+    )
+    rank_sorted = jnp.arange(n, dtype=jnp.int32) - starts[sorted_part]
+    rank = jnp.zeros((n,), jnp.int32).at[order].set(rank_sorted)
+    in_cap = rank < capacity
+    slot = part.astype(jnp.int32) * capacity + jnp.minimum(rank, capacity - 1)
+    return slot, in_cap, counts
+
+
+def all_to_all_shuffle(
+    columns: Dict[str, jnp.ndarray],
+    part: jnp.ndarray,
+    capacity: int,
+    axis: str = DATA_AXIS,
+) -> ShuffleResult:
+    """Exchange rows so each device receives the rows whose ``part`` equals its
+    index along ``axis``.  Must be called inside shard_map over ``axis``.
+    """
+    ndev = jax.lax.axis_size(axis)
+    slot, in_cap, _counts = bucket_by_partition(part, ndev, capacity)
+    dropped = jnp.sum(~in_cap).astype(jnp.int32)
+
+    send_valid = (
+        jnp.zeros((ndev * capacity,), jnp.bool_)
+        .at[jnp.where(in_cap, slot, ndev * capacity)]
+        .set(True, mode="drop")
+        .reshape(ndev, capacity)
+    )
+
+    recv_cols = {}
+    for name, data in columns.items():
+        send = (
+            jnp.zeros((ndev * capacity,) + data.shape[1:], data.dtype)
+            .at[jnp.where(in_cap, slot, ndev * capacity)]
+            .set(data, mode="drop")
+            .reshape((ndev, capacity) + data.shape[1:])
+        )
+        recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0, tiled=False)
+        recv_cols[name] = recv.reshape((ndev * capacity,) + data.shape[1:])
+
+    recv_valid = jax.lax.all_to_all(
+        send_valid, axis, split_axis=0, concat_axis=0, tiled=False
+    ).reshape(ndev * capacity)
+    return ShuffleResult(recv_cols, recv_valid, dropped)

@@ -1,18 +1,25 @@
-"""Test configuration: force an 8-device virtual CPU mesh before JAX initializes.
+"""Test configuration: force an 8-device virtual CPU mesh.
 
-Multi-chip sharding logic is tested on a virtual CPU mesh (the driver dry-runs the
-real multi-chip path separately via __graft_entry__.dryrun_multichip); kernel
-correctness tests are backend-agnostic and also run here on CPU.
+Multi-chip sharding logic is tested on a virtual CPU mesh (the driver dry-runs
+the real multi-chip path separately via __graft_entry__.dryrun_multichip);
+kernel correctness tests are backend-agnostic and also run here on CPU.
+
+In the interactive axon environment, the sitecustomize-registered TPU platform
+is escaped by the boot_cpu_mesh plugin (repo root, loaded via pyproject addopts
+before pytest starts output capture), which re-execs pytest with a clean env.
+Set SRT_TEST_TPU=1 to run the suite on the real chip instead (slow: every
+kernel recompiles remotely).
 """
 
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+if os.environ.get("SRT_TEST_TPU") != "1":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
