@@ -1,0 +1,795 @@
+"""Spark-exact string -> integer / decimal casts, and `conv`-style base casts.
+
+Reference behavior being reproduced (semantics only, TPU-first implementation):
+- ``CastStrings.toInteger`` (reference ``CastStrings.java:36-68``,
+  ``cast_string.cu:159`` ``string_to_integer_kernel``): per-row parser with
+  optional whitespace strip, sign, digit accumulation with exact overflow
+  detection, non-ANSI truncation at a decimal point, ANSI error row capture
+  (``CastStringJni.cpp:37-57`` -> ``CastException``).
+- ``CastStrings.toDecimal`` (``cast_string.cu:392`` ``string_to_decimal_kernel``
+  with the two-pass validate/accumulate design of ``validate_and_exponent``,
+  ``cast_string.cu:248-374``): scientific notation, half-up rounding at the
+  scale boundary, precision overflow checks.
+- ``CastStrings.toIntegersWithBase`` / ``fromIntegersWithBase``
+  (``CastStringJni.cpp:159-257``): Spark ``conv()`` semantics — prefix match
+  ``^\\s*(-?[0-9a-fA-F]+).*``, junk -> 0, empty/whitespace -> null, uint64
+  wraparound for negatives, hex output without leading zeros.
+
+Where the reference walks each row with one GPU thread (SIMT), here every
+character position is a vectorized step over all rows (SIMD-over-lanes): the
+parser state machine advances with `lax.scan` across the padded byte matrix,
+keeping one small state vector per row.  This keeps the inner loop on the VPU
+with static shapes, which is what XLA needs to pipeline it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from spark_rapids_jni_tpu.columnar import dtypes
+from spark_rapids_jni_tpu.columnar.column import (
+    Column,
+    Decimal128Column,
+    StringColumn,
+    strings_from_padded,
+)
+from spark_rapids_jni_tpu.columnar.dtypes import DType, Kind
+from spark_rapids_jni_tpu.utils import int128
+
+__all__ = [
+    "CastException",
+    "string_to_integer",
+    "string_to_decimal",
+    "to_integers_with_base",
+    "from_integers_with_base",
+]
+
+
+class CastException(ValueError):
+    """ANSI-mode cast failure; carries the first offending row, mirroring the
+    reference's ``CastException`` (``CastException.java``, thrown from
+    ``validate_ansi_column`` at ``cast_string.cu:602-635``)."""
+
+    def __init__(self, string_with_error: str, row_with_error: int):
+        super().__init__(
+            f"Error casting data on row {row_with_error}: {string_with_error}"
+        )
+        self.string_with_error = string_with_error
+        self.row_with_error = row_with_error
+
+
+# Whitespace per the reference's is_whitespace (cast_string.cu:46-56):
+# C0 control codes 0x00-0x1F plus ' ' — i.e. any byte <= 0x20.  Bytes >= 0x80
+# are "negative chars" there and never whitespace; uint8 <= 0x20 matches that.
+def _is_ws(c):
+    return c <= jnp.uint8(0x20)
+
+
+def _is_digit(c):
+    return (c >= jnp.uint8(ord("0"))) & (c <= jnp.uint8(ord("9")))
+
+
+_INT_BOUNDS = {
+    Kind.INT8: (-(2**7), 2**7 - 1),
+    Kind.INT16: (-(2**15), 2**15 - 1),
+    Kind.INT32: (-(2**31), 2**31 - 1),
+    Kind.INT64: (-(2**63), 2**63 - 1),
+}
+
+
+def _leading_ws_count(padded, lens):
+    """Per-row count of leading whitespace bytes (within the row length)."""
+    L = padded.shape[1]
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    ws_run = _is_ws(padded) & (pos < lens[:, None])
+    return jnp.sum(jnp.cumprod(ws_run.astype(jnp.int32), axis=1), axis=1).astype(
+        jnp.int32
+    )
+
+
+def _sign_and_start(padded, lens, strip: bool, signed: bool):
+    """Apply leading-whitespace skip + sign detection; returns (sign, i0).
+
+    Mirrors cast_string.cu:184-201 (integer) / :325-341 (decimal): skip ws only
+    when strip, then one optional +/- (signed types only).
+    """
+    n, L = padded.shape
+    if strip:
+        p = _leading_ws_count(padded, lens)
+    else:
+        p = jnp.zeros((n,), dtype=jnp.int32)
+    c = jnp.take_along_axis(
+        padded, jnp.clip(p, 0, max(L - 1, 0))[:, None], axis=1
+    )[:, 0]
+    in_range = p < lens
+    if signed:
+        is_minus = in_range & (c == jnp.uint8(ord("-")))
+        is_plus = in_range & (c == jnp.uint8(ord("+")))
+        sign = jnp.where(is_minus, jnp.int32(-1), jnp.int32(1))
+        i0 = p + (is_minus | is_plus).astype(jnp.int32)
+    else:
+        sign = jnp.ones((n,), dtype=jnp.int32)
+        i0 = p
+    return sign, i0
+
+
+@functools.partial(jax.jit, static_argnames=("ansi_mode", "strip", "min_v", "max_v"))
+def _string_to_integer_kernel(
+    padded, lens, valid_in, *, ansi_mode: bool, strip: bool, min_v: int, max_v: int
+):
+    """Vectorized port of string_to_integer_kernel (cast_string.cu:159-245)."""
+    n, L = padded.shape
+    signed = min_v < 0
+    sign, i0 = _sign_and_start(padded, lens, strip, signed)
+    positive = sign > 0
+
+    valid0 = valid_in & (lens > 0) & (i0 < lens)
+
+    # element-wise overflow guards in int64; bounds are the target type's
+    max_div10 = jnp.int64(max_v // 10)
+    # C++ truncates toward zero: INT_MIN/10
+    min_div10 = jnp.int64(-((-min_v) // 10)) if signed else jnp.int64(0)
+
+    def step(state, xs):
+        val, valid, trunc, trailing, done = state
+        chr_col, j = xs
+        active = valid0 & (j >= i0) & (j < lens) & valid & ~done
+
+        ws = _is_ws(chr_col)
+        dig = _is_digit(chr_col)
+
+        # decision chain, in reference order (cast_string.cu:205-236)
+        inv_trailing = trailing & ~ws
+        set_trunc = (
+            ~inv_trailing & ~trunc & (chr_col == jnp.uint8(ord("."))) & (not ansi_mode)
+        )
+        other = ~inv_trailing & ~set_trunc & ~dig
+        set_trailing = other & ws & (j != i0) & strip
+        invalid_now = active & (inv_trailing | (other & ~set_trailing))
+
+        trunc2 = trunc | (active & set_trunc)
+        trailing2 = trailing | (active & set_trailing)
+
+        acc = active & ~invalid_now & ~trunc2 & ~trailing2 & dig
+        first = j == i0
+        d = (chr_col - jnp.uint8(ord("0"))).astype(jnp.int64)
+
+        ov1 = ~first & jnp.where(positive, val > max_div10, val < min_div10)
+        val1 = jnp.where(first, val, val * 10)
+        ov2 = jnp.where(
+            positive, val1 > jnp.int64(max_v) - d, val1 < jnp.int64(min_v) + d
+        )
+        overflow = acc & (ov1 | ov2)
+        val2 = jnp.where(
+            acc & ~overflow, jnp.where(positive, val1 + d, val1 - d), val
+        )
+
+        invalid_now = invalid_now | overflow
+        return (
+            val2,
+            valid & ~invalid_now,
+            trunc2,
+            trailing2,
+            done | invalid_now,
+        ), None
+
+    init = (
+        jnp.zeros((n,), dtype=jnp.int64),
+        jnp.ones((n,), dtype=jnp.bool_),
+        jnp.zeros((n,), dtype=jnp.bool_),
+        jnp.zeros((n,), dtype=jnp.bool_),
+        jnp.zeros((n,), dtype=jnp.bool_),
+    )
+    xs = (padded.T, jnp.arange(L, dtype=jnp.int32))
+    (val, valid, _, _, _), _ = lax.scan(step, init, xs)
+    valid = valid0 & valid
+    return jnp.where(valid, val, jnp.int64(0)), valid
+
+
+def _raise_if_ansi_error(col: StringColumn, valid_out: np.ndarray):
+    """Mirror validate_ansi_column (cast_string.cu:602-635): first row that was
+    non-null on input but null on output raises CastException."""
+    valid_in = np.asarray(col.is_valid())
+    errors = valid_in & ~valid_out
+    if errors.any():
+        row = int(np.argmax(errors))
+        chars = np.asarray(col.chars)
+        offs = np.asarray(col.offsets)
+        s = bytes(chars[offs[row] : offs[row + 1]]).decode(
+            "utf-8", errors="surrogatepass"
+        )
+        raise CastException(s, row)
+
+
+def string_to_integer(
+    col: StringColumn,
+    dtype: DType,
+    ansi_mode: bool = False,
+    strip: bool = True,
+) -> Column:
+    """Cast a string column to an integral column with Spark semantics.
+
+    Equivalent of ``CastStrings.toInteger`` (CastStrings.java:36-68).  Invalid
+    rows become null (or raise :class:`CastException` in ANSI mode); values
+    after a decimal point are truncated in non-ANSI mode; whitespace (bytes
+    <= 0x20) is stripped when ``strip``.
+    """
+    if dtype.kind not in _INT_BOUNDS:
+        raise ValueError(f"not an integral type: {dtype}")
+    min_v, max_v = _INT_BOUNDS[dtype.kind]
+    n = col.size
+    if n == 0:
+        return Column(jnp.zeros((0,), dtype=dtype.jnp_dtype), None, dtype)
+    padded, lens = col.padded()
+    val, valid = _string_to_integer_kernel(
+        padded,
+        lens,
+        col.is_valid(),
+        ansi_mode=ansi_mode,
+        strip=strip,
+        min_v=min_v,
+        max_v=max_v,
+    )
+    if ansi_mode:
+        # the only host sync on the cast path, and only in ANSI mode
+        _raise_if_ansi_error(col, np.asarray(valid))
+    return Column(val.astype(dtype.jnp_dtype), valid, dtype)
+
+
+# ---------------------------------------------------------------------------
+# string -> decimal
+# ---------------------------------------------------------------------------
+
+# validate_and_exponent states (cast_string.cu:261-270)
+_ST_DIGITS = 0
+_ST_EXPONENT = 1
+_ST_DECIMAL_POINT = 2
+_ST_EXPONENT_OR_SIGN = 3
+_ST_EXPONENT_SIGN = 4
+_ST_TRAILING_WS = 5
+_ST_INVALID = 6
+
+
+@functools.partial(jax.jit, static_argnames=("strip", "precision", "scale"))
+def _string_to_decimal_kernel(
+    padded, lens, valid_in, *, precision: int, scale: int, strip: bool
+):
+    """Vectorized port of string_to_decimal_kernel + validate_and_exponent
+    (cast_string.cu:248-582).  ``scale`` is cudf-convention here (value =
+    unscaled * 10**scale) to keep the formulas aligned with the reference.
+
+    Accumulation runs in 128-bit limb math regardless of target width; the
+    overflow guards compare against the target width's bounds, which makes the
+    wider accumulator exactly equivalent to the reference's in-type arithmetic.
+    """
+    n, L = padded.shape
+    sign, i0 = _sign_and_start(padded, lens, strip, signed=True)
+    positive = sign > 0
+    first_digit = i0
+
+    valid0 = valid_in & (lens > 0) & (i0 < lens)
+
+    B_DOT = jnp.uint8(ord("."))
+    B_E1, B_E2 = jnp.uint8(ord("e")), jnp.uint8(ord("E"))
+    B_PLUS, B_MINUS = jnp.uint8(ord("+")), jnp.uint8(ord("-"))
+
+    # ---- pass 1: validate + find decimal location (validate_and_exponent) ----
+    def v_step(state, xs):
+        st, dl, expv, exp_pos, last_digit = state
+        chr_col, j = xs
+        active = valid0 & (j >= i0) & (j < lens) & (st != _ST_INVALID)
+        char_num = (j - i0).astype(jnp.int32)
+
+        ws = _is_ws(chr_col)
+        dig = _is_digit(chr_col)
+        allow_trailing = ws & (char_num != 0) & strip
+
+        in_digits = (st == _ST_DIGITS) | (st == _ST_DECIMAL_POINT)
+        # ST_DIGITS / ST_DECIMAL_POINT transitions (cast_string.cu:278-293)
+        d_dot = in_digits & ~dig & (chr_col == B_DOT) & (dl == -1)
+        d_exp = in_digits & ~dig & ~d_dot & ((chr_col == B_E1) | (chr_col == B_E2))
+        d_tws = in_digits & ~dig & ~d_dot & ~d_exp & allow_trailing
+        d_inv = in_digits & ~dig & ~d_dot & ~d_exp & ~d_tws
+        st_digits_next = jnp.where(
+            dig,
+            _ST_DIGITS,
+            jnp.where(
+                d_dot,
+                _ST_DECIMAL_POINT,
+                jnp.where(
+                    d_exp,
+                    _ST_EXPONENT_OR_SIGN,
+                    jnp.where(d_tws, _ST_TRAILING_WS, _ST_INVALID),
+                ),
+            ),
+        )
+
+        # ST_EXPONENT_OR_SIGN transitions (:294-308)
+        eos = st == _ST_EXPONENT_OR_SIGN
+        e_sign = (chr_col == B_PLUS) | (chr_col == B_MINUS)
+        e_tws = ~e_sign & allow_trailing
+        st_eos_next = jnp.where(
+            e_sign,
+            _ST_EXPONENT_SIGN,
+            jnp.where(
+                e_tws,
+                _ST_TRAILING_WS,
+                jnp.where(dig, _ST_EXPONENT, _ST_INVALID),
+            ),
+        )
+
+        # ST_EXPONENT_SIGN / ST_EXPONENT (:309-316)
+        in_exp = (st == _ST_EXPONENT) | (st == _ST_EXPONENT_SIGN)
+        st_exp_next = jnp.where(dig, _ST_EXPONENT, _ST_INVALID)
+
+        # ST_TRAILING_WHITESPACE (:275-277)
+        in_tws = st == _ST_TRAILING_WS
+        st_tws_next = jnp.where(ws, _ST_TRAILING_WS, _ST_INVALID)
+
+        st_next = jnp.where(
+            in_digits,
+            st_digits_next,
+            jnp.where(
+                eos, st_eos_next, jnp.where(in_exp, st_exp_next, st_tws_next)
+            ),
+        ).astype(jnp.int32)
+        st2 = jnp.where(active, st_next, st)
+
+        dl2 = jnp.where(active & d_dot, char_num, dl)
+        exp_pos2 = jnp.where(active & eos & (chr_col == B_MINUS), False, exp_pos)
+
+        # record where digits ended (":353-356")
+        left_digits = (
+            active
+            & (st == _ST_DIGITS)
+            & (st2 != _ST_DIGITS)
+            & (st2 != _ST_DECIMAL_POINT)
+            & (last_digit == lens)
+        )
+        last_digit2 = jnp.where(left_digits, j, last_digit)
+
+        # exponent accumulation (":358-364"), int64 guards
+        acc = active & (st2 == _ST_EXPONENT) & dig
+        d = (chr_col - jnp.uint8(ord("0"))).astype(jnp.int64)
+        first = expv == 0
+        maxd10 = jnp.int64((2**63 - 1) // 10)
+        mind10 = jnp.int64(-((2**63) // 10))
+        ov1 = ~first & jnp.where(exp_pos2, expv > maxd10, expv < mind10)
+        ev1 = jnp.where(first, expv, expv * 10)
+        ov2 = jnp.where(
+            exp_pos2,
+            ev1 > jnp.int64(2**63 - 1) - d,
+            ev1 < jnp.int64(-(2**63)) + d,
+        )
+        exp_overflow = acc & (ov1 | ov2)
+        ev2 = jnp.where(
+            acc & ~exp_overflow, jnp.where(exp_pos2, ev1 + d, ev1 - d), expv
+        )
+        st2 = jnp.where(exp_overflow, _ST_INVALID, st2)
+
+        return (st2, dl2, ev2, exp_pos2, last_digit2), None
+
+    v_init = (
+        jnp.full((n,), _ST_DIGITS, dtype=jnp.int32),
+        jnp.full((n,), -1, dtype=jnp.int32),
+        jnp.zeros((n,), dtype=jnp.int64),
+        jnp.ones((n,), dtype=jnp.bool_),
+        lens.astype(jnp.int32),
+    )
+    xs = (padded.T, jnp.arange(L, dtype=jnp.int32))
+    (st, dl, expv, _, last_digit1), _ = lax.scan(v_step, v_init, xs)
+
+    valid = valid0 & (st != _ST_INVALID)
+    # decimal location defaults to the end of digits, then exponent shift (:367-371)
+    dl = jnp.where(dl < 0, last_digit1 - first_digit, dl)
+    # clamp into int32 range after exponent add (int64 exponents are absurd inputs
+    # that the downstream significant-digit check rejects anyway)
+    dl64 = dl.astype(jnp.int64) + expv
+    dl = jnp.clip(dl64, -(2**31), 2**31 - 1).astype(jnp.int32)
+
+    # ---- pass 2a: count significant digits before the decimal (":425-441") ----
+    def s_step(state, xs):
+        digits_found, count, done = state
+        chr_col, j = xs
+        active = (
+            valid
+            & (j >= first_digit)
+            & (j < lens)
+            & ~done
+            & (digits_found < dl)
+        )
+        is_e = (chr_col == B_E1) | (chr_col == B_E2)
+        done2 = done | (active & is_e)
+        is_num = active & ~is_e & (chr_col != B_DOT)
+        digits_found2 = digits_found + is_num.astype(jnp.int32)
+        sig = is_num & ((count != 0) | (chr_col != jnp.uint8(ord("0"))))
+        return (digits_found2, count + sig.astype(jnp.int32), done2), None
+
+    s_init = (
+        jnp.zeros((n,), dtype=jnp.int32),
+        jnp.zeros((n,), dtype=jnp.int32),
+        jnp.zeros((n,), dtype=jnp.bool_),
+    )
+    (_, sig_in_string, _), _ = lax.scan(s_step, s_init, xs)
+
+    # target-width bounds for overflow guards
+    if precision <= dtypes.MAX_DECIMAL32_PRECISION:
+        tmin, tmax = -(2**31), 2**31 - 1
+    elif precision <= dtypes.MAX_DECIMAL64_PRECISION:
+        tmin, tmax = -(2**63), 2**63 - 1
+    else:
+        tmin, tmax = -(2**127), 2**127 - 1
+    maxd10_h, maxd10_l = int128.const128(tmax // 10)
+    mind10_h, mind10_l = int128.const128(-((-tmin) // 10))
+
+    def will_ov_mul10(vh, vl, pos):
+        over_pos = int128.gt(vh, vl, jnp.int64(maxd10_h), jnp.uint64(maxd10_l))
+        over_neg = int128.lt(vh, vl, jnp.int64(mind10_h), jnp.uint64(mind10_l))
+        return jnp.where(pos, over_pos, over_neg)
+
+    def will_ov_add(vh, vl, d, pos):
+        # pos: v > tmax - d ; neg: v < tmin + d  (d in [0,9])
+        mh, ml = int128.const128(tmax)
+        mh2, ml2 = int128.const128(tmin)
+        bh, bl = int128.sub_small(jnp.int64(mh), jnp.uint64(ml), d)
+        ch, cl = int128.add_small(jnp.int64(mh2), jnp.uint64(ml2), d)
+        return jnp.where(
+            pos, int128.gt(vh, vl, bh, bl), int128.lt(vh, vl, ch, cl)
+        )
+
+    # last processable digit count: scale units past the decimal (":450-452")
+    last_digit = dl - jnp.int32(scale)
+
+    # ---- pass 2b: march digits, accumulate with rounding (":462-529") ----
+    def m_step(state, xs):
+        vh, vl, total, precise, found_sig, rdigits, dloc, valid_m, done = state
+        chr_col, j = xs
+        active = (
+            valid_m & (j >= first_digit) & (j < lens) & ~done & (last_digit >= 0)
+        )
+        dig = _is_digit(chr_col)
+        is_dot = chr_col == B_DOT
+        # '.' -> continue; other non-digit -> break (stop processing)
+        stop = active & ~dig & ~is_dot
+        done2 = done | stop
+        proc = active & dig
+
+        d = (chr_col - jnp.uint8(ord("0"))).astype(jnp.int64)
+        needs_round = proc & (
+            (precise + 1 > precision) | (total + 1 > last_digit)
+        )
+
+        # rounding path (":474-512"): half-up toward the sign
+        do_inc = needs_round & (d >= 5)
+        inc_ov = do_inc & will_ov_add(vh, vl, jnp.int64(1), positive)
+        rh, rl = int128.add_small(vh, vl, jnp.int64(1))
+        rh2, rl2 = int128.sub_small(vh, vl, jnp.int64(1))
+        nh = jnp.where(positive, rh, rh2)
+        nl = jnp.where(positive, rl, rl2)
+        apply_inc = do_inc & ~inc_ov
+        before = int128.count_digits(vh, vl)
+        after = int128.count_digits(nh, nl)
+        orig_zero = (vh == 0) & (vl == jnp.uint64(0))
+        grew = apply_inc & ~orig_zero & (after > before)
+        vh2 = jnp.where(apply_inc, nh, vh)
+        vl2 = jnp.where(apply_inc, nl, vl)
+        total2 = total + grew.astype(jnp.int32)
+        precise2 = precise + grew.astype(jnp.int32)
+        dloc2 = dloc + grew.astype(jnp.int32)
+        rdigits2 = rdigits + grew.astype(jnp.int32)
+        done2 = done2 | needs_round
+        valid2 = valid_m & ~inc_ov
+
+        # normal accumulate path (":515-527")
+        acc = proc & ~needs_round
+        total3 = total2 + acc.astype(jnp.int32)
+        sig_now = acc & (found_sig | (total3 > dloc2) | (d != 0))
+        found_sig2 = found_sig | sig_now
+        precise3 = precise2 + sig_now.astype(jnp.int32)
+
+        first = j == first_digit
+        ov1 = acc & ~first & will_ov_mul10(vh2, vl2, positive)
+        th, tl = int128.mul_small(vh2, vl2, 10)
+        vh3 = jnp.where(acc & ~first, th, vh2)
+        vl3 = jnp.where(acc & ~first, tl, vl2)
+        ov2 = acc & will_ov_add(vh3, vl3, d, positive)
+        ah, al = int128.add_small(vh3, vl3, d)
+        sh, sl = int128.sub_small(vh3, vl3, d)
+        apply = acc & ~ov1 & ~ov2
+        vh4 = jnp.where(apply, jnp.where(positive, ah, sh), jnp.where(acc, vh2, vh3))
+        vl4 = jnp.where(apply, jnp.where(positive, al, sl), jnp.where(acc, vl2, vl3))
+        # on overflow the reference breaks with valid=false
+        acc_ov = acc & (ov1 | ov2)
+        valid3 = valid2 & ~acc_ov
+        done3 = done2 | acc_ov
+
+        return (
+            jnp.where(acc, vh4, vh2),
+            jnp.where(acc, vl4, vl2),
+            total3,
+            precise3,
+            found_sig2,
+            rdigits2,
+            dloc2,
+            valid3,
+            done3,
+        ), None
+
+    m_init = (
+        jnp.zeros((n,), dtype=jnp.int64),
+        jnp.zeros((n,), dtype=jnp.uint64),
+        jnp.zeros((n,), dtype=jnp.int32),
+        jnp.zeros((n,), dtype=jnp.int32),
+        jnp.zeros((n,), dtype=jnp.bool_),
+        jnp.zeros((n,), dtype=jnp.int32),
+        dl,
+        jnp.ones((n,), dtype=jnp.bool_),
+        jnp.zeros((n,), dtype=jnp.bool_),
+    )
+    (vh, vl, total, precise, _, rdigits, dloc, valid_m, _), _ = lax.scan(
+        m_step, m_init, xs
+    )
+    valid = valid & valid_m
+
+    # ---- post-march scaling (":531-575") ----
+    preceding_zeros = jnp.where(dloc < 0, -dloc, 0)
+    if scale > 0:
+        zeros_to_decimal = jnp.maximum(0, dloc - total - jnp.int32(scale))
+    else:
+        zeros_to_decimal = jnp.maximum(0, dloc - total)
+    sig_before_decimal = sig_in_string + zeros_to_decimal + rdigits
+    valid = valid & (jnp.int32(precision + scale) >= sig_before_decimal)
+
+    # zero-pad loops (":548-555" and ":562-573"): 40 multiplies covers any
+    # in-range value; a nonzero value needing more than 39 would overflow
+    # anyway, which we detect directly.
+    ZCAP = 40
+    zero_val = (vh == 0) & (vl == jnp.uint64(0))
+
+    def pad_zeros(count, vh, vl, valid):
+        valid = valid & ~((count > ZCAP) & ~zero_val)
+
+        def body(i, carry):
+            vh, vl, valid_p = carry
+            run = (i < count) & valid_p
+            ov = run & will_ov_mul10(vh, vl, positive)
+            th, tl = int128.mul_small(vh, vl, 10)
+            apply = run & ~ov
+            return (
+                jnp.where(apply, th, vh),
+                jnp.where(apply, tl, vl),
+                valid_p & ~ov,
+            )
+
+        return lax.fori_loop(0, ZCAP, body, (vh, vl, valid))
+
+    vh, vl, valid = pad_zeros(zeros_to_decimal, vh, vl, valid)
+    precise = precise + zeros_to_decimal
+
+    digits_after_decimal = precise - sig_before_decimal + preceding_zeros
+    digits_needed = jnp.minimum(
+        jnp.int32(precision) - sig_before_decimal, jnp.int32(-scale)
+    )
+    pad2_count = jnp.maximum(0, digits_needed - digits_after_decimal)
+    vh, vl, valid = pad_zeros(pad2_count, vh, vl, valid)
+
+    vh = jnp.where(valid, vh, jnp.int64(0))
+    vl = jnp.where(valid, vl, jnp.uint64(0))
+    return vh, vl, valid
+
+
+def string_to_decimal(
+    col: StringColumn,
+    precision: int,
+    scale: int,
+    ansi_mode: bool = False,
+    strip: bool = True,
+):
+    """Cast strings to a Spark decimal(precision, scale) column.
+
+    Equivalent of ``CastStrings.toDecimal`` (CastStrings.java:70-100).  ``scale``
+    is Spark-convention (digits after the decimal point); internally the cudf
+    convention ``-scale`` keeps formulas aligned with the reference kernel.
+    Storage follows precision like cudf: <=9 int32, <=18 int64, else 128-bit.
+    """
+    cudf_scale = -scale
+    dtype = dtypes.decimal(precision, scale)
+    n = col.size
+    if n == 0:
+        if dtype.kind == Kind.DECIMAL128:
+            z = jnp.zeros((0,), dtype=jnp.int64)
+            return Decimal128Column(z, z.astype(jnp.uint64), None, dtype)
+        return Column(jnp.zeros((0,), dtype=dtype.jnp_dtype), None, dtype)
+    padded, lens = col.padded()
+    vh, vl, valid = _string_to_decimal_kernel(
+        padded,
+        lens,
+        col.is_valid(),
+        precision=precision,
+        scale=cudf_scale,
+        strip=strip,
+    )
+    if ansi_mode:
+        _raise_if_ansi_error(col, np.asarray(valid))
+    if dtype.kind == Kind.DECIMAL128:
+        return Decimal128Column(vh, vl, valid, dtype)
+    return Column(vl.astype(jnp.int64).astype(dtype.jnp_dtype), valid, dtype)
+
+
+# ---------------------------------------------------------------------------
+# Spark conv(): to/from integers with base
+# ---------------------------------------------------------------------------
+
+
+def _hex_value(c):
+    """Hex digit value or 255 for non-hex bytes."""
+    dec = jnp.where(_is_digit(c), c - jnp.uint8(ord("0")), jnp.uint8(255))
+    up = jnp.where(
+        (c >= jnp.uint8(ord("A"))) & (c <= jnp.uint8(ord("F"))),
+        c - jnp.uint8(ord("A") - 10),
+        jnp.uint8(255),
+    )
+    lo = jnp.where(
+        (c >= jnp.uint8(ord("a"))) & (c <= jnp.uint8(ord("f"))),
+        c - jnp.uint8(ord("a") - 10),
+        jnp.uint8(255),
+    )
+    return jnp.minimum(dec, jnp.minimum(up, lo))
+
+
+# \s in cudf regex: space, \t, \n, \r, \f, \v
+def _is_regex_ws(c):
+    return (c == jnp.uint8(0x20)) | ((c >= jnp.uint8(0x09)) & (c <= jnp.uint8(0x0D)))
+
+
+@functools.partial(jax.jit, static_argnames=("base",))
+def _to_integers_with_base_kernel(padded, lens, valid_in, *, base: int):
+    """Spark conv() parse: ``^\\s*(-?[digits]+).*`` -> uint64 with wraparound;
+    junk -> 0; empty/whitespace-only -> null (CastStringJni.cpp:159-227)."""
+    n, L = padded.shape
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    inb = pos < lens[:, None]
+    ws_run = _is_regex_ws(padded) & inb
+    lead = jnp.sum(jnp.cumprod(ws_run.astype(jnp.int32), axis=1), axis=1).astype(
+        jnp.int32
+    )
+    all_ws = lead >= lens  # matches ^\s*$ (also empty)
+
+    c0 = jnp.take_along_axis(
+        padded, jnp.clip(lead, 0, max(L - 1, 0))[:, None], axis=1
+    )[:, 0]
+    neg = (c0 == jnp.uint8(ord("-"))) & (lead < lens)
+    start = lead + neg.astype(jnp.int32)
+
+    if base == 16:
+        dv = _hex_value(padded)
+    else:
+        dv = jnp.where(_is_digit(padded), padded - jnp.uint8(ord("0")), jnp.uint8(255))
+    after_start = pos >= start[:, None]
+    is_d = (dv != jnp.uint8(255)) & inb & after_start
+    # digit run immediately at `start` (regex: digits must directly follow \s*-?)
+    run = jnp.cumprod(
+        jnp.where(after_start, is_d.astype(jnp.int32), 1), axis=1
+    )
+    take_mask = (run > 0) & after_start
+    ndigits = jnp.sum(take_mask.astype(jnp.int32), axis=1)
+    matched = ndigits > 0
+
+    def step(val, xs):
+        d_col, take = xs
+        val2 = val * jnp.uint64(base) + d_col.astype(jnp.uint64)
+        return jnp.where(take, val2, val), None
+
+    val, _ = lax.scan(
+        step,
+        jnp.zeros((n,), dtype=jnp.uint64),
+        (dv.T, take_mask.T),
+    )
+    val = jnp.where(neg, jnp.uint64(0) - val, val)
+    val = jnp.where(matched, val, jnp.uint64(0))
+    valid = valid_in & ~all_ws
+    return val, valid
+
+
+def to_integers_with_base(col: StringColumn, base: int = 10) -> Column:
+    """Spark ``conv(str, base, 10)`` front half: parse string in ``base`` to
+    UINT64 (stored as int64 bits) with wraparound for negatives.
+
+    Mirrors ``CastStrings.toIntegersWithBase`` (CastStrings.java:116-130).
+    """
+    if base not in (10, 16):
+        raise CastException(f"Bases supported 10, 16; Actual: {base}", 0)
+    n = col.size
+    if n == 0:
+        return Column(jnp.zeros((0,), dtype=jnp.uint64), None, dtypes.UINT64)
+    padded, lens = col.padded()
+    val, valid = _to_integers_with_base_kernel(
+        padded, lens, col.is_valid(), base=base
+    )
+    return Column(val, valid, dtypes.UINT64)
+
+
+@functools.partial(jax.jit, static_argnames=("base", "signed", "width"))
+def _format_int_kernel(data, *, base: int, signed: bool, width: int):
+    """integer -> digit bytes, no leading zeros (uppercase hex).
+
+    Hex formats the two's-complement bits at the column's type width (cudf
+    integers_to_hex behavior: int32 -5 -> "FFFFFFFB", not 16 F's).
+    """
+    if base == 10:
+        max_digits = 20
+    else:
+        max_digits = 16
+    if signed and base == 10:
+        i = data.astype(jnp.int64)
+        negative = i < 0
+        u = i.astype(jnp.uint64)
+        mag = jnp.where(negative, jnp.uint64(0) - u, u)
+    else:
+        negative = jnp.zeros(data.shape, dtype=jnp.bool_)
+        # sign-extend then mask to the type width so hex shows type-width bits
+        mask = jnp.uint64((1 << (8 * width)) - 1) if width < 8 else jnp.uint64(
+            0xFFFFFFFFFFFFFFFF
+        )
+        mag = data.astype(jnp.int64).astype(jnp.uint64) & mask
+
+    # digit j counted from the least-significant end
+    if base == 16:
+        shifts = jnp.arange(max_digits, dtype=jnp.uint64) * jnp.uint64(4)
+        digs = ((mag[:, None] >> shifts[None, :]) & jnp.uint64(0xF)).astype(jnp.uint8)
+        above = mag[:, None] >> shifts[None, :]
+        has = above != jnp.uint64(0)
+    else:
+        divs = jnp.asarray([10**k for k in range(max_digits)], dtype=jnp.uint64)
+        quot = mag[:, None] // divs[None, :]
+        digs = (quot % jnp.uint64(10)).astype(jnp.uint8)
+        has = quot != jnp.uint64(0)
+
+    ndig = jnp.maximum(jnp.sum(has.astype(jnp.int32), axis=1), 1)
+    lengths = ndig + negative.astype(jnp.int32)
+    # byte at output position p: '-' if p==0 and negative, else digit
+    # (length-1-p-ish reversed); gather from digs
+    out_pos = jnp.arange(max_digits + 1, dtype=jnp.int32)[None, :]
+    digit_pos = out_pos - negative.astype(jnp.int32)[:, None]
+    src = ndig[:, None] - 1 - digit_pos
+    src_c = jnp.clip(src, 0, max_digits - 1)
+    dsel = jnp.take_along_axis(digs, src_c, axis=1)
+    chars = jnp.where(
+        dsel < 10, dsel + jnp.uint8(ord("0")), dsel - 10 + jnp.uint8(ord("A"))
+    )
+    bytes_out = jnp.where(
+        (out_pos == 0) & negative[:, None], jnp.uint8(ord("-")), chars
+    )
+    in_len = out_pos < lengths[:, None]
+    return jnp.where(in_len, bytes_out, jnp.uint8(0)), lengths
+
+
+def from_integers_with_base(col: Column, base: int = 10) -> StringColumn:
+    """Format integers as strings in ``base`` (CastStrings.java:133-152).
+
+    base 10: signed columns print a leading '-', UINT64 columns (the Spark
+    ``conv`` path) print unsigned.  base 16 is always unsigned uppercase over
+    the two's-complement bits at the column's type width, with no leading
+    zeros (zero -> "0").
+    """
+    if base not in (10, 16):
+        raise CastException(f"Bases supported 10, 16; Actual: {base}", 0)
+    n = col.size
+    if n == 0:
+        return StringColumn(
+            jnp.zeros((0,), dtype=jnp.uint8),
+            jnp.zeros((1,), dtype=jnp.int32),
+            None,
+        )
+    signed = col.data.dtype.kind == "i"
+    width = col.data.dtype.itemsize
+    padded, lengths = _format_int_kernel(
+        col.data, base=base, signed=signed, width=width
+    )
+    return strings_from_padded(padded, lengths, col.validity)

@@ -1,0 +1,152 @@
+"""Vectorized 128-bit integer arithmetic as (hi int64, lo uint64) limb pairs.
+
+TPUs have no native int128; the MXU/VPU operate on 32-bit lanes and JAX's x64
+mode executes 64-bit integer ops as 32-bit pairs.  Spark's DECIMAL128 semantics
+(reference: decimal_utils.cu `chunked256`, cast_string.cu `__int128_t` paths)
+therefore run here as two's-complement (hi, lo) limb arithmetic: every helper is
+elementwise over same-shape arrays and safe under jit.
+
+Conventions: value = hi * 2**64 + lo  (hi signed int64, lo unsigned uint64).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+MASK64 = (1 << 64) - 1
+_MASK32 = (1 << 32) - 1
+
+
+def const128(v: int):
+    """Split a python int into scalar (hi int64, lo uint64) numpy constants."""
+    v &= (1 << 128) - 1
+    hi = (v >> 64) & MASK64
+    if hi >= 1 << 63:
+        hi -= 1 << 64
+    return np.int64(hi), np.uint64(v & MASK64)
+
+
+def from_int64(x):
+    """Sign-extend int64 -> (hi, lo)."""
+    x = x.astype(jnp.int64)
+    hi = jnp.where(x < 0, jnp.int64(-1), jnp.int64(0))
+    return hi, x.astype(jnp.uint64)
+
+
+def to_int64(hi, lo):
+    """Truncate to the low 64 bits as signed."""
+    del hi
+    return lo.astype(jnp.int64)
+
+
+def add(ah, al, bh, bl):
+    lo = al + bl
+    carry = (lo < al).astype(jnp.int64)
+    return ah + bh + carry, lo
+
+
+def add_small(hi, lo, d):
+    """(hi, lo) + d where d is a small non-negative int64/array."""
+    d = jnp.asarray(d).astype(jnp.uint64)
+    lo2 = lo + d
+    carry = (lo2 < lo).astype(jnp.int64)
+    return hi + carry, lo2
+
+
+def sub_small(hi, lo, d):
+    d = jnp.asarray(d).astype(jnp.uint64)
+    lo2 = lo - d
+    borrow = (lo2 > lo).astype(jnp.int64)
+    return hi - borrow, lo2
+
+
+def neg(hi, lo):
+    nh = ~hi
+    nl = ~lo
+    lo2 = nl + jnp.uint64(1)
+    # +1 carries into hi exactly when nl was all-ones, i.e. lo2 wrapped to 0
+    carry = (lo2 == jnp.uint64(0)).astype(jnp.int64)
+    return nh + carry, lo2
+
+
+def abs_(hi, lo):
+    is_neg = hi < 0
+    nh, nl = neg(hi, lo)
+    return jnp.where(is_neg, nh, hi), jnp.where(is_neg, nl, lo)
+
+
+def mul_small(hi, lo, k: int):
+    """(hi, lo) * k for a small positive python-int k (fits in 32 bits).
+
+    The low-limb product is built from 32-bit halves so no intermediate
+    exceeds uint64.
+    """
+    ku = jnp.uint64(k)
+    a = lo >> jnp.uint64(32)
+    b = lo & jnp.uint64(_MASK32)
+    t = b * ku
+    u = a * ku + (t >> jnp.uint64(32))
+    lo2 = (u << jnp.uint64(32)) | (t & jnp.uint64(_MASK32))
+    carry = (u >> jnp.uint64(32)).astype(jnp.int64)
+    return hi * jnp.int64(k) + carry, lo2
+
+
+def shl1(hi, lo):
+    hi2 = (hi << jnp.int64(1)) | (lo >> jnp.uint64(63)).astype(jnp.int64)
+    return hi2, lo << jnp.uint64(1)
+
+
+def lt(ah, al, bh, bl):
+    """Signed (ah,al) < (bh,bl)."""
+    return (ah < bh) | ((ah == bh) & (al < bl))
+
+
+def gt(ah, al, bh, bl):
+    return (ah > bh) | ((ah == bh) & (al > bl))
+
+
+def eq(ah, al, bh, bl):
+    return (ah == bh) & (al == bl)
+
+
+def lt_const(hi, lo, v: int):
+    bh, bl = const128(v)
+    return lt(hi, lo, jnp.int64(bh), jnp.uint64(bl))
+
+
+def gt_const(hi, lo, v: int):
+    bh, bl = const128(v)
+    return gt(hi, lo, jnp.int64(bh), jnp.uint64(bl))
+
+
+def eq_const(hi, lo, v: int):
+    bh, bl = const128(v)
+    return eq(hi, lo, jnp.int64(bh), jnp.uint64(bl))
+
+
+def select(mask, ah, al, bh, bl):
+    return jnp.where(mask, ah, bh), jnp.where(mask, al, bl)
+
+
+# |value| >= 10**k comparisons, used for digit counting of 128-bit magnitudes.
+_POW10_TABLE = [const128(10**k) for k in range(40)]
+
+
+def count_digits(hi, lo):
+    """Number of base-10 digits of |value| (0 -> 0 digits, like the reference's
+    count_digits which loops while val != 0; cast_string.cu:490-497)."""
+    mh, ml = abs_(hi, lo)
+    count = jnp.zeros(hi.shape, dtype=jnp.int32)
+    for k in range(40):
+        ph, pl = _POW10_TABLE[k]
+        ge = ~lt(mh, ml, jnp.int64(ph), jnp.uint64(pl))
+        count = count + ge.astype(jnp.int32)
+    return count
+
+
+def to_python_ints(hi, lo):
+    """Host materialization to a list of python ints (test/oracle use)."""
+    hi_np = np.asarray(hi).astype(np.int64)
+    lo_np = np.asarray(lo).astype(np.uint64)
+    return [int(h) * (1 << 64) + int(l) for h, l in zip(hi_np, lo_np)]

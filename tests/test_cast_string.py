@@ -1,0 +1,339 @@
+"""Tests for string->integer/decimal casts and base conversions.
+
+Vectors mirror the reference's CastStringsTest.java (castToIntegerTest:34,
+castToIntegerNoStripTest:63, castToIntegerAnsiTest:92, castToDecimalTest:162,
+castToDecimalNoStripTest:194, baseDec2HexTest*:238-355), plus fuzz against a
+host oracle implementing the same state machine.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import dtypes
+from spark_rapids_jni_tpu.columnar.column import strings_column
+from spark_rapids_jni_tpu.ops.cast_string import (
+    CastException,
+    from_integers_with_base,
+    string_to_decimal,
+    string_to_integer,
+    to_integers_with_base,
+)
+
+
+def cast_ints(strs, dtype, ansi=False, strip=True):
+    return string_to_integer(strings_column(strs), dtype, ansi, strip).to_list()
+
+
+class TestCastToInteger:
+    # CastStringsTest.castToIntegerTest:34
+    def test_strip(self):
+        assert cast_ints(
+            [" 3", "9", "4", "2", "20.5", None, "7.6asd", "\x00 \x1f1\x14"],
+            dtypes.INT64,
+        ) == [3, 9, 4, 2, 20, None, None, 1]
+        assert cast_ints(
+            ["5", "1  ", "0", "2", "7.1", None, "asdf", "\x00 \x1f1\x14"],
+            dtypes.INT32,
+        ) == [5, 1, 0, 2, 7, None, None, 1]
+        assert cast_ints(
+            ["2", "3", " 4 ", "5", " 9.2 ", None, "7.8.3", "\x00 \x1f1\x14"],
+            dtypes.INT8,
+        ) == [2, 3, 4, 5, 9, None, None, 1]
+
+    # CastStringsTest.castToIntegerNoStripTest:63
+    def test_no_strip(self):
+        assert cast_ints(
+            [" 3", "9", "4", "2", "20.5", None, "7.6asd"], dtypes.INT64, strip=False
+        ) == [None, 9, 4, 2, 20, None, None]
+        assert cast_ints(
+            ["5", "1 ", "0", "2", "7.1", None, "asdf"], dtypes.INT32, strip=False
+        ) == [5, None, 0, 2, 7, None, None]
+        assert cast_ints(
+            ["2", "3", " 4 ", "5.6", " 9.2 ", None, "7.8.3"],
+            dtypes.INT8,
+            strip=False,
+        ) == [2, 3, None, 5, None, None, None]
+
+    # CastStringsTest.castToIntegerAnsiTest:92
+    def test_ansi_ok(self):
+        assert cast_ints(["3", "9", "4", "2", "20"], dtypes.INT64, ansi=True) == [
+            3,
+            9,
+            4,
+            2,
+            20,
+        ]
+
+    def test_ansi_throws_with_row(self):
+        with pytest.raises(CastException) as e:
+            cast_ints(["asdf", "9.0.2", "- 4e", "b2", "20-fe"], dtypes.INT64, ansi=True)
+        assert e.value.string_with_error == "asdf"
+        assert e.value.row_with_error == 0
+
+    def test_ansi_rejects_decimal_point(self):
+        with pytest.raises(CastException) as e:
+            cast_ints(["1", "20.5"], dtypes.INT64, ansi=True)
+        assert e.value.row_with_error == 1
+
+    def test_overflow(self):
+        assert cast_ints(["127", "128", "-128", "-129"], dtypes.INT8) == [
+            127,
+            None,
+            -128,
+            None,
+        ]
+        assert cast_ints(
+            ["9223372036854775807", "9223372036854775808", "-9223372036854775808"],
+            dtypes.INT64,
+        ) == [2**63 - 1, None, -(2**63)]
+
+    def test_signs_and_empties(self):
+        assert cast_ints(["+5", "-5", "+", "-", "", "  ", "5-", "5+"], dtypes.INT32) == [
+            5,
+            -5,
+            None,
+            None,
+            None,
+            None,
+            None,
+            None,
+        ]
+
+    def test_truncation_only_non_ansi(self):
+        assert cast_ints([".5", "0.", "3.9999", "3."], dtypes.INT32) == [0, 0, 3, 3]
+
+
+def cast_dec(strs, precision, scale, ansi=False, strip=True):
+    """scale is Spark-convention (digits after the point)."""
+    return string_to_decimal(
+        strings_column(strs), precision, scale, ansi, strip
+    ).to_list()
+
+
+def unscaled(strs, precision, scale, **kw):
+    col = string_to_decimal(strings_column(strs), precision, scale, **kw)
+    import numpy as np
+
+    data = np.asarray(col.data) if hasattr(col, "data") else None
+    if data is not None:
+        vals = [int(v) for v in data]
+        va = col.validity
+        if va is None:
+            return vals
+        return [v if m else None for v, m in zip(vals, np.asarray(va))]
+    return col.unscaled_to_list()
+
+
+class TestCastToDecimal:
+    # CastStringsTest.castToDecimalTest:162 (cudf scales {0,0,-1} == spark {0,0,1})
+    def test_strip(self):
+        assert unscaled(
+            [" 3", "9", "4", "2", "20.5", None, "7.6asd", "\x00 \x1f1\x14"],
+            2,
+            0,
+        ) == [3, 9, 4, 2, 21, None, None, 1]
+        assert unscaled(
+            ["5", "1 ", "0", "2", "7.1", None, "asdf", "\x00 \x1f1\x14"], 10, 0
+        ) == [5, 1, 0, 2, 7, None, None, 1]
+        assert unscaled(
+            ["2", "3", " 4 ", "5.07", "9.23", None, "7.8.3", "\x00 \x1f1\x14"],
+            3,
+            1,
+        ) == [20, 30, 40, 51, 92, None, None, 10]
+
+    # CastStringsTest.castToDecimalNoStripTest:194
+    def test_no_strip(self):
+        assert unscaled(
+            [" 3", "9", "4", "2", "20.5", None, "7.6asd"], 2, 0, strip=False
+        ) == [None, 9, 4, 2, 21, None, None]
+        assert unscaled(
+            ["5", "1 ", "0", "2", "7.1", None, "asdf"], 10, 0, strip=False
+        ) == [5, None, 0, 2, 7, None, None]
+        assert unscaled(
+            ["2", "3", " 4 ", "5.07", "9.23", None, "7.8.3"], 3, 1, strip=False
+        ) == [20, 30, None, 51, 92, None, None]
+
+    def test_scientific(self):
+        assert unscaled(["1.5e2", "15E1", "1500e-1", "2e0"], 5, 0) == [
+            150,
+            150,
+            150,
+            2,
+        ]
+        assert unscaled(["1e-3", "0.5e-2"], 6, 4) == [10, 50]
+
+    def test_rounding_half_up(self):
+        assert unscaled(["1.25", "1.35", "-1.25", "-1.35"], 5, 1) == [
+            13,
+            14,
+            -13,
+            -14,
+        ]
+        # rounding that adds a digit: 9.99 -> 10.0
+        assert unscaled(["9.99"], 3, 1) == [100]
+
+    def test_precision_overflow(self):
+        assert unscaled(["123", "1234"], 3, 0) == [123, None]
+        # digits before decimal exceed precision - scale
+        assert unscaled(["123.4"], 3, 1) == [None]
+
+    def test_decimal128(self):
+        big = "9" * 38
+        vals = unscaled([big, "-" + big], 38, 0)
+        assert vals == [int(big), -int(big)]
+
+    def test_decimal128_rounding(self):
+        assert unscaled(["12345678901234567890.5"], 38, 0) == [
+            12345678901234567891
+        ]
+
+
+class TestBaseConversion:
+    # CastStringsTest.baseDec2HexTestNoNulls:238 / Mixed:262
+    def test_dec_roundtrip(self):
+        inp = [
+            None,
+            " ",
+            "junk-510junk510",
+            "--510",
+            "   -510junk510",
+            "  510junk510",
+            "510",
+            "00510",
+            "00-510",
+        ]
+        ints = to_integers_with_base(strings_column(inp), 10)
+        dec = from_integers_with_base(ints, 10).to_list()
+        hexs = from_integers_with_base(ints, 16).to_list()
+        assert dec == [
+            None,
+            None,
+            "0",
+            "0",
+            "18446744073709551106",
+            "510",
+            "510",
+            "510",
+            "0",
+        ]
+        assert hexs == [
+            None,
+            None,
+            "0",
+            "0",
+            "FFFFFFFFFFFFFE02",
+            "1FE",
+            "1FE",
+            "1FE",
+            "0",
+        ]
+
+    # CastStringsTest.baseHex2DecTest:304
+    def test_hex_to_dec(self):
+        inp = [
+            None,
+            "junk",
+            "0",
+            "f",
+            "junk-5Ajunk5A",
+            "--5A",
+            "   -5Ajunk5A",
+            "  5Ajunk5A",
+            "5a",
+            "05a",
+            "005a",
+            "00-5a",
+            "NzGGImWNRh",
+        ]
+        ints = to_integers_with_base(strings_column(inp), 16)
+        dec = from_integers_with_base(ints, 10).to_list()
+        hexs = from_integers_with_base(ints, 16).to_list()
+        assert dec == [
+            None,
+            "0",
+            "0",
+            "15",
+            "0",
+            "0",
+            "18446744073709551526",
+            "90",
+            "90",
+            "90",
+            "90",
+            "0",
+            "0",
+        ]
+        assert hexs == [
+            None,
+            "0",
+            "0",
+            "F",
+            "0",
+            "0",
+            "FFFFFFFFFFFFFFA6",
+            "5A",
+            "5A",
+            "5A",
+            "5A",
+            "0",
+            "0",
+        ]
+
+
+def _oracle_to_int(s, lo, hi, strip=True, ansi=False):
+    """Host oracle for the reference's string_to_integer state machine."""
+    if s is None:
+        return None
+    b = s.encode("utf-8", errors="surrogatepass")
+    ws = lambda c: c <= 0x20
+    n = len(b)
+    i = 0
+    if n == 0:
+        return None
+    if strip:
+        while i < n and ws(b[i]):
+            i += 1
+    sign = 1
+    if i < n and b[i] in (ord("+"), ord("-")):
+        if b[i] == ord("-"):
+            sign = -1
+        i += 1
+    if i == n:
+        return None
+    val = 0
+    i0 = i
+    truncating = trailing = False
+    for c in range(i, n):
+        ch = b[c]
+        if trailing and not ws(ch):
+            return None
+        elif not truncating and ch == ord(".") and not ansi:
+            truncating = True
+        elif not (ord("0") <= ch <= ord("9")):
+            if ws(ch) and c != i0 and strip:
+                trailing = True
+            else:
+                return None
+        if not truncating and not trailing:
+            d = ch - ord("0")
+            if c != i0:
+                val *= 10
+            val = val + d if sign > 0 else val - d
+            if not (lo <= val <= hi):
+                return None
+    return val
+
+
+@pytest.mark.parametrize("strip", [True, False])
+def test_fuzz_against_oracle(strip):
+    rng = np.random.RandomState(7)
+    alphabet = list("0123456789+-. e\t") + ["", "\x00"]
+    strs = [
+        "".join(rng.choice(alphabet) for _ in range(rng.randint(0, 12)))
+        for _ in range(500)
+    ]
+    got = cast_ints(strs, dtypes.INT32, strip=strip)
+    want = [_oracle_to_int(s, -(2**31), 2**31 - 1, strip=strip) for s in strs]
+    assert got == want, [
+        (s, g, w) for s, g, w in zip(strs, got, want) if g != w
+    ][:10]
