@@ -3,9 +3,23 @@ from spark_rapids_jni_tpu.ops.hashing import (
     xxhash64,
     DEFAULT_XXHASH64_SEED,
 )
+from spark_rapids_jni_tpu.ops.decimal128 import (
+    multiply128,
+    divide128,
+    integer_divide128,
+    remainder128,
+    add128,
+    subtract128,
+)
 
 __all__ = [
     "murmur_hash32",
     "xxhash64",
     "DEFAULT_XXHASH64_SEED",
+    "multiply128",
+    "divide128",
+    "integer_divide128",
+    "remainder128",
+    "add128",
+    "subtract128",
 ]

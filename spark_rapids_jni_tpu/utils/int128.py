@@ -27,23 +27,7 @@ def const128(v: int):
     return np.int64(hi), np.uint64(v & MASK64)
 
 
-def from_int64(x):
-    """Sign-extend int64 -> (hi, lo)."""
-    x = x.astype(jnp.int64)
-    hi = jnp.where(x < 0, jnp.int64(-1), jnp.int64(0))
-    return hi, x.astype(jnp.uint64)
 
-
-def to_int64(hi, lo):
-    """Truncate to the low 64 bits as signed."""
-    del hi
-    return lo.astype(jnp.int64)
-
-
-def add(ah, al, bh, bl):
-    lo = al + bl
-    carry = (lo < al).astype(jnp.int64)
-    return ah + bh + carry, lo
 
 
 def add_small(hi, lo, d):
@@ -92,10 +76,6 @@ def mul_small(hi, lo, k: int):
     return hi * jnp.int64(k) + carry, lo2
 
 
-def shl1(hi, lo):
-    hi2 = (hi << jnp.int64(1)) | (lo >> jnp.uint64(63)).astype(jnp.int64)
-    return hi2, lo << jnp.uint64(1)
-
 
 def lt(ah, al, bh, bl):
     """Signed (ah,al) < (bh,bl)."""
@@ -110,23 +90,7 @@ def eq(ah, al, bh, bl):
     return (ah == bh) & (al == bl)
 
 
-def lt_const(hi, lo, v: int):
-    bh, bl = const128(v)
-    return lt(hi, lo, jnp.int64(bh), jnp.uint64(bl))
 
-
-def gt_const(hi, lo, v: int):
-    bh, bl = const128(v)
-    return gt(hi, lo, jnp.int64(bh), jnp.uint64(bl))
-
-
-def eq_const(hi, lo, v: int):
-    bh, bl = const128(v)
-    return eq(hi, lo, jnp.int64(bh), jnp.uint64(bl))
-
-
-def select(mask, ah, al, bh, bl):
-    return jnp.where(mask, ah, bh), jnp.where(mask, al, bl)
 
 
 # |value| >= 10**k comparisons, used for digit counting of 128-bit magnitudes.
@@ -145,8 +109,3 @@ def count_digits(hi, lo):
     return count
 
 
-def to_python_ints(hi, lo):
-    """Host materialization to a list of python ints (test/oracle use)."""
-    hi_np = np.asarray(hi).astype(np.int64)
-    lo_np = np.asarray(lo).astype(np.uint64)
-    return [int(h) * (1 << 64) + int(l) for h, l in zip(hi_np, lo_np)]

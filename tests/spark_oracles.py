@@ -154,3 +154,123 @@ def java_bigdecimal_bytes(unscaled: int) -> bytes:
     else:
         nbytes = (unscaled + 1).bit_length() // 8 + 1
     return unscaled.to_bytes(nbytes, "big", signed=True)
+
+
+# ---------------------------------------------------------------------------
+# DECIMAL128 oracles: the reference decimal_utils.cu algorithms re-run in
+# arbitrary-precision python ints (independent of the device limb math).
+# Scales here are cudf convention (negative Spark scale) to match the kernels.
+
+
+def dec_trunc_div(n, d):
+    """Truncate-toward-zero division (Java DOWN)."""
+    q = abs(n) // abs(d)
+    return -q if (n < 0) != (d < 0) else q
+
+
+def dec_divide_and_round(n, d):
+    """Half-up division (reference divide_and_round, decimal_utils.cu:228)."""
+    ad = abs(d)
+    q, r = divmod(abs(n), ad)
+    if 2 * r >= ad:
+        q += 1
+    return -q if (n < 0) != (d < 0) else q
+
+
+def dec_precision10(v):
+    """Smallest i with 10**i >= |v| (decimal_utils.cu:520)."""
+    v = abs(v)
+    i = 0
+    while 10**i < v:
+        i += 1
+    return i
+
+
+def dec_overflow38(v):
+    return abs(v) >= 10**38
+
+
+def dec_multiply(ua, ub, sa, sb, prod_scale, interim):
+    """Returns (overflow, value-or-None); Spark scales in, follows
+    dec128_multiplier (decimal_utils.cu:662)."""
+    a_cs, b_cs, prod_cs = -sa, -sb, -prod_scale
+    product = ua * ub
+    mult_cs = a_cs + b_cs
+    if interim:
+        fdp = dec_precision10(product) - 38
+        if fdp > 0:
+            product = dec_divide_and_round(product, 10**fdp)
+            mult_cs = a_cs + b_cs + fdp
+    exponent = prod_cs - mult_cs
+    if exponent < 0:
+        if dec_precision10(product) - exponent > 38:
+            return True, None
+        product *= 10 ** (-exponent)
+    else:
+        product = dec_divide_and_round(product, 10**exponent)
+    return dec_overflow38(product), product
+
+
+def dec_divide(ua, ub, sa, sb, q_scale, int_div=False):
+    """dec128_divider (decimal_utils.cu:738); returns (overflow, value)."""
+    if ub == 0:
+        return True, 0
+    n_shift_exp = -q_scale - ((-sa) - (-sb))
+    if n_shift_exp > 0:
+        q1 = dec_trunc_div(ua, ub)
+        rounder = dec_trunc_div if int_div else dec_divide_and_round
+        result = rounder(q1, 10**n_shift_exp)
+    else:
+        n = ua * 10 ** (-n_shift_exp)
+        result = dec_trunc_div(n, ub) if int_div else dec_divide_and_round(n, ub)
+    return dec_overflow38(result), result
+
+
+def dec_remainder(ua, ub, sa, sb, rem_scale):
+    """dec128_remainder (decimal_utils.cu:845); returns (overflow, value)."""
+    if ub == 0:
+        return True, 0
+    a_cs, b_cs, rem_cs = -sa, -sb, -rem_scale
+    d_shift_exp = rem_cs - b_cs
+    n_shift_exp = rem_cs - a_cs
+    abs_d = abs(ub)
+    if d_shift_exp > 0:
+        abs_d = dec_divide_and_round(abs_d, 10**d_shift_exp)
+        if abs_d == 0:
+            return True, 0  # divisor rounded away; device flags overflow
+    else:
+        n_shift_exp -= d_shift_exp
+    abs_n = abs(ua)
+    if n_shift_exp > 0:
+        q1 = abs_n // abs_d
+        int_div = q1 // 10**n_shift_exp
+    else:
+        abs_n *= 10 ** (-n_shift_exp)
+        int_div = abs_n // abs_d
+    less_n = int_div * abs_d
+    if d_shift_exp < 0:
+        less_n *= 10 ** (-d_shift_exp)
+    rem = abs_n - less_n
+    overflow = dec_overflow38(rem)
+    if ua < 0:
+        rem = -rem
+    return overflow, rem
+
+
+def dec_add_sub(ua, ub, sa, sb, target_scale, sub=False):
+    """dec128_add_sub (decimal_utils.cu:560); returns (overflow, value)."""
+    a_cs, b_cs, res_cs = -sa, -sb, -target_scale
+
+    def set_scale(v, old, new):
+        if new == old:
+            return v
+        if new < old:
+            return v * 10 ** (old - new)
+        return dec_divide_and_round(v, 10 ** (new - old))
+
+    inter = min(a_cs, b_cs)
+    a = set_scale(ua, a_cs, inter)
+    b = set_scale(ub, b_cs, inter)
+    s = a - b if sub else a + b
+    s = set_scale(s, inter, res_cs)
+    return dec_overflow38(s), s
