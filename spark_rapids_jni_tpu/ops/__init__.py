@@ -3,6 +3,10 @@ from spark_rapids_jni_tpu.ops.hashing import (
     xxhash64,
     DEFAULT_XXHASH64_SEED,
 )
+from spark_rapids_jni_tpu.ops.datetime_rebase import (
+    rebase_gregorian_to_julian,
+    rebase_julian_to_gregorian,
+)
 from spark_rapids_jni_tpu.ops.decimal128 import (
     multiply128,
     divide128,
@@ -14,6 +18,8 @@ from spark_rapids_jni_tpu.ops.decimal128 import (
 
 __all__ = [
     "murmur_hash32",
+    "rebase_gregorian_to_julian",
+    "rebase_julian_to_gregorian",
     "xxhash64",
     "DEFAULT_XXHASH64_SEED",
     "multiply128",

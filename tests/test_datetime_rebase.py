@@ -1,0 +1,177 @@
+"""Tests for Gregorian<->Julian rebase, mirroring DateTimeRebaseTest.java.
+
+The fixed vectors are the exact inputs/expecteds of the reference's JUnit suite
+(DateTimeRebaseTest.java:27-117); the randomized sweep cross-checks against a
+pure-python oracle built on datetime (proleptic Gregorian) and an independent
+Julian-calendar implementation.
+"""
+
+import datetime
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import column, DATE32, TIMESTAMP_MICROS
+from spark_rapids_jni_tpu.ops.datetime_rebase import (
+    rebase_gregorian_to_julian,
+    rebase_julian_to_gregorian,
+)
+
+EPOCH = datetime.date(1970, 1, 1)
+CUM_DAYS = [0, 31, 59, 90, 120, 151, 181, 212, 243, 273, 304, 334]
+
+
+def _julian_leap(y):
+    return y % 4 == 0
+
+
+def _days_from_julian_py(y, m, d):
+    yy = y - (1 if m <= 2 else 0)
+    era = yy // 4
+    yoe = yy - era * 4
+    mm = m + (-3 if m > 2 else 9)
+    doy = (153 * mm + 2) // 5 + d - 1
+    return era * 1461 + yoe * 365 + doy - 719470
+
+
+def _julian_from_days_py(days):
+    z = days + 719470
+    era = z // 1461
+    doe = z - era * 1461
+    yoe = (doe - doe // 1460) // 365
+    y = yoe + era * 4
+    doy = doe - 365 * yoe
+    mp = (5 * doy + 2) // 153
+    m = mp + (3 if mp < 10 else -9)
+    d = doy - (153 * mp + 2) // 5 + 1
+    return y + (1 if m <= 2 else 0), m, d
+
+
+def _greg_to_julian_day_py(days):
+    if days >= -141427:
+        return days
+    y, m, d = _civil_from_days_py(days)
+    if (y, m, d) > (1582, 10, 4) and (y, m, d) < (1582, 10, 15):
+        return -141427
+    return _days_from_julian_py(y, m, d)
+
+
+def _julian_to_greg_day_py(days):
+    if days >= -141427:
+        return days
+    y, m, d = _julian_from_days_py(days)
+    return _days_from_civil_py(y, m, d)
+
+
+def _civil_from_days_py(days):
+    z = days + 719468
+    era = z // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + (3 if mp < 10 else -9)
+    return y + (1 if m <= 2 else 0), m, d
+
+
+def _days_from_civil_py(y, m, d):
+    y -= m <= 2
+    era = y // 400
+    yoe = y - era * 400
+    doy = (153 * (m + (-3 if m > 2 else 9)) + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+# --- reference JUnit vectors (DateTimeRebaseTest.java) ---
+
+G2J_DAYS_IN = [-719162, -354285, None, -141714, -141438, -141437, None, None,
+               -141432, -141427, -31463, -31453, -1, 0, 18335]
+G2J_DAYS_OUT = [-719164, -354280, None, -141704, -141428, -141427, None, None,
+                -141427, -141427, -31463, -31453, -1, 0, 18335]
+
+G2J_MICROS_IN = [-62135593076345679, -30610213078876544, None, -12244061221876544,
+                 -12220243200000000, -12219639001448163, -12219292799000001,
+                 -45446999900, 1, None, 1584178381500000]
+G2J_MICROS_OUT = [-62135765876345679, -30609781078876544, None, -12243197221876544,
+                  -12219379200000000, -12219207001448163, -12219292799000001,
+                  -45446999900, 1, None, 1584178381500000]
+
+J2G_MICROS_IN = G2J_MICROS_OUT[:5] + [-12219207001448163, -12219292799000001,
+                                      -45446999900, 1, None, 1584178381500000]
+J2G_MICROS_OUT = G2J_MICROS_IN[:5] + [-12219207001448163, -12219292799000001,
+                                      -45446999900, 1, None, 1584178381500000]
+
+
+def test_rebase_days_to_julian_reference_vectors():
+    out = rebase_gregorian_to_julian(column(G2J_DAYS_IN, DATE32))
+    assert out.to_list() == G2J_DAYS_OUT
+
+
+def test_rebase_days_to_gregorian_reference_vectors():
+    # JUnit rebaseDaysToGregorianTest
+    inp = [-719164, -354280, None, -141704, -141428, -141427, None, None,
+           -141427, -141427, -31463, -31453, -1, 0, 18335]
+    exp = [-719162, -354285, None, -141714, -141438, -141427, None, None,
+           -141427, -141427, -31463, -31453, -1, 0, 18335]
+    out = rebase_julian_to_gregorian(column(inp, DATE32))
+    assert out.to_list() == exp
+
+
+def test_rebase_micros_to_julian_reference_vectors():
+    out = rebase_gregorian_to_julian(column(G2J_MICROS_IN, TIMESTAMP_MICROS))
+    assert out.to_list() == G2J_MICROS_OUT
+
+
+def test_rebase_micros_to_gregorian_reference_vectors():
+    out = rebase_julian_to_gregorian(column(J2G_MICROS_IN, TIMESTAMP_MICROS))
+    assert out.to_list() == J2G_MICROS_OUT
+
+
+def test_rebase_days_random_vs_oracle():
+    rng = np.random.RandomState(7)
+    days = np.concatenate([
+        rng.randint(-800000, 20000, size=400),
+        np.arange(-141445, -141420),  # the calendar gap and its edges
+    ]).astype(np.int64).tolist()
+    g2j = rebase_gregorian_to_julian(column(days, DATE32)).to_list()
+    j2g = rebase_julian_to_gregorian(column(days, DATE32)).to_list()
+    assert g2j == [_greg_to_julian_day_py(d) for d in days]
+    assert j2g == [_julian_to_greg_day_py(d) for d in days]
+
+
+def test_rebase_days_oracle_against_datetime():
+    """The civil oracle itself must agree with python's proleptic datetime."""
+    for days in [-141427, -141428, -500000, -1, 0, 18335]:
+        y, m, d = _civil_from_days_py(days)
+        if 1 <= y <= 9999:
+            assert (datetime.date(y, m, d) - EPOCH).days == days
+
+
+def test_rebase_micros_random_vs_oracle():
+    rng = np.random.RandomState(11)
+    day = rng.randint(-800000, 20000, size=300).astype(np.int64)
+    tod = rng.randint(0, 86_400_000_000, size=300).astype(np.int64)
+    micros = (day * 86_400_000_000 + tod).tolist()
+    out = rebase_gregorian_to_julian(column(micros, TIMESTAMP_MICROS)).to_list()
+    for m_in, m_out in zip(micros, out):
+        d, t = divmod(m_in, 86_400_000_000)
+        if m_in >= -12219292800000000:
+            assert m_out == m_in
+        else:
+            assert m_out == _greg_to_julian_day_py(d) * 86_400_000_000 + t
+    back = rebase_julian_to_gregorian(column(micros, TIMESTAMP_MICROS)).to_list()
+    for m_in, m_out in zip(micros, back):
+        d, t = divmod(m_in, 86_400_000_000)
+        if m_in >= -12219292800000000:
+            assert m_out == m_in
+        else:
+            assert m_out == _julian_to_greg_day_py(d) * 86_400_000_000 + t
+
+
+def test_rebase_rejects_bad_dtype():
+    from spark_rapids_jni_tpu.columnar import INT64
+    with pytest.raises(TypeError):
+        rebase_gregorian_to_julian(column([1, 2], INT64))
