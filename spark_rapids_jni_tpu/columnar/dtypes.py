@@ -20,6 +20,7 @@ class Kind(enum.Enum):
     INT16 = "int16"
     INT32 = "int32"
     INT64 = "int64"
+    UINT8 = "uint8"
     UINT64 = "uint64"
     FLOAT32 = "float32"
     FLOAT64 = "float64"
@@ -43,6 +44,7 @@ _JNP = {
     Kind.INT16: jnp.int16,
     Kind.INT32: jnp.int32,
     Kind.INT64: jnp.int64,
+    Kind.UINT8: jnp.uint8,
     Kind.UINT64: jnp.uint64,
     Kind.FLOAT32: jnp.float32,
     Kind.FLOAT64: jnp.float64,
@@ -58,6 +60,7 @@ _WIDTH = {
     Kind.INT16: 2,
     Kind.INT32: 4,
     Kind.INT64: 8,
+    Kind.UINT8: 1,
     Kind.UINT64: 8,
     Kind.FLOAT32: 4,
     Kind.FLOAT64: 8,
@@ -125,6 +128,7 @@ INT8 = DType(Kind.INT8)
 INT16 = DType(Kind.INT16)
 INT32 = DType(Kind.INT32)
 INT64 = DType(Kind.INT64)
+UINT8 = DType(Kind.UINT8)
 UINT64 = DType(Kind.UINT64)
 FLOAT32 = DType(Kind.FLOAT32)
 FLOAT64 = DType(Kind.FLOAT64)

@@ -16,7 +16,11 @@ from spark_rapids_jni_tpu.ops.decimal128 import (
     subtract128,
 )
 
+from spark_rapids_jni_tpu.ops.zorder import hilbert_index, interleave_bits
+
 __all__ = [
+    "hilbert_index",
+    "interleave_bits",
     "murmur_hash32",
     "rebase_gregorian_to_julian",
     "rebase_julian_to_gregorian",

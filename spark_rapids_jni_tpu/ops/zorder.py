@@ -1,0 +1,161 @@
+"""DeltaLake z-order clustering ops: interleave_bits and hilbert_index.
+
+Spark-exact semantics of the reference's zorder ops (zorder.cu:138 interleave_bits,
+zorder.cu:224 hilbert_index; Hilbert transform per David Moten's port of Skilling's
+"Programming the Hilbert curve", zorder.cu:66-74).
+
+The reference computes one output byte per GPU thread with per-bit gather loops.
+On TPU both ops are reformulated as dense bit-plane arithmetic:
+
+- ``interleave_bits``: each value is exploded to a big-endian bit plane
+  ``bits[n, width]``; the interleave is then a single static-permutation gather
+  producing ``bits[n, width*ncols]``, packed back to bytes with a matmul-free
+  shift-or reduction.  The permutation is computed host-side (shapes are static
+  under jit) so XLA sees a plain gather — no per-bit control flow.
+- ``hilbert_index``: Skilling's inverse-undo loop has a static trip count
+  (num_bits x num_dims <= 64), so it fully unrolls into vectorized xor/select
+  lane ops over ``x[dim][n]`` arrays; the data-dependent branches become
+  ``jnp.where`` selects.
+
+Null handling matches the reference: null cells read as 0 and the outputs carry
+no null mask (zorder.cu:205-207,:262).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu.columnar.column import Column, ListColumn
+from spark_rapids_jni_tpu.columnar.dtypes import DType, Kind, UINT8
+
+
+def _to_bit_planes(col: Column, width_bits: int) -> jnp.ndarray:
+    """``bits[n, width_bits]`` of each value, most significant bit first.
+
+    Nulls read as 0 (matches zorder.cu:205 ``column.is_valid(...) ? data : 0``).
+    """
+    # Widen through uint64 so the shift is well-defined for every input width.
+    data = col.data
+    if col.dtype.kind == Kind.FLOAT32:
+        # interleave operates on the IEEE-754 bit pattern, not the value
+        # (FLOAT64 columns already store their bits in int64; see columnar.column).
+        data = jax.lax.bitcast_convert_type(data, jnp.uint32)
+    if data.dtype == jnp.bool_:
+        v = data.astype(jnp.uint64)
+    else:
+        # signed -> unsigned reinterpret of the low `width_bits` bits
+        v = data.astype(jnp.int64).astype(jnp.uint64) & jnp.uint64(
+            (1 << width_bits) - 1 if width_bits < 64 else 0xFFFFFFFFFFFFFFFF
+        )
+    if col.validity is not None:
+        v = jnp.where(col.validity, v, jnp.uint64(0))
+    shifts = jnp.arange(width_bits - 1, -1, -1, dtype=jnp.uint64)
+    return ((v[:, None] >> shifts[None, :]) & jnp.uint64(1)).astype(jnp.uint8)
+
+
+def _pack_bits_to_bytes(bits: jnp.ndarray) -> jnp.ndarray:
+    """``bits[n, 8*k]`` (MSB first) -> ``bytes[n, k]`` uint8."""
+    n, total = bits.shape
+    assert total % 8 == 0
+    grouped = bits.reshape(n, total // 8, 8).astype(jnp.uint8)
+    weights = (jnp.uint8(1) << jnp.arange(7, -1, -1, dtype=jnp.uint8))[None, None, :]
+    return jnp.sum(grouped * weights, axis=-1, dtype=jnp.uint8)
+
+
+def interleave_bits(columns: Sequence[Column]) -> ListColumn:
+    """DeltaLake ``interleaveBits``: LIST<UINT8> of round-robin interleaved bits.
+
+    Bit ``b`` (MSB-first) of every column is emitted before bit ``b+1`` of any,
+    column 0 first — the deltalake source-of-truth loop shape
+    (InterleaveBitsTest.java:44-66).  Output row width is
+    ``ncols * value_byte_width`` bytes.
+    """
+    if not columns:
+        raise ValueError("The input table must have at least one column.")
+    kinds = {c.dtype.kind for c in columns}
+    if len(kinds) != 1:
+        raise TypeError("All columns of the input table must be the same type.")
+    width_bytes = columns[0].dtype.fixed_width
+    if width_bytes == 0 or not all(isinstance(c, Column) for c in columns):
+        raise TypeError("Only fixed width columns can be used")
+    n = columns[0].size
+    ncols = len(columns)
+    width_bits = width_bytes * 8
+
+    # bits[n, ncols, width_bits] -> transpose to [n, width_bits, ncols] so that
+    # flattening yields (bit0 of col0, bit0 of col1, ..., bit1 of col0, ...).
+    planes = jnp.stack([_to_bit_planes(c, width_bits) for c in columns], axis=1)
+    interleaved = jnp.transpose(planes, (0, 2, 1)).reshape(n, width_bits * ncols)
+    data = _pack_bits_to_bytes(interleaved).reshape(n * width_bytes * ncols)
+
+    row_bytes = width_bytes * ncols
+    offsets = jnp.arange(n + 1, dtype=jnp.int32) * row_bytes
+    child = Column(data, None, UINT8)
+    return ListColumn(offsets, child, None)
+
+
+def hilbert_index(num_bits_per_entry: int, columns: Sequence[Column]) -> Column:
+    """Hilbert-curve distance of each row's point (zorder.cu:224).
+
+    Each INT32 column is one coordinate using the low ``num_bits_per_entry``
+    bits; the result is the INT64 position along the ``ndims``-dimensional
+    Hilbert curve (Skilling transpose + gray decode, zorder.cu:95-133).
+    """
+    if not (0 < num_bits_per_entry <= 32):
+        raise ValueError("the number of bits must be >0 and <= 32.")
+    if not columns:
+        raise ValueError("at least one column is required.")
+    ndims = len(columns)
+    if num_bits_per_entry * ndims > 64:
+        raise ValueError("we only support up to 64 bits of output right now.")
+    for c in columns:
+        if c.dtype.kind != Kind.INT32:
+            raise TypeError("All columns of the input table must be INT32.")
+
+    nb = num_bits_per_entry
+    mask_val = jnp.uint32((1 << nb) - 1) if nb < 32 else jnp.uint32(0xFFFFFFFF)
+    x = []
+    for c in columns:
+        v = c.data.astype(jnp.uint32) & mask_val
+        if c.validity is not None:
+            v = jnp.where(c.validity, v, jnp.uint32(0))
+        x.append(v)
+
+    # Inverse undo (static unroll: nb-1 outer x ndims inner iterations).
+    m = 1 << (nb - 1)
+    q = m
+    while q > 1:
+        p = jnp.uint32(q - 1)
+        for i in range(ndims):
+            cond = (x[i] & jnp.uint32(q)) != 0
+            if i == 0:
+                x[0] = jnp.where(cond, x[0] ^ p, x[0])
+            else:
+                t = (x[0] ^ x[i]) & p
+                x0_else, xi_else = x[0] ^ t, x[i] ^ t
+                x[0] = jnp.where(cond, x[0] ^ p, x0_else)
+                x[i] = jnp.where(cond, x[i], xi_else)
+        q >>= 1
+
+    # Gray encode.
+    for i in range(1, ndims):
+        x[i] = x[i] ^ x[i - 1]
+    t = jnp.zeros_like(x[0])
+    q = m
+    while q > 1:
+        t = jnp.where((x[ndims - 1] & jnp.uint32(q)) != 0, t ^ jnp.uint32(q - 1), t)
+        q >>= 1
+    for i in range(ndims):
+        x[i] = x[i] ^ t
+
+    # Transposed form -> distance: bit (nb-1-i) of each dim j, MSB-first
+    # (zorder.cu:76-93 to_hilbert_index).
+    b = jnp.zeros(x[0].shape, dtype=jnp.uint64)
+    for i in range(nb - 1, -1, -1):
+        for j in range(ndims):
+            bit = ((x[j] >> jnp.uint32(i)) & jnp.uint32(1)).astype(jnp.uint64)
+            b = (b << jnp.uint64(1)) | bit
+    return Column(b.astype(jnp.int64), None, DType(Kind.INT64))
