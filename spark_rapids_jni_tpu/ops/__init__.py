@@ -16,9 +16,15 @@ from spark_rapids_jni_tpu.ops.decimal128 import (
     subtract128,
 )
 
+from spark_rapids_jni_tpu.ops.histogram import (
+    create_histogram_if_valid,
+    percentile_from_histogram,
+)
 from spark_rapids_jni_tpu.ops.zorder import hilbert_index, interleave_bits
 
 __all__ = [
+    "create_histogram_if_valid",
+    "percentile_from_histogram",
     "hilbert_index",
     "interleave_bits",
     "murmur_hash32",

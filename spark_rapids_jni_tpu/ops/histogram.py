@@ -1,0 +1,276 @@
+"""Spark ``percentile`` aggregation over pre-binned data.
+
+Spark-exact semantics of the reference's histogram ops
+(histogram.cu:283 create_histogram_if_valid, histogram.cu:431
+percentile_from_histogram; interpolation kernel fill_percentile_fn
+histogram.cu:50-105).
+
+The reference sorts each LIST segment with a segmented sort, scans counts by
+key, then runs one thread per (histogram, percentage) doing a sequential
+``lower_bound`` over that histogram's accumulated counts.  On TPU the ragged
+segments are instead gathered into a dense padded ``[num_histograms, max_len]``
+tile (padding = int64 max) so that every search is a vectorized
+compare-and-sum over lanes.  Histograms are small (percentile buckets), so the
+padding cost is bounded.
+
+Exactness split: the O(n) work — sorting, the count scan, the per-percentile
+binary searches, element gathers — runs on device over *exact integer keys*
+(FLOAT64 columns are IEEE-754 bits in int64 per the framework convention;
+sorting uses the sign-flip total order on the bits, never emulated-f64
+compares).  The final O(H x P) interpolation is finished on host in true
+binary64, because TPU f64 is float32-pair emulated and would not be bit-exact
+(columnar.column doc; the aggregation finish is negligible next to the scan).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar.column import (
+    Column,
+    ListColumn,
+    StructColumn,
+)
+from spark_rapids_jni_tpu.columnar.dtypes import FLOAT64, Kind
+from spark_rapids_jni_tpu.utils.floatbits import f32_to_bits
+
+_I64_MAX = (1 << 63) - 1
+
+
+def create_histogram_if_valid(
+    values: Column, frequencies: Column, output_as_lists: bool
+):
+    """Validate (values, frequencies) and build a histogram column.
+
+    Mirrors histogram.cu:283-425: frequencies must be INT64, non-null and
+    non-negative.  ``output_as_lists=False`` returns STRUCT<value,freq> with
+    zero-frequency rows nullified (their freq forced to 1, histogram.cu:365-378);
+    ``True`` wraps each row in its own list, with zero-frequency rows becoming
+    empty lists.
+    """
+    if frequencies.dtype.kind != Kind.INT64:
+        raise TypeError("The input frequencies must be of type INT64.")
+    if frequencies.validity is not None and frequencies.null_count() > 0:
+        raise ValueError("The input frequencies must not have nulls.")
+    if values.size != frequencies.size:
+        raise ValueError("The input values and frequencies must have the same size.")
+
+    freq = np.asarray(frequencies.data)
+    if (freq < 0).any():
+        raise ValueError("The input frequencies must not contain negative values.")
+    has_zero = bool((freq == 0).any())
+    n = values.size
+
+    if output_as_lists:
+        # Each row becomes a 1-element list; zero-frequency rows become empty.
+        struct = StructColumn((values, frequencies), None)
+        if not has_zero:
+            offsets = jnp.arange(n + 1, dtype=jnp.int32)
+            return ListColumn(offsets, struct, None)
+        keep = freq > 0
+        sizes = keep.astype(np.int32)
+        offsets = jnp.asarray(np.concatenate([[0], np.cumsum(sizes)]).astype(np.int32))
+        gather = jnp.asarray(np.nonzero(keep)[0].astype(np.int32))
+        kept_vals = Column(
+            values.data[gather],
+            None if values.validity is None else values.validity[gather],
+            values.dtype,
+        )
+        kept_freq = Column(frequencies.data[gather], None, frequencies.dtype)
+        return ListColumn(offsets, StructColumn((kept_vals, kept_freq), None), None)
+
+    if not has_zero:
+        # Reference quirk preserved: when no zero frequencies exist, null-value
+        # rows keep their original frequency (the freq->1 fixup below only runs
+        # on the zero-frequency path; histogram.cu:399-401 vs :365-378).
+        return StructColumn((values, frequencies), None)
+    # Nullify zero-frequency values (AND with any existing mask) and force
+    # the frequency of EVERY null row (including originally-null values) to 1
+    # so downstream MERGE_HISTOGRAM never sees freq 0.
+    pos = jnp.asarray(freq > 0)
+    validity = pos if values.validity is None else (values.validity & pos)
+    fixed_freq = jnp.where(validity, frequencies.data, jnp.int64(1))
+    out_vals = Column(values.data, validity, values.dtype)
+    return StructColumn((out_vals, Column(fixed_freq, None, frequencies.dtype)), None)
+
+
+def _total_order_key(col: Column) -> jnp.ndarray:
+    """int64 key whose < order equals the column's value order (exact on device).
+
+    FLOAT64 data is already IEEE-754 bits in int64; the standard sign-flip map
+    (negatives -> bitwise complement) makes integer compare match float compare.
+    """
+    kind = col.dtype.kind
+    if kind == Kind.FLOAT64:
+        bits = col.data.astype(jnp.int64)
+        u = bits.astype(jnp.uint64)
+        flipped = jnp.where(
+            bits < 0, ~u, u | jnp.uint64(0x8000000000000000)
+        )
+        return (flipped ^ jnp.uint64(0x8000000000000000)).astype(jnp.int64)
+    if kind == Kind.FLOAT32:
+        bits = f32_to_bits(col.data).astype(jnp.int64)
+        u = bits.astype(jnp.uint64)
+        flipped = jnp.where(bits < 0, (~u) & jnp.uint64(0xFFFFFFFF), u | jnp.uint64(0x80000000))
+        return flipped.astype(jnp.int64)
+    if kind == Kind.UINT64:
+        return (col.data ^ jnp.uint64(1 << 63)).astype(jnp.int64)
+    return col.data.astype(jnp.int64)
+
+
+def _raw_int_repr(col: Column) -> jnp.ndarray:
+    """int64 carrying the exact value representation (bits for floats)."""
+    if col.dtype.kind == Kind.FLOAT32:
+        return f32_to_bits(col.data).astype(jnp.int64)
+    return col.data.astype(jnp.int64)
+
+
+def _decode_raw(raw: np.ndarray, kind: Kind) -> np.ndarray:
+    """Host: raw gathered int64 representations -> float64 values."""
+    if kind == Kind.FLOAT64:
+        return raw.astype(np.int64).view(np.float64)
+    if kind == Kind.FLOAT32:
+        return raw.astype(np.int64).astype(np.int32).view(np.float32).astype(np.float64)
+    if kind == Kind.UINT64:
+        return raw.astype(np.uint64).astype(np.float64)
+    return raw.astype(np.float64)
+
+
+def percentile_from_histogram(
+    input: ListColumn, percentages: Sequence[float], output_as_list: bool
+):
+    """Spark percentile over LIST<STRUCT<value, freq INT64>> histograms.
+
+    Returns FLOAT64 percentiles (as bit-pattern int64 per framework convention):
+    a flat Column of ``H * P`` rows, or a ListColumn of P-element lists per
+    histogram with all-null histograms yielding empty lists (histogram.cu:255).
+    """
+    if not isinstance(input, ListColumn) or not isinstance(input.child, StructColumn):
+        raise TypeError("The input column must be of type LIST of STRUCT.")
+    struct = input.child
+    if len(struct.children) != 2:
+        raise TypeError("Child of the input column must have two children.")
+    if struct.validity is not None and int(jnp.sum(~struct.validity)) > 0:
+        raise ValueError("Child of the input column must not have nulls.")
+    data_col, counts_col = struct.children
+    if not isinstance(counts_col, Column) or counts_col.dtype.kind != Kind.INT64:
+        raise TypeError("Histogram frequencies must be INT64.")
+    if counts_col.validity is not None and counts_col.null_count() > 0:
+        raise ValueError("Histogram frequencies must be non-null.")
+    arithmetic = isinstance(data_col, Column) and (
+        data_col.dtype.is_integral
+        or data_col.dtype.is_floating
+        or data_col.dtype.kind in (Kind.BOOL, Kind.UINT8, Kind.UINT64)
+    )
+    if not arithmetic:
+        raise TypeError("Unsupported type in histogram-to-percentile evaluation.")
+
+    num_hist = input.size
+    pcts = np.asarray(list(percentages), dtype=np.float64)
+    num_pct = pcts.size
+
+    offsets_np = np.asarray(input.offsets).astype(np.int64)
+    seg_lens = offsets_np[1:] - offsets_np[:-1]
+    max_len = int(seg_lens.max()) if num_hist else 0
+
+    if data_col.size == 0 or num_pct == 0:
+        # Reference-faithful: empty data or empty percentages yield
+        # num_histograms ALL-NULL rows (flat) / empty lists, NOT 0 rows
+        # (percentile_dispatcher early return, histogram.cu:171-180).
+        return _wrap_percentile_output(
+            np.zeros((num_hist * max(num_pct, 1),), np.int64),
+            np.zeros((num_hist,), np.bool_),
+            num_pct,
+            output_as_list,
+        )
+
+    # --- device: segmented sort (label asc, value asc, nulls AFTER) ---
+    key = _total_order_key(data_col)
+    valid = data_col.is_valid()
+    labels = jnp.asarray(np.repeat(np.arange(num_hist, dtype=np.int64), seg_lens))
+    order = jnp.argsort(key, stable=True)
+    order = order[jnp.argsort((~valid)[order], stable=True)]
+    order = order[jnp.argsort(labels[order], stable=True)]
+
+    sorted_raw = _raw_int_repr(data_col)[order]
+    sorted_valid = valid[order]
+    sorted_counts = counts_col.data[order].astype(jnp.int64)
+
+    # Per-segment inclusive scan of counts: global cumsum minus segment base.
+    csum = jnp.cumsum(sorted_counts)
+    starts = jnp.asarray(offsets_np[:-1])
+    base = jnp.where(starts > 0, csum[jnp.maximum(starts - 1, 0)], jnp.int64(0))
+    acc = csum - base[labels]
+
+    # Dense padded [H, L] tiles (pad acc with i64 max so searches stop there).
+    n_elem = data_col.size
+    pad_idx = np.full((num_hist, max_len), n_elem, dtype=np.int64)
+    lane = np.arange(max_len)
+    in_seg_np = lane[None, :] < seg_lens[:, None]
+    pad_idx[in_seg_np] = (offsets_np[:-1, None] + lane[None, :])[in_seg_np]
+    pad_idx_j = jnp.asarray(pad_idx)
+    in_seg = jnp.asarray(in_seg_np)
+
+    def padded(arr, fill):
+        safe = jnp.concatenate([arr, jnp.array([fill], dtype=arr.dtype)])
+        return jnp.where(in_seg, safe[pad_idx_j], fill)
+
+    acc_pad = padded(acc, jnp.int64(_I64_MAX))
+    raw_pad = padded(sorted_raw, jnp.int64(0))
+    valid_pad = padded(sorted_valid.astype(jnp.int32), jnp.int32(0))
+
+    # Valid prefix length per histogram (nulls sort last; histogram.cu:57-64).
+    n_valid_d = jnp.sum(valid_pad, axis=1)
+    end_idx = jnp.maximum(n_valid_d - 1, 0)
+    max_positions_d = jnp.take_along_axis(acc_pad, end_idx[:, None], axis=1)[:, 0] - 1
+
+    # --- host: exact binary64 position math on [H] / [H,P] scalars ---
+    n_valid = np.asarray(n_valid_d)
+    has_any = n_valid > 0
+    max_positions = np.where(has_any, np.asarray(max_positions_d), 0)
+    position = max_positions[:, None].astype(np.float64) * pcts[None, :]  # [H,P]
+    lower = np.floor(position).astype(np.int64)
+    higher = np.ceil(position).astype(np.int64)
+
+    # --- device: vectorized lower_bound + element gather ---
+    def lower_bound(q_np):
+        q = jnp.asarray(q_np)  # [H,P]
+        lt = acc_pad[:, None, :] < q[:, :, None]  # [H,P,L]
+        return jnp.minimum(jnp.sum(lt, axis=-1), max_len - 1)
+
+    lo_idx = lower_bound(lower + 1)
+    hi_idx = lower_bound(higher + 1)
+    lo_raw = np.asarray(jnp.take_along_axis(raw_pad, lo_idx, axis=1))
+    hi_raw = np.asarray(jnp.take_along_axis(raw_pad, hi_idx, axis=1))
+
+    # --- host: exact binary64 interpolation (fill_percentile_fn :77-104) ---
+    kind = data_col.dtype.kind
+    lo_elem = _decode_raw(lo_raw, kind)
+    hi_elem = _decode_raw(hi_raw, kind)
+    lower_part = (higher.astype(np.float64) - position) * lo_elem
+    higher_part = (position - lower.astype(np.float64)) * hi_elem
+    interp = np.where(
+        (higher == lower) | (hi_raw == lo_raw), lo_elem, lower_part + higher_part
+    )
+    out_bits = interp.view(np.int64).reshape(num_hist * num_pct)
+    return _wrap_percentile_output(out_bits, has_any, num_pct, output_as_list)
+
+
+def _wrap_percentile_output(out_bits_np, row_valid_np, num_pct, output_as_list):
+    """Package flat [H*P] percentile bits + per-histogram validity (host arrays)."""
+    num_hist = row_valid_np.shape[0]
+    if not output_as_list:
+        validity = None
+        if num_hist and (~row_valid_np).any():
+            rep = np.repeat(row_valid_np, max(num_pct, 1))[: out_bits_np.shape[0]]
+            validity = jnp.asarray(rep)
+        return Column(jnp.asarray(out_bits_np), validity, FLOAT64)
+    # Lists: all-null histograms become empty lists (purge_nonempty_nulls).
+    sizes = np.where(row_valid_np, num_pct, 0).astype(np.int32)
+    offsets = jnp.asarray(np.concatenate([[0], np.cumsum(sizes)]).astype(np.int32))
+    keep = np.repeat(row_valid_np, max(num_pct, 1))[: out_bits_np.shape[0]]
+    child = Column(jnp.asarray(out_bits_np[keep]), None, FLOAT64)
+    return ListColumn(offsets, child, None)

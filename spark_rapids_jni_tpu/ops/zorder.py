@@ -25,9 +25,9 @@ from __future__ import annotations
 
 from typing import Sequence
 
-import jax
 import jax.numpy as jnp
 
+from spark_rapids_jni_tpu.utils.floatbits import f32_to_bits
 from spark_rapids_jni_tpu.columnar.column import Column, ListColumn
 from spark_rapids_jni_tpu.columnar.dtypes import DType, Kind, UINT8
 
@@ -42,7 +42,7 @@ def _to_bit_planes(col: Column, width_bits: int) -> jnp.ndarray:
     if col.dtype.kind == Kind.FLOAT32:
         # interleave operates on the IEEE-754 bit pattern, not the value
         # (FLOAT64 columns already store their bits in int64; see columnar.column).
-        data = jax.lax.bitcast_convert_type(data, jnp.uint32)
+        data = f32_to_bits(data)
     if data.dtype == jnp.bool_:
         v = data.astype(jnp.uint64)
     else:
@@ -81,6 +81,8 @@ def interleave_bits(columns: Sequence[Column]) -> ListColumn:
     width_bytes = columns[0].dtype.fixed_width
     if width_bytes == 0 or not all(isinstance(c, Column) for c in columns):
         raise TypeError("Only fixed width columns can be used")
+    if any(c.size != columns[0].size for c in columns):
+        raise ValueError("All columns of the input table must be the same size.")
     n = columns[0].size
     ncols = len(columns)
     width_bits = width_bytes * 8
@@ -112,8 +114,10 @@ def hilbert_index(num_bits_per_entry: int, columns: Sequence[Column]) -> Column:
     if num_bits_per_entry * ndims > 64:
         raise ValueError("we only support up to 64 bits of output right now.")
     for c in columns:
-        if c.dtype.kind != Kind.INT32:
+        if not isinstance(c, Column) or c.dtype.kind != Kind.INT32:
             raise TypeError("All columns of the input table must be INT32.")
+        if c.size != columns[0].size:
+            raise ValueError("All columns of the input table must be the same size.")
 
     nb = num_bits_per_entry
     mask_val = jnp.uint32((1 << nb) - 1) if nb < 32 else jnp.uint32(0xFFFFFFFF)

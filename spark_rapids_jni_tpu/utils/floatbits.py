@@ -1,0 +1,42 @@
+"""float64 <-> int64 bit-pattern conversion via uint32 limbs.
+
+The framework carries FLOAT64 column data as IEEE-754 bits in int64
+(columnar.column doc): TPU f64 is float32-pair emulated, so Spark-exact double
+semantics are done over the exact bits.
+
+CAVEAT: the f64 conversions here only lower on CPU-backend JAX (tests, host
+staging).  On the TPU backend the x64 rewrite pass cannot bitcast emulated-f64
+at all — ops must either stay in integer bit space on device or decode on host
+with ``np.view`` (see ops.histogram for the pattern).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def f64_to_bits(x: jnp.ndarray) -> jnp.ndarray:
+    """float64 -> int64 IEEE-754 bit pattern."""
+    limbs = jax.lax.bitcast_convert_type(x, jnp.uint32)  # [..., 2] little-endian
+    lo = limbs[..., 0].astype(jnp.uint64)
+    hi = limbs[..., 1].astype(jnp.uint64)
+    return ((hi << jnp.uint64(32)) | lo).astype(jnp.int64)
+
+
+def bits_to_f64(bits: jnp.ndarray) -> jnp.ndarray:
+    """int64 IEEE-754 bit pattern -> float64."""
+    u = bits.astype(jnp.uint64)
+    lo = (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    hi = (u >> jnp.uint64(32)).astype(jnp.uint32)
+    return jax.lax.bitcast_convert_type(jnp.stack([lo, hi], axis=-1), jnp.float64)
+
+
+def f32_to_bits(x: jnp.ndarray) -> jnp.ndarray:
+    """float32 -> int32 IEEE-754 bit pattern."""
+    return jax.lax.bitcast_convert_type(x, jnp.int32)
+
+
+def bits_to_f32(bits: jnp.ndarray) -> jnp.ndarray:
+    """int32 IEEE-754 bit pattern -> float32."""
+    return jax.lax.bitcast_convert_type(bits.astype(jnp.int32), jnp.float32)

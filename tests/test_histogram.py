@@ -1,0 +1,216 @@
+"""Tests for create_histogram_if_valid / percentile_from_histogram.
+
+Oracle: a direct python implementation of Spark's percentile-over-histogram
+interpolation (the same contract the reference's fill_percentile_fn implements,
+histogram.cu:50-105): expand each histogram's (value, freq) pairs into a sorted
+value sequence by cumulative position, then interpolate at
+position = (total_freq - 1) * percentage.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import column, INT32, INT64, FLOAT64
+from spark_rapids_jni_tpu.columnar.column import (
+    Column,
+    ListColumn,
+    StructColumn,
+)
+from spark_rapids_jni_tpu.ops.histogram import (
+    create_histogram_if_valid,
+    percentile_from_histogram,
+)
+from spark_rapids_jni_tpu.utils.floatbits import bits_to_f64, f64_to_bits
+
+
+def percentile_oracle(pairs, percentages):
+    """pairs: [(value_or_None, freq)] for one histogram -> [percentile or None]."""
+    valid = sorted((v, f) for v, f in pairs if v is not None)
+    if not valid:
+        return [None] * len(percentages)
+    values = [v for v, _ in valid]
+    acc = np.cumsum([f for _, f in valid])
+    out = []
+    for pct in percentages:
+        max_pos = int(acc[-1]) - 1
+        position = max_pos * pct
+        lower, higher = math.floor(position), math.ceil(position)
+        lo_elem = values[int(np.searchsorted(acc, lower + 1))]
+        if higher == lower:
+            out.append(float(lo_elem))
+            continue
+        hi_elem = values[int(np.searchsorted(acc, higher + 1))]
+        if hi_elem == lo_elem:
+            out.append(float(lo_elem))
+            continue
+        out.append((higher - position) * lo_elem + (position - lower) * hi_elem)
+    return out
+
+
+def make_histograms(hists, dtype=INT32):
+    """hists: list of [(value, freq)] -> LIST<STRUCT<value, freq>> column."""
+    flat_v, flat_f, sizes = [], [], []
+    for h in hists:
+        sizes.append(len(h))
+        for v, f in h:
+            flat_v.append(v)
+            flat_f.append(f)
+    offsets = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int32)
+    import jax.numpy as jnp
+
+    struct = StructColumn((column(flat_v, dtype), column(flat_f, INT64)), None)
+    return ListColumn(jnp.asarray(offsets), struct, None)
+
+
+def run_and_compare(hists, percentages, dtype=INT32):
+    inp = make_histograms(hists, dtype)
+    out = percentile_from_histogram(inp, percentages, output_as_list=True)
+    offs = np.asarray(out.offsets)
+    vals = bits_to_f64(out.child.data)
+    got = [
+        np.asarray(vals[offs[i] : offs[i + 1]]).tolist() for i in range(len(hists))
+    ]
+    want = []
+    for h in hists:
+        o = percentile_oracle(h, percentages)
+        want.append([] if o[0] is None and all(x is None for x in o) else o)
+    for g, w, h in zip(got, want, hists):
+        assert g == pytest.approx(w, abs=0, rel=0) if w else g == [], (h, g, w)
+
+
+def test_percentile_basic_median():
+    run_and_compare([[(1, 2), (2, 1), (3, 1)]], [0.5])
+
+
+def test_percentile_multiple_percentages():
+    hists = [
+        [(10, 1), (20, 3), (30, 2)],
+        [(5, 7)],
+        [(-3, 2), (0, 1), (9, 4)],
+    ]
+    run_and_compare(hists, [0.0, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0])
+
+
+def test_percentile_random_vs_oracle():
+    rng = np.random.RandomState(17)
+    hists = []
+    for _ in range(30):
+        k = rng.randint(1, 10)
+        vals = rng.choice(np.arange(-50, 50), size=k, replace=False)
+        freqs = rng.randint(1, 20, size=k)
+        hists.append([(int(v), int(f)) for v, f in zip(vals, freqs)])
+    run_and_compare(hists, [0.01, 0.33, 0.5, 0.66, 0.99])
+
+
+def test_percentile_float64_values():
+    hists = [[(1.5, 2), (2.25, 3), (-0.75, 1)]]
+    run_and_compare(hists, [0.5, 0.9], dtype=FLOAT64)
+
+
+def test_percentile_null_values_ignored():
+    # One null element per histogram, sorted last, excluded from interpolation.
+    hists_with_null = [[(None, 1), (1, 2), (5, 2)], [(None, 3)]]
+    inp = make_histograms(hists_with_null)
+    out = percentile_from_histogram(inp, [0.5], output_as_list=True)
+    offs = np.asarray(out.offsets).tolist()
+    assert offs == [0, 1, 1]  # all-null histogram -> empty list
+    got = float(bits_to_f64(out.child.data)[0])
+    assert got == pytest.approx(percentile_oracle([(1, 2), (5, 2)], [0.5])[0])
+
+
+def test_percentile_flat_output_with_nulls():
+    inp = make_histograms([[(4, 2)], [(None, 1)]])
+    out = percentile_from_histogram(inp, [0.5], output_as_list=False)
+    assert isinstance(out, Column)
+    vals = out.to_list()  # FLOAT64 to_list decodes the bit pattern
+    assert vals == [4.0, None]
+
+
+def test_percentile_empty_percentages():
+    inp = make_histograms([[(4, 2)]])
+    out = percentile_from_histogram(inp, [], output_as_list=True)
+    assert np.asarray(out.offsets).tolist() == [0, 0]
+    # Flat mode matches histogram.cu:171-180: H all-null rows, not 0 rows.
+    flat = percentile_from_histogram(inp, [], output_as_list=False)
+    assert flat.to_list() == [None]
+
+
+def test_percentile_validation():
+    inp = make_histograms([[(4, 2)]])
+    bad_counts = ListColumn(
+        inp.offsets,
+        StructColumn((inp.child.children[0], column([2], INT32)), None),
+        None,
+    )
+    with pytest.raises(TypeError):
+        percentile_from_histogram(bad_counts, [0.5], True)
+    with pytest.raises(TypeError):
+        percentile_from_histogram(column([1], INT32), [0.5], True)
+
+
+def test_create_histogram_struct_mode():
+    values = column([1, 2, None, 4], INT32)
+    freqs = column([2, 0, 3, 1], INT64)
+    out = create_histogram_if_valid(values, freqs, output_as_lists=False)
+    assert isinstance(out, StructColumn)
+    v, f = out.children
+    # zero-freq row 1 nullified; null row 2 stays null; freqs of nulls forced to 1
+    assert v.to_list() == [1, None, None, 4]
+    assert f.to_list() == [2, 1, 1, 1]
+
+
+def test_create_histogram_lists_mode():
+    values = column([1, 2, None, 4], INT32)
+    freqs = column([2, 0, 3, 1], INT64)
+    out = create_histogram_if_valid(values, freqs, output_as_lists=True)
+    assert isinstance(out, ListColumn)
+    assert np.asarray(out.offsets).tolist() == [0, 1, 1, 2, 3]  # row1 empty
+    v, f = out.child.children
+    assert v.to_list() == [1, None, 4]
+    assert f.to_list() == [2, 3, 1]  # lists mode keeps original freqs
+
+
+def test_create_histogram_null_freq_quirk():
+    """Reference quirk: null-value rows keep their freq unless a zero freq
+    exists anywhere, in which case every null row's freq becomes 1
+    (histogram.cu:399-401 vs :365-378)."""
+    out = create_histogram_if_valid(
+        column([1, None], INT32), column([2, 3], INT64), output_as_lists=False
+    )
+    assert out.children[1].to_list() == [2, 3]
+    out2 = create_histogram_if_valid(
+        column([1, None, 7], INT32), column([2, 3, 0], INT64), output_as_lists=False
+    )
+    assert out2.children[1].to_list() == [2, 1, 1]
+
+
+def test_hilbert_and_interleave_reject_mismatched_sizes():
+    from spark_rapids_jni_tpu.ops.zorder import hilbert_index, interleave_bits
+
+    with pytest.raises(ValueError):
+        hilbert_index(4, [column([3], INT32), column([1, 2, 3], INT32)])
+    with pytest.raises(ValueError):
+        interleave_bits([column([3], INT32), column([1, 2, 3], INT32)])
+
+
+def test_create_histogram_validation():
+    with pytest.raises(TypeError):
+        create_histogram_if_valid(column([1], INT32), column([1], INT32), False)
+    with pytest.raises(ValueError):
+        create_histogram_if_valid(column([1], INT32), column([None], INT64), False)
+    with pytest.raises(ValueError):
+        create_histogram_if_valid(column([1], INT32), column([-1], INT64), False)
+    with pytest.raises(ValueError):
+        create_histogram_if_valid(column([1, 2], INT32), column([1], INT64), False)
+
+
+def test_f64_bits_roundtrip():
+    import jax.numpy as jnp
+
+    x = jnp.asarray(np.array([0.0, -0.0, 1.5, -2.25, np.pi, np.inf, -np.inf]))
+    back = bits_to_f64(f64_to_bits(x))
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+    nan_bits = f64_to_bits(jnp.asarray(np.array([np.nan])))
+    assert np.isnan(np.asarray(bits_to_f64(nan_bits))[0])
