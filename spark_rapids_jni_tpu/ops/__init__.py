@@ -3,6 +3,15 @@ from spark_rapids_jni_tpu.ops.hashing import (
     xxhash64,
     DEFAULT_XXHASH64_SEED,
 )
+from spark_rapids_jni_tpu.ops.bloom_filter import (
+    BloomFilter,
+    bloom_filter_create,
+    bloom_filter_deserialize,
+    bloom_filter_merge,
+    bloom_filter_probe,
+    bloom_filter_put,
+    bloom_filter_serialize,
+)
 from spark_rapids_jni_tpu.ops.datetime_rebase import (
     rebase_gregorian_to_julian,
     rebase_julian_to_gregorian,
@@ -23,6 +32,13 @@ from spark_rapids_jni_tpu.ops.histogram import (
 from spark_rapids_jni_tpu.ops.zorder import hilbert_index, interleave_bits
 
 __all__ = [
+    "BloomFilter",
+    "bloom_filter_create",
+    "bloom_filter_deserialize",
+    "bloom_filter_merge",
+    "bloom_filter_probe",
+    "bloom_filter_put",
+    "bloom_filter_serialize",
     "create_histogram_if_valid",
     "percentile_from_histogram",
     "hilbert_index",

@@ -230,6 +230,10 @@ def percentile_from_histogram(
     # --- host: exact binary64 position math on [H] / [H,P] scalars ---
     n_valid = np.asarray(n_valid_d)
     has_any = n_valid > 0
+    if input.validity is not None:
+        # Null histogram rows produce null/empty outputs even if their segment
+        # is non-empty (cudf purges null rows' segments; guard it here).
+        has_any &= np.asarray(input.validity)
     max_positions = np.where(has_any, np.asarray(max_positions_d), 0)
     position = max_positions[:, None].astype(np.float64) * pcts[None, :]  # [H,P]
     lower = np.floor(position).astype(np.int64)

@@ -172,6 +172,20 @@ def test_create_histogram_lists_mode():
     assert f.to_list() == [2, 3, 1]  # lists mode keeps original freqs
 
 
+def test_percentile_null_histogram_row():
+    """A null top-level list row yields null/empty output even if non-empty."""
+    import jax.numpy as jnp
+
+    base = make_histograms([[(1, 1), (2, 1)], [(4, 2)]])
+    with_null = ListColumn(
+        base.offsets, base.child, jnp.asarray(np.array([False, True]))
+    )
+    flat = percentile_from_histogram(with_null, [0.5], output_as_list=False)
+    assert flat.to_list() == [None, 4.0]
+    lists = percentile_from_histogram(with_null, [0.5], output_as_list=True)
+    assert np.asarray(lists.offsets).tolist() == [0, 0, 1]
+
+
 def test_create_histogram_null_freq_quirk():
     """Reference quirk: null-value rows keep their freq unless a zero freq
     exists anywhere, in which case every null row's freq becomes 1
