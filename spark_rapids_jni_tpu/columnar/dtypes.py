@@ -29,6 +29,8 @@ class Kind(enum.Enum):
     DATE32 = "date32"
     # Microseconds since unix epoch, int64 (Spark TimestampType).
     TIMESTAMP_MICROS = "timestamp[us]"
+    TIMESTAMP_MILLIS = "timestamp[ms]"
+    TIMESTAMP_SECONDS = "timestamp[s]"
     # Unscaled value in an int32/int64/(int64 hi, uint64 lo) pair; see DType.precision.
     DECIMAL32 = "decimal32"
     DECIMAL64 = "decimal64"
@@ -50,6 +52,8 @@ _JNP = {
     Kind.FLOAT64: jnp.float64,
     Kind.DATE32: jnp.int32,
     Kind.TIMESTAMP_MICROS: jnp.int64,
+    Kind.TIMESTAMP_MILLIS: jnp.int64,
+    Kind.TIMESTAMP_SECONDS: jnp.int64,
     Kind.DECIMAL32: jnp.int32,
     Kind.DECIMAL64: jnp.int64,
 }
@@ -66,6 +70,8 @@ _WIDTH = {
     Kind.FLOAT64: 8,
     Kind.DATE32: 4,
     Kind.TIMESTAMP_MICROS: 8,
+    Kind.TIMESTAMP_MILLIS: 8,
+    Kind.TIMESTAMP_SECONDS: 8,
     Kind.DECIMAL32: 4,
     Kind.DECIMAL64: 8,
     Kind.DECIMAL128: 16,
@@ -135,3 +141,5 @@ FLOAT64 = DType(Kind.FLOAT64)
 STRING = DType(Kind.STRING)
 DATE32 = DType(Kind.DATE32)
 TIMESTAMP_MICROS = DType(Kind.TIMESTAMP_MICROS)
+TIMESTAMP_MILLIS = DType(Kind.TIMESTAMP_MILLIS)
+TIMESTAMP_SECONDS = DType(Kind.TIMESTAMP_SECONDS)

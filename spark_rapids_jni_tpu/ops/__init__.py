@@ -29,6 +29,11 @@ from spark_rapids_jni_tpu.ops.histogram import (
     create_histogram_if_valid,
     percentile_from_histogram,
 )
+from spark_rapids_jni_tpu.ops.timezones import (
+    TimeZoneDB,
+    convert_timestamp_to_utc,
+    convert_utc_timestamp_to_timezone,
+)
 from spark_rapids_jni_tpu.ops.zorder import hilbert_index, interleave_bits
 
 __all__ = [
@@ -41,6 +46,9 @@ __all__ = [
     "bloom_filter_serialize",
     "create_histogram_if_valid",
     "percentile_from_histogram",
+    "TimeZoneDB",
+    "convert_timestamp_to_utc",
+    "convert_utc_timestamp_to_timezone",
     "hilbert_index",
     "interleave_bits",
     "murmur_hash32",

@@ -1,0 +1,187 @@
+"""Timezone conversion tests, mirroring TimeZoneTest.java.
+
+The fixed Asia/Shanghai vectors are the exact JUnit inputs/expecteds
+(TimeZoneTest.java:57-231).  Randomized sweeps cross-check the from-UTC
+direction against python's zoneinfo (an independent tzdata consumer).
+"""
+
+import datetime
+from zoneinfo import ZoneInfo
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import column
+from spark_rapids_jni_tpu.columnar.dtypes import (
+    TIMESTAMP_MICROS,
+    TIMESTAMP_MILLIS,
+    TIMESTAMP_SECONDS,
+)
+from spark_rapids_jni_tpu.ops.timezones import (
+    TimeZoneDB,
+    convert_timestamp_to_utc,
+    convert_utc_timestamp_to_timezone,
+    normalize_zone_id,
+)
+
+TO_UTC_SECONDS = [
+    (-1262260800, -1262289600),
+    (-908838000, -908870400),
+    (-908840700, -908869500),
+    (-888800400, -888832800),
+    (-888799500, -888831900),
+    (-888796800, -888825600),
+    (0, -28800),
+    (1699571634, 1699542834),
+    (568036800, 568008000),
+]
+
+FROM_UTC_SECONDS = [
+    (-1262289600, -1262260800),
+    (-908870400, -908838000),
+    (-908869500, -908837100),
+    (-888832800, -888800400),
+    (-888831900, -888799500),
+    (-888825600, -888796800),
+    (0, 28800),
+    (1699542834, 1699571634),
+    (568008000, 568036800),
+]
+
+
+def test_shanghai_to_utc_seconds():
+    inp, exp = zip(*TO_UTC_SECONDS)
+    out = convert_timestamp_to_utc(column(list(inp), TIMESTAMP_SECONDS), "Asia/Shanghai")
+    assert out.to_list() == list(exp)
+
+
+def test_shanghai_to_utc_millis():
+    inp = [v * 1000 for v, _ in TO_UTC_SECONDS[:-2]] + [1699571634312, 568036800000]
+    exp = [v * 1000 for _, v in TO_UTC_SECONDS[:-2]] + [1699542834312, 568008000000]
+    out = convert_timestamp_to_utc(column(inp, TIMESTAMP_MILLIS), "Asia/Shanghai")
+    assert out.to_list() == exp
+
+
+def test_shanghai_to_utc_micros():
+    inp = [v * 1000000 for v, _ in TO_UTC_SECONDS[:-2]] + [1699571634312000, 568036800000000]
+    exp = [v * 1000000 for _, v in TO_UTC_SECONDS[:-2]] + [1699542834312000, 568008000000000]
+    out = convert_timestamp_to_utc(column(inp, TIMESTAMP_MICROS), "Asia/Shanghai")
+    assert out.to_list() == exp
+
+
+def test_shanghai_from_utc_all_units():
+    inp, exp = zip(*FROM_UTC_SECONDS)
+    out = convert_utc_timestamp_to_timezone(
+        column(list(inp), TIMESTAMP_SECONDS), "Asia/Shanghai"
+    )
+    assert out.to_list() == list(exp)
+    out_ms = convert_utc_timestamp_to_timezone(
+        column([v * 1000 for v in inp[:-2]] + [1699542834312, 568008000000], TIMESTAMP_MILLIS),
+        "Asia/Shanghai",
+    )
+    assert out_ms.to_list() == [v * 1000 for v in exp[:-2]] + [1699571634312, 568036800000]
+    out_us = convert_utc_timestamp_to_timezone(
+        column([v * 1000000 for v in inp[:-2]] + [1699542834312000, 568008000000000],
+               TIMESTAMP_MICROS),
+        "Asia/Shanghai",
+    )
+    assert out_us.to_list() == [v * 1000000 for v in exp[:-2]] + [1699571634312000, 568036800000000]
+
+
+def test_database_loaded_like_reference():
+    """Mirrors databaseLoadedTest: UTC+8 is one fixed row; Shanghai row count
+    equals transitions + 1 (the LONG_MIN sentinel)."""
+    db = TimeZoneDB.instance()
+    utc8 = db.host_transitions("UTC+8")
+    assert len(utc8) == 1
+    assert utc8[0][2] == 8 * 3600
+    shanghai = db.host_transitions("Asia/Shanghai")
+    assert len(shanghai) > 10  # Shanghai has ~30 historical transitions
+    assert shanghai[0][0] == -(1 << 63)
+
+
+@pytest.mark.parametrize(
+    "zone", ["Asia/Shanghai", "Asia/Kolkata", "Asia/Ho_Chi_Minh", "Pacific/Apia"]
+)
+def test_from_utc_matches_zoneinfo(zone):
+    if zone == "Pacific/Apia":
+        # Apia has recurring DST in some tzdata versions; skip if rejected.
+        try:
+            TimeZoneDB.instance().transitions(zone)
+        except ValueError:
+            pytest.skip("zone has recurring DST rules in this tzdata")
+    rng = np.random.RandomState(31)
+    secs = [int(v) for v in rng.randint(-2_000_000_000, 2_000_000_000, size=200)]
+    out = convert_utc_timestamp_to_timezone(
+        column(secs, TIMESTAMP_SECONDS), zone
+    ).to_list()
+    zi = ZoneInfo(zone)
+    for s, got in zip(secs, out):
+        dt = datetime.datetime.fromtimestamp(s, tz=datetime.timezone.utc)
+        offset = zi.utcoffset(dt.astimezone(zi).replace(tzinfo=None))
+        want = s + int(dt.astimezone(zi).utcoffset().total_seconds())
+        assert got == want, (s, got, want, offset)
+
+
+def test_round_trip_away_from_transitions():
+    rng = np.random.RandomState(37)
+    secs = [int(v) for v in rng.randint(1_500_000_000, 2_000_000_000, size=100)]
+    col = column(secs, TIMESTAMP_SECONDS)
+    local = convert_utc_timestamp_to_timezone(col, "Asia/Kolkata")
+    back = convert_timestamp_to_utc(local, "Asia/Kolkata")
+    assert back.to_list() == secs
+
+
+def test_dst_zone_rejected():
+    with pytest.raises(ValueError, match="recurring DST"):
+        convert_timestamp_to_utc(
+            column([0], TIMESTAMP_SECONDS), "America/New_York"
+        )
+
+
+def test_unknown_zone_raises():
+    with pytest.raises(KeyError):
+        convert_timestamp_to_utc(column([0], TIMESTAMP_SECONDS), "Not/AZone")
+
+
+def test_fixed_offset_ids():
+    col = column([0, 1000], TIMESTAMP_SECONDS)
+    for zid, off in [("+08:00", 28800), ("UTC+8", 28800), ("-05:00", -18000),
+                     ("GMT+05:30", 19800), ("Z", 0), ("UTC", 0)]:
+        out = convert_utc_timestamp_to_timezone(col, zid)
+        assert out.to_list() == [0 + off, 1000 + off], zid
+
+
+def test_short_ids_and_legacy_minute_format():
+    assert normalize_zone_id("CTT") == "Asia/Shanghai"
+    assert normalize_zone_id("EST") == "-05:00"
+    assert normalize_zone_id("+08:3") == "+08:03"
+    out = convert_utc_timestamp_to_timezone(column([0], TIMESTAMP_SECONDS), "CTT")
+    assert out.to_list() == [28800]
+
+
+def test_invalid_offset_ids_raise():
+    col = column([0], TIMESTAMP_SECONDS)
+    for bad in ["+99:00", "+08:75", "+18:01", "-19:00"]:
+        with pytest.raises(ValueError):
+            convert_utc_timestamp_to_timezone(col, bad)
+    # exactly +/-18:00 is the java.time boundary and is allowed
+    assert convert_utc_timestamp_to_timezone(col, "+18:00").to_list() == [64800]
+
+
+def test_path_traversal_rejected():
+    with pytest.raises(KeyError):
+        convert_timestamp_to_utc(column([0], TIMESTAMP_SECONDS), "../../etc/passwd")
+
+
+def test_nulls_pass_through():
+    out = convert_timestamp_to_utc(
+        column([0, None], TIMESTAMP_SECONDS), "Asia/Shanghai"
+    )
+    assert out.to_list() == [-28800, None]
+
+
+def test_negative_truncation_millis():
+    """duration_cast truncates toward zero: -1ms -> 0s epoch seconds."""
+    out = convert_utc_timestamp_to_timezone(column([-1], TIMESTAMP_MILLIS), "UTC+8")
+    assert out.to_list() == [-1 + 28800 * 1000]
