@@ -29,6 +29,12 @@ from spark_rapids_jni_tpu.ops.histogram import (
     create_histogram_if_valid,
     percentile_from_histogram,
 )
+from spark_rapids_jni_tpu.ops.row_conversion import (
+    convert_from_rows,
+    convert_from_rows_fixed_width_optimized,
+    convert_to_rows,
+    convert_to_rows_fixed_width_optimized,
+)
 from spark_rapids_jni_tpu.ops.timezones import (
     TimeZoneDB,
     convert_timestamp_to_utc,
@@ -47,6 +53,10 @@ __all__ = [
     "create_histogram_if_valid",
     "percentile_from_histogram",
     "TimeZoneDB",
+    "convert_from_rows",
+    "convert_from_rows_fixed_width_optimized",
+    "convert_to_rows",
+    "convert_to_rows_fixed_width_optimized",
     "convert_timestamp_to_utc",
     "convert_utc_timestamp_to_timezone",
     "hilbert_index",

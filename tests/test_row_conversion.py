@@ -1,0 +1,222 @@
+"""Tests for JCUDF row conversion, mirroring RowConversionTest.java.
+
+The oracle builds JCUDF row bytes directly from the documented layout
+(RowConversion.java:44-117; compute_column_information row_conversion.cu:1323):
+struct-aligned columns, trailing LSB-first validity bits, string chars after
+the fixed section, 8-byte row alignment.  Conversion must be byte-exact, and
+to/from must round-trip losslessly including nulls.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import (
+    column,
+    strings_column,
+    BOOL,
+    INT8,
+    INT16,
+    INT32,
+    INT64,
+    FLOAT32,
+    FLOAT64,
+    STRING,
+)
+from spark_rapids_jni_tpu.columnar.column import decimal128_column
+from spark_rapids_jni_tpu.columnar.dtypes import DType, Kind
+from spark_rapids_jni_tpu.ops.row_conversion import (
+    compute_layout,
+    convert_from_rows,
+    convert_from_rows_fixed_width_optimized,
+    convert_to_rows,
+    convert_to_rows_fixed_width_optimized,
+)
+
+
+def _pack_value(v, dt):
+    if dt.kind == Kind.BOOL:
+        return struct.pack("<B", 1 if v else 0)
+    if dt.kind == Kind.INT8:
+        return struct.pack("<b", 0 if v is None else v)
+    if dt.kind == Kind.INT16:
+        return struct.pack("<h", v)
+    if dt.kind == Kind.INT32:
+        return struct.pack("<i", v)
+    if dt.kind == Kind.INT64:
+        return struct.pack("<q", v)
+    if dt.kind == Kind.FLOAT32:
+        return struct.pack("<f", v)
+    if dt.kind == Kind.FLOAT64:
+        return struct.pack("<d", v)
+    if dt.kind == Kind.DECIMAL128:
+        return (v & ((1 << 128) - 1)).to_bytes(16, "little")
+    raise AssertionError(dt)
+
+
+def jcudf_oracle(rows, dtypes):
+    """rows: list of per-row value tuples (None == null) -> list of row bytes."""
+    starts, sizes, validity_offset, size_per_row = compute_layout(dtypes)
+    out = []
+    for values in rows:
+        buf = bytearray(size_per_row)
+        svals = [v for v, dt in zip(values, dtypes) if dt.kind == Kind.STRING]
+        str_data = b""
+        within = size_per_row
+        si = 0
+        for v, dt, start in zip(values, dtypes, starts):
+            if dt.kind == Kind.STRING:
+                s = (svals[si] or "").encode() if svals[si] is not None else b""
+                buf[start : start + 8] = struct.pack("<II", within, len(s))
+                str_data += s
+                within += len(s)
+                si += 1
+            elif v is not None:
+                b = _pack_value(v, dt)
+                buf[start : start + len(b)] = b
+        for c, v in enumerate(values):
+            if v is not None:
+                buf[validity_offset + c // 8] |= 1 << (c % 8)
+        row = bytes(buf) + str_data
+        pad = (-len(row)) % 8
+        out.append(row + b"\x00" * pad)
+    return out
+
+
+def _batch_rows_bytes(batch):
+    data = np.asarray(batch.child.data)
+    offs = np.asarray(batch.offsets)
+    return [bytes(data[offs[i] : offs[i + 1]].tobytes()) for i in range(batch.size)]
+
+
+def test_layout_matches_javadoc_example():
+    # | A BOOL | P | B INT16 x2 | C INT32 x4 | V | P x7 | == 16-byte rows
+    starts, sizes, voff, spr = compute_layout([BOOL, INT16, INT32])
+    assert starts == [0, 2, 4] and voff == 8 and spr == 9
+
+
+def test_fixed_width_bytes_exact():
+    cols = [
+        column([True, False, None], BOOL),
+        column([1000, -2, 3], INT16),
+        column([7, None, -100000], INT32),
+        column([2**40, -1, 0], INT64),
+        column([1.5, -2.25, 3.75], FLOAT32),
+        column([3.141592653589793, -0.0, 1e300], FLOAT64),
+    ]
+    dtypes = [c.dtype for c in cols]
+    rows = list(zip(*[c.to_list() for c in cols]))
+    [batch] = convert_to_rows(cols)
+    assert _batch_rows_bytes(batch) == jcudf_oracle(rows, dtypes)
+
+
+def test_decimal128_bytes_exact():
+    cols = [decimal128_column([12345678901234567890123456789, -1, None], 38, 2)]
+    [batch] = convert_to_rows(cols)
+    want = jcudf_oracle(
+        [(12345678901234567890123456789,), (-1,), (None,)], [cols[0].dtype]
+    )
+    assert _batch_rows_bytes(batch) == want
+
+
+def test_strings_bytes_exact():
+    cols = [
+        column([1, 2, 3], INT32),
+        strings_column(["hello", "", None]),
+        strings_column(["x", "yz", "longer string here"]),
+    ]
+    dtypes = [c.dtype for c in cols]
+    rows = [(1, "hello", "x"), (2, "", "yz"), (3, None, "longer string here")]
+    [batch] = convert_to_rows(cols)
+    assert _batch_rows_bytes(batch) == jcudf_oracle(rows, dtypes)
+
+
+def test_round_trip_mixed():
+    rng = np.random.RandomState(5)
+    n = 257
+    ints = [int(v) if rng.rand() > 0.1 else None for v in rng.randint(-(2**31), 2**31, n)]
+    longs = [int(v) for v in rng.randint(-(2**62), 2**62, n)]
+    bools = [bool(v) if rng.rand() > 0.1 else None for v in rng.randint(0, 2, n)]
+    strs = [
+        None if rng.rand() < 0.1 else "s" * rng.randint(0, 20) + str(i)
+        for i, _ in enumerate(range(n))
+    ]
+    cols = [
+        column(ints, INT32),
+        strings_column(strs),
+        column(longs, INT64),
+        column(bools, BOOL),
+    ]
+    [batch] = convert_to_rows(cols)
+    back = convert_from_rows(batch, [c.dtype for c in cols])
+    for orig, b in zip(cols, back):
+        assert orig.to_list() == b.to_list()
+
+
+def test_round_trip_decimal128():
+    vals = [3, -(10**30), None, 10**37, -7]
+    cols = [decimal128_column(vals, 38, 4)]
+    [batch] = convert_to_rows(cols)
+    back = convert_from_rows(batch, [cols[0].dtype])
+    assert back[0].unscaled_to_list() == vals
+    assert back[0].dtype.scale == 4
+
+
+def test_many_columns_validity():
+    # >8 columns exercises multiple validity bytes
+    n = 20
+    cols = []
+    rng = np.random.RandomState(11)
+    for i in range(19):
+        vals = [int(v) if rng.rand() > 0.2 else None for v in rng.randint(-100, 100, n)]
+        cols.append(column(vals, INT32))
+    [batch] = convert_to_rows(cols)
+    rows = list(zip(*[c.to_list() for c in cols]))
+    assert _batch_rows_bytes(batch) == jcudf_oracle(rows, [c.dtype for c in cols])
+    back = convert_from_rows(batch, [c.dtype for c in cols])
+    for orig, b in zip(cols, back):
+        assert orig.to_list() == b.to_list()
+
+
+def test_batching_splits_on_32_row_boundaries():
+    n = 100
+    cols = [column(list(range(n)), INT64)]
+    # row size = round_up(8 + 1, 8) = 16 bytes; limit 16*40 -> 40 rows -> 32-row batches
+    batches = convert_to_rows(cols, max_batch_bytes=16 * 40)
+    sizes = [b.size for b in batches]
+    # 40 rows fit; non-final batches round down to 32, the final batch takes
+    # all remaining rows (build_batches row_conversion.cu:1505-1512)
+    assert sizes == [32, 32, 36]
+    got = []
+    for b in batches:
+        got.extend(convert_from_rows(b, [INT64])[0].to_list())
+    assert got == list(range(n))
+
+
+def test_batching_exact_fit_boundary():
+    """Regression: rows summing exactly to the limit form one full batch."""
+    cols = [column(list(range(64)), INT64)]  # 16-byte rows
+    batches = convert_to_rows(cols, max_batch_bytes=16 * 32)
+    assert [b.size for b in batches] == [32, 32]
+
+
+def test_oversized_row_raises():
+    with pytest.raises(ValueError, match="larger than the maximum batch"):
+        convert_to_rows([column([1, 2], INT64)], max_batch_bytes=8)
+
+
+def test_fixed_width_optimized_limits():
+    with pytest.raises(TypeError):
+        convert_to_rows_fixed_width_optimized([strings_column(["a"])])
+    too_many = [column([1], INT32) for _ in range(100)]
+    with pytest.raises(ValueError):
+        convert_to_rows_fixed_width_optimized(too_many)
+    ok = convert_to_rows_fixed_width_optimized([column([1, 2], INT32)])
+    assert convert_from_rows_fixed_width_optimized(ok[0], [INT32])[0].to_list() == [1, 2]
+
+
+def test_row_alignment():
+    [batch] = convert_to_rows([column([1], INT8), strings_column(["abc"])])
+    offs = np.asarray(batch.offsets)
+    assert all(o % 8 == 0 for o in offs)
