@@ -12,6 +12,14 @@ from spark_rapids_jni_tpu.ops.bloom_filter import (
     bloom_filter_put,
     bloom_filter_serialize,
 )
+from spark_rapids_jni_tpu.ops.cast_string import (
+    CastException,
+    from_integers_with_base,
+    string_to_decimal,
+    string_to_integer,
+    to_integers_with_base,
+)
+from spark_rapids_jni_tpu.ops.cast_string_to_float import string_to_float
 from spark_rapids_jni_tpu.ops.datetime_rebase import (
     rebase_gregorian_to_julian,
     rebase_julian_to_gregorian,
@@ -25,6 +33,7 @@ from spark_rapids_jni_tpu.ops.decimal128 import (
     subtract128,
 )
 
+from spark_rapids_jni_tpu.ops.float_to_string import float_to_string
 from spark_rapids_jni_tpu.ops.histogram import (
     create_histogram_if_valid,
     percentile_from_histogram,
@@ -44,6 +53,11 @@ from spark_rapids_jni_tpu.ops.zorder import hilbert_index, interleave_bits
 
 __all__ = [
     "BloomFilter",
+    "CastException",
+    "from_integers_with_base",
+    "string_to_decimal",
+    "string_to_integer",
+    "to_integers_with_base",
     "bloom_filter_create",
     "bloom_filter_deserialize",
     "bloom_filter_merge",
@@ -52,6 +66,8 @@ __all__ = [
     "bloom_filter_serialize",
     "create_histogram_if_valid",
     "percentile_from_histogram",
+    "float_to_string",
+    "string_to_float",
     "TimeZoneDB",
     "convert_from_rows",
     "convert_from_rows_fixed_width_optimized",

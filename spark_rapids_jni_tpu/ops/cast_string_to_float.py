@@ -1,0 +1,336 @@
+"""Spark-exact string -> float32/float64 cast.
+
+Behavioral parity with the reference's warp-per-row parser
+(cast_string_to_float.cu:598 string_to_float_kernel; parse stages :86-557),
+including its quirks:
+
+- 'nan' (any case) is only valid as the exact 3-char string; leading
+  whitespace/sign make it null (+ ANSI error) but still parse as nan
+  (check_for_nan :243-260);
+- 'inf'/'infinity' allow leading whitespace and sign, must end the string,
+  and garbage after them is null WITHOUT an ANSI error (check_for_inf :276);
+- at most 19 significant digits accumulate into a uint64; beyond that, digits
+  truncate with the reference's exact (slightly lossy) exponent accounting
+  (parse_digits :327-470, max_holding rule :395-445);
+- manual exponents read at most 4 digits (parse_manual_exp :505);
+- a single trailing f/F/d/D is allowed — except after a zero value, where only
+  whitespace may follow (operator() :134-145);
+- the final value is digits x 10^exp in IEEE binary64 (subnormal two-step
+  :158-195), cast to float32 at the end for FLOAT32 outputs.
+
+TPU split: the O(n x len) character scan is vectorized lane arithmetic on the
+padded byte matrix (cummax prefix masks replace the warp ballot/shuffle
+choreography).  The final O(n) digits->double assembly runs on host in exact
+binary64 — TPU f64 is float32-pair emulated and would not be bit-exact
+(columnar.column doc).  Digit windows longer than one warp batch (32 chars)
+follow the single-batch accounting rather than the reference's batch-boundary-
+dependent truncation bookkeeping.
+
+Known <=1-ulp divergence: for negative powers (10^-k) our table is the
+correctly-rounded binary64 value, while CUDA's exp10 is occasionally 1 ulp
+off (verified at exp10(-291)); this only shows in the extreme-exponent range
+where the reference already deviates from Java's correctly-rounded parse.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar.column import Column, StringColumn
+from spark_rapids_jni_tpu.columnar.dtypes import DType, FLOAT32, FLOAT64, Kind
+from spark_rapids_jni_tpu.ops.cast_string import CastException
+
+MAX_SAFE_DIGITS = 19
+MAX_HOLDING = ((1 << 64) - 1 - 9) // 10  # 1844674407370955160
+
+# binary64 values of 10^k for k in [-360, 359].  Non-negative k: float(10**k)
+# is correctly rounded (exact integer -> nearest double), overflowing to inf
+# past 308, matching exp10 saturation.  Negative k: libm pow (what CUDA's
+# exp10 effectively is within an ulp).
+_EXP10_OFFSET = 360
+_EXP10 = np.array(
+    [float(np.power(10.0, k)) for k in range(-_EXP10_OFFSET, 0)]
+    + [float(10**k) if k <= 308 else np.inf for k in range(360)],
+    dtype=np.float64,
+)
+
+
+def _exp10(k: np.ndarray) -> np.ndarray:
+    idx = np.clip(k + _EXP10_OFFSET, 0, len(_EXP10) - 1)
+    return _EXP10[idx]
+
+
+def _scan(col: StringColumn):
+    """Device scan: per-row parse fields, all as [n] arrays.
+
+    Returns a dict of numpy arrays (pulled to host once, together).
+    """
+    padded, lens = col.padded()
+    n, L = padded.shape
+    lens = lens.astype(jnp.int32)
+    pos_mat = jnp.arange(L, dtype=jnp.int32)[None, :]
+    in_str = pos_mat < lens[:, None]
+    c = padded
+    lower = jnp.where((c >= 65) & (c <= 90), c + 32, c)  # ascii tolower
+
+    is_ws = ((c <= 0x1F) | (c == 32)) & in_str
+    is_digit = (c >= 48) & (c <= 57) & in_str
+    is_dot = (c == 46) & in_str
+
+    def first_true(mask, default):
+        """index of first True per row, else default."""
+        any_ = jnp.any(mask, axis=1)
+        idx = jnp.argmax(mask, axis=1).astype(jnp.int32)
+        return jnp.where(any_, idx, jnp.int32(default))
+
+    def char_at(p):
+        """lowercased char at position p (0 beyond string)."""
+        pc = jnp.clip(p, 0, L - 1)
+        v = jnp.take_along_axis(lower, pc[:, None], axis=1)[:, 0]
+        return jnp.where((p >= 0) & (p < lens), v, jnp.uint8(0))
+
+    # leading whitespace: first position that is not whitespace (positions at
+    # or beyond the string end count as non-ws, so all-ws rows land on lens)
+    ws_end = jnp.minimum(first_true(~is_ws, L), lens)
+    all_ws = ws_end >= lens
+
+    c0 = char_at(ws_end)
+    has_sign = (c0 == ord("+")) | (c0 == ord("-"))
+    negative = c0 == ord("-")
+    p0 = ws_end + has_sign.astype(jnp.int32)
+
+    def match(p, word):
+        ok = jnp.ones((n,), jnp.bool_)
+        for k, ch in enumerate(word):
+            ok &= char_at(p + k) == ord(ch)
+        return ok
+
+    is_nan = match(p0, "nan")
+    inf3 = match(p0, "inf")
+    inf8 = inf3 & match(p0 + 3, "inity")
+    inf_exact = (inf3 & (p0 + 3 == lens)) | (inf8 & (p0 + 8 == lens))
+
+    # ---- digit run [p0, stop) : digits plus at most the first dot ----
+    after_p0 = pos_mat >= p0[:, None]
+    dot_in_tail = is_dot & after_p0
+    first_dot = first_true(dot_in_tail, L)
+    run_char = is_digit | (pos_mat == first_dot[:, None])
+    # break at first position >= p0 that is not a run char
+    brk = after_p0 & ~run_char
+    stop = first_true(brk, L)
+    stop = jnp.minimum(stop, lens)
+    in_run = after_p0 & (pos_mat < stop[:, None])
+    dot_in_run = (first_dot < stop) & (first_dot >= p0)
+    digit_in_run = is_digit & in_run
+
+    # leading zeros before the dot (while the value is still zero)
+    nonzero_digit = digit_in_run & (c != 48)
+    first_sig = first_true(nonzero_digit, L)  # first nonzero digit anywhere
+    pre_dot = pos_mat < first_dot[:, None]
+    lead_zero = digit_in_run & pre_dot & (pos_mat < first_sig[:, None])
+    n_lead_zeros = jnp.sum(lead_zero, axis=1).astype(jnp.int32)
+
+    sig_mask = digit_in_run & ~lead_zero
+    n_sig = jnp.sum(sig_mask, axis=1).astype(jnp.int32)  # digit chars kept
+    n_digit_chars = jnp.sum(digit_in_run, axis=1).astype(jnp.int32)
+    # significant digits before the dot
+    decimal_pos = jnp.sum(sig_mask & pre_dot, axis=1).astype(jnp.int32)
+
+    # rank of each significant digit (0-based within the kept sequence)
+    rank = jnp.cumsum(sig_mask.astype(jnp.int32), axis=1) - 1
+    take20 = sig_mask & (rank < 20)
+    # value of first min(n_sig, 20) digits as u64 (20 digits can express the
+    # +1-digit rule's candidate; overflow beyond is masked before use)
+    k_eff = jnp.minimum(n_sig, 20)
+    weight_pow = jnp.where(take20, (k_eff[:, None] - 1 - rank), 0)
+    pow10 = jnp.asarray(
+        np.array([10**k for k in range(20)], dtype=np.uint64)
+    )
+    w = pow10[jnp.clip(weight_pow, 0, 19)]
+    digit_vals = (c - jnp.uint8(48)).astype(jnp.uint64)
+    val20 = jnp.sum(jnp.where(take20, digit_vals * w, jnp.uint64(0)), axis=1)
+    # value of first min(n_sig, 19) digits
+    k19 = jnp.minimum(n_sig, 19)
+    take19 = sig_mask & (rank < 19)
+    w19 = pow10[jnp.clip(jnp.where(take19, (k19[:, None] - 1 - rank), 0), 0, 19)]
+    val19 = jnp.sum(jnp.where(take19, digit_vals * w19, jnp.uint64(0)), axis=1)
+    # the 20th digit itself
+    d20 = jnp.sum(
+        jnp.where(sig_mask & (rank == 19), digit_vals, jnp.uint64(0)), axis=1
+    )
+
+    # ---- manual exponent at `stop` ----
+    ce = char_at(stop)
+    has_exp = ce == ord("e")
+    pe = stop + 1
+    cs = char_at(pe)
+    exp_has_sign = has_exp & ((cs == ord("+")) | (cs == ord("-")))
+    exp_neg = exp_has_sign & (cs == ord("-"))
+    pd = pe + exp_has_sign.astype(jnp.int32)
+    # up to 4 digit chars considered
+    exp_digits = jnp.zeros((n,), jnp.int32)
+    exp_val = jnp.zeros((n,), jnp.int32)
+    still = jnp.ones((n,), jnp.bool_)
+    for k in range(4):
+        ck = char_at(pd + k)
+        is_d = (ck >= 48) & (ck <= 57) & still & (pd + k < lens)
+        exp_val = jnp.where(is_d, exp_val * 10 + (ck - 48).astype(jnp.int32), exp_val)
+        exp_digits = exp_digits + is_d.astype(jnp.int32)
+        still = still & is_d
+    p_after_exp = jnp.where(has_exp, pd + exp_digits, stop)
+
+    # ---- trailing: one f/d then whitespace then end ----
+    cf = char_at(p_after_exp)
+    has_suffix = (cf == ord("f")) | (cf == ord("d"))
+    pt = p_after_exp + has_suffix.astype(jnp.int32)
+    tail = (pos_mat >= pt[:, None]) & in_str
+    tail_nonws = jnp.any(tail & ~is_ws, axis=1)
+
+    # zero-value rows allow only whitespace after the number (no f/d suffix)
+    tail0 = (pos_mat >= p_after_exp[:, None]) & in_str
+    tail0_nonws = jnp.any(tail0 & ~is_ws, axis=1)
+
+    fields = dict(
+        lens=lens, all_ws=all_ws, negative=negative,
+        is_nan=is_nan, inf3=inf3, inf_exact=inf_exact,
+        n_lead_zeros=n_lead_zeros, n_sig=n_sig, n_digit_chars=n_digit_chars,
+        decimal_pos=decimal_pos, dot_in_run=dot_in_run,
+        val19=val19, val20=val20, d20=d20,
+        has_exp=has_exp, exp_neg=exp_neg, exp_val=exp_val,
+        exp_digits=exp_digits,
+        has_suffix=has_suffix, tail_nonws=tail_nonws, tail0_nonws=tail0_nonws,
+        stop_eq_p0=(stop == p0), first_dot_valid=dot_in_run,
+        p0=p0, stop=stop, first_dot=first_dot,
+    )
+    return {k: np.asarray(v) for k, v in fields.items()}
+
+
+def _assemble(f, out_dtype_np):
+    """Host: replicate the reference's final double assembly (:134-199)."""
+    n = f["lens"].shape[0]
+    out = np.zeros((n,), np.float64)
+    valid = np.ones((n,), bool)
+    except_ = np.zeros((n,), bool)
+
+    lens = f["lens"].astype(np.int64)
+    sign = np.where(f["negative"], -1.0, 1.0)
+
+    # nan: always writes NaN; only the bare 3-char string is valid
+    nan_rows = f["is_nan"]
+    out[nan_rows] = np.nan
+    bad_nan = nan_rows & (lens != 3)
+    valid[bad_nan] = False
+    except_[bad_nan] = True
+
+    # inf / infinity
+    inf_rows = f["inf3"] & ~nan_rows
+    ok_inf = inf_rows & f["inf_exact"]
+    out[ok_inf] = np.where(f["negative"][ok_inf], -np.inf, np.inf)
+    valid[inf_rows & ~f["inf_exact"]] = False  # no ANSI error (cu :276 comment)
+
+    plain = ~nan_rows & ~inf_rows
+
+    # no digits at all -> invalid + except (includes empty / all-ws strings)
+    seen_digit = (f["n_digit_chars"] > 0) | (f["n_lead_zeros"] > 0)
+    no_digits = plain & ~seen_digit
+    valid[no_digits] = False
+    except_[no_digits] = True
+
+    # 19/20-digit accumulation with the reference's truncation accounting
+    n_sig = f["n_sig"].astype(np.int64)
+    digits = f["val19"].copy()
+    real_digits = np.minimum(n_sig, 19)
+    truncated = np.zeros((n,), np.int64)
+    over = n_sig > 19
+    # single-batch equivalence: num_chars = n_sig, safe_count = 19
+    can_add = over & (f["val19"] <= MAX_HOLDING) & (
+        f["val19"] * 10 + f["d20"] <= MAX_HOLDING
+    )
+    digits = np.where(can_add, f["val20"], digits)
+    truncated = np.where(
+        over & can_add, n_sig - 18, np.where(over, n_sig - 19, 0)
+    )
+
+    total_digits = real_digits + truncated
+    exp_base = truncated - np.where(
+        f["dot_in_run"], total_digits - f["decimal_pos"].astype(np.int64), 0
+    )
+
+    # manual exponent; 'e' with no digits is invalid
+    bad_exp = plain & f["has_exp"] & (f["exp_digits"] == 0)
+    valid[bad_exp] = False
+    except_[bad_exp] = True
+    manual = np.where(f["exp_neg"], -f["exp_val"], f["exp_val"]).astype(np.int64)
+    manual = np.where(f["has_exp"], manual, 0)
+
+    zero = plain & (digits == 0) & seen_digit
+    bad_zero_tail = zero & f["tail0_nonws"]
+    valid[bad_zero_tail] = False
+    except_[bad_zero_tail] = True
+    out = np.where(zero, sign * 0.0, out)
+
+    nonzero = plain & (digits != 0)
+    bad_tail = nonzero & f["tail_nonws"]
+    valid[bad_tail] = False
+    except_[bad_tail] = True
+
+    # final assembly in binary64 (cast_string_to_float.cu:153-199)
+    exp_ten = (exp_base + manual).astype(np.int64)
+    digitsf = sign * digits.astype(np.float64)
+    with np.errstate(over="ignore", invalid="ignore", divide="ignore"):
+        res = np.zeros((n,), np.float64)
+        too_big = exp_ten > 308
+        res[too_big] = np.where(f["negative"][too_big], -np.inf, np.inf)
+        sub_shift = -307 - exp_ten
+        subnormal = ~too_big & (sub_shift > 0)
+        if subnormal.any():
+            nd = np.char.str_len(
+                digits[subnormal].astype("U32")
+            ).astype(np.int64)  # number of digits
+            dsub = digitsf[subnormal] / _exp10(nd - 1 + sub_shift[subnormal])
+            e2 = exp_ten[subnormal] + nd - 1 + sub_shift[subnormal]
+            res[subnormal] = dsub * _exp10(e2)
+        normal = ~too_big & ~subnormal
+        exponent = _exp10(np.abs(exp_ten[normal]))
+        dn = digitsf[normal]
+        res[normal] = np.where(exp_ten[normal] < 0, dn / exponent, dn * exponent)
+    out = np.where(nonzero, res, out)
+
+    if out_dtype_np == np.float32:
+        with np.errstate(over="ignore"):  # double->float32 overflow -> inf
+            out = out.astype(np.float32)
+    return out, valid, except_
+
+
+def string_to_float(
+    col: StringColumn, ansi_mode: bool, dtype: DType = FLOAT64
+) -> Column:
+    """Parse a string column into FLOAT32/FLOAT64 with Spark semantics.
+
+    Invalid rows become null, or raise CastException (with the first bad row
+    index) when ``ansi_mode`` (CastStringJni.cpp CATCH_CAST_EXCEPTION path).
+    """
+    if dtype.kind not in (Kind.FLOAT32, Kind.FLOAT64):
+        raise TypeError("string_to_float produces FLOAT32 or FLOAT64")
+    f = _scan(col)
+    np_t = np.float32 if dtype.kind == Kind.FLOAT32 else np.float64
+    out, valid, except_ = _assemble(f, np_t)
+
+    in_valid = (
+        np.ones((col.size,), bool)
+        if col.validity is None
+        else np.asarray(col.validity)
+    )
+    except_ &= in_valid
+    if ansi_mode and except_.any():
+        row = int(np.nonzero(except_)[0][0])
+        raise CastException(col.to_list()[row], row)
+
+    validity_np = valid & in_valid
+    validity = None if validity_np.all() else jnp.asarray(validity_np)
+    if dtype.kind == Kind.FLOAT64:
+        data = jnp.asarray(out.view(np.int64))  # bit-pattern convention
+    else:
+        data = jnp.asarray(out)
+    return Column(data, validity, dtype)

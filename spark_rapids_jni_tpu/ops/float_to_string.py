@@ -1,0 +1,453 @@
+"""Java ``Double.toString`` / ``Float.toString`` — vectorized Ryu on TPU.
+
+Capability parity with the reference's device Ryu port (ftos_converter.cuh:
+d2d :480, f2d :575, to_chars :797/:922, special strings :259; driver
+cast_float_to_string.cu:34-128): the shortest decimal representation that
+round-trips, formatted per the Java spec — plain notation in [1e-3, 1e7),
+scientific ``d.dddE±x`` otherwise, ``NaN`` / ``Infinity`` / ``-0.0`` specials.
+
+The reference runs scalar Ryu per GPU thread.  Here every step is lane
+arithmetic over the whole column:
+
+- 128-bit multiplies decompose into 32-bit limb products in uint64 lanes
+  (_umul128), with per-lane variable shifts;
+- the power-of-5 tables are exact-precomputed host arrays (utils.ryu_tables)
+  gathered per element;
+- Ryu's shortest-search loop has a bounded trip count (<= 22 digit removals),
+  so it unrolls into masked iterations;
+- character emission is a batch scatter of (row, position) pairs into a padded
+  byte matrix, rebuilt into an Arrow StringColumn.
+
+FLOAT64 input is the int64 bit-pattern convention (columnar.column) — exactly
+what Ryu wants: the algorithm never touches float arithmetic.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar.column import (
+    Column,
+    StringColumn,
+    strings_from_padded,
+)
+from spark_rapids_jni_tpu.columnar.dtypes import Kind
+from spark_rapids_jni_tpu.utils.floatbits import f32_to_bits
+from spark_rapids_jni_tpu.utils import ryu_tables as rt
+
+_U64 = jnp.uint64
+_U32 = jnp.uint32
+_I32 = jnp.int32
+_M32 = jnp.uint64(0xFFFFFFFF)
+
+MAX_D2S_LEN = 24  # sign + 17 digits + '.' + pad0 + 'E' + '-' + 3 exp digits
+
+_POW10_U64 = jnp.asarray(np.array([10**k for k in range(20)], dtype=np.uint64))
+_POW5_U64 = jnp.asarray(np.array([5**k for k in range(24)], dtype=np.uint64))
+
+
+def _u64(x):
+    return jnp.asarray(x, dtype=jnp.uint64)
+
+
+def _umul128(a, b):
+    """(hi, lo) of the full 128-bit product of two u64 lane arrays."""
+    a_lo, a_hi = a & _M32, a >> _U64(32)
+    b_lo, b_hi = b & _M32, b >> _U64(32)
+    ll = a_lo * b_lo
+    lh = a_lo * b_hi
+    hl = a_hi * b_lo
+    hh = a_hi * b_hi
+    mid = (ll >> _U64(32)) + (lh & _M32) + (hl & _M32)
+    lo = (ll & _M32) | ((mid & _M32) << _U64(32))
+    hi = hh + (lh >> _U64(32)) + (hl >> _U64(32)) + (mid >> _U64(32))
+    return hi, lo
+
+
+def _shiftright128(lo, hi, dist):
+    """(hi:lo) >> dist for per-lane dist in (0, 64)."""
+    dist = dist.astype(jnp.uint64)
+    return (hi << (_U64(64) - dist)) | (lo >> dist)
+
+
+def _mul_shift64(m, mul_lo, mul_hi, j):
+    """Ryu mulShift64 (ftos_converter.cuh:375): ((m * mul) >> j) low 64."""
+    hi1, lo1 = _umul128(m, mul_hi)
+    hi0, _lo0 = _umul128(m, mul_lo)
+    s = hi0 + lo1
+    hi1 = hi1 + (s < hi0).astype(jnp.uint64)  # carry
+    return _shiftright128(s, hi1, j - 64)
+
+
+def _pow5bits(e):
+    return ((e * _I32(1217359)) >> 19) + _I32(1)
+
+
+def _log10_pow2(e):
+    return (e * _I32(78913)) >> 18
+
+
+def _log10_pow5(e):
+    return (e * _I32(732923)) >> 20
+
+
+def _multiple_of_pow5(value, q):
+    """value % 5^q == 0 for q in [0, 23] lanes (exact u64 mod)."""
+    return value % _POW5_U64[jnp.clip(q, 0, 23)] == 0
+
+
+def _multiple_of_pow2(value, q):
+    mask = (_U64(1) << jnp.clip(q, 0, 63).astype(jnp.uint64)) - _U64(1)
+    return (value & mask) == 0
+
+
+def _decimal_length_u64(v, max_digits):
+    """number of decimal digits of v (>= 1)."""
+    n = jnp.ones(v.shape, _I32)
+    for k in range(1, max_digits):
+        n = n + (v >= _POW10_U64[k]).astype(_I32)
+    return n
+
+
+def _d2d(bits):
+    """Vectorized Ryu d2d (ftos_converter.cuh:480): bit patterns ->
+    (mantissa u64, exponent i32) of the shortest decimal."""
+    u = bits.astype(jnp.uint64)
+    ieee_mantissa = u & _U64((1 << 52) - 1)
+    ieee_exponent = ((u >> _U64(52)) & _U64(0x7FF)).astype(_I32)
+
+    denormal = ieee_exponent == 0
+    e2 = jnp.where(denormal, _I32(1 - 1023 - 52 - 2), ieee_exponent - (1023 + 52 + 2))
+    m2 = jnp.where(denormal, ieee_mantissa, ieee_mantissa | _U64(1 << 52))
+    even = (m2 & _U64(1)) == 0
+    accept_bounds = even
+
+    mv = _U64(4) * m2
+    mm_shift = ((ieee_mantissa != 0) | (ieee_exponent <= 1)).astype(jnp.uint64)
+
+    # --- branch A: e2 >= 0 (inverse powers of 5) ---
+    qa = jnp.maximum(_log10_pow2(e2) - (e2 > 3).astype(_I32), 0)
+    ka = _I32(rt.DOUBLE_POW5_INV_BITCOUNT) + _pow5bits(qa) - 1
+    ja = -e2 + qa + ka  # shift argument
+    qa_c = jnp.clip(qa, 0, len(rt.DOUBLE_POW5_INV_SPLIT_LO) - 1)
+    inv_lo = jnp.asarray(rt.DOUBLE_POW5_INV_SPLIT_LO)[qa_c]
+    inv_hi = jnp.asarray(rt.DOUBLE_POW5_INV_SPLIT_HI)[qa_c]
+    vr_a = _mul_shift64(mv, inv_lo, inv_hi, ja)
+    vp_a = _mul_shift64(mv + _U64(2), inv_lo, inv_hi, ja)
+    vm_a = _mul_shift64(mv - _U64(1) - mm_shift, inv_lo, inv_hi, ja)
+    e10_a = qa
+    # trailing-zero flags (q <= 21 guard)
+    guard_a = qa <= 21
+    mv_mod5 = mv % _U64(5) == 0
+    vr_tz_a = guard_a & mv_mod5 & _multiple_of_pow5(mv, qa)
+    vm_tz_a = guard_a & ~mv_mod5 & accept_bounds & _multiple_of_pow5(
+        mv - _U64(1) - mm_shift, qa
+    )
+    vp_a = vp_a - (
+        guard_a & ~mv_mod5 & ~accept_bounds & _multiple_of_pow5(mv + _U64(2), qa)
+    ).astype(jnp.uint64)
+
+    # --- branch B: e2 < 0 (powers of 5) ---
+    neg_e2 = -e2
+    qb = jnp.maximum(_log10_pow5(neg_e2) - (neg_e2 > 1).astype(_I32), 0)
+    ib = neg_e2 - qb
+    kb = _pow5bits(ib) - _I32(rt.DOUBLE_POW5_BITCOUNT)
+    jb = qb - kb
+    ib_c = jnp.clip(ib, 0, len(rt.DOUBLE_POW5_SPLIT_LO) - 1)
+    pw_lo = jnp.asarray(rt.DOUBLE_POW5_SPLIT_LO)[ib_c]
+    pw_hi = jnp.asarray(rt.DOUBLE_POW5_SPLIT_HI)[ib_c]
+    vr_b = _mul_shift64(mv, pw_lo, pw_hi, jb)
+    vp_b = _mul_shift64(mv + _U64(2), pw_lo, pw_hi, jb)
+    vm_b = _mul_shift64(mv - _U64(1) - mm_shift, pw_lo, pw_hi, jb)
+    e10_b = qb + e2
+    q_le1 = qb <= 1
+    vr_tz_b = q_le1 | ((qb < 63) & _multiple_of_pow2(mv, qb))
+    vm_tz_b = q_le1 & (mm_shift == 1)
+    vp_b = vp_b - (q_le1 & ~accept_bounds).astype(jnp.uint64)
+
+    pos = e2 >= 0
+    vr = jnp.where(pos, vr_a, vr_b)
+    vp = jnp.where(pos, vp_a, vp_b)
+    vm = jnp.where(pos, vm_a, vm_b)
+    e10 = jnp.where(pos, e10_a, e10_b)
+    vm_tz = jnp.where(pos, vm_tz_a, vm_tz_b)
+    vr_tz = jnp.where(pos, vr_tz_a, vr_tz_b)
+
+    return _shortest_loop(vr, vp, vm, e10, vm_tz, vr_tz, accept_bounds, 22)
+
+
+def _f2d(bits):
+    """Vectorized Ryu f2d (ftos_converter.cuh:575) in u64 lanes."""
+    u = bits.astype(jnp.uint64) & _M32
+    ieee_mantissa = u & _U64((1 << 23) - 1)
+    ieee_exponent = ((u >> _U64(23)) & _U64(0xFF)).astype(_I32)
+
+    denormal = ieee_exponent == 0
+    e2 = jnp.where(denormal, _I32(1 - 127 - 23 - 2), ieee_exponent - (127 + 23 + 2))
+    m2 = jnp.where(denormal, ieee_mantissa, ieee_mantissa | _U64(1 << 23))
+    even = (m2 & _U64(1)) == 0
+    accept_bounds = even
+
+    mv = _U64(4) * m2
+    mp = mv + _U64(2)
+    mm_shift = ((ieee_mantissa != 0) | (ieee_exponent <= 1)).astype(jnp.uint64)
+    mm = mv - _U64(1) - mm_shift
+
+    inv_tab = jnp.asarray(rt.FLOAT_POW5_INV_SPLIT)
+    pow_tab = jnp.asarray(rt.FLOAT_POW5_SPLIT)
+
+    def mul_pow5_inv_div_pow2(m, q, j):
+        factor = inv_tab[jnp.clip(q, 0, len(rt.FLOAT_POW5_INV_SPLIT) - 1)]
+        return _mul_shift32(m, factor, j)
+
+    def mul_pow5_div_pow2(m, i, j):
+        factor = pow_tab[jnp.clip(i, 0, len(rt.FLOAT_POW5_SPLIT) - 1)]
+        return _mul_shift32(m, factor, j)
+
+    # branch A: e2 >= 0
+    qa = jnp.maximum(_log10_pow2(e2), 0)
+    ka = _I32(rt.FLOAT_POW5_INV_BITCOUNT) + _pow5bits(qa) - 1
+    ja = -e2 + qa + ka
+    vr_a = mul_pow5_inv_div_pow2(mv, qa, ja)
+    vp_a = mul_pow5_inv_div_pow2(mp, qa, ja)
+    vm_a = mul_pow5_inv_div_pow2(mm, qa, ja)
+    e10_a = qa
+    la = _I32(rt.FLOAT_POW5_INV_BITCOUNT) + _pow5bits(jnp.maximum(qa - 1, 0)) - 1
+    lrd_a = jnp.where(
+        (qa != 0) & ((vp_a - _U64(1)) // _U64(10) <= vm_a // _U64(10)),
+        mul_pow5_inv_div_pow2(mv, jnp.maximum(qa - 1, 0), -e2 + qa - 1 + la)
+        % _U64(10),
+        _U64(0),
+    )
+    guard_a = qa <= 9
+    mv_mod5 = mv % _U64(5) == 0
+    vr_tz_a = guard_a & mv_mod5 & _multiple_of_pow5(mv, qa)
+    vm_tz_a = guard_a & ~mv_mod5 & accept_bounds & _multiple_of_pow5(mm, qa)
+    vp_a = vp_a - (
+        guard_a & ~mv_mod5 & ~accept_bounds & _multiple_of_pow5(mp, qa)
+    ).astype(jnp.uint64)
+
+    # branch B: e2 < 0
+    neg_e2 = -e2
+    qb = jnp.maximum(_log10_pow5(neg_e2), 0)
+    ib = neg_e2 - qb
+    kb = _pow5bits(ib) - _I32(rt.FLOAT_POW5_BITCOUNT)
+    jb = qb - kb
+    vr_b = mul_pow5_div_pow2(mv, ib, jb)
+    vp_b = mul_pow5_div_pow2(mp, ib, jb)
+    vm_b = mul_pow5_div_pow2(mm, ib, jb)
+    e10_b = qb + e2
+    jb2 = qb - 1 - (_pow5bits(ib + 1) - _I32(rt.FLOAT_POW5_BITCOUNT))
+    lrd_b = jnp.where(
+        (qb != 0) & ((vp_b - _U64(1)) // _U64(10) <= vm_b // _U64(10)),
+        mul_pow5_div_pow2(mv, ib + 1, jb2) % _U64(10),
+        _U64(0),
+    )
+    q_le1 = qb <= 1
+    vr_tz_b = q_le1 | ((qb < 31) & _multiple_of_pow2(mv, jnp.maximum(qb - 1, 0)))
+    vm_tz_b = q_le1 & (mm_shift == 1)
+    vp_b = vp_b - (q_le1 & ~accept_bounds).astype(jnp.uint64)
+
+    pos = e2 >= 0
+    vr = jnp.where(pos, vr_a, vr_b)
+    vp = jnp.where(pos, vp_a, vp_b)
+    vm = jnp.where(pos, vm_a, vm_b)
+    e10 = jnp.where(pos, e10_a, e10_b)
+    vm_tz = jnp.where(pos, vm_tz_a, vm_tz_b)
+    vr_tz = jnp.where(pos, vr_tz_a, vr_tz_b)
+    lrd = jnp.where(pos, lrd_a, lrd_b)
+
+    return _shortest_loop(
+        vr, vp, vm, e10, vm_tz, vr_tz, accept_bounds, 11, last_removed=lrd
+    )
+
+
+def _mul_shift32(m, factor, shift):
+    """Ryu mulShift32 (ftos_converter.cuh:242) in u64 lanes; shift > 32."""
+    factor_lo = factor & _M32
+    factor_hi = factor >> _U64(32)
+    bits0 = m * factor_lo
+    bits1 = m * factor_hi
+    s = (bits0 >> _U64(32)) + bits1
+    return s >> (shift.astype(jnp.uint64) - _U64(32))
+
+
+def _shortest_loop(vr, vp, vm, e10, vm_tz, vr_tz, accept_bounds, max_iter,
+                   last_removed=None):
+    """Ryu step 4 (ftos_converter.cuh:570-650): masked unrolled digit removal.
+
+    The reference's common-case div100 fast path is an optimization of the
+    same recurrence; the general loop with correctly-initialized flags gives
+    identical output for all lanes.
+    """
+    removed = jnp.zeros(vr.shape, _I32)
+    lrd = jnp.zeros(vr.shape, jnp.uint64) if last_removed is None else last_removed
+
+    for _ in range(max_iter):
+        act = vp // _U64(10) > vm // _U64(10)
+        vm_tz = jnp.where(act, vm_tz & (vm % _U64(10) == 0), vm_tz)
+        vr_tz = jnp.where(act, vr_tz & (lrd == 0), vr_tz)
+        lrd = jnp.where(act, vr % _U64(10), lrd)
+        vr = jnp.where(act, vr // _U64(10), vr)
+        vp = jnp.where(act, vp // _U64(10), vp)
+        vm = jnp.where(act, vm // _U64(10), vm)
+        removed = removed + act.astype(_I32)
+
+    for _ in range(max_iter):
+        act = vm_tz & (vm % _U64(10) == 0)
+        vr_tz = jnp.where(act, vr_tz & (lrd == 0), vr_tz)
+        lrd = jnp.where(act, vr % _U64(10), lrd)
+        vr = jnp.where(act, vr // _U64(10), vr)
+        vp = jnp.where(act, vp // _U64(10), vp)
+        vm = jnp.where(act, vm // _U64(10), vm)
+        removed = removed + act.astype(_I32)
+
+    lrd = jnp.where(vr_tz & (lrd == 5) & (vr % _U64(2) == 0), _U64(4), lrd)
+    round_up = ((vr == vm) & (~accept_bounds | ~vm_tz)) | (lrd >= 5)
+    output = vr + round_up.astype(jnp.uint64)
+    return output, e10 + removed
+
+
+def _emit(output, exp10, negative, special_id, is_float):
+    """Scatter the decimal into a padded byte matrix per Java formatting
+    (to_chars, ftos_converter.cuh:797-893)."""
+    n = output.shape[0]
+    max_digits = 9 if is_float else 17
+    olength = _decimal_length_u64(output, max_digits)
+    exp = exp10 + olength - 1
+    sci = (exp < -3) | (exp >= 7)
+    s = negative.astype(_I32)
+
+    out = jnp.full((n, MAX_D2S_LEN), 0, jnp.uint8)
+    rows = jnp.arange(n, dtype=_I32)
+    OOB = _I32(MAX_D2S_LEN)  # dropped by mode="drop"
+
+    def put(pos, ch, mask):
+        p = jnp.where(mask, pos, OOB)
+        return lambda o: o.at[rows, p].set(ch, mode="drop")
+
+    writes = []
+    normal = special_id < 0
+
+    # sign
+    writes.append(put(jnp.zeros(n, _I32), jnp.uint8(ord("-")), normal & negative))
+
+    # digits (MSB-first digit k = (output // 10^(olength-1-k)) % 10)
+    plain_neg = normal & ~sci & (exp < 0)
+    plain_big = normal & ~sci & (exp >= 0) & (exp + 1 >= olength)
+    plain_mid = normal & ~sci & (exp >= 0) & (exp + 1 < olength)
+    sci_m = normal & sci
+    for k in range(max_digits):
+        have = olength > k
+        digit = (
+            (output // _POW10_U64[jnp.clip(olength - 1 - k, 0, 19)]) % _U64(10)
+        ).astype(jnp.uint8) + jnp.uint8(ord("0"))
+        kk = _I32(k)
+        writes.append(put(s + kk + (1 if k > 0 else 0), digit, sci_m & have))
+        writes.append(put(s + 2 + (-exp - 1) + kk, digit, plain_neg & have))
+        writes.append(put(s + kk, digit, plain_big & have))
+        writes.append(put(s + kk + (kk > exp).astype(_I32), digit, plain_mid & have))
+
+    dot = jnp.uint8(ord("."))
+    zero_c = jnp.uint8(ord("0"))
+    # scientific: '.', pad '0' when olength == 1, 'E', exp sign + digits
+    writes.append(put(s + 1, dot, sci_m))
+    writes.append(put(s + 2, zero_c, sci_m & (olength == 1)))
+    p_e = s + olength + 1 + (olength == 1).astype(_I32)
+    writes.append(put(p_e, jnp.uint8(ord("E")), sci_m))
+    neg_e = exp < 0
+    writes.append(put(p_e + 1, jnp.uint8(ord("-")), sci_m & neg_e))
+    eabs = jnp.abs(exp)
+    elen = 1 + (eabs >= 10).astype(_I32) + (eabs >= 100).astype(_I32)
+    pe0 = p_e + 1 + neg_e.astype(_I32)
+    # exponent digits MSB-first: digit j of the elen-digit number
+    for j in range(3):
+        have = elen > j
+        p10 = jnp.asarray(np.array([1, 10, 100], np.int32))
+        ed = ((eabs // p10[jnp.clip(elen - 1 - j, 0, 2)]) % 10).astype(
+            jnp.uint8
+        ) + zero_c
+        writes.append(put(pe0 + j, ed, sci_m & have))
+
+    # plain, exp < 0: "0." + (-exp-1) zeros + digits
+    writes.append(put(s + 0, zero_c, plain_neg))
+    writes.append(put(s + 1, dot, plain_neg))
+    for t in range(2):  # exp >= -3 -> at most 2 leading zeros
+        writes.append(put(s + 2 + t, zero_c, plain_neg & (-exp - 1 > t)))
+
+    # plain, exp+1 >= olength: digits + zeros + ".0"
+    for t in range(7):  # exp < 7 -> at most 7 trailing zeros
+        writes.append(
+            put(s + olength + t, zero_c, plain_big & (exp + 1 - olength > t))
+        )
+    writes.append(put(s + exp + 1, dot, plain_big))
+    writes.append(put(s + exp + 2, zero_c, plain_big))
+
+    # plain, dot between digits
+    writes.append(put(s + exp + 1, dot, plain_mid))
+
+    for w in writes:
+        out = w(out)
+
+    # lengths (d2s_size, ftos_converter.cuh:877-906)
+    len_sci = s + olength + 1 + (olength == 1).astype(_I32) + 1 + neg_e.astype(_I32) + elen
+    len_pn = s + 1 - exp + olength
+    len_pb = s + exp + 3
+    len_pm = s + olength + 1
+    lens = jnp.where(
+        sci, len_sci, jnp.where(exp < 0, len_pn, jnp.where(exp + 1 >= olength, len_pb, len_pm))
+    )
+
+    # specials: 0:"0.0" 1:"-0.0" 2:"Infinity" 3:"-Infinity" 4:"NaN"
+    specials = ["0.0", "-0.0", "Infinity", "-Infinity", "NaN"]
+    tab = np.zeros((5, MAX_D2S_LEN), np.uint8)
+    slen = np.zeros(5, np.int32)
+    for i, sp in enumerate(specials):
+        b = sp.encode()
+        tab[i, : len(b)] = np.frombuffer(b, np.uint8)
+        slen[i] = len(b)
+    sid = jnp.clip(special_id, 0, 4)
+    out = jnp.where(normal[:, None], out, jnp.asarray(tab)[sid])
+    lens = jnp.where(normal, lens, jnp.asarray(slen)[sid])
+    return out, lens
+
+
+def float_to_string(col: Column) -> StringColumn:
+    """Shortest round-trip decimal string of a FLOAT32/FLOAT64 column
+    (spark_rapids_jni::float_to_string)."""
+    if col.dtype.kind == Kind.FLOAT64:
+        bits = col.data.astype(jnp.int64).astype(jnp.uint64)
+        negative = (col.data.astype(jnp.int64) < 0)
+        mant = bits & _U64((1 << 52) - 1)
+        expo = (bits >> _U64(52)) & _U64(0x7FF)
+        is_nan = (expo == 0x7FF) & (mant != 0)
+        is_inf = (expo == 0x7FF) & (mant == 0)
+        is_zero = (expo == 0) & (mant == 0)
+        output, e10 = _d2d(bits)
+        is_float = False
+    elif col.dtype.kind == Kind.FLOAT32:
+        bits32 = f32_to_bits(col.data)
+        bits = bits32.astype(jnp.uint64) & _M32
+        negative = bits32 < 0
+        mant = bits & _U64((1 << 23) - 1)
+        expo = (bits >> _U64(23)) & _U64(0xFF)
+        is_nan = (expo == 0xFF) & (mant != 0)
+        is_inf = (expo == 0xFF) & (mant == 0)
+        is_zero = (expo == 0) & (mant == 0)
+        output, e10 = _f2d(bits)
+        is_float = True
+    else:
+        raise TypeError("float_to_string requires FLOAT32 or FLOAT64")
+
+    special_id = jnp.where(
+        is_nan,
+        _I32(4),
+        jnp.where(
+            is_inf,
+            jnp.where(negative, _I32(3), _I32(2)),
+            jnp.where(is_zero, jnp.where(negative, _I32(1), _I32(0)), _I32(-1)),
+        ),
+    )
+    padded, lens = _emit(output, e10, negative, special_id, is_float)
+    return strings_from_padded(padded, lens, col.validity)

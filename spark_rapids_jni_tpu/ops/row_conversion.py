@@ -155,8 +155,12 @@ def _batch_boundaries(row_sizes: np.ndarray, max_batch_bytes: int) -> List[int]:
         i = int(np.searchsorted(cum, base + max_batch_bytes, side="right"))
         if i >= n:
             end = n
+        elif i - last >= 32:
+            end = last + (i - last) // 32 * 32
         else:
-            end = last + max((i - last) // 32 * 32, 1)
+            # fewer than 32 rows fit: take all of them rather than degrade to
+            # 1-row batches (the reference would round down to 0 and hang)
+            end = max(i, last + 1)
         bounds.append(end)
         last = end
     return bounds
@@ -176,13 +180,7 @@ def convert_to_rows(
 
     if string_cols:
         str_lens = [c.lengths().astype(jnp.int64) for c in string_cols]
-        row_sizes_j = size_per_row + sum(str_lens)
-        row_sizes_j = (
-            (row_sizes_j + JCUDF_ROW_ALIGNMENT - 1)
-            // JCUDF_ROW_ALIGNMENT
-            * JCUDF_ROW_ALIGNMENT
-        )
-        row_sizes = np.asarray(row_sizes_j)
+        row_sizes = np.asarray(_round_up(size_per_row + sum(str_lens), JCUDF_ROW_ALIGNMENT))
     else:
         row_sizes = np.full((n,), fixed_row, dtype=np.int64)
 
@@ -209,9 +207,7 @@ def convert_to_rows(
 
     # ---- emit batches ----
     bounds = _batch_boundaries(row_sizes, max_batch_bytes)
-    padded_strs = [
-        (scol.padded(max(scol.max_len(), 1))) for scol in string_cols
-    ]
+    str_lens_np = [np.asarray(c.lengths()) for c in string_cols]
     out: List[ListColumn] = []
     cum_sizes = np.concatenate([[0], np.cumsum(row_sizes)])
     for b0, b1 in zip(bounds[:-1], bounds[1:]):
@@ -222,13 +218,21 @@ def convert_to_rows(
         # scatter the fixed sections
         pos = row_off[:, None] + jnp.arange(size_per_row, dtype=jnp.int64)[None, :]
         flat = flat.at[pos].set(fixed[b0:b1], mode="drop")
-        # scatter string chars (column order)
-        for (padded, lens), sstart in zip(padded_strs, str_starts):
-            lane = jnp.arange(padded.shape[1], dtype=jnp.int64)[None, :]
+        # scatter string chars (column order); pad per batch so one long
+        # string elsewhere in the table doesn't inflate this batch's tile
+        for scol, lens_np, sstart in zip(string_cols, str_lens_np, str_starts):
+            batch_max = max(int(lens_np[b0:b1].max()) if b1 > b0 else 0, 1)
+            sub = StringColumn(
+                scol.chars,
+                scol.offsets[b0 : b1 + 1],
+                None,
+            )
+            padded, lens = sub.padded(batch_max)
+            lane = jnp.arange(batch_max, dtype=jnp.int64)[None, :]
             cpos = row_off[:, None] + sstart[b0:b1, None] + lane
-            in_bounds = lane < lens[b0:b1, None].astype(jnp.int64)
+            in_bounds = lane < lens[:, None].astype(jnp.int64)
             cpos = jnp.where(in_bounds, cpos, jnp.int64(total))
-            flat = flat.at[cpos].set(padded[b0:b1], mode="drop")
+            flat = flat.at[cpos].set(padded, mode="drop")
         out.append(
             ListColumn(
                 jnp.asarray(offsets_np), Column(flat[:total], None, UINT8), None

@@ -1,0 +1,136 @@
+"""Tests for float_to_string, mirroring cast_float_to_string.cpp
+(FromFloats32 :32, FromFloats64 :53) plus fuzz against a Java-Double.toString
+oracle (python repr supplies the shortest round-trip digits — the same digits
+Ryu produces — reformatted with the Java layout rules)."""
+
+import math
+import re
+import struct
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import column, FLOAT32, FLOAT64
+from spark_rapids_jni_tpu.ops.float_to_string import float_to_string
+
+
+def java_double_to_string(v):
+    """Java Double.toString / Float.toString oracle."""
+    if math.isnan(v):
+        return "NaN"
+    if v == math.inf:
+        return "Infinity"
+    if v == -math.inf:
+        return "-Infinity"
+    if v == 0:
+        return "-0.0" if math.copysign(1, v) < 0 else "0.0"
+    s = repr(abs(v))
+    # normalize python repr to (digits, decimal exponent)
+    m = re.fullmatch(r"(\d+)\.(\d+)(?:e([+-]\d+))?", s)
+    if m:
+        int_part, frac, e = m.group(1), m.group(2), int(m.group(3) or 0)
+        digits = (int_part + frac).lstrip("0") or "0"
+        exp = e + len(int_part) - 1 - (len(int_part + frac) - len((int_part + frac).lstrip("0")))
+    else:
+        m = re.fullmatch(r"(\d+)(?:e([+-]\d+))?", s)
+        digits = m.group(1)
+        exp = int(m.group(2) or 0) + len(digits) - 1
+    digits = digits.rstrip("0") or "0"
+    sign = "-" if v < 0 else ""
+    if -3 <= exp < 7:
+        if exp >= len(digits) - 1:
+            out = digits + "0" * (exp + 1 - len(digits)) + ".0"
+        elif exp >= 0:
+            out = digits[: exp + 1] + "." + digits[exp + 1 :]
+        else:
+            out = "0." + "0" * (-exp - 1) + digits
+    else:
+        mant = digits[0] + "." + (digits[1:] or "0")
+        out = f"{mant}E{exp}"
+    return sign + out
+
+
+def test_from_floats32_gtest_vectors():
+    vals = [100.0, 654321.25, -12761.125, 0.0, 5.0, -4.0, float("nan"),
+            123456789012.34, -0.0]
+    got = float_to_string(column(vals, FLOAT32)).to_list()
+    assert got == ["100.0", "654321.25", "-12761.125", "0.0", "5.0", "-4.0",
+                   "NaN", "1.2345679E11", "-0.0"]
+
+
+def test_from_floats64_gtest_vectors():
+    vals = [100.0, 654321.25, -12761.125, 1.123456789123456789,
+            0.000000000000000000123456789123456789, 0.0, 5.0, -4.0,
+            float("nan"), 839542223232.794248339, -0.0]
+    got = float_to_string(column(vals, FLOAT64)).to_list()
+    assert got == ["100.0", "654321.25", "-12761.125", "1.1234567891234568",
+                   "1.234567891234568E-19", "0.0", "5.0", "-4.0", "NaN",
+                   "8.395422232327942E11", "-0.0"]
+
+
+def test_specials_and_boundaries():
+    vals = [float("inf"), float("-inf"), 1e7, 9999999.0, 1e-3, 9.0e-4,
+            5e-324, 1.7976931348623157e308, 2.2250738585072014e-308]
+    got = float_to_string(column(vals, FLOAT64)).to_list()
+    # note: C ryu (and thus the reference) prints Double.MIN_VALUE as
+    # "5.0E-324"; legacy Java FloatingDecimal would say "4.9E-324".
+    assert got == ["Infinity", "-Infinity", "1.0E7", "9999999.0", "0.001",
+                   "9.0E-4", "5.0E-324", "1.7976931348623157E308",
+                   "2.2250738585072014E-308"]
+
+
+def test_nulls_pass_through():
+    got = float_to_string(column([1.5, None], FLOAT64)).to_list()
+    assert got == ["1.5", None]
+
+
+def test_oracle_agreement_on_vectors():
+    vals = [100.0, 654321.25, -12761.125, 1e7, 1e-3, 9e-4, 0.001, 123.456]
+    got = float_to_string(column(vals, FLOAT64)).to_list()
+    assert got == [java_double_to_string(v) for v in vals]
+
+
+def test_fuzz_double_vs_oracle():
+    rng = np.random.RandomState(53)
+    bits = rng.randint(0, 2**64, size=2000, dtype=np.uint64)
+    vals = bits.view(np.float64)
+    vals = vals[np.isfinite(vals)]
+    got = float_to_string(column(vals.tolist(), FLOAT64)).to_list()
+    for v, g in zip(vals, got):
+        w = java_double_to_string(float(v))
+        assert g == w, (float(v).hex(), g, w)
+    # round-trip: every output parses back to the exact input
+    for v, g in zip(vals, got):
+        assert float(g.replace("E", "e")) == float(v)
+
+
+def test_fuzz_float_roundtrip():
+    rng = np.random.RandomState(59)
+    bits = rng.randint(0, 2**32, size=2000, dtype=np.uint32)
+    vals = bits.view(np.float32)
+    vals = vals[np.isfinite(vals)]
+    got = float_to_string(column(vals.tolist(), FLOAT32)).to_list()
+    for v, g in zip(vals, got):
+        # shortest repr must round-trip through float32 exactly
+        assert np.float32(g.replace("E", "e")) == v, (float(v).hex(), g)
+        # and must be the shortest: removing the last mantissa digit breaks it
+        m = re.fullmatch(r"(-?\d+)\.(\d+)(E-?\d+)?", g)
+        intp, frac, e = m.group(1), m.group(2), m.group(3) or ""
+        if len(frac) > 1:
+            shorter = f"{intp}.{frac[:-1]}{e}"
+            assert np.float32(shorter.replace("E", "e")) != v, (g, shorter)
+
+
+def test_subnormal_doubles():
+    vals = [5e-324, 1e-310, 2.2250738585072009e-308]
+    got = float_to_string(column(vals, FLOAT64)).to_list()
+    for v, g in zip(vals, got):
+        assert float(g.replace("E", "e")) == v
+        assert g == java_double_to_string(v)
+
+
+def test_rejects_non_float():
+    from spark_rapids_jni_tpu.columnar import INT32
+
+    with pytest.raises(TypeError):
+        float_to_string(column([1], INT32))
