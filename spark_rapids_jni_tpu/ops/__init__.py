@@ -33,7 +33,9 @@ from spark_rapids_jni_tpu.ops.decimal128 import (
     subtract128,
 )
 
+from spark_rapids_jni_tpu.ops.cast_decimal_to_string import decimal_to_string
 from spark_rapids_jni_tpu.ops.float_to_string import float_to_string
+from spark_rapids_jni_tpu.ops.format_float import format_float
 from spark_rapids_jni_tpu.ops.histogram import (
     create_histogram_if_valid,
     percentile_from_histogram,
@@ -66,7 +68,9 @@ __all__ = [
     "bloom_filter_serialize",
     "create_histogram_if_valid",
     "percentile_from_histogram",
+    "decimal_to_string",
     "float_to_string",
+    "format_float",
     "string_to_float",
     "TimeZoneDB",
     "convert_from_rows",

@@ -137,28 +137,15 @@ def _scan(col: StringColumn):
     # significant digits before the dot
     decimal_pos = jnp.sum(sig_mask & pre_dot, axis=1).astype(jnp.int32)
 
-    # rank of each significant digit (0-based within the kept sequence)
+    # rank of each significant digit (0-based within the kept sequence);
+    # value of the first min(n_sig, 19) digits as u64
     rank = jnp.cumsum(sig_mask.astype(jnp.int32), axis=1) - 1
-    take20 = sig_mask & (rank < 20)
-    # value of first min(n_sig, 20) digits as u64 (20 digits can express the
-    # +1-digit rule's candidate; overflow beyond is masked before use)
-    k_eff = jnp.minimum(n_sig, 20)
-    weight_pow = jnp.where(take20, (k_eff[:, None] - 1 - rank), 0)
-    pow10 = jnp.asarray(
-        np.array([10**k for k in range(20)], dtype=np.uint64)
-    )
-    w = pow10[jnp.clip(weight_pow, 0, 19)]
+    pow10 = jnp.asarray(np.array([10**k for k in range(20)], dtype=np.uint64))
     digit_vals = (c - jnp.uint8(48)).astype(jnp.uint64)
-    val20 = jnp.sum(jnp.where(take20, digit_vals * w, jnp.uint64(0)), axis=1)
-    # value of first min(n_sig, 19) digits
     k19 = jnp.minimum(n_sig, 19)
     take19 = sig_mask & (rank < 19)
     w19 = pow10[jnp.clip(jnp.where(take19, (k19[:, None] - 1 - rank), 0), 0, 19)]
     val19 = jnp.sum(jnp.where(take19, digit_vals * w19, jnp.uint64(0)), axis=1)
-    # the 20th digit itself
-    d20 = jnp.sum(
-        jnp.where(sig_mask & (rank == 19), digit_vals, jnp.uint64(0)), axis=1
-    )
 
     # ---- manual exponent at `stop` ----
     ce = char_at(stop)
@@ -196,7 +183,7 @@ def _scan(col: StringColumn):
         is_nan=is_nan, inf3=inf3, inf_exact=inf_exact,
         n_lead_zeros=n_lead_zeros, n_sig=n_sig, n_digit_chars=n_digit_chars,
         decimal_pos=decimal_pos, dot_in_run=dot_in_run,
-        val19=val19, val20=val20, d20=d20,
+        val19=val19,
         has_exp=has_exp, exp_neg=exp_neg, exp_val=exp_val,
         exp_digits=exp_digits,
         has_suffix=has_suffix, tail_nonws=tail_nonws, tail0_nonws=tail0_nonws,
@@ -237,20 +224,16 @@ def _assemble(f, out_dtype_np):
     valid[no_digits] = False
     except_[no_digits] = True
 
-    # 19/20-digit accumulation with the reference's truncation accounting
+    # 19-digit accumulation with the reference's truncation accounting.
+    # The reference's "maybe add a 20th digit" rule (cast_string_to_float.cu
+    # :428-441) is unsatisfiable for normalized significant digits: 19 of
+    # them make digits >= 10^18, so digits*10 + d > max_holding always.
+    # Both truncation sub-branches add num_chars - safe_count, i.e. n_sig-19.
     n_sig = f["n_sig"].astype(np.int64)
     digits = f["val19"].copy()
     real_digits = np.minimum(n_sig, 19)
-    truncated = np.zeros((n,), np.int64)
     over = n_sig > 19
-    # single-batch equivalence: num_chars = n_sig, safe_count = 19
-    can_add = over & (f["val19"] <= MAX_HOLDING) & (
-        f["val19"] * 10 + f["d20"] <= MAX_HOLDING
-    )
-    digits = np.where(can_add, f["val20"], digits)
-    truncated = np.where(
-        over & can_add, n_sig - 18, np.where(over, n_sig - 19, 0)
-    )
+    truncated = np.where(over, n_sig - 19, 0)
 
     total_digits = real_digits + truncated
     exp_base = truncated - np.where(
@@ -325,7 +308,9 @@ def string_to_float(
     except_ &= in_valid
     if ansi_mode and except_.any():
         row = int(np.nonzero(except_)[0][0])
-        raise CastException(col.to_list()[row], row)
+        offs = np.asarray(col.offsets)
+        bad = bytes(np.asarray(col.chars[offs[row] : offs[row + 1]]))
+        raise CastException(bad.decode("utf-8", errors="replace"), row)
 
     validity_np = valid & in_valid
     validity = None if validity_np.all() else jnp.asarray(validity_np)

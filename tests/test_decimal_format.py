@@ -1,0 +1,117 @@
+"""Tests for format_float + decimal_to_string.
+
+format_float mirrors the reference gtests (format_float.cpp FormatFloats32
+:30, FormatFloats64 :58).  decimal_to_string mirrors
+cast_decimal_to_string.cpp and fuzzes against python's Decimal __str__, which
+implements the same General Decimal Arithmetic to-string algorithm as Java
+BigDecimal.toString (plain when scale <= 0 and adjusted exponent >= -6)."""
+
+import decimal
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import column, FLOAT32, FLOAT64
+from spark_rapids_jni_tpu.columnar.column import decimal128_column
+from spark_rapids_jni_tpu.columnar.dtypes import decimal as decimal_dtype
+from spark_rapids_jni_tpu.ops.cast_decimal_to_string import decimal_to_string
+from spark_rapids_jni_tpu.ops.format_float import format_float
+
+
+def test_format_floats32_gtest_vectors():
+    vals = [100.0, 654321.25, -12761.125, 0.0, 5.0, -4.0, float("nan"),
+            123456789012.34, -0.0]
+    got = format_float(column(vals, FLOAT32), 5).to_list()
+    assert got == ["100.00000", "654,321.25000", "-12,761.12500", "0.00000",
+                   "5.00000", "-4.00000", "�", "123,456,790,000.00000",
+                   "-0.00000"]
+
+
+def test_format_floats64_gtest_vectors():
+    vals = [100.0, 654321.25, -12761.125, 1.123456789123456789,
+            0.000000000000000000123456789123456789, 0.0, 5.0, -4.0,
+            float("nan"), 839542223232.794248339, 3232.794248339,
+            11234000000.0, -0.0]
+    got = format_float(column(vals, FLOAT64), 5).to_list()
+    assert got == ["100.00000", "654,321.25000", "-12,761.12500", "1.12346",
+                   "0.00000", "0.00000", "5.00000", "-4.00000", "�",
+                   "839,542,223,232.79420", "3,232.79425",
+                   "11,234,000,000.00000", "-0.00000"]
+
+
+def test_format_float_specials_and_rounding():
+    got = format_float(column([float("inf"), float("-inf")], FLOAT64), 2).to_list()
+    assert got == ["∞", "-∞"]
+    # digits=0: values < 1 print the bare '0' before rounding (cuh:1284)
+    got0 = format_float(column([0.9999, 123.456, 999.5], FLOAT64), 0).to_list()
+    assert got0 == ["0", "123", "1,000"]
+    # half-even on the shortest digits
+    got2 = format_float(column([0.99999, 0.005, 0.015], FLOAT64), 2).to_list()
+    assert got2 == ["1.00", "0.00", "0.02"]
+
+
+def test_format_float_nulls_and_validation():
+    assert format_float(column([1.5, None], FLOAT64), 1).to_list() == ["1.5", None]
+    from spark_rapids_jni_tpu.columnar import INT32
+
+    with pytest.raises(TypeError):
+        format_float(column([1], INT32), 2)
+    with pytest.raises(ValueError):
+        format_float(column([1.0], FLOAT64), -1)
+
+
+def _dec_col(unscaled, precision, scale):
+    dt = decimal_dtype(precision, scale)
+    if precision > 18:
+        return decimal128_column(unscaled, precision, scale)
+    return column(unscaled, dt)
+
+
+_CTX = decimal.Context(prec=60)  # wide enough that scaleb never rounds
+
+
+def _oracle(unscaled, scale):
+    return [
+        None if u is None else str(decimal.Decimal(u).scaleb(-scale, _CTX))
+        for u in unscaled
+    ]
+
+
+def test_decimal_simple_gtest():
+    got = decimal_to_string(_dec_col(list(range(11)), 9, 0)).to_list()
+    assert got == ["0", "1", "2", "3", "4", "5", "6", "7", "8", "9", "10"]
+
+
+def test_decimal_scientific_edge_gtest():
+    # cast_decimal_to_string.cpp ScientificEdge :55-85
+    assert decimal_to_string(_dec_col([0, 100000000], 18, 6)).to_list() == [
+        "0.000000", "100.000000"]
+    assert decimal_to_string(_dec_col([0, 100000000], 18, 7)).to_list() == [
+        "0E-7", "10.0000000"]
+    assert decimal_to_string(_dec_col([0, 1000000000], 18, 8)).to_list() == [
+        "0E-8", "10.00000000"]
+
+
+def test_decimal_negative_scale_scientific():
+    # spark negative scale (cudf positive) is always scientific
+    got = decimal_to_string(_dec_col([21, -30, 5], 9, -1)).to_list()
+    assert got == _oracle([21, -30, 5], -1) == ["2.1E+2", "-3.0E+2", "5E+1"]
+
+
+def test_decimal128_values():
+    vals = [12345678901234567890123456789012345678, -1, 0, None,
+            -(10**37), 10**30 + 7]
+    got = decimal_to_string(_dec_col(vals, 38, 10)).to_list()
+    assert got == _oracle(vals, 10)
+
+
+@pytest.mark.parametrize("precision,scale", [(9, 0), (9, 4), (18, 2), (38, 0),
+                                             (38, 6), (38, 37), (38, -2)])
+def test_decimal_fuzz_vs_python_decimal(precision, scale):
+    rng = np.random.RandomState(61)
+    hi = 10**precision - 1
+    vals = [int(v) for v in rng.randint(-10**9, 10**9, size=40)]
+    vals += [0, 1, -1, hi, -hi, hi // 7]
+    vals = [v if abs(v) <= hi else v % hi for v in vals]
+    got = decimal_to_string(_dec_col(vals, precision, scale)).to_list()
+    assert got == _oracle(vals, scale)
