@@ -1,0 +1,69 @@
+"""Memory governance: the multi-tenant task arbiter (SURVEY.md §2.2 analog).
+
+See mem.governor for the facade and the batch-admission resource, mem.arbiter
+for the native bindings, native/task_arbiter.cpp for the state machine core.
+"""
+
+from spark_rapids_jni_tpu.mem.arbiter import (
+    Arbiter,
+    OOM_ALL,
+    OOM_CPU,
+    OOM_GPU,
+    STATE_ALLOC,
+    STATE_ALLOC_FREE,
+    STATE_BLOCKED,
+    STATE_BUFN,
+    STATE_BUFN_THROW,
+    STATE_BUFN_WAIT,
+    STATE_REMOVE_THROW,
+    STATE_RUNNING,
+    STATE_SPLIT_THROW,
+    STATE_UNKNOWN,
+    current_thread_id,
+)
+from spark_rapids_jni_tpu.mem.exceptions import (
+    CpuRetryOOM,
+    CpuSplitAndRetryOOM,
+    GpuOOM,
+    GpuRetryOOM,
+    GpuSplitAndRetryOOM,
+    InjectedException,
+    RetryOOM,
+    SplitAndRetryOOM,
+    ThreadRemovedError,
+)
+from spark_rapids_jni_tpu.mem.governor import (
+    BudgetedResource,
+    MemoryGovernor,
+    OutOfBudget,
+)
+
+__all__ = [
+    "Arbiter",
+    "BudgetedResource",
+    "CpuRetryOOM",
+    "CpuSplitAndRetryOOM",
+    "GpuOOM",
+    "GpuRetryOOM",
+    "GpuSplitAndRetryOOM",
+    "InjectedException",
+    "MemoryGovernor",
+    "OOM_ALL",
+    "OOM_CPU",
+    "OOM_GPU",
+    "OutOfBudget",
+    "RetryOOM",
+    "SplitAndRetryOOM",
+    "STATE_ALLOC",
+    "STATE_ALLOC_FREE",
+    "STATE_BLOCKED",
+    "STATE_BUFN",
+    "STATE_BUFN_THROW",
+    "STATE_BUFN_WAIT",
+    "STATE_REMOVE_THROW",
+    "STATE_RUNNING",
+    "STATE_SPLIT_THROW",
+    "STATE_UNKNOWN",
+    "ThreadRemovedError",
+    "current_thread_id",
+]

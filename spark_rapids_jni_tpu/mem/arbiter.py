@@ -1,0 +1,241 @@
+"""ctypes bindings + lifecycle for the native task arbiter.
+
+The native core (native/task_arbiter.cpp) is the re-expression of the
+reference's SparkResourceAdaptorJni state machine; this module is the analog
+of the JNI shim: load the library (building it from source on first use if
+needed), map return codes onto the exception hierarchy, and pin the
+thread-id convention (python ``threading.get_ident()``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+from spark_rapids_jni_tpu.mem import exceptions as exc
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+_SRC = os.path.join(_NATIVE_DIR, "task_arbiter.cpp")
+_LIB = os.path.join(_NATIVE_DIR, "libtask_arbiter.so")
+
+# return codes (task_arbiter.cpp arbiter_code)
+OK = 0
+RECURSIVE = 1
+_CODE_TO_EXC = {
+    -1: exc.GpuRetryOOM,
+    -2: exc.GpuSplitAndRetryOOM,
+    -3: exc.CpuRetryOOM,
+    -4: exc.CpuSplitAndRetryOOM,
+    -5: exc.InjectedException,
+    -6: exc.GpuOOM,
+    -7: exc.ThreadRemovedError,
+    -8: ValueError,
+    -9: RuntimeError,
+}
+
+# thread_state values (task_arbiter.cpp / RmmSparkThreadState.java)
+STATE_UNKNOWN = -1
+STATE_RUNNING = 0
+STATE_ALLOC = 1
+STATE_ALLOC_FREE = 2
+STATE_BLOCKED = 3
+STATE_BUFN_THROW = 4
+STATE_BUFN_WAIT = 5
+STATE_BUFN = 6
+STATE_SPLIT_THROW = 7
+STATE_REMOVE_THROW = 8
+
+# oom filter bits (OomInjectionType): CPU=1, GPU=2, ALL=3
+OOM_CPU = 1
+OOM_GPU = 2
+OOM_ALL = 3
+
+# metric selectors
+METRIC_RETRY_COUNT = 0
+METRIC_SPLIT_RETRY_COUNT = 1
+METRIC_BLOCKED_NS = 2
+METRIC_LOST_NS = 3
+
+_build_lock = threading.Lock()
+_lib = None
+
+
+def _ensure_lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            subprocess.run(
+                ["g++", "-std=c++17", "-O2", "-shared", "-fPIC", "-o", _LIB, _SRC,
+                 "-lpthread"],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(_LIB)
+        lib.arbiter_create.restype = ctypes.c_void_p
+        lib.arbiter_create.argtypes = [ctypes.c_char_p]
+        lib.arbiter_destroy.argtypes = [ctypes.c_void_p]
+        lib.arbiter_last_error.restype = ctypes.c_char_p
+        i64 = ctypes.c_int64
+        for name, args, res in [
+            ("arbiter_start_dedicated_task_thread", [ctypes.c_void_p, i64, i64], ctypes.c_int),
+            ("arbiter_pool_thread_working_on_task", [ctypes.c_void_p, i64, i64, ctypes.c_int], ctypes.c_int),
+            ("arbiter_pool_thread_finished_for_task", [ctypes.c_void_p, i64, i64], ctypes.c_int),
+            ("arbiter_remove_thread_association", [ctypes.c_void_p, i64, i64], ctypes.c_int),
+            ("arbiter_task_done", [ctypes.c_void_p, i64], ctypes.c_int),
+            ("arbiter_set_pool_blocked", [ctypes.c_void_p, i64, ctypes.c_int], ctypes.c_int),
+            ("arbiter_set_externally_blocked", [ctypes.c_void_p, i64, ctypes.c_int], ctypes.c_int),
+            ("arbiter_start_retry_block", [ctypes.c_void_p, i64], ctypes.c_int),
+            ("arbiter_end_retry_block", [ctypes.c_void_p, i64], ctypes.c_int),
+            ("arbiter_force_retry_oom", [ctypes.c_void_p, i64, ctypes.c_int, ctypes.c_int, ctypes.c_int], ctypes.c_int),
+            ("arbiter_force_split_and_retry_oom", [ctypes.c_void_p, i64, ctypes.c_int, ctypes.c_int, ctypes.c_int], ctypes.c_int),
+            ("arbiter_force_cudf_exception", [ctypes.c_void_p, i64, ctypes.c_int], ctypes.c_int),
+            ("arbiter_pre_alloc", [ctypes.c_void_p, i64, ctypes.c_int, ctypes.c_int], ctypes.c_int),
+            ("arbiter_post_alloc_success", [ctypes.c_void_p, i64, ctypes.c_int, ctypes.c_int], ctypes.c_int),
+            ("arbiter_post_alloc_failed", [ctypes.c_void_p, i64, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int], ctypes.c_int),
+            ("arbiter_dealloc", [ctypes.c_void_p, i64, ctypes.c_int], ctypes.c_int),
+            ("arbiter_block_thread_until_ready", [ctypes.c_void_p, i64], ctypes.c_int),
+            ("arbiter_check_and_break_deadlocks", [ctypes.c_void_p], ctypes.c_int),
+            ("arbiter_get_state_of", [ctypes.c_void_p, i64], ctypes.c_int),
+            ("arbiter_get_and_reset_metric", [ctypes.c_void_p, i64, ctypes.c_int], i64),
+            ("arbiter_get_total_blocked_or_bufn", [ctypes.c_void_p], i64),
+        ]:
+            fn = getattr(lib, name)
+            fn.argtypes = args
+            fn.restype = res
+        _lib = lib
+        return _lib
+
+
+def current_thread_id() -> int:
+    return threading.get_ident()
+
+
+class Arbiter:
+    """Handle to one native arbiter instance."""
+
+    def __init__(self, log_path: str | None = None):
+        self._lib = _ensure_lib()
+        self._h = self._lib.arbiter_create(
+            log_path.encode() if log_path else None
+        )
+        if not self._h:
+            raise RuntimeError("failed to create native arbiter")
+
+    def close(self):
+        if self._h:
+            self._lib.arbiter_destroy(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+    def _check(self, code: int) -> int:
+        if code >= 0:
+            return code
+        err = self._lib.arbiter_last_error().decode()
+        raise _CODE_TO_EXC.get(code, RuntimeError)(err)
+
+    # registration ----------------------------------------------------------
+    def start_dedicated_task_thread(self, thread_id, task_id):
+        self._check(self._lib.arbiter_start_dedicated_task_thread(self._h, thread_id, task_id))
+
+    def pool_thread_working_on_task(self, thread_id, task_id, is_shuffle=False):
+        self._check(
+            self._lib.arbiter_pool_thread_working_on_task(self._h, thread_id, task_id, is_shuffle)
+        )
+
+    def pool_thread_finished_for_task(self, thread_id, task_id):
+        self._check(self._lib.arbiter_pool_thread_finished_for_task(self._h, thread_id, task_id))
+
+    def remove_thread_association(self, thread_id, task_id=-1):
+        self._check(self._lib.arbiter_remove_thread_association(self._h, thread_id, task_id))
+
+    def task_done(self, task_id):
+        self._check(self._lib.arbiter_task_done(self._h, task_id))
+
+    def set_pool_blocked(self, thread_id, blocked):
+        self._check(self._lib.arbiter_set_pool_blocked(self._h, thread_id, blocked))
+
+    def set_externally_blocked(self, thread_id, blocked):
+        self._check(self._lib.arbiter_set_externally_blocked(self._h, thread_id, blocked))
+
+    # retry / injection -----------------------------------------------------
+    def start_retry_block(self, thread_id):
+        self._check(self._lib.arbiter_start_retry_block(self._h, thread_id))
+
+    def end_retry_block(self, thread_id):
+        self._check(self._lib.arbiter_end_retry_block(self._h, thread_id))
+
+    def force_retry_oom(self, thread_id, num_ooms, oom_filter=OOM_GPU, skip_count=0):
+        self._check(
+            self._lib.arbiter_force_retry_oom(self._h, thread_id, num_ooms, oom_filter, skip_count)
+        )
+
+    def force_split_and_retry_oom(self, thread_id, num_ooms, oom_filter=OOM_GPU, skip_count=0):
+        self._check(
+            self._lib.arbiter_force_split_and_retry_oom(
+                self._h, thread_id, num_ooms, oom_filter, skip_count
+            )
+        )
+
+    def force_injected_exception(self, thread_id, num_times):
+        self._check(self._lib.arbiter_force_cudf_exception(self._h, thread_id, num_times))
+
+    # alloc protocol --------------------------------------------------------
+    def pre_alloc(self, thread_id, is_cpu=False, blocking=True) -> bool:
+        """True if this is a recursive (spill) allocation."""
+        return self._check(self._lib.arbiter_pre_alloc(self._h, thread_id, is_cpu, blocking)) == RECURSIVE
+
+    def post_alloc_success(self, thread_id, is_cpu=False, was_recursive=False):
+        self._check(
+            self._lib.arbiter_post_alloc_success(self._h, thread_id, is_cpu, was_recursive)
+        )
+
+    def post_alloc_failed(self, thread_id, is_cpu=False, is_oom=True, blocking=True,
+                          was_recursive=False) -> bool:
+        """True if the allocation should be retried."""
+        return (
+            self._check(
+                self._lib.arbiter_post_alloc_failed(
+                    self._h, thread_id, is_cpu, is_oom, blocking, was_recursive
+                )
+            )
+            == 1
+        )
+
+    def dealloc(self, thread_id, is_cpu=False):
+        self._check(self._lib.arbiter_dealloc(self._h, thread_id, is_cpu))
+
+    def block_thread_until_ready(self, thread_id):
+        self._check(self._lib.arbiter_block_thread_until_ready(self._h, thread_id))
+
+    def check_and_break_deadlocks(self):
+        self._check(self._lib.arbiter_check_and_break_deadlocks(self._h))
+
+    # introspection ---------------------------------------------------------
+    def state_of(self, thread_id) -> int:
+        return self._lib.arbiter_get_state_of(self._h, thread_id)
+
+    def get_and_reset_num_retry(self, task_id) -> int:
+        return self._lib.arbiter_get_and_reset_metric(self._h, task_id, METRIC_RETRY_COUNT)
+
+    def get_and_reset_num_split_retry(self, task_id) -> int:
+        return self._lib.arbiter_get_and_reset_metric(self._h, task_id, METRIC_SPLIT_RETRY_COUNT)
+
+    def get_and_reset_blocked_time_ns(self, task_id) -> int:
+        return self._lib.arbiter_get_and_reset_metric(self._h, task_id, METRIC_BLOCKED_NS)
+
+    def get_and_reset_compute_time_lost_ns(self, task_id) -> int:
+        return self._lib.arbiter_get_and_reset_metric(self._h, task_id, METRIC_LOST_NS)
+
+    def total_blocked_or_bufn(self) -> int:
+        return self._lib.arbiter_get_total_blocked_or_bufn(self._h)

@@ -1,0 +1,206 @@
+"""Memory governor: the RmmSpark facade + HBM batch-admission resource.
+
+Two layers, mirroring the reference split:
+
+- :class:`MemoryGovernor` — the analog of RmmSpark.java's static facade +
+  SparkResourceAdaptor.java's watchdog: thread/task registration, retry-block
+  bracketing, OOM injection, per-task metrics, and a daemon polling
+  ``checkAndBreakDeadlocks`` every 100ms (SparkResourceAdaptor.java:35-79).
+
+- :class:`BudgetedResource` — where the reference interposes on RMM
+  ``do_allocate`` (SparkResourceAdaptorJni.cpp:1731-1752), a TPU framework
+  cannot intercept XLA's allocator.  Governance instead happens at *batch
+  admission*: a task reserves its working-set bytes from a budget before
+  launching device work and releases them after.  The reserve/release calls
+  drive the exact same native state machine (pre_alloc -> try -> post_alloc
+  -> retry loop), so blocking, BUFN escalation, split-and-retry and deadlock
+  breaking behave identically to the reference.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from spark_rapids_jni_tpu.mem.arbiter import (
+    Arbiter,
+    OOM_ALL,
+    OOM_CPU,
+    OOM_GPU,
+    current_thread_id,
+)
+
+
+class MemoryGovernor:
+    """Singleton-style facade over one native arbiter + watchdog daemon."""
+
+    _instance: Optional["MemoryGovernor"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, log_path: str | None = None, watchdog_period_s: float = 0.1):
+        self.arbiter = Arbiter(log_path)
+        self._shutdown = threading.Event()
+        self._watchdog = threading.Thread(
+            target=self._watch, args=(watchdog_period_s,), daemon=True,
+            name="memory-governor-watchdog",
+        )
+        self._watchdog.start()
+
+    # -- lifecycle ----------------------------------------------------------
+    @classmethod
+    def initialize(cls, log_path: str | None = None) -> "MemoryGovernor":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls(log_path)
+            return cls._instance
+
+    @classmethod
+    def instance(cls) -> "MemoryGovernor":
+        return cls.initialize()
+
+    @classmethod
+    def shutdown(cls):
+        with cls._lock:
+            if cls._instance is not None:
+                cls._instance._shutdown.set()
+                cls._instance._watchdog.join(timeout=2)
+                cls._instance.arbiter.close()
+                cls._instance = None
+
+    def _watch(self, period_s: float):
+        # SparkResourceAdaptor.java:59-79 watchdog loop
+        while not self._shutdown.wait(period_s):
+            try:
+                self.arbiter.check_and_break_deadlocks()
+            except Exception:  # pragma: no cover - defensive, mirrors daemon
+                pass
+
+    # -- thread/task association (RmmSpark.java:131-238) --------------------
+    def current_thread_is_dedicated_to_task(self, task_id: int):
+        self.arbiter.start_dedicated_task_thread(current_thread_id(), task_id)
+
+    def shuffle_thread_working_on_tasks(self, task_ids):
+        tid = current_thread_id()
+        for task_id in task_ids:
+            self.arbiter.pool_thread_working_on_task(tid, task_id, is_shuffle=True)
+
+    def pool_thread_working_on_task(self, task_id: int):
+        self.arbiter.pool_thread_working_on_task(current_thread_id(), task_id)
+
+    def pool_thread_finished_for_task(self, task_id: int):
+        self.arbiter.pool_thread_finished_for_task(current_thread_id(), task_id)
+
+    def remove_current_dedicated_thread_association(self, task_id: int = -1):
+        self.arbiter.remove_thread_association(current_thread_id(), task_id)
+
+    def task_done(self, task_id: int):
+        self.arbiter.task_done(task_id)
+
+    # -- retry blocks (RmmSpark.java:242-431) -------------------------------
+    def start_retry_block(self):
+        self.arbiter.start_retry_block(current_thread_id())
+
+    def end_retry_block(self):
+        self.arbiter.end_retry_block(current_thread_id())
+
+    def block_thread_until_ready(self):
+        self.arbiter.block_thread_until_ready(current_thread_id())
+
+    # -- injection (RmmSpark.java:435-515) ----------------------------------
+    def force_retry_oom(self, thread_id=None, num_ooms=1, oom_filter=OOM_GPU, skip_count=0):
+        self.arbiter.force_retry_oom(
+            thread_id if thread_id is not None else current_thread_id(),
+            num_ooms, oom_filter, skip_count,
+        )
+
+    def force_split_and_retry_oom(self, thread_id=None, num_ooms=1, oom_filter=OOM_GPU,
+                                  skip_count=0):
+        self.arbiter.force_split_and_retry_oom(
+            thread_id if thread_id is not None else current_thread_id(),
+            num_ooms, oom_filter, skip_count,
+        )
+
+    def force_injected_exception(self, thread_id=None, num_times=1):
+        self.arbiter.force_injected_exception(
+            thread_id if thread_id is not None else current_thread_id(), num_times
+        )
+
+    # -- metrics (RmmSpark.java:533-590) ------------------------------------
+    def get_and_reset_num_retry(self, task_id):
+        return self.arbiter.get_and_reset_num_retry(task_id)
+
+    def get_and_reset_num_split_retry(self, task_id):
+        return self.arbiter.get_and_reset_num_split_retry(task_id)
+
+    def get_and_reset_block_time_ns(self, task_id):
+        return self.arbiter.get_and_reset_blocked_time_ns(task_id)
+
+    def get_and_reset_compute_time_lost_ns(self, task_id):
+        return self.arbiter.get_and_reset_compute_time_lost_ns(task_id)
+
+    def state_of_current_thread(self):
+        return self.arbiter.state_of(current_thread_id())
+
+
+class OutOfBudget(MemoryError):
+    """Raised by a budget when a reservation cannot be satisfied."""
+
+
+class BudgetedResource:
+    """An HBM/host-memory budget driven through the arbiter's retry protocol.
+
+    ``acquire(nbytes)`` is the analog of the reference's ``do_allocate`` loop
+    (SparkResourceAdaptorJni.cpp:1731-1752): pre_alloc (injection + blocking),
+    try the reservation, post_alloc_success on success; on OutOfBudget,
+    post_alloc_failed (-> BLOCKED + BUFN escalation) and loop.  ``release``
+    frees budget and wakes the highest-priority blocked thread, exactly like
+    ``do_deallocate`` -> dealloc_core.
+    """
+
+    def __init__(self, governor: MemoryGovernor, limit_bytes: int, is_cpu: bool = False):
+        self.gov = governor
+        self.limit = limit_bytes
+        self.used = 0
+        self.is_cpu = is_cpu
+        self._lock = threading.Lock()
+
+    def _try_reserve(self, nbytes: int) -> bool:
+        with self._lock:
+            if self.used + nbytes > self.limit:
+                return False
+            self.used += nbytes
+            return True
+
+    def acquire(self, nbytes: int) -> int:
+        """Reserve ``nbytes``; blocks/raises RetryOOM per the state machine."""
+        arb = self.gov.arbiter
+        tid = current_thread_id()
+        while True:
+            likely_spill = arb.pre_alloc(tid, is_cpu=self.is_cpu, blocking=True)
+            try:
+                if self._try_reserve(nbytes):
+                    arb.post_alloc_success(tid, is_cpu=self.is_cpu, was_recursive=likely_spill)
+                    return nbytes
+                raise OutOfBudget(f"out of budget: {nbytes} requested, "
+                                  f"{self.limit - self.used} available")
+            except OutOfBudget:
+                if not arb.post_alloc_failed(
+                    tid, is_cpu=self.is_cpu, is_oom=True, blocking=True,
+                    was_recursive=likely_spill,
+                ):
+                    raise
+
+    def release(self, nbytes: int):
+        with self._lock:
+            self.used -= nbytes
+        self.gov.arbiter.dealloc(current_thread_id(), is_cpu=self.is_cpu)
+
+
+__all__ = [
+    "BudgetedResource",
+    "MemoryGovernor",
+    "OutOfBudget",
+    "OOM_ALL",
+    "OOM_CPU",
+    "OOM_GPU",
+]

@@ -95,8 +95,13 @@ def decimal_to_string(col) -> StringColumn:
     else:
         raise TypeError("decimal_to_string requires a decimal column")
 
-    h19, l19 = _split_1919(ahi, alo)
-    nd = _digits_1919(h19, l19)
+    # digit count via u128 >= 10^k comparisons (no divider needed)
+    p10_hi = jnp.asarray(_P10_HI)
+    p10_lo = jnp.asarray(_P10_LO)
+    nd = jnp.ones(alo.shape, _I32)
+    for k in range(1, 39):
+        ge = (ahi > p10_hi[k]) | ((ahi == p10_hi[k]) & (alo >= p10_lo[k]))
+        nd = nd + ge.astype(_I32)
     adj = _I32(-ss) + nd - 1  # adjusted exponent (cu:72)
     plain = (ss >= 0) & (adj >= -6)
     K = jnp.where(plain, _I32(ss), nd - 1)  # fraction width

@@ -138,7 +138,9 @@ def _scan(col: StringColumn):
     decimal_pos = jnp.sum(sig_mask & pre_dot, axis=1).astype(jnp.int32)
 
     # rank of each significant digit (0-based within the kept sequence);
-    # value of the first min(n_sig, 19) digits as u64
+    # value of the first min(n_sig, 19) digits as u64, plus the 20th digit
+    # (post-dot zeros count as significant chars but keep the value small, so
+    # the reference's +1-digit rule is reachable for 0.00...ddd inputs)
     rank = jnp.cumsum(sig_mask.astype(jnp.int32), axis=1) - 1
     pow10 = jnp.asarray(np.array([10**k for k in range(20)], dtype=np.uint64))
     digit_vals = (c - jnp.uint8(48)).astype(jnp.uint64)
@@ -146,6 +148,9 @@ def _scan(col: StringColumn):
     take19 = sig_mask & (rank < 19)
     w19 = pow10[jnp.clip(jnp.where(take19, (k19[:, None] - 1 - rank), 0), 0, 19)]
     val19 = jnp.sum(jnp.where(take19, digit_vals * w19, jnp.uint64(0)), axis=1)
+    d20 = jnp.sum(
+        jnp.where(sig_mask & (rank == 19), digit_vals, jnp.uint64(0)), axis=1
+    )
 
     # ---- manual exponent at `stop` ----
     ce = char_at(stop)
@@ -183,7 +188,7 @@ def _scan(col: StringColumn):
         is_nan=is_nan, inf3=inf3, inf_exact=inf_exact,
         n_lead_zeros=n_lead_zeros, n_sig=n_sig, n_digit_chars=n_digit_chars,
         decimal_pos=decimal_pos, dot_in_run=dot_in_run,
-        val19=val19,
+        val19=val19, d20=d20,
         has_exp=has_exp, exp_neg=exp_neg, exp_val=exp_val,
         exp_digits=exp_digits,
         has_suffix=has_suffix, tail_nonws=tail_nonws, tail0_nonws=tail0_nonws,
@@ -224,16 +229,24 @@ def _assemble(f, out_dtype_np):
     valid[no_digits] = False
     except_[no_digits] = True
 
-    # 19-digit accumulation with the reference's truncation accounting.
-    # The reference's "maybe add a 20th digit" rule (cast_string_to_float.cu
-    # :428-441) is unsatisfiable for normalized significant digits: 19 of
-    # them make digits >= 10^18, so digits*10 + d > max_holding always.
-    # Both truncation sub-branches add num_chars - safe_count, i.e. n_sig-19.
+    # 19-significant-char accumulation with the reference's truncation
+    # accounting (cast_string_to_float.cu:395-445).  The "+1 digit" rule only
+    # fires when post-dot zeros pad the window (value stays <= max_holding/10,
+    # e.g. "0.0123...": zeros count as chars but not value); for a normalized
+    # 19-digit value digits*10 always overflows max_holding.
     n_sig = f["n_sig"].astype(np.int64)
     digits = f["val19"].copy()
     real_digits = np.minimum(n_sig, 19)
     over = n_sig > 19
-    truncated = np.where(over, n_sig - 19, 0)
+    # the val19 <= MAX_HOLDING clause both mirrors the reference's outer
+    # check and keeps the *10 below from wrapping u64
+    can_add = over & (f["val19"] <= MAX_HOLDING) & (
+        f["val19"] * 10 + f["d20"] <= MAX_HOLDING
+    )
+    digits = np.where(can_add, f["val19"] * 10 + f["d20"], digits)
+    # bug-compat: the reference counts one extra truncated char when it adds
+    # the 20th digit without incrementing real_digits (:437)
+    truncated = np.where(can_add, n_sig - 18, np.where(over, n_sig - 19, 0))
 
     total_digits = real_digits + truncated
     exp_base = truncated - np.where(

@@ -98,7 +98,7 @@ def format_float(col: Column, digits: int, width_hint: int = 0) -> StringColumn:
 
     if width_hint > 0:
         max_exp = min(max_exp, width_hint)
-    elif not isinstance(col.data, _core.Tracer):
+    elif n > 0 and not isinstance(col.data, _core.Tracer):
         e2_max = int(np.max(np.asarray(expo_f).astype(np.int64)))
         bias = 1023 if col.dtype.kind == Kind.FLOAT64 else 127
         max_exp = max(2, min(max_exp, int((max(e2_max - bias, 1)) * 0.30103) + 3))

@@ -138,6 +138,22 @@ def test_fuzz_exact_domain():
         assert g == float(s), (s, g, float(s))
 
 
+def test_twentieth_digit_rule_post_dot_zeros():
+    """Post-dot zeros pad the 19-char window but keep the value small, so the
+    reference keeps a 20th digit (cast_string_to_float.cu:428-441)."""
+    # 0. + one zero + 19 value digits: chars "0123456789012345678" (19) + "9"
+    s = "0.01234567890123456789"
+    [got] = run([s])
+    # reference accounting: digits=1234567890123456789*10+... no: zeros pad,
+    # so digits after 19 chars = 123456789012345678 (18 value digits,
+    # <= max_holding) -> 20th char '9' appended -> 1234567890123456789
+    # truncated = 20-18 = 2, exp = 2 - (21 - 0) = ... verify numerically:
+    digits = 1234567890123456789
+    total = 19 + 2  # real_digits + truncated (bug-compat +1)
+    exp = 2 - total  # truncated - (total - decimal_pos), decimal_pos=0
+    assert got == float(digits) * 10.0 ** exp or got == digits / 10.0 ** -exp
+
+
 def test_subnormal():
     got = run(["1e-310", "4.9e-324", "1e-400"])
     # reference formula: digits/10^a * 10^b two-step in binary64

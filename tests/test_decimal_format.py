@@ -50,6 +50,10 @@ def test_format_float_specials_and_rounding():
     assert got2 == ["1.00", "0.00", "0.02"]
 
 
+def test_format_float_empty_column():
+    assert format_float(column([], FLOAT64), 2).to_list() == []
+
+
 def test_format_float_nulls_and_validation():
     assert format_float(column([1.5, None], FLOAT64), 1).to_list() == ["1.5", None]
     from spark_rapids_jni_tpu.columnar import INT32
