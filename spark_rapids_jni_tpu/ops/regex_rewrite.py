@@ -1,0 +1,49 @@
+"""Fast-path replacement for the regex ``literal[start-end]{len,}``.
+
+Parity with the reference's literal_range_pattern (regex_rewrite_utils.cu:37
+literal_range_pattern_fn): True where the string contains the literal prefix
+immediately followed by at least ``range_len`` characters whose codepoints lie
+in ``[start, end]``.  Null rows yield null (mask copied; stored value False).
+
+The reference scans per row with nested char loops; here the string column is
+decoded to a char-compacted codepoint matrix (utils.utf8) and the match is a
+shifted-AND reduction: for window origin i, prefix equality uses ``m`` static
+shifts and the range check ``range_len`` more — all elementwise over
+``[rows, chars]``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu.columnar.column import Column, StringColumn
+from spark_rapids_jni_tpu.columnar.dtypes import BOOL
+
+
+def literal_range_pattern(
+    input: StringColumn, prefix: str, range_len: int, start: int, end: int
+) -> Column:
+    """Does each row match ``prefix`` + ``range_len`` chars in [start, end]?"""
+    from spark_rapids_jni_tpu.utils.utf8 import decode_utf8
+
+    pat = [ord(c) for c in prefix]
+    m = len(pat)
+    padded, lens = input.padded()
+    cp, nchars = decode_utf8(padded, lens)
+    n, L = cp.shape
+
+    window = m + range_len
+    # pad chars so static window shifts stay in bounds
+    cp_ext = jnp.pad(cp, ((0, 0), (0, window)), constant_values=-1)
+
+    ok = jnp.ones((n, L), jnp.bool_)
+    for j, pc in enumerate(pat):
+        ok = ok & (cp_ext[:, j : j + L] == pc)
+    for j in range(range_len):
+        c = cp_ext[:, m + j : m + j + L]
+        ok = ok & (c >= start) & (c <= end)
+    # origin must satisfy i <= nchars - m - range_len
+    origin_ok = jnp.arange(L, dtype=jnp.int32)[None, :] <= (nchars - window)[:, None]
+    found = jnp.any(ok & origin_ok, axis=1)
+    found = jnp.where(input.is_valid(), found, False)
+    return Column(found, input.validity, BOOL)

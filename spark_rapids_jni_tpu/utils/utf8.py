@@ -1,0 +1,64 @@
+"""Vectorized UTF-8 decoding over padded byte matrices.
+
+Several reference kernels operate on *characters* (codepoints) rather than
+bytes — cudf::string_view indexes by character (regex_rewrite_utils.cu,
+parse_uri.cu's UTF-8 handling).  This module decodes a dense ``[n, L]`` byte
+matrix into a character-indexed codepoint matrix with pure lane arithmetic:
+classify lead bytes, gather up to 3 continuation bytes with static shifts,
+then compact to char positions with a cumsum scatter.
+
+Invalid sequences decode to the replacement semantics of "whatever the bytes
+say": no validation is performed (matching cudf's permissive utf8 decode).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def decode_utf8(padded: jnp.ndarray, lens: jnp.ndarray):
+    """Decode ``bytes[n, L]`` (lengths in bytes) to characters.
+
+    Returns ``(cp[n, L] int32, nchars[n] int32)`` where ``cp[:, k]`` is the
+    codepoint of character ``k`` (0 beyond ``nchars``).  The output is
+    char-compacted: column k holds the k-th character, not the byte at k.
+    """
+    n, L = padded.shape
+    b = padded.astype(jnp.int32)
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    in_str = pos < lens[:, None]
+
+    is_cont = (b & 0xC0) == 0x80
+    is_lead = in_str & ~is_cont
+    # bytes of the sequence: gather with static shifts (zeros beyond L)
+    b1 = jnp.pad(b, ((0, 0), (0, 3)))[:, 1 : L + 1]
+    b2 = jnp.pad(b, ((0, 0), (0, 3)))[:, 2 : L + 2]
+    b3 = jnp.pad(b, ((0, 0), (0, 3)))[:, 3 : L + 3]
+
+    one = b < 0x80
+    two = (b & 0xE0) == 0xC0
+    three = (b & 0xF0) == 0xE0
+    # four = (b & 0xF8) == 0xF0 (the fall-through case)
+    cp = jnp.where(
+        one,
+        b,
+        jnp.where(
+            two,
+            ((b & 0x1F) << 6) | (b1 & 0x3F),
+            jnp.where(
+                three,
+                ((b & 0x0F) << 12) | ((b1 & 0x3F) << 6) | (b2 & 0x3F),
+                ((b & 0x07) << 18) | ((b1 & 0x3F) << 12) | ((b2 & 0x3F) << 6)
+                | (b3 & 0x3F),
+            ),
+        ),
+    )
+
+    # compact to character positions
+    char_idx = jnp.cumsum(is_lead.astype(jnp.int32), axis=1) - 1
+    nchars = jnp.sum(is_lead, axis=1).astype(jnp.int32)
+    out = jnp.zeros((n, L), jnp.int32)
+    rows = jnp.arange(n, dtype=jnp.int32)[:, None]
+    tgt = jnp.where(is_lead, char_idx, L)  # dropped when not a lead byte
+    out = out.at[rows, tgt].set(jnp.where(is_lead, cp, 0), mode="drop")
+    return out, nchars
