@@ -51,9 +51,25 @@ from spark_rapids_jni_tpu.ops.timezones import (
     convert_timestamp_to_utc,
     convert_utc_timestamp_to_timezone,
 )
+from spark_rapids_jni_tpu.ops.regex_rewrite import literal_range_pattern
+from spark_rapids_jni_tpu.ops.parse_uri import (
+    parse_uri_host,
+    parse_uri_path,
+    parse_uri_protocol,
+    parse_uri_query,
+    parse_uri_query_column,
+    parse_uri_query_literal,
+)
 from spark_rapids_jni_tpu.ops.zorder import hilbert_index, interleave_bits
 
 __all__ = [
+    "literal_range_pattern",
+    "parse_uri_host",
+    "parse_uri_path",
+    "parse_uri_protocol",
+    "parse_uri_query",
+    "parse_uri_query_column",
+    "parse_uri_query_literal",
     "BloomFilter",
     "CastException",
     "from_integers_with_base",

@@ -1,0 +1,596 @@
+"""Spark ``parse_url``: protocol/host/query/query-param/path extraction.
+
+Parity target: the reference's ``parse_uri.cu`` (validate_uri at
+``parse_uri.cu:535``, chunk validators ``:153-493``, query-param narrowing
+``find_query_part`` ``:495``) behind ``ParseURI.java:36-98``.  The reference
+re-implements ``java.net.URI``'s accept/reject behavior: a URI is validated
+*completely* (scheme, fragment, authority incl. IPv4/IPv6/domain hosts, query,
+path, escapes, UTF-8) and a fatally-invalid row nulls every chunk, while some
+failures (e.g. a bad host) null only that chunk.
+
+TPU-first design notes (vs the reference's one-thread-per-row SIMT kernels):
+
+- All character-class validation (``validate_chunk`` + the ``%XX`` escape and
+  UTF-8 rules of ``skip_and_validate_special``, ``parse_uri.cu:92-151``) is
+  done with *shift-based elementwise masks* over the padded ``[rows, bytes]``
+  matrix — no sequential pass at all.  This relies on a position-independence
+  property: in any span that the sequential scanner accepts, every ``%`` begins
+  an escape (hex chars are never ``%``), and in any span it rejects, the first
+  offending position is flagged by the local rule too, so "each ``%`` must be
+  followed by two in-span hex bytes" is exactly equivalent.  Likewise UTF-8
+  continuation checks are static shifts of the lead-byte mask.
+- The three host grammars (IPv4 dotted-quad, registry domain name, IPv6 — all
+  sequential state machines in the reference, ``:165-345``) run as ONE fused
+  ``lax.scan`` across the byte axis with small per-row state vectors, keeping
+  every row in VPU lanes.
+- Bug-compat quirks are preserved deliberately: ``validate_port`` accepts any
+  byte (the ``c < '0' && c > '9'`` predicate at ``parse_uri.cu:448`` is never
+  true); 'G'-'Z' count as hex digits inside IPv6 groups (``:251``); the
+  ``amp == 0`` authority path leaves host offsets relative to the unadvanced
+  authority (``:686,:707``); on an empty remainder the valid-bit mask is
+  overwritten to just PATH-if-schemeless (``:610``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from spark_rapids_jni_tpu.columnar.column import (
+    StringColumn,
+    strings_column,
+    strings_from_padded,
+)
+
+__all__ = [
+    "parse_uri_protocol",
+    "parse_uri_host",
+    "parse_uri_query",
+    "parse_uri_query_literal",
+    "parse_uri_query_column",
+    "parse_uri_path",
+]
+
+# Chunk selectors (mirror URI_chunks, parse_uri.cu:58-68).
+_PROTOCOL, _HOST, _QUERY, _PATH = 0, 1, 2, 3
+
+# Host validation outcomes (chunk_validity, parse_uri.cu:70).
+_H_VALID, _H_INVALID, _H_FATAL = 0, 1, 2
+
+
+def _build_luts():
+    hexd = np.zeros(256, np.bool_)
+    for c in b"0123456789abcdefABCDEF":
+        hexd[c] = True
+    alpha = np.zeros(256, np.bool_)
+    alpha[ord("a") : ord("z") + 1] = True
+    alpha[ord("A") : ord("Z") + 1] = True
+    digit = np.zeros(256, np.bool_)
+    digit[ord("0") : ord("9") + 1] = True
+    alnum = alpha | digit
+
+    def from_ranges(singles=b"", ranges=(), minus=b""):
+        t = np.zeros(256, np.bool_)
+        for c in singles:
+            t[c] = True
+        for lo, hi in ranges:
+            t[lo : hi + 1] = True
+        for c in minus:
+            t[c] = False
+        return t
+
+    # validate_query (parse_uri.cu:399-411)
+    query = from_ranges(b'!"$=_~', [(0x26, 0x3B), (0x3F, 0x5D), (0x61, 0x7A)], b"\\")
+    # validate_path (parse_uri.cu:453-465)
+    path = from_ranges(b"!$=_~", [(0x26, 0x3B), (0x40, 0x5A), (0x61, 0x7A)])
+    # validate_opaque / validate_fragment (parse_uri.cu:467-493) — identical sets
+    opaque = from_ranges(b"!$=_~", [(0x26, 0x3B), (0x3F, 0x5D), (0x61, 0x7A)], b"\\")
+    # validate_authority (parse_uri.cu:413-429)
+    auth = from_ranges(
+        b"!$=~", [(0x26, 0x3B), (0x40, 0x5F), (0x61, 0x7A)], b"/^\\"
+    )
+    auth_pct = auth.copy()
+    auth_pct[ord("%")] = True
+    # validate_userinfo (parse_uri.cu:431-440): anything but brackets
+    userinfo = np.ones(256, np.bool_)
+    userinfo[ord("[")] = False
+    userinfo[ord("]")] = False
+    # validate_port (parse_uri.cu:442-451): the predicate can never fail
+    port = np.ones(256, np.bool_)
+    scheme_rest = alnum.copy()
+    for c in b"+-.":
+        scheme_rest[c] = True
+    return {
+        "hex": hexd,
+        "alpha": alpha,
+        "digit": digit,
+        "alnum": alnum,
+        "query": query,
+        "path": path,
+        "opaque": opaque,
+        "fragment": opaque,
+        "auth": auth,
+        "auth_pct": auth_pct,
+        "userinfo": userinfo,
+        "port": port,
+        "scheme_rest": scheme_rest,
+    }
+
+
+_LUTS = {k: jnp.asarray(v) for k, v in _build_luts().items()}
+
+
+def _first(mask, pos, L):
+    """(first position, found) over axis 1; position is L+9 when not found."""
+    p = jnp.where(mask, pos, jnp.int32(L + 9))
+    return jnp.min(p, axis=1), jnp.any(mask, axis=1)
+
+
+def _last(mask, pos):
+    p = jnp.where(mask, pos, jnp.int32(-1))
+    return jnp.max(p, axis=1), jnp.any(mask, axis=1)
+
+
+def _at(b, idx):
+    """Gather one byte per row at a clipped index (callers gate validity)."""
+    L = b.shape[1]
+    return jnp.take_along_axis(
+        b, jnp.clip(idx, 0, L - 1)[:, None].astype(jnp.int32), axis=1
+    )[:, 0]
+
+
+def _shr(m, k):
+    """Shift mask right along the byte axis: out[i] = m[i-k]."""
+    return jnp.pad(m, ((0, 0), (k, 0)))[:, : m.shape[1]]
+
+
+def _validate_span(b, bx, s, e, lut, raw_pct=None):
+    """Vectorized validate_chunk (parse_uri.cu:133-151) over per-row spans.
+
+    ``raw_pct`` (bool[n] or None) mirrors allow_invalid_escapes: where True,
+    '%' is an ordinary character checked against the LUT instead of starting a
+    mandatory %XX escape.
+    """
+    n, L = b.shape
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    in_span = (pos >= s[:, None]) & (pos < e[:, None])
+    b1, b2, b3 = bx[:, 1 : L + 1], bx[:, 2 : L + 2], bx[:, 3 : L + 3]
+
+    is_pct = b == ord("%")
+    if raw_pct is None:
+        esc_start = in_span & is_pct
+    else:
+        esc_start = in_span & is_pct & ~raw_pct[:, None]
+    hex1 = _LUTS["hex"][b1]
+    hex2 = _LUTS["hex"][b2]
+    esc_ok = (pos + 1 < e[:, None]) & hex1 & (pos + 2 < e[:, None]) & hex2
+    esc_viol = esc_start & ~esc_ok
+    esc_hex = _shr(esc_start, 1) | _shr(esc_start, 2)
+
+    # Multi-byte UTF-8 handling (skip_and_validate_special, parse_uri.cu:108-123):
+    # lead bytes >= 0xC0 consume their continuations; continuations must be
+    # 10xxxxxx and the packed codepoint bytes must not be unicode whitespace.
+    nb = (
+        1
+        + (b >= 0xC0).astype(jnp.int32)
+        + (b >= 0xE0).astype(jnp.int32)
+        + (b >= 0xF0).astype(jnp.int32)
+    )
+    lead = in_span & (nb > 1) & ~esc_hex
+    cont1 = (b1 & 0xC0) == 0x80
+    cont2 = (b2 & 0xC0) == 0x80
+    cont3 = (b3 & 0xC0) == 0x80
+    utf8_ok = jnp.where(
+        nb == 2, cont1, jnp.where(nb == 3, cont1 & cont2, cont1 & cont2 & cont3)
+    )
+    p2 = (b.astype(jnp.int32) << 8) | b1.astype(jnp.int32)
+    p3 = (p2 << 8) | b2.astype(jnp.int32)
+    forb2 = (p2 >= 0xC280) & (p2 <= 0xC2A0)
+    forb3 = (
+        ((p3 >= 0xE28080) & (p3 <= 0xE2808A))
+        | (p3 == 0xE19A80)
+        | (p3 == 0xE280AF)
+        | (p3 == 0xE280A8)
+        | (p3 == 0xE2819F)
+        | (p3 == 0xE38080)
+    )
+    lead_viol = lead & (~utf8_ok | ((nb == 2) & forb2) | ((nb == 3) & forb3))
+    cover = (
+        _shr(lead & (nb >= 2), 1) | _shr(lead & (nb >= 3), 2) | _shr(lead & (nb >= 4), 3)
+    )
+
+    plain = in_span & ~esc_start & ~esc_hex & ~lead & ~cover
+    plain_viol = plain & ~lut[b]
+    return ~jnp.any(esc_viol | lead_viol | plain_viol, axis=1)
+
+
+def _host_machines(b, hs, he):
+    """One fused scan over the byte axis running the IPv4 dotted-quad,
+    domain-name, and IPv6 validators (parse_uri.cu:165-345) for every row's
+    host span simultaneously.  Returns (ipv4_ok, domain_ok, ipv6_ok)."""
+    n, L = b.shape
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    in_span = (pos >= hs[:, None]) & (pos < he[:, None])
+    first = pos == hs[:, None]
+    last = pos == (he - 1)[:, None]
+    xs = (b.T, in_span.T, first.T, last.T)
+
+    tb = jnp.ones((n,), jnp.bool_)
+    z = jnp.zeros((n,), jnp.int32)
+    init = dict(
+        # ipv4 (parse_uri.cu:269-304)
+        a4=z, s4=z, d4=z, ok4=tb,
+        # domain (parse_uri.cu:306-345)
+        dh=~tb, dp=~tb, dn=~tb, dc=z, okd=tb,
+        # ipv6 (parse_uri.cu:165-267)
+        v6_dc=~tb, v6_ob=z, v6_cb=z, v6_pr=z, v6_co=z, v6_pc=z,
+        v6_prev=jnp.zeros((n,), jnp.uint8), v6_a=z, v6_ac=z, v6_hx=~tb, ok6=tb,
+    )
+
+    def step(st, x):
+        c, ins, fst, lst = x
+        ci = c.astype(jnp.int32)
+        dig = _LUTS["digit"][c]
+        dv = ci - ord("0")
+
+        # ---- IPv4: digits and interior dots; every prefix value <= 255.
+        dot = c == ord(".")
+        ok4 = st["ok4"] & (dig | (dot & ~fst))
+        ok4 = jnp.where(dot, ok4 & (st["s4"] > 0), ok4)
+        a4n = jnp.minimum(st["a4"] * 10 + dv, 1000)
+        ok4 = jnp.where(dig, ok4 & (a4n <= 255), ok4)
+        a4 = jnp.where(dot, 0, jnp.where(dig, a4n, st["a4"]))
+        s4 = jnp.where(dot, 0, jnp.where(dig, st["s4"] + 1, st["s4"]))
+        d4 = st["d4"] + jnp.where(dot, 1, 0)
+
+        # ---- Domain name: alnum/-/.; '-' not at edges or beside '.'; '.' not
+        # doubled/leading; final label must not start with a digit.
+        an = _LUTS["alnum"][c]
+        hy = c == ord("-")
+        pd = c == ord(".")
+        okd = st["okd"] & (an | hy | pd)
+        dn = st["dp"] & dig
+        okd = jnp.where(hy, okd & ~st["dp"] & ~fst & ~lst, okd)
+        okd = jnp.where(pd, okd & ~st["dh"] & ~st["dp"] & (st["dc"] > 0), okd)
+        dh = hy
+        dp = pd
+        dcnt = jnp.where(hy | pd, jnp.where(pd, 0, st["dc"]), st["dc"] + 1)
+        dcnt = jnp.where(hy, st["dc"], dcnt)
+
+        # ---- IPv6 (with bracket/zone%/embedded-IPv4 bookkeeping).
+        is_ob = c == ord("[")
+        is_cb = c == ord("]")
+        is_co = c == ord(":")
+        is_pd = c == ord(".")
+        is_pc = c == ord("%")
+        other = ~(is_ob | is_cb | is_co | is_pd | is_pc)
+        ok6 = st["ok6"]
+        ob = st["v6_ob"] + jnp.where(is_ob, 1, 0)
+        cb = st["v6_cb"] + jnp.where(is_cb, 1, 0)
+        ok6 = jnp.where(is_ob, ok6 & (ob <= 1), ok6)
+        seg_bad = st["v6_hx"] | (st["v6_a"] > 255)
+        ok6 = jnp.where(is_cb, ok6 & (cb <= 1) & ~((st["v6_pr"] > 0) & seg_bad), ok6)
+        dbl = st["v6_prev"] == ord(":")
+        co = st["v6_co"] + jnp.where(is_co, 1, 0)
+        ok6 = jnp.where(
+            is_co,
+            ok6
+            & ~(dbl & st["v6_dc"])
+            & ~((co > 8) | ((co == 8) & ~(st["v6_dc"] | dbl)))
+            & ~((st["v6_pr"] > 0) | (st["v6_pc"] > 0)),
+            ok6,
+        )
+        v6_dc = st["v6_dc"] | (is_co & dbl)
+        pr = st["v6_pr"] + jnp.where(is_pd, 1, 0)
+        ok6 = jnp.where(
+            is_pd,
+            ok6
+            & (st["v6_pc"] == 0)
+            & (pr <= 3)
+            & ~st["v6_hx"]
+            & (st["v6_a"] <= 255)
+            & ((st["v6_co"] == 6) | st["v6_dc"])
+            & (st["v6_co"] < 8),
+            ok6,
+        )
+        pc = st["v6_pc"] + jnp.where(is_pc, 1, 0)
+        ok6 = jnp.where(
+            is_pc, ok6 & (pc <= 1) & ~((st["v6_pr"] > 0) & seg_bad), ok6
+        )
+        in_group = other & (st["v6_pc"] == 0)
+        lower = (c >= ord("a")) & (c <= ord("f"))
+        upper = (c >= ord("A")) & (c <= ord("Z"))  # bug-compat: G-Z "hex"
+        ok6 = jnp.where(in_group, ok6 & (st["v6_ac"] <= 3) & (lower | upper | dig), ok6)
+        add = jnp.where(
+            lower, 10 + ci - ord("a"), jnp.where(upper, 10 + ci - ord("A"), dv)
+        )
+        a6n = jnp.minimum(st["v6_a"] * 10 + jnp.where(lower | upper | dig, add, 0), 99999)
+        reset6 = is_co | is_pd | is_pc
+        v6_a = jnp.where(reset6, 0, jnp.where(in_group, a6n, st["v6_a"]))
+        v6_ac = jnp.where(reset6, 0, jnp.where(in_group, st["v6_ac"] + 1, st["v6_ac"]))
+        v6_hx = jnp.where(
+            reset6, False, st["v6_hx"] | (in_group & (lower | upper))
+        )
+
+        def sel(new, old):
+            return jnp.where(ins, new, old)
+
+        return (
+            dict(
+                a4=sel(a4, st["a4"]), s4=sel(s4, st["s4"]), d4=sel(d4, st["d4"]),
+                ok4=sel(ok4, st["ok4"]),
+                dh=sel(dh, st["dh"]), dp=sel(dp, st["dp"]), dn=sel(dn, st["dn"]),
+                dc=sel(dcnt, st["dc"]), okd=sel(okd, st["okd"]),
+                v6_dc=sel(v6_dc, st["v6_dc"]), v6_ob=sel(ob, st["v6_ob"]),
+                v6_cb=sel(cb, st["v6_cb"]), v6_pr=sel(pr, st["v6_pr"]),
+                v6_co=sel(co, st["v6_co"]), v6_pc=sel(pc, st["v6_pc"]),
+                v6_prev=sel(c, st["v6_prev"]), v6_a=sel(v6_a, st["v6_a"]),
+                v6_ac=sel(v6_ac, st["v6_ac"]), v6_hx=sel(v6_hx, st["v6_hx"]),
+                ok6=sel(ok6, st["ok6"]),
+            ),
+            None,
+        )
+
+    st, _ = lax.scan(step, init, xs)
+    ipv4_ok = st["ok4"] & (st["s4"] > 0) & (st["d4"] == 3)
+    domain_ok = st["okd"] & ~st["dn"]
+    ipv6_ok = st["ok6"] & ((he - hs) >= 2)
+    return ipv4_ok, domain_ok, ipv6_ok
+
+
+def _validate_host(b, bx, hs, he):
+    """validate_host (parse_uri.cu:347-397) → 0 VALID / 1 INVALID / 2 FATAL."""
+    n, L = b.shape
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    in_span = (pos >= hs[:, None]) & (pos < he[:, None])
+    ipv4_ok, domain_ok, ipv6_ok = _host_machines(b, hs, he)
+
+    first_b = _at(b, hs)
+    last_b = _at(b, he - 1)
+    starts_br = (first_b == ord("[")) & (he > hs)
+    bracket_any = jnp.any(in_span & ((b == ord("[")) | (b == ord("]"))), axis=1)
+    lp, lp_f = _last(in_span & (b == ord(".")), jnp.broadcast_to(pos, (n, L)))
+    after = _at(b, lp + 1)
+    domain_route = ~lp_f | (lp == he - 1) | ~_LUTS["digit"][after]
+
+    bracket_state = jnp.where(
+        (last_b == ord("]")) & ipv6_ok, _H_VALID, _H_FATAL
+    )
+    plain_state = jnp.where(
+        bracket_any,
+        _H_FATAL,
+        jnp.where(
+            domain_route,
+            jnp.where(domain_ok, _H_VALID, _H_INVALID),
+            jnp.where(ipv4_ok, _H_VALID, _H_INVALID),
+        ),
+    )
+    return jnp.where(starts_br, bracket_state, plain_state)
+
+
+@functools.partial(jax.jit, static_argnames=("want", "with_needle"))
+def _parse(padded, lens, valid_in, want, with_needle, n_padded, n_lens, n_valid):
+    """Vectorized validate_uri (parse_uri.cu:535-746) + chunk selection."""
+    n, L = padded.shape
+    b = padded
+    bx = jnp.pad(b, ((0, 0), (0, 4)))
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    lens = lens.astype(jnp.int32)
+    in_str = pos < lens[:, None]
+    posb = jnp.broadcast_to(pos, (n, L))
+
+    col_p, col_f = _first(in_str & (b == ord(":")), posb, L)
+    slash_p, slash_f = _first(in_str & (b == ord("/")), posb, L)
+    hash_p, hash_f = _first(in_str & (b == ord("#")), posb, L)
+    q_p, q_f = _first(in_str & (b == ord("?")), posb, L)
+
+    # Fragment: everything after '#'; invalid fragment kills the row
+    # (parse_uri.cu:569-582).
+    E = jnp.where(hash_f, hash_p, lens)
+    frag_ok = _validate_span(b, bx, hash_p + 1, lens, _LUTS["fragment"])
+    row_pre = jnp.where(hash_f, frag_ok, True)
+    col_f = col_f & (~hash_f | (col_p < hash_p))
+    slash_f = slash_f & (~hash_f | (slash_p < hash_p))
+    q_f = q_f & (~hash_f | (q_p < hash_p))
+
+    # Scheme (parse_uri.cu:584-603).
+    has_scheme = col_f & (~slash_f | (col_p < slash_p))
+    first_alpha = _LUTS["alpha"][b[:, 0]]
+    rest_bad = jnp.any(
+        (pos >= 1) & (pos < col_p[:, None]) & in_str & ~_LUTS["scheme_rest"][b], axis=1
+    )
+    scheme_ok = (col_p > 0) & first_alpha & ~rest_bad
+    row_pre = row_pre & (~has_scheme | scheme_ok)
+    proto_bit = has_scheme & scheme_ok
+    rs = jnp.where(has_scheme, col_p + 1, 0)
+    empty_rest = (E - rs) <= 0
+
+    # Hierarchical vs opaque (parse_uri.cu:614-616).
+    hier = (_at(b, rs) == ord("/")) | (rs == 0)
+
+    # Query (parse_uri.cu:619-647).
+    has_q = hier & q_f & (q_p >= rs)
+    qs = jnp.where(has_q, q_p + 1, 0)
+    qe = jnp.where(has_q, E, 0)
+    query_ok = _validate_span(b, bx, qs, qe, _LUTS["query"])
+    row_post = jnp.where(has_q, query_ok, True)
+    query_bit = has_q & query_ok
+
+    PE = jnp.where(has_q, q_p, E)
+
+    # Authority (parse_uri.cu:650-725).
+    has_auth = hier & (_at(b, rs) == ord("/")) & (_at(b, rs + 1) == ord("/"))
+    a_s = rs + 2
+    ns_p, ns_f = _first(
+        (b == ord("/")) & (pos >= a_s[:, None]) & (pos < PE[:, None]), posb, L
+    )
+    a_e = jnp.where(ns_f, ns_p, jnp.where(has_q, q_p, E))
+    auth_nonempty = has_auth & (a_e > a_s)
+    ipv6_escapes = auth_nonempty & ((a_e - a_s) > 2) & (_at(b, a_s) == ord("["))
+    auth_lut_ok = _validate_span(
+        b, bx, a_s, a_e, _LUTS["auth"], raw_pct=None
+    )
+    auth_lut_ok_pct = _validate_span(
+        b, bx, a_s, a_e, _LUTS["auth_pct"], raw_pct=jnp.ones((n,), jnp.bool_)
+    )
+    auth_ok = jnp.where(ipv6_escapes, auth_lut_ok_pct, auth_lut_ok)
+    row_post = row_post & (~auth_nonempty | auth_ok)
+    auth_bit = auth_nonempty & auth_ok
+
+    in_auth = (pos >= a_s[:, None]) & (pos < a_e[:, None])
+    amp_p, amp_f = _first(in_auth & (b == ord("@")), posb, L)
+    bound = jnp.where(amp_f, amp_p, a_s - 1)
+    lc_p, lc_f = _last(in_auth & (b == ord(":")) & (pos > bound[:, None]), posb)
+    cb_p, cb_f = _first(in_auth & (b == ord("]")) & (pos > bound[:, None]), posb, L)
+    amp_rel = amp_p - a_s
+    has_ui = auth_bit & amp_f & (amp_rel > 0)
+    ui_ok = _validate_span(b, bx, a_s, amp_p, _LUTS["userinfo"])
+    row_post = row_post & (~has_ui | ui_ok)
+    hs = jnp.where(has_ui, amp_p + 1, a_s)
+    # Offsets adjust relative to the '@' only when amp > 0 (parse_uri.cu:686-688)
+    adj = amp_f & (amp_rel > 0)
+    lc_rel = jnp.where(lc_f, jnp.where(adj, lc_p - amp_p - 1, lc_p - a_s), -1)
+    cb_rel = jnp.where(cb_f, jnp.where(adj, cb_p - amp_p, cb_p - a_s), -1)
+    has_port = auth_bit & (lc_rel > 0) & (lc_rel > cb_rel)
+    port_ok = _validate_span(b, bx, hs + lc_rel + 1, a_e, _LUTS["port"])
+    row_post = row_post & (~has_port | port_ok)
+    host_s = hs
+    host_e = jnp.where(has_port, hs + lc_rel, a_e)
+    host_state = _validate_host(b, bx, host_s, host_e)
+    row_post = row_post & (~auth_bit | (host_state != _H_FATAL))
+    host_bit = auth_bit & (host_state == _H_VALID)
+
+    # Path (parse_uri.cu:661,:726-735): with authority, only from the slash
+    # after it (empty — but present — otherwise); without, the whole remainder.
+    path_s = jnp.where(has_auth, jnp.where(ns_f, ns_p, 0), rs)
+    path_e = jnp.where(has_auth, jnp.where(ns_f, PE, 0), PE)
+    path_ok = _validate_span(b, bx, path_s, path_e, _LUTS["path"])
+    row_post = row_post & (~hier | path_ok)
+    path_bit = hier & path_ok
+
+    # Opaque (parse_uri.cu:736-743).
+    opq_ok = _validate_span(b, bx, rs, E, _LUTS["opaque"])
+    row_post = row_post & (hier | opq_ok)
+
+    # Query-param narrowing (find_query_part, parse_uri.cu:495-533).
+    if with_needle:
+        NL = n_padded.shape[1]
+        nl = n_lens.astype(jnp.int32)
+        B = jnp.pad(b, ((0, 0), (0, NL + 1)))
+        m = jnp.ones((n, L), jnp.bool_)
+        for j in range(NL):
+            m = m & (
+                (j >= nl[:, None]) | (B[:, j : j + L] == n_padded[:, j : j + 1])
+            )
+        eq_at = jnp.take_along_axis(B, pos + nl[:, None], axis=1)
+        m = m & (eq_at == ord("="))
+        prev_amp = _shr(b == ord("&"), 1)
+        cand = (posb == qs[:, None]) | (
+            (pos > qs[:, None]) & (pos < qe[:, None]) & prev_amp
+        )
+        cand = cand & ((pos + nl[:, None]) < qe[:, None])
+        hit_p, hit_f = _first(cand & m, posb, L)
+        v_s = hit_p + nl + 1
+        amp2_p, amp2_f = _first(
+            (b == ord("&")) & (pos >= v_s[:, None]) & (pos < qe[:, None]), posb, L
+        )
+        v_e = jnp.where(amp2_f, amp2_p, qe)
+        matched = hit_f & n_valid
+        query_bit = query_bit & matched
+        qs = jnp.where(matched, v_s, qs)
+        qe = jnp.where(matched, v_e, qe)
+
+    row_ok = valid_in & row_pre & (empty_rest | row_post)
+
+    if want == _PROTOCOL:
+        s, e, bit = jnp.zeros_like(rs), col_p, proto_bit
+    elif want == _HOST:
+        s, e, bit = host_s, host_e, host_bit
+    elif want == _QUERY:
+        s, e, bit = qs, qe, query_bit
+    else:
+        s, e, bit = path_s, path_e, path_bit
+
+    # Empty remainder: the valid mask collapses to PATH-iff-no-scheme
+    # (parse_uri.cu:606-612) — even PROTOCOL/FRAGMENT bits are dropped.
+    if want == _PATH:
+        bit = jnp.where(empty_rest, ~has_scheme, bit)
+        s = jnp.where(empty_rest, 0, s)
+        e = jnp.where(empty_rest, 0, e)
+    else:
+        bit = bit & ~empty_rest
+
+    out_valid = row_ok & bit
+    out_len = jnp.maximum(e - s, 0)
+    out_len = jnp.where(out_valid, out_len, 0)
+    Bout = jnp.pad(b, ((0, 0), (0, L)))
+    gathered = jnp.take_along_axis(Bout, s[:, None] + pos, axis=1)
+    return gathered, out_len, out_valid
+
+
+def _run(input: StringColumn, want: int, needle=None) -> StringColumn:
+    n = input.size
+    if n == 0:
+        return StringColumn(
+            jnp.zeros((0,), jnp.uint8), jnp.zeros((1,), jnp.int32), None
+        )
+    padded, lens = input.padded()
+    valid_in = input.is_valid()
+    if needle is None:
+        np_, nl_, nv_ = (
+            jnp.zeros((n, 1), jnp.uint8),
+            jnp.zeros((n,), jnp.int32),
+            jnp.ones((n,), jnp.bool_),
+        )
+        with_needle = False
+    else:
+        npad, nlens = needle.padded()
+        if needle.size == 1 and n != 1:
+            npad = jnp.broadcast_to(npad, (n, npad.shape[1]))
+            nlens = jnp.broadcast_to(nlens, (n,))
+            nv_ = jnp.broadcast_to(needle.is_valid(), (n,))
+        else:
+            nv_ = needle.is_valid()
+        np_, nl_ = npad, nlens
+        with_needle = True
+    gathered, out_len, out_valid = _parse(
+        padded, lens, valid_in, want, with_needle, np_, nl_, nv_
+    )
+    return strings_from_padded(gathered, out_len, out_valid)
+
+
+def parse_uri_protocol(input: StringColumn) -> StringColumn:
+    """Spark ``parse_url(url, 'PROTOCOL')`` (ParseURI.java:36)."""
+    return _run(input, _PROTOCOL)
+
+
+def parse_uri_host(input: StringColumn) -> StringColumn:
+    """Spark ``parse_url(url, 'HOST')`` (ParseURI.java:47)."""
+    return _run(input, _HOST)
+
+
+def parse_uri_query(input: StringColumn) -> StringColumn:
+    """Spark ``parse_url(url, 'QUERY')`` (ParseURI.java:58)."""
+    return _run(input, _QUERY)
+
+
+def parse_uri_query_literal(input: StringColumn, literal: str) -> StringColumn:
+    """Spark ``parse_url(url, 'QUERY', key)`` with a literal key
+    (ParseURI.java:70)."""
+    return _run(input, _QUERY, needle=strings_column([literal]))
+
+
+def parse_uri_query_column(input: StringColumn, keys: StringColumn) -> StringColumn:
+    """Spark ``parse_url(url, 'QUERY', key)`` with a per-row key column
+    (ParseURI.java:82)."""
+    return _run(input, _QUERY, needle=keys)
+
+
+def parse_uri_path(input: StringColumn) -> StringColumn:
+    """Spark ``parse_url(url, 'PATH')`` (ParseURI.java:94)."""
+    return _run(input, _PATH)

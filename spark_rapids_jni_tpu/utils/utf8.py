@@ -31,9 +31,8 @@ def decode_utf8(padded: jnp.ndarray, lens: jnp.ndarray):
     is_cont = (b & 0xC0) == 0x80
     is_lead = in_str & ~is_cont
     # bytes of the sequence: gather with static shifts (zeros beyond L)
-    b1 = jnp.pad(b, ((0, 0), (0, 3)))[:, 1 : L + 1]
-    b2 = jnp.pad(b, ((0, 0), (0, 3)))[:, 2 : L + 2]
-    b3 = jnp.pad(b, ((0, 0), (0, 3)))[:, 3 : L + 3]
+    bp = jnp.pad(b, ((0, 0), (0, 3)))
+    b1, b2, b3 = bp[:, 1 : L + 1], bp[:, 2 : L + 2], bp[:, 3 : L + 3]
 
     one = b < 0x80
     two = (b & 0xE0) == 0xC0

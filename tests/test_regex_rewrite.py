@@ -1,0 +1,49 @@
+"""literal_range_pattern tests — vectors from RegexRewriteUtilsTest.java plus
+null handling and a brute-force python cross-check."""
+
+import random
+
+from spark_rapids_jni_tpu.columnar.column import strings_column
+from spark_rapids_jni_tpu.ops import literal_range_pattern
+
+
+def _oracle(s, prefix, range_len, start, end):
+    if s is None:
+        return None
+    window = len(prefix) + range_len
+    for i in range(len(s) - window + 1):
+        if s[i : i + len(prefix)] != prefix:
+            continue
+        tail = s[i + len(prefix) : i + window]
+        if all(start <= ord(c) <= end for c in tail):
+            return True
+    return False
+
+
+def test_literal_range_pattern():
+    # RegexRewriteUtilsTest.java:29-37
+    col = strings_column(["abc123", "aabc123", "aabc12", "abc1232", "aabc1232"])
+    got = literal_range_pattern(col, "abc", 3, 48, 57).to_list()
+    assert got == [True, True, False, True, True]
+
+
+def test_literal_range_pattern_chinese():
+    # RegexRewriteUtilsTest.java:40-48 — multibyte literal + CJK char range
+    col = strings_column(["数据砖块", "火花-急流英伟达", "英伟达Nvidia", "火花-急流"])
+    got = literal_range_pattern(col, "英", 2, 19968, 40869).to_list()
+    assert got == [False, True, True, False]
+
+
+def test_literal_range_pattern_nulls_and_fuzz():
+    rng = random.Random(7)
+    alphabet = "ab1英伟9x"
+    data = [
+        "".join(rng.choice(alphabet) for _ in range(rng.randint(0, 12)))
+        for _ in range(200)
+    ]
+    data += [None, "", "ab", "ab11", "xab119"]
+    col = strings_column(data)
+    for prefix, rl, lo, hi in [("ab", 2, 48, 57), ("英", 1, 19968, 40869)]:
+        got = literal_range_pattern(col, prefix, rl, lo, hi).to_list()
+        want = [_oracle(s, prefix, rl, lo, hi) for s in data]
+        assert got == want, (prefix, rl)

@@ -1,0 +1,375 @@
+"""Sequential pure-python oracle for Spark parse_url semantics.
+
+Follows the same rule-set as the reference's validate_uri (parse_uri.cu:535)
+but as straightforward per-row python, independent of the vectorized TPU
+implementation — agreement between the two on the reference's JUnit corpus
+(ParseURITest.java) plus fuzz inputs is what the tests assert.
+"""
+
+from typing import Optional
+
+_HEX = set(b"0123456789abcdefABCDEF")
+_FORB3 = {0xE19A80, 0xE280AF, 0xE280A8, 0xE2819F, 0xE38080}
+
+
+def _is_alpha(c):
+    return ord("a") <= c <= ord("z") or ord("A") <= c <= ord("Z")
+
+
+def _is_digit(c):
+    return ord("0") <= c <= ord("9")
+
+
+def _is_alnum(c):
+    return _is_alpha(c) or _is_digit(c)
+
+
+def _nb(c):
+    return 1 + (c >= 0xC0) + (c >= 0xE0) + (c >= 0xF0)
+
+
+def _skip_special(bs, i, e, allow):
+    while i < e:
+        c = bs[i]
+        if c == 0x25 and not allow:
+            for k in (1, 2):
+                if i + k >= e or bs[i + k] not in _HEX:
+                    return False, i
+            i += 3
+        elif c >= 0xC0:
+            n = _nb(c)
+            for k in range(1, n):
+                if i + k >= e or (bs[i + k] & 0xC0) != 0x80:
+                    return False, i
+            packed = int.from_bytes(bs[i : i + n], "big")
+            if n == 2 and 0xC280 <= packed <= 0xC2A0:
+                return False, i
+            if n == 3 and (0xE28080 <= packed <= 0xE2808A or packed in _FORB3):
+                return False, i
+            i += n
+        else:
+            break
+    return True, i
+
+
+def _validate_chunk(bs, s, e, allowed, allow=False):
+    ok, i = _skip_special(bs, s, e, allow)
+    if not ok:
+        return False
+    while i < e:
+        if not allowed(bs[i]):
+            return False
+        i += 1
+        ok, i = _skip_special(bs, i, e, allow)
+        if not ok:
+            return False
+    return True
+
+
+def _q_allowed(c):
+    return (
+        c in b'!"$=_~'
+        or 0x26 <= c <= 0x3B
+        or (0x3F <= c <= 0x5D and c != 0x5C)
+        or ord("a") <= c <= ord("z")
+    )
+
+
+def _path_allowed(c):
+    return (
+        c in b"!$=_~"
+        or 0x26 <= c <= 0x3B
+        or 0x40 <= c <= 0x5A
+        or ord("a") <= c <= ord("z")
+    )
+
+
+def _opaque_allowed(c):
+    return (
+        c in b"!$=_~"
+        or 0x26 <= c <= 0x3B
+        or (0x3F <= c <= 0x5D and c != 0x5C)
+        or ord("a") <= c <= ord("z")
+    )
+
+
+def _auth_allowed_f(allow_pct):
+    def f(c):
+        return (
+            c in b"!$=~"
+            or (0x26 <= c <= 0x3B and c != 0x2F)
+            or (0x40 <= c <= 0x5F and c not in (0x5E, 0x5C))
+            or ord("a") <= c <= ord("z")
+            or (allow_pct and c == 0x25)
+        )
+
+    return f
+
+
+def _validate_scheme(bs, s, e):
+    if s >= e or not _is_alpha(bs[s]):
+        return False
+    return all(_is_alnum(c) or c in b"+-." for c in bs[s + 1 : e])
+
+
+def _validate_ipv4(bs, s, e):
+    addr = cnt = dots = 0
+    for i in range(s, e):
+        c = bs[i]
+        if not _is_digit(c) and (i == s or c != ord(".")):
+            return False
+        if c == ord("."):
+            if cnt == 0:
+                return False
+            addr = cnt = 0
+            dots += 1
+            continue
+        cnt += 1
+        addr = addr * 10 + (c - ord("0"))
+        if addr > 255:
+            return False
+    return cnt > 0 and dots == 3
+
+
+def _validate_domain(bs, s, e):
+    lh = lp = ns = False
+    cbp = 0
+    for i in range(s, e):
+        c = bs[i]
+        if not (_is_alnum(c) or c in b"-."):
+            return False
+        ns = lp and _is_digit(c)
+        if c == ord("-"):
+            if lp or i == s or i == e - 1:
+                return False
+            lh, lp = True, False
+        elif c == ord("."):
+            if lh or lp or cbp == 0:
+                return False
+            lp, lh, cbp = True, False, 0
+        else:
+            lp = lh = False
+            cbp += 1
+    return not ns
+
+
+def _validate_ipv6(bs, s, e):
+    if e - s < 2:
+        return False
+    dc = False
+    ob = cb = pr = co = pc = 0
+    prev = 0
+    addr = ac = 0
+    hx = False
+    for i in range(s, e):
+        c = bs[i]
+        if c == ord("["):
+            ob += 1
+            if ob > 1:
+                return False
+        elif c == ord("]"):
+            cb += 1
+            if cb > 1:
+                return False
+            if pr > 0 and (hx or addr > 255):
+                return False
+        elif c == ord(":"):
+            co += 1
+            if prev == ord(":"):
+                if dc:
+                    return False
+                dc = True
+            addr, hx, ac = 0, False, 0
+            if co > 8 or (co == 8 and not dc):
+                return False
+            if pr > 0 or pc > 0:
+                return False
+        elif c == ord("."):
+            pr += 1
+            if pc > 0 or pr > 3 or hx or addr > 255:
+                return False
+            if co != 6 and not dc:
+                return False
+            if co >= 8:
+                return False
+            addr, hx, ac = 0, False, 0
+        elif c == ord("%"):
+            pc += 1
+            if pc > 1:
+                return False
+            if pr > 0 and (hx or addr > 255):
+                return False
+            addr, hx, ac = 0, False, 0
+        else:
+            if pc == 0:
+                if ac > 3:
+                    return False
+                ac += 1
+                addr *= 10
+                if ord("a") <= c <= ord("f"):
+                    addr += 10 + c - ord("a")
+                    hx = True
+                elif ord("A") <= c <= ord("Z"):
+                    addr += 10 + c - ord("A")
+                    hx = True
+                elif _is_digit(c):
+                    addr += c - ord("0")
+                else:
+                    return False
+        prev = c
+    return True
+
+
+def _validate_host(bs, s, e):
+    """-> 'valid' | 'invalid' | 'fatal' (chunk_validity, parse_uri.cu:347)."""
+    if s < e and bs[s] == ord("["):
+        if bs[e - 1] != ord("]") or not _validate_ipv6(bs, s, e):
+            return "fatal"
+        return "valid"
+    last_p = -1
+    for i in range(s, e):
+        if bs[i] in b"[]":
+            return "fatal"
+        if bs[i] == ord("."):
+            last_p = i
+    if last_p < 0 or last_p == e - 1 or not _is_digit(bs[last_p + 1]):
+        return "valid" if _validate_domain(bs, s, e) else "invalid"
+    if _validate_ipv4(bs, s, e):
+        return "valid"
+    return "invalid"
+
+
+def _find_query_part(bs, qs, qe, needle: bytes):
+    nb = len(needle)
+    h = qs
+    while h + nb < qe:
+        if bs[h : h + nb] == needle and bs[h + nb] == ord("="):
+            v = h + nb + 1
+            ve = v
+            while ve < qe and bs[ve] != ord("&"):
+                ve += 1
+            return (v, ve)
+        while h + nb < qe and bs[h] != ord("&"):
+            h += 1
+        h += 1
+    return None
+
+
+def parse_url(
+    s: Optional[str], part: str, needle: Optional[str] = None
+) -> Optional[str]:
+    """part in {'PROTOCOL','HOST','QUERY','PATH'}; needle narrows QUERY."""
+    if s is None:
+        return None
+    bs = s.encode("utf-8", errors="surrogatepass")
+    res = _parse(bs, needle.encode("utf-8") if needle is not None else None)
+    if res is None:
+        return None
+    span = res.get(part)
+    if span is None:
+        return None
+    return bs[span[0] : span[1]].decode("utf-8", errors="surrogatepass")
+
+
+def _parse(bs: bytes, needle: Optional[bytes]):
+    n = len(bs)
+    col = slash = hsh = ques = -1
+    for i, c in enumerate(bs):
+        if c == ord(":") and col == -1:
+            col = i
+        elif c == ord("/") and slash == -1:
+            slash = i
+        elif c == ord("#") and hsh == -1:
+            hsh = i
+        elif c == ord("?") and ques == -1:
+            ques = i
+    out = {}
+    E = n
+    if hsh >= 0:
+        if not _validate_chunk(bs, hsh + 1, n, _opaque_allowed):
+            return None
+        E = hsh
+        if col > hsh:
+            col = -1
+        if slash > hsh:
+            slash = -1
+        if ques > hsh:
+            ques = -1
+    has_scheme = col != -1 and (slash == -1 or col < slash)
+    rs = 0
+    if has_scheme:
+        if not _validate_scheme(bs, 0, col):
+            return None
+        out["PROTOCOL"] = (0, col)
+        rs = col + 1
+    if E - rs <= 0:
+        # parse_uri.cu:606-612 — valid mask collapses to PATH iff schemeless
+        return {"PATH": (rs, rs)} if not has_scheme else {}
+    hier = bs[rs] == ord("/") or rs == 0
+    if not hier:
+        if not _validate_chunk(bs, rs, E, _opaque_allowed):
+            return None
+        return out
+    qs = qe = None
+    if ques >= rs:
+        qs, qe = ques + 1, E
+        if not _validate_chunk(bs, qs, qe, _q_allowed):
+            return None
+        if needle is not None:
+            hit = _find_query_part(bs, qs, qe, needle)
+            if hit is None:
+                return None
+            qs, qe = hit
+        out["QUERY"] = (qs, qe)
+    PE = ques if ques >= rs else E
+    path = (0, 0)
+    next_b = bs[rs + 1] if rs + 1 < n else 0
+    if bs[rs] == ord("/") and next_b == ord("/"):
+        a_s = rs + 2
+        ns = -1
+        for i in range(a_s, PE):
+            if bs[i] == ord("/"):
+                ns = i
+                break
+        a_e = ns if ns >= 0 else (ques if ques >= rs else E)
+        if ns >= 0:
+            path = (ns, PE)
+        if a_e > a_s:
+            ipv6 = a_e - a_s > 2 and bs[a_s] == ord("[")
+            if not _validate_chunk(bs, a_s, a_e, _auth_allowed_f(ipv6), allow=ipv6):
+                return None
+            amp = lc = cbk = -1  # indices relative to a_s, as in the reference
+            for idx in range(a_s, a_e):
+                i = idx - a_s
+                c = bs[idx]
+                if c == ord("@"):
+                    if amp == -1:
+                        amp = i
+                        lc = cbk = -1
+                elif c == ord(":"):
+                    lc = (i - amp - 1) if amp > 0 else i
+                elif c == ord("]"):
+                    if cbk == -1:
+                        cbk = (i - amp) if amp > 0 else i
+            hs = a_s
+            if amp > 0:
+                if not _validate_chunk(bs, a_s, a_s + amp, lambda c: c not in b"[]"):
+                    return None
+                hs = a_s + amp + 1
+            if lc > 0 and lc > cbk:
+                if not _validate_chunk(bs, hs + lc + 1, a_e, lambda c: True):
+                    return None
+                he = hs + lc
+            else:
+                he = a_e
+            state = _validate_host(bs, hs, he)
+            if state == "fatal":
+                return None
+            if state == "valid":
+                out["HOST"] = (hs, he)
+    else:
+        path = (rs, PE)
+    if not _validate_chunk(bs, path[0], path[1], _path_allowed):
+        return None
+    out["PATH"] = path
+    return out
