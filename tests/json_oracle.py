@@ -1,0 +1,691 @@
+"""Sequential pure-python oracle for Spark get_json_object semantics.
+
+Transliterates the reference's rule-set (json_parser.cuh tokenizer +
+get_json_object.cu evaluate_path/json_generator) as straightforward per-row
+python.  The vectorized TPU kernel is tested for agreement with this oracle on
+the reference JUnit corpus (GetJsonObjectTest.java) and fuzz inputs.
+
+Deliberate bug-compat quirks preserved:
+- ``\\uXXXX`` escapes always emit decoded UTF-8 bytes raw, even in escaped
+  (quoted) output (json_parser.cuh:975 TODO notes this).
+- A field name containing a ``\\u`` escape never matches a path name
+  (the inverted eof-check at json_parser.cuh:985).
+- ``-0`` integer normalizes to ``0``; float numbers re-render via Java
+  Double.toString, with quoted ``"Infinity"`` (ftos_converter.cuh:1154).
+- Root-level trailing garbage after a complete value is ignored
+  (json_parser.cuh:1250-1254).
+"""
+
+from typing import List, Optional, Tuple
+
+# token kinds
+INIT, ERRORTOK, SUCCESS = 0, 1, 2
+START_OBJECT, END_OBJECT, START_ARRAY, END_ARRAY = 3, 4, 5, 6
+FIELD_NAME, VALUE_STRING = 7, 8
+VALUE_NUMBER_INT, VALUE_NUMBER_FLOAT = 9, 10
+VALUE_TRUE, VALUE_FALSE, VALUE_NULL = 11, 12, 13
+
+MAX_DEPTH = 64
+MAX_NUM_LEN = 1000
+MAX_PATH_DEPTH = 16
+
+# path instruction types
+WILDCARD, INDEX, NAMED = 0, 1, 2
+
+
+class JsonInvalid(Exception):
+    """Global abort -> NULL row (iterative evaluate_path `return false`)."""
+
+
+def _is_ws(c):
+    return c in b" \t\n\r"
+
+
+def _is_digit(c):
+    return ord("0") <= c <= ord("9")
+
+
+def _is_hex(c):
+    return _is_digit(c) or ord("a") <= c <= ord("f") or ord("A") <= c <= ord("F")
+
+
+_SIMPLE_ESC = {
+    ord('"'): b'"',
+    ord("'"): b"'",
+    ord("\\"): b"\\",
+    ord("/"): b"/",
+    ord("b"): b"\x08",
+    ord("f"): b"\x0c",
+    ord("n"): b"\n",
+    ord("r"): b"\r",
+    ord("t"): b"\t",
+}
+
+
+def _escape_ctrl(c: int) -> bytes:
+    m = {8: b"\\b", 9: b"\\t", 10: b"\\n", 12: b"\\f", 13: b"\\r"}
+    if c in m:
+        return m[c]
+    return b"\\u00" + (b"1" if c >= 16 else b"0") + b"%X" % (c % 16)
+
+
+def java_double_repr(v: float) -> str:
+    """Java Double.toString (shortest repr re-formatted Java-style)."""
+    import math
+    import re
+
+    if v == math.inf:
+        return '"Infinity"'
+    if v == -math.inf:
+        return '"-Infinity"'
+    if v == 0:
+        return "-0.0" if math.copysign(1, v) < 0 else "0.0"
+    s = repr(abs(v))
+    m = re.fullmatch(r"(\d+)\.(\d+)(?:e([+-]?\d+))?", s)
+    if m:
+        ip, fp, e = m.group(1), m.group(2), int(m.group(3) or 0)
+        allp = ip + fp
+        digits = allp.lstrip("0") or "0"
+        exp = e + len(ip) - 1 - (len(allp) - len(allp.lstrip("0")))
+    else:
+        m = re.fullmatch(r"(\d+)(?:e([+-]?\d+))?", s)
+        digits = m.group(1).lstrip("0") or "0"
+        exp = int(m.group(2) or 0) + len(m.group(1)) - 1
+    digits = digits.rstrip("0") or "0"
+    sign = "-" if v < 0 else ""
+    if -3 <= exp < 7:
+        if exp >= len(digits) - 1:
+            out = digits + "0" * (exp + 1 - len(digits)) + ".0"
+        elif exp >= 0:
+            out = digits[: exp + 1] + "." + digits[exp + 1 :]
+        else:
+            out = "0." + "0" * (-exp - 1) + digits
+    else:
+        out = digits[0] + "." + (digits[1:] or "0") + "E" + str(exp)
+    return sign + out
+
+
+class _Parser:
+    """json_parser.cuh transliteration (token-at-a-time)."""
+
+    def __init__(self, data: bytes):
+        self.b = data
+        self.pos = 0
+        self.tok = INIT
+        self.stack: List[bool] = []  # True == object context
+        self.tok_start = 0
+        self.num_len = 0
+        self.has_comma = False
+        self.has_colon = False
+
+    def _eof(self):
+        return self.pos >= len(self.b)
+
+    def _skip_ws(self):
+        while not self._eof() and _is_ws(self.b[self.pos : self.pos + 1]):
+            self.pos += 1
+
+    # --- string machinery -------------------------------------------------
+    def _scan_string(self, start: int) -> Tuple[bool, int]:
+        """Validate string at `start`; return (ok, end_pos_after_close)."""
+        b = self.b
+        if start >= len(b):
+            return False, start
+        quote = b[start]
+        i = start + 1
+        while i < len(b):
+            c = b[i]
+            if c == quote:
+                return True, i + 1
+            if c < 32:
+                i += 1
+            elif c == ord("\\"):
+                i += 1
+                if i >= len(b):
+                    return False, i
+                e = b[i]
+                if e in _SIMPLE_ESC:
+                    i += 1
+                elif e == ord("u"):
+                    i += 1
+                    for _ in range(4):
+                        if i >= len(b) or not _is_hex(b[i]):
+                            return False, i
+                        i += 1
+                else:
+                    return False, i
+            else:
+                i += 1
+        return False, i
+
+    def _string_payload(self, span: Tuple[int, int]):
+        """Yield (kind, data) events for string content.
+
+        kind: 'raw' (safe byte), 'ctrl' (raw control char), 'esc' (simple
+        escape -> unescaped byte), 'uni' (utf8 bytes from \\uXXXX).
+        """
+        b = self.b
+        s, e = span
+        quote = b[s]
+        i = s + 1
+        while i < e:
+            c = b[i]
+            if c == quote:
+                break
+            if c < 32:
+                yield ("ctrl", bytes([c]))
+                i += 1
+            elif c == ord("\\"):
+                e2 = b[i + 1]
+                if e2 == ord("u"):
+                    cp = int(b[i + 2 : i + 6], 16)
+                    yield ("uni", _cp_to_utf8(cp))
+                    i += 6
+                else:
+                    yield ("esc", _SIMPLE_ESC[e2], bytes([e2]))
+                    i += 2
+            else:
+                yield ("raw", bytes([c]))
+                i += 1
+
+    def unescaped_string(self, span) -> bytes:
+        out = b""
+        for ev in self._string_payload(span):
+            out += ev[1]
+        return out
+
+    def escaped_string(self, span) -> bytes:
+        out = b'"'
+        for ev in self._string_payload(span):
+            kind, data = ev[0], ev[1]
+            if kind == "raw":
+                if data == b'"':
+                    out += b'\\"'
+                else:
+                    out += data
+            elif kind == "ctrl":
+                out += _escape_ctrl(data[0])
+            elif kind == "uni":
+                out += data  # bug-compat: decoded bytes raw, not re-escaped
+            else:  # simple escape
+                src = ev[2]
+                if src == b'"':
+                    out += b'\\"'
+                elif src == b"'":
+                    out += b"'"
+                elif src == b"\\":
+                    out += b"\\\\"
+                elif src == b"/":
+                    out += b"/"
+                else:  # bfnrt
+                    out += b"\\" + src
+        return out + b'"'
+
+    def field_matches(self, span, name: bytes) -> bool:
+        pos = 0
+        for ev in self._string_payload(span):
+            if ev[0] == "uni":
+                return False  # bug-compat: \u never matches
+            data = ev[1]
+            if name[pos : pos + len(data)] != data:
+                return False
+            pos += len(data)
+        return pos == len(name)
+
+    # --- number ----------------------------------------------------------
+    def _scan_number(self, start: int) -> Tuple[bool, int, bool]:
+        """Return (ok, end_pos, is_float) for number at start (incl. '-')."""
+        b = self.b
+        i = start
+        ndigits = 0
+        is_float = False
+        if i < len(b) and b[i] == ord("-"):
+            i += 1
+        if i >= len(b) or not _is_digit(b[i]):
+            return False, i, False
+        if b[i] == ord("0"):
+            i += 1
+            ndigits += 1
+            if i < len(b) and _is_digit(b[i]):
+                return False, i, False  # leading zero
+        else:
+            while i < len(b) and _is_digit(b[i]):
+                i += 1
+                ndigits += 1
+        if i < len(b) and b[i] == ord("."):
+            i += 1
+            is_float = True
+            if i >= len(b) or not _is_digit(b[i]):
+                return False, i, True
+            while i < len(b) and _is_digit(b[i]):
+                i += 1
+                ndigits += 1
+        if i < len(b) and b[i] in b"eE":
+            i += 1
+            is_float = True
+            if i < len(b) and b[i] in b"+-":
+                i += 1
+            if i >= len(b) or not _is_digit(b[i]):
+                return False, i, True
+            while i < len(b) and _is_digit(b[i]):
+                i += 1
+                ndigits += 1
+        if ndigits > MAX_NUM_LEN:
+            return False, i, is_float
+        return True, i, is_float
+
+    # --- token machine ----------------------------------------------------
+    def _first_value_token(self):
+        self.tok_start = self.pos
+        b, i = self.b, self.pos
+        c = b[i]
+        if c == ord("{"):
+            if len(self.stack) >= MAX_DEPTH:
+                self.tok = ERRORTOK
+                return
+            self.stack.append(True)
+            self.pos += 1
+            self.tok = START_OBJECT
+        elif c == ord("["):
+            if len(self.stack) >= MAX_DEPTH:
+                self.tok = ERRORTOK
+                return
+            self.stack.append(False)
+            self.pos += 1
+            self.tok = START_ARRAY
+        elif c in b"\"'":
+            ok, end = self._scan_string(i)
+            if ok:
+                self.pos = end
+                self.tok = VALUE_STRING
+            else:
+                self.tok = ERRORTOK
+        elif c == ord("t"):
+            if b[i : i + 4] == b"true":
+                self.pos = i + 4
+                self.tok = VALUE_TRUE
+            else:
+                self.tok = ERRORTOK
+        elif c == ord("f"):
+            if b[i : i + 5] == b"false":
+                self.pos = i + 5
+                self.tok = VALUE_FALSE
+            else:
+                self.tok = ERRORTOK
+        elif c == ord("n"):
+            if b[i : i + 4] == b"null":
+                self.pos = i + 4
+                self.tok = VALUE_NULL
+            else:
+                self.tok = ERRORTOK
+        else:
+            ok, end, is_float = self._scan_number(i)
+            if ok:
+                self.pos = end
+                self.num_len = end - i
+                self.tok = VALUE_NUMBER_FLOAT if is_float else VALUE_NUMBER_INT
+            else:
+                self.tok = ERRORTOK
+
+    def next_token(self) -> int:
+        self.has_comma = False
+        self.has_colon = False
+        self._skip_ws()
+        b = self.b
+        if not self._eof():
+            c = b[self.pos]
+            if not self.stack:
+                if self.tok == INIT:
+                    self._first_value_token()
+                else:
+                    self.tok = SUCCESS  # trailing content ignored
+            elif self.stack[-1]:  # object context
+                if self.tok == START_OBJECT:
+                    if c == ord("}"):
+                        self.tok_start = self.pos
+                        self.pos += 1
+                        self.stack.pop()
+                        self.tok = END_OBJECT
+                    else:
+                        self._field_name()
+                elif self.tok == FIELD_NAME:
+                    if c == ord(":"):
+                        self.has_colon = True
+                        self.pos += 1
+                        self._skip_ws()
+                        if self._eof():
+                            self.tok = ERRORTOK
+                        else:
+                            self._first_value_token()
+                    else:
+                        self.tok = ERRORTOK
+                else:
+                    if c == ord("}"):
+                        self.tok_start = self.pos
+                        self.pos += 1
+                        self.stack.pop()
+                        self.tok = END_OBJECT
+                    elif c == ord(","):
+                        self.has_comma = True
+                        self.pos += 1
+                        self._skip_ws()
+                        if self._eof():
+                            self.tok = ERRORTOK
+                        else:
+                            self._field_name()
+                    else:
+                        self.tok = ERRORTOK
+            else:  # array context
+                if self.tok == START_ARRAY:
+                    if c == ord("]"):
+                        self.tok_start = self.pos
+                        self.pos += 1
+                        self.stack.pop()
+                        self.tok = END_ARRAY
+                    else:
+                        self._first_value_token()
+                else:
+                    if c == ord(","):
+                        self.has_comma = True
+                        self.pos += 1
+                        self._skip_ws()
+                        if self._eof():
+                            self.tok = ERRORTOK
+                        else:
+                            self._first_value_token()
+                    elif c == ord("]"):
+                        self.tok_start = self.pos
+                        self.pos += 1
+                        self.stack.pop()
+                        self.tok = END_ARRAY
+                    else:
+                        self.tok = ERRORTOK
+        else:
+            if not self.stack and self.tok != INIT:
+                self.tok = SUCCESS
+            else:
+                self.tok = ERRORTOK
+        return self.tok
+
+    def _field_name(self):
+        self.tok_start = self.pos
+        ok, end = self._scan_string(self.pos)
+        if ok:
+            self.pos = end
+            self.tok = FIELD_NAME
+        else:
+            self.tok = ERRORTOK
+
+    def span(self):
+        return (self.tok_start, self.pos)
+
+    def try_skip_children(self) -> bool:
+        if self.tok in (ERRORTOK, INIT, SUCCESS):
+            return False
+        if self.tok not in (START_OBJECT, START_ARRAY):
+            return True
+        open_cnt = 1
+        while True:
+            t = self.next_token()
+            if t in (START_OBJECT, START_ARRAY):
+                open_cnt += 1
+            elif t in (END_OBJECT, END_ARRAY):
+                open_cnt -= 1
+                if open_cnt == 0:
+                    return True
+            elif t == ERRORTOK:
+                return False
+
+    # --- token text -------------------------------------------------------
+    def unescaped_text(self) -> bytes:
+        return self._text(escaped=False)
+
+    def escaped_text(self) -> bytes:
+        return self._text(escaped=True)
+
+    def _text(self, escaped: bool) -> bytes:
+        t = self.tok
+        if t in (VALUE_STRING, FIELD_NAME):
+            return (
+                self.escaped_string(self.span())
+                if escaped
+                else self.unescaped_string(self.span())
+            )
+        if t == VALUE_NUMBER_INT:
+            s, e = self.tok_start, self.tok_start + self.num_len
+            raw = self.b[s:e]
+            if raw == b"-0":
+                return b"0"
+            return raw
+        if t == VALUE_NUMBER_FLOAT:
+            s, e = self.tok_start, self.tok_start + self.num_len
+            return java_double_repr(float(self.b[s:e])).encode()
+        return {
+            VALUE_TRUE: b"true",
+            VALUE_FALSE: b"false",
+            VALUE_NULL: b"null",
+            START_ARRAY: b"[",
+            END_ARRAY: b"]",
+            START_OBJECT: b"{",
+            END_OBJECT: b"}",
+        }.get(t, b"")
+
+    def copy_current_structure(self, g: "_Gen") -> None:
+        """generator.copy_current_structure + parser copy (escaped style)."""
+        g.try_write_comma()
+        if g.depth > 0:
+            g.empty = False
+        t = self.tok
+        if t in (INIT, ERRORTOK, SUCCESS, FIELD_NAME, END_ARRAY, END_OBJECT):
+            raise JsonInvalid()
+        if t not in (START_OBJECT, START_ARRAY):
+            g.emit(self.escaped_text())
+            return
+        backup = len(self.stack)
+        g.emit(self.escaped_text())
+        while True:
+            self.next_token()
+            if self.tok == ERRORTOK:
+                raise JsonInvalid()
+            if self.has_comma:
+                g.emit(b",")
+            if self.has_colon:
+                g.emit(b":")
+            g.emit(self.escaped_text())
+            if len(self.stack) == backup - 1:
+                return
+
+
+def _cp_to_utf8(cp: int) -> bytes:
+    """codepoint_to_utf8 (json_parser.cuh:903) — plain UTF-8, no surrogates."""
+    if cp < 0x80:
+        return bytes([cp])
+    if cp < 0x800:
+        return bytes([0xC0 | (cp >> 6), 0x80 | (cp & 0x3F)])
+    return bytes([0xE0 | (cp >> 12), 0x80 | ((cp >> 6) & 0x3F), 0x80 | (cp & 0x3F)])
+
+
+# write styles
+RAW, QUOTED, FLATTEN = 0, 1, 2
+
+
+class _Gen:
+    """json_generator over a shared per-row bytearray."""
+
+    def __init__(self, buf: bytearray, start: int):
+        self.buf = buf
+        self.start = start
+        self.depth = 0
+        self.empty = True
+
+    def emit(self, data: bytes):
+        self.buf.extend(data)
+
+    def need_comma(self):
+        return self.depth > 0 and not self.empty
+
+    def try_write_comma(self):
+        if self.need_comma():
+            self.emit(b",")
+
+    def write_start_array(self):
+        self.try_write_comma()
+        self.emit(b"[")
+        self.depth += 1
+        self.empty = True
+
+    def write_end_array(self):
+        self.emit(b"]")
+        self.depth -= 1
+        self.empty = False
+
+    def write_raw(self, p: _Parser):
+        if self.depth > 0:
+            self.empty = False
+        self.emit(p.unescaped_text())
+
+    def new_child(self) -> "_Gen":
+        return _Gen(self.buf, len(self.buf))
+
+    def write_child_raw_value(self, child: "_Gen", outer: bool):
+        insert_comma = self.need_comma()
+        if self.depth > 0:
+            self.empty = False
+        pre = (b"," if insert_comma else b"") + (b"[" if outer else b"")
+        self.buf[child.start : child.start] = pre
+        if outer:
+            self.buf.extend(b"]")
+
+
+def _evaluate(p: _Parser, g: _Gen, style: int, path: list) -> int:
+    """Recursive evaluate_path (get_json_object.cu:360); returns dirty count,
+    raises JsonInvalid on global abort."""
+    t = p.tok
+
+    def nxt():
+        if p.next_token() == ERRORTOK:
+            raise JsonInvalid()
+        return p.tok
+
+    # case 1
+    if t == VALUE_STRING and not path and style == RAW:
+        g.write_raw(p)
+        return 1
+    # case 2
+    if t == START_ARRAY and not path and style == FLATTEN:
+        dirty = 0
+        while p.next_token() != END_ARRAY:
+            if p.tok == ERRORTOK:
+                raise JsonInvalid()
+            dirty += _evaluate(p, g, style, [])
+        return dirty
+    # case 3
+    if not path:
+        p.copy_current_structure(g)
+        return 1
+    # case 4
+    if t == START_OBJECT and path[0][0] == NAMED:
+        name = path[0][1]
+        dirty = 0
+        found = False
+        while p.next_token() != END_OBJECT:
+            if p.tok == ERRORTOK:
+                raise JsonInvalid()
+            if not found and p.field_matches(p.span(), name):
+                if nxt() == VALUE_NULL:
+                    raise JsonInvalid()
+                dirty = _evaluate(p, g, style, path[1:])
+                if dirty == 0:
+                    raise JsonInvalid()
+                found = True
+            else:
+                nxt()
+                if not p.try_skip_children():
+                    raise JsonInvalid()
+        return dirty
+    # case 5
+    if (
+        t == START_ARRAY
+        and len(path) >= 2
+        and path[0][0] == WILDCARD
+        and path[1][0] == WILDCARD
+    ):
+        g.write_start_array()
+        dirty = 0
+        while p.next_token() != END_ARRAY:
+            if p.tok == ERRORTOK:
+                raise JsonInvalid()
+            dirty += _evaluate(p, g, FLATTEN, path[2:])
+        g.write_end_array()
+        return dirty
+    # case 6
+    if t == START_ARRAY and path[0][0] == WILDCARD and style != QUOTED:
+        next_style = QUOTED if style == RAW else FLATTEN
+        child = g.new_child()
+        child.depth = 1
+        child.empty = True
+        dirty = 0
+        while p.next_token() != END_ARRAY:
+            if p.tok == ERRORTOK:
+                raise JsonInvalid()
+            dirty += _evaluate(p, child, next_style, path[1:])
+        if dirty > 1:
+            g.write_child_raw_value(child, True)
+        elif dirty == 1:
+            g.write_child_raw_value(child, False)
+        return dirty
+    # case 7
+    if t == START_ARRAY and path[0][0] == WILDCARD:
+        g.write_start_array()
+        dirty = 0
+        while p.next_token() != END_ARRAY:
+            if p.tok == ERRORTOK:
+                raise JsonInvalid()
+            dirty += _evaluate(p, g, QUOTED, path[1:])
+        g.write_end_array()
+        return dirty
+    # cases 8/9
+    if t == START_ARRAY and path[0][0] == INDEX:
+        idx = path[0][1]
+        with_wildcard = len(path) >= 2 and path[1][0] == WILDCARD
+        nxt()
+        for _ in range(idx):
+            if p.tok == END_ARRAY:
+                raise JsonInvalid()
+            if not p.try_skip_children():
+                raise JsonInvalid()
+            nxt()
+        dirty = _evaluate(
+            p, g, QUOTED if with_wildcard else style, path[1:]
+        )
+        while p.next_token() != END_ARRAY:
+            if p.tok == ERRORTOK:
+                raise JsonInvalid()
+            if not p.try_skip_children():
+                raise JsonInvalid()
+        return dirty
+    # case 12
+    if not p.try_skip_children():
+        raise JsonInvalid()
+    return 0
+
+
+def get_json_object(s: Optional[str], path: list) -> Optional[str]:
+    """path: list of (type, arg) — (NAMED, bytes), (INDEX, int), (WILDCARD,)."""
+    if s is None:
+        return None
+    if len(path) > MAX_PATH_DEPTH:
+        return None
+    data = s.encode("utf-8", errors="surrogatepass")
+    p = _Parser(data)
+    if p.next_token() == ERRORTOK:
+        return None
+    buf = bytearray()
+    g = _Gen(buf, 0)
+    try:
+        dirty = _evaluate(p, g, RAW, list(path))
+    except JsonInvalid:
+        return None
+    if dirty <= 0:
+        return None
+    return bytes(buf).decode("utf-8", errors="surrogatepass")
