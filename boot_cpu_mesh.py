@@ -16,11 +16,8 @@ if (
     and os.environ.get("SRT_REEXECED") != "1"
     and os.environ.get("PALLAS_AXON_POOL_IPS")
 ):
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = (
-        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    from __graft_entry__ import cpu_mesh_env  # shared with the driver dryrun
+
+    env = cpu_mesh_env(8)
     env["SRT_REEXECED"] = "1"
     os.execve(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
