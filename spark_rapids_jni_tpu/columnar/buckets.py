@@ -1,0 +1,230 @@
+"""Length-bucketed padded views of string columns.
+
+A whole-column padded view materializes ``n x max_len`` bytes, so one 2KB
+outlier in a 16M-row column would cost a ~32GB dense buffer.  Instead, rows
+are grouped into power-of-two max-length buckets and each bucket gets its own
+dense ``[rows, width]`` view:
+
+- memory is bounded by ``2 * total_bytes + n * MIN_WIDTH`` (each row's bucket
+  width is < 2x its length, plus the floor bucket),
+- compiled shapes form a small fixed set: widths are powers of two and row
+  counts are rounded up to powers of two, so XLA recompiles at most
+  O(log(rows) * log(max_len)) kernel variants ever, regardless of data.
+
+The reference has no analog — cuDF kernels walk ragged (chars, offsets)
+directly with one thread per row; a dense-lane sweep with bounded padding is
+the TPU-idiomatic replacement (VPU lanes want rectangles).
+
+Ops consume buckets through two drivers:
+
+- :func:`map_buckets`: per-row fixed-shape outputs (hashes, parsed numbers,
+  validity), scattered back into full-size ``[n, ...]`` arrays.
+- :func:`strings_from_buckets`: per-row *string* outputs (each bucket yields
+  its own padded result matrix), assembled into one Arrow-layout column.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar.column import StringColumn
+
+__all__ = [
+    "PaddedBucket",
+    "length_buckets",
+    "padded_buckets",
+    "map_buckets",
+    "strings_from_buckets",
+]
+
+# Narrowest bucket: one VPU lane register row.  Strings shorter than this
+# share a bucket; the padding floor costs at most MIN_WIDTH bytes/row.
+MIN_WIDTH = 32
+
+
+@dataclasses.dataclass
+class PaddedBucket:
+    """One length class of a string column as a dense byte rectangle.
+
+    ``rows[i]`` is the original row index of ``bytes[i]``.  Rows beyond
+    ``n_valid`` are zero-length padding used to round the row count up to a
+    power of two; their ``rows`` entry repeats a real index and their
+    ``lengths`` entry is 0, so kernels can process them harmlessly and
+    scatters drop them (callers scatter with the padded tail masked).
+    """
+
+    rows: jnp.ndarray  # int32[n_rows] original row indices
+    bytes: jnp.ndarray  # uint8[n_rows, width]
+    lengths: jnp.ndarray  # int32[n_rows]
+    width: int  # static bucket width (power of two)
+    n_valid: int  # count of real rows (<= n_rows)
+
+    @property
+    def n_rows(self) -> int:
+        return self.bytes.shape[0]
+
+    def valid_mask(self) -> jnp.ndarray:
+        """[n_rows] bool: True for real rows, False for the pow2-padding tail."""
+        return jnp.arange(self.n_rows, dtype=jnp.int32) < self.n_valid
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(int(x) - 1, 0).bit_length() if x > 1 else 1
+
+
+def _next_pow2_arr(v: np.ndarray) -> np.ndarray:
+    """Element-wise next power of two for v >= 1 (exact, no float log)."""
+    v = v.astype(np.uint32) - 1
+    for s in (1, 2, 4, 8, 16):
+        v |= v >> s
+    return (v.astype(np.int64)) + 1
+
+
+def length_buckets(
+    lens: np.ndarray,
+    min_width: int = 1,
+    round_rows: bool = True,
+) -> List[Tuple[int, np.ndarray, int]]:
+    """Group row indices into power-of-two length classes.
+
+    Returns ``[(width, rows, n_valid), ...]`` ordered by width, where
+    ``rows`` is int32 row indices padded up to a power-of-two count by
+    repeating the last real index (callers mask the tail with
+    ``arange < n_valid``).  Zero-length rows land in the ``min_width``
+    bucket.  The shared kernel under both padded_buckets and the nested
+    hash walk, so the bucketing rules can't drift apart.
+    """
+    lens = np.asarray(lens)
+    widths = np.maximum(min_width, _next_pow2_arr(np.maximum(lens, 1)))
+    out = []
+    for w in sorted(set(widths.tolist())):
+        rows_np = np.nonzero(widths == w)[0].astype(np.int32)
+        n_valid = len(rows_np)
+        n_rows = _next_pow2(n_valid) if round_rows else n_valid
+        if n_rows > n_valid:
+            rows_np = np.concatenate(
+                [rows_np, np.full(n_rows - n_valid, rows_np[-1], np.int32)]
+            )
+        out.append((int(w), rows_np, n_valid))
+    return out
+
+
+def padded_buckets(
+    col: StringColumn,
+    min_width: int = MIN_WIDTH,
+    round_rows: bool = True,
+) -> List[PaddedBucket]:
+    """Split ``col`` into power-of-two-width padded buckets.
+
+    Bucket assignment happens on host from the offsets (metadata-sized
+    transfer; the same host sync ``.padded()`` already needs for max_len).
+    Returns buckets ordered by width; empty column -> empty list.
+    """
+    n = col.size
+    if n == 0:
+        return []
+    offs = np.asarray(col.offsets)
+    lens = (offs[1:] - offs[:-1]).astype(np.int32)
+    out: List[PaddedBucket] = []
+    starts = jnp.asarray(offs[:-1].astype(np.int32))
+    chars = col.chars
+    nchars = int(chars.shape[0])
+    for w, rows_np, n_valid in length_buckets(
+        lens, min_width=min_width, round_rows=round_rows
+    ):
+        n_rows = len(rows_np)
+        rows = jnp.asarray(rows_np)
+        blens = jnp.where(
+            jnp.arange(n_rows, dtype=jnp.int32) < n_valid,
+            jnp.asarray(lens)[rows],
+            jnp.int32(0),
+        )
+        bstarts = starts[rows]
+        idx = bstarts[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]
+        in_bounds = jnp.arange(w, dtype=jnp.int32)[None, :] < blens[:, None]
+        if nchars == 0:
+            gathered = jnp.zeros((n_rows, w), dtype=jnp.uint8)
+        else:
+            gathered = chars[jnp.clip(idx, 0, nchars - 1)]
+        out.append(
+            PaddedBucket(
+                rows=rows,
+                bytes=jnp.where(in_bounds, gathered, jnp.uint8(0)),
+                lengths=blens,
+                width=int(w),
+                n_valid=n_valid,
+            )
+        )
+    return out
+
+
+def map_buckets(
+    col: StringColumn,
+    kernel: Callable,
+    out_init: Sequence[Tuple[tuple, jnp.dtype]],
+    *,
+    min_width: int = MIN_WIDTH,
+    row_args: Sequence[jnp.ndarray] = (),
+):
+    """Run ``kernel(bytes, lengths, *row_args_for_bucket)`` per bucket and
+    scatter each output back into full-size arrays.
+
+    ``kernel`` must return a tuple of arrays whose leading dim is the bucket
+    row count and whose trailing shape/dtype matches ``out_init`` (a list of
+    ``(trailing_shape, dtype)``).  ``row_args`` are per-row arrays of the full
+    column (e.g. validity) gathered into each bucket before the call.
+    Returns the tuple of ``[n, *trailing]`` arrays (zero-filled off-bucket).
+    """
+    n = col.size
+    outs = [jnp.zeros((n,) + tuple(shape), dtype=dt) for shape, dt in out_init]
+    for b in padded_buckets(col, min_width=min_width):
+        extra = [a[b.rows] for a in row_args]
+        res = kernel(b.bytes, b.lengths, *extra)
+        if not isinstance(res, (tuple, list)):
+            res = (res,)
+        # drop the pow2-padding tail: scatter real rows only
+        tgt = jnp.where(b.valid_mask(), b.rows, jnp.int32(n))
+        for i, r in enumerate(res):
+            outs[i] = outs[i].at[tgt].set(r, mode="drop")
+    return tuple(outs)
+
+
+def strings_from_buckets(
+    n: int,
+    results: Sequence[Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, int]],
+    validity: Optional[jnp.ndarray] = None,
+) -> StringColumn:
+    """Assemble per-bucket padded string results into one StringColumn.
+
+    ``results``: per bucket ``(rows, padded[nb, w], lens[nb], n_valid)`` —
+    only the first ``n_valid`` entries of each bucket are real.  Row order of
+    the output column follows the original row indices.
+    """
+    lens_full = jnp.zeros((n,), dtype=jnp.int32)
+    for rows, padded, lens, n_valid in results:
+        mask = jnp.arange(rows.shape[0], dtype=jnp.int32) < n_valid
+        tgt = jnp.where(mask, rows, jnp.int32(n))
+        lens_full = lens_full.at[tgt].set(lens.astype(jnp.int32), mode="drop")
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(lens_full, dtype=jnp.int32)]
+    )
+    total = int(offsets[-1])
+    chars = jnp.zeros((max(total, 1),), dtype=jnp.uint8)
+    for rows, padded, lens, n_valid in results:
+        nb, w = padded.shape
+        mask = jnp.arange(nb, dtype=jnp.int32) < n_valid
+        row_start = jnp.where(mask, offsets[:-1][rows], jnp.int32(total))
+        pos = row_start[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]
+        in_bounds = (
+            jnp.arange(w, dtype=jnp.int32)[None, :] < lens[:, None]
+        ) & mask[:, None]
+        chars = chars.at[jnp.where(in_bounds, pos, total)].set(
+            padded, mode="drop"
+        )
+    chars = chars[:total]
+    return StringColumn(chars, offsets, validity)

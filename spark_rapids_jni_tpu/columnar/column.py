@@ -15,9 +15,11 @@ Validity is an *unpacked* bool vector rather than Arrow's packed bits: the VPU
 operates on lanes, and packed-bit twiddling per element would serialize.  Packing
 to/from Arrow bitmasks for interchange lives in utils.bitmask.
 
-Vectorized string kernels consume a *padded view*: ``bytes[n, max_len]`` + lengths.
-That trades memory for a dense rectangular layout the VPU can sweep; ops chunk rows
-to bound the padding cost.
+Vectorized string kernels consume a *padded view*: dense ``bytes[rows, width]``
+rectangles the VPU can sweep.  Ops go through columnar/buckets.py, which
+length-buckets rows into power-of-two widths so memory stays O(total_bytes)
+and one long outlier never pads the whole column; bare ``.padded()`` (whole
+column at max_len) remains for small/uniform intermediates only.
 """
 
 from __future__ import annotations

@@ -33,6 +33,7 @@ import numpy as np
 from jax import lax
 
 from spark_rapids_jni_tpu.columnar import dtypes
+from spark_rapids_jni_tpu.columnar.buckets import map_buckets
 from spark_rapids_jni_tpu.columnar.column import (
     Column,
     Decimal128Column,
@@ -226,15 +227,13 @@ def string_to_integer(
     n = col.size
     if n == 0:
         return Column(jnp.zeros((0,), dtype=dtype.jnp_dtype), None, dtype)
-    padded, lens = col.padded()
-    val, valid = _string_to_integer_kernel(
-        padded,
-        lens,
-        col.is_valid(),
-        ansi_mode=ansi_mode,
-        strip=strip,
-        min_v=min_v,
-        max_v=max_v,
+    val, valid = map_buckets(
+        col,
+        lambda b, l, v: _string_to_integer_kernel(
+            b, l, v, ansi_mode=ansi_mode, strip=strip, min_v=min_v, max_v=max_v
+        ),
+        [((), jnp.int64), ((), jnp.bool_)],
+        row_args=[col.is_valid()],
     )
     if ansi_mode:
         # the only host sync on the cast path, and only in ANSI mode
@@ -606,14 +605,13 @@ def string_to_decimal(
             z = jnp.zeros((0,), dtype=jnp.int64)
             return Decimal128Column(z, z.astype(jnp.uint64), None, dtype)
         return Column(jnp.zeros((0,), dtype=dtype.jnp_dtype), None, dtype)
-    padded, lens = col.padded()
-    vh, vl, valid = _string_to_decimal_kernel(
-        padded,
-        lens,
-        col.is_valid(),
-        precision=precision,
-        scale=cudf_scale,
-        strip=strip,
+    vh, vl, valid = map_buckets(
+        col,
+        lambda b, l, v: _string_to_decimal_kernel(
+            b, l, v, precision=precision, scale=cudf_scale, strip=strip
+        ),
+        [((), jnp.int64), ((), jnp.uint64), ((), jnp.bool_)],
+        row_args=[col.is_valid()],
     )
     if ansi_mode:
         _raise_if_ansi_error(col, np.asarray(valid))
@@ -708,9 +706,11 @@ def to_integers_with_base(col: StringColumn, base: int = 10) -> Column:
     n = col.size
     if n == 0:
         return Column(jnp.zeros((0,), dtype=jnp.uint64), None, dtypes.UINT64)
-    padded, lens = col.padded()
-    val, valid = _to_integers_with_base_kernel(
-        padded, lens, col.is_valid(), base=base
+    val, valid = map_buckets(
+        col,
+        lambda b, l, v: _to_integers_with_base_kernel(b, l, v, base=base),
+        [((), jnp.uint64), ((), jnp.bool_)],
+        row_args=[col.is_valid()],
     )
     return Column(val, valid, dtypes.UINT64)
 

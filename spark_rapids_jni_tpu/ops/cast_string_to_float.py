@@ -61,12 +61,36 @@ def _exp10(k: np.ndarray) -> np.ndarray:
     return _EXP10[idx]
 
 
-def _scan(col: StringColumn):
-    """Device scan: per-row parse fields, all as [n] arrays.
+_SCAN_FIELDS = [
+    ("lens", jnp.int32), ("all_ws", jnp.bool_), ("negative", jnp.bool_),
+    ("is_nan", jnp.bool_), ("inf3", jnp.bool_), ("inf_exact", jnp.bool_),
+    ("n_lead_zeros", jnp.int32), ("n_sig", jnp.int32),
+    ("n_digit_chars", jnp.int32), ("decimal_pos", jnp.int32),
+    ("dot_in_run", jnp.bool_), ("val19", jnp.uint64), ("d20", jnp.uint64),
+    ("has_exp", jnp.bool_), ("exp_neg", jnp.bool_), ("exp_val", jnp.int32),
+    ("exp_digits", jnp.int32), ("has_suffix", jnp.bool_),
+    ("tail_nonws", jnp.bool_), ("tail0_nonws", jnp.bool_),
+]
 
-    Returns a dict of numpy arrays (pulled to host once, together).
+
+def _scan(col: StringColumn):
+    """Per-row parse fields as a dict of host numpy arrays.
+
+    Runs the padded-sweep kernel per length bucket (columnar/buckets.py) so a
+    long outlier doesn't pad the whole column, then scatters fields back.
     """
-    padded, lens = col.padded()
+    from spark_rapids_jni_tpu.columnar.buckets import map_buckets
+
+    outs = map_buckets(
+        col,
+        _scan_padded,
+        [((), dt) for _, dt in _SCAN_FIELDS],
+    )
+    return {k: np.asarray(v) for (k, _), v in zip(_SCAN_FIELDS, outs)}
+
+
+def _scan_padded(padded, lens):
+    """Padded-view parse sweep over one [n, L] byte rectangle."""
     n, L = padded.shape
     lens = lens.astype(jnp.int32)
     pos_mat = jnp.arange(L, dtype=jnp.int32)[None, :]
@@ -192,10 +216,8 @@ def _scan(col: StringColumn):
         has_exp=has_exp, exp_neg=exp_neg, exp_val=exp_val,
         exp_digits=exp_digits,
         has_suffix=has_suffix, tail_nonws=tail_nonws, tail0_nonws=tail0_nonws,
-        stop_eq_p0=(stop == p0), first_dot_valid=dot_in_run,
-        p0=p0, stop=stop, first_dot=first_dot,
     )
-    return {k: np.asarray(v) for k, v in fields.items()}
+    return tuple(fields[k].astype(dt) for k, dt in _SCAN_FIELDS)
 
 
 def _assemble(f, out_dtype_np):

@@ -34,6 +34,7 @@ from spark_rapids_jni_tpu.columnar import (
     StringColumn,
     StructColumn,
 )
+from spark_rapids_jni_tpu.columnar.buckets import map_buckets
 from spark_rapids_jni_tpu.columnar.dtypes import DType, Kind
 
 DEFAULT_XXHASH64_SEED = 42  # hash.cuh:29
@@ -312,8 +313,17 @@ def _decimal128_java_bytes(col: Decimal128Column):
 def _hash_element(col, h, *, mm: bool):
     """One column's contribution: h' per row, ignoring validity (caller masks)."""
     if isinstance(col, StringColumn):
-        padded, lens = col.padded()
-        return _mm_hash_bytes(padded, lens, h) if mm else _xx_hash_bytes(padded, lens, h)
+        # Length-bucketed: each length class hashes over its own dense
+        # rectangle, so one long outlier doesn't pad the whole column.
+        (out,) = map_buckets(
+            col,
+            lambda b, l, hh: (
+                _mm_hash_bytes(b, l, hh) if mm else _xx_hash_bytes(b, l, hh)
+            ),
+            [((), _U32 if mm else _U64)],
+            row_args=[h],
+        )
+        return out
     if isinstance(col, Decimal128Column):
         be, lens = _decimal128_java_bytes(col)
         return _mm_hash_bytes(be, lens, h) if mm else _xx_hash_bytes(be, lens, h)
@@ -337,7 +347,7 @@ def _hash_element(col, h, *, mm: bool):
         # unscaled value hashed as an 8-byte long (both hashes; xxhash64.cu:248-260)
         v = col.data.astype(jnp.int64)
         return _mm_hash_long(v, h) if mm else _xx_hash_fixed8(v.astype(_U64), h)
-    raise NotImplementedError(f"hash of {col.dtype}")
+    raise ValueError(f"unsupported column type for hashing: {col.dtype}")
 
 
 def _hash_column(col, h, *, mm: bool):
@@ -359,37 +369,104 @@ def _hash_column(col, h, *, mm: bool):
 
 
 def _hash_list(col: ListColumn, h, *, mm: bool):
-    """Serial element hashing of LIST rows, lockstep across rows.
+    """Serial leaf-element hashing of (arbitrarily nested) LIST rows.
 
-    Each row walks its own elements; rows shorter than the longest list stop
-    contributing (mask).  Null elements pass the seed through, like top-level
-    nulls (murmur_hash.cu:50-56).
+    Mirrors murmur_hash.cu:119-142: nested lists descend to the non-nested
+    leaf child by composing offsets, so a row of ``[[1,2],[3]]`` hashes the
+    flattened leaf span ``1,2,3`` serially — the hash of each element seeds
+    the next.  Null leaf elements and null rows pass the seed through.
+    LIST-of-STRUCT is rejected exactly like check_hash_compatibility
+    (murmur_hash.cu:164-171).
+
+    Rows are bucketed by leaf-span length (powers of two) so one long list
+    doesn't pad the whole column's walk.
     """
-    child = col.child
-    if isinstance(child, (ListColumn, StructColumn)):
-        raise NotImplementedError("hash of nested list-of-nested not yet supported")
-    starts = col.offsets[:-1]
-    lens = col.offsets[1:] - col.offsets[:-1]
-    max_elems = int(jnp.max(lens)) if col.size else 0
-    row_valid = col.is_valid()
+    import numpy as np
 
+    from spark_rapids_jni_tpu.columnar.buckets import length_buckets
+
+    # descend nested lists: leaf span per row by offset composition
+    starts = col.offsets[:-1]
+    ends = col.offsets[1:]
+    child = col.child
+    while isinstance(child, ListColumn):
+        starts = child.offsets[starts]
+        ends = child.offsets[ends]
+        child = child.child
+    if isinstance(child, StructColumn):
+        raise ValueError(
+            "hashing a LIST of STRUCT column is not supported"
+        )  # murmur_hash.cu:169
+
+    n = col.size
+    if n == 0:
+        return h
+    row_valid = col.is_valid()
+    lens_np = np.asarray(ends - starts)
+    if int(lens_np.max()) == 0:
+        return h
     child_valid = child.is_valid()
+    csize = max(child.size, 1)
     if isinstance(child, StringColumn):
-        child_padded, child_lens = child.padded()
-    for j in range(max_elems):
-        idx = jnp.clip(starts + j, 0, max(child.size - 1, 0))
-        active = row_valid & (j < lens)
+        # Per-step transient gather widths instead of one resident
+        # [child_n, global_max] pad: each list bucket pads leaf strings only
+        # to the longest leaf *it references* (host metadata compute).
+        coffs_np = np.asarray(child.offsets)
+        clens_np = (coffs_np[1:] - coffs_np[:-1]).astype(np.int32)
+        cstarts = child.offsets[:-1]
+        clens = child.offsets[1:] - child.offsets[:-1]
+        nchars = max(int(child.chars.shape[0]), 1)
+        starts_np = np.asarray(starts)
+        # per-list-row max leaf byte length (0 for empty spans)
+        safe_starts = np.minimum(starts_np, max(len(clens_np) - 1, 0))
+        row_max_leaf = (
+            np.maximum.reduceat(clens_np, safe_starts)
+            if len(clens_np)
+            else np.zeros(n, np.int32)
+        )
+        row_max_leaf = np.where(lens_np > 0, row_max_leaf, 0)
+
+    nonempty = lens_np > 0  # rows with no elements contribute nothing
+    for w, rows_np, n_real in length_buckets(lens_np[nonempty]):
+        rows_np = np.nonzero(nonempty)[0].astype(np.int32)[rows_np]
+        nb = len(rows_np)
+        rows = jnp.asarray(rows_np)
+        real = jnp.arange(nb, dtype=jnp.int32) < n_real
+        bstart = starts[rows]
+        blen = jnp.where(real, (ends - starts)[rows], 0)
+        bvalid = row_valid[rows] & real
+        hb = h[rows]
         if isinstance(child, StringColumn):
-            upd = (
-                _mm_hash_bytes(child_padded[idx], child_lens[idx], h)
-                if mm
-                else _xx_hash_bytes(child_padded[idx], child_lens[idx], h)
-            )
-        else:
-            gathered = Column(child.data[idx], None, child.dtype)
-            upd = _hash_element(gathered, h, mm=mm)
-        elem_ok = active & child_valid[jnp.clip(idx, 0, max(child.size - 1, 0))]
-        h = jnp.where(elem_ok, upd, h)
+            w_child = max(int(row_max_leaf[rows_np[:n_real]].max()), 1)
+            lane = jnp.arange(w_child, dtype=jnp.int32)[None, :]
+
+        def elem_step(hc, j):
+            idx = jnp.clip(bstart + j, 0, csize - 1)
+            if isinstance(child, StringColumn):
+                s0 = cstarts[idx]
+                l0 = clens[idx]
+                pos = jnp.clip(s0[:, None] + lane, 0, nchars - 1)
+                eb = jnp.where(lane < l0[:, None], child.chars[pos], jnp.uint8(0))
+                upd = (
+                    _mm_hash_bytes(eb, l0, hc)
+                    if mm
+                    else _xx_hash_bytes(eb, l0, hc)
+                )
+            elif isinstance(child, Decimal128Column):
+                g = Decimal128Column(
+                    child.hi[idx], child.lo[idx], None, child.dtype
+                )
+                upd = _hash_element(g, hc, mm=mm)
+            else:
+                upd = _hash_element(
+                    Column(child.data[idx], None, child.dtype), hc, mm=mm
+                )
+            ok = bvalid & (j < blen) & child_valid[idx]
+            return jnp.where(ok, upd, hc), None
+
+        hb, _ = jax.lax.scan(elem_step, hb, jnp.arange(w))
+        tgt = jnp.where(real, rows, jnp.int32(n))
+        h = h.at[tgt].set(hb, mode="drop")
     return h
 
 

@@ -29,6 +29,11 @@ TPU-first design notes (vs the reference's one-thread-per-row SIMT kernels):
   ``amp == 0`` authority path leaves host offsets relative to the unadvanced
   authority (``:686,:707``); on an empty remainder the valid-bit mask is
   overwritten to just PATH-if-schemeless (``:610``).
+- One *resolved* (not preserved) reference quirk: ``has_auth`` probes the byte
+  after ``//`` via ``_at``, which clamps past-the-end reads to a zero byte.
+  The reference reads ``str[1]`` unconditionally (``parse_uri.cu:650``), an
+  out-of-bounds read for a 1-byte remainder like ``"http:/"`` — defined
+  behavior here (zero byte, no authority) vs memory-dependent UB there.
 """
 
 from __future__ import annotations
@@ -41,10 +46,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from spark_rapids_jni_tpu.columnar.buckets import (
+    padded_buckets,
+    strings_from_buckets,
+)
 from spark_rapids_jni_tpu.columnar.column import (
     StringColumn,
     strings_column,
-    strings_from_padded,
 )
 
 __all__ = [
@@ -539,7 +547,6 @@ def _run(input: StringColumn, want: int, needle=None) -> StringColumn:
         return StringColumn(
             jnp.zeros((0,), jnp.uint8), jnp.zeros((1,), jnp.int32), None
         )
-    padded, lens = input.padded()
     valid_in = input.is_valid()
     if needle is None:
         np_, nl_, nv_ = (
@@ -549,6 +556,13 @@ def _run(input: StringColumn, want: int, needle=None) -> StringColumn:
         )
         with_needle = False
     else:
+        if needle.size not in (1, n):
+            # The reference JNI layer only ever passes a scalar key or a
+            # same-size column (ParseURI.java:70-93); anything else would
+            # surface as an opaque broadcast error below.
+            raise ValueError(
+                f"query key column must have 1 or {n} rows, got {needle.size}"
+            )
         npad, nlens = needle.padded()
         if needle.size == 1 and n != 1:
             npad = jnp.broadcast_to(npad, (n, npad.shape[1]))
@@ -558,10 +572,26 @@ def _run(input: StringColumn, want: int, needle=None) -> StringColumn:
             nv_ = needle.is_valid()
         np_, nl_ = npad, nlens
         with_needle = True
-    gathered, out_len, out_valid = _parse(
-        padded, lens, valid_in, want, with_needle, np_, nl_, nv_
-    )
-    return strings_from_padded(gathered, out_len, out_valid)
+
+    # Length-bucketed sweep: each URI length class parses over its own dense
+    # rectangle (one long URL doesn't pad the whole column).
+    results = []
+    out_valid_full = jnp.zeros((n,), jnp.bool_)
+    for b in padded_buckets(input):
+        gathered, out_len, out_valid = _parse(
+            b.bytes,
+            b.lengths,
+            valid_in[b.rows],
+            want,
+            with_needle,
+            np_[b.rows],
+            nl_[b.rows],
+            nv_[b.rows],
+        )
+        results.append((b.rows, gathered, out_len, b.n_valid))
+        tgt = jnp.where(b.valid_mask(), b.rows, jnp.int32(n))
+        out_valid_full = out_valid_full.at[tgt].set(out_valid, mode="drop")
+    return strings_from_buckets(n, results, out_valid_full)
 
 
 def parse_uri_protocol(input: StringColumn) -> StringColumn:

@@ -26,24 +26,29 @@ def literal_range_pattern(
     """Does each row match ``prefix`` + ``range_len`` chars in [start, end]?"""
     from spark_rapids_jni_tpu.utils.utf8 import decode_utf8
 
+    from spark_rapids_jni_tpu.columnar.buckets import map_buckets
+
     pat = [ord(c) for c in prefix]
     m = len(pat)
-    padded, lens = input.padded()
-    cp, nchars = decode_utf8(padded, lens)
-    n, L = cp.shape
-
     window = m + range_len
-    # pad chars so static window shifts stay in bounds
-    cp_ext = jnp.pad(cp, ((0, 0), (0, window)), constant_values=-1)
 
-    ok = jnp.ones((n, L), jnp.bool_)
-    for j, pc in enumerate(pat):
-        ok = ok & (cp_ext[:, j : j + L] == pc)
-    for j in range(range_len):
-        c = cp_ext[:, m + j : m + j + L]
-        ok = ok & (c >= start) & (c <= end)
-    # origin must satisfy i <= nchars - m - range_len
-    origin_ok = jnp.arange(L, dtype=jnp.int32)[None, :] <= (nchars - window)[:, None]
-    found = jnp.any(ok & origin_ok, axis=1)
+    def kernel(padded, lens):
+        cp, nchars = decode_utf8(padded, lens)
+        n, L = cp.shape
+        # pad chars so static window shifts stay in bounds
+        cp_ext = jnp.pad(cp, ((0, 0), (0, window)), constant_values=-1)
+        ok = jnp.ones((n, L), jnp.bool_)
+        for j, pc in enumerate(pat):
+            ok = ok & (cp_ext[:, j : j + L] == pc)
+        for j in range(range_len):
+            c = cp_ext[:, m + j : m + j + L]
+            ok = ok & (c >= start) & (c <= end)
+        # origin must satisfy i <= nchars - m - range_len
+        origin_ok = (
+            jnp.arange(L, dtype=jnp.int32)[None, :] <= (nchars - window)[:, None]
+        )
+        return (jnp.any(ok & origin_ok, axis=1),)
+
+    (found,) = map_buckets(input, kernel, [((), jnp.bool_)])
     found = jnp.where(input.is_valid(), found, False)
     return Column(found, input.validity, BOOL)

@@ -1,0 +1,118 @@
+"""Length-bucketed padded view tests (columnar/buckets.py).
+
+The contract under test: memory stays O(total_bytes) + O(n * MIN_WIDTH)
+instead of O(n * max_len), compiled shapes are powers of two, and
+reassembly (map_buckets scatter / strings_from_buckets) is order-exact.
+"""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import columnar as c
+from spark_rapids_jni_tpu.columnar.buckets import (
+    MIN_WIDTH,
+    map_buckets,
+    padded_buckets,
+    strings_from_buckets,
+)
+
+
+def _mk(strs):
+    return c.strings_column(strs)
+
+
+def test_outlier_does_not_pad_everything():
+    # 1M-row-style scenario scaled down: one 4KB string among short rows.
+    n_short = 4096
+    strs = ["ab"] * n_short + ["x" * 4096]
+    col = _mk(strs)
+    buckets = padded_buckets(col)
+    padded_bytes = sum(b.bytes.size for b in buckets)
+    # dense whole-column view would be (n_short+1) * 4096 ≈ 16.7MB;
+    # bucketed must stay under 2*total_bytes + n*MIN_WIDTH
+    total = sum(len(s) for s in strs)
+    assert padded_bytes <= 2 * total + (n_short + 1) * MIN_WIDTH
+    assert padded_bytes < (n_short + 1) * 4096 // 8
+
+
+def test_bucket_shapes_are_pow2():
+    rng = random.Random(0)
+    strs = ["y" * rng.randrange(0, 300) for _ in range(501)]
+    col = _mk(strs)
+    for b in padded_buckets(col):
+        assert b.width & (b.width - 1) == 0
+        assert b.bytes.shape[0] & (b.bytes.shape[0] - 1) == 0
+        assert b.bytes.shape == (b.n_rows, b.width)
+        # every real row fits its bucket
+        assert int(jnp.max(b.lengths)) <= b.width
+
+
+def test_buckets_cover_all_rows_once():
+    rng = random.Random(1)
+    strs = ["z" * rng.randrange(0, 200) for _ in range(257)]
+    col = _mk(strs)
+    seen = []
+    for b in padded_buckets(col):
+        seen.extend(np.asarray(b.rows)[: b.n_valid].tolist())
+    assert sorted(seen) == list(range(257))
+
+
+def test_bucket_bytes_roundtrip():
+    rng = random.Random(2)
+    strs = [
+        bytes(rng.randrange(1, 256) for _ in range(rng.randrange(0, 100)))
+        for _ in range(100)
+    ]
+    col = c.strings_from_bytes(strs)
+    for b in padded_buckets(col):
+        mat = np.asarray(b.bytes)
+        lens = np.asarray(b.lengths)
+        for i, r in enumerate(np.asarray(b.rows)[: b.n_valid]):
+            assert bytes(mat[i][: lens[i]]) == strs[r]
+
+
+def test_map_buckets_scatter():
+    strs = ["a", "bb" * 40, "", "cccc", "d" * 200]
+    col = _mk(strs)
+    (lens_out,) = map_buckets(
+        col, lambda b, l: (l,), [((), jnp.int32)]
+    )
+    assert lens_out.tolist() == [len(s) for s in strs]
+
+
+def test_map_buckets_row_args():
+    strs = ["aa", "b" * 99, "cc"]
+    col = _mk(strs)
+    extra = jnp.asarray([10, 20, 30], dtype=jnp.int32)
+    (out,) = map_buckets(
+        col,
+        lambda b, l, e: (l + e,),
+        [((), jnp.int32)],
+        row_args=[extra],
+    )
+    assert out.tolist() == [12, 119, 32]
+
+
+def test_strings_from_buckets_roundtrip():
+    rng = random.Random(3)
+    strs = ["w" * rng.randrange(0, 500) for _ in range(123)]
+    col = _mk(strs)
+    results = []
+    for b in padded_buckets(col):
+        results.append((b.rows, b.bytes, b.lengths, b.n_valid))
+    out = strings_from_buckets(col.size, results)
+    assert out.to_list() == strs
+
+
+def test_empty_and_tiny_columns():
+    assert padded_buckets(_mk([])) == []
+    col = _mk([""])
+    bs = padded_buckets(col)
+    assert len(bs) == 1 and bs[0].n_valid == 1
+    out = strings_from_buckets(
+        1, [(b.rows, b.bytes, b.lengths, b.n_valid) for b in bs]
+    )
+    assert out.to_list() == [""]

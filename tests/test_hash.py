@@ -355,3 +355,106 @@ def test_random_longs_vs_oracle():
     for i, v in enumerate(vals):
         assert mm[i] == oracle.to_signed32(oracle.murmur32_long(v, 3))
         assert xx[i] == oracle.to_signed64(oracle.xxh64_long(v, 3))
+
+
+# --- arbitrary-depth nesting (murmur_hash.cu:119-142 offset-composed flatten) ----
+
+
+def test_murmur_list_of_list_flattens_to_leaf():
+    # [[1,2],[3]] hashes identically to the flat element walk 1,2,3
+    # (murmur_device_row_hasher descends LIST children to the leaf span).
+    leaf = c.column([1, 2, 3, 4, 5, 6], c.INT32)
+    inner = c.ListColumn(np.array([0, 2, 3, 3, 6], np.int32), leaf, None)
+    outer = c.ListColumn(np.array([0, 2, 3, 4], np.int32), inner, None)
+    flat = c.ListColumn(np.array([0, 3, 3, 6], np.int32), leaf, None)
+    assert (
+        murmur_hash32([outer], seed=1868).to_list()
+        == murmur_hash32([flat], seed=1868).to_list()
+    )
+
+
+def test_murmur_list_of_list_of_strings():
+    leaf = c.strings_column(["a", "bb", LONG_STR, "", "x"])
+    inner = c.ListColumn(np.array([0, 1, 3, 4, 5], np.int32), leaf, None)
+    outer = c.ListColumn(np.array([0, 3, 4], np.int32), inner, None)
+    flat = c.ListColumn(np.array([0, 4, 5], np.int32), leaf, None)
+    assert (
+        murmur_hash32([outer], seed=42).to_list()
+        == murmur_hash32([flat], seed=42).to_list()
+    )
+
+
+def test_murmur_list_null_rows_pass_seed():
+    leaf = c.column([7, 8], c.INT32)
+    inner = c.ListColumn(np.array([0, 1, 2], np.int32), leaf, None)
+    outer = c.ListColumn(
+        np.array([0, 2, 2], np.int32), inner, np.array([True, False])
+    )
+    out = murmur_hash32([outer], seed=5).to_list()
+    # null row passes the seed straight through
+    assert out[1] == oracle.to_signed32(5)
+
+
+def test_murmur_struct_of_lists_matches_flat():
+    # structCV = {intList, doubles} decomposes to serial column chaining
+    leaf = c.column([0, -2, 3, 9], c.INT32)
+    lst = c.ListColumn(np.array([0, 3, 4], np.int32), leaf, None)
+    dbl = c.column([1.5, -2.25], c.FLOAT64)
+    st = c.StructColumn((lst, dbl), None)
+    assert (
+        murmur_hash32([st], seed=1868).to_list()
+        == murmur_hash32([lst, dbl], seed=1868).to_list()
+    )
+
+
+def test_murmur_list_of_struct_rejected():
+    child = c.StructColumn((c.column([1, 2], c.INT32),), None)
+    lst = c.ListColumn(np.array([0, 1, 2], np.int32), child, None)
+    with pytest.raises(ValueError, match="LIST of STRUCT"):
+        murmur_hash32([lst], seed=0)
+
+
+def test_murmur_deep_list_vs_oracle():
+    # randomized 3-deep list of ints vs serial python oracle on the leaf span
+    rng = random.Random(11)
+    leaf_vals = [rng.randrange(-(2**31), 2**31) for _ in range(64)]
+    leaf = c.column(leaf_vals, c.INT32)
+    o1 = sorted(rng.sample(range(65), 9))
+    o1[0], o1[-1] = 0, 64
+    inner = c.ListColumn(np.array(o1, np.int32), leaf, None)
+    o2 = sorted(rng.sample(range(9), 4))
+    o2[0], o2[-1] = 0, 8
+    outer = c.ListColumn(np.array(o2, np.int32), inner, None)
+    got = murmur_hash32([outer], seed=77).to_list()
+    for r in range(len(o2) - 1):
+        lo, hi = o1[o2[r]], o1[o2[r + 1]]
+        h = 77
+        for v in leaf_vals[lo:hi]:
+            h = oracle.murmur32_int(v, h)
+        assert got[r] == oracle.to_signed32(h), f"row {r}"
+
+
+def test_skewed_string_lengths_hash():
+    # one 4KB outlier among many short rows: bucketing must keep this exact
+    rng = random.Random(3)
+    strs = ["s%d" % i for i in range(1000)] + ["x" * 4096]
+    col = c.strings_column(strs)
+    got = murmur_hash32([col], seed=9).to_list()
+    for i in (0, 500, 999, 1000):
+        assert got[i] == oracle.to_signed32(
+            oracle.murmur32_bytes(strs[i].encode(), 9)
+        ), f"row {i}"
+
+
+def test_skewed_list_of_strings_hash():
+    # leaf outlier: per-bucket transient gather width, still oracle-exact
+    leaf_strs = ["e%d" % i for i in range(50)] + ["L" * 2048] + ["t"]
+    leaf = c.strings_column(leaf_strs)
+    offs = list(range(0, 51)) + [52]  # 50 1-elem rows, then a 2-elem row
+    lst = c.ListColumn(np.array(offs, np.int32), leaf, None)
+    got = murmur_hash32([lst], seed=4).to_list()
+    for r in (0, 49, 50):
+        h = 4
+        for s in leaf_strs[offs[r] : offs[r + 1]]:
+            h = oracle.murmur32_bytes(s.encode(), h)
+        assert got[r] == oracle.to_signed32(h), f"row {r}"
