@@ -132,6 +132,7 @@ def padded_buckets(
     lens = (offs[1:] - offs[:-1]).astype(np.int32)
     out: List[PaddedBucket] = []
     starts = jnp.asarray(offs[:-1].astype(np.int32))
+    jlens = jnp.asarray(lens)
     chars = col.chars
     nchars = int(chars.shape[0])
     for w, rows_np, n_valid in length_buckets(
@@ -141,7 +142,7 @@ def padded_buckets(
         rows = jnp.asarray(rows_np)
         blens = jnp.where(
             jnp.arange(n_rows, dtype=jnp.int32) < n_valid,
-            jnp.asarray(lens)[rows],
+            jlens[rows],
             jnp.int32(0),
         )
         bstarts = starts[rows]

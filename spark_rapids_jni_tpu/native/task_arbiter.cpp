@@ -374,9 +374,9 @@ class task_arbiter {
           // recursive allocation (spill inside alloc) (:1244-1261)
           if (is_for_cpu && blocking) {
             throw_code(ARB_INVALID,
-                       "thread " + std::to_string(thread_id) +
-                         " is trying to do a blocking allocate while already in the state " +
-                         as_str(thread->second.state));
+                       "blocking admission request from thread " +
+                         std::to_string(thread_id) + " rejected: thread is mid-allocation (" +
+                         as_str(thread->second.state) + ")");
           }
           return ARB_RECURSIVE;
         default: break;
@@ -392,7 +392,7 @@ class task_arbiter {
           log_status(is_for_cpu ? "INJECTED_RETRY_OOM_CPU" : "INJECTED_RETRY_OOM_GPU",
                      thread_id, st.task_id, st.state);
           st.record_failed_retry_time();
-          throw_code(is_for_cpu ? ARB_CPU_RETRY_OOM : ARB_GPU_RETRY_OOM, "injected RetryOOM");
+          throw_code(is_for_cpu ? ARB_CPU_RETRY_OOM : ARB_GPU_RETRY_OOM, "fault injection: forced retry OOM");
         }
       }
       if (st.cudf_exception_injected > 0) {
@@ -412,7 +412,7 @@ class task_arbiter {
                      thread_id, st.task_id, st.state);
           st.record_failed_retry_time();
           throw_code(is_for_cpu ? ARB_CPU_SPLIT_RETRY : ARB_GPU_SPLIT_RETRY,
-                     "injected SplitAndRetryOOM");
+                     "fault injection: forced split-and-retry OOM");
         }
       }
 
@@ -427,8 +427,8 @@ class task_arbiter {
           break;
         default:
           throw_code(ARB_INVALID,
-                     "thread " + std::to_string(thread_id) + " in unexpected state pre alloc " +
-                       as_str(again->second.state));
+                     "admission precheck: thread " + std::to_string(thread_id) +
+                       " cannot start an allocation from state " + as_str(again->second.state));
       }
     }
     return ARB_OK;

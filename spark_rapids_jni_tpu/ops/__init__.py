@@ -61,8 +61,11 @@ from spark_rapids_jni_tpu.ops.parse_uri import (
     parse_uri_query_literal,
 )
 from spark_rapids_jni_tpu.ops.zorder import hilbert_index, interleave_bits
+from spark_rapids_jni_tpu.ops.from_json import JsonParsingException, from_json
 
 __all__ = [
+    "from_json",
+    "JsonParsingException",
     "literal_range_pattern",
     "parse_uri_host",
     "parse_uri_path",

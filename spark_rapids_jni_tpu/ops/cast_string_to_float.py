@@ -37,6 +37,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
+from spark_rapids_jni_tpu.columnar.buckets import map_buckets
 from spark_rapids_jni_tpu.columnar.column import Column, StringColumn
 from spark_rapids_jni_tpu.columnar.dtypes import DType, FLOAT32, FLOAT64, Kind
 from spark_rapids_jni_tpu.ops.cast_string import CastException
@@ -79,8 +80,6 @@ def _scan(col: StringColumn):
     Runs the padded-sweep kernel per length bucket (columnar/buckets.py) so a
     long outlier doesn't pad the whole column, then scatters fields back.
     """
-    from spark_rapids_jni_tpu.columnar.buckets import map_buckets
-
     outs = map_buckets(
         col,
         _scan_padded,

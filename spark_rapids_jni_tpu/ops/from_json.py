@@ -1,0 +1,193 @@
+"""Spark ``from_json`` for MAP<STRING,STRING>: raw key/value span extraction.
+
+Parity target: ``MapUtils.extractRawMapFromJsonString`` (MapUtils.java:31-53)
+over ``from_json`` (/root/reference/src/main/cpp/src/map_utils.cu:644).
+Per row of JSON text, every *top-level object field* becomes one
+``STRUCT<STRING,STRING>`` entry in a ``LIST`` column:
+
+- keys: the field-name bytes without quotes, raw (no unescaping) —
+  map_utils.cu node_ranges_fn (include_quote_char=false, :394-449);
+- values: raw spans — string values lose their quotes, numbers/literals are
+  their exact text, nested objects/arrays keep their *entire original text*
+  including internal whitespace (``[4,{},null,{"a":[{ }, {}] } ]``);
+- null input rows -> null list rows (reference replaces them with ``{}``
+  before the parse and copies the input validity, map_utils.cu:86-90,:722);
+- non-object rows contribute zero pairs (empty list);
+- any malformed non-null row raises (the reference throws on any tokenizer
+  error in the concatenated buffer, map_utils.cu:113-135 throw_if_error) —
+  a whole-column error, not a per-row null.
+
+Design: the reference concatenates all rows into one buffer and runs cuDF's
+nested-JSON tokenizer, then classifies nodes by parent (key = field whose
+parent is a row object).  Here rows tokenize independently on their length
+bucket (ops/json_tokenizer.py); with per-row token streams, "parent is the
+row object" is simply "FIELD_NAME at container depth 1 under a root object",
+and the value is the following token (its span extended to the matching
+close for containers).  Grammar differences from cuDF's tokenizer are
+inherited deliberately from the Spark-JSON dialect of json_parser.cuh
+(single quotes allowed, etc.).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar.buckets import padded_buckets
+from spark_rapids_jni_tpu.columnar.column import (
+    ListColumn,
+    StringColumn,
+    StructColumn,
+)
+from spark_rapids_jni_tpu.ops import json_tokenizer as jt
+
+__all__ = ["from_json", "JsonParsingException"]
+
+_I32 = jnp.int32
+
+
+class JsonParsingException(ValueError):
+    """Malformed JSON in from_json input (maps the reference's throw)."""
+
+
+def from_json(col: StringColumn) -> ListColumn:
+    """Extract raw top-level key/value pairs per row.
+
+    Returns ``LIST<STRUCT<STRING,STRING>>`` with the input's validity.
+    """
+    n = col.size
+    valid = np.asarray(col.is_valid())
+    if n == 0:
+        empty = StringColumn(
+            jnp.zeros((0,), jnp.uint8), jnp.zeros((1,), _I32), None
+        )
+        return ListColumn(
+            jnp.zeros((1,), _I32), StructColumn((empty, empty), None), None
+        )
+
+    # per-row pair counts + per-bucket pair records
+    pair_counts = np.zeros((n,), np.int64)
+    bucket_recs = []  # (rows_np, kstart, kend, vstart, vend, krank  [np arrays])
+    for b in padded_buckets(col):
+        ts = jt.tokenize(b.bytes, b.lengths)
+        kind = np.asarray(ts.kind)
+        start = np.asarray(ts.start)
+        end = np.asarray(ts.end)
+        match = np.asarray(ts.match)
+        ntok = np.asarray(ts.n_tokens)
+        ok = np.asarray(ts.ok)
+        trailing = np.asarray(ts.trailing)
+        rows = np.asarray(b.rows)[: b.n_valid]
+        kindv = kind[: b.n_valid]
+        startv = start[: b.n_valid]
+        endv = end[: b.n_valid]
+        matchv = match[: b.n_valid]
+        ntokv = ntok[: b.n_valid]
+
+        rvalid = valid[rows]
+        bad = rvalid & (~ok[: b.n_valid] | trailing[: b.n_valid])
+        if bad.any():
+            r = int(rows[int(np.argmax(bad))])
+            raise JsonParsingException(
+                f"JSON Parser encountered an invalid format at row {r}"
+            )
+
+        T = kindv.shape[1]
+        tok_idx = np.arange(T)[None, :]
+        in_tok = tok_idx < ntokv[:, None]
+        opens = np.isin(kindv, (jt.START_OBJECT, jt.START_ARRAY)) & in_tok
+        closes = np.isin(kindv, (jt.END_OBJECT, jt.END_ARRAY)) & in_tok
+        depth_after = np.cumsum(
+            opens.astype(np.int32) - closes.astype(np.int32), axis=1
+        )
+        depth_before = depth_after - opens.astype(np.int32) + closes.astype(
+            np.int32
+        )
+        root_is_obj = (kindv[:, 0] == jt.START_OBJECT) & (ntokv > 0)
+        is_key = (
+            (kindv == jt.FIELD_NAME)
+            & (depth_before == 1)
+            & in_tok
+            & root_is_obj[:, None]
+            & rvalid[:, None]
+        )
+
+        if not is_key.any():
+            continue
+        krank = np.cumsum(is_key, axis=1) - 1
+        ri, ti = np.nonzero(is_key)
+        vt = ti + 1  # value token follows its field name
+        vkind = kindv[ri, vt]
+        vstart = startv[ri, vt]
+        vend = endv[ri, vt]
+        is_str = vkind == jt.VALUE_STRING
+        is_container = np.isin(vkind, (jt.START_OBJECT, jt.START_ARRAY))
+        vstart = np.where(is_str, vstart + 1, vstart)
+        vend = np.where(
+            is_container, endv[ri, matchv[ri, vt]], np.where(is_str, vend - 1, vend)
+        )
+        kstart = startv[ri, ti] + 1  # strip quotes
+        kend = endv[ri, ti] - 1
+
+        np.add.at(pair_counts, rows[ri], 1)
+        bucket_recs.append(
+            (b, rows[ri], ri, kstart, kend, vstart, vend, krank[ri, ti])
+        )
+
+    offsets = np.zeros((n + 1,), np.int64)
+    np.cumsum(pair_counts, out=offsets[1:])
+    total = int(offsets[-1])
+
+    keys = _gather_spans(
+        total, bucket_recs, lambda r: (r[3], r[4]), offsets
+    )
+    values = _gather_spans(
+        total, bucket_recs, lambda r: (r[5], r[6]), offsets
+    )
+    return ListColumn(
+        jnp.asarray(offsets.astype(np.int32)),
+        StructColumn((keys, values), None),
+        col.validity,
+    )
+
+
+def _gather_spans(total, bucket_recs, get_span, row_offsets) -> StringColumn:
+    """Assemble a StringColumn from per-bucket (row, span) records.
+
+    Final pair position = row_offsets[row] + within-row rank, so output
+    order is row-major regardless of bucket assignment.
+    """
+    lens = np.zeros((max(total, 1),), np.int64)
+    pair_pos = []
+    for rec in bucket_recs:
+        _, rows_ri, _ri, *_ , krank = rec
+        s, e = get_span(rec)
+        pos = row_offsets[rows_ri] + krank
+        lens[pos] = e - s
+        pair_pos.append(pos)
+    if total == 0:
+        return StringColumn(
+            jnp.zeros((0,), jnp.uint8), jnp.zeros((1,), _I32), None
+        )
+    offs = np.zeros((total + 1,), np.int64)
+    np.cumsum(lens[:total], out=offs[1:])
+    nbytes = int(offs[-1])
+    chars = jnp.zeros((max(nbytes, 1),), jnp.uint8)
+    for rec, pos in zip(bucket_recs, pair_pos):
+        b = rec[0]
+        s, e = get_span(rec)
+        bloc = rec[2].astype(np.int32)  # bucket-local row of each pair
+        w = int((e - s).max()) if len(s) else 1
+        w = max(w, 1)
+        lane = jnp.arange(w, dtype=_I32)[None, :]
+        src = jnp.asarray(s.astype(np.int32))[:, None] + lane
+        mat = b.bytes[jnp.asarray(bloc)[:, None], jnp.clip(src, 0, b.width - 1)]
+        span_len = jnp.asarray((e - s).astype(np.int32))
+        dst = jnp.asarray(offs[pos].astype(np.int64))[:, None] + lane.astype(
+            jnp.int64
+        )
+        in_b = lane < span_len[:, None]
+        chars = chars.at[jnp.where(in_b, dst, nbytes)].set(mat, mode="drop")
+    return StringColumn(
+        chars[:nbytes], jnp.asarray(offs.astype(np.int32)), None
+    )

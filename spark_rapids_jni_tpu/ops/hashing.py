@@ -26,6 +26,7 @@ from typing import Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from spark_rapids_jni_tpu.columnar import (
     Column,
@@ -34,7 +35,7 @@ from spark_rapids_jni_tpu.columnar import (
     StringColumn,
     StructColumn,
 )
-from spark_rapids_jni_tpu.columnar.buckets import map_buckets
+from spark_rapids_jni_tpu.columnar.buckets import length_buckets, map_buckets
 from spark_rapids_jni_tpu.columnar.dtypes import DType, Kind
 
 DEFAULT_XXHASH64_SEED = 42  # hash.cuh:29
@@ -381,10 +382,6 @@ def _hash_list(col: ListColumn, h, *, mm: bool):
     Rows are bucketed by leaf-span length (powers of two) so one long list
     doesn't pad the whole column's walk.
     """
-    import numpy as np
-
-    from spark_rapids_jni_tpu.columnar.buckets import length_buckets
-
     # descend nested lists: leaf span per row by offset composition
     starts = col.offsets[:-1]
     ends = col.offsets[1:]

@@ -1,0 +1,581 @@
+"""Vectorized Spark-JSON tokenizer: byte rectangles -> validated token streams.
+
+The shared front half of ``get_json_object`` and ``from_json``.  The reference
+parses per row with a sequential pushdown parser
+(/root/reference/src/main/cpp/src/json_parser.cuh:220, one GPU thread per
+row); on TPU that serializes, so tokenization is re-architected as dense
+whole-rectangle passes over a length bucket's ``[rows, width]`` byte matrix:
+
+1. **String-context automaton** — 5 states (outside / in-double-quote /
+   dq-escape / in-single-quote / sq-escape) composed over the byte axis with
+   ``lax.associative_scan`` over transition *functions* (state maps composed
+   by gather), giving every byte its string context in O(log width) passes.
+2. **Number DFA** — the grammar of ``json_parser.cuh`` ``try_parse_number``
+   (leading-zero rejection, ``.`` needs digits both sides, exponent needs
+   digits; a valid prefix followed by junk splits into value + junk token,
+   which reproduces the root-level trailing-garbage tolerance of
+   json_parser.cuh:1250-1254) — also a composed-function scan, with resets
+   at token starts.
+3. **Token compaction** — token-start bytes get ranks by row cumsum and
+   scatter into dense ``[rows, T]`` token arrays.
+4. **Grammar scan** — one ``lax.scan`` over token steps, all rows in
+   lockstep: enforces the object/array separator grammar of
+   ``json_parser.cuh`` ``next_token``, bounds nesting at
+   ``MAX_DEPTH=64`` (json_parser.cuh:46), records FIELD_NAME context,
+   matches open/close pairs (the evaluator's O(1) skip_children), and finds
+   the root-value end so trailing garbage is ignored.
+
+Spark quirks preserved (same set as tests/json_oracle.py): single-quoted
+strings, raw control chars legal inside strings, ``\\uXXXX`` must be 4 hex
+digits, numbers reject leading zeros and bare ``.5``/``5.``, at most
+MAX_NUM_LEN digits, root-level trailing garbage after a complete value is
+ignored.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["TokenStream", "tokenize", "MAX_DEPTH", "MAX_NUM_LEN"]
+
+MAX_DEPTH = 64  # json_parser.cuh:46 max_json_nesting_depth
+MAX_NUM_LEN = 1000  # json_parser.cuh max_num_len
+
+# token kinds (aligned with tests/json_oracle.py)
+ERRORTOK = 1
+START_OBJECT, END_OBJECT, START_ARRAY, END_ARRAY = 3, 4, 5, 6
+FIELD_NAME, VALUE_STRING = 7, 8
+VALUE_NUMBER_INT, VALUE_NUMBER_FLOAT = 9, 10
+VALUE_TRUE, VALUE_FALSE, VALUE_NULL = 11, 12, 13
+COMMA, COLON = 14, 15  # internal: validated then dropped
+PAD = 0
+
+_I32 = jnp.int32
+_I8 = jnp.int8
+_U8 = jnp.uint8
+
+# string automaton states
+_S_OUT, _S_DQ, _S_DQE, _S_SQ, _S_SQE = 0, 1, 2, 3, 4
+
+# number DFA states
+_N_IDLE, _N_NEG, _N_ZERO, _N_INT, _N_DOT, _N_FRAC = 0, 1, 2, 3, 4, 5
+_N_EXP, _N_EXPS, _N_EXPD, _N_DONE, _N_ERR = 6, 7, 8, 9, 10
+
+# grammar expect states
+_E_VALUE = 0
+_E_FIELD_OR_CLOSE = 1
+_E_COLON = 2
+_E_COMMA_OR_CLOSE_OBJ = 3
+_E_FIELD = 4
+_E_COMMA_OR_CLOSE_ARR = 5
+_E_VALUE_OR_CLOSE = 6
+
+
+@dataclasses.dataclass
+class TokenStream:
+    """Validated, separator-free token stream for one length bucket.
+
+    ``kind[r, t]`` is PAD beyond ``n_tokens[r]``.  ``start``/``end`` are byte
+    spans into the bucket's byte matrix (strings include their quotes).
+    ``match[r, t]`` is the index of the matching close for START_* tokens
+    (self otherwise).  ``ok[r]`` is False for malformed rows (entire row ->
+    NULL downstream).
+    """
+
+    kind: jnp.ndarray  # uint8 [n, T]
+    start: jnp.ndarray  # int32 [n, T]
+    end: jnp.ndarray  # int32 [n, T]
+    match: jnp.ndarray  # int32 [n, T]
+    n_tokens: jnp.ndarray  # int32 [n]
+    ok: jnp.ndarray  # bool [n]
+    trailing: jnp.ndarray  # bool [n]: tokens existed after the root value
+
+
+def _pow2_at_least(x: int) -> int:
+    return 1 << max(int(x) - 1, 0).bit_length() if x > 1 else 1
+
+
+def _compose_scan(maps: jnp.ndarray) -> jnp.ndarray:
+    """Inclusive prefix composition of per-byte state maps along axis 1.
+
+    ``maps[r, i, s]`` = next state from ``s`` on byte i.  Returns
+    ``state_after[r, i]`` starting from state 0.
+    """
+
+    def comb(a, b):  # apply a, then b
+        return jnp.take_along_axis(b, a.astype(_I32), axis=-1).astype(_I8)
+
+    pref = jax.lax.associative_scan(comb, maps, axis=1)
+    return pref[..., 0].astype(_I32)
+
+
+def _next_pos(mask: jnp.ndarray, big: int) -> jnp.ndarray:
+    """For each i: smallest j >= i with mask[j], else ``big`` (per row)."""
+    L = mask.shape[1]
+    pos = jnp.arange(L, dtype=_I32)[None, :]
+    cand = jnp.where(mask, pos, _I32(big))
+    return jax.lax.cummin(cand, axis=1, reverse=True)
+
+
+def _string_automaton(b, in_row):
+    """state_after[r, i] of the 5-state string-context machine."""
+    n, L = b.shape
+    is_dq = b == ord('"')
+    is_sq = b == ord("'")
+    is_bs = b == ord("\\")
+
+    maps = jnp.empty((n, L, 5), dtype=_I8)
+    frm_out = jnp.where(is_dq, _S_DQ, jnp.where(is_sq, _S_SQ, _S_OUT))
+    frm_dq = jnp.where(is_bs, _S_DQE, jnp.where(is_dq, _S_OUT, _S_DQ))
+    frm_sq = jnp.where(is_bs, _S_SQE, jnp.where(is_sq, _S_OUT, _S_SQ))
+    maps = maps.at[..., _S_OUT].set(frm_out.astype(_I8))
+    maps = maps.at[..., _S_DQ].set(frm_dq.astype(_I8))
+    maps = maps.at[..., _S_DQE].set(_I8(_S_DQ))
+    maps = maps.at[..., _S_SQ].set(frm_sq.astype(_I8))
+    maps = maps.at[..., _S_SQE].set(_I8(_S_SQ))
+    ident = jnp.broadcast_to(jnp.arange(5, dtype=_I8), (n, L, 5))
+    maps = jnp.where(in_row[..., None], maps, ident)
+    return _compose_scan(maps)
+
+
+def _number_dfa(b, run_start, in_num_run):
+    """state_after of the number grammar DFA, reset at each run start."""
+    n, L = b.shape
+    is_d0 = b == ord("0")
+    is_d19 = (b >= ord("1")) & (b <= ord("9"))
+    is_dig = is_d0 | is_d19
+    is_minus = b == ord("-")
+    is_plus = b == ord("+")
+    is_dot = b == ord(".")
+    is_e = (b == ord("e")) | (b == ord("E"))
+
+    def mk(*pairs):
+        """byte-class -> state selector, default ERR."""
+        out = jnp.full(b.shape, _N_ERR, dtype=_I8)
+        for cls, st in reversed(pairs):
+            out = jnp.where(cls, _I8(st), out)
+        return out
+
+    # transition rows (what each current state maps to on this byte)
+    t = {}
+    t[_N_IDLE] = jnp.full(b.shape, _N_IDLE, dtype=_I8)
+    t[_N_NEG] = mk((is_d0, _N_ZERO), (is_d19, _N_INT))
+    t[_N_ZERO] = mk(
+        (is_dot, _N_DOT), (is_e, _N_EXP),
+        (is_dig, _N_ERR), (~is_dig, _N_DONE),
+    )
+    t[_N_INT] = mk(
+        (is_dig, _N_INT), (is_dot, _N_DOT), (is_e, _N_EXP),
+        (~is_dig, _N_DONE),
+    )
+    t[_N_DOT] = mk((is_dig, _N_FRAC))
+    t[_N_FRAC] = mk((is_dig, _N_FRAC), (is_e, _N_EXP), (~is_dig, _N_DONE))
+    t[_N_EXP] = mk((is_dig, _N_EXPD), (is_minus | is_plus, _N_EXPS))
+    t[_N_EXPS] = mk((is_dig, _N_EXPD))
+    t[_N_EXPD] = mk((is_dig, _N_EXPD), (~is_dig, _N_DONE))
+    t[_N_DONE] = jnp.full(b.shape, _N_DONE, dtype=_I8)
+    t[_N_ERR] = jnp.full(b.shape, _N_ERR, dtype=_I8)
+
+    maps = jnp.stack([t[s] for s in range(11)], axis=-1)
+
+    # at a run start, the map is constant: state after the FIRST char from S0
+    first = mk((is_minus, _N_NEG), (is_d0, _N_ZERO), (is_d19, _N_INT))
+    maps = jnp.where(run_start[..., None], first[..., None], maps)
+    # outside number runs: identity (state parks until next run)
+    ident = jnp.broadcast_to(jnp.arange(11, dtype=_I8), (n, L, 11))
+    maps = jnp.where((in_num_run | run_start)[..., None], maps, ident)
+    return _compose_scan(maps)
+
+
+_ESC_OK = np.zeros(256, dtype=bool)
+for _c in b"\"'\\/bfnrtu":
+    _ESC_OK[_c] = True
+
+
+def _is_hex(b):
+    return (
+        ((b >= ord("0")) & (b <= ord("9")))
+        | ((b >= ord("a")) & (b <= ord("f")))
+        | ((b >= ord("A")) & (b <= ord("F")))
+    )
+
+
+def tokenize(bytes_mat: jnp.ndarray, lens: jnp.ndarray) -> TokenStream:
+    """Tokenize one bucket's ``[n, L]`` byte matrix into a TokenStream."""
+    n, L = bytes_mat.shape
+    b = bytes_mat
+    lens = lens.astype(_I32)
+    pos = jnp.arange(L, dtype=_I32)[None, :]
+    in_row = pos < lens[:, None]
+    BIG = L + 1
+
+    # ---- phase 1: string context ----------------------------------------
+    st_after = _string_automaton(b, in_row)
+    st_before = jnp.pad(st_after, ((0, 0), (1, 0)))[:, :L]
+
+    is_open_q = (st_before == _S_OUT) & ((b == ord('"')) | (b == ord("'"))) & in_row
+    is_close_q = (
+        ((st_before == _S_DQ) & (b == ord('"')))
+        | ((st_before == _S_SQ) & (b == ord("'")))
+    ) & in_row
+    outside = (st_before == _S_OUT) & ~is_open_q & in_row
+    escaped_char = ((st_before == _S_DQE) | (st_before == _S_SQE)) & in_row
+
+    # escape validity: escaped char must be legal; \\u needs 4 in-row hex
+    esc_ok_lut = jnp.asarray(_ESC_OK)
+    bad_esc = escaped_char & ~esc_ok_lut[b.astype(_I32)]
+    is_u = escaped_char & (b == ord("u"))
+    hex_ok = _is_hex(b) & in_row
+    u_ok = jnp.ones((n, L), dtype=bool)
+    for k in range(1, 5):
+        shifted = jnp.pad(hex_ok, ((0, 0), (0, k)))[:, k : L + k]
+        u_ok = u_ok & shifted
+    bad_esc = bad_esc | (is_u & ~u_ok)
+    next_bad_esc = _next_pos(bad_esc, BIG)
+    next_close = _next_pos(is_close_q, BIG)
+
+    # ---- phase 2: structural & runs -------------------------------------
+    is_ws = ((b == 0x20) | (b == 0x09) | (b == 0x0A) | (b == 0x0D)) & in_row
+    is_struct = (
+        (b == ord("{")) | (b == ord("}")) | (b == ord("["))
+        | (b == ord("]")) | (b == ord(",")) | (b == ord(":"))
+    ) & outside
+    run_byte = outside & ~is_ws & ~is_struct
+    prev_run = jnp.pad(run_byte, ((0, 0), (1, 0)))[:, :L]
+    run_start = run_byte & ~prev_run
+    next_nonrun = _next_pos(~run_byte, BIG)  # first i >= here not in a run
+
+    # ---- phase 3: number DFA + literals ---------------------------------
+    nstate = _number_dfa(b, run_start, run_byte)
+    nstate_prev = jnp.pad(nstate, ((0, 0), (1, 0)))[:, :L]
+    done_entry = (nstate == _N_DONE) & (nstate_prev != _N_DONE) & run_byte
+
+    def match_word(word):
+        ok = jnp.ones((n, L), dtype=bool)
+        for k, ch in enumerate(word):
+            shifted = jnp.pad(b, ((0, 0), (0, k)), constant_values=0)[:, k : L + k]
+            ok = ok & (shifted == ch) & jnp.pad(
+                in_row, ((0, 0), (0, k))
+            )[:, k : L + k]
+        return ok
+
+    true_at = match_word(b"true")
+    false_at = match_word(b"false")
+    null_at = match_word(b"null")
+
+    def shift_right(mask, k):
+        return jnp.pad(mask, ((0, 0), (k, 0)))[:, :L]
+
+    lit_junk = (
+        (shift_right(run_start & true_at, 4) | shift_right(run_start & null_at, 4))
+        | shift_right(run_start & false_at, 5)
+    ) & run_byte
+
+    token_start = is_struct | is_open_q | run_start | done_entry | lit_junk
+
+    # ---- phase 4: per-start kind/end ------------------------------------
+    first_c = b
+    is_digit_start = (first_c == ord("-")) | (
+        (first_c >= ord("0")) & (first_c <= ord("9"))
+    )
+    # number value end: first DONE entry or run end
+    next_done = _next_pos(done_entry, BIG)
+    run_end = next_nonrun
+    num_value_end = jnp.minimum(next_done, run_end)
+    # number final state: state at value_end - 1
+    vend_idx = jnp.clip(num_value_end - 1, 0, L - 1)
+    num_final = jnp.take_along_axis(nstate, vend_idx, axis=1)
+    num_valid = (
+        (num_final == _N_ZERO) | (num_final == _N_INT)
+        | (num_final == _N_FRAC) | (num_final == _N_EXPD)
+        | (num_final == _N_DONE)
+    )
+    # digit count <= MAX_NUM_LEN over the value span
+    is_digit_b = (b >= ord("0")) & (b <= ord("9"))
+    dcum = jnp.cumsum((is_digit_b & in_row).astype(_I32), axis=1)
+    dcum_at = lambda idx: jnp.take_along_axis(  # noqa: E731
+        jnp.pad(dcum, ((0, 0), (1, 0))), jnp.clip(idx, 0, L), axis=1
+    )
+    ndigits = dcum_at(num_value_end) - dcum_at(pos)
+    num_valid = num_valid & (ndigits <= MAX_NUM_LEN)
+    # float if '.' or e/E inside the value span
+    dot_e = ((b == ord(".")) | (b == ord("e")) | (b == ord("E"))) & in_row
+    decum = jnp.cumsum(dot_e.astype(_I32), axis=1)
+    decum_at = lambda idx: jnp.take_along_axis(  # noqa: E731
+        jnp.pad(decum, ((0, 0), (1, 0))), jnp.clip(idx, 0, L), axis=1
+    )
+    num_is_float = (decum_at(num_value_end) - decum_at(pos)) > 0
+
+    # string token: end & validity
+    str_close = next_close  # first close at/after the open (open isn't one)
+    str_end = str_close + 1
+    str_bad = (str_close >= BIG - 1) | (next_bad_esc < str_close)
+
+    struct_kind = jnp.where(
+        b == ord("{"), START_OBJECT,
+        jnp.where(
+            b == ord("}"), END_OBJECT,
+            jnp.where(
+                b == ord("["), START_ARRAY,
+                jnp.where(
+                    b == ord("]"), END_ARRAY,
+                    jnp.where(b == ord(","), COMMA, COLON),
+                ),
+            ),
+        ),
+    )
+
+    lit_kind = jnp.where(
+        true_at, VALUE_TRUE, jnp.where(false_at, VALUE_FALSE, VALUE_NULL)
+    )
+    lit_match = true_at | false_at | null_at
+    lit_len = jnp.where(false_at, 5, 4)
+
+    num_kind = jnp.where(
+        num_valid,
+        jnp.where(num_is_float, VALUE_NUMBER_FLOAT, VALUE_NUMBER_INT),
+        ERRORTOK,
+    )
+
+    kind_b = jnp.where(
+        is_struct,
+        struct_kind,
+        jnp.where(
+            is_open_q,
+            jnp.where(str_bad, ERRORTOK, VALUE_STRING),
+            jnp.where(
+                done_entry | lit_junk,
+                ERRORTOK,
+                jnp.where(
+                    is_digit_start,
+                    num_kind,
+                    jnp.where(lit_match, lit_kind, ERRORTOK),
+                ),
+            ),
+        ),
+    )
+    end_b = jnp.where(
+        is_struct,
+        pos + 1,
+        jnp.where(
+            is_open_q,
+            str_end,
+            jnp.where(
+                done_entry | lit_junk,
+                run_end,
+                jnp.where(
+                    is_digit_start,
+                    num_value_end,
+                    jnp.where(lit_match, pos + lit_len, run_end),
+                ),
+            ),
+        ),
+    )
+
+    # ---- phase 5: compaction --------------------------------------------
+    rank = jnp.cumsum(token_start.astype(_I32), axis=1) - 1
+    counts = jnp.sum(token_start.astype(_I32), axis=1)
+    # pow2 token capacity keeps the compiled-variant set bounded, matching
+    # the row/width bucketing discipline (columnar/buckets.py)
+    T = _pow2_at_least(int(jnp.max(counts)) if n else 0)
+
+    rows2d = jnp.broadcast_to(jnp.arange(n, dtype=_I32)[:, None], (n, L))
+    tgt_row = jnp.where(token_start, rows2d, n)
+    tgt_tok = jnp.where(token_start, jnp.minimum(rank, T - 1), 0)
+
+    def compact(vals, fill):
+        out = jnp.full((n + 1, T), fill, dtype=vals.dtype)
+        out = out.at[tgt_row, tgt_tok].set(
+            jnp.where(token_start, vals, fill), mode="drop"
+        )
+        return out[:n]
+
+    tok_kind = compact(kind_b.astype(_U8), _U8(PAD))
+    tok_start = compact(pos + jnp.zeros_like(rank), _I32(0))
+    tok_end = compact(end_b.astype(_I32), _I32(0))
+
+    # ---- phase 6: grammar scan ------------------------------------------
+    return _grammar_scan(tok_kind, tok_start, tok_end, counts)
+
+
+def _grammar_scan(kind, start, end, counts):
+    """Lockstep grammar validation + match computation + separator drop."""
+    n, T = kind.shape
+
+    def step(carry, t):
+        depth, ctx, open_stack, expect, err, done = carry
+        k = kind[:, t].astype(_I32)
+        active = ~done & ~err & (t < counts)
+
+        is_scalar = (
+            (k == VALUE_STRING) | (k == VALUE_NUMBER_INT)
+            | (k == VALUE_NUMBER_FLOAT) | (k == VALUE_TRUE)
+            | (k == VALUE_FALSE) | (k == VALUE_NULL)
+        )
+        is_open_obj = k == START_OBJECT
+        is_open_arr = k == START_ARRAY
+        is_close_obj = k == END_OBJECT
+        is_close_arr = k == END_ARRAY
+        is_comma = k == COMMA
+        is_colon = k == COLON
+
+        exp_value = (expect == _E_VALUE) | (expect == _E_VALUE_OR_CLOSE)
+
+        # legal moves
+        take_scalar = exp_value & is_scalar
+        take_open = exp_value & (is_open_obj | is_open_arr)
+        take_field = (
+            ((expect == _E_FIELD_OR_CLOSE) | (expect == _E_FIELD))
+            & (k == VALUE_STRING)
+        )
+        take_colon = (expect == _E_COLON) & is_colon
+        take_comma_obj = (expect == _E_COMMA_OR_CLOSE_OBJ) & is_comma
+        take_comma_arr = (expect == _E_COMMA_OR_CLOSE_ARR) & is_comma
+        take_close_obj = (
+            ((expect == _E_FIELD_OR_CLOSE) | (expect == _E_COMMA_OR_CLOSE_OBJ))
+            & is_close_obj
+        )
+        take_close_arr = (
+            ((expect == _E_VALUE_OR_CLOSE) | (expect == _E_COMMA_OR_CLOSE_ARR))
+            & is_close_arr
+        )
+        take_close = take_close_obj | take_close_arr
+        legal = (
+            take_scalar | take_open | take_field | take_colon
+            | take_comma_obj | take_comma_arr | take_close
+        )
+        overflow = take_open & (depth >= MAX_DEPTH)
+        new_err = err | (active & (~legal | overflow))
+        do = active & legal & ~overflow
+
+        # stack ops
+        push = do & take_open
+        pop = do & take_close
+        depth2 = depth + push.astype(_I32) - pop.astype(_I32)
+        sel = jnp.clip(depth, 0, MAX_DEPTH - 1)
+        ctx2 = jnp.where(
+            push[:, None]
+            & (jnp.arange(MAX_DEPTH, dtype=_I32)[None, :] == sel[:, None]),
+            is_open_obj[:, None],
+            ctx,
+        )
+        open_stack2 = jnp.where(
+            push[:, None]
+            & (jnp.arange(MAX_DEPTH, dtype=_I32)[None, :] == sel[:, None]),
+            _I32(t),
+            open_stack,
+        )
+        # matching open for a close: top of stack
+        sel_pop = jnp.clip(depth2, 0, MAX_DEPTH - 1)
+        popped_open = jnp.take_along_axis(open_stack, sel_pop[:, None], axis=1)[:, 0]
+        close_rec = jnp.where(pop, popped_open, _I32(-1))
+        # close type must match container
+        popped_is_obj = jnp.take_along_axis(ctx, sel_pop[:, None], axis=1)[:, 0]
+        mismatch = pop & (popped_is_obj != is_close_obj)
+        new_err = new_err | mismatch
+        do = do & ~mismatch
+        pop = pop & ~mismatch
+        depth2 = jnp.where(mismatch, depth, depth2)
+
+        # value completion (scalar or close) -> what next
+        completed = do & (take_scalar | pop)
+        at_root = completed & (depth2 == 0)
+        done2 = done | at_root
+        # parent context for non-root completion
+        parent_sel = jnp.clip(depth2 - 1, 0, MAX_DEPTH - 1)
+        parent_obj = jnp.take_along_axis(ctx2, parent_sel[:, None], axis=1)[:, 0]
+        after_value = jnp.where(
+            parent_obj, _E_COMMA_OR_CLOSE_OBJ, _E_COMMA_OR_CLOSE_ARR
+        )
+
+        expect2 = jnp.where(
+            completed & ~at_root, after_value,
+            jnp.where(
+                do & take_open & is_open_obj, _E_FIELD_OR_CLOSE,
+                jnp.where(
+                    do & take_open & is_open_arr, _E_VALUE_OR_CLOSE,
+                    jnp.where(
+                        do & take_field, _E_COLON,
+                        jnp.where(
+                            do & take_colon, _E_VALUE,
+                            jnp.where(
+                                do & take_comma_obj, _E_FIELD,
+                                jnp.where(
+                                    do & take_comma_arr, _E_VALUE, expect
+                                ),
+                            ),
+                        ),
+                    ),
+                ),
+            ),
+        )
+        is_field_tok = do & take_field
+
+        ys = (is_field_tok, close_rec, done)
+        return (depth2, ctx2, open_stack2, expect2, new_err, done2), ys
+
+    init = (
+        jnp.zeros((n,), _I32),
+        jnp.zeros((n, MAX_DEPTH), dtype=bool),
+        jnp.zeros((n, MAX_DEPTH), _I32),
+        jnp.full((n,), _E_VALUE, dtype=_I32),
+        jnp.zeros((n,), dtype=bool),
+        jnp.zeros((n,), dtype=bool),
+    )
+    (depth, ctx, open_stack, expect, err, done), (
+        is_field, close_rec, done_before
+    ) = jax.lax.scan(step, init, jnp.arange(T))
+
+    is_field = is_field.T  # [n, T]
+    close_rec = close_rec.T
+    done_before = done_before.T  # done flag BEFORE processing token t
+
+    ok = done & ~err  # err can only be set while not done
+
+    # reclassify FIELD_NAMEs
+    kind = jnp.where(is_field, _U8(FIELD_NAME), kind)
+    # match indices: match[open] = close step, match[close] = open, else self
+    tok_idx = jnp.broadcast_to(jnp.arange(T, dtype=_I32)[None, :], (n, T))
+    match = tok_idx
+    rows2d = jnp.broadcast_to(jnp.arange(n, dtype=_I32)[:, None], (n, T))
+    has_close = close_rec >= 0
+    match = match.at[
+        jnp.where(has_close, rows2d, n), jnp.where(has_close, close_rec, 0)
+    ].set(tok_idx, mode="drop")
+    match = jnp.where(has_close, close_rec, match)
+
+    # keep only value/structure tokens up to the root end
+    keep = (
+        ~done_before
+        & (kind != _U8(COMMA))
+        & (kind != _U8(COLON))
+        & (kind != _U8(PAD))
+        & (tok_idx < counts[:, None])
+    )
+    new_idx = jnp.cumsum(keep.astype(_I32), axis=1) - 1
+    n_tokens = jnp.sum(keep.astype(_I32), axis=1)
+    T2 = _pow2_at_least(int(jnp.max(n_tokens)) if n else 0)
+
+    def compact(vals, fill):
+        out = jnp.full((n + 1, T2), fill, dtype=vals.dtype)
+        out = out.at[
+            jnp.where(keep, rows2d, n), jnp.where(keep, jnp.minimum(new_idx, T2 - 1), 0)
+        ].set(jnp.where(keep, vals, fill), mode="drop")
+        return out[:n]
+
+    kind2 = compact(kind, _U8(PAD))
+    start2 = compact(start, _I32(0))
+    end2 = compact(end, _I32(0))
+    # remap match through new indices (clip: matches of dropped tokens unused)
+    match_new = jnp.take_along_axis(new_idx, jnp.clip(match, 0, T - 1), axis=1)
+    match2 = compact(match_new, _I32(0))
+
+    trailing = jnp.any(done_before & (tok_idx < counts[:, None]), axis=1)
+    return TokenStream(
+        kind=kind2, start=start2, end=end2, match=match2,
+        n_tokens=n_tokens, ok=ok, trailing=trailing,
+    )

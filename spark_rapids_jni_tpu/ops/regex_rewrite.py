@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from spark_rapids_jni_tpu.columnar.buckets import map_buckets
 from spark_rapids_jni_tpu.columnar.column import Column, StringColumn
 from spark_rapids_jni_tpu.columnar.dtypes import BOOL
 
@@ -25,8 +26,6 @@ def literal_range_pattern(
 ) -> Column:
     """Does each row match ``prefix`` + ``range_len`` chars in [start, end]?"""
     from spark_rapids_jni_tpu.utils.utf8 import decode_utf8
-
-    from spark_rapids_jni_tpu.columnar.buckets import map_buckets
 
     pat = [ord(c) for c in prefix]
     m = len(pat)

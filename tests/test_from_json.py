@@ -1,0 +1,139 @@
+"""from_json (map_utils) tests.
+
+Fixed cases mirror the reference JUnit suite
+(/root/reference/src/test/java/com/nvidia/spark/rapids/jni/MapUtilsTest.java).
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import columnar as c
+from spark_rapids_jni_tpu.ops.from_json import JsonParsingException, from_json
+
+
+def materialize(lst):
+    """-> list of (None | [(key, value), ...]) per row."""
+    offs = np.asarray(lst.offsets)
+    keys = lst.child.children[0].to_list()
+    vals = lst.child.children[1].to_list()
+    valid = np.asarray(lst.is_valid())
+    out = []
+    for i in range(lst.size):
+        if not valid[i]:
+            out.append(None)
+            continue
+        out.append(
+            [(keys[k], vals[k]) for k in range(offs[i], offs[i + 1])]
+        )
+    return out
+
+
+def test_extract_raw_map_basic():
+    # MapUtilsTest.java testExtractRawMapFromJsonString
+    s1 = (
+        '{"Zipcode" : 704 , "ZipCodeType" : "STANDARD" , "City" : "PARC'
+        ' PARQUE" , "State" : "PR"}'
+    )
+    s3 = (
+        '{"category": "reference", "index": [4,{},null,{"a":[{ }, {}] } '
+        '], "author": "Nigel Rees", "title": "{}[], '
+        '<=semantic-symbols-string", "price": 8.95}'
+    )
+    col = c.strings_column([s1, "{}", None, s3])
+    got = materialize(from_json(col))
+    assert got[0] == [
+        ("Zipcode", "704"),
+        ("ZipCodeType", "STANDARD"),
+        ("City", "PARC PARQUE"),
+        ("State", "PR"),
+    ]
+    assert got[1] == []
+    assert got[2] is None
+    assert got[3] == [
+        ("category", "reference"),
+        ("index", '[4,{},null,{"a":[{ }, {}] } ]'),
+        ("author", "Nigel Rees"),
+        ("title", "{}[], <=semantic-symbols-string"),
+        ("price", "8.95"),
+    ]
+
+
+def test_extract_raw_map_utf8():
+    s1 = (
+        '{"Zipcóde" : 704 , "ZípCodeTypé" : "STANDARD" ,'
+        ' "City" : "PARC PARQUE" , "Stâte" : "PR"}'
+    )
+    s3 = (
+        '{"Zipcóde" : 704 , "ZípCodeTypé" : '
+        '"\U00029E3D" , "City" : "\U0001F3F3" , "Stâte" : '
+        '"\U0001F3F3"}'
+    )
+    col = c.strings_column([s1, "{}", None, s3])
+    got = materialize(from_json(col))
+    assert got[0] == [
+        ("Zipcóde", "704"),
+        ("ZípCodeTypé", "STANDARD"),
+        ("City", "PARC PARQUE"),
+        ("Stâte", "PR"),
+    ]
+    assert got[3] == [
+        ("Zipcóde", "704"),
+        ("ZípCodeTypé", "\U00029E3D"),
+        ("City", "\U0001F3F3"),
+        ("Stâte", "\U0001F3F3"),
+    ]
+
+
+def test_nested_keys_not_extracted():
+    col = c.strings_column(['{"a":{"x":1,"y":2},"b":[{"z":3}],"c":7}'])
+    got = materialize(from_json(col))
+    assert got[0] == [
+        ("a", '{"x":1,"y":2}'),
+        ("b", '[{"z":3}]'),
+        ("c", "7"),
+    ]
+
+
+def test_non_object_rows_give_empty_lists():
+    col = c.strings_column(["[1,2,3]", '"str"', "42", "true", "{}"])
+    got = materialize(from_json(col))
+    assert got == [[], [], [], [], []]
+
+
+def test_escapes_stay_raw():
+    col = c.strings_column(['{"k\\t1":"v\\n2"}'])
+    got = materialize(from_json(col))
+    assert got[0] == [("k\\t1", "v\\n2")]
+
+
+def test_invalid_row_raises():
+    col = c.strings_column(['{"a":1}', "{bad"])
+    with pytest.raises(JsonParsingException, match="row 1"):
+        from_json(col)
+
+
+def test_trailing_garbage_raises():
+    col = c.strings_column(['{"a":1} xyz'])
+    with pytest.raises(JsonParsingException):
+        from_json(col)
+
+
+def test_null_rows_skip_validation():
+    col = c.strings_column([None, '{"a":1}'])
+    got = materialize(from_json(col))
+    assert got == [None, [("a", "1")]]
+
+
+def test_skewed_row_lengths():
+    big = '{"k":"' + "x" * 3000 + '"}'
+    col = c.strings_column(['{"a":1}', big, "{}"])
+    got = materialize(from_json(col))
+    assert got[0] == [("a", "1")]
+    assert got[1] == [("k", "x" * 3000)]
+    assert got[2] == []
+
+
+def test_empty_column():
+    col = c.strings_column([])
+    lst = from_json(col)
+    assert lst.size == 0

@@ -1,0 +1,200 @@
+"""Tokenizer agreement tests against the sequential oracle parser.
+
+The oracle (tests/json_oracle.py, a transliteration of json_parser.cuh) is
+driven token-by-token; the vectorized tokenizer must produce the identical
+(kind, start, end) sequence for every valid row and the same valid/invalid
+verdict for every row.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import json_oracle as jo
+from spark_rapids_jni_tpu import columnar as c
+from spark_rapids_jni_tpu.columnar.buckets import padded_buckets
+from spark_rapids_jni_tpu.ops import json_tokenizer as jt
+
+
+def oracle_tokens(data: bytes):
+    """(tokens, ok): walk the oracle parser over the whole root value."""
+    p = jo._Parser(data)
+    toks = []
+    while True:
+        t = p.next_token()
+        if t == jo.SUCCESS:
+            return toks, True
+        if t == jo.ERRORTOK:
+            return toks, False
+        toks.append((t, p.span()[0], p.span()[1]))
+
+
+def run_tokenizer(strings):
+    """Tokenize a list of byte strings; returns per-row (tokens, ok)."""
+    col = c.strings_from_bytes(strings)
+    out = [None] * len(strings)
+    for b in padded_buckets(col):
+        ts = jt.tokenize(b.bytes, b.lengths)
+        kind = np.asarray(ts.kind)
+        start = np.asarray(ts.start)
+        end = np.asarray(ts.end)
+        ntok = np.asarray(ts.n_tokens)
+        ok = np.asarray(ts.ok)
+        for i, r in enumerate(np.asarray(b.rows)[: b.n_valid]):
+            toks = [
+                (int(kind[i, t]), int(start[i, t]), int(end[i, t]))
+                for t in range(ntok[i])
+            ]
+            out[r] = (toks, bool(ok[i]))
+    return out
+
+
+CORPUS = [
+    b"{}",
+    b"[]",
+    b"1",
+    b"-0",
+    b"0",
+    b"01",
+    b"-",
+    b"1.",
+    b".5",
+    b"1.5",
+    b"1e3",
+    b"1e",
+    b"1e+",
+    b"1e+5",
+    b"123abc",
+    b"truex",
+    b"true",
+    b"false",
+    b"null",
+    b"nul",
+    b'"abc"',
+    b"'abc'",
+    b'"a\'b"',
+    b"'a\"b'",
+    b'"unterminated',
+    b'"bad\\x"',
+    b'"ok\\u0041"',
+    b'"bad\\u00g1"',
+    b'"bad\\u12"',
+    b'{"a":1}',
+    b'{"a":1,"b":[2,3]}',
+    b'{"a" :  1 }',
+    b'{"a":1 "b":2}',
+    b'{"a":}',
+    b'{,}',
+    b"[1,]",
+    b"[,1]",
+    b"[1 2]",
+    b"[1,2] garbage",
+    b'{"a":1} []',
+    b"[[[]]]",
+    b'{"a":{"b":{"c":[1,2,{"d":null}]}}}',
+    b"[" * 65,  # depth overflow
+    b"[" * 63 + b"]" * 63,
+    b'{"\\u0041":1}',
+    b'["\\t\\n\\\\"]',
+    b"  [1]  ",
+    b"",
+    b"   ",
+    b"{\x01}",  # raw ctrl outside string -> run -> error
+    b'"\x01\x02"',  # raw ctrl inside string: legal
+    b"[true,false,null]",
+    b"[1.25e-3,-2E+10]",
+    b'["a","b"]',
+    b"{'a':'b'}",
+    b"[0.0,-0.0,-0]",
+    b"9" * 1200,  # > MAX_NUM_LEN digits
+    b"[" + b"9" * 999 + b"]",
+]
+
+
+def test_tokenizer_corpus_matches_oracle():
+    got = run_tokenizer(CORPUS)
+    for s, (toks, ok) in zip(CORPUS, got):
+        otoks, ook = oracle_tokens(s)
+        assert ok == ook, f"{s!r}: ok={ok} oracle={ook} toks={toks} o={otoks}"
+        if ok:
+            assert toks == otoks, f"{s!r}:\n got {toks}\n exp {otoks}"
+
+
+def _rand_json(rng, depth=0):
+    r = rng.random()
+    if depth > 3 or r < 0.35:
+        return rng.choice(
+            [
+                "1",
+                "-17",
+                "3.5",
+                "1e4",
+                "-0.25",
+                "true",
+                "false",
+                "null",
+                '"s"',
+                '"a b\\tc"',
+                '"\\u00e9x"',
+                "'sq'",
+                '""',
+                "0",
+            ]
+        )
+    if r < 0.7:
+        items = ",".join(
+            _rand_json(rng, depth + 1) for _ in range(rng.randrange(0, 4))
+        )
+        return "[" + items + "]"
+    fields = ",".join(
+        f'"k{i}":' + _rand_json(rng, depth + 1) for i in range(rng.randrange(0, 4))
+    )
+    return "{" + fields + "}"
+
+
+def _mutate(rng, s: bytes) -> bytes:
+    if not s:
+        return s
+    i = rng.randrange(len(s))
+    op = rng.random()
+    if op < 0.4:
+        return s[:i] + bytes([rng.randrange(32, 127)]) + s[i + 1 :]
+    if op < 0.7:
+        return s[:i] + s[i + 1 :]
+    return s[:i] + bytes([rng.randrange(32, 127)]) + s[i:]
+
+
+def test_tokenizer_fuzz_matches_oracle():
+    rng = random.Random(42)
+    strs = []
+    for _ in range(300):
+        s = _rand_json(rng).encode()
+        strs.append(s)
+        strs.append(_mutate(rng, s))
+        strs.append(_mutate(rng, _mutate(rng, s)))
+    got = run_tokenizer(strs)
+    for s, (toks, ok) in zip(strs, got):
+        otoks, ook = oracle_tokens(s)
+        assert ok == ook, f"{s!r}: ok={ok} oracle={ook}\n got {toks}\n exp {otoks}"
+        if ok:
+            assert toks == otoks, f"{s!r}:\n got {toks}\n exp {otoks}"
+
+
+def test_tokenizer_match_indices():
+    got = run_tokenizer([b'{"a":[1,{"b":2},3],"c":{}}'])
+    toks, ok = got[0]
+    assert ok
+    col = c.strings_from_bytes([b'{"a":[1,{"b":2},3],"c":{}}'])
+    (b,) = padded_buckets(col)
+    ts = jt.tokenize(b.bytes, b.lengths)
+    kind = np.asarray(ts.kind)[0]
+    match = np.asarray(ts.match)[0]
+    n = int(np.asarray(ts.n_tokens)[0])
+    for t in range(n):
+        if kind[t] in (jt.START_OBJECT, jt.START_ARRAY):
+            m = match[t]
+            assert kind[m] in (jt.END_OBJECT, jt.END_ARRAY)
+            assert match[m] == t
+            # everything between is deeper
+            assert m > t
