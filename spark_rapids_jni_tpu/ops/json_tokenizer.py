@@ -35,6 +35,7 @@ ignored.
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -205,7 +206,22 @@ def _is_hex(b):
 
 
 def tokenize(bytes_mat: jnp.ndarray, lens: jnp.ndarray) -> TokenStream:
-    """Tokenize one bucket's ``[n, L]`` byte matrix into a TokenStream."""
+    """Tokenize one bucket's ``[n, L]`` byte matrix into a TokenStream.
+
+    Two jitted stages (cached per shape): a byte-analysis pass, then — after
+    one host sync for the max token count (rounded to a power of two so the
+    compiled-variant set stays bounded) — compaction + the grammar scan.
+    """
+    n, L = bytes_mat.shape
+    token_start, kind_b, end_b, counts = _scan_bytes(bytes_mat, lens)
+    T = _pow2_at_least(int(jnp.max(counts)) if n else 0)
+    res = _compact_and_grammar(token_start, kind_b, end_b, counts, T)
+    return TokenStream(*res)
+
+
+@jax.jit
+def _scan_bytes(bytes_mat: jnp.ndarray, lens: jnp.ndarray):
+    """Per-byte analysis: token starts, kinds, and end positions."""
     n, L = bytes_mat.shape
     b = bytes_mat
     lens = lens.astype(_I32)
@@ -376,12 +392,16 @@ def tokenize(bytes_mat: jnp.ndarray, lens: jnp.ndarray) -> TokenStream:
         ),
     )
 
-    # ---- phase 5: compaction --------------------------------------------
-    rank = jnp.cumsum(token_start.astype(_I32), axis=1) - 1
     counts = jnp.sum(token_start.astype(_I32), axis=1)
-    # pow2 token capacity keeps the compiled-variant set bounded, matching
-    # the row/width bucketing discipline (columnar/buckets.py)
-    T = _pow2_at_least(int(jnp.max(counts)) if n else 0)
+    return token_start, kind_b.astype(_I32), end_b.astype(_I32), counts
+
+
+@functools.partial(jax.jit, static_argnums=(4,))
+def _compact_and_grammar(token_start, kind_b, end_b, counts, T: int):
+    """Phase 5 compaction + phase 6 grammar scan (static token capacity)."""
+    n, L = token_start.shape
+    pos = jnp.arange(L, dtype=_I32)[None, :]
+    rank = jnp.cumsum(token_start.astype(_I32), axis=1) - 1
 
     rows2d = jnp.broadcast_to(jnp.arange(n, dtype=_I32)[:, None], (n, L))
     tgt_row = jnp.where(token_start, rows2d, n)
@@ -558,7 +578,7 @@ def _grammar_scan(kind, start, end, counts):
     )
     new_idx = jnp.cumsum(keep.astype(_I32), axis=1) - 1
     n_tokens = jnp.sum(keep.astype(_I32), axis=1)
-    T2 = _pow2_at_least(int(jnp.max(n_tokens)) if n else 0)
+    T2 = T  # static upper bound: keeps the whole pipeline inside one jit
 
     def compact(vals, fill):
         out = jnp.full((n + 1, T2), fill, dtype=vals.dtype)
@@ -575,7 +595,4 @@ def _grammar_scan(kind, start, end, counts):
     match2 = compact(match_new, _I32(0))
 
     trailing = jnp.any(done_before & (tok_idx < counts[:, None]), axis=1)
-    return TokenStream(
-        kind=kind2, start=start2, end=end2, match=match2,
-        n_tokens=n_tokens, ok=ok, trailing=trailing,
-    )
+    return kind2, start2, end2, match2, n_tokens, ok, trailing

@@ -1,0 +1,324 @@
+"""get_json_object tests: reference JUnit corpus + fuzz agreement with the
+sequential oracle.
+
+Corpus: /root/reference/src/test/java/com/nvidia/spark/rapids/jni/
+GetJsonObjectTest.java (615 LoC) — every case transcribed; expected values are
+the literal strings from the JUnit asserts.
+"""
+
+import os
+import random
+
+import pytest
+
+from spark_rapids_jni_tpu.columnar.column import strings_column
+from spark_rapids_jni_tpu.ops.get_json_object import (
+    INDEX,
+    NAMED,
+    WILDCARD,
+    get_json_object,
+    parse_path,
+)
+
+import json_oracle as jo
+
+
+def named(n):
+    return (NAMED, n.encode() if isinstance(n, str) else n)
+
+
+def idx(i):
+    return (INDEX, i)
+
+
+WC = (WILDCARD,)
+
+
+def run(rows, path):
+    col = strings_column(rows)
+    return get_json_object(col, path).to_list()
+
+
+# ---------------------------------------------------------------- corpus ---
+
+def test_named_simple():  # getJsonObjectTest
+    assert run(['{"k": "v"}'], [named("k")]) == ["v"]
+
+
+def test_long_names():  # getJsonObjectTest2
+    k = "k1_" + "1" * 96
+    v = "v1_" + "1" * 96
+    assert run(['{"%s":"%s"}' % (k, v)] * 7, [named(k)]) == [v] * 7
+
+
+def test_nested_named():  # getJsonObjectTest3
+    assert run(['{"k1":{"k2":"v2"}}'] * 7, [named("k1"), named("k2")]) == ["v2"] * 7
+
+
+def test_depth8_names():  # getJsonObjectTest4
+    json = '{"k1":{"k2":{"k3":{"k4":{"k5":{"k6":{"k7":{"k8":"v8"}}}}}}}}'
+    path = [named(f"k{i}") for i in range(1, 9)]
+    assert run([json] * 7, path) == ["v8"] * 7
+
+
+def test_baidu_unescape_backslash():  # getJsonObjectTest_Baidu_unescape_backslash
+    json = (
+        '{"brand":"ssssss","duratRon":15,"eqTosuresurl":"","RsZxarthrl":false,'
+        '"xonRtorsurl":"","xonRtorsurlstOTe":0,"TRctures":[{"RxaGe":'
+        r'"VttTs:\/\/feed-RxaGe.baRdu.cox\/0\/TRc\/-196588744s840172444s-773690137.zTG"}],'
+        r'"Toster":"VttTs:\/\/feed-RxaGe.baRdu.cox\/0\/TRc\/-196588744s840172444s-773690137.zTG",'
+        '"reserUed":{"bRtLate":391.79,"xooUZRke":26876,"nahrlIeneratRonNOTe":0,'
+        '"useJublRc":6,"URdeoRd":821284086},"tRtle":"ssssssssssmMsssssssssssssssssss",'
+        '"url":"s{storehrl}","usersTortraRt":'
+        r'"VttTs:\/\/feed-RxaGe.baRdu.cox\/0\/TRc\/-6971178959s-664926866s-6096674871.zTG",'
+        r'"URdeosurl":"http:\/\/nadURdeo2.baRdu.cox\/5fa3893aed7fc0f8231dab7be23efc75s820s6240.xT3",'
+        '"URdeoRd":821284086}'
+    )
+    expected = "http://nadURdeo2.baRdu.cox/5fa3893aed7fc0f8231dab7be23efc75s820s6240.xT3"
+    assert run([json] * 7, [named("URdeosurl")]) == [expected] * 7
+
+
+def test_baidu_unexist_field():  # getJsonObjectTest_Baidu_get_unexist_field_name
+    json = (
+        '{"brand":"ssssss","duratgzn":17,"eSyzsuresurl":"","gswUartWrl":false,'
+        '"Uzngtzrsurl":"","UzngtzrsurlstJye":0,"ygctures":[{"gUaqe":'
+        r'"Ittys:\/\/feed-gUaqe.bagdu.czU\/0\/ygc\/63025364s-376461312s7528698939.Qyq"}],'
+        r'"yzster":"Ittys:\/\/feed-gUaqe.bagdu.czU\,"url":"s{stHreqrl}",'
+        r'"usersPHrtraIt":"LttPs:\/\/feed-IUaxe.baIdu.cHU\/0\/PIc\/-1043913002s489796992s-1505641721.Pnx",'
+        r'"kIdeHsurl":"LttP:\/\/nadkIdeH9.baIdu.cHU\/4d7d308bd7c04e63069fd343adfa792as1790s1080.UP3",'
+        '"kIdeHId":852890923}'
+    )
+    assert run([json] * 7, [named("Vgdezsurl")]) == [None] * 7
+
+
+def test_escapes():  # getJsonObjectTest_Escape
+    rows = [
+        '{ "a": "A" }',
+        '{\'a\':\'A"\'}',
+        "{'a':\"B'\"}",
+        "['a','b','\"C\"']",
+        r"""'中国\"\'\\\/\b\f\n\r\t\b'""",
+    ]
+    expected = [
+        '{"a":"A"}',
+        '{"a":"A\\""}',
+        '{"a":"B\'"}',
+        '["a","b","\\"C\\""]',
+        "中国\"'\\/\b\f\n\r\t\b",
+    ]
+    assert run(rows, []) == expected
+
+
+def test_escapes_in_array():  # getJsonObjectTest_Escape JSON6 (documented)
+    row = r"""['中国\"\'\\\/\b\f\n\r\t\b']"""
+    want = jo.get_json_object(row, [])
+    assert run([row], []) == [want]
+
+
+def test_number_normalization():  # getJsonObjectTest_Number_Normalization
+    rows = [
+        "[100.0,200.000,351.980]",
+        "[12345678900000000000.0]",
+        "[0.0]",
+        "[-0.0]",
+        "[-0]",
+        "[12345678999999999999999999]",
+        "[9.299999257686047e-0005603333574677677]",
+        "9.299999257686047e0005603333574677677",
+        "[1E308]",
+        "[1.0E309,-1E309,1E5000]",
+        "0.3",
+        "0.03",
+        "0.003",
+        "0.0003",
+        "0.00003",
+    ]
+    expected = [
+        "[100.0,200.0,351.98]",
+        "[1.23456789E19]",
+        "[0.0]",
+        "[-0.0]",
+        "[0]",
+        "[12345678999999999999999999]",
+        "[0.0]",
+        '"Infinity"',
+        "[1.0E308]",
+        '["Infinity","-Infinity","Infinity"]',
+        "0.3",
+        "0.03",
+        "0.003",
+        "3.0E-4",
+        "3.0E-5",
+    ]
+    assert run(rows, []) == expected
+
+
+def test_leading_zeros_invalid():  # getJsonObjectTest_Test_leading_zeros
+    rows = ["00", "01", "02", "000", "-01", "-00", "-02"]
+    assert run(rows, []) == [None] * 7
+
+
+def test_index():  # getJsonObjectTest_Test_index
+    json = "[ [0, 1, 2] , [10, [11], [121, 122, 123], 13] ,  [20, 21, 22]]"
+    assert run([json], [idx(1)]) == ["[10,[11],[121,122,123],13]"]
+
+
+def test_index_index():  # getJsonObjectTest_Test_index_index
+    json = "[ [0, 1, 2] , [10, [11], [121, 122, 123], 13] ,  [20, 21, 22]]"
+    assert run([json], [idx(1), idx(2)]) == ["[121,122,123]"]
+
+
+def test_case_path1():
+    assert run(["'abc'"], []) == ["abc"]
+
+
+def test_case_path2_flatten():
+    json = "[ [11, 12], [21, [221, [2221, [22221, 22222]]]], [31, 32] ]"
+    assert run([json], [WC, WC]) == ["[11,12,21,221,2221,22221,22222,31,32]"]
+
+
+def test_case_path3():
+    assert run(["123"], []) == ["123"]
+
+
+def test_case_path4():
+    assert run(["{ 'k' : 'v'  }"], [named("k")]) == ["v"]
+
+
+def test_case_path5():
+    json = ("[  [[[ {'k': 'v1'} ], {'k': 'v2'}]], [[{'k': 'v3'}], "
+            "{'k': 'v4'}], {'k': 'v5'}  ]")
+    assert run([json], [WC, WC, named("k")]) == ['["v5"]']
+
+
+def test_case_path6():
+    rows = ["[1, [21, 22], 3]", "[1]"]
+    assert run(rows, [WC]) == ["[1,[21,22],3]", "1"]
+
+
+def test_case_path7_quoted_mode():
+    json = "[ {'k': [0, 1, 2]}, {'k': [10, 11, 12]}, {'k': [20, 21, 22]}  ]"
+    assert run([json], [WC, named("k"), WC]) == ["[[0,1,2],[10,11,12],[20,21,22]]"]
+
+
+def test_case_path8():
+    json = "[ [0], [10, 11, 12], [2] ]"
+    assert run([json], [idx(1), WC]) == ["[10,11,12]"]
+
+
+def test_case_path9():
+    rows = [
+        "[[0, 1, 2], [10, [111, 112, 113], 12], [20, 21, 22]]",
+        "[[0, 1, 2], [10, [], 12], [20, 21, 22]]",
+    ]
+    assert run(rows, [idx(1), idx(1), WC]) == ["[111,112,113]", None]
+
+
+def test_case_path10():
+    rows = ["{'k' : [0,1,2]}", "{'k' : null}"]
+    assert run(rows, [named("k"), idx(1)]) == ["1", None]
+
+
+def test_case_path11_object_wildcard():
+    rows = ["{'k' : [0,1,2]}", "{'k' : null}"]
+    assert run(rows, [WC]) == [None, None]
+
+
+def test_case_path12():
+    assert run(["123"], [WC]) == [None]
+
+
+def test_insert_comma_insert_outer_array():
+    rows = ["[ [11, 12], [21, 22]]", "[ [11], [22] ]"]
+    assert run(rows, [WC, WC, WC]) == ["[[11,12],[21,22]]", "[11,22]"]
+
+
+def test_15_invalid_quote_in_string():
+    rows = ["{'a':'v1'}", "{'a':\"b\"c\"}"]
+    assert run(rows, [named("a")]) == ["v1", None]
+
+
+# ------------------------------------------------------ behaviour extras ---
+
+def test_null_rows_and_path_parser():
+    rows = ['{"a": {"b": 7}}', None, "junk"]
+    assert run(rows, "$.a.b") == ["7", None, None]
+    assert parse_path("$['x'][3].*") == [
+        (NAMED, b"x"), (INDEX, 3), (WILDCARD,)]
+
+
+def test_path_deeper_than_16_throws():
+    # get_json_object.cu:958 CUDF_FAIL("JSONPath query exceeds maximum depth")
+    with pytest.raises(ValueError, match="maximum depth"):
+        run(['{"a": 1}'], [named("a")] * 17)
+    # parse-level rejections mirroring Spark's JsonPathParser
+    with pytest.raises(ValueError):
+        parse_path("$[-1]")
+    assert parse_path("$['a]b']") == [(NAMED, b"a]b")]
+
+
+def test_empty_and_whitespace():
+    assert run(["", "   ", "null", "true"], []) == [None, None, "null", "true"]
+
+
+def test_mixed_length_buckets():
+    # spread rows across several length buckets, verify row-order assembly
+    rows = []
+    for i in range(50):
+        pad = "x" * (i * 7 % 120)
+        rows.append('{"k": "%s", "pad": "%s"}' % (f"v{i}", pad))
+    got = run(rows, [named("k")])
+    assert got == [f"v{i}" for i in range(50)]
+
+
+# ----------------------------------------------------------------- fuzz ----
+
+def _rand_json(rng, depth=0):
+    r = rng.random()
+    if depth > 3 or r < 0.35:
+        return rng.choice([
+            "123", "-5", "0", "-0", "1.5", "2e3", "-0.25", "true", "false",
+            "null", "'s'", '"t"', '"a b"', "'q\\'x'", '"\\u0041\\u00e9"',
+            '"\\n\\t"', "1e999", "3.14159", "00", "01",  # invalid numbers too
+        ])
+    if r < 0.6:
+        k = rng.randint(0, 3)
+        items = ",".join(_rand_json(rng, depth + 1) for _ in range(k))
+        return "[%s]" % items
+    k = rng.randint(0, 3)
+    names = ["a", "b", "k", "x y", "\\u0041"]
+    fields = ",".join(
+        '"%s":%s' % (rng.choice(names), _rand_json(rng, depth + 1))
+        for _ in range(k)
+    )
+    return "{%s}" % fields
+
+
+_FUZZ_PATHS = [
+    [],
+    [named("a")],
+    [named("a"), named("b")],
+    [idx(0)],
+    [idx(1)],
+    [WC],
+    [WC, WC],
+    [named("a"), WC],
+    [idx(0), WC],
+    [WC, named("k")],
+    [named("k"), idx(1), WC],
+]
+
+
+def test_fuzz_against_oracle():
+    rng = random.Random(42)
+    n = int(os.environ.get("SRT_JSON_FUZZ_ROWS", "300"))
+    rows = [_rand_json(rng) for _ in range(n)]
+    # sprinkle malformed rows
+    for i in range(0, n, 17):
+        rows[i] = rows[i][:-1] if rows[i] else "{"
+    for path in _FUZZ_PATHS:
+        got = run(rows, path)
+        want = [jo.get_json_object(s, path) for s in rows]
+        bad = [(i, rows[i], got[i], want[i])
+               for i in range(n) if got[i] != want[i]]
+        assert not bad, f"path={path}: first mismatches {bad[:5]}"
