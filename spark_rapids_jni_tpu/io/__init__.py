@@ -1,0 +1,19 @@
+"""Host-side IO tooling (parquet footer parse/filter/serialize)."""
+
+from spark_rapids_jni_tpu.io.parquet_footer import (
+    ListElement,
+    MapElement,
+    ParquetFooter,
+    StructBuilder,
+    StructElement,
+    ValueElement,
+)
+
+__all__ = [
+    "ListElement",
+    "MapElement",
+    "ParquetFooter",
+    "StructBuilder",
+    "StructElement",
+    "ValueElement",
+]

@@ -72,9 +72,10 @@ _F_CASE2, _F_CASE4, _F_CASE5, _F_CASE6, _F_CASE7, _F_CASE8, _F_COPY = range(7)
 # frame sub-states
 _SUB_NONE, _SUB_ENTERING, _SUB_WAITING, _SUB_DRAIN = 0, 1, 2, 3
 
-# segment types
-_SEG_NONE, _SEG_CONST, _SEG_RAW_TOK, _SEG_ESC_TOK, _SEG_INT_TOK = 0, 1, 2, 3, 4
-_SEG_FLOAT_TOK, _SEG_COND_OPEN, _SEG_COND_CLOSE = 5, 6, 7
+# segment types (int/float tokens travel as RAW/ESC and are remapped by kind
+# in _render — no dedicated segment types)
+_SEG_NONE, _SEG_CONST, _SEG_RAW_TOK, _SEG_ESC_TOK = 0, 1, 2, 3
+_SEG_COND_OPEN, _SEG_COND_CLOSE = 4, 5
 
 # constant-byte table (segment arg for _SEG_CONST)
 _CONSTS = [b"", b",", b":", b"[", b"]", b"{", b"}", b"true", b"false",
@@ -1018,7 +1019,12 @@ def get_json_object(col: StringColumn, path: Sequence[tuple]) -> StringColumn:
         ok = np.asarray(ts.ok)[: b.n_valid]
         rows_np = np.asarray(b.rows)[: b.n_valid]
 
-        bi = _byte_info(b.bytes[: b.n_valid], b.lengths[: b.n_valid])
+        # run the jitted automaton on the full pow2-padded bucket (bounded
+        # compile-shape set), then slice the host copies to the real rows
+        bi = _byte_info(b.bytes, b.lengths)
+        if b.n_valid < b.n_rows:
+            for f in dataclasses.fields(bi):
+                setattr(bi, f.name, getattr(bi, f.name)[: b.n_valid])
         len_raw, len_esc, has_uni, neg0 = _token_tables(bi, kind, start, end)
         nm = _name_matches(bi, kind, start, end, names, len_raw, has_uni)
         ftext, flen, fidx = _float_texts(bi, kind, start, end)

@@ -1,0 +1,230 @@
+"""ParquetFooter tests: thrift round-trip, column pruning, row-group split
+filtering — validated against pyarrow's own parquet reader as the oracle
+(the reference validates via parquet-avro/hadoop, pom.xml:116-141).
+"""
+
+import io
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_jni_tpu.io import (
+    ListElement,
+    MapElement,
+    ParquetFooter,
+    StructElement,
+    ValueElement,
+)
+
+
+def footer_bytes(buf: bytes) -> bytes:
+    """Extract the raw thrift footer from a parquet file's bytes."""
+    assert buf[-4:] == b"PAR1"
+    n = int.from_bytes(buf[-8:-4], "little")
+    return buf[-8 - n : -8]
+
+
+def write_table(table, **kw) -> bytes:
+    sink = io.BytesIO()
+    pq.write_table(table, sink, **kw)
+    return sink.getvalue()
+
+
+def read_meta(footer_file: bytes):
+    """Parse a PAR1-wrapped footer 'file' with pyarrow."""
+    return pq.read_metadata(io.BytesIO(footer_file))
+
+
+@pytest.fixture(scope="module")
+def flat_file():
+    table = pa.table({
+        "a": pa.array(range(1000), pa.int64()),
+        "B_col": pa.array([f"s{i}" for i in range(1000)]),
+        "c": pa.array([i * 0.5 for i in range(1000)]),
+    })
+    return write_table(table, row_group_size=250)
+
+
+def full_schema_flat():
+    return (StructElement.builder()
+            .add_child("a", ValueElement())
+            .add_child("B_col", ValueElement())
+            .add_child("c", ValueElement())
+            .build())
+
+
+def test_round_trip_full(flat_file):
+    fb = footer_bytes(flat_file)
+    f = ParquetFooter.read_and_filter(fb, 0, -1, full_schema_flat(), False)
+    assert f.num_rows == 1000
+    assert f.num_columns == 3
+    meta = read_meta(f.serialize_thrift_file())
+    orig = pq.read_metadata(io.BytesIO(flat_file))
+    assert meta.num_rows == orig.num_rows
+    assert meta.num_row_groups == orig.num_row_groups
+    assert meta.schema.to_arrow_schema().names == ["a", "B_col", "c"]
+    assert meta.row_group(0).num_rows == orig.row_group(0).num_rows
+
+
+def test_column_prune(flat_file):
+    fb = footer_bytes(flat_file)
+    schema = (StructElement.builder()
+              .add_child("c", ValueElement())
+              .add_child("a", ValueElement())
+              .build())
+    f = ParquetFooter.read_and_filter(fb, 0, -1, schema, False)
+    assert f.num_columns == 2
+    meta = read_meta(f.serialize_thrift_file())
+    # parquet schema order is preserved (file order, not request order)
+    assert meta.schema.to_arrow_schema().names == ["a", "c"]
+    assert meta.num_rows == 1000
+    # chunk metadata follows the pruned columns
+    rg = meta.row_group(0)
+    assert rg.num_columns == 2
+    assert rg.column(0).path_in_schema == "a"
+    assert rg.column(1).path_in_schema == "c"
+
+
+def test_case_insensitive_prune(flat_file):
+    fb = footer_bytes(flat_file)
+    schema = (StructElement.builder()
+              .add_child("b_col", ValueElement())  # lowered by caller
+              .build())
+    f = ParquetFooter.read_and_filter(fb, 0, -1, schema, True)
+    assert f.num_columns == 1
+    meta = read_meta(f.serialize_thrift_file())
+    assert meta.schema.to_arrow_schema().names == ["B_col"]
+    # case-sensitive: no match -> zero columns survive
+    f2 = ParquetFooter.read_and_filter(fb, 0, -1, schema, False)
+    assert f2.num_columns == 0
+
+
+def test_missing_column_pruned(flat_file):
+    fb = footer_bytes(flat_file)
+    schema = (StructElement.builder()
+              .add_child("a", ValueElement())
+              .add_child("nope", ValueElement())
+              .build())
+    f = ParquetFooter.read_and_filter(fb, 0, -1, schema, False)
+    assert f.num_columns == 1
+
+
+def test_row_group_split_filtering(flat_file):
+    fb = footer_bytes(flat_file)
+    orig = pq.read_metadata(io.BytesIO(flat_file))
+    assert orig.num_row_groups == 4
+    # per-group midpoints, as the reference computes them: start =
+    # min(data_page_offset, dictionary_page_offset), size = compressed
+    mids = []
+    for i in range(4):
+        rg = orig.row_group(i)
+        col0 = rg.column(0)
+        start = col0.data_page_offset
+        if col0.has_dictionary_page:
+            start = min(start, col0.dictionary_page_offset)
+        total = sum(rg.column(j).total_compressed_size
+                    for j in range(rg.num_columns))
+        mids.append(start + total // 2)
+
+    # a split covering the first two midpoints keeps exactly groups 0-1
+    split_end = mids[1] + 1
+    f = ParquetFooter.read_and_filter(fb, 0, split_end,
+                                      full_schema_flat(), False)
+    assert f.num_rows == 500
+    meta = read_meta(f.serialize_thrift_file())
+    assert meta.num_row_groups == 2
+    # the complementary split keeps the rest
+    f2 = ParquetFooter.read_and_filter(fb, split_end, 1 << 40,
+                                       full_schema_flat(), False)
+    assert f2.num_rows == 500
+    # a split covering nothing keeps nothing
+    f3 = ParquetFooter.read_and_filter(fb, 0, 1, full_schema_flat(), False)
+    assert f3.num_rows == 0
+
+
+def test_nested_struct_prune():
+    table = pa.table({
+        "s": pa.array([{"x": 1, "y": "a"}, {"x": 2, "y": "b"}],
+                      pa.struct([("x", pa.int64()), ("y", pa.string())])),
+        "flat": pa.array([1, 2], pa.int32()),
+    })
+    buf = write_table(table)
+    fb = footer_bytes(buf)
+    schema = (StructElement.builder()
+              .add_child("s", StructElement.builder()
+                         .add_child("x", ValueElement())
+                         .build())
+              .build())
+    f = ParquetFooter.read_and_filter(fb, 0, -1, schema, False)
+    assert f.num_columns == 1
+    meta = read_meta(f.serialize_thrift_file())
+    arrow = meta.schema.to_arrow_schema()
+    assert arrow.names == ["s"]
+    assert [fld.name for fld in arrow.field("s").type] == ["x"]
+    assert meta.row_group(0).num_columns == 1
+    assert meta.row_group(0).column(0).path_in_schema == "s.x"
+
+
+@pytest.mark.parametrize("compliant", [True, False])
+def test_list_prune(compliant):
+    table = pa.table({
+        "l": pa.array([[1, 2], [3]], pa.list_(pa.int64())),
+        "z": pa.array([1, 2], pa.int32()),
+    })
+    buf = write_table(table, use_compliant_nested_type=compliant)
+    fb = footer_bytes(buf)
+    schema = (StructElement.builder()
+              .add_child("l", ListElement(ValueElement()))
+              .build())
+    f = ParquetFooter.read_and_filter(fb, 0, -1, schema, False)
+    assert f.num_columns == 1
+    meta = read_meta(f.serialize_thrift_file())
+    assert meta.schema.to_arrow_schema().names == ["l"]
+    assert meta.num_rows == 2
+
+
+def test_map_prune():
+    table = pa.table({
+        "m": pa.array([[("k1", 1)], [("k2", 2)]],
+                      pa.map_(pa.string(), pa.int64())),
+        "z": pa.array([1, 2], pa.int32()),
+    })
+    buf = write_table(table)
+    fb = footer_bytes(buf)
+    schema = (StructElement.builder()
+              .add_child("m", MapElement(ValueElement(), ValueElement()))
+              .build())
+    f = ParquetFooter.read_and_filter(fb, 0, -1, schema, False)
+    assert f.num_columns == 1
+    meta = read_meta(f.serialize_thrift_file())
+    assert meta.schema.to_arrow_schema().names == ["m"]
+
+
+def test_list_of_struct_prune():
+    table = pa.table({
+        "ls": pa.array([[{"p": 1, "q": 2}], []],
+                       pa.list_(pa.struct([("p", pa.int64()),
+                                           ("q", pa.int64())]))),
+    })
+    buf = write_table(table)
+    fb = footer_bytes(buf)
+    schema = (StructElement.builder()
+              .add_child("ls", ListElement(
+                  StructElement.builder()
+                  .add_child("q", ValueElement())
+                  .build()))
+              .build())
+    f = ParquetFooter.read_and_filter(fb, 0, -1, schema, False)
+    meta = read_meta(f.serialize_thrift_file())
+    arrow = meta.schema.to_arrow_schema()
+    inner = arrow.field("ls").type.value_type
+    assert [fld.name for fld in inner] == ["q"]
+
+
+def test_malformed_footer_raises():
+    with pytest.raises(ValueError, match="deserialize thrift"):
+        ParquetFooter.read_and_filter(
+            b"\xff\xfe\xfd", 0, -1,
+            StructElement.builder().add_child("a", ValueElement()).build(),
+            False)
