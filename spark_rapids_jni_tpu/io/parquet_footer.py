@@ -600,6 +600,11 @@ class ParquetFooter:
             new_cols = [cols[i] for i in chunk_map]
             new_groups.append(_set(list(rg), 1, _T_LIST, (etype, new_cols)))
         meta = _set(meta, 4, _T_LIST, (_T_STRUCT, new_groups))
+        # keep the file-level row count consistent with the surviving groups
+        # (the reference leaves FileMetaData.num_rows stale here; fixed
+        # deliberately so the serialized footer is self-consistent)
+        meta = _set(meta, 3, _T_I64,
+                    sum(_get(rg, 3, 0) for rg in new_groups))
         return ParquetFooter(meta)
 
     @property

@@ -1,0 +1,238 @@
+"""Randomized multi-task stress for the memory-governance state machine.
+
+Parity target: ``RmmSparkMonteCarlo`` (src/test/java/com/nvidia/spark/rapids/
+jni/RmmSparkMonteCarlo.java:56, 979 LoC; CI invocation ci/fuzz-test.sh
+``--taskMaxMiB=2048 --gpuMiB=3072 --skewed --allocMode=ASYNC``).  N simulated
+tasks run on real threads against a budget-capped resource, with skewed
+allocation sizes, shuffle threads serving multiple tasks, injected OOMs, and
+the full retry / split-and-retry protocol.  The run succeeds iff every task
+completes (possibly after retries/splits), nothing leaks, and no thread ends
+blocked — the arbiter's liveness and accounting invariants under chaos.
+
+Runable as a CLI (the fuzz-test.sh analog)::
+
+    python -m spark_rapids_jni_tpu.mem.montecarlo --tasks 16 --seed 7 \
+        --budget-mib 64 --task-max-mib 48 --skewed --duration-s 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import random
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional
+
+from spark_rapids_jni_tpu.mem.exceptions import (
+    RetryOOM,
+    SplitAndRetryOOM,
+)
+from spark_rapids_jni_tpu.mem.governor import BudgetedResource, MemoryGovernor
+
+__all__ = ["MonteCarloConfig", "MonteCarloStats", "run_monte_carlo", "main"]
+
+
+@dataclasses.dataclass
+class MonteCarloConfig:
+    n_tasks: int = 8
+    n_threads: int = 4                  # concurrent dedicated task threads
+    n_shuffle_threads: int = 1
+    budget_bytes: int = 16 << 20
+    task_max_bytes: int = 12 << 20      # peak working set a task may try
+    allocs_per_task: int = 20
+    skewed: bool = True                 # a few tasks allocate near the max
+    inject_retry_pct: float = 5.0       # chance per alloc of a forced RetryOOM
+    seed: int = 0
+    max_task_retries: int = 1000
+    duration_s: Optional[float] = None  # wall-clock cap: stop issuing tasks
+
+
+@dataclasses.dataclass
+class MonteCarloStats:
+    tasks_completed: int = 0
+    retries: int = 0
+    splits: int = 0
+    injected: int = 0
+    peak_used: int = 0
+    leaked_bytes: int = 0
+    blocked_at_end: int = 0
+    failures: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return (not self.failures and self.leaked_bytes == 0
+                and self.blocked_at_end == 0)
+
+
+class _Task:
+    """One simulated Spark task: a random alloc/free program with retry."""
+
+    def __init__(self, task_id: int, cfg: MonteCarloConfig, rng: random.Random):
+        self.task_id = task_id
+        self.cfg = cfg
+        # skew: every 4th task works near the ceiling (RmmSparkMonteCarlo
+        # --skewed gives some tasks outsized footprints)
+        scale = 1.0 if not cfg.skewed or task_id % 4 else 3.0
+        cap = min(cfg.task_max_bytes, int(cfg.task_max_bytes * scale / 3))
+        self.sizes = [
+            max(1, int(rng.expovariate(1.0) * cap / cfg.allocs_per_task))
+            for _ in range(cfg.allocs_per_task)
+        ]
+        self.inject = [rng.uniform(0, 100) < cfg.inject_retry_pct
+                       for _ in range(cfg.allocs_per_task)]
+
+    def run(self, gov: MemoryGovernor, budget: BudgetedResource,
+            stats: "MonteCarloStats", stats_lock: threading.Lock) -> None:
+        gov.current_thread_is_dedicated_to_task(self.task_id)
+        held: List[int] = []
+        sizes = list(self.sizes)
+        try:
+            attempts = 0
+            while attempts < self.cfg.max_task_retries:
+                attempts += 1
+                try:
+                    gov.start_retry_block()
+                    for i, size in enumerate(sizes):
+                        if self.inject[i]:
+                            self.inject[i] = False
+                            gov.force_retry_oom()
+                            with stats_lock:
+                                stats.injected += 1
+                        held.append(budget.acquire(size))
+                        with stats_lock:
+                            stats.peak_used = max(stats.peak_used, budget.used)
+                        # steady-state: drop some early allocations
+                        if len(held) > 4:
+                            budget.release(held.pop(0))
+                    break  # program completed
+                except RetryOOM:
+                    # roll back to spillable state and try again
+                    with stats_lock:
+                        stats.retries += 1
+                    for h in held:
+                        budget.release(h)
+                    held.clear()
+                    gov.block_thread_until_ready()
+                except SplitAndRetryOOM:
+                    # halve the working set and retry (the split protocol)
+                    with stats_lock:
+                        stats.splits += 1
+                    for h in held:
+                        budget.release(h)
+                    held.clear()
+                    sizes = [max(1, s // 2) for s in sizes]
+                finally:
+                    gov.end_retry_block()
+            else:
+                with stats_lock:
+                    stats.failures.append(
+                        f"task {self.task_id} hit max_task_retries")
+        finally:
+            for h in held:
+                budget.release(h)
+            gov.task_done(self.task_id)
+            gov.remove_current_dedicated_thread_association(self.task_id)
+            with stats_lock:
+                stats.tasks_completed += 1
+
+
+def _shuffle_thread(gov: MemoryGovernor, budget: BudgetedResource,
+                    task_ids: List[int], stop: threading.Event,
+                    rng: random.Random, stats: MonteCarloStats,
+                    stats_lock: threading.Lock) -> None:
+    """Highest-priority shuffle thread serving several tasks at once
+    (RmmSpark.shuffleThreadWorkingTasks:155)."""
+    gov.shuffle_thread_working_on_tasks(task_ids)
+    try:
+        while not stop.is_set():
+            size = max(1, int(rng.expovariate(1.0) * 4096))
+            try:
+                budget.acquire(size)
+                budget.release(size)
+            except (RetryOOM, SplitAndRetryOOM):
+                with stats_lock:
+                    stats.retries += 1
+            time.sleep(0.001)
+    finally:
+        gov.remove_current_dedicated_thread_association(-1)
+
+
+def run_monte_carlo(cfg: MonteCarloConfig) -> MonteCarloStats:
+    rng = random.Random(cfg.seed)
+    stats = MonteCarloStats()
+    stats_lock = threading.Lock()
+    gov = MemoryGovernor.initialize()
+    try:
+        budget = BudgetedResource(gov, cfg.budget_bytes)
+        tasks = [_Task(i, cfg, rng) for i in range(cfg.n_tasks)]
+        stop = threading.Event()
+        shufflers = []
+        for i in range(cfg.n_shuffle_threads):
+            t = threading.Thread(
+                target=_shuffle_thread,
+                args=(gov, budget, list(range(cfg.n_tasks)), stop,
+                      random.Random(cfg.seed + 1000 + i), stats, stats_lock),
+                daemon=True)
+            t.start()
+            shufflers.append(t)
+
+        deadline = (time.monotonic() + cfg.duration_s
+                    if cfg.duration_s else None)
+        with ThreadPoolExecutor(max_workers=cfg.n_threads) as pool:
+            futures = []
+            for task in tasks:
+                if deadline and time.monotonic() > deadline:
+                    break
+                futures.append(pool.submit(
+                    task.run, gov, budget, stats, stats_lock))
+            for f in futures:
+                try:
+                    f.result(timeout=120)
+                except Exception as e:  # noqa: BLE001 - collected as failure
+                    stats.failures.append(repr(e))
+        stop.set()
+        for t in shufflers:
+            t.join(timeout=10)
+        stats.leaked_bytes = budget.used
+        stats.blocked_at_end = gov.arbiter.total_blocked_or_bufn()
+    finally:
+        MemoryGovernor.shutdown()
+    return stats
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="arbiter monte-carlo stress")
+    ap.add_argument("--tasks", type=int, default=16)
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--shuffle-threads", type=int, default=2)
+    ap.add_argument("--budget-mib", type=int, default=64)
+    ap.add_argument("--task-max-mib", type=int, default=48)
+    ap.add_argument("--allocs", type=int, default=50)
+    ap.add_argument("--skewed", action="store_true")
+    ap.add_argument("--inject-pct", type=float, default=5.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--duration-s", type=float, default=None)
+    args = ap.parse_args(argv)
+    cfg = MonteCarloConfig(
+        n_tasks=args.tasks, n_threads=args.threads,
+        n_shuffle_threads=args.shuffle_threads,
+        budget_bytes=args.budget_mib << 20,
+        task_max_bytes=args.task_max_mib << 20,
+        allocs_per_task=args.allocs, skewed=args.skewed,
+        inject_retry_pct=args.inject_pct, seed=args.seed,
+        duration_s=args.duration_s)
+    stats = run_monte_carlo(cfg)
+    print(f"tasks_completed={stats.tasks_completed} retries={stats.retries} "
+          f"splits={stats.splits} injected={stats.injected} "
+          f"peak_used={stats.peak_used} leaked={stats.leaked_bytes} "
+          f"blocked_at_end={stats.blocked_at_end} ok={stats.ok}")
+    for f in stats.failures:
+        print("FAILURE:", f, file=sys.stderr)
+    return 0 if stats.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

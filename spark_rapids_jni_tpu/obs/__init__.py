@@ -1,0 +1,29 @@
+"""Observability & chaos: profiler, trace converter, fault injection.
+
+The reference's CUPTI profiler + libcufaultinj analog (SURVEY.md §2.4, §5),
+re-seated on the framework dispatch seam instead of the CUDA API boundary.
+"""
+
+from spark_rapids_jni_tpu.obs.faultinj import FaultInjector, install_from_env
+from spark_rapids_jni_tpu.obs.profiler import Profiler
+from spark_rapids_jni_tpu.obs.seam import (
+    ALLOC,
+    COLLECTIVE,
+    OP,
+    TRANSFER,
+    instrument,
+)
+
+# NB: the `seam` context manager stays at spark_rapids_jni_tpu.obs.seam.seam —
+# re-exporting it here would shadow the submodule attribute of the package.
+
+__all__ = [
+    "ALLOC",
+    "COLLECTIVE",
+    "FaultInjector",
+    "OP",
+    "Profiler",
+    "TRANSFER",
+    "install_from_env",
+    "instrument",
+]

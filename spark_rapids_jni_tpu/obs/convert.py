@@ -1,0 +1,113 @@
+"""Offline profile converter CLI (spark_rapids_profile_converter analog).
+
+Parses the SRTP capture format (obs/profiler.py) and emits either JSON lines
+(one event per line) or a chrome://tracing / Perfetto-compatible trace —
+the role NVTXT output plays for the reference
+(spark_rapids_profile_converter.cpp:106-116).
+
+Usage::
+
+    python -m spark_rapids_jni_tpu.obs.convert capture.srtp --format json
+    python -m spark_rapids_jni_tpu.obs.convert capture.srtp --format chrome -o trace.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import struct
+import sys
+from typing import Iterator, Tuple
+
+from spark_rapids_jni_tpu.obs.profiler import MAGIC, VERSION
+
+_CATEGORY_NAMES = ["op", "transfer", "collective", "alloc", "marker"]
+
+
+def parse_capture(data: bytes) -> Iterator[dict]:
+    """Yield event dicts from a raw capture byte string."""
+    if data[:4] != MAGIC:
+        raise ValueError("not an SRTP capture (bad magic)")
+    version = struct.unpack_from("<I", data, 4)[0]
+    if version != VERSION:
+        raise ValueError(f"unsupported SRTP version {version}")
+    pos = 8
+    while pos < len(data):
+        (blen,) = struct.unpack_from("<I", data, pos)
+        pos += 4
+        end = pos + blen
+        names = {}
+        while pos < end:
+            kind = data[pos]
+            pos += 1
+            if kind == 0:  # STRING_DEF
+                nid, ln = struct.unpack_from("<IH", data, pos)
+                pos += 6
+                names[nid] = data[pos : pos + ln].decode("utf-8")
+                pos += ln
+            elif kind == 1:  # RANGE
+                nid, cat, t0, t1, tid = struct.unpack_from("<IBQQI", data, pos)
+                pos += 25
+                yield {"type": "range", "name": names.get(nid, f"#{nid}"),
+                       "category": _CATEGORY_NAMES[cat], "start_ns": t0,
+                       "end_ns": t1, "tid": tid}
+            elif kind == 2:  # INSTANT
+                nid, cat, t, tid = struct.unpack_from("<IBQI", data, pos)
+                pos += 17
+                yield {"type": "instant", "name": names.get(nid, f"#{nid}"),
+                       "category": _CATEGORY_NAMES[cat], "t_ns": t, "tid": tid}
+            elif kind == 3:  # COUNTER
+                nid, t, value = struct.unpack_from("<IQq", data, pos)
+                pos += 20
+                yield {"type": "counter", "name": names.get(nid, f"#{nid}"),
+                       "t_ns": t, "value": value}
+            else:
+                raise ValueError(f"corrupt capture: record kind {kind}")
+        pos = end
+
+
+def to_chrome(events) -> dict:
+    """Chrome trace-event JSON (ts/dur in microseconds)."""
+    out = []
+    for e in events:
+        if e["type"] == "range":
+            out.append({"name": e["name"], "cat": e["category"], "ph": "X",
+                        "ts": e["start_ns"] / 1e3,
+                        "dur": (e["end_ns"] - e["start_ns"]) / 1e3,
+                        "pid": 0, "tid": e["tid"]})
+        elif e["type"] == "instant":
+            out.append({"name": e["name"], "cat": e["category"], "ph": "i",
+                        "ts": e["t_ns"] / 1e3, "pid": 0, "tid": e["tid"],
+                        "s": "t"})
+        else:
+            out.append({"name": e["name"], "ph": "C", "ts": e["t_ns"] / 1e3,
+                        "pid": 0, "args": {"value": e["value"]}})
+    return {"traceEvents": out}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Convert an SRTP profiler capture to JSON or chrome trace")
+    ap.add_argument("capture")
+    ap.add_argument("--format", choices=["json", "chrome"], default="json")
+    ap.add_argument("-o", "--output", default="-")
+    args = ap.parse_args(argv)
+
+    with open(args.capture, "rb") as f:
+        data = f.read()
+    events = parse_capture(data)
+    out = sys.stdout if args.output == "-" else open(args.output, "w")
+    try:
+        if args.format == "json":
+            for e in events:
+                out.write(json.dumps(e) + "\n")
+        else:
+            json.dump(to_chrome(events), out)
+    finally:
+        if out is not sys.stdout:
+            out.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,178 @@
+"""Config-driven fault injection at the framework dispatch seam.
+
+Parity target: ``libcufaultinj`` (faultinj/faultinj.cu) — the CUPTI-hooked
+chaos tool that injects faults into CUDA calls per a JSON config with
+match-by-name / ``*`` wildcards, probabilities, interception counts, and
+inotify hot reload (faultinj.cu:387 config parse, :139-144 trap/assert
+injection, README.md).  The TPU analog hooks the dispatch seam
+(obs/seam.py) that every instrumented op, transfer, and collective crosses.
+
+Config shape::
+
+    {
+      "dynamic": true,            # hot-reload on file change (mtime poll)
+      "seed": 42,                 # optional deterministic RNG
+      "op": {
+        "murmur_hash32": {"percent": 50, "injectionType": "exception",
+                           "interceptionCount": 2},
+        "*":             {"percent": 1,  "injectionType": "retry_oom"}
+      },
+      "transfer": { ... }, "collective": { ... }, "alloc": { ... }
+    }
+
+``injectionType``:
+
+- ``exception``    -> InjectedException (the PTX ``trap;`` analog: the call
+  fails immediately with a framework error)
+- ``retry_oom``    -> GpuRetryOOM (drives the arbiter's retry protocol)
+- ``split_oom``    -> GpuSplitAndRetryOOM
+- ``device_error`` -> GpuOOM (the sticky ``assert(0)`` analog: a
+  non-retryable device failure)
+
+``interceptionCount`` limits how many times the rule fires (faultinj.cu
+``injectionCount`` countdown); ``percent`` gates each crossing.
+
+Auto-activation: if ``SRT_FAULT_INJECTOR_CONFIG_PATH`` is set when
+``install_from_env()`` runs (the ops package calls it on import), the
+injector arms itself — mirroring the driver-level ``CUDA_INJECTION64_PATH``
+/ ``FAULT_INJECTOR_CONFIG_PATH`` environment contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from typing import Optional
+
+from spark_rapids_jni_tpu.mem.exceptions import (
+    GpuOOM,
+    GpuRetryOOM,
+    GpuSplitAndRetryOOM,
+    InjectedException,
+)
+from spark_rapids_jni_tpu.obs import seam as _seam
+
+__all__ = ["FaultInjector", "install_from_env", "ENV_CONFIG_PATH"]
+
+ENV_CONFIG_PATH = "SRT_FAULT_INJECTOR_CONFIG_PATH"
+
+_FAULTS = {
+    "exception": lambda name: InjectedException(f"injected fault in {name}"),
+    "retry_oom": lambda name: GpuRetryOOM(f"injected retry OOM in {name}"),
+    "split_oom": lambda name: GpuSplitAndRetryOOM(
+        f"injected split-and-retry OOM in {name}"),
+    "device_error": lambda name: GpuOOM(f"injected device error in {name}"),
+}
+
+
+class _Rule:
+    def __init__(self, spec: dict):
+        self.percent = float(spec.get("percent", 100))
+        self.kind = spec.get("injectionType", "exception")
+        if self.kind not in _FAULTS:
+            raise ValueError(f"unknown injectionType {self.kind!r}")
+        # None = unlimited, mirroring a missing injectionCount in faultinj
+        c = spec.get("interceptionCount")
+        self.remaining = None if c is None else int(c)
+
+    def fire(self, rng: random.Random, name: str):
+        if self.remaining is not None and self.remaining <= 0:
+            return None
+        if self.percent < 100 and rng.uniform(0, 100) >= self.percent:
+            return None
+        if self.remaining is not None:
+            self.remaining -= 1
+        return _FAULTS[self.kind](name)
+
+
+class FaultInjector:
+    """Singleton chaos hook over the dispatch seam."""
+
+    _instance: Optional["FaultInjector"] = None
+
+    def __init__(self, config, config_path: Optional[str] = None):
+        self._lock = threading.Lock()
+        self._path = config_path
+        self._mtime = 0.0
+        self._watcher: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._load(config)
+
+    # -- lifecycle ---------------------------------------------------------
+    @classmethod
+    def install(cls, config_or_path) -> "FaultInjector":
+        """Arm the injector from a dict or a JSON config file path."""
+        if cls._instance is not None:
+            raise RuntimeError("fault injector already installed")
+        if isinstance(config_or_path, (str, os.PathLike)):
+            path = os.fspath(config_or_path)
+            with open(path) as f:
+                config = json.load(f)
+            inj = cls(config, path)
+            inj._mtime = os.stat(path).st_mtime
+            if config.get("dynamic"):
+                inj._watcher = threading.Thread(
+                    target=inj._watch, name="srt-faultinj-watch", daemon=True)
+                inj._watcher.start()
+        else:
+            inj = cls(dict(config_or_path))
+        cls._instance = inj
+        _seam._set_injector(inj._check)
+        return inj
+
+    @classmethod
+    def uninstall(cls) -> None:
+        inj = cls._instance
+        if inj is None:
+            return
+        _seam._set_injector(None)
+        inj._stop.set()
+        if inj._watcher is not None:
+            inj._watcher.join(timeout=5)
+        cls._instance = None
+
+    # -- config ------------------------------------------------------------
+    def _load(self, config: dict) -> None:
+        rules = {}
+        for cat in (_seam.OP, _seam.TRANSFER, _seam.COLLECTIVE, _seam.ALLOC):
+            cat_spec = config.get(cat, {})
+            rules[cat] = {name: _Rule(spec) for name, spec in cat_spec.items()}
+        with self._lock:
+            self._rules = rules
+            self._rng = random.Random(config.get("seed"))
+
+    def _watch(self) -> None:
+        """Hot reload on config change (faultinj.cu:32 inotify analog)."""
+        while not self._stop.wait(0.2):
+            try:
+                m = os.stat(self._path).st_mtime
+                if m != self._mtime:
+                    self._mtime = m
+                    with open(self._path) as f:
+                        self._load(json.load(f))
+            except (OSError, ValueError):
+                pass  # mid-write config; retry next poll
+
+    # -- the seam hook -----------------------------------------------------
+    def _check(self, category: str, name: str) -> None:
+        with self._lock:
+            cat_rules = self._rules.get(category)
+            if not cat_rules:
+                return
+            rule = cat_rules.get(name) or cat_rules.get("*")
+            if rule is None:
+                return
+            fault = rule.fire(self._rng, name)
+        if fault is not None:
+            raise fault
+
+
+def install_from_env() -> Optional[FaultInjector]:
+    """Arm from SRT_FAULT_INJECTOR_CONFIG_PATH if set (and not already)."""
+    path = os.environ.get(ENV_CONFIG_PATH)
+    if path and FaultInjector._instance is None:
+        return FaultInjector.install(path)
+    return None

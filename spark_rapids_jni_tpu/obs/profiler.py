@@ -1,0 +1,218 @@
+"""Always-on framework profiler with a buffered background writer.
+
+Parity target: the CUPTI profiler (Profiler.java:50-124 API,
+ProfilerJni.cpp:61-180 double-buffering + :366 writer thread,
+profiler_serializer.cpp:222 size-prefixed flatbuffer blocks).  The TPU
+analog: op/transfer/collective ranges captured at the dispatch seam
+(obs/seam.py), double-buffered through a completed-buffer queue, serialized
+by a dedicated writer thread into size-prefixed binary blocks delivered to a
+user writer (file path or ``write(bytes)`` object), plus optional
+jax.profiler XPlane capture for on-chip kernel timelines.
+
+Capture format (little-endian):
+
+- file header: ``b"SRTP"`` + u32 version (1)
+- blocks: u32 payload_len + payload (the size-prefix mirrors the
+  reference's size-prefixed flatbuffers so a stream can be split without
+  parsing records)
+- payload records, each starting with a u8 kind:
+  - 0 STRING_DEF: u32 id, u16 len, utf-8 bytes (interned names)
+  - 1 RANGE: u32 name_id, u8 category, u64 start_ns, u64 end_ns, u32 tid
+  - 2 INSTANT: u32 name_id, u8 category, u64 t_ns, u32 tid
+  - 3 COUNTER: u32 name_id, u64 t_ns, i64 value
+
+Offline conversion to JSON / chrome-trace: ``python -m
+spark_rapids_jni_tpu.obs.convert`` (the spark_rapids_profile_converter
+analog, spark_rapids_profile_converter.cpp:106-116).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import queue
+import struct
+import threading
+import time
+from typing import Optional
+
+from spark_rapids_jni_tpu.obs import seam as _seam
+
+__all__ = ["Profiler", "MAGIC", "VERSION"]
+
+MAGIC = b"SRTP"
+VERSION = 1
+
+_CATEGORIES = {_seam.OP: 0, _seam.TRANSFER: 1, _seam.COLLECTIVE: 2,
+               _seam.ALLOC: 3, "marker": 4}
+
+_R_STRING, _R_RANGE, _R_INSTANT, _R_COUNTER = 0, 1, 2, 3
+
+
+class _State:
+    """Module-singleton state (Profiler.java static API shape)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.writer = None
+        self.own_file = None
+        self.active = False  # between start() and stop()
+        self.buf = bytearray()
+        self.buf_limit = 1 << 16
+        self.completed: "queue.Queue" = queue.Queue()
+        self.writer_thread: Optional[threading.Thread] = None
+        self.names = {}
+        self.next_name_id = 0
+        self.xplane_dir: Optional[str] = None
+        self.initialized = False
+
+
+_st = _State()
+
+
+def _intern(name: str) -> int:
+    """Intern a name; emits a STRING_DEF record on first sight."""
+    nid = _st.names.get(name)
+    if nid is None:
+        nid = _st.next_name_id
+        _st.next_name_id += 1
+        _st.names[name] = nid
+        raw = name.encode("utf-8")
+        _st.buf += struct.pack("<BIH", _R_STRING, nid, len(raw)) + raw
+    return nid
+
+
+def _flush_active_locked():
+    if _st.buf:
+        _st.completed.put(bytes(_st.buf))
+        _st.buf = bytearray()
+
+
+def _emit(rec: bytes):
+    with _st.lock:
+        if not _st.active:
+            return
+        _st.buf += rec
+        if len(_st.buf) >= _st.buf_limit:
+            _flush_active_locked()
+            # string table resets with each buffer: every block is
+            # self-contained, so a consumer can start mid-stream
+            _st.names = {}
+            _st.next_name_id = 0
+
+
+def _writer_loop():
+    """Dedicated writer thread (writer_thread_process, ProfilerJni.cpp:366)."""
+    while True:
+        item = _st.completed.get()
+        if item is None:
+            return
+        _st.writer.write(struct.pack("<I", len(item)) + item)
+
+
+@contextlib.contextmanager
+def _range(category: str, name: str):
+    t0 = time.monotonic_ns()
+    try:
+        yield
+    finally:
+        t1 = time.monotonic_ns()
+        with _st.lock:
+            if _st.active:
+                nid = _intern(name)
+                _st.buf += struct.pack(
+                    "<BIBQQI", _R_RANGE, nid, _CATEGORIES.get(category, 0),
+                    t0, t1, threading.get_ident() & 0xFFFFFFFF)
+                if len(_st.buf) >= _st.buf_limit:
+                    _flush_active_locked()
+                    _st.names = {}
+                    _st.next_name_id = 0
+
+
+class Profiler:
+    """Static facade mirroring Profiler.java init/start/stop/shutdown."""
+
+    @staticmethod
+    def init(writer, *, buffer_bytes: int = 1 << 16,
+             xplane_dir: Optional[str] = None) -> None:
+        """Set up capture.  ``writer`` is a path or an object with
+        ``write(bytes)``; events flow only between start() and stop()."""
+        with _st.lock:
+            if _st.initialized:
+                raise RuntimeError("profiler already initialized")
+            if isinstance(writer, (str, bytes)):
+                _st.own_file = open(writer, "wb")
+                _st.writer = _st.own_file
+            else:
+                _st.writer = writer
+            _st.buf_limit = buffer_bytes
+            _st.xplane_dir = xplane_dir
+            _st.writer.write(MAGIC + struct.pack("<I", VERSION))
+            _st.writer_thread = threading.Thread(
+                target=_writer_loop, name="srt-profiler-writer", daemon=True)
+            _st.writer_thread.start()
+            _st.initialized = True
+        _seam._set_profiler(_range)
+
+    @staticmethod
+    def start() -> None:
+        with _st.lock:
+            if not _st.initialized:
+                raise RuntimeError("profiler not initialized")
+            _st.active = True
+        if _st.xplane_dir is not None:
+            import jax
+
+            jax.profiler.start_trace(_st.xplane_dir)
+
+    @staticmethod
+    def stop() -> None:
+        if _st.xplane_dir is not None:
+            import jax
+
+            jax.profiler.stop_trace()
+        with _st.lock:
+            _st.active = False
+            _flush_active_locked()
+            _st.names = {}
+            _st.next_name_id = 0
+
+    @staticmethod
+    def shutdown() -> None:
+        """Stop capture, drain the queue, detach from the seam."""
+        with _st.lock:
+            was_init = _st.initialized
+            _st.active = False
+            _flush_active_locked()
+        if not was_init:
+            return
+        _seam._set_profiler(None)
+        _st.completed.put(None)
+        _st.writer_thread.join(timeout=10)
+        if _st.own_file is not None:
+            _st.own_file.close()
+        with _st.lock:
+            _st.writer = None
+            _st.own_file = None
+            _st.writer_thread = None
+            _st.names = {}
+            _st.next_name_id = 0
+            _st.initialized = False
+
+    # -- extra event sources ------------------------------------------------
+    @staticmethod
+    def marker(name: str) -> None:
+        """Instant event (NVTX marker analog)."""
+        with _st.lock:
+            if _st.active:
+                nid = _intern(name)
+                _st.buf += struct.pack(
+                    "<BIBQI", _R_INSTANT, nid, _CATEGORIES["marker"],
+                    time.monotonic_ns(), threading.get_ident() & 0xFFFFFFFF)
+
+    @staticmethod
+    def counter(name: str, value: int) -> None:
+        with _st.lock:
+            if _st.active:
+                nid = _intern(name)
+                _st.buf += struct.pack(
+                    "<BIQq", _R_COUNTER, nid, time.monotonic_ns(), value)

@@ -1,0 +1,73 @@
+"""The framework dispatch seam: one instrumentation point for every op call.
+
+The reference hooks its observability and chaos tooling into the CUDA API
+boundary from outside the op code (CUPTI subscriber for the profiler,
+ProfilerJni.cpp:437; CUDA_INJECTION64_PATH driver hook for fault injection,
+faultinj/faultinj.cu).  The equivalent boundary here is the public op
+dispatch: every call to an instrumented op/transfer/collective passes through
+:func:`seam`, which consults the fault injector (may raise) and the profiler
+(records a range).  When neither is active the overhead is two module-flag
+checks.
+
+Categories mirror the activity kinds the reference captures: ``op`` (kernel
+launches), ``transfer`` (host<->device movement), ``collective`` (multi-chip
+exchange), ``alloc`` (memory governance).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Callable, Optional
+
+__all__ = ["seam", "instrument", "OP", "TRANSFER", "COLLECTIVE", "ALLOC"]
+
+OP = "op"
+TRANSFER = "transfer"
+COLLECTIVE = "collective"
+ALLOC = "alloc"
+
+# registered sinks; None = inactive (checked without locks on the hot path)
+_injector: Optional[Callable[[str, str], None]] = None  # may raise
+_profiler_range: Optional[Callable[[str, str], "contextlib.AbstractContextManager"]] = None
+
+
+def _set_injector(fn: Optional[Callable[[str, str], None]]) -> None:
+    global _injector
+    _injector = fn
+
+
+def _set_profiler(fn) -> None:
+    global _profiler_range
+    _profiler_range = fn
+
+
+@contextlib.contextmanager
+def seam(category: str, name: str):
+    """Cross the instrumented dispatch boundary."""
+    inj = _injector
+    if inj is not None:
+        inj(category, name)  # may raise an injected fault
+    prof = _profiler_range
+    if prof is None:
+        yield
+        return
+    with prof(category, name):
+        yield
+
+
+def instrument(category: str, name: str):
+    """Decorator form: wrap a callable in the dispatch seam."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            if _injector is None and _profiler_range is None:
+                return fn(*args, **kwargs)
+            with seam(category, name):
+                return fn(*args, **kwargs)
+
+        wrapped.__srt_seam__ = (category, name)
+        return wrapped
+
+    return deco

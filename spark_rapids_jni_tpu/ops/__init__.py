@@ -115,3 +115,28 @@ __all__ = [
     "add128",
     "subtract128",
 ]
+
+# Route every public op function through the dispatch seam — the boundary
+# where the profiler records ranges and the fault injector may raise
+# (obs/seam.py; the CUPTI-subscription analog, zero changes to op code).
+import spark_rapids_jni_tpu.obs.faultinj as _faultinj  # noqa: E402
+import spark_rapids_jni_tpu.obs.seam as _seam_mod  # noqa: E402
+
+for _name in __all__:
+    _fn = globals()[_name]
+    if callable(_fn) and not isinstance(_fn, type):
+        globals()[_name] = _seam_mod.instrument(_seam_mod.OP, _name)(_fn)
+del _name, _fn
+
+# CUDA_INJECTION64_PATH-style auto-arming via env var; a broken config must
+# not make the library unimportable
+try:
+    _faultinj.install_from_env()
+except Exception as _e:  # noqa: BLE001
+    import warnings as _warnings
+
+    _warnings.warn(
+        f"fault injector config ({_faultinj.ENV_CONFIG_PATH}) ignored: {_e!r}",
+        RuntimeWarning,
+        stacklevel=2,
+    )

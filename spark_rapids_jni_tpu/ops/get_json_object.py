@@ -201,9 +201,16 @@ def _string_states(b_j: jnp.ndarray, lens_j: jnp.ndarray) -> jnp.ndarray:
     return jnp.pad(st_after, ((0, 0), (1, 0)))[:, :L]
 
 
-def _byte_info(b_j: jnp.ndarray, lens_j: jnp.ndarray) -> _ByteInfo:
+def _byte_info(b_j: jnp.ndarray, lens_j: jnp.ndarray,
+               n_valid: Optional[int] = None) -> _ByteInfo:
+    """Per-byte tables for a bucket.  The jitted automaton sees the full
+    pow2-padded shape (bounded compile-variant set); the host-side numpy
+    passes run only on the first ``n_valid`` real rows."""
     st_before = np.asarray(_string_states(b_j, lens_j))
     b = np.asarray(b_j)
+    if n_valid is not None:
+        st_before = st_before[:n_valid]
+        b = b[:n_valid]
     n, L = b.shape
 
     in_dq = (st_before == jt._S_DQ)
@@ -1019,12 +1026,7 @@ def get_json_object(col: StringColumn, path: Sequence[tuple]) -> StringColumn:
         ok = np.asarray(ts.ok)[: b.n_valid]
         rows_np = np.asarray(b.rows)[: b.n_valid]
 
-        # run the jitted automaton on the full pow2-padded bucket (bounded
-        # compile-shape set), then slice the host copies to the real rows
-        bi = _byte_info(b.bytes, b.lengths)
-        if b.n_valid < b.n_rows:
-            for f in dataclasses.fields(bi):
-                setattr(bi, f.name, getattr(bi, f.name)[: b.n_valid])
+        bi = _byte_info(b.bytes, b.lengths, n_valid=b.n_valid)
         len_raw, len_esc, has_uni, neg0 = _token_tables(bi, kind, start, end)
         nm = _name_matches(bi, kind, start, end, names, len_raw, has_uni)
         ftext, flen, fidx = _float_texts(bi, kind, start, end)

@@ -24,6 +24,7 @@ from typing import Dict, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from spark_rapids_jni_tpu.obs.seam import COLLECTIVE, instrument
 from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS
 
 
@@ -55,6 +56,7 @@ def bucket_by_partition(part: jnp.ndarray, n_parts: int, capacity: int):
     return slot, in_cap, counts
 
 
+@instrument(COLLECTIVE, "all_to_all_shuffle")
 def all_to_all_shuffle(
     columns: Dict[str, jnp.ndarray],
     part: jnp.ndarray,
@@ -63,6 +65,9 @@ def all_to_all_shuffle(
 ) -> ShuffleResult:
     """Exchange rows so each device receives the rows whose ``part`` equals its
     index along ``axis``.  Must be called inside shard_map over ``axis``.
+
+    The seam range covers the dispatch (trace) boundary; on-chip timing comes
+    from the profiler's optional XPlane capture.
     """
     ndev = jax.lax.axis_size(axis)
     slot, in_cap, _counts = bucket_by_partition(part, ndev, capacity)

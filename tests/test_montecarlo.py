@@ -1,0 +1,35 @@
+"""Seeded monte-carlo stress of the memory-governance state machine
+(RmmSparkMonteCarlo / ci/fuzz-test.sh analog, short mode for the suite)."""
+
+from spark_rapids_jni_tpu.mem.montecarlo import (
+    MonteCarloConfig,
+    run_monte_carlo,
+)
+
+
+def test_monte_carlo_short():
+    cfg = MonteCarloConfig(
+        n_tasks=12, n_threads=6, n_shuffle_threads=2,
+        budget_bytes=4 << 20, task_max_bytes=3 << 20,
+        allocs_per_task=30, skewed=True, inject_retry_pct=10.0, seed=42,
+    )
+    stats = run_monte_carlo(cfg)
+    assert stats.ok, stats.failures
+    assert stats.tasks_completed == 12
+    assert stats.injected > 0          # chaos actually fired
+    assert stats.retries >= stats.injected
+    assert stats.leaked_bytes == 0
+    assert stats.blocked_at_end == 0
+    assert stats.peak_used <= cfg.budget_bytes
+
+
+def test_monte_carlo_no_injection_deterministic():
+    cfg = MonteCarloConfig(
+        n_tasks=6, n_threads=3, n_shuffle_threads=1,
+        budget_bytes=2 << 20, task_max_bytes=1 << 20,
+        allocs_per_task=15, skewed=False, inject_retry_pct=0.0, seed=1,
+    )
+    stats = run_monte_carlo(cfg)
+    assert stats.ok, stats.failures
+    assert stats.tasks_completed == 6
+    assert stats.injected == 0

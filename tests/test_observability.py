@@ -1,0 +1,187 @@
+"""Profiler capture/convert, fault injection, and dispatch-seam tests."""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from spark_rapids_jni_tpu.columnar.column import column, strings_column
+from spark_rapids_jni_tpu.columnar.dtypes import INT32
+from spark_rapids_jni_tpu.mem.exceptions import (
+    GpuRetryOOM,
+    GpuSplitAndRetryOOM,
+    InjectedException,
+)
+from spark_rapids_jni_tpu.obs import FaultInjector, Profiler
+from spark_rapids_jni_tpu.obs.convert import parse_capture, to_chrome
+from spark_rapids_jni_tpu import ops
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    FaultInjector.uninstall()
+    Profiler.shutdown()
+
+
+def _run_some_ops():
+    col = column([1, 2, 3, None], INT32)
+    ops.murmur_hash32([col], seed=42)
+    ops.xxhash64([col])
+
+
+def test_profiler_capture_and_convert(tmp_path):
+    path = tmp_path / "capture.srtp"
+    Profiler.init(str(path))
+    Profiler.start()
+    _run_some_ops()
+    Profiler.marker("checkpoint-a")
+    Profiler.counter("batch_rows", 4)
+    Profiler.stop()
+    Profiler.shutdown()
+
+    events = list(parse_capture(path.read_bytes()))
+    ranges = [e for e in events if e["type"] == "range"]
+    names = {e["name"] for e in ranges}
+    assert "murmur_hash32" in names and "xxhash64" in names
+    assert all(e["category"] == "op" for e in ranges)
+    assert all(e["end_ns"] >= e["start_ns"] for e in ranges)
+    markers = [e for e in events if e["type"] == "instant"]
+    assert markers and markers[0]["name"] == "checkpoint-a"
+    counters = [e for e in events if e["type"] == "counter"]
+    assert counters and counters[0]["value"] == 4
+
+    chrome = to_chrome(events)
+    assert any(t["ph"] == "X" and t["name"] == "murmur_hash32"
+               for t in chrome["traceEvents"])
+
+
+def test_profiler_writer_object_and_block_framing():
+    sink = io.BytesIO()
+    Profiler.init(sink, buffer_bytes=64)  # tiny buffer: force many blocks
+    Profiler.start()
+    for i in range(50):
+        Profiler.marker(f"m{i}")
+    Profiler.stop()
+    Profiler.shutdown()
+    data = sink.getvalue()
+    events = list(parse_capture(data))
+    assert sum(e["type"] == "instant" for e in events) == 50
+    # every block is self-contained (string table restarts per block)
+    assert {e["name"] for e in events} == {f"m{i}" for i in range(50)}
+
+
+def test_profiler_inactive_records_nothing():
+    sink = io.BytesIO()
+    Profiler.init(sink)
+    _run_some_ops()  # before start(): nothing captured
+    Profiler.start()
+    Profiler.stop()
+    Profiler.shutdown()
+    assert list(parse_capture(sink.getvalue())) == []
+
+
+def test_convert_cli(tmp_path):
+    path = tmp_path / "c.srtp"
+    Profiler.init(str(path))
+    Profiler.start()
+    Profiler.marker("cli-marker")
+    Profiler.stop()
+    Profiler.shutdown()
+    out = subprocess.run(
+        [sys.executable, "-m", "spark_rapids_jni_tpu.obs.convert",
+         str(path), "--format", "json"],
+        capture_output=True, text=True, check=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    lines = [json.loads(l) for l in out.stdout.splitlines()]
+    assert any(e["name"] == "cli-marker" for e in lines)
+
+
+def test_fault_injection_by_name_and_count():
+    FaultInjector.install({
+        "op": {"murmur_hash32": {"injectionType": "exception",
+                                 "interceptionCount": 2}},
+    })
+    col = column([1, 2], INT32)
+    for _ in range(2):
+        with pytest.raises(InjectedException, match="murmur_hash32"):
+            ops.murmur_hash32([col], seed=42)
+    # count exhausted: op works again
+    assert ops.murmur_hash32([col], seed=42).to_list() is not None
+    # other ops unaffected throughout
+    assert ops.xxhash64([col]).to_list() is not None
+
+
+def test_fault_injection_wildcard_and_types():
+    FaultInjector.install({
+        "op": {"*": {"injectionType": "retry_oom", "interceptionCount": 1}},
+    })
+    col = strings_column(["1.5"])
+    with pytest.raises(GpuRetryOOM):
+        ops.string_to_float(col, ansi_mode=False)
+    # exhausted
+    ops.string_to_float(col, ansi_mode=False)
+    FaultInjector.uninstall()
+
+    FaultInjector.install({
+        "op": {"xxhash64": {"injectionType": "split_oom"}},
+    })
+    icol = column([1], INT32)
+    with pytest.raises(GpuSplitAndRetryOOM):
+        ops.xxhash64([icol])
+
+
+def test_fault_injection_percent_seeded():
+    FaultInjector.install({
+        "seed": 7,
+        "op": {"murmur_hash32": {"injectionType": "exception",
+                                 "percent": 50}},
+    })
+    col = column([1], INT32)
+    hits = 0
+    for _ in range(100):
+        try:
+            ops.murmur_hash32([col], seed=0)
+        except InjectedException:
+            hits += 1
+    assert 20 <= hits <= 80  # seeded coin; bounds loose but meaningful
+
+
+def test_fault_injection_hot_reload(tmp_path):
+    cfg = tmp_path / "faults.json"
+    cfg.write_text(json.dumps({"dynamic": True, "op": {}}))
+    FaultInjector.install(str(cfg))
+    col = column([1], INT32)
+    ops.murmur_hash32([col], seed=0)  # no faults configured
+    cfg.write_text(json.dumps({
+        "dynamic": True,
+        "op": {"murmur_hash32": {"injectionType": "exception"}},
+    }))
+    os.utime(cfg, (time.time() + 2, time.time() + 2))
+    deadline = time.time() + 5
+    fired = False
+    while time.time() < deadline and not fired:
+        try:
+            ops.murmur_hash32([col], seed=0)
+            time.sleep(0.05)
+        except InjectedException:
+            fired = True
+    assert fired, "hot reload never armed the new rule"
+
+
+def test_env_var_activation(tmp_path, monkeypatch):
+    from spark_rapids_jni_tpu.obs import faultinj as fi
+
+    cfg = tmp_path / "env_faults.json"
+    cfg.write_text(json.dumps(
+        {"op": {"xxhash64": {"injectionType": "exception"}}}))
+    monkeypatch.setenv(fi.ENV_CONFIG_PATH, str(cfg))
+    assert fi.install_from_env() is not None
+    with pytest.raises(InjectedException):
+        ops.xxhash64([column([1], INT32)])

@@ -134,6 +134,7 @@ def test_row_group_split_filtering(flat_file):
     assert f.num_rows == 500
     meta = read_meta(f.serialize_thrift_file())
     assert meta.num_row_groups == 2
+    assert meta.num_rows == 500  # file-level count tracks surviving groups
     # the complementary split keeps the rest
     f2 = ParquetFooter.read_and_filter(fb, split_end, 1 << 40,
                                        full_schema_flat(), False)
