@@ -1,12 +1,22 @@
-"""Headline benchmark: Spark-exact murmur3-32 over a single INT32 column.
+"""Staged benchmarks vs a *measured* HBM roofline.
 
-This is BASELINE.md staged config 1 ("Hash.murmurHash32 on a single INT32
-ColumnVector").  The reference publishes no absolute numbers (BASELINE.md:3-16,
-nvbench infra only); `vs_baseline` is therefore reported against a nominal
-1.0 Grows/s — the order of magnitude an A100/H100-class GPU achieves on this
-memory-bound elementwise kernel (4B in / 4B out per row at ~TB/s HBM).
+Covers BASELINE.md staged configs 1-4 (the reference's nvbench list,
+benchmarks/CMakeLists.txt:72-85 maps to the same ops):
 
-Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+1. murmur3-32 over one INT32 column (headline metric)
+2. string<->float casts (string_to_float / float_to_string)
+3. JCUDF row conversion to/from rows (fixed-width)
+4. bloom filter build+probe and decimal128 multiply
+
+The roofline is measured on the same device with a saturating copy kernel
+(read+write of a large f32 array); every config reports achieved bytes/s as
+a fraction of it, answering "how far from the memory bound are we" without a
+flattering nominal (round-1 feedback).  Host-orchestrated ops (string
+parsing) additionally report wall-clock rows/s — their cost is real even
+where the device is idle.
+
+Prints ONE json line: the headline murmur3 metric, with every config and the
+roofline under "detail".
 """
 
 import json
@@ -16,46 +26,163 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-NOMINAL_BASELINE_ROWS_PER_S = 1.0e9
+NOMINAL_BASELINE_ROWS_PER_S = 1.0e9  # order-of-magnitude GPU figure, config 1
+
+
+def _time(fn, iters, *args):
+    out = fn(*args)
+    _block(out)  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _block(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def _block(out):
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(out):
+        if hasattr(leaf, "block_until_ready"):
+            leaf.block_until_ready()
 
 
 def main():
+    # Fail fast instead of hanging forever when the TPU tunnel is dead
+    # (shared probe with the driver's dryrun entry point).
+    from __graft_entry__ import probe_ambient
+
+    usable, reason = probe_ambient(1, timeout=180)
+    if not usable:
+        print(json.dumps({
+            "metric": "murmur3_32_int32_throughput", "value": 0.0,
+            "unit": "Grows/s", "vs_baseline": 0.0,
+            "detail": {"error": f"device unusable: {reason}"},
+        }))
+        return
+
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from spark_rapids_jni_tpu.columnar import Column, INT32
-    from spark_rapids_jni_tpu.ops import murmur_hash32
-
-    n = int(os.environ.get("BENCH_ROWS", 1 << 24))  # 16M rows
-    rng = np.random.RandomState(42)
-    data = jnp.asarray(rng.randint(-(2**31), 2**31, size=n).astype(np.int32))
-
-    @jax.jit
-    def hash_col(d):
-        return murmur_hash32([Column(d, None, INT32)], seed=42).data
-
-    out = hash_col(data)
-    out.block_until_ready()  # compile + warm
-
-    iters = int(os.environ.get("BENCH_ITERS", 50))
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = hash_col(data)
-    out.block_until_ready()
-    dt = (time.perf_counter() - t0) / iters
-
-    rows_per_s = n / dt
-    print(
-        json.dumps(
-            {
-                "metric": "murmur3_32_int32_throughput",
-                "value": round(rows_per_s / 1e9, 4),
-                "unit": "Grows/s",
-                "vs_baseline": round(rows_per_s / NOMINAL_BASELINE_ROWS_PER_S, 4),
-            }
-        )
+    from spark_rapids_jni_tpu.columnar import Column, INT64, INT32, FLOAT64
+    from spark_rapids_jni_tpu.columnar.dtypes import DType, Kind
+    from spark_rapids_jni_tpu.ops import (
+        bloom_filter_create,
+        bloom_filter_probe,
+        bloom_filter_put,
+        convert_from_rows_fixed_width_optimized,
+        convert_to_rows_fixed_width_optimized,
+        float_to_string,
+        multiply128,
+        murmur_hash32,
+        string_to_float,
     )
+
+    from spark_rapids_jni_tpu import config
+
+    detail = {}
+    n = config.get("bench_rows")
+    iters = config.get("bench_iters")
+    rng = np.random.RandomState(42)
+
+    # ---- measured HBM roofline (read + write of f32) ----------------------
+    big = jnp.asarray(rng.rand(max(n, 1 << 24)).astype(np.float32))
+    copy = jax.jit(lambda x: x + 1.0)
+    dt = _time(copy, iters, big)
+    roofline_bytes_s = 2 * big.size * 4 / dt
+    detail["hbm_roofline_GBps"] = round(roofline_bytes_s / 1e9, 1)
+
+    # ---- config 1: murmur3-32 on INT32 ------------------------------------
+    data = jnp.asarray(rng.randint(-(2**31), 2**31, size=n).astype(np.int32))
+    hash_col = jax.jit(
+        lambda d: murmur_hash32([Column(d, None, INT32)], seed=42).data)
+    dt = _time(hash_col, iters, data)
+    mm_rows_s = n / dt
+    detail["murmur3_int32"] = {
+        "Grows_per_s": round(mm_rows_s / 1e9, 3),
+        "roofline_frac": round(mm_rows_s * 8 / roofline_bytes_s, 3),
+    }
+
+    # ---- config 2: string<->float -----------------------------------------
+    ns = min(n, 1 << 20)  # host-orchestrated: smaller working set
+    fvals = rng.rand(ns) * np.exp(rng.uniform(-30, 30, size=ns))
+    fcol = Column(jnp.asarray(fvals.view(np.int64)), None, FLOAT64)
+    dt = _time(lambda c: float_to_string(c).chars, max(iters // 4, 3), fcol)
+    f2s_rows_s = ns / dt
+    scol = float_to_string(fcol)
+    dt = _time(
+        lambda c: string_to_float(c, ansi_mode=False, dtype=FLOAT64).data,
+        max(iters // 4, 3), scol)
+    s2f_rows_s = ns / dt
+    detail["float_to_string"] = {"Mrows_per_s": round(f2s_rows_s / 1e6, 2)}
+    detail["string_to_float"] = {"Mrows_per_s": round(s2f_rows_s / 1e6, 2)}
+
+    # ---- config 3: row conversion (fixed-width) ---------------------------
+    nr = min(n, 1 << 22)
+    cols = [
+        Column(jnp.asarray(rng.randint(-(2**31), 2**31, nr, dtype=np.int64)),
+               None, INT64),
+        Column(jnp.asarray(rng.randint(-(2**31), 2**31, nr).astype(np.int32)),
+               None, INT32),
+        Column(jnp.asarray(rng.rand(nr).view(np.int64)), None, FLOAT64),
+    ]
+    row_bytes = 8 + 4 + 8 + 4  # 8B-aligned JCUDF row incl. pad + validity
+    dt = _time(lambda: convert_to_rows_fixed_width_optimized(cols),
+               max(iters // 4, 3))
+    to_rows_s = nr / dt
+    rows_col = convert_to_rows_fixed_width_optimized(cols)[0]
+    dtypes = [INT64, INT32, FLOAT64]
+    dt = _time(
+        lambda: convert_from_rows_fixed_width_optimized(rows_col, dtypes),
+        max(iters // 4, 3))
+    from_rows_s = nr / dt
+    detail["rows_to"] = {
+        "Mrows_per_s": round(to_rows_s / 1e6, 2),
+        "roofline_frac": round(to_rows_s * 2 * row_bytes / roofline_bytes_s, 3),
+    }
+    detail["rows_from"] = {
+        "Mrows_per_s": round(from_rows_s / 1e6, 2),
+        "roofline_frac": round(from_rows_s * 2 * row_bytes / roofline_bytes_s, 3),
+    }
+
+    # ---- config 4: bloom filter build+probe, decimal128 multiply ----------
+    keys = Column(jnp.asarray(rng.randint(0, 1 << 62, n, dtype=np.int64)),
+                  None, INT64)
+    bf0 = bloom_filter_create(3, 1 << 15)
+
+    def build_and_probe(k):
+        bf = bloom_filter_put(bf0, k)
+        return bloom_filter_probe(k, bf).data
+
+    dt = _time(build_and_probe, max(iters // 4, 3), keys)
+    bloom_rows_s = n / dt
+    detail["bloom_build_probe"] = {
+        "Mrows_per_s": round(bloom_rows_s / 1e6, 2),
+        "roofline_frac": round(bloom_rows_s * 16 / roofline_bytes_s, 3),
+    }
+
+    from spark_rapids_jni_tpu.columnar.column import Decimal128Column
+
+    nd = min(n, 1 << 22)
+    lo = rng.randint(0, 1 << 62, nd, dtype=np.uint64)
+    hi = rng.randint(-(1 << 30), 1 << 30, nd, dtype=np.int64)
+    d128 = DType(Kind.DECIMAL128, scale=2)
+    a = Decimal128Column(jnp.asarray(hi), jnp.asarray(lo), None, d128)
+    mul = jax.jit(lambda x_hi, x_lo: tuple(
+        c.hi if hasattr(c, "hi") else c.data
+        for c in multiply128(Decimal128Column(x_hi, x_lo, None, d128),
+                             Decimal128Column(x_hi, x_lo, None, d128), 2)))
+    dt = _time(mul, max(iters // 8, 2), a.hi, a.lo)
+    detail["decimal128_multiply"] = {"Mrows_per_s": round(nd / dt / 1e6, 2)}
+
+    print(json.dumps({
+        "metric": "murmur3_32_int32_throughput",
+        "value": round(mm_rows_s / 1e9, 4),
+        "unit": "Grows/s",
+        "vs_baseline": round(mm_rows_s / NOMINAL_BASELINE_ROWS_PER_S, 4),
+        "detail": detail,
+    }))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,105 @@
+"""Self-contained lint gate (no external linters in the image).
+
+Checks, in the spirit of the reference's clang-format CI gate
+(.github/workflows/clang-format.yml): every file must parse, imports must be
+used, no tabs / trailing whitespace / overlong lines.
+
+Run: ``python ci/lint.py`` (exit 1 on findings).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+MAX_LINE = 100
+ROOTS = ["spark_rapids_jni_tpu", "tests", "bench.py", "__graft_entry__.py",
+         "boot_cpu_mesh.py", "ci"]
+
+
+def iter_py_files(repo_root: str):
+    for root in ROOTS:
+        path = os.path.join(repo_root, root)
+        if os.path.isfile(path):
+            yield path
+        else:
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for f in filenames:
+                    if f.endswith(".py"):
+                        yield os.path.join(dirpath, f)
+
+
+class _ImportChecker(ast.NodeVisitor):
+    """Unused-import detection: imported names never referenced."""
+
+    def __init__(self):
+        self.imported = {}  # name -> lineno
+        self.used = set()
+
+    def visit_Import(self, node):
+        for a in node.names:
+            name = (a.asname or a.name).split(".")[0]
+            self.imported[name] = node.lineno
+
+    def visit_ImportFrom(self, node):
+        if node.module == "__future__":
+            return
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.imported[a.asname or a.name] = node.lineno
+
+    def visit_Name(self, node):
+        self.used.add(node.id)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+
+def check_file(path: str):
+    findings = []
+    with open(path, "rb") as f:
+        raw = f.read()
+    text = raw.decode("utf-8")
+    try:
+        tree = ast.parse(text, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+
+    for i, line in enumerate(text.splitlines(), 1):
+        if "noqa" in line:
+            continue
+        if "\t" in line:
+            findings.append(f"{path}:{i}: tab character")
+        if line != line.rstrip():
+            findings.append(f"{path}:{i}: trailing whitespace")
+        if len(line) > MAX_LINE and "http" not in line:
+            findings.append(f"{path}:{i}: line too long ({len(line)})")
+
+    chk = _ImportChecker()
+    chk.visit(tree)
+    # __init__.py re-exports are used by importers, not the module itself
+    if not path.endswith("__init__.py"):
+        for name, lineno in chk.imported.items():
+            if name not in chk.used and name not in text.split("__all__", 1)[-1]:
+                findings.append(f"{path}:{lineno}: unused import {name!r}")
+    return findings
+
+
+def main() -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = []
+    n = 0
+    for path in iter_py_files(repo_root):
+        n += 1
+        findings.extend(check_file(path))
+    for f in findings:
+        print(f)
+    print(f"lint: {n} files, {len(findings)} findings")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# CI test entry (premerge-build.sh analog): lint, unit suite on a virtual
+# 8-device CPU mesh, arbiter fuzz (fuzz-test.sh analog), multichip dryrun.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python ci/lint.py
+
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu SRT_REEXECED=1 \
+    python -m pytest tests/ -q
+
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m spark_rapids_jni_tpu.mem.montecarlo \
+    --tasks 16 --threads 8 --shuffle-threads 2 \
+    --budget-mib 8 --task-max-mib 6 --allocs 40 --skewed --inject-pct 10 \
+    --seed "${FUZZ_SEED:-0}"
+
+python -c "
+from __graft_entry__ import dryrun_multichip
+dryrun_multichip(8)
+"

@@ -28,7 +28,8 @@ import jax
 if os.environ.get("SPARK_RAPIDS_TPU_NO_X64") != "1":  # escape hatch for embedders
     jax.config.update("jax_enable_x64", True)
 
-__version__ = "26.08.0"
+from spark_rapids_jni_tpu.version import VERSION as __version__  # noqa: E402
+from spark_rapids_jni_tpu.version import build_info  # noqa: E402
 
 from spark_rapids_jni_tpu.columnar import (  # noqa: E402
     Column,

@@ -1,0 +1,145 @@
+"""Typed runtime flags backed by environment variables.
+
+The reference has no runtime config framework of its own — it uses build
+flags, Java system properties (``ai.rapids.cudf.spark.rmmWatchdogPollingPeriod``,
+SparkResourceAdaptor.java:35), and env vars for tooling
+(``FAULT_INJECTOR_CONFIG_PATH``) — see SURVEY.md §5 config/flag system.  This
+module is the coherent analog: one registry of every knob the framework
+reads, each with a type, default, env var, and doc string, plus runtime
+override support for tests.
+
+Usage::
+
+    from spark_rapids_jni_tpu import config
+    rows = config.get("bench_rows")
+    with config.override(json_fuzz_rows=10000):
+        ...
+    config.describe()   # -> human-readable flag table
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["Flag", "register", "get", "set", "override", "describe", "FLAGS"]
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass
+class Flag:
+    name: str
+    default: Any
+    env: str
+    parser: Callable[[str], Any]
+    doc: str
+
+
+FLAGS: Dict[str, Flag] = {}
+_overrides: Dict[str, Any] = {}
+_lock = threading.Lock()
+
+
+def register(name: str, default: Any, doc: str,
+             env: Optional[str] = None,
+             parser: Optional[Callable[[str], Any]] = None) -> Flag:
+    """Register a flag; the env var defaults to ``SRT_<NAME>``."""
+    if env is None:
+        env = "SRT_" + name.upper()
+    if parser is None:
+        if isinstance(default, bool):
+            parser = _parse_bool
+        elif isinstance(default, int):
+            parser = int
+        elif isinstance(default, float):
+            parser = float
+        else:
+            parser = str
+    flag = Flag(name, default, env, parser, doc)
+    with _lock:
+        if name in FLAGS:
+            raise ValueError(f"flag {name!r} already registered")
+        FLAGS[name] = flag
+    return flag
+
+
+def get(name: str) -> Any:
+    """Resolve a flag: runtime override > env var > default."""
+    flag = FLAGS[name]
+    with _lock:
+        if name in _overrides:
+            return _overrides[name]
+    raw = os.environ.get(flag.env)
+    if raw is not None:
+        try:
+            return flag.parser(raw)
+        except (ValueError, TypeError):
+            import warnings
+
+            warnings.warn(f"ignoring unparsable {flag.env}={raw!r}",
+                          RuntimeWarning, stacklevel=2)
+    return flag.default
+
+
+def set(name: str, value: Any) -> None:  # noqa: A001 - flag-registry verb
+    if name not in FLAGS:
+        raise KeyError(f"unknown flag {name!r}")
+    with _lock:
+        _overrides[name] = value
+
+
+@contextlib.contextmanager
+def override(**kv):
+    """Temporarily override flags (tests)."""
+    with _lock:
+        saved = dict(_overrides)
+        for name, value in kv.items():
+            if name not in FLAGS:
+                raise KeyError(f"unknown flag {name!r}")
+            _overrides[name] = value
+    try:
+        yield
+    finally:
+        with _lock:
+            _overrides.clear()
+            _overrides.update(saved)
+
+
+def describe() -> str:
+    lines = []
+    for name in sorted(FLAGS):
+        f = FLAGS[name]
+        cur = get(name)
+        lines.append(f"{name} = {cur!r}  [env {f.env}, default {f.default!r}]"
+                     f"\n    {f.doc}")
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# framework flags (every env knob the package reads, in one place)
+# --------------------------------------------------------------------------
+
+register("test_tpu", False,
+         "Run the pytest suite on the real TPU instead of the virtual CPU "
+         "mesh (slow: remote-compiles every kernel).", env="SRT_TEST_TPU")
+register("bench_rows", 1 << 24,
+         "Row count for bench.py workloads.", env="BENCH_ROWS")
+register("bench_iters", 20,
+         "Timed iterations per bench.py workload.", env="BENCH_ITERS")
+register("json_fuzz_rows", 300,
+         "Row count for the get_json_object fuzz-vs-oracle test.",
+         env="SRT_JSON_FUZZ_ROWS")
+register("fault_injector_config_path", "",
+         "JSON config that arms the fault injector at import "
+         "(obs/faultinj.py; the FAULT_INJECTOR_CONFIG_PATH analog).",
+         env="SRT_FAULT_INJECTOR_CONFIG_PATH")
+register("watchdog_period_s", 0.1,
+         "Memory-governor deadlock-watchdog poll period (the "
+         "rmmWatchdogPollingPeriod analog, SparkResourceAdaptor.java:35).",
+         env="SRT_WATCHDOG_PERIOD_S")

@@ -84,7 +84,7 @@ def _ensure_lib() -> ctypes.CDLL:
         i64 = ctypes.c_int64
         for name, args, res in [
             ("arbiter_start_dedicated_task_thread", [ctypes.c_void_p, i64, i64], ctypes.c_int),
-            ("arbiter_pool_thread_working_on_task", [ctypes.c_void_p, i64, i64, ctypes.c_int], ctypes.c_int),
+            ("arbiter_pool_thread_working_on_task", [ctypes.c_void_p, i64, i64, ctypes.c_int], ctypes.c_int),  # noqa
             ("arbiter_pool_thread_finished_for_task", [ctypes.c_void_p, i64, i64], ctypes.c_int),
             ("arbiter_remove_thread_association", [ctypes.c_void_p, i64, i64], ctypes.c_int),
             ("arbiter_task_done", [ctypes.c_void_p, i64], ctypes.c_int),
@@ -92,12 +92,12 @@ def _ensure_lib() -> ctypes.CDLL:
             ("arbiter_set_externally_blocked", [ctypes.c_void_p, i64, ctypes.c_int], ctypes.c_int),
             ("arbiter_start_retry_block", [ctypes.c_void_p, i64], ctypes.c_int),
             ("arbiter_end_retry_block", [ctypes.c_void_p, i64], ctypes.c_int),
-            ("arbiter_force_retry_oom", [ctypes.c_void_p, i64, ctypes.c_int, ctypes.c_int, ctypes.c_int], ctypes.c_int),
-            ("arbiter_force_split_and_retry_oom", [ctypes.c_void_p, i64, ctypes.c_int, ctypes.c_int, ctypes.c_int], ctypes.c_int),
+            ("arbiter_force_retry_oom", [ctypes.c_void_p, i64, ctypes.c_int, ctypes.c_int, ctypes.c_int], ctypes.c_int),  # noqa
+            ("arbiter_force_split_and_retry_oom", [ctypes.c_void_p, i64, ctypes.c_int, ctypes.c_int, ctypes.c_int], ctypes.c_int),  # noqa
             ("arbiter_force_cudf_exception", [ctypes.c_void_p, i64, ctypes.c_int], ctypes.c_int),
             ("arbiter_pre_alloc", [ctypes.c_void_p, i64, ctypes.c_int, ctypes.c_int], ctypes.c_int),
-            ("arbiter_post_alloc_success", [ctypes.c_void_p, i64, ctypes.c_int, ctypes.c_int], ctypes.c_int),
-            ("arbiter_post_alloc_failed", [ctypes.c_void_p, i64, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int], ctypes.c_int),
+            ("arbiter_post_alloc_success", [ctypes.c_void_p, i64, ctypes.c_int, ctypes.c_int], ctypes.c_int),  # noqa
+            ("arbiter_post_alloc_failed", [ctypes.c_void_p, i64, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int], ctypes.c_int),  # noqa
             ("arbiter_dealloc", [ctypes.c_void_p, i64, ctypes.c_int], ctypes.c_int),
             ("arbiter_block_thread_until_ready", [ctypes.c_void_p, i64], ctypes.c_int),
             ("arbiter_check_and_break_deadlocks", [ctypes.c_void_p], ctypes.c_int),
@@ -193,7 +193,7 @@ class Arbiter:
     # alloc protocol --------------------------------------------------------
     def pre_alloc(self, thread_id, is_cpu=False, blocking=True) -> bool:
         """True if this is a recursive (spill) allocation."""
-        return self._check(self._lib.arbiter_pre_alloc(self._h, thread_id, is_cpu, blocking)) == RECURSIVE
+        return self._check(self._lib.arbiter_pre_alloc(self._h, thread_id, is_cpu, blocking)) == RECURSIVE  # noqa
 
     def post_alloc_success(self, thread_id, is_cpu=False, was_recursive=False):
         self._check(

@@ -37,7 +37,12 @@ class MemoryGovernor:
     _instance: Optional["MemoryGovernor"] = None
     _lock = threading.Lock()
 
-    def __init__(self, log_path: str | None = None, watchdog_period_s: float = 0.1):
+    def __init__(self, log_path: str | None = None,
+                 watchdog_period_s: float | None = None):
+        if watchdog_period_s is None:
+            from spark_rapids_jni_tpu import config
+
+            watchdog_period_s = config.get("watchdog_period_s")
         self.arbiter = Arbiter(log_path)
         self._shutdown = threading.Event()
         self._watchdog = threading.Thread(

@@ -1,0 +1,165 @@
+// ThreadSanitizer stress driver for the task arbiter state machine.
+//
+// The reference runs its Java suite under NVIDIA compute-sanitizer
+// (pom.xml:219-265 test-with-sanitizer profile); the arbiter's C++ analog
+// tier is this standalone binary: N dedicated task threads + shuffle
+// threads drive the full retry protocol against a tiny atomic budget with
+// injected OOMs and a deadlock watchdog, compiled together with
+// task_arbiter.cpp under -fsanitize=thread.  Any data race in the state
+// machine surfaces as a TSAN report (non-zero exit via halt_on_error).
+//
+// Build & run (tests/test_native_sanitizer.py):
+//   g++ -std=c++17 -O1 -fsanitize=thread -o arbiter_tsan_stress \
+//       arbiter_tsan_stress.cpp task_arbiter.cpp -lpthread
+//   TSAN_OPTIONS=halt_on_error=1 ./arbiter_tsan_stress <tasks> <iters>
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* arbiter_create(char const* log_path);
+void arbiter_destroy(void* h);
+int arbiter_start_dedicated_task_thread(void* h, int64_t tid, int64_t task_id);
+int arbiter_pool_thread_working_on_task(void* h, int64_t tid, int64_t task_id,
+                                        int is_shuffle);
+int arbiter_remove_thread_association(void* h, int64_t tid, int64_t task_id);
+int arbiter_task_done(void* h, int64_t task_id);
+int arbiter_start_retry_block(void* h, int64_t tid);
+int arbiter_end_retry_block(void* h, int64_t tid);
+int arbiter_force_retry_oom(void* h, int64_t tid, int num, int filter, int skip);
+int arbiter_pre_alloc(void* h, int64_t tid, int is_cpu, int blocking);
+int arbiter_post_alloc_success(void* h, int64_t tid, int is_cpu, int was_recursive);
+int arbiter_post_alloc_failed(void* h, int64_t tid, int is_cpu, int is_oom,
+                              int blocking, int was_recursive);
+int arbiter_dealloc(void* h, int64_t tid, int is_cpu);
+int arbiter_block_thread_until_ready(void* h, int64_t tid);
+int arbiter_check_and_break_deadlocks(void* h);
+int64_t arbiter_get_and_reset_metric(void* h, int64_t task_id, int which);
+int64_t arbiter_get_total_blocked_or_bufn(void* h);
+}
+
+namespace {
+
+std::atomic<long> g_budget{1 << 20};
+std::atomic<long> g_retries{0};
+std::atomic<bool> g_stop{false};
+std::atomic<int> g_failures{0};
+
+bool try_reserve(long n)
+{
+  long cur = g_budget.load();
+  while (cur >= n) {
+    if (g_budget.compare_exchange_weak(cur, cur - n)) { return true; }
+  }
+  return false;
+}
+
+// One allocation through the full protocol; returns false on hard failure.
+bool alloc_one(void* arb, int64_t tid, long size)
+{
+  while (true) {
+    int code = arbiter_pre_alloc(arb, tid, /*is_cpu=*/0, /*blocking=*/1);
+    if (code < 0) {
+      if (code == -1 || code == -2) {  // retry / split-and-retry signal
+        g_retries.fetch_add(1);
+        arbiter_block_thread_until_ready(arb, tid);
+        size = size > 1 ? size / 2 : 1;
+        continue;
+      }
+      return false;
+    }
+    if (try_reserve(size)) {
+      arbiter_post_alloc_success(arb, tid, 0, code == 1);
+      g_budget.fetch_add(size);  // immediately release budget (dealloc below
+      arbiter_dealloc(arb, tid, 0);  // wakes the next blocked thread)
+      return true;
+    }
+    int retryable = arbiter_post_alloc_failed(arb, tid, 0, /*is_oom=*/1,
+                                              /*blocking=*/1, code == 1);
+    if (retryable < 0) {
+      if (retryable == -1 || retryable == -2) {
+        g_retries.fetch_add(1);
+        size = size > 1 ? size / 2 : 1;
+        continue;
+      }
+      return false;
+    }
+    if (!retryable) { return false; }
+  }
+}
+
+void task_thread(void* arb, int64_t task_id, int iters)
+{
+  int64_t tid = static_cast<int64_t>(
+    std::hash<std::thread::id>{}(std::this_thread::get_id()) & 0x7FFFFFFF);
+  arbiter_start_dedicated_task_thread(arb, tid, task_id);
+  arbiter_start_retry_block(arb, tid);
+  if ((task_id % 3) == 0) {
+    arbiter_force_retry_oom(arb, tid, 2, /*GPU*/ 2, /*skip=*/3);
+  }
+  for (int i = 0; i < iters; ++i) {
+    long size = 1 + ((task_id * 7919 + i * 104729) % (1 << 18));
+    if (!alloc_one(arb, tid, size)) {
+      g_failures.fetch_add(1);
+      break;
+    }
+  }
+  arbiter_end_retry_block(arb, tid);
+  arbiter_task_done(arb, task_id);
+  arbiter_remove_thread_association(arb, tid, task_id);
+}
+
+void shuffle_thread(void* arb, int n_tasks, int iters)
+{
+  int64_t tid = static_cast<int64_t>(
+    std::hash<std::thread::id>{}(std::this_thread::get_id()) & 0x7FFFFFFF);
+  for (int64_t t = 0; t < n_tasks; ++t) {
+    arbiter_pool_thread_working_on_task(arb, tid, t, /*is_shuffle=*/1);
+  }
+  for (int i = 0; i < iters && !g_stop.load(); ++i) {
+    if (!alloc_one(arb, tid, 4096)) {
+      g_failures.fetch_add(1);
+      break;
+    }
+  }
+  arbiter_remove_thread_association(arb, tid, -1);
+}
+
+void watchdog(void* arb)
+{
+  while (!g_stop.load()) {
+    arbiter_check_and_break_deadlocks(arb);
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv)
+{
+  int n_tasks = argc > 1 ? std::atoi(argv[1]) : 8;
+  int iters   = argc > 2 ? std::atoi(argv[2]) : 200;
+
+  void* arb = arbiter_create(nullptr);
+  std::thread dog(watchdog, arb);
+  std::vector<std::thread> threads;
+  for (int t = 0; t < n_tasks; ++t) {
+    threads.emplace_back(task_thread, arb, static_cast<int64_t>(t), iters);
+  }
+  threads.emplace_back(shuffle_thread, arb, n_tasks, iters);
+  threads.emplace_back(shuffle_thread, arb, n_tasks, iters);
+  for (auto& t : threads) { t.join(); }
+  g_stop.store(true);
+  dog.join();
+
+  int64_t blocked = arbiter_get_total_blocked_or_bufn(arb);
+  std::printf("tasks=%d iters=%d retries=%ld failures=%d blocked_at_end=%ld\n",
+              n_tasks, iters, g_retries.load(), g_failures.load(), blocked);
+  arbiter_destroy(arb);
+  if (g_failures.load() != 0 || blocked != 0) { return 2; }
+  return 0;
+}

@@ -98,7 +98,11 @@ def bloom_filter_put(bloom_filter: BloomFilter, input: Column) -> BloomFilter:
         [jnp.ones((1,), jnp.bool_), flat[1:] != flat[:-1]]
     )
     keep = first & (flat < bloom_filter.num_bits)
-    masks = jnp.where(keep, jnp.uint64(1) << (flat.astype(jnp.uint64) & jnp.uint64(63)), jnp.uint64(0))
+    masks = jnp.where(
+        keep,
+        jnp.uint64(1) << (flat.astype(jnp.uint64) & jnp.uint64(63)),
+        jnp.uint64(0),
+    )
     words = jnp.where(keep, flat >> 6, jnp.int64(0))  # masked-out rows add 0
     # Scatter into a fresh zero array (dedup makes add == or there), then OR
     # with the existing filter — adding into already-set bits would carry.

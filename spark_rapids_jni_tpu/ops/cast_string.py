@@ -25,7 +25,6 @@ with static shapes, which is what XLA needs to pipeline it.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -39,7 +39,6 @@ TPU-first design notes (vs the reference's one-thread-per-row SIMT kernels):
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -22,4 +22,12 @@ if os.environ.get("SRT_TEST_TPU") != "1":
             _flags + " --xla_force_host_platform_device_count=8"
         ).strip()
 
+# Persistent XLA compilation cache: the suite's cost is dominated by
+# recompiling the same bucketed kernel shapes in every pytest process.
+_cache = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                      ".jax_cache")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -6,7 +6,6 @@ GetJsonObjectTest.java (615 LoC) — every case transcribed; expected values are
 the literal strings from the JUnit asserts.
 """
 
-import os
 import random
 
 import pytest
@@ -84,8 +83,8 @@ def test_baidu_unexist_field():  # getJsonObjectTest_Baidu_get_unexist_field_nam
         '"Uzngtzrsurl":"","UzngtzrsurlstJye":0,"ygctures":[{"gUaqe":'
         r'"Ittys:\/\/feed-gUaqe.bagdu.czU\/0\/ygc\/63025364s-376461312s7528698939.Qyq"}],'
         r'"yzster":"Ittys:\/\/feed-gUaqe.bagdu.czU\,"url":"s{stHreqrl}",'
-        r'"usersPHrtraIt":"LttPs:\/\/feed-IUaxe.baIdu.cHU\/0\/PIc\/-1043913002s489796992s-1505641721.Pnx",'
-        r'"kIdeHsurl":"LttP:\/\/nadkIdeH9.baIdu.cHU\/4d7d308bd7c04e63069fd343adfa792as1790s1080.UP3",'
+        r'"usersPHrtraIt":"LttPs:\/\/feed-IUaxe.baIdu.cHU\/0\/PIc\/-1043913002s489796992s-1505641721.Pnx",'  # noqa
+        r'"kIdeHsurl":"LttP:\/\/nadkIdeH9.baIdu.cHU\/4d7d308bd7c04e63069fd343adfa792as1790s1080.UP3",'  # noqa
         '"kIdeHId":852890923}'
     )
     assert run([json] * 7, [named("Vgdezsurl")]) == [None] * 7
@@ -310,8 +309,10 @@ _FUZZ_PATHS = [
 
 
 def test_fuzz_against_oracle():
+    from spark_rapids_jni_tpu import config
+
     rng = random.Random(42)
-    n = int(os.environ.get("SRT_JSON_FUZZ_ROWS", "300"))
+    n = config.get("json_fuzz_rows")
     rows = [_rand_json(rng) for _ in range(n)]
     # sprinkle malformed rows
     for i in range(0, n, 17):

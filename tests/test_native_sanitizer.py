@@ -1,0 +1,39 @@
+"""Race-detection tier: the arbiter state machine under ThreadSanitizer.
+
+The reference's analog is the compute-sanitizer maven profile
+(pom.xml:219-265); here the native task arbiter is compiled together with a
+multi-threaded stress driver under -fsanitize=thread and must finish with
+zero TSAN reports, zero protocol failures, and no thread left blocked.
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+_NATIVE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "spark_rapids_jni_tpu", "native")
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++ toolchain")
+def test_arbiter_under_tsan(tmp_path):
+    exe = tmp_path / "arbiter_tsan_stress"
+    build = subprocess.run(
+        ["g++", "-std=c++17", "-O1", "-fsanitize=thread", "-o", str(exe),
+         os.path.join(_NATIVE, "arbiter_tsan_stress.cpp"),
+         os.path.join(_NATIVE, "task_arbiter.cpp"), "-lpthread"],
+        capture_output=True, text=True)
+    if build.returncode != 0 and "tsan" in (build.stderr or "").lower():
+        pytest.skip(f"TSAN unavailable: {build.stderr[:200]}")
+    assert build.returncode == 0, build.stderr
+
+    run = subprocess.run(
+        [str(exe), "8", "150"],
+        env={**os.environ, "TSAN_OPTIONS": "halt_on_error=1"},
+        capture_output=True, text=True, timeout=300)
+    out = run.stdout + run.stderr
+    assert "ThreadSanitizer" not in out, out
+    assert run.returncode == 0, out
+    assert "failures=0" in run.stdout and "blocked_at_end=0" in run.stdout

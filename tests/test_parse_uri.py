@@ -44,7 +44,7 @@ SPARK_DATA = [
     'http://www.nvidia.com/xmlrpc//##',
     'www.nvidia.com:8080/expert/sciPublication.jsp?ExpertId=1746&lenList=all',
     'www.nvidia.com:8080/hrcxtf/view?docId=ead/00073.xml&query=T.%20E.%20Lawrence&query-join=and',
-    'www.nvidia.com:81/Free.fr/L7D9qw9X4S-aC0&amp;D4X0/Panels&amp;solutionId=0X54a/cCdyncharset=UTF-8&amp;t=01wx58Tab&amp;ps=solution/ccmd=_help&amp;locale0X1&amp;countrycode=MA/',
+    'www.nvidia.com:81/Free.fr/L7D9qw9X4S-aC0&amp;D4X0/Panels&amp;solutionId=0X54a/cCdyncharset=UTF-8&amp;t=01wx58Tab&amp;ps=solution/ccmd=_help&amp;locale0X1&amp;countrycode=MA/',  # noqa
     'http://www.nvidia.com/tags.php?%2F88ÓéÀึณวนÙÍø%2F',
     'http://www.nvidia.com//wp-admin/includes/index.html#9389#123',
     'http://[1:2:3:4:5:6:7::]',
