@@ -32,11 +32,23 @@ def iter_py_files(repo_root: str):
 
 
 class _ImportChecker(ast.NodeVisitor):
-    """Unused-import detection: imported names never referenced."""
+    """Unused-import detection: imported names never referenced.
+
+    Names listed in ``__all__`` string literals count as used (re-exports).
+    """
 
     def __init__(self):
         self.imported = {}  # name -> lineno
         self.used = set()
+
+    def visit_Assign(self, node):
+        is_all = any(isinstance(t, ast.Name) and t.id == "__all__"
+                     for t in node.targets)
+        if is_all and isinstance(node.value, (ast.List, ast.Tuple)):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    self.used.add(elt.value)
+        self.generic_visit(node)
 
     def visit_Import(self, node):
         for a in node.names:
@@ -62,7 +74,10 @@ def check_file(path: str):
     findings = []
     with open(path, "rb") as f:
         raw = f.read()
-    text = raw.decode("utf-8")
+    try:
+        text = raw.decode("utf-8")
+    except UnicodeDecodeError as e:
+        return [f"{path}: not valid UTF-8 at byte {e.start}"]
     try:
         tree = ast.parse(text, filename=path)
     except SyntaxError as e:
@@ -83,7 +98,7 @@ def check_file(path: str):
     # __init__.py re-exports are used by importers, not the module itself
     if not path.endswith("__init__.py"):
         for name, lineno in chk.imported.items():
-            if name not in chk.used and name not in text.split("__all__", 1)[-1]:
+            if name not in chk.used:
                 findings.append(f"{path}:{lineno}: unused import {name!r}")
     return findings
 

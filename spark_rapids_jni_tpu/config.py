@@ -87,9 +87,14 @@ def get(name: str) -> Any:
     return flag.default
 
 
+def _validate(names) -> None:
+    unknown = [n for n in names if n not in FLAGS]
+    if unknown:
+        raise KeyError(f"unknown flag(s) {unknown!r}")
+
+
 def set(name: str, value: Any) -> None:  # noqa: A001 - flag-registry verb
-    if name not in FLAGS:
-        raise KeyError(f"unknown flag {name!r}")
+    _validate([name])
     with _lock:
         _overrides[name] = value
 
@@ -97,12 +102,10 @@ def set(name: str, value: Any) -> None:  # noqa: A001 - flag-registry verb
 @contextlib.contextmanager
 def override(**kv):
     """Temporarily override flags (tests)."""
+    _validate(kv)  # all-or-nothing: validate before applying any
     with _lock:
         saved = dict(_overrides)
-        for name, value in kv.items():
-            if name not in FLAGS:
-                raise KeyError(f"unknown flag {name!r}")
-            _overrides[name] = value
+        _overrides.update(kv)
     try:
         yield
     finally:

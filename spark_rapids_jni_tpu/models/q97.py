@@ -1,0 +1,153 @@
+"""Mini NDS q97: a distributed two-table join-count over the device mesh.
+
+BASELINE.md staged config 5 is "NDS TPC-DS q5+q97 end-to-end"; this module is
+the framework-native q97 core.  TPC-DS q97 counts (customer_sk, item_sk)
+pairs sold in store only, catalog only, and both, from two fact tables —
+i.e. a full outer join on a composite key reduced to presence counts:
+
+    SELECT SUM(store_only), SUM(catalog_only), SUM(both) FROM
+      (SELECT customer_sk, item_sk FROM store_sales GROUP BY 1,2) ss
+      FULL OUTER JOIN
+      (SELECT customer_sk, item_sk FROM catalog_sales GROUP BY 1,2) cs
+      USING (customer_sk, item_sk)
+
+Distributed plan (the Spark plan's TPU-native shape):
+
+1. hash the composite key per row (Spark murmur3 row hashing, ops/hashing);
+2. all_to_all shuffle BOTH tables by ``hash % ndev`` over the data axis —
+   co-locating every distinct key on one owner shard (the exchange Spark
+   does with its UCX shuffle, here one ICI collective);
+3. per shard: sort the union of (key, source-tag) pairs and count
+   equal-key runs by which sources appear — a static-shape sort-merge
+   "join" (XLA-friendly: no dynamic hash table);
+4. psum the three counters over the mesh.
+
+Shuffled row counts are data-dependent; capacity is a static bound with
+overflow reported (parallel/shuffle.py) — the caller retries with a larger
+capacity exactly like a Spark shuffle spill retry.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from spark_rapids_jni_tpu.ops.hashing import murmur3_raw_int64
+from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS
+from spark_rapids_jni_tpu.parallel.shuffle import all_to_all_shuffle
+
+
+class Q97Out(NamedTuple):
+    store_only: jnp.ndarray  # scalar int32
+    catalog_only: jnp.ndarray
+    both: jnp.ndarray
+    dropped: jnp.ndarray  # shuffle capacity overflows (0 == exact result)
+
+
+def _composite_key(customer_sk: jnp.ndarray, item_sk: jnp.ndarray) -> jnp.ndarray:
+    """One int64 key per (customer, item) pair.
+
+    Both sks are positive 32-bit surrogate keys in TPC-DS, so packing is
+    exact (no collisions), unlike hashing the pair.
+    """
+    return (customer_sk.astype(jnp.int64) << 32) | (
+        item_sk.astype(jnp.int64) & 0xFFFFFFFF
+    )
+
+
+def _count_runs(keys: jnp.ndarray, is_store: jnp.ndarray, valid: jnp.ndarray):
+    """Sort-merge presence counting over one shard's co-located rows.
+
+    For every distinct valid key: did it appear with a store tag, a catalog
+    tag, or both?  Returns (store_only, catalog_only, both) scalars.
+    """
+    # order by key; invalid rows sort last via the max sentinel
+    sentinel = jnp.int64(0x7FFFFFFFFFFFFFFF)
+    k = jnp.where(valid, keys, sentinel)
+    order = jnp.argsort(k)
+    ks = k[order]
+    store_s = jnp.where(valid, is_store, False)[order]
+    cat_s = jnp.where(valid, ~is_store, False)[order]
+
+    # run starts: first element or key change
+    n = ks.shape[0]
+    prev = jnp.concatenate([ks[:1] - 1, ks[:-1]])
+    run_start = ks != prev
+    run_id = jnp.cumsum(run_start.astype(jnp.int32)) - 1
+
+    # per-run presence via segment max (bounded by n runs)
+    has_store = jax.ops.segment_max(
+        store_s.astype(jnp.int32), run_id, num_segments=n
+    )
+    has_cat = jax.ops.segment_max(
+        cat_s.astype(jnp.int32), run_id, num_segments=n
+    )
+    run_valid = jax.ops.segment_max(
+        (ks != sentinel).astype(jnp.int32), run_id, num_segments=n
+    )
+    has_store = has_store * run_valid
+    has_cat = has_cat * run_valid
+    both = jnp.sum((has_store & has_cat).astype(jnp.int32))
+    store_only = jnp.sum((has_store & (1 - has_cat)).astype(jnp.int32))
+    cat_only = jnp.sum((has_cat & (1 - has_store)).astype(jnp.int32))
+    return store_only, cat_only, both
+
+
+def q97_local(store: tuple, catalog: tuple) -> Q97Out:
+    """Single-chip q97 core over (customer_sk, item_sk) int arrays."""
+    sk = _composite_key(*store)
+    ck = _composite_key(*catalog)
+    keys = jnp.concatenate([sk, ck])
+    is_store = jnp.concatenate(
+        [jnp.ones(sk.shape, bool), jnp.zeros(ck.shape, bool)]
+    )
+    so, co, b = _count_runs(keys, is_store, jnp.ones(keys.shape, bool))
+    return Q97Out(so, co, b, jnp.int32(0))
+
+
+def _sharded_q97(s_cust, s_item, c_cust, c_item, capacity: int):
+    dp = jax.lax.axis_size(DATA_AXIS)
+    sk = _composite_key(s_cust, s_item)
+    ck = _composite_key(c_cust, c_item)
+
+    # co-locate keys: both tables shuffled by the same Spark-hash partition
+    def exchange(keys):
+        part = (murmur3_raw_int64(keys, 42) % jnp.uint32(dp)).astype(jnp.int32)
+        return all_to_all_shuffle({"k": keys}, part, capacity, axis=DATA_AXIS)
+
+    ss = exchange(sk)
+    cs = exchange(ck)
+    keys = jnp.concatenate([ss.columns["k"], cs.columns["k"]])
+    valid = jnp.concatenate([ss.valid, cs.valid])
+    is_store = jnp.concatenate(
+        [jnp.ones(ss.valid.shape, bool), jnp.zeros(cs.valid.shape, bool)]
+    )
+    so, co, b = _count_runs(keys, is_store, valid)
+    axes = (DATA_AXIS,)
+    return Q97Out(
+        jax.lax.psum(so, axes),
+        jax.lax.psum(co, axes),
+        jax.lax.psum(b, axes),
+        jax.lax.psum(ss.dropped + cs.dropped, axes),
+    )
+
+
+def make_distributed_q97(mesh, capacity: int):
+    """jit-compiled distributed q97 over ``mesh``'s data axis.
+
+    Inputs: four [rows] int arrays sharded over DATA_AXIS (store customer/
+    item, catalog customer/item).  ``capacity`` bounds per-destination
+    shuffle buckets; Q97Out.dropped > 0 means retry with a larger one.
+    """
+    step = jax.shard_map(
+        functools.partial(_sharded_q97, capacity=capacity),
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=Q97Out(P(), P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(step)

@@ -17,7 +17,7 @@ import argparse
 import json
 import struct
 import sys
-from typing import Iterator, Tuple
+from typing import Iterator
 
 from spark_rapids_jni_tpu.obs.profiler import MAGIC, VERSION
 

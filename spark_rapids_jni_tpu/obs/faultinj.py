@@ -44,7 +44,6 @@ import json
 import os
 import random
 import threading
-import time
 from typing import Optional
 
 from spark_rapids_jni_tpu.mem.exceptions import (

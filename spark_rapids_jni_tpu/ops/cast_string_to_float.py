@@ -39,7 +39,7 @@ import numpy as np
 
 from spark_rapids_jni_tpu.columnar.buckets import map_buckets
 from spark_rapids_jni_tpu.columnar.column import Column, StringColumn
-from spark_rapids_jni_tpu.columnar.dtypes import DType, FLOAT32, FLOAT64, Kind
+from spark_rapids_jni_tpu.columnar.dtypes import DType, FLOAT64, Kind
 from spark_rapids_jni_tpu.ops.cast_string import CastException
 
 MAX_SAFE_DIGITS = 19

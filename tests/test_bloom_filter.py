@@ -15,7 +15,6 @@ import pytest
 
 from spark_rapids_jni_tpu.columnar import column, INT64, INT32
 from spark_rapids_jni_tpu.ops.bloom_filter import (
-    BloomFilter,
     bloom_filter_create,
     bloom_filter_deserialize,
     bloom_filter_merge,

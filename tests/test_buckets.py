@@ -9,7 +9,6 @@ import random
 
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from spark_rapids_jni_tpu import columnar as c
 from spark_rapids_jni_tpu.columnar.buckets import (

@@ -14,6 +14,7 @@ def test_version_and_build_info():
 
 
 def test_flag_env_resolution(monkeypatch):
+    monkeypatch.delenv("BENCH_ITERS", raising=False)
     assert config.get("bench_iters") == 20
     monkeypatch.setenv("BENCH_ITERS", "7")
     assert config.get("bench_iters") == 7

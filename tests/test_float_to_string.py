@@ -5,7 +5,6 @@ Ryu produces — reformatted with the Java layout rules)."""
 
 import math
 import re
-import struct
 
 import numpy as np
 import pytest

@@ -12,7 +12,6 @@ import struct
 import numpy as np
 import pytest
 
-import spark_rapids_jni_tpu as srt
 from spark_rapids_jni_tpu import columnar as c
 from spark_rapids_jni_tpu.ops import murmur_hash32, xxhash64
 

@@ -9,7 +9,6 @@ verdict for every row.
 import random
 
 import numpy as np
-import pytest
 
 import json_oracle as jo
 from spark_rapids_jni_tpu import columnar as c

@@ -11,7 +11,6 @@ from concurrent.futures import ThreadPoolExecutor
 import pytest
 
 from spark_rapids_jni_tpu.mem import (
-    Arbiter,
     BudgetedResource,
     CpuRetryOOM,
     GpuOOM,
@@ -22,8 +21,6 @@ from spark_rapids_jni_tpu.mem import (
     OOM_CPU,
     OOM_GPU,
     OutOfBudget,
-    STATE_BLOCKED,
-    STATE_BUFN,
     STATE_RUNNING,
     ThreadRemovedError,
     current_thread_id,

@@ -4,7 +4,6 @@ expectations and seeded fuzz inputs."""
 
 import random
 
-import pytest
 
 from spark_rapids_jni_tpu.columnar.column import strings_column
 from spark_rapids_jni_tpu.ops import parse_uri as pu

@@ -22,10 +22,9 @@ from spark_rapids_jni_tpu.columnar import (
     INT64,
     FLOAT32,
     FLOAT64,
-    STRING,
 )
 from spark_rapids_jni_tpu.columnar.column import decimal128_column
-from spark_rapids_jni_tpu.columnar.dtypes import DType, Kind
+from spark_rapids_jni_tpu.columnar.dtypes import Kind
 from spark_rapids_jni_tpu.ops.row_conversion import (
     compute_layout,
     convert_from_rows,
