@@ -41,13 +41,31 @@ class _ImportChecker(ast.NodeVisitor):
         self.imported = {}  # name -> lineno
         self.used = set()
 
-    def visit_Assign(self, node):
-        is_all = any(isinstance(t, ast.Name) and t.id == "__all__"
-                     for t in node.targets)
-        if is_all and isinstance(node.value, (ast.List, ast.Tuple)):
-            for elt in node.value.elts:
+    def _collect_strings(self, node):
+        """Names from any expression built of list/tuple literals and +."""
+        if isinstance(node, (ast.List, ast.Tuple)):
+            for elt in node.elts:
                 if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
                     self.used.add(elt.value)
+        elif isinstance(node, ast.BinOp):
+            self._collect_strings(node.left)
+            self._collect_strings(node.right)
+
+    def visit_Assign(self, node):
+        if any(isinstance(t, ast.Name) and t.id == "__all__"
+               for t in node.targets):
+            self._collect_strings(node.value)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):  # __all__ += [...]
+        if isinstance(node.target, ast.Name) and node.target.id == "__all__":
+            self._collect_strings(node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):  # __all__: list = [...]
+        if (isinstance(node.target, ast.Name)
+                and node.target.id == "__all__" and node.value is not None):
+            self._collect_strings(node.value)
         self.generic_visit(node)
 
     def visit_Import(self, node):

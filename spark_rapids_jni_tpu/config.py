@@ -142,6 +142,10 @@ register("fault_injector_config_path", "",
          "JSON config that arms the fault injector at import "
          "(obs/faultinj.py; the FAULT_INJECTOR_CONFIG_PATH analog).",
          env="SRT_FAULT_INJECTOR_CONFIG_PATH")
+register("json_eval_device", False,
+         "Evaluate JSON paths with the jitted lax.scan machine "
+         "(ops/json_eval_device.py) instead of the host numpy machine.",
+         env="SRT_JSON_EVAL_DEVICE")
 register("watchdog_period_s", 0.1,
          "Memory-governor deadlock-watchdog poll period (the "
          "rmmWatchdogPollingPeriod analog, SparkResourceAdaptor.java:35).",

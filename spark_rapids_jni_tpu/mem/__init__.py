@@ -28,6 +28,7 @@ from spark_rapids_jni_tpu.mem.exceptions import (
     GpuRetryOOM,
     GpuSplitAndRetryOOM,
     InjectedException,
+    OffHeapOOM,
     RetryOOM,
     SplitAndRetryOOM,
     ThreadRemovedError,
@@ -48,6 +49,7 @@ __all__ = [
     "GpuSplitAndRetryOOM",
     "InjectedException",
     "MemoryGovernor",
+    "OffHeapOOM",
     "OOM_ALL",
     "OOM_CPU",
     "OOM_GPU",

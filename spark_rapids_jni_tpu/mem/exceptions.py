@@ -37,6 +37,10 @@ class GpuOOM(MemoryError):
     """A real out-of-memory (including the 500-retry livelock cap)."""
 
 
+class OffHeapOOM(MemoryError):
+    """A real host/off-heap out-of-memory (OffHeapOOM.java)."""
+
+
 class ThreadRemovedError(RuntimeError):
     """The thread's task was removed while it was blocked."""
 

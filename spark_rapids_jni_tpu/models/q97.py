@@ -114,25 +114,25 @@ def _sharded_q97(s_cust, s_item, c_cust, c_item, capacity: int):
     sk = _composite_key(s_cust, s_item)
     ck = _composite_key(c_cust, c_item)
 
-    # co-locate keys: both tables shuffled by the same Spark-hash partition
-    def exchange(keys):
-        part = (murmur3_raw_int64(keys, 42) % jnp.uint32(dp)).astype(jnp.int32)
-        return all_to_all_shuffle({"k": keys}, part, capacity, axis=DATA_AXIS)
-
-    ss = exchange(sk)
-    cs = exchange(ck)
-    keys = jnp.concatenate([ss.columns["k"], cs.columns["k"]])
-    valid = jnp.concatenate([ss.valid, cs.valid])
-    is_store = jnp.concatenate(
-        [jnp.ones(ss.valid.shape, bool), jnp.zeros(cs.valid.shape, bool)]
+    # co-locate keys from BOTH tables with ONE tagged all_to_all: same bytes
+    # moved, half the collective launches on the query hot path
+    keys = jnp.concatenate([sk, ck])
+    tag = jnp.concatenate(
+        [jnp.ones(sk.shape, jnp.int8), jnp.zeros(ck.shape, jnp.int8)]
     )
-    so, co, b = _count_runs(keys, is_store, valid)
+    part = (murmur3_raw_int64(keys, 42) % jnp.uint32(dp)).astype(jnp.int32)
+    ex = all_to_all_shuffle(
+        {"k": keys, "tag": tag}, part, capacity, axis=DATA_AXIS
+    )
+    so, co, b = _count_runs(
+        ex.columns["k"], ex.columns["tag"] == 1, ex.valid
+    )
     axes = (DATA_AXIS,)
     return Q97Out(
         jax.lax.psum(so, axes),
         jax.lax.psum(co, axes),
         jax.lax.psum(b, axes),
-        jax.lax.psum(ss.dropped + cs.dropped, axes),
+        jax.lax.psum(ex.dropped, axes),
     )
 
 
@@ -141,7 +141,8 @@ def make_distributed_q97(mesh, capacity: int):
 
     Inputs: four [rows] int arrays sharded over DATA_AXIS (store customer/
     item, catalog customer/item).  ``capacity`` bounds per-destination
-    shuffle buckets; Q97Out.dropped > 0 means retry with a larger one.
+    shuffle buckets over the COMBINED row stream (both tables ride one
+    tagged all_to_all); Q97Out.dropped > 0 means retry with a larger one.
     """
     step = jax.shard_map(
         functools.partial(_sharded_q97, capacity=capacity),

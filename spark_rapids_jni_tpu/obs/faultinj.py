@@ -28,6 +28,7 @@ Config shape::
 - ``split_oom``    -> GpuSplitAndRetryOOM
 - ``device_error`` -> GpuOOM (the sticky ``assert(0)`` analog: a
   non-retryable device failure)
+- ``host_oom``     -> OffHeapOOM (a hard host/off-heap allocation failure)
 
 ``interceptionCount`` limits how many times the rule fires (faultinj.cu
 ``injectionCount`` countdown); ``percent`` gates each crossing.
@@ -51,6 +52,7 @@ from spark_rapids_jni_tpu.mem.exceptions import (
     GpuRetryOOM,
     GpuSplitAndRetryOOM,
     InjectedException,
+    OffHeapOOM,
 )
 from spark_rapids_jni_tpu.obs import seam as _seam
 
@@ -64,6 +66,7 @@ _FAULTS = {
     "split_oom": lambda name: GpuSplitAndRetryOOM(
         f"injected split-and-retry OOM in {name}"),
     "device_error": lambda name: GpuOOM(f"injected device error in {name}"),
+    "host_oom": lambda name: OffHeapOOM(f"injected host OOM in {name}"),
 }
 
 

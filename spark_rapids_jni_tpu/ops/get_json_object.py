@@ -43,6 +43,7 @@ from spark_rapids_jni_tpu.columnar.buckets import (
     padded_buckets,
     strings_from_buckets,
 )
+from spark_rapids_jni_tpu import config
 from spark_rapids_jni_tpu.columnar.column import Column, StringColumn
 from spark_rapids_jni_tpu.columnar.dtypes import FLOAT64
 from spark_rapids_jni_tpu.ops import json_tokenizer as jt
@@ -1031,8 +1032,14 @@ def get_json_object(col: StringColumn, path: Sequence[tuple]) -> StringColumn:
         nm = _name_matches(bi, kind, start, end, names, len_raw, has_uni)
         ftext, flen, fidx = _float_texts(bi, kind, start, end)
 
-        m = _Machine(kind, start, end, match, ntok, ok, ptypes, pargs, nm)
-        segs = m.run()
+        if config.get("json_eval_device"):
+            from spark_rapids_jni_tpu.ops.json_eval_device import run_device
+
+            m, segs = run_device(kind, start, end, match, ntok, ok,
+                                 ptypes, pargs, nm)
+        else:
+            m = _Machine(kind, start, end, match, ntok, ok, ptypes, pargs, nm)
+            segs = m.run()
         m.err |= m.dirty_root <= 0
         m.err |= ~np.asarray(in_valid)[rows_np]
         padded, out_len = _render(bi, segs, m, kind, start, end,

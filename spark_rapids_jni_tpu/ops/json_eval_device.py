@@ -1,0 +1,497 @@
+"""Device-side JSON path evaluation: the lockstep token machine as lax.scan.
+
+A jitted translation of ops/get_json_object.py's host ``_Machine`` —
+the explicit-stack form of evaluate_path (get_json_object.cu:360-394) with
+every row advancing one token (or one frame return) per scan step.  State is
+a pytree of [n]- and [n, F]-shaped arrays; frame/generator stack updates are
+one-hot writes at the stack pointer.  Shapes (n, T, F, G, S) all derive from
+the pow2 bucket geometry, so the compiled-variant set stays bounded.
+
+Selected via the ``json_eval_device`` config flag; both backends emit the
+identical segment stream, so the renderer and all corpus/fuzz tests are
+shared.  Equivalence with the host machine is asserted directly in
+tests/test_get_json_object.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.ops import json_tokenizer as jt
+from spark_rapids_jni_tpu.ops.get_json_object import (
+    INDEX,
+    MAX_PATH_DEPTH,
+    NAMED,
+    WILDCARD,
+    _C_CLOSE_ARR,
+    _C_COLON,
+    _C_COMMA,
+    _C_OPEN_ARR,
+    _F_CASE2,
+    _F_CASE4,
+    _F_CASE5,
+    _F_CASE6,
+    _F_CASE7,
+    _F_CASE8,
+    _F_COPY,
+    _FLATTEN,
+    _P_END,
+    _QUOTED,
+    _RAW,
+    _SCALARS,
+    _SEG_COND_CLOSE,
+    _SEG_COND_OPEN,
+    _SEG_CONST,
+    _SEG_ESC_TOK,
+    _SEG_RAW_TOK,
+    _SUB_DRAIN,
+    _SUB_ENTERING,
+    _SUB_NONE,
+    _SUB_WAITING,
+)
+
+_I32 = jnp.int32
+_I8 = jnp.int8
+
+_SCALARS_ARR = np.asarray(_SCALARS, np.int32)
+
+
+def _isin(x, values):
+    out = jnp.zeros(x.shape, bool)
+    for v in values:
+        out = out | (x == v)
+    return out
+
+
+@functools.partial(jax.jit, static_argnums=(7, 8, 9))
+def _run_scan(kind, match, ntok, ok, nm_stack, ptype, parg,
+              T: int, F: int, G: int):
+    """Scan the machine over 2T+40 steps; returns final state + per-step ys."""
+    n = kind.shape[0]
+    S = 2 * T + 40
+    P1 = ptype.shape[0]
+
+    rowsF = jnp.arange(F, dtype=_I32)[None, :]
+    rowsG = jnp.arange(G, dtype=_I32)[None, :]
+
+    def top(arr, fp):
+        return jnp.take_along_axis(
+            arr, jnp.clip(fp, 0, F - 1)[:, None], axis=1)[:, 0]
+
+    def set_top(arr, fp, mask, val):
+        sel = (rowsF == jnp.clip(fp, 0, F - 1)[:, None]) & mask[:, None]
+        val = jnp.broadcast_to(jnp.asarray(val, arr.dtype), (n,))
+        return jnp.where(sel, val[:, None], arr)
+
+    def gtop(arr, gp):
+        return jnp.take_along_axis(
+            arr, jnp.clip(gp, 0, G - 1)[:, None], axis=1)[:, 0]
+
+    def set_gtop(arr, gp, mask, val):
+        sel = (rowsG == jnp.clip(gp, 0, G - 1)[:, None]) & mask[:, None]
+        val = jnp.broadcast_to(jnp.asarray(val, arr.dtype), (n,))
+        return jnp.where(sel, val[:, None], arr)
+
+    def kind_at(idx):
+        return jnp.take_along_axis(
+            kind, jnp.clip(idx, 0, T - 1)[:, None], axis=1)[:, 0]
+
+    def match_at(idx):
+        return jnp.take_along_axis(
+            match, jnp.clip(idx, 0, T - 1)[:, None], axis=1)[:, 0]
+
+    def step(st, s):
+        seg = jnp.zeros((n, 2, 2), _I32)
+        close_grp = jnp.full((n,), -1, _I32)
+        close_dirty = jnp.zeros((n,), _I32)
+        close_nc = jnp.zeros((n,), bool)
+
+        active = ~st["done"] & ~st["err"]
+
+        # ---- 1) process pending returns -------------------------------
+        retm = active & st["ret_valid"]
+        at_root = retm & (st["fp"] < 0)
+        st["done"] = st["done"] | at_root
+        st["dirty_root"] = jnp.where(at_root, st["ret_dirty"], st["dirty_root"])
+        fr = retm & ~at_root
+        case_r = top(st["f_case"], st["fp"])
+        sub_r = top(st["f_sub"], st["fp"])
+        acc = fr & _isin(case_r, (_F_CASE2, _F_CASE5, _F_CASE6, _F_CASE7))
+        st["f_dirty"] = set_top(st["f_dirty"], st["fp"], acc,
+                                top(st["f_dirty"], st["fp"]) + st["ret_dirty"])
+        c4r = fr & (case_r == _F_CASE4) & (sub_r == _SUB_WAITING)
+        bad = c4r & (st["ret_dirty"] == 0)
+        st["err"] = st["err"] | bad
+        good = c4r & ~bad
+        st["f_dirty"] = set_top(st["f_dirty"], st["fp"], good, st["ret_dirty"])
+        st["f_flag"] = set_top(st["f_flag"], st["fp"], good, True)
+        st["f_sub"] = set_top(st["f_sub"], st["fp"], good, _SUB_NONE)
+        c8r = fr & (case_r == _F_CASE8) & (sub_r == _SUB_WAITING)
+        st["f_dirty"] = set_top(st["f_dirty"], st["fp"], c8r, st["ret_dirty"])
+        st["f_sub"] = set_top(st["f_sub"], st["fp"], c8r, _SUB_DRAIN)
+        st["ret_valid"] = st["ret_valid"] & ~retm
+        active = active & ~retm & ~st["err"]
+
+        # ---- 2) frame-top dispatch ------------------------------------
+        out_of_tok = active & (st["tcur"] >= ntok)
+        st["err"] = st["err"] | out_of_tok
+        active = active & ~out_of_tok
+
+        k = kind_at(st["tcur"])
+        case = top(st["f_case"], st["fp"])
+        sub = top(st["f_sub"], st["fp"])
+        style = top(st["f_style"], st["fp"])
+        fpath = top(st["f_path"], st["fp"])
+        faux = top(st["f_aux"], st["fp"])
+        fflag = top(st["f_flag"], st["fp"])
+        fdirty = top(st["f_dirty"], st["fp"])
+
+        is_root = active & (st["fp"] < 0) & ~st["entered_root"]
+        st["entered_root"] = st["entered_root"] | is_root
+
+        close_arr = k == jt.END_ARRAY
+        close_obj = k == jt.END_OBJECT
+
+        def pop_ret(st, mask, dirty):
+            st["ret_valid"] = st["ret_valid"] | mask
+            st["ret_dirty"] = jnp.where(mask, dirty, st["ret_dirty"])
+            st["fp"] = jnp.where(mask, st["fp"] - 1, st["fp"])
+            return st
+
+        # COPY
+        copym = active & (st["fp"] >= 0) & (case == _F_COPY)
+        prevk = kind_at(st["tcur"] - 1)
+        sep_colon = prevk == jt.FIELD_NAME
+        prev_valend = _isin(prevk, tuple(_SCALARS_ARR.tolist())) | \
+            (prevk == jt.END_OBJECT) | (prevk == jt.END_ARRAY)
+        cur_close = close_arr | close_obj
+        sep_comma = prev_valend & ~cur_close
+        seg = seg.at[:, 0, 0].set(jnp.where(
+            copym & (sep_colon | sep_comma), _SEG_CONST, seg[:, 0, 0]))
+        seg = seg.at[:, 0, 1].set(jnp.where(
+            copym & sep_colon, _C_COLON,
+            jnp.where(copym & sep_comma, _C_COMMA, seg[:, 0, 1])))
+        seg = seg.at[:, 1, 0].set(jnp.where(copym, _SEG_ESC_TOK, seg[:, 1, 0]))
+        seg = seg.at[:, 1, 1].set(jnp.where(copym, st["tcur"], seg[:, 1, 1]))
+        at_end = copym & (st["tcur"] == faux)
+        st = pop_ret(st, at_end, jnp.ones((n,), _I32))
+        st["tcur"] = jnp.where(copym, st["tcur"] + 1, st["tcur"])
+        active = active & ~copym
+
+        # CASE2
+        c2 = active & (st["fp"] >= 0) & (case == _F_CASE2)
+        c2_close = c2 & close_arr
+        st = pop_ret(st, c2_close, fdirty)
+        st["tcur"] = jnp.where(c2_close, st["tcur"] + 1, st["tcur"])
+        c2_enter = c2 & ~close_arr
+
+        # CASE4
+        c4 = active & (st["fp"] >= 0) & (case == _F_CASE4)
+        c4_entering = c4 & (sub == _SUB_ENTERING)
+        c4 = c4 & (sub != _SUB_ENTERING)
+        c4_close = c4 & close_obj
+        st = pop_ret(st, c4_close, fdirty)
+        st["tcur"] = jnp.where(c4_close, st["tcur"] + 1, st["tcur"])
+        c4_field = c4 & ~close_obj
+        # per-row name match at (path level, current token)
+        lvl = jnp.clip(fpath, 0, P1 - 1)
+        nm_tok = jnp.take_along_axis(
+            nm_stack,
+            jnp.clip(st["tcur"], 0, T - 1)[None, :, None], axis=2)[:, :, 0]
+        nm = jnp.take_along_axis(nm_tok, lvl[None, :], axis=0)[0]
+        found = fflag
+        hit = c4_field & nm & ~found
+        miss = c4_field & ~hit
+        vt = st["tcur"] + 1
+        vkind = kind_at(vt)
+        vopen = (vkind == jt.START_OBJECT) | (vkind == jt.START_ARRAY)
+        skip_to = jnp.where(vopen, match_at(vt) + 1, st["tcur"] + 2)
+        st["tcur"] = jnp.where(miss, skip_to, st["tcur"])
+        isnull = vkind == jt.VALUE_NULL
+        st["err"] = st["err"] | (hit & isnull)
+        ok_hit = hit & ~isnull
+        st["tcur"] = jnp.where(ok_hit, st["tcur"] + 1, st["tcur"])
+        st["f_sub"] = set_top(st["f_sub"], st["fp"], ok_hit, _SUB_ENTERING)
+        c4_go = c4_entering
+        st["f_sub"] = set_top(st["f_sub"], st["fp"], c4_go, _SUB_WAITING)
+
+        # CASE5
+        c5 = active & (st["fp"] >= 0) & (case == _F_CASE5)
+        c5_close = c5 & close_arr
+        seg = seg.at[:, 1, 0].set(jnp.where(c5_close, _SEG_CONST, seg[:, 1, 0]))
+        seg = seg.at[:, 1, 1].set(jnp.where(c5_close, _C_CLOSE_ARR, seg[:, 1, 1]))
+        st["g_depth"] = set_gtop(st["g_depth"], st["gp"], c5_close,
+                                 gtop(st["g_depth"], st["gp"]) - 1)
+        st["g_empty"] = set_gtop(st["g_empty"], st["gp"], c5_close, False)
+        st = pop_ret(st, c5_close, fdirty)
+        st["tcur"] = jnp.where(c5_close, st["tcur"] + 1, st["tcur"])
+        c5_enter = c5 & ~close_arr
+
+        # CASE6
+        c6 = active & (st["fp"] >= 0) & (case == _F_CASE6)
+        c6_close = c6 & close_arr
+        close_grp = jnp.where(c6_close, faux, close_grp)
+        close_dirty = jnp.where(c6_close, fdirty, close_dirty)
+        close_nc = jnp.where(c6_close, fflag, close_nc)
+        seg = seg.at[:, 1, 0].set(jnp.where(c6_close, _SEG_COND_CLOSE,
+                                            seg[:, 1, 0]))
+        seg = seg.at[:, 1, 1].set(jnp.where(c6_close, faux, seg[:, 1, 1]))
+        st["gp"] = jnp.where(c6_close, st["gp"] - 1, st["gp"])
+        wrote = c6_close & (fdirty >= 1) & (gtop(st["g_depth"], st["gp"]) > 0)
+        st["g_empty"] = set_gtop(st["g_empty"], st["gp"], wrote, False)
+        st = pop_ret(st, c6_close, fdirty)
+        st["tcur"] = jnp.where(c6_close, st["tcur"] + 1, st["tcur"])
+        c6_enter = c6 & ~close_arr
+
+        # CASE7
+        c7 = active & (st["fp"] >= 0) & (case == _F_CASE7)
+        c7_close = c7 & close_arr
+        seg = seg.at[:, 1, 0].set(jnp.where(c7_close, _SEG_CONST, seg[:, 1, 0]))
+        seg = seg.at[:, 1, 1].set(jnp.where(c7_close, _C_CLOSE_ARR, seg[:, 1, 1]))
+        st["g_depth"] = set_gtop(st["g_depth"], st["gp"], c7_close,
+                                 gtop(st["g_depth"], st["gp"]) - 1)
+        st["g_empty"] = set_gtop(st["g_empty"], st["gp"], c7_close, False)
+        st = pop_ret(st, c7_close, fdirty)
+        st["tcur"] = jnp.where(c7_close, st["tcur"] + 1, st["tcur"])
+        c7_enter = c7 & ~close_arr
+
+        # CASE8
+        c8 = active & (st["fp"] >= 0) & (case == _F_CASE8)
+        c8_skip = c8 & (sub == _SUB_NONE) & (faux > 0)
+        st["err"] = st["err"] | (c8_skip & close_arr)
+        ok8 = c8_skip & ~close_arr
+        isopen_k = (k == jt.START_OBJECT) | (k == jt.START_ARRAY)
+        skip_cur = jnp.where(isopen_k, match_at(st["tcur"]) + 1, st["tcur"] + 1)
+        st["tcur"] = jnp.where(ok8, skip_cur, st["tcur"])
+        st["f_aux"] = set_top(st["f_aux"], st["fp"], ok8, faux - 1)
+        c8_go = c8 & (sub == _SUB_NONE) & (faux <= 0) & ~c8_skip
+        st["f_sub"] = set_top(st["f_sub"], st["fp"], c8_go, _SUB_WAITING)
+        c8_drain = c8 & (sub == _SUB_DRAIN)
+        d_close = c8_drain & close_arr
+        st = pop_ret(st, d_close, fdirty)
+        d_skip = c8_drain & ~close_arr
+        st["tcur"] = jnp.where(d_skip, skip_cur, st["tcur"])
+        st["tcur"] = jnp.where(d_close, st["tcur"] + 1, st["tcur"])
+
+        # ---- 3) ENTER dispatch ----------------------------------------
+        enter = is_root | c2_enter | c4_go | c5_enter | c6_enter | c7_enter \
+            | c8_go
+        e_style = jnp.full((n,), _RAW, _I8)
+        e_path = jnp.zeros((n,), _I32)
+        e_style = jnp.where(c2_enter, _FLATTEN, e_style)
+        e_path = jnp.where(c2_enter, P1 - 1, e_path)
+        e_style = jnp.where(c4_go, style, e_style)
+        e_path = jnp.where(c4_go, fpath + 1, e_path)
+        e_style = jnp.where(c5_enter, _FLATTEN, e_style)
+        e_path = jnp.where(c5_enter, fpath, e_path)
+        e_style = jnp.where(c6_enter, style, e_style)
+        e_path = jnp.where(c6_enter, fpath, e_path)
+        e_style = jnp.where(c7_enter, _QUOTED, e_style)
+        e_path = jnp.where(c7_enter, fpath, e_path)
+        e_style = jnp.where(c8_go, jnp.where(fflag, _QUOTED, style), e_style)
+        e_path = jnp.where(c8_go, fpath, e_path)
+
+        # -- enter dispatch (evaluate_path cases) --
+        pt = ptype[jnp.clip(e_path, 0, P1 - 1)]
+        ptn = ptype[jnp.clip(e_path + 1, 0, P1 - 1)]
+        path_end = pt == _P_END
+        is_str = k == jt.VALUE_STRING
+        is_arr = k == jt.START_ARRAY
+        is_obj = k == jt.START_OBJECT
+        mtch = match_at(st["tcur"])
+
+        need_comma = (gtop(st["g_depth"], st["gp"]) > 0) & \
+            ~gtop(st["g_empty"], st["gp"])
+
+        m1 = enter & is_str & path_end & (e_style == _RAW)
+        m2 = enter & is_arr & path_end & (e_style == _FLATTEN) & ~m1
+        m3 = enter & path_end & ~m1 & ~m2
+        rest = enter & ~path_end
+        m4 = rest & is_obj & (pt == NAMED)
+        m5 = rest & is_arr & (pt == WILDCARD) & (ptn == WILDCARD)
+        m6 = rest & is_arr & (pt == WILDCARD) & (e_style != _QUOTED) & ~m5
+        m7 = rest & is_arr & (pt == WILDCARD) & ~m5 & ~m6
+        m8 = rest & is_arr & (pt == INDEX)
+        m12 = rest & ~m4 & ~m5 & ~m6 & ~m7 & ~m8
+
+        def push(st, mask, case_v, style_v, path_v, aux_v=None, flag_v=None):
+            st["fp"] = jnp.where(mask, st["fp"] + 1, st["fp"])
+            over = mask & (st["fp"] >= F)
+            st["err"] = st["err"] | over
+            st["fp"] = jnp.where(over, F - 1, st["fp"])
+            m = mask & ~over
+            st["f_case"] = set_top(st["f_case"], st["fp"], m, case_v)
+            st["f_style"] = set_top(st["f_style"], st["fp"], m, style_v)
+            st["f_path"] = set_top(st["f_path"], st["fp"], m, path_v)
+            st["f_dirty"] = set_top(st["f_dirty"], st["fp"], m, 0)
+            st["f_sub"] = set_top(st["f_sub"], st["fp"], m, _SUB_NONE)
+            st["f_aux"] = set_top(st["f_aux"], st["fp"], m,
+                                  0 if aux_v is None else aux_v)
+            st["f_flag"] = set_top(st["f_flag"], st["fp"], m,
+                                   False if flag_v is None else flag_v)
+            return st
+
+        # case 1
+        seg = seg.at[:, 1, 0].set(jnp.where(m1, _SEG_RAW_TOK, seg[:, 1, 0]))
+        seg = seg.at[:, 1, 1].set(jnp.where(m1, st["tcur"], seg[:, 1, 1]))
+        wrote1 = m1 & (gtop(st["g_depth"], st["gp"]) > 0)
+        st["g_empty"] = set_gtop(st["g_empty"], st["gp"], wrote1, False)
+        st["ret_valid"] = st["ret_valid"] | m1
+        st["ret_dirty"] = jnp.where(m1, 1, st["ret_dirty"])
+        st["tcur"] = jnp.where(m1, st["tcur"] + 1, st["tcur"])
+
+        # case 2
+        st = push(st, m2, _F_CASE2, _FLATTEN, P1 - 1)
+        st["tcur"] = jnp.where(m2, st["tcur"] + 1, st["tcur"])
+
+        # case 3
+        badk = _isin(k, (jt.FIELD_NAME, jt.END_OBJECT, jt.END_ARRAY,
+                         jt.ERRORTOK, jt.PAD))
+        st["err"] = st["err"] | (m3 & badk)
+        ok3 = m3 & ~badk
+        seg = seg.at[:, 0, 0].set(jnp.where(ok3 & need_comma, _SEG_CONST,
+                                            seg[:, 0, 0]))
+        seg = seg.at[:, 0, 1].set(jnp.where(ok3 & need_comma, _C_COMMA,
+                                            seg[:, 0, 1]))
+        seg = seg.at[:, 1, 0].set(jnp.where(ok3, _SEG_ESC_TOK, seg[:, 1, 0]))
+        seg = seg.at[:, 1, 1].set(jnp.where(ok3, st["tcur"], seg[:, 1, 1]))
+        st["g_empty"] = set_gtop(st["g_empty"], st["gp"],
+                                 ok3 & (gtop(st["g_depth"], st["gp"]) > 0),
+                                 False)
+        opn = ok3 & (is_arr | is_obj)
+        st = push(st, opn, _F_COPY, _RAW, 0, aux_v=mtch)
+        scal = ok3 & ~opn
+        st["ret_valid"] = st["ret_valid"] | scal
+        st["ret_dirty"] = jnp.where(scal, 1, st["ret_dirty"])
+        st["tcur"] = jnp.where(ok3, st["tcur"] + 1, st["tcur"])
+
+        # case 4
+        st = push(st, m4, _F_CASE4, e_style, e_path)
+        st["tcur"] = jnp.where(m4, st["tcur"] + 1, st["tcur"])
+
+        # case 5
+        seg = seg.at[:, 0, 0].set(jnp.where(m5 & need_comma, _SEG_CONST,
+                                            seg[:, 0, 0]))
+        seg = seg.at[:, 0, 1].set(jnp.where(m5 & need_comma, _C_COMMA,
+                                            seg[:, 0, 1]))
+        seg = seg.at[:, 1, 0].set(jnp.where(m5, _SEG_CONST, seg[:, 1, 0]))
+        seg = seg.at[:, 1, 1].set(jnp.where(m5, _C_OPEN_ARR, seg[:, 1, 1]))
+        st["g_depth"] = set_gtop(st["g_depth"], st["gp"], m5,
+                                 gtop(st["g_depth"], st["gp"]) + 1)
+        st["g_empty"] = set_gtop(st["g_empty"], st["gp"], m5, True)
+        st = push(st, m5, _F_CASE5, e_style, e_path + 2)
+        st["tcur"] = jnp.where(m5, st["tcur"] + 1, st["tcur"])
+
+        # case 6
+        child_style = jnp.where(e_style == _RAW, _QUOTED, _FLATTEN).astype(_I8)
+        st = push(st, m6, _F_CASE6, child_style, e_path + 1,
+                  aux_v=jnp.full((n,), s, _I32), flag_v=need_comma)
+        st["gp"] = jnp.where(m6, st["gp"] + 1, st["gp"])
+        overg = m6 & (st["gp"] >= G)
+        st["err"] = st["err"] | overg
+        st["gp"] = jnp.where(overg, G - 1, st["gp"])
+        st["g_depth"] = set_gtop(st["g_depth"], st["gp"], m6, 1)
+        st["g_empty"] = set_gtop(st["g_empty"], st["gp"], m6, True)
+        seg = seg.at[:, 0, 0].set(jnp.where(m6, _SEG_COND_OPEN, seg[:, 0, 0]))
+        seg = seg.at[:, 0, 1].set(jnp.where(m6, s, seg[:, 0, 1]))
+        st["tcur"] = jnp.where(m6, st["tcur"] + 1, st["tcur"])
+
+        # case 7
+        seg = seg.at[:, 0, 0].set(jnp.where(m7 & need_comma, _SEG_CONST,
+                                            seg[:, 0, 0]))
+        seg = seg.at[:, 0, 1].set(jnp.where(m7 & need_comma, _C_COMMA,
+                                            seg[:, 0, 1]))
+        seg = seg.at[:, 1, 0].set(jnp.where(m7, _SEG_CONST, seg[:, 1, 0]))
+        seg = seg.at[:, 1, 1].set(jnp.where(m7, _C_OPEN_ARR, seg[:, 1, 1]))
+        st["g_depth"] = set_gtop(st["g_depth"], st["gp"], m7,
+                                 gtop(st["g_depth"], st["gp"]) + 1)
+        st["g_empty"] = set_gtop(st["g_empty"], st["gp"], m7, True)
+        st = push(st, m7, _F_CASE7, e_style, e_path + 1)
+        st["tcur"] = jnp.where(m7, st["tcur"] + 1, st["tcur"])
+
+        # cases 8/9
+        idxv = parg[jnp.clip(e_path, 0, P1 - 1)]
+        st = push(st, m8, _F_CASE8, e_style, e_path + 1,
+                  aux_v=idxv, flag_v=(ptn == WILDCARD))
+        st["tcur"] = jnp.where(m8, st["tcur"] + 1, st["tcur"])
+
+        # case 12
+        isopen12 = is_arr | is_obj
+        skip12 = jnp.where(isopen12, mtch + 1, st["tcur"] + 1)
+        st["tcur"] = jnp.where(m12, skip12, st["tcur"])
+        st["ret_valid"] = st["ret_valid"] | m12
+        st["ret_dirty"] = jnp.where(m12, 0, st["ret_dirty"])
+
+        return st, (seg, close_grp, close_dirty, close_nc)
+
+    init = dict(
+        tcur=jnp.zeros((n,), _I32),
+        err=~ok,
+        done=jnp.zeros((n,), bool),
+        dirty_root=jnp.zeros((n,), _I32),
+        ret_valid=jnp.zeros((n,), bool),
+        ret_dirty=jnp.zeros((n,), _I32),
+        fp=jnp.full((n,), -1, _I32),
+        f_case=jnp.zeros((n, F), _I8),
+        f_path=jnp.zeros((n, F), _I32),
+        f_style=jnp.zeros((n, F), _I8),
+        f_dirty=jnp.zeros((n, F), _I32),
+        f_sub=jnp.zeros((n, F), _I8),
+        f_aux=jnp.zeros((n, F), _I32),
+        f_flag=jnp.zeros((n, F), bool),
+        g_depth=jnp.zeros((n, G), _I32),
+        g_empty=jnp.ones((n, G), bool),
+        gp=jnp.zeros((n,), _I32),
+        entered_root=jnp.zeros((n,), bool),
+    )
+    st, ys = jax.lax.scan(step, init, jnp.arange(S, dtype=_I32))
+    return st["err"], st["done"], st["dirty_root"], ys
+
+
+def run_device(kind, start, end, match, ntok, ok, path_types, path_args,
+               name_match):
+    """Drop-in device replacement for the host _Machine: same result shape."""
+    n, T = kind.shape
+    P1 = len(path_types) + 1
+    ptype = np.asarray(list(path_types) + [_P_END], np.int32)
+    parg = np.asarray(
+        [a if isinstance(a, int) else 0 for a in path_args] + [0], np.int32)
+    if name_match:
+        nm_stack = np.stack(name_match).astype(bool)
+        nm_stack = np.concatenate(
+            [nm_stack, np.zeros((P1 - len(name_match), n, T), bool)])
+    else:
+        nm_stack = np.zeros((P1, n, T), bool)
+
+    F = min(jt.MAX_DEPTH + MAX_PATH_DEPTH + 6, T + 3)
+    G = min(MAX_PATH_DEPTH + 2, F)
+    err, done, dirty_root, (segs, close_grp, close_dirty, close_nc) = \
+        _run_scan(jnp.asarray(kind), jnp.asarray(match),
+                  jnp.asarray(ntok.astype(np.int32)),
+                  jnp.asarray(np.asarray(ok, bool)), jnp.asarray(nm_stack),
+                  jnp.asarray(ptype), jnp.asarray(parg), T, F, G)
+
+    err = np.asarray(err) | ~np.asarray(done)
+    segs_np = np.asarray(segs)  # [S, n, 2, 2]
+    seg_list = [segs_np[i].astype(np.int32) for i in range(segs_np.shape[0])]
+
+    res_dirty = {}
+    res_nc = {}
+    cg = np.asarray(close_grp)
+    cd = np.asarray(close_dirty)
+    cn = np.asarray(close_nc)
+    steps, rows = np.nonzero(cg >= 0)
+    for srow, r in zip(steps, rows):
+        g = int(cg[srow, r])
+        res_dirty.setdefault(g, np.zeros(n, np.int64))[r] = cd[srow, r]
+        res_nc.setdefault(g, np.zeros(n, bool))[r] = cn[srow, r]
+
+    return SimpleNamespace(
+        n=n, T=T, err=err, dirty_root=np.asarray(dirty_root).astype(np.int64),
+        res_dirty=res_dirty, res_nc=res_nc,
+    ), seg_list

@@ -308,6 +308,38 @@ _FUZZ_PATHS = [
 ]
 
 
+def test_device_eval_backend_corpus():
+    """The jitted lax.scan evaluator must match the host machine exactly."""
+    from spark_rapids_jni_tpu import config
+
+    rows = [
+        '{"k": "v"}', "{'k' : [0,1,2]}", "[ [0], [10, 11, 12], [2] ]",
+        "[ [11, 12], [21, [221, [2221, [22221, 22222]]]], [31, 32] ]",
+        "[1, [21, 22], 3]", "[1]", "123", "'abc'", "bad", None, "",
+        '{"a":[{"b":1},{"b":2}]}', '{"a": 1.5e2, "b": -0}',
+        r"""'中国\"\'\\\/\b\f\n\r\t\b'""",
+    ]
+    paths = [[], [named("k")], [WC], [WC, WC], [idx(1)], [idx(1), WC],
+             [named("a"), WC, named("b")]]
+    for path in paths:
+        host = run(rows, path)
+        with config.override(json_eval_device=True):
+            dev = run(rows, path)
+        assert dev == host, f"path={path}"
+
+
+def test_device_eval_backend_fuzz():
+    from spark_rapids_jni_tpu import config
+
+    rng = random.Random(7)
+    rows = [_rand_json(rng) for _ in range(120)]
+    for path in _FUZZ_PATHS[:6]:
+        want = [jo.get_json_object(s, path) for s in rows]
+        with config.override(json_eval_device=True):
+            got = run(rows, path)
+        assert got == want, f"path={path}"
+
+
 def test_fuzz_against_oracle():
     from spark_rapids_jni_tpu import config
 

@@ -135,6 +135,15 @@ def test_fault_injection_wildcard_and_types():
     icol = column([1], INT32)
     with pytest.raises(GpuSplitAndRetryOOM):
         ops.xxhash64([icol])
+    FaultInjector.uninstall()
+
+    from spark_rapids_jni_tpu.mem.exceptions import OffHeapOOM
+
+    FaultInjector.install({
+        "op": {"murmur_hash32": {"injectionType": "host_oom"}},
+    })
+    with pytest.raises(OffHeapOOM):
+        ops.murmur_hash32([icol], seed=0)
 
 
 def test_fault_injection_percent_seeded():
