@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_rapids_jni_tpu.columnar import dtypes
+from spark_rapids_jni_tpu.obs.seam import TRANSFER, instrument
 from spark_rapids_jni_tpu.columnar.dtypes import DType, Kind
 
 
@@ -241,6 +242,7 @@ def _validity_from(values: Sequence) -> Optional[jnp.ndarray]:
     return None
 
 
+@instrument(TRANSFER, "column")
 def column(values: Sequence, dtype: DType) -> Column:
     """Build a fixed-width Column from a python sequence (None == null).
 
@@ -261,6 +263,7 @@ def column(values: Sequence, dtype: DType) -> Column:
     return Column(jnp.asarray(filled), _validity_from(values), dtype)
 
 
+@instrument(TRANSFER, "decimal128_column")
 def decimal128_column(
     unscaled: Sequence, precision: int, scale: int
 ) -> Decimal128Column:
@@ -281,6 +284,7 @@ def decimal128_column(
     )
 
 
+@instrument(TRANSFER, "strings_column")
 def strings_column(values: Sequence[Optional[str]]) -> StringColumn:
     """Build a StringColumn from python strings (None == null).
 
@@ -301,6 +305,7 @@ def strings_column(values: Sequence[Optional[str]]) -> StringColumn:
     )
 
 
+@instrument(TRANSFER, "strings_from_bytes")
 def strings_from_bytes(values: Sequence[Optional[bytes]]) -> StringColumn:
     """Build a StringColumn from raw byte strings (None == null)."""
     bufs = []

@@ -1035,8 +1035,21 @@ def get_json_object(col: StringColumn, path: Sequence[tuple]) -> StringColumn:
         if config.get("json_eval_device"):
             from spark_rapids_jni_tpu.ops.json_eval_device import run_device
 
-            m, segs = run_device(kind, start, end, match, ntok, ok,
-                                 ptypes, pargs, nm)
+            # scan on the full pow2-padded bucket (bounded compile-variant
+            # set); the padding tail has ok=False so it idles, and outputs
+            # are sliced back to the real rows below
+            nr, nv = b.n_rows, b.n_valid
+            nm_full = [np.pad(a, ((0, nr - nv), (0, 0))) for a in nm]
+            m, segs = run_device(
+                np.asarray(ts.kind).astype(np.int32), None, None,
+                np.asarray(ts.match), np.asarray(ts.n_tokens).astype(np.int64),
+                np.asarray(ts.ok), ptypes, pargs, nm_full)
+            m.err = m.err[:nv]
+            m.dirty_root = m.dirty_root[:nv]
+            m.n = nv
+            segs = [sg[:nv] for sg in segs]
+            m.res_dirty = {g: v[:nv] for g, v in m.res_dirty.items()}
+            m.res_nc = {g: v[:nv] for g, v in m.res_nc.items()}
         else:
             m = _Machine(kind, start, end, match, ntok, ok, ptypes, pargs, nm)
             segs = m.run()

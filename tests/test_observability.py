@@ -48,7 +48,10 @@ def test_profiler_capture_and_convert(tmp_path):
     ranges = [e for e in events if e["type"] == "range"]
     names = {e["name"] for e in ranges}
     assert "murmur_hash32" in names and "xxhash64" in names
-    assert all(e["category"] == "op" for e in ranges)
+    cats = {e["name"]: e["category"] for e in ranges}
+    assert cats["murmur_hash32"] == "op"
+    assert cats["xxhash64"] == "op"
+    assert cats["column"] == "transfer"  # h2d construction seam
     assert all(e["end_ns"] >= e["start_ns"] for e in ranges)
     markers = [e for e in events if e["type"] == "instant"]
     assert markers and markers[0]["name"] == "checkpoint-a"
@@ -144,6 +147,14 @@ def test_fault_injection_wildcard_and_types():
     })
     with pytest.raises(OffHeapOOM):
         ops.murmur_hash32([icol], seed=0)
+    FaultInjector.uninstall()
+
+    # transfer seam: host->device column construction is interceptable too
+    FaultInjector.install({
+        "transfer": {"strings_column": {"injectionType": "exception"}},
+    })
+    with pytest.raises(InjectedException):
+        strings_column(["x"])
 
 
 def test_fault_injection_percent_seeded():
