@@ -47,6 +47,16 @@ def _block(out):
             leaf.block_until_ready()
 
 
+
+def _stage(detail, key, fn):
+    """Run one benchmark stage; a failure becomes a detail entry, not a
+    bench abort (axon remote compiles can OOM/timeout per kernel)."""
+    try:
+        detail[key] = fn()
+    except Exception as e:  # noqa: BLE001 - reported, never fatal
+        detail[key] = {"error": repr(e)[:300]}
+
+
 def main():
     # Fail fast instead of hanging forever when the TPU tunnel is dead
     # (shared probe with the driver's dryrun entry point).
@@ -108,15 +118,20 @@ def main():
     ns = min(n, 1 << 20)  # host-orchestrated: smaller working set
     fvals = rng.rand(ns) * np.exp(rng.uniform(-30, 30, size=ns))
     fcol = Column(jnp.asarray(fvals.view(np.int64)), None, FLOAT64)
-    dt = _time(lambda c: float_to_string(c).chars, max(iters // 4, 3), fcol)
-    f2s_rows_s = ns / dt
-    scol = float_to_string(fcol)
-    dt = _time(
-        lambda c: string_to_float(c, ansi_mode=False, dtype=FLOAT64).data,
-        max(iters // 4, 3), scol)
-    s2f_rows_s = ns / dt
-    detail["float_to_string"] = {"Mrows_per_s": round(f2s_rows_s / 1e6, 2)}
-    detail["string_to_float"] = {"Mrows_per_s": round(s2f_rows_s / 1e6, 2)}
+
+    def _f2s():
+        dt = _time(lambda c: float_to_string(c).chars, max(iters // 4, 3), fcol)
+        return {"Mrows_per_s": round(ns / dt / 1e6, 2)}
+
+    def _s2f():
+        scol = float_to_string(fcol)
+        dt = _time(
+            lambda c: string_to_float(c, ansi_mode=False, dtype=FLOAT64).data,
+            max(iters // 4, 3), scol)
+        return {"Mrows_per_s": round(ns / dt / 1e6, 2)}
+
+    _stage(detail, "float_to_string", _f2s)
+    _stage(detail, "string_to_float", _s2f)
 
     # ---- config 3: row conversion (fixed-width) ---------------------------
     nr = min(n, 1 << 22)
@@ -128,23 +143,30 @@ def main():
         Column(jnp.asarray(rng.rand(nr).view(np.int64)), None, FLOAT64),
     ]
     row_bytes = 8 + 4 + 8 + 4  # 8B-aligned JCUDF row incl. pad + validity
-    dt = _time(lambda: convert_to_rows_fixed_width_optimized(cols),
-               max(iters // 4, 3))
-    to_rows_s = nr / dt
-    rows_col = convert_to_rows_fixed_width_optimized(cols)[0]
-    dtypes = [INT64, INT32, FLOAT64]
-    dt = _time(
-        lambda: convert_from_rows_fixed_width_optimized(rows_col, dtypes),
-        max(iters // 4, 3))
-    from_rows_s = nr / dt
-    detail["rows_to"] = {
-        "Mrows_per_s": round(to_rows_s / 1e6, 2),
-        "roofline_frac": round(to_rows_s * 2 * row_bytes / roofline_bytes_s, 3),
-    }
-    detail["rows_from"] = {
-        "Mrows_per_s": round(from_rows_s / 1e6, 2),
-        "roofline_frac": round(from_rows_s * 2 * row_bytes / roofline_bytes_s, 3),
-    }
+
+    def _rows_to():
+        dt = _time(lambda: convert_to_rows_fixed_width_optimized(cols),
+                   max(iters // 4, 3))
+        return {
+            "Mrows_per_s": round(nr / dt / 1e6, 2),
+            "roofline_frac": round(
+                (nr / dt) * 2 * row_bytes / roofline_bytes_s, 3),
+        }
+
+    def _rows_from():
+        rows_col = convert_to_rows_fixed_width_optimized(cols)[0]
+        dtypes = [INT64, INT32, FLOAT64]
+        dt = _time(
+            lambda: convert_from_rows_fixed_width_optimized(rows_col, dtypes),
+            max(iters // 4, 3))
+        return {
+            "Mrows_per_s": round(nr / dt / 1e6, 2),
+            "roofline_frac": round(
+                (nr / dt) * 2 * row_bytes / roofline_bytes_s, 3),
+        }
+
+    _stage(detail, "rows_to", _rows_to)
+    _stage(detail, "rows_from", _rows_from)
 
     # ---- config 4: bloom filter build+probe, decimal128 multiply ----------
     keys = Column(jnp.asarray(rng.randint(0, 1 << 62, n, dtype=np.int64)),
@@ -155,12 +177,14 @@ def main():
         bf = bloom_filter_put(bf0, k)
         return bloom_filter_probe(k, bf).data
 
-    dt = _time(build_and_probe, max(iters // 4, 3), keys)
-    bloom_rows_s = n / dt
-    detail["bloom_build_probe"] = {
-        "Mrows_per_s": round(bloom_rows_s / 1e6, 2),
-        "roofline_frac": round(bloom_rows_s * 16 / roofline_bytes_s, 3),
-    }
+    def _bloom():
+        dt = _time(build_and_probe, max(iters // 4, 3), keys)
+        return {
+            "Mrows_per_s": round(n / dt / 1e6, 2),
+            "roofline_frac": round((n / dt) * 16 / roofline_bytes_s, 3),
+        }
+
+    _stage(detail, "bloom_build_probe", _bloom)
 
     from spark_rapids_jni_tpu.columnar.column import Decimal128Column
 
@@ -173,8 +197,12 @@ def main():
         c.hi if hasattr(c, "hi") else c.data
         for c in multiply128(Decimal128Column(x_hi, x_lo, None, d128),
                              Decimal128Column(x_hi, x_lo, None, d128), 2)))
-    dt = _time(mul, max(iters // 8, 2), a.hi, a.lo)
-    detail["decimal128_multiply"] = {"Mrows_per_s": round(nd / dt / 1e6, 2)}
+
+    def _dec():
+        dt = _time(mul, max(iters // 8, 2), a.hi, a.lo)
+        return {"Mrows_per_s": round(nd / dt / 1e6, 2)}
+
+    _stage(detail, "decimal128_multiply", _dec)
 
     print(json.dumps({
         "metric": "murmur3_32_int32_throughput",

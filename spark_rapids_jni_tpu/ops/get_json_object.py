@@ -1019,15 +1019,21 @@ def get_json_object(col: StringColumn, path: Sequence[tuple]) -> StringColumn:
     valid_out = np.zeros((n,), bool)
     for b in padded_buckets(col):
         ts = jt.tokenize(b.bytes, b.lengths)
-        kind = np.asarray(ts.kind)[: b.n_valid].astype(np.int32)
-        start = np.asarray(ts.start)[: b.n_valid]
-        end = np.asarray(ts.end)[: b.n_valid]
-        match = np.asarray(ts.match)[: b.n_valid]
-        ntok = np.asarray(ts.n_tokens)[: b.n_valid].astype(np.int64)
-        ok = np.asarray(ts.ok)[: b.n_valid]
-        rows_np = np.asarray(b.rows)[: b.n_valid]
+        # one device->host transfer per token array; host paths use slices
+        kind_f = np.asarray(ts.kind).astype(np.int32)
+        match_f = np.asarray(ts.match)
+        ntok_f = np.asarray(ts.n_tokens).astype(np.int64)
+        ok_f = np.asarray(ts.ok)
+        nr, nv = b.n_rows, b.n_valid
+        kind = kind_f[:nv]
+        start = np.asarray(ts.start)[:nv]
+        end = np.asarray(ts.end)[:nv]
+        match = match_f[:nv]
+        ntok = ntok_f[:nv]
+        ok = ok_f[:nv]
+        rows_np = np.asarray(b.rows)[:nv]
 
-        bi = _byte_info(b.bytes, b.lengths, n_valid=b.n_valid)
+        bi = _byte_info(b.bytes, b.lengths, n_valid=nv)
         len_raw, len_esc, has_uni, neg0 = _token_tables(bi, kind, start, end)
         nm = _name_matches(bi, kind, start, end, names, len_raw, has_uni)
         ftext, flen, fidx = _float_texts(bi, kind, start, end)
@@ -1038,12 +1044,9 @@ def get_json_object(col: StringColumn, path: Sequence[tuple]) -> StringColumn:
             # scan on the full pow2-padded bucket (bounded compile-variant
             # set); the padding tail has ok=False so it idles, and outputs
             # are sliced back to the real rows below
-            nr, nv = b.n_rows, b.n_valid
             nm_full = [np.pad(a, ((0, nr - nv), (0, 0))) for a in nm]
-            m, segs = run_device(
-                np.asarray(ts.kind).astype(np.int32), None, None,
-                np.asarray(ts.match), np.asarray(ts.n_tokens).astype(np.int64),
-                np.asarray(ts.ok), ptypes, pargs, nm_full)
+            m, segs = run_device(kind_f, match_f, ntok_f, ok_f,
+                                 ptypes, pargs, nm_full)
             m.err = m.err[:nv]
             m.dirty_root = m.dirty_root[:nv]
             m.n = nv

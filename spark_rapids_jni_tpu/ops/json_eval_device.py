@@ -453,8 +453,7 @@ def _run_scan(kind, match, ntok, ok, nm_stack, ptype, parg,
     return st["err"], st["done"], st["dirty_root"], ys
 
 
-def run_device(kind, start, end, match, ntok, ok, path_types, path_args,
-               name_match):
+def run_device(kind, match, ntok, ok, path_types, path_args, name_match):
     """Drop-in device replacement for the host _Machine: same result shape."""
     n, T = kind.shape
     P1 = len(path_types) + 1

@@ -335,3 +335,135 @@ def test_cpu_budget_like_limiting_offheap(gov):
         budget.acquire(1)
     budget.release(64)
     gov.task_done(1)
+
+
+# -- additional RmmSparkTest.java scenario ports --------------------------
+
+
+def test_insert_multiple_ooms(gov):
+    """testInsertMultipleOOMs: queued injections drain one per alloc, with
+    block_thread_until_ready a no-op between them."""
+    gov.current_thread_is_dedicated_to_task(0)
+    arb, tid = gov.arbiter, current_thread_id()
+    assert arb.pre_alloc(tid) is False
+    arb.post_alloc_success(tid)
+
+    gov.force_retry_oom(num_ooms=3)
+    for _ in range(3):
+        with pytest.raises(GpuRetryOOM):
+            arb.pre_alloc(tid)
+        gov.block_thread_until_ready()  # injected OOM: no actual block
+    assert arb.pre_alloc(tid) is False
+    arb.post_alloc_success(tid)
+
+    gov.force_split_and_retry_oom(num_ooms=5)
+    for _ in range(5):
+        with pytest.raises(GpuSplitAndRetryOOM):
+            arb.pre_alloc(tid)
+        gov.block_thread_until_ready()
+    assert arb.pre_alloc(tid) is False
+    arb.post_alloc_success(tid)
+    gov.task_done(0)
+
+
+def test_insert_ooms_with_skip_count(gov):
+    """forceRetryOOM skip_count: the first ``skip`` allocations succeed."""
+    gov.current_thread_is_dedicated_to_task(0)
+    arb, tid = gov.arbiter, current_thread_id()
+    gov.force_retry_oom(num_ooms=1, skip_count=2)
+    for _ in range(2):
+        assert arb.pre_alloc(tid) is False
+        arb.post_alloc_success(tid)
+    with pytest.raises(GpuRetryOOM):
+        arb.pre_alloc(tid)
+    assert arb.pre_alloc(tid) is False
+    arb.post_alloc_success(tid)
+    gov.task_done(0)
+
+
+def test_non_blocking_alloc_failed(gov):
+    """testNonBlockingCpuAllocFailedOOM: a non-blocking failed alloc returns
+    the thread to RUNNING instead of BLOCKED."""
+    from spark_rapids_jni_tpu.mem import STATE_ALLOC
+
+    gov.current_thread_is_dedicated_to_task(0)
+    arb, tid = gov.arbiter, current_thread_id()
+    assert gov.state_of_current_thread() == STATE_RUNNING
+    arb.pre_alloc(tid, is_cpu=True, blocking=False)
+    assert gov.state_of_current_thread() == STATE_ALLOC
+    retryable = arb.post_alloc_failed(tid, is_cpu=True, is_oom=True,
+                                      blocking=False)
+    assert gov.state_of_current_thread() == STATE_RUNNING
+    assert isinstance(retryable, bool)
+    gov.remove_current_dedicated_thread_association(0)
+
+
+def test_reentrant_associate_thread(gov):
+    """testReentrantAssociateThread (RmmSparkTest.java:439): double
+    registration, un-matched removes, and dedicated<->shuffle transitions
+    must all be tolerated (GPU-semaphore usage doesn't match counts)."""
+    arb = gov.arbiter
+    tid = 100  # explicit foreign thread id, as in the reference
+    arb.start_dedicated_task_thread(tid, 1)
+    arb.start_dedicated_task_thread(tid, 1)
+    arb.remove_thread_association(tid, 1)
+    arb.pool_thread_working_on_task(tid, 1, is_shuffle=True)
+    arb.pool_thread_working_on_task(tid, 1, is_shuffle=True)
+    arb.remove_thread_association(tid, 1)
+    arb.remove_thread_association(tid, 1)
+    gov.task_done(1)
+
+
+def test_injected_exception_skip_count(gov):
+    """testCudfException with skips: exception fires after N clean allocs."""
+    gov.current_thread_is_dedicated_to_task(0)
+    arb, tid = gov.arbiter, current_thread_id()
+    gov.force_injected_exception(num_times=1)
+    with pytest.raises(InjectedException):
+        arb.pre_alloc(tid)
+    # injection consumed; next alloc clean
+    assert arb.pre_alloc(tid) is False
+    arb.post_alloc_success(tid)
+    gov.task_done(0)
+
+
+def test_mixed_gpu_cpu_blocking(gov):
+    """testBasicMixedBlocking core: GPU and CPU budgets block independently
+    and wake on their own release paths."""
+    gpu = BudgetedResource(gov, limit_bytes=100)
+    cpu = BudgetedResource(gov, limit_bytes=100, is_cpu=True)
+    done = {}
+    ready = threading.Event()
+
+    def holder():
+        gov.current_thread_is_dedicated_to_task(1)
+        gpu.acquire(90)
+        cpu.acquire(90)
+        ready.set()
+        wait_for(lambda: gov.arbiter.total_blocked_or_bufn() >= 2,
+                 msg="both waiters blocked")
+        gpu.release(90)
+        cpu.release(90)
+        gov.remove_current_dedicated_thread_association()
+
+    def gpu_waiter():
+        ready.wait()
+        gov.current_thread_is_dedicated_to_task(2)
+        gpu.acquire(50)
+        done["gpu"] = True
+        gpu.release(50)
+        gov.remove_current_dedicated_thread_association()
+
+    def cpu_waiter():
+        ready.wait()
+        gov.current_thread_is_dedicated_to_task(3)
+        cpu.acquire(50)
+        done["cpu"] = True
+        cpu.release(50)
+        gov.remove_current_dedicated_thread_association()
+
+    with ThreadPoolExecutor(max_workers=3) as ex:
+        fs = [ex.submit(holder), ex.submit(gpu_waiter), ex.submit(cpu_waiter)]
+        for f in fs:
+            f.result(timeout=15)
+    assert done == {"gpu": True, "cpu": True}
