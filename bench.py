@@ -97,34 +97,56 @@ def main():
     rng = np.random.RandomState(42)
 
     # ---- measured HBM roofline (read + write of f32) ----------------------
-    big = jnp.asarray(rng.rand(max(n, 1 << 24)).astype(np.float32))
-    copy = jax.jit(lambda x: x + 1.0)
-    dt = _time(copy, iters, big)
-    roofline_bytes_s = 2 * big.size * 4 / dt
-    detail["hbm_roofline_GBps"] = round(roofline_bytes_s / 1e9, 1)
+    roofline_bytes_s = float("nan")
+
+    def _roofline():
+        nonlocal roofline_bytes_s
+        big = jnp.asarray(rng.rand(max(n, 1 << 24)).astype(np.float32))
+        copy = jax.jit(lambda x: x + 1.0)
+        dt = _time(copy, iters, big)
+        roofline_bytes_s = 2 * big.size * 4 / dt
+        return round(roofline_bytes_s / 1e9, 1)
+
+    _stage(detail, "hbm_roofline_GBps", _roofline)
+
+    def _frac(bytes_per_s):
+        # None (JSON null) when the roofline stage failed, never NaN
+        if roofline_bytes_s != roofline_bytes_s:
+            return None
+        return round(bytes_per_s / roofline_bytes_s, 3)
 
     # ---- config 1: murmur3-32 on INT32 ------------------------------------
-    data = jnp.asarray(rng.randint(-(2**31), 2**31, size=n).astype(np.int32))
-    hash_col = jax.jit(
-        lambda d: murmur_hash32([Column(d, None, INT32)], seed=42).data)
-    dt = _time(hash_col, iters, data)
-    mm_rows_s = n / dt
-    detail["murmur3_int32"] = {
-        "Grows_per_s": round(mm_rows_s / 1e9, 3),
-        "roofline_frac": round(mm_rows_s * 8 / roofline_bytes_s, 3),
-    }
+    mm_rows_s = 0.0
+
+    def _murmur():
+        nonlocal mm_rows_s
+        data = jnp.asarray(
+            rng.randint(-(2**31), 2**31, size=n).astype(np.int32))
+        hash_col = jax.jit(
+            lambda d: murmur_hash32([Column(d, None, INT32)], seed=42).data)
+        dt = _time(hash_col, iters, data)
+        mm_rows_s = n / dt
+        return {
+            "Grows_per_s": round(mm_rows_s / 1e9, 3),
+            "roofline_frac": _frac(mm_rows_s * 8),
+        }
+
+    _stage(detail, "murmur3_int32", _murmur)
 
     # ---- config 2: string<->float -----------------------------------------
     ns = min(n, 1 << 20)  # host-orchestrated: smaller working set
-    fvals = rng.rand(ns) * np.exp(rng.uniform(-30, 30, size=ns))
-    fcol = Column(jnp.asarray(fvals.view(np.int64)), None, FLOAT64)
+
+    def _fcol():
+        fvals = rng.rand(ns) * np.exp(rng.uniform(-30, 30, size=ns))
+        return Column(jnp.asarray(fvals.view(np.int64)), None, FLOAT64)
 
     def _f2s():
+        fcol = _fcol()
         dt = _time(lambda c: float_to_string(c).chars, max(iters // 4, 3), fcol)
         return {"Mrows_per_s": round(ns / dt / 1e6, 2)}
 
     def _s2f():
-        scol = float_to_string(fcol)
+        scol = float_to_string(_fcol())
         dt = _time(
             lambda c: string_to_float(c, ansi_mode=False, dtype=FLOAT64).data,
             max(iters // 4, 3), scol)
@@ -135,53 +157,57 @@ def main():
 
     # ---- config 3: row conversion (fixed-width) ---------------------------
     nr = min(n, 1 << 22)
-    cols = [
-        Column(jnp.asarray(rng.randint(-(2**31), 2**31, nr, dtype=np.int64)),
-               None, INT64),
-        Column(jnp.asarray(rng.randint(-(2**31), 2**31, nr).astype(np.int32)),
-               None, INT32),
-        Column(jnp.asarray(rng.rand(nr).view(np.int64)), None, FLOAT64),
-    ]
+
+    def _cols():
+        return [
+            Column(jnp.asarray(
+                rng.randint(-(2**31), 2**31, nr, dtype=np.int64)),
+                None, INT64),
+            Column(jnp.asarray(
+                rng.randint(-(2**31), 2**31, nr).astype(np.int32)),
+                None, INT32),
+            Column(jnp.asarray(rng.rand(nr).view(np.int64)), None, FLOAT64),
+        ]
+
     row_bytes = 8 + 4 + 8 + 4  # 8B-aligned JCUDF row incl. pad + validity
 
     def _rows_to():
+        cols = _cols()
         dt = _time(lambda: convert_to_rows_fixed_width_optimized(cols),
                    max(iters // 4, 3))
         return {
             "Mrows_per_s": round(nr / dt / 1e6, 2),
-            "roofline_frac": round(
-                (nr / dt) * 2 * row_bytes / roofline_bytes_s, 3),
+            "roofline_frac": _frac((nr / dt) * 2 * row_bytes),
         }
 
     def _rows_from():
-        rows_col = convert_to_rows_fixed_width_optimized(cols)[0]
+        rows_col = convert_to_rows_fixed_width_optimized(_cols())[0]
         dtypes = [INT64, INT32, FLOAT64]
         dt = _time(
             lambda: convert_from_rows_fixed_width_optimized(rows_col, dtypes),
             max(iters // 4, 3))
         return {
             "Mrows_per_s": round(nr / dt / 1e6, 2),
-            "roofline_frac": round(
-                (nr / dt) * 2 * row_bytes / roofline_bytes_s, 3),
+            "roofline_frac": _frac((nr / dt) * 2 * row_bytes),
         }
 
     _stage(detail, "rows_to", _rows_to)
     _stage(detail, "rows_from", _rows_from)
 
     # ---- config 4: bloom filter build+probe, decimal128 multiply ----------
-    keys = Column(jnp.asarray(rng.randint(0, 1 << 62, n, dtype=np.int64)),
-                  None, INT64)
-    bf0 = bloom_filter_create(3, 1 << 15)
-
-    def build_and_probe(k):
-        bf = bloom_filter_put(bf0, k)
-        return bloom_filter_probe(k, bf).data
-
     def _bloom():
+        keys = Column(jnp.asarray(rng.randint(0, 1 << 62, n, dtype=np.int64)),
+                      None, INT64)
+        bf0 = bloom_filter_create(3, 1 << 15)
+
+        def build_and_probe(k):
+            bf = bloom_filter_put(bf0, k)
+            return bloom_filter_probe(k, bf).data
+
         dt = _time(build_and_probe, max(iters // 4, 3), keys)
         return {
             "Mrows_per_s": round(n / dt / 1e6, 2),
-            "roofline_frac": round((n / dt) * 16 / roofline_bytes_s, 3),
+            "roofline_frac": _frac((n / dt) * 16),
         }
 
     _stage(detail, "bloom_build_probe", _bloom)
@@ -189,26 +215,28 @@ def main():
     from spark_rapids_jni_tpu.columnar.column import Decimal128Column
 
     nd = min(n, 1 << 22)
-    lo = rng.randint(0, 1 << 62, nd, dtype=np.uint64)
-    hi = rng.randint(-(1 << 30), 1 << 30, nd, dtype=np.int64)
-    d128 = DType(Kind.DECIMAL128, scale=2)
-    a = Decimal128Column(jnp.asarray(hi), jnp.asarray(lo), None, d128)
-    mul = jax.jit(lambda x_hi, x_lo: tuple(
-        c.hi if hasattr(c, "hi") else c.data
-        for c in multiply128(Decimal128Column(x_hi, x_lo, None, d128),
-                             Decimal128Column(x_hi, x_lo, None, d128), 2)))
 
     def _dec():
+        lo = rng.randint(0, 1 << 62, nd, dtype=np.uint64)
+        hi = rng.randint(-(1 << 30), 1 << 30, nd, dtype=np.int64)
+        d128 = DType(Kind.DECIMAL128, scale=2)
+        a = Decimal128Column(jnp.asarray(hi), jnp.asarray(lo), None, d128)
+        mul = jax.jit(lambda x_hi, x_lo: tuple(
+            c.hi if hasattr(c, "hi") else c.data
+            for c in multiply128(Decimal128Column(x_hi, x_lo, None, d128),
+                                 Decimal128Column(x_hi, x_lo, None, d128), 2)))
         dt = _time(mul, max(iters // 8, 2), a.hi, a.lo)
         return {"Mrows_per_s": round(nd / dt / 1e6, 2)}
 
     _stage(detail, "decimal128_multiply", _dec)
 
+    measured = mm_rows_s > 0
     print(json.dumps({
         "metric": "murmur3_32_int32_throughput",
-        "value": round(mm_rows_s / 1e9, 4),
+        "value": round(mm_rows_s / 1e9, 4) if measured else None,
         "unit": "Grows/s",
-        "vs_baseline": round(mm_rows_s / NOMINAL_BASELINE_ROWS_PER_S, 4),
+        "vs_baseline": (round(mm_rows_s / NOMINAL_BASELINE_ROWS_PER_S, 4)
+                        if measured else None),
         "detail": detail,
     }))
 

@@ -90,18 +90,70 @@ class TimeZoneDB:
         # zone id -> (utc_instants, tz_instants, offsets) device arrays
         self._tables: Dict[str, Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]] = {}
         self._table_lock = threading.Lock()
+        self._loader: Optional[threading.Thread] = None
 
     @classmethod
     def instance(cls) -> "TimeZoneDB":
         with cls._lock:
             if cls._instance is None:
+                if cls._shutdown_called:
+                    # GpuTimeZoneDB: once shut down, never load again
+                    raise RuntimeError("TimeZoneDB was shut down")
                 cls._instance = cls()
             return cls._instance
 
     @classmethod
     def shutdown(cls) -> None:
+        """Drop the cache and refuse future loads until re-enabled
+        (GpuTimeZoneDB.java:76 'whether a shutdown is called ever')."""
         with cls._lock:
+            inst = cls._instance
+            loader = inst._loader if inst is not None else None
+        if loader is not None:
+            try:
+                loader.join(timeout=30)  # shutdown waits for async caching
+            except RuntimeError:
+                pass  # loader created but never started
+        with cls._lock:
+            cls._shutdown_called = True
             cls._instance = None
+
+    _shutdown_called = False
+
+    @classmethod
+    def cache_database(cls, zone_ids=None) -> None:
+        """Eagerly build transition tables (GpuTimeZoneDB.cacheDatabase:129).
+
+        ``zone_ids`` defaults to every zone the host tzdata provides whose
+        rules the non-DST cache supports; unsupported/unknown zones are
+        skipped, as the reference skips zones it cannot represent.
+        """
+        with cls._lock:
+            if cls._shutdown_called:
+                return  # reference: never load again after shutdown
+        inst = cls.instance()
+        if zone_ids is None:
+            import zoneinfo
+
+            zone_ids = sorted(zoneinfo.available_timezones())
+        for z in zone_ids:
+            try:
+                inst.transitions(z)
+            except (KeyError, ValueError):
+                continue  # unknown or recurring-DST zone: not cacheable
+
+    @classmethod
+    def cache_database_async(cls, zone_ids=None) -> None:
+        """Background-thread preload (GpuTimeZoneDB.cacheDatabaseAsync:88)."""
+        with cls._lock:
+            if cls._shutdown_called:
+                return
+        inst = cls.instance()
+        t = threading.Thread(
+            target=cls.cache_database, args=(zone_ids,),
+            name="srt-tzdb-loader", daemon=True)
+        t.start()
+        inst._loader = t  # published only once started (shutdown joins it)
 
     def _build_rows(self, zone_id: str) -> List[Tuple[int, int, int]]:
         """(utcInstant, tzInstant, offset) rows per GpuTimeZoneDB.java:284-318."""

@@ -185,3 +185,31 @@ def test_negative_truncation_millis():
     """duration_cast truncates toward zero: -1ms -> 0s epoch seconds."""
     out = convert_utc_timestamp_to_timezone(column([-1], TIMESTAMP_MILLIS), "UTC+8")
     assert out.to_list() == [-1 + 28800 * 1000]
+
+
+def test_cache_database_async_and_shutdown():
+    """cacheDatabaseAsync/cacheDatabase/shutdown lifecycle
+    (GpuTimeZoneDB.java:88-156)."""
+    import pytest
+
+    from spark_rapids_jni_tpu.ops.timezones import TimeZoneDB
+
+    try:
+        TimeZoneDB._shutdown_called = False
+        TimeZoneDB._instance = None
+        TimeZoneDB.cache_database_async(
+            ["Asia/Shanghai", "UTC", "No/Such_Zone"])
+        TimeZoneDB.instance()._loader.join(timeout=30)
+        inst = TimeZoneDB.instance()
+        assert "Asia/Shanghai" in inst._tables
+        assert "UTC" in inst._tables
+        assert "No/Such_Zone" not in inst._tables  # unknown zones skipped
+        # shutdown: cache dropped, later loads refuse
+        TimeZoneDB.shutdown()
+        TimeZoneDB.cache_database(["UTC"])  # silent no-op
+        assert TimeZoneDB._instance is None
+        with pytest.raises(RuntimeError, match="shut down"):
+            TimeZoneDB.instance()
+    finally:
+        TimeZoneDB._shutdown_called = False
+        TimeZoneDB._instance = None
