@@ -1,12 +1,14 @@
 """Staged benchmarks vs a *measured* HBM roofline.
 
 Covers BASELINE.md staged configs 1-4 (the reference's nvbench list,
-benchmarks/CMakeLists.txt:72-85 maps to the same ops):
+benchmarks/CMakeLists.txt:72-85 maps to the same ops) plus the config-5
+query-step core:
 
 1. murmur3-32 over one INT32 column (headline metric)
 2. string<->float casts (string_to_float / float_to_string)
 3. JCUDF row conversion to/from rows (fixed-width)
 4. bloom filter build+probe and decimal128 multiply
+5. q97 two-table join-count core (models/q97.py, single-chip)
 
 The roofline is measured on the same device with a saturating copy kernel
 (read+write of a large f32 array); every config reports achieved bytes/s as
@@ -229,6 +231,21 @@ def main():
         return {"Mrows_per_s": round(nd / dt / 1e6, 2)}
 
     _stage(detail, "decimal128_multiply", _dec)
+
+    # ---- config 5 direction: q97 query-step core --------------------------
+    def _q97():
+        from spark_rapids_jni_tpu.models import q97_local
+
+        nq = min(n, 1 << 22)
+        s_cust = jnp.asarray(rng.randint(1, 1 << 20, nq).astype(np.int32))
+        s_item = jnp.asarray(rng.randint(1, 1 << 16, nq).astype(np.int32))
+        c_cust = jnp.asarray(rng.randint(1, 1 << 20, nq).astype(np.int32))
+        c_item = jnp.asarray(rng.randint(1, 1 << 16, nq).astype(np.int32))
+        fn = jax.jit(lambda a, b, c, d: tuple(q97_local((a, b), (c, d))))
+        dt = _time(fn, max(iters // 4, 3), s_cust, s_item, c_cust, c_item)
+        return {"Mrows_per_s": round(2 * nq / dt / 1e6, 2)}
+
+    _stage(detail, "q97_join_count", _q97)
 
     measured = mm_rows_s > 0
     print(json.dumps({
