@@ -95,8 +95,32 @@ class MemoryGovernor:
     def pool_thread_finished_for_task(self, task_id: int):
         self.arbiter.pool_thread_finished_for_task(current_thread_id(), task_id)
 
+    def pool_thread_finished_for_tasks(self, task_ids):
+        tid = current_thread_id()
+        for task_id in task_ids:
+            self.arbiter.pool_thread_finished_for_task(tid, task_id)
+
+    # shuffle threads register/deregister through the same pool protocol
+    shuffle_thread_finished_for_tasks = pool_thread_finished_for_tasks
+
     def remove_current_dedicated_thread_association(self, task_id: int = -1):
         self.arbiter.remove_thread_association(current_thread_id(), task_id)
+
+    def remove_all_current_thread_association(self):
+        """removeAllCurrentThreadAssociation (RmmSpark.java:323)."""
+        self.arbiter.remove_thread_association(current_thread_id(), -1)
+
+    # -- transitive pool blocking (RmmSpark.java:344-399) -------------------
+    # A dedicated task thread that submits to / waits on a thread pool can be
+    # transitively blocked by it; the deadlock detector must see it blocked.
+    def submitting_to_pool(self):
+        self.arbiter.set_pool_blocked(current_thread_id(), True)
+
+    def waiting_on_pool(self):
+        self.arbiter.set_pool_blocked(current_thread_id(), True)
+
+    def done_waiting_on_pool(self):
+        self.arbiter.set_pool_blocked(current_thread_id(), False)
 
     def task_done(self, task_id: int):
         self.arbiter.task_done(task_id)

@@ -467,3 +467,66 @@ def test_mixed_gpu_cpu_blocking(gov):
         for f in fs:
             f.result(timeout=15)
     assert done == {"gpu": True, "cpu": True}
+
+
+def test_pool_submission_protocol(gov):
+    """submittingToPool/waitingOnPool/doneWaitingOnPool + plural finishers
+    (RmmSpark.java:195-234, 344-399)."""
+    gov.current_thread_is_dedicated_to_task(5)
+    gov.submitting_to_pool()
+    gov.waiting_on_pool()
+    gov.done_waiting_on_pool()
+    gov.remove_all_current_thread_association()
+
+    gov.shuffle_thread_working_on_tasks([1, 2, 3])
+    gov.shuffle_thread_finished_for_tasks([1, 2, 3])
+    gov.pool_thread_working_on_task(4)
+    gov.pool_thread_finished_for_tasks([4])
+    for t in (1, 2, 3, 4, 5):
+        gov.task_done(t)
+
+
+def test_pool_wait_counts_as_blocked_for_deadlock(gov):
+    """A thread waiting on a pool is transitively blocked: with every other
+    thread blocked on memory, the watchdog must still detect the deadlock."""
+    budget = BudgetedResource(gov, limit_bytes=100)
+    outcome = {}
+    pool_blocked = threading.Event()
+
+    def submitter():
+        gov.current_thread_is_dedicated_to_task(1)
+        budget.acquire(90)
+        gov.submitting_to_pool()
+        gov.waiting_on_pool()
+        pool_blocked.set()  # only now may task 2 try (and fail) to acquire
+        # wait until the other task ends up blocked, then the watchdog must
+        # escalate it (this thread can't be woken: it is pool-blocked)
+        wait_for(lambda: outcome.get("t2_done"), timeout=15,
+                 msg="task2 escalated")
+        gov.done_waiting_on_pool()
+        budget.release(90)
+        gov.remove_all_current_thread_association()
+
+    def blocked_task():
+        pool_blocked.wait(timeout=15)
+        gov.current_thread_is_dedicated_to_task(2)
+        escalated = False
+        try:
+            budget.acquire(50)  # must escalate, not hang: t1 is pool-blocked
+            budget.release(50)
+        except (GpuRetryOOM, GpuSplitAndRetryOOM):
+            escalated = True
+        finally:
+            outcome["t2_done"] = True
+            outcome["escalated"] = escalated
+            gov.remove_current_dedicated_thread_association()
+
+    with ThreadPoolExecutor(max_workers=2) as ex:
+        f1 = ex.submit(submitter)
+        f2 = ex.submit(blocked_task)
+        f1.result(timeout=30)
+        f2.result(timeout=30)
+    assert outcome.get("t2_done") is True
+    # the acquire cannot have succeeded: 90 of 100 was held by a pool-blocked
+    # thread, so the watchdog must have escalated task 2
+    assert outcome.get("escalated") is True
