@@ -66,9 +66,10 @@ def main():
 
     usable, reason = probe_ambient(1, timeout=180)
     if not usable:
+        # null = missing measurement (same convention as a failed stage)
         print(json.dumps({
-            "metric": "murmur3_32_int32_throughput", "value": 0.0,
-            "unit": "Grows/s", "vs_baseline": 0.0,
+            "metric": "murmur3_32_int32_throughput", "value": None,
+            "unit": "Grows/s", "vs_baseline": None,
             "detail": {"error": f"device unusable: {reason}"},
         }))
         return
