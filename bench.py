@@ -50,11 +50,29 @@ def _block(out):
 
 
 
-def _stage(detail, key, fn):
+def _stage(detail, key, fn, nbytes=0):
     """Run one benchmark stage; a failure becomes a detail entry, not a
-    bench abort (axon remote compiles can OOM/timeout per kernel)."""
+    bench abort (axon remote compiles can OOM/timeout per kernel).
+
+    Every stage's working set is admitted through the memory governor via
+    the canonical retry driver (mem/governed.py) — the bench runs governed,
+    like any other consumer of the framework.  A bench stage is not
+    splittable (it measures one fixed geometry), so a split signal becomes
+    the stage's error entry."""
+    from spark_rapids_jni_tpu.mem.governed import (
+        default_device_budget,
+        run_with_split_retry,
+    )
+
+    budget = default_device_budget()
     try:
-        detail[key] = fn()
+        detail[key] = run_with_split_retry(
+            budget, None,
+            nbytes_of=lambda _b: int(nbytes),
+            run=lambda _b: fn(),
+            split=lambda _b: [],
+            combine=lambda rs: rs[0],
+        )
     except Exception as e:  # noqa: BLE001 - reported, never fatal
         detail[key] = {"error": repr(e)[:300]}
 
@@ -93,11 +111,18 @@ def main():
     )
 
     from spark_rapids_jni_tpu import config
+    from spark_rapids_jni_tpu.mem.governor import MemoryGovernor
 
     detail = {}
     n = config.get("bench_rows")
     iters = config.get("bench_iters")
     rng = np.random.RandomState(42)
+
+    # the bench is a governed tenant like any framework consumer: one
+    # dedicated task thread, every stage's working set admitted through the
+    # arbiter (_stage reserves nbytes before launching device work)
+    gov = MemoryGovernor.initialize()
+    gov.current_thread_is_dedicated_to_task(0)
 
     # ---- measured HBM roofline (read + write of f32) ----------------------
     roofline_bytes_s = float("nan")
@@ -110,7 +135,8 @@ def main():
         roofline_bytes_s = 2 * big.size * 4 / dt
         return round(roofline_bytes_s / 1e9, 1)
 
-    _stage(detail, "hbm_roofline_GBps", _roofline)
+    _stage(detail, "hbm_roofline_GBps", _roofline,
+           nbytes=max(n, 1 << 24) * 4 * 2)
 
     def _frac(bytes_per_s):
         # None (JSON null) when the roofline stage failed, never NaN
@@ -134,7 +160,7 @@ def main():
             "roofline_frac": _frac(mm_rows_s * 8),
         }
 
-    _stage(detail, "murmur3_int32", _murmur)
+    _stage(detail, "murmur3_int32", _murmur, nbytes=n * 8 * 2)
 
     # ---- config 2: string<->float -----------------------------------------
     ns = min(n, 1 << 20)  # host-orchestrated: smaller working set
@@ -155,8 +181,8 @@ def main():
             max(iters // 4, 3), scol)
         return {"Mrows_per_s": round(ns / dt / 1e6, 2)}
 
-    _stage(detail, "float_to_string", _f2s)
-    _stage(detail, "string_to_float", _s2f)
+    _stage(detail, "float_to_string", _f2s, nbytes=ns * 64)
+    _stage(detail, "string_to_float", _s2f, nbytes=ns * 64)
 
     # ---- config 3: row conversion (fixed-width) ---------------------------
     nr = min(n, 1 << 22)
@@ -194,8 +220,8 @@ def main():
             "roofline_frac": _frac((nr / dt) * 2 * row_bytes),
         }
 
-    _stage(detail, "rows_to", _rows_to)
-    _stage(detail, "rows_from", _rows_from)
+    _stage(detail, "rows_to", _rows_to, nbytes=nr * row_bytes * 3)
+    _stage(detail, "rows_from", _rows_from, nbytes=nr * row_bytes * 3)
 
     # ---- config 4: bloom filter build+probe, decimal128 multiply ----------
     def _bloom():
@@ -213,7 +239,7 @@ def main():
             "roofline_frac": _frac((n / dt) * 16),
         }
 
-    _stage(detail, "bloom_build_probe", _bloom)
+    _stage(detail, "bloom_build_probe", _bloom, nbytes=n * 16 * 2)
 
     from spark_rapids_jni_tpu.columnar.column import Decimal128Column
 
@@ -231,7 +257,7 @@ def main():
         dt = _time(mul, max(iters // 8, 2), a.hi, a.lo)
         return {"Mrows_per_s": round(nd / dt / 1e6, 2)}
 
-    _stage(detail, "decimal128_multiply", _dec)
+    _stage(detail, "decimal128_multiply", _dec, nbytes=nd * 16 * 4)
 
     # ---- config 5 direction: q97 query-step core --------------------------
     def _q97():
@@ -246,7 +272,11 @@ def main():
         dt = _time(fn, max(iters // 4, 3), s_cust, s_item, c_cust, c_item)
         return {"Mrows_per_s": round(2 * nq / dt / 1e6, 2)}
 
-    _stage(detail, "q97_join_count", _q97)
+    _stage(detail, "q97_join_count", _q97,
+           nbytes=min(n, 1 << 22) * 4 * 4 * 4)
+
+    gov.task_done(0)
+    MemoryGovernor.shutdown()
 
     measured = mm_rows_s > 0
     print(json.dumps({

@@ -1,10 +1,17 @@
 #!/usr/bin/env bash
 # CI test entry (premerge-build.sh analog): lint, unit suite on a virtual
 # 8-device CPU mesh, arbiter fuzz (fuzz-test.sh analog), multichip dryrun.
+# QUICK=1 runs the fast tier only (-m "not slow", no fuzz/dryrun) for
+# inner-loop iteration; full CI always runs everything.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 python ci/lint.py
+
+if [[ "${QUICK:-0}" == "1" ]]; then
+    exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu SRT_REEXECED=1 \
+        python -m pytest tests/ -q -m "not slow"
+fi
 
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu SRT_REEXECED=1 \
     python -m pytest tests/ -q

@@ -150,3 +150,7 @@ register("watchdog_period_s", 0.1,
          "Memory-governor deadlock-watchdog poll period (the "
          "rmmWatchdogPollingPeriod analog, SparkResourceAdaptor.java:35).",
          env="SRT_WATCHDOG_PERIOD_S")
+register("device_budget_bytes", 8 << 30,
+         "Default HBM working-set admission budget for governed execution "
+         "(mem/governed.py); the RMM pool-size analog.",
+         env="SRT_DEVICE_BUDGET_BYTES")

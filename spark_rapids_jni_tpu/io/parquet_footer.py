@@ -21,6 +21,13 @@ parquet-format parquet.thrift):
 - RowGroup: 1=columns, 3=num_rows, 5=file_offset, 6=total_compressed_size
 - ColumnChunk: 3=meta_data; ColumnMetaData: 7=total_compressed_size,
   9=data_page_offset, 11=dictionary_page_offset
+
+DELIBERATE DEVIATION from the reference: ``read_and_filter`` rewrites
+``FileMetaData.num_rows`` (field 3) to the sum over surviving row groups so
+the re-serialized footer is self-consistent; ``NativeParquetJni.cpp`` leaves
+the original file-level count stale and computes ``getNumRows`` from
+row_groups instead.  Readers that trust FileMetaData.num_rows (parquet-mr
+split planning) will see the filtered count here, the unfiltered one there.
 """
 
 from __future__ import annotations
@@ -183,9 +190,16 @@ def _read_value(buf: bytes, pos: int, ttype: int) -> Tuple[object, int]:
         pos += 1
         ktype, vtype = head >> 4, head & 0x0F
         pairs = []
+
+        def _elem(p, etype):
+            # bools in map elements are one byte each, like list elements
+            if etype == _T_TRUE:
+                return buf[p] == 1, p + 1
+            return _read_value(buf, p, etype)
+
         for _ in range(size):
-            k, pos = _read_value(buf, pos, ktype)
-            v, pos = _read_value(buf, pos, vtype)
+            k, pos = _elem(pos, ktype)
+            v, pos = _elem(pos, vtype)
             pairs.append((k, v))
         return (ktype, vtype, pairs), pos
     if ttype == _T_STRUCT:
@@ -243,8 +257,14 @@ def _write_value(out: bytearray, ttype: int, value) -> None:
         if pairs:
             out.append((ktype << 4) | vtype)
             for k, v in pairs:
-                _write_value(out, ktype, k)
-                _write_value(out, vtype, v)
+                if ktype == _T_TRUE:
+                    out.append(1 if k else 2)
+                else:
+                    _write_value(out, ktype, k)
+                if vtype == _T_TRUE:
+                    out.append(1 if v else 2)
+                else:
+                    _write_value(out, vtype, v)
     elif ttype == _T_STRUCT:
         _write_struct(out, value)
     else:

@@ -33,6 +33,13 @@ from spark_rapids_jni_tpu.mem.exceptions import (
     SplitAndRetryOOM,
     ThreadRemovedError,
 )
+from spark_rapids_jni_tpu.mem.governed import (
+    MaxSplitDepthExceeded,
+    default_device_budget,
+    reservation,
+    run_with_split_retry,
+    task_context,
+)
 from spark_rapids_jni_tpu.mem.governor import (
     BudgetedResource,
     MemoryGovernor,
@@ -42,6 +49,11 @@ from spark_rapids_jni_tpu.mem.governor import (
 __all__ = [
     "Arbiter",
     "BudgetedResource",
+    "MaxSplitDepthExceeded",
+    "default_device_budget",
+    "reservation",
+    "run_with_split_retry",
+    "task_context",
     "CpuRetryOOM",
     "CpuSplitAndRetryOOM",
     "GpuOOM",

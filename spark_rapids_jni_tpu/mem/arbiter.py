@@ -129,7 +129,7 @@ class Arbiter:
 
     def close(self):
         if self._h:
-            self._lib.arbiter_destroy(self._h)
+            self._lib.arbiter_destroy(self.handle)
             self._h = None
 
     def __enter__(self):
@@ -137,6 +137,15 @@ class Arbiter:
 
     def __exit__(self, *a):
         self.close()
+
+    @property
+    def handle(self):
+        """Native handle; raises instead of passing NULL into the C API
+        after close() (a stale cached facade would otherwise segfault)."""
+        h = self._h
+        if not h:
+            raise RuntimeError("arbiter is closed")
+        return h
 
     def _check(self, code: int) -> int:
         if code >= 0:
@@ -146,58 +155,61 @@ class Arbiter:
 
     # registration ----------------------------------------------------------
     def start_dedicated_task_thread(self, thread_id, task_id):
-        self._check(self._lib.arbiter_start_dedicated_task_thread(self._h, thread_id, task_id))
+        self._check(self._lib.arbiter_start_dedicated_task_thread(self.handle, thread_id, task_id))
 
     def pool_thread_working_on_task(self, thread_id, task_id, is_shuffle=False):
         self._check(
-            self._lib.arbiter_pool_thread_working_on_task(self._h, thread_id, task_id, is_shuffle)
+            self._lib.arbiter_pool_thread_working_on_task(
+                self.handle, thread_id, task_id, is_shuffle)
         )
 
     def pool_thread_finished_for_task(self, thread_id, task_id):
-        self._check(self._lib.arbiter_pool_thread_finished_for_task(self._h, thread_id, task_id))
+        self._check(self._lib.arbiter_pool_thread_finished_for_task(
+            self.handle, thread_id, task_id))
 
     def remove_thread_association(self, thread_id, task_id=-1):
-        self._check(self._lib.arbiter_remove_thread_association(self._h, thread_id, task_id))
+        self._check(self._lib.arbiter_remove_thread_association(self.handle, thread_id, task_id))
 
     def task_done(self, task_id):
-        self._check(self._lib.arbiter_task_done(self._h, task_id))
+        self._check(self._lib.arbiter_task_done(self.handle, task_id))
 
     def set_pool_blocked(self, thread_id, blocked):
-        self._check(self._lib.arbiter_set_pool_blocked(self._h, thread_id, blocked))
+        self._check(self._lib.arbiter_set_pool_blocked(self.handle, thread_id, blocked))
 
     def set_externally_blocked(self, thread_id, blocked):
-        self._check(self._lib.arbiter_set_externally_blocked(self._h, thread_id, blocked))
+        self._check(self._lib.arbiter_set_externally_blocked(self.handle, thread_id, blocked))
 
     # retry / injection -----------------------------------------------------
     def start_retry_block(self, thread_id):
-        self._check(self._lib.arbiter_start_retry_block(self._h, thread_id))
+        self._check(self._lib.arbiter_start_retry_block(self.handle, thread_id))
 
     def end_retry_block(self, thread_id):
-        self._check(self._lib.arbiter_end_retry_block(self._h, thread_id))
+        self._check(self._lib.arbiter_end_retry_block(self.handle, thread_id))
 
     def force_retry_oom(self, thread_id, num_ooms, oom_filter=OOM_GPU, skip_count=0):
         self._check(
-            self._lib.arbiter_force_retry_oom(self._h, thread_id, num_ooms, oom_filter, skip_count)
+            self._lib.arbiter_force_retry_oom(
+                self.handle, thread_id, num_ooms, oom_filter, skip_count)
         )
 
     def force_split_and_retry_oom(self, thread_id, num_ooms, oom_filter=OOM_GPU, skip_count=0):
         self._check(
             self._lib.arbiter_force_split_and_retry_oom(
-                self._h, thread_id, num_ooms, oom_filter, skip_count
+                self.handle, thread_id, num_ooms, oom_filter, skip_count
             )
         )
 
     def force_injected_exception(self, thread_id, num_times):
-        self._check(self._lib.arbiter_force_cudf_exception(self._h, thread_id, num_times))
+        self._check(self._lib.arbiter_force_cudf_exception(self.handle, thread_id, num_times))
 
     # alloc protocol --------------------------------------------------------
     def pre_alloc(self, thread_id, is_cpu=False, blocking=True) -> bool:
         """True if this is a recursive (spill) allocation."""
-        return self._check(self._lib.arbiter_pre_alloc(self._h, thread_id, is_cpu, blocking)) == RECURSIVE  # noqa
+        return self._check(self._lib.arbiter_pre_alloc(self.handle, thread_id, is_cpu, blocking)) == RECURSIVE  # noqa
 
     def post_alloc_success(self, thread_id, is_cpu=False, was_recursive=False):
         self._check(
-            self._lib.arbiter_post_alloc_success(self._h, thread_id, is_cpu, was_recursive)
+            self._lib.arbiter_post_alloc_success(self.handle, thread_id, is_cpu, was_recursive)
         )
 
     def post_alloc_failed(self, thread_id, is_cpu=False, is_oom=True, blocking=True,
@@ -206,36 +218,37 @@ class Arbiter:
         return (
             self._check(
                 self._lib.arbiter_post_alloc_failed(
-                    self._h, thread_id, is_cpu, is_oom, blocking, was_recursive
+                    self.handle, thread_id, is_cpu, is_oom, blocking, was_recursive
                 )
             )
             == 1
         )
 
     def dealloc(self, thread_id, is_cpu=False):
-        self._check(self._lib.arbiter_dealloc(self._h, thread_id, is_cpu))
+        self._check(self._lib.arbiter_dealloc(self.handle, thread_id, is_cpu))
 
     def block_thread_until_ready(self, thread_id):
-        self._check(self._lib.arbiter_block_thread_until_ready(self._h, thread_id))
+        self._check(self._lib.arbiter_block_thread_until_ready(self.handle, thread_id))
 
     def check_and_break_deadlocks(self):
-        self._check(self._lib.arbiter_check_and_break_deadlocks(self._h))
+        self._check(self._lib.arbiter_check_and_break_deadlocks(self.handle))
 
     # introspection ---------------------------------------------------------
     def state_of(self, thread_id) -> int:
-        return self._lib.arbiter_get_state_of(self._h, thread_id)
+        return self._lib.arbiter_get_state_of(self.handle, thread_id)
 
     def get_and_reset_num_retry(self, task_id) -> int:
-        return self._lib.arbiter_get_and_reset_metric(self._h, task_id, METRIC_RETRY_COUNT)
+        return self._lib.arbiter_get_and_reset_metric(self.handle, task_id, METRIC_RETRY_COUNT)
 
     def get_and_reset_num_split_retry(self, task_id) -> int:
-        return self._lib.arbiter_get_and_reset_metric(self._h, task_id, METRIC_SPLIT_RETRY_COUNT)
+        return self._lib.arbiter_get_and_reset_metric(
+            self.handle, task_id, METRIC_SPLIT_RETRY_COUNT)
 
     def get_and_reset_blocked_time_ns(self, task_id) -> int:
-        return self._lib.arbiter_get_and_reset_metric(self._h, task_id, METRIC_BLOCKED_NS)
+        return self._lib.arbiter_get_and_reset_metric(self.handle, task_id, METRIC_BLOCKED_NS)
 
     def get_and_reset_compute_time_lost_ns(self, task_id) -> int:
-        return self._lib.arbiter_get_and_reset_metric(self._h, task_id, METRIC_LOST_NS)
+        return self._lib.arbiter_get_and_reset_metric(self.handle, task_id, METRIC_LOST_NS)
 
     def total_blocked_or_bufn(self) -> int:
-        return self._lib.arbiter_get_total_blocked_or_bufn(self._h)
+        return self._lib.arbiter_get_total_blocked_or_bufn(self.handle)

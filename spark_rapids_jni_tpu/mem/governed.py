@@ -1,0 +1,223 @@
+"""Governed execution: the retry/split-and-retry driver over the arbiter.
+
+This is the glue the reference expresses in its *protocol documentation*
+(RmmSpark.java:402-416): task code brackets device work in a retry block,
+reserves its working set before launching, and reacts to the two arbiter
+signals —
+
+- ``RetryOOM``: roll back and retry the same batch (the arbiter has already
+  blocked the thread until memory was freed);
+- ``SplitAndRetryOOM``: the thread holds the highest priority and still can't
+  make progress — *split the input batch* into smaller disjoint pieces and
+  process them sequentially, combining partial results.
+
+On the reference GPU stack the reservation point is RMM ``do_allocate``
+(SparkResourceAdaptorJni.cpp:1731); on TPU, XLA owns allocation, so the
+admission point is :meth:`BudgetedResource.acquire` *before* the jitted
+launch.  Everything else — blocking, BUFN escalation, watchdog, metrics —
+is byte-identical state-machine behavior (native/task_arbiter.cpp).
+
+Usage shape (what models/ and bench.py go through)::
+
+    gov = MemoryGovernor.instance()
+    budget = default_device_budget(gov)
+    with task_context(gov, task_id=7):
+        out = run_with_split_retry(
+            budget, batch,
+            nbytes_of=lambda b: b.nbytes * 3,   # working-set estimate
+            run=step,                            # launches device work
+            split=split_in_half,                 # -> [b0, b1] disjoint
+            combine=sum_outputs,
+        )
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+from spark_rapids_jni_tpu.mem.exceptions import RetryOOM, SplitAndRetryOOM
+from spark_rapids_jni_tpu.mem.governor import (
+    BudgetedResource,
+    MemoryGovernor,
+    OutOfBudget,
+)
+
+__all__ = [
+    "task_context",
+    "reservation",
+    "run_with_split_retry",
+    "default_device_budget",
+    "MaxSplitDepthExceeded",
+    "ShuffleCapacityExceeded",
+]
+
+
+class MaxSplitDepthExceeded(MemoryError):
+    """A batch could not be made small enough within the split-depth cap."""
+
+
+class ShuffleCapacityExceeded(Exception):
+    """Raised by a ``run`` callback when a fixed-capacity exchange overflowed
+    (``ShuffleResult.dropped > 0``).  The driver responds by re-running the
+    same piece after ``grow(piece)`` — the shuffle-spill retry the reference
+    protocol describes for exchanges that outgrow their buffers."""
+
+
+@contextlib.contextmanager
+def task_context(gov: MemoryGovernor, task_id: int):
+    """Register the current thread as the dedicated thread of ``task_id``
+    for the duration (startDedicatedTaskThread / taskDone pairing)."""
+    gov.current_thread_is_dedicated_to_task(task_id)
+    try:
+        yield gov
+    finally:
+        gov.task_done(task_id)
+
+
+@contextlib.contextmanager
+def reservation(budget: BudgetedResource, nbytes: int):
+    """Reserve ``nbytes`` of budget around a block of device work.
+
+    ``acquire`` drives the arbiter's pre_alloc/post_alloc protocol: it may
+    block (another task holds the budget), raise RetryOOM/SplitAndRetryOOM
+    (escalation decided this thread must retry or split), or raise
+    OutOfBudget (non-retryable; request exceeds the whole budget).
+    """
+    budget.acquire(nbytes)
+    try:
+        yield
+    finally:
+        budget.release(nbytes)
+
+
+_NO_BUDGET_LOCK = threading.Lock()
+_DEFAULT_BUDGET: Optional[BudgetedResource] = None
+
+
+def _probed_hbm_bytes() -> Optional[int]:
+    """Total accelerator memory of device 0 if the backend reports it."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+        limit = (stats or {}).get("bytes_limit")
+        return int(limit) if limit else None
+    except Exception:  # backend without memory_stats (CPU), or no device
+        return None
+
+
+def default_device_budget(gov: Optional[MemoryGovernor] = None) -> BudgetedResource:
+    """Process-wide device (HBM) budget.
+
+    Sized like the reference sizes its RMM pool — from the real device when
+    the backend reports capacity (``memory_stats()['bytes_limit']``), else
+    the ``device_budget_bytes`` config flag.  The cached facade is rebuilt
+    if the governor it was bound to has been shut down (a stale budget
+    would otherwise drive a closed native arbiter).
+    """
+    global _DEFAULT_BUDGET
+    with _NO_BUDGET_LOCK:
+        stale = (
+            _DEFAULT_BUDGET is not None
+            and _DEFAULT_BUDGET.gov.arbiter._h is None
+        )
+        if _DEFAULT_BUDGET is None or stale:
+            from spark_rapids_jni_tpu import config
+
+            limit = _probed_hbm_bytes() or int(config.get("device_budget_bytes"))
+            _DEFAULT_BUDGET = BudgetedResource(
+                gov or MemoryGovernor.instance(), limit
+            )
+        return _DEFAULT_BUDGET
+
+
+def _reset_default_budget_for_tests():
+    global _DEFAULT_BUDGET
+    with _NO_BUDGET_LOCK:
+        _DEFAULT_BUDGET = None
+
+
+def run_with_split_retry(
+    budget: BudgetedResource,
+    batch: Any,
+    *,
+    nbytes_of: Callable[[Any], int],
+    run: Callable[[Any], Any],
+    split: Callable[[Any], Sequence[Any]],
+    combine: Callable[[List[Any]], Any],
+    grow: Optional[Callable[[Any], Any]] = None,
+    max_split_depth: int = 8,
+    max_grows: int = 8,
+) -> Any:
+    """Process ``batch`` under the arbiter's retry protocol.
+
+    Each (sub-)batch attempt is bracketed in a retry block; the working set
+    ``nbytes_of(b)`` is reserved before ``run(b)`` launches device work and
+    released after.  ``RetryOOM`` retries the same piece (the arbiter already
+    blocked us until memory freed); ``SplitAndRetryOOM`` — and a first-level
+    non-retryable ``OutOfBudget`` whose request exceeds the total budget —
+    replaces the piece with ``split(b)`` (disjoint sub-batches), processed
+    depth-first so partial results stay in input order for ``combine``.
+
+    ``run`` may additionally raise :class:`ShuffleCapacityExceeded` to signal
+    a fixed-capacity exchange overflow; the piece is re-attempted as
+    ``grow(piece)`` (typically doubling the shuffle capacity), with the
+    reservation recomputed for the bigger buffers.
+    """
+    gov = budget.gov
+    results: List[Any] = []
+    # depth-first work list of (piece, depth, grows) keeps combine() order ==
+    # input order
+    work: List[tuple] = [(batch, 0, 0)]
+    while work:
+        piece, depth, grows = work.pop(0)
+        try:
+            results.append(_attempt(gov, budget, piece, nbytes_of, run))
+            continue
+        except ShuffleCapacityExceeded:
+            if grow is None or grows >= max_grows:
+                raise
+            work.insert(0, (grow(piece), depth, grows + 1))
+            continue
+        except SplitAndRetryOOM as e:
+            err = e
+        except OutOfBudget as e:
+            if int(nbytes_of(piece)) <= budget.limit:
+                # the arbiter declared this non-retryable (livelock cap /
+                # unregistered thread): a real OOM, as in the reference
+                raise
+            err = e
+        if depth >= max_split_depth:
+            raise MaxSplitDepthExceeded(
+                f"split depth {depth} reached and batch still does not fit"
+            ) from err
+        parts = list(split(piece))
+        if len(parts) <= 1:
+            raise MaxSplitDepthExceeded(
+                "batch is not splittable further"
+            ) from err
+        work = [(p, depth + 1, grows) for p in parts] + work
+    return combine(results)
+
+
+def _attempt(gov, budget, piece, nbytes_of, run):
+    """One retry-block around one piece.
+
+    Returns run's result; raises SplitAndRetryOOM / terminal OutOfBudget
+    (request larger than the whole budget) for the caller to split, and
+    passes ShuffleCapacityExceeded through for the caller to grow.
+    """
+    nbytes = int(nbytes_of(piece))
+    gov.start_retry_block()
+    try:
+        while True:
+            try:
+                with reservation(budget, nbytes):
+                    return run(piece)
+            except RetryOOM:
+                # arbiter blocked us until ready; same piece, try again
+                continue
+    finally:
+        gov.end_retry_block()

@@ -30,7 +30,11 @@ from spark_rapids_jni_tpu.mem.exceptions import (
     RetryOOM,
     SplitAndRetryOOM,
 )
-from spark_rapids_jni_tpu.mem.governor import BudgetedResource, MemoryGovernor
+from spark_rapids_jni_tpu.mem.governor import (
+    BudgetedResource,
+    MemoryGovernor,
+    OutOfBudget,
+)
 
 __all__ = ["MonteCarloConfig", "MonteCarloStats", "run_monte_carlo", "main"]
 
@@ -155,6 +159,13 @@ def _shuffle_thread(gov: MemoryGovernor, budget: BudgetedResource,
             except (RetryOOM, SplitAndRetryOOM):
                 with stats_lock:
                     stats.retries += 1
+            except OutOfBudget:
+                # non-retryable: record it — a silently-dead shuffle thread
+                # would weaken the run's liveness invariants
+                with stats_lock:
+                    stats.failures.append(
+                        "shuffle thread hit non-retryable OutOfBudget")
+                return
             time.sleep(0.001)
     finally:
         gov.remove_current_dedicated_thread_association(-1)

@@ -6,18 +6,24 @@ from spark_rapids_jni_tpu.models.nds import (
     make_example_batch,
 )
 from spark_rapids_jni_tpu.models.q97 import (
+    Q97Batch,
     Q97Out,
     make_distributed_q97,
     q97_local,
+    run_distributed_q97,
+    split_q97_batch,
 )
 
 __all__ = [
     "QueryStepConfig",
     "QueryStepOut",
+    "Q97Batch",
     "Q97Out",
     "local_query_step",
     "make_distributed_query_step",
     "make_distributed_q97",
     "make_example_batch",
     "q97_local",
+    "run_distributed_q97",
+    "split_q97_batch",
 ]

@@ -29,12 +29,14 @@ capacity exactly like a Spark shuffle spill retry.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from spark_rapids_jni_tpu.ops.hashing import murmur3_raw_int64
 from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS
@@ -109,7 +111,8 @@ def q97_local(store: tuple, catalog: tuple) -> Q97Out:
     return Q97Out(so, co, b, jnp.int32(0))
 
 
-def _sharded_q97(s_cust, s_item, c_cust, c_item, capacity: int):
+def _sharded_q97(s_cust, s_item, c_cust, c_item, capacity: int,
+                 s_valid=None, c_valid=None):
     dp = jax.lax.axis_size(DATA_AXIS)
     sk = _composite_key(s_cust, s_item)
     ck = _composite_key(c_cust, c_item)
@@ -120,9 +123,15 @@ def _sharded_q97(s_cust, s_item, c_cust, c_item, capacity: int):
     tag = jnp.concatenate(
         [jnp.ones(sk.shape, jnp.int8), jnp.zeros(ck.shape, jnp.int8)]
     )
+    row_valid = None
+    if s_valid is not None or c_valid is not None:
+        sv = jnp.ones(sk.shape, bool) if s_valid is None else s_valid
+        cv = jnp.ones(ck.shape, bool) if c_valid is None else c_valid
+        row_valid = jnp.concatenate([sv, cv])
     part = (murmur3_raw_int64(keys, 42) % jnp.uint32(dp)).astype(jnp.int32)
     ex = all_to_all_shuffle(
-        {"k": keys, "tag": tag}, part, capacity, axis=DATA_AXIS
+        {"k": keys, "tag": tag}, part, capacity, axis=DATA_AXIS,
+        row_valid=row_valid,
     )
     so, co, b = _count_runs(
         ex.columns["k"], ex.columns["tag"] == 1, ex.valid
@@ -136,19 +145,206 @@ def _sharded_q97(s_cust, s_item, c_cust, c_item, capacity: int):
     )
 
 
-def make_distributed_q97(mesh, capacity: int):
+def make_distributed_q97(mesh, capacity: int, with_validity: bool = False):
     """jit-compiled distributed q97 over ``mesh``'s data axis.
 
     Inputs: four [rows] int arrays sharded over DATA_AXIS (store customer/
-    item, catalog customer/item).  ``capacity`` bounds per-destination
-    shuffle buckets over the COMBINED row stream (both tables ride one
-    tagged all_to_all); Q97Out.dropped > 0 means retry with a larger one.
+    item, catalog customer/item); with ``with_validity``, two more bool
+    arrays (store row-valid, catalog row-valid) marking padding rows that
+    must not count.  ``capacity`` bounds per-destination shuffle buckets
+    over the COMBINED row stream (both tables ride one tagged all_to_all);
+    Q97Out.dropped > 0 means retry with a larger one.
     """
+    if with_validity:
+        def body(s_cust, s_item, c_cust, c_item, s_valid, c_valid):
+            return _sharded_q97(s_cust, s_item, c_cust, c_item, capacity,
+                                s_valid=s_valid, c_valid=c_valid)
+
+        in_specs = tuple(P(DATA_AXIS) for _ in range(6))
+    else:
+        body = functools.partial(_sharded_q97, capacity=capacity)
+        in_specs = tuple(P(DATA_AXIS) for _ in range(4))
     step = jax.shard_map(
-        functools.partial(_sharded_q97, capacity=capacity),
+        body,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        in_specs=in_specs,
         out_specs=Q97Out(P(), P(), P(), P()),
         check_vma=False,
     )
     return jax.jit(step)
+
+
+# ---------------------------------------------------------------- governed --
+# The host-driven control loop around the jitted step: batch admission through
+# the memory arbiter, key-space split-and-retry, shuffle-capacity-grow retry.
+# This is the protocol of RmmSpark.java:402-416 driving a real query.
+
+
+@dataclasses.dataclass(frozen=True)
+class Q97Batch:
+    """One (sub-)batch of host rows: the store and catalog key columns.
+
+    ``split_depth`` tracks which key-space bit splits this piece next;
+    ``capacity`` is the per-destination shuffle bucket bound.
+    """
+
+    s_cust: np.ndarray
+    s_item: np.ndarray
+    c_cust: np.ndarray
+    c_item: np.ndarray
+    capacity: int
+    split_depth: int = 0
+
+    @property
+    def rows(self) -> int:
+        return len(self.s_cust) + len(self.c_cust)
+
+
+def _split_hash(cust: np.ndarray, item: np.ndarray) -> np.ndarray:
+    """Mixing hash of the composite key for key-space splitting (host)."""
+    packed = (cust.astype(np.int64) << 32) | (item.astype(np.int64) & 0xFFFFFFFF)
+    return packed.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
+
+
+def split_q97_batch(batch: Q97Batch):
+    """Split the *key space* in half (bit ``split_depth`` of a mixing hash).
+
+    Unlike a row split, a key-space split is exact for q97: every distinct
+    key lands wholly in one child (both tables filtered by the same
+    predicate), so the three presence counters sum across children.
+
+    Each child also halves the shuffle capacity — the exchange buffers
+    dominate the working set, and a child carries ~half the rows; if that
+    undershoots, the grow retry recovers it.
+    """
+    bit = np.uint64(63 - batch.split_depth)
+    parts = []
+    for side in (0, 1):
+        sm = ((_split_hash(batch.s_cust, batch.s_item) >> bit) & 1) == side
+        cm = ((_split_hash(batch.c_cust, batch.c_item) >> bit) & 1) == side
+        parts.append(dataclasses.replace(
+            batch,
+            s_cust=batch.s_cust[sm], s_item=batch.s_item[sm],
+            c_cust=batch.c_cust[cm], c_item=batch.c_item[cm],
+            capacity=max(16, batch.capacity // 2),
+            split_depth=batch.split_depth + 1,
+        ))
+    return parts
+
+
+def q97_working_set_bytes(batch: Q97Batch, dp: int) -> int:
+    """Global working-set estimate: inputs + key/tag/valid stream + the
+    [dp, capacity] send/recv exchange buffers + sort-merge workspace."""
+    n = batch.rows
+    per_row = 8 + 1 + 1  # key int64 + tag int8 + row_valid bool
+    slots = dp * dp * batch.capacity
+    return n * (8 + per_row) + 2 * slots * per_row + 2 * slots * 10
+
+
+@functools.lru_cache(maxsize=32)
+def _q97_step_cached(mesh, capacity: int):
+    return make_distributed_q97(mesh, capacity, with_validity=True)
+
+
+def _pad_to_multiple(arr: np.ndarray, mult: int, fill=0):
+    pad = (-len(arr)) % mult
+    if pad == 0:
+        return arr, np.ones(len(arr), bool)
+    padded = np.concatenate([arr, np.full(pad, fill, dtype=arr.dtype)])
+    valid = np.concatenate([np.ones(len(arr), bool), np.zeros(pad, bool)])
+    return padded, valid
+
+
+def default_q97_capacity(total_rows: int, dp: int) -> int:
+    """Safe-ish default per-(sender,dest) bucket bound: uniform share with a
+    2x skew margin (overflow is recoverable via the grow retry)."""
+    return max(16, int(2 * total_rows / (dp * dp)) if dp > 1 else total_rows)
+
+
+def run_distributed_q97(
+    mesh,
+    store,
+    catalog,
+    *,
+    budget=None,
+    task_id: int = 0,
+    capacity: Optional[int] = None,
+    max_split_depth: int = 8,
+    manage_task: bool = True,
+) -> Q97Out:
+    """Governed distributed q97 over host (numpy) inputs.
+
+    ``store``/``catalog`` are (customer_sk, item_sk) int32 array pairs.
+    Every device launch is admitted through the memory arbiter: the working
+    set is reserved before the step runs (mem/governed.py), RetryOOM retries,
+    SplitAndRetryOOM splits the key space (exact), and shuffle-capacity
+    overflow (dropped > 0) grows the exchange buffers and re-reserves.
+
+    Reference protocol: RmmSpark.java:402-416; admission point analog of
+    SparkResourceAdaptorJni.cpp:1731 do_allocate.
+
+    ``manage_task=False`` joins a task context the caller already registered
+    (the Spark shape: one dedicated thread registered per task runs many
+    ops); the default registers/ends ``task_id`` itself.
+    """
+    from spark_rapids_jni_tpu.mem.governed import (
+        ShuffleCapacityExceeded,
+        default_device_budget,
+        run_with_split_retry,
+        task_context,
+    )
+
+    dp = mesh.shape[DATA_AXIS]
+    s_cust, s_item = (np.asarray(a, np.int32) for a in store)
+    c_cust, c_item = (np.asarray(a, np.int32) for a in catalog)
+    if budget is None:
+        budget = default_device_budget()
+    total = len(s_cust) + len(c_cust)
+    cap0 = capacity if capacity is not None else default_q97_capacity(total, dp)
+    batch = Q97Batch(s_cust, s_item, c_cust, c_item, capacity=cap0)
+
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+
+    def run(piece: Q97Batch) -> Q97Out:
+        sc, sv = _pad_to_multiple(piece.s_cust, dp)
+        si, _ = _pad_to_multiple(piece.s_item, dp)
+        cc, cv = _pad_to_multiple(piece.c_cust, dp)
+        ci, _ = _pad_to_multiple(piece.c_item, dp)
+        if len(sc) == 0:
+            sc, sv = np.zeros(dp, np.int32), np.zeros(dp, bool)
+            si = np.zeros(dp, np.int32)
+        if len(cc) == 0:
+            cc, cv = np.zeros(dp, np.int32), np.zeros(dp, bool)
+            ci = np.zeros(dp, np.int32)
+        step = _q97_step_cached(mesh, piece.capacity)
+        args = [jax.device_put(a, sharding)
+                for a in (sc, si, cc, ci, sv, cv)]
+        out = step(*args)
+        jax.block_until_ready(out)
+        if int(out.dropped) > 0:
+            raise ShuffleCapacityExceeded(
+                f"{int(out.dropped)} rows overflowed capacity {piece.capacity}")
+        return out
+
+    def combine(outs) -> Q97Out:
+        return Q97Out(
+            sum(int(o.store_only) for o in outs),
+            sum(int(o.catalog_only) for o in outs),
+            sum(int(o.both) for o in outs),
+            0,
+        )
+
+    import contextlib
+
+    ctx = (task_context(budget.gov, task_id) if manage_task
+           else contextlib.nullcontext())
+    with ctx:
+        return run_with_split_retry(
+            budget, batch,
+            nbytes_of=lambda b: q97_working_set_bytes(b, dp),
+            run=run,
+            split=split_q97_batch,
+            combine=combine,
+            grow=lambda b: dataclasses.replace(b, capacity=2 * b.capacity),
+            max_split_depth=max_split_depth,
+        )

@@ -173,8 +173,11 @@ class FaultInjector:
 
 
 def install_from_env() -> Optional[FaultInjector]:
-    """Arm from SRT_FAULT_INJECTOR_CONFIG_PATH if set (and not already)."""
-    path = os.environ.get(ENV_CONFIG_PATH)
+    """Arm from the ``fault_injector_config_path`` config flag (env-backed by
+    SRT_FAULT_INJECTOR_CONFIG_PATH) if set and not already armed."""
+    from spark_rapids_jni_tpu import config
+
+    path = config.get("fault_injector_config_path")
     if path and FaultInjector._instance is None:
         return FaultInjector.install(path)
     return None

@@ -87,17 +87,15 @@ def _flush_active_locked():
         _st.buf = bytearray()
 
 
-def _emit(rec: bytes):
-    with _st.lock:
-        if not _st.active:
-            return
-        _st.buf += rec
-        if len(_st.buf) >= _st.buf_limit:
-            _flush_active_locked()
-            # string table resets with each buffer: every block is
-            # self-contained, so a consumer can start mid-stream
-            _st.names = {}
-            _st.next_name_id = 0
+def _append_locked(rec: bytes):
+    """Append one record and flush at the buffer limit (caller holds lock)."""
+    _st.buf += rec
+    if len(_st.buf) >= _st.buf_limit:
+        _flush_active_locked()
+        # string table resets with each buffer: every block is
+        # self-contained, so a consumer can start mid-stream
+        _st.names = {}
+        _st.next_name_id = 0
 
 
 def _writer_loop():
@@ -119,13 +117,9 @@ def _range(category: str, name: str):
         with _st.lock:
             if _st.active:
                 nid = _intern(name)
-                _st.buf += struct.pack(
+                _append_locked(struct.pack(
                     "<BIBQQI", _R_RANGE, nid, _CATEGORIES.get(category, 0),
-                    t0, t1, threading.get_ident() & 0xFFFFFFFF)
-                if len(_st.buf) >= _st.buf_limit:
-                    _flush_active_locked()
-                    _st.names = {}
-                    _st.next_name_id = 0
+                    t0, t1, threading.get_ident() & 0xFFFFFFFF))
 
 
 class Profiler:
@@ -205,14 +199,14 @@ class Profiler:
         with _st.lock:
             if _st.active:
                 nid = _intern(name)
-                _st.buf += struct.pack(
+                _append_locked(struct.pack(
                     "<BIBQI", _R_INSTANT, nid, _CATEGORIES["marker"],
-                    time.monotonic_ns(), threading.get_ident() & 0xFFFFFFFF)
+                    time.monotonic_ns(), threading.get_ident() & 0xFFFFFFFF))
 
     @staticmethod
     def counter(name: str, value: int) -> None:
         with _st.lock:
             if _st.active:
                 nid = _intern(name)
-                _st.buf += struct.pack(
-                    "<BIQq", _R_COUNTER, nid, time.monotonic_ns(), value)
+                _append_locked(struct.pack(
+                    "<BIQq", _R_COUNTER, nid, time.monotonic_ns(), value))

@@ -62,20 +62,35 @@ def all_to_all_shuffle(
     part: jnp.ndarray,
     capacity: int,
     axis: str = DATA_AXIS,
+    row_valid: jnp.ndarray | None = None,
 ) -> ShuffleResult:
     """Exchange rows so each device receives the rows whose ``part`` equals its
     index along ``axis``.  Must be called inside shard_map over ``axis``.
+
+    ``row_valid`` (bool[n], optional) marks padding/invalid local rows: they
+    are never sent, never occupy a capacity slot, and don't count in
+    ``dropped`` — static-shape callers (governed runners padding a batch to a
+    shard multiple) rely on this so pads can't evict real rows or trigger
+    spurious capacity retries.
 
     The seam range covers the dispatch (trace) boundary; on-chip timing comes
     from the profiler's optional XPlane capture.
     """
     ndev = jax.lax.axis_size(axis)
+    if row_valid is not None:
+        # invalid rows ride the out-of-range bucket: excluded from ranking,
+        # capacity, sending, and the dropped count
+        part = jnp.where(row_valid, part, ndev)
     slot, in_cap, _counts = bucket_by_partition(part, ndev, capacity)
-    dropped = jnp.sum(~in_cap).astype(jnp.int32)
+    sendable = in_cap if row_valid is None else in_cap & row_valid
+    if row_valid is None:
+        dropped = jnp.sum(~in_cap).astype(jnp.int32)
+    else:
+        dropped = jnp.sum(row_valid & ~in_cap).astype(jnp.int32)
 
     send_valid = (
         jnp.zeros((ndev * capacity,), jnp.bool_)
-        .at[jnp.where(in_cap, slot, ndev * capacity)]
+        .at[jnp.where(sendable, slot, ndev * capacity)]
         .set(True, mode="drop")
         .reshape(ndev, capacity)
     )
@@ -84,7 +99,7 @@ def all_to_all_shuffle(
     for name, data in columns.items():
         send = (
             jnp.zeros((ndev * capacity,) + data.shape[1:], data.dtype)
-            .at[jnp.where(in_cap, slot, ndev * capacity)]
+            .at[jnp.where(sendable, slot, ndev * capacity)]
             .set(data, mode="drop")
             .reshape((ndev, capacity) + data.shape[1:])
         )

@@ -324,6 +324,7 @@ def _oracle_to_int(s, lo, hi, strip=True, ansi=False):
     return val
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("strip", [True, False])
 def test_fuzz_against_oracle(strip):
     rng = np.random.RandomState(7)

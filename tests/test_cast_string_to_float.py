@@ -112,6 +112,7 @@ def test_nulls_propagate():
     assert run(["1.5", None]) == [1.5, None]
 
 
+@pytest.mark.slow
 def test_fuzz_exact_domain():
     """<=15 sig digits and |total exp| <= 22: digits*10^e is one exact IEEE
     op, so the reference algorithm equals correctly-rounded float()."""

@@ -308,6 +308,7 @@ _FUZZ_PATHS = [
 ]
 
 
+@pytest.mark.slow
 def test_device_eval_backend_corpus():
     """The jitted lax.scan evaluator must match the host machine exactly."""
     from spark_rapids_jni_tpu import config
@@ -328,6 +329,7 @@ def test_device_eval_backend_corpus():
         assert dev == host, f"path={path}"
 
 
+@pytest.mark.slow
 def test_device_eval_backend_fuzz():
     from spark_rapids_jni_tpu import config
 
@@ -340,6 +342,7 @@ def test_device_eval_backend_fuzz():
         assert got == want, f"path={path}"
 
 
+@pytest.mark.slow
 def test_fuzz_against_oracle():
     from spark_rapids_jni_tpu import config
 

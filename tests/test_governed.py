@@ -1,0 +1,275 @@
+"""Governed execution: the split-and-retry driver wired into a real query.
+
+Round-3 closure of VERDICT.md missing #1: the arbiter now *governs* the
+execution path.  These tests drive distributed q97 through the governed
+runner (models/q97.py run_distributed_q97 -> mem/governed.py
+run_with_split_retry) and assert the three retry behaviors the reference
+protocol defines (RmmSpark.java:402-416):
+
+- injected SplitAndRetryOOM actually splits the key space, result stays
+  exact, per-task split metrics record it;
+- a working set larger than the whole budget splits until pieces fit;
+- shuffle-capacity overflow (dropped > 0) grows the exchange and re-runs.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from spark_rapids_jni_tpu.mem import (
+    BudgetedResource,
+    MaxSplitDepthExceeded,
+    MemoryGovernor,
+    run_with_split_retry,
+    task_context,
+)
+from spark_rapids_jni_tpu.mem.governed import ShuffleCapacityExceeded
+from spark_rapids_jni_tpu.models import run_distributed_q97, split_q97_batch
+from spark_rapids_jni_tpu.models.q97 import Q97Batch, q97_working_set_bytes
+from spark_rapids_jni_tpu.parallel import make_mesh
+
+
+@pytest.fixture
+def gov():
+    g = MemoryGovernor(watchdog_period_s=0.02)
+    yield g
+    g._shutdown.set()
+    g._watchdog.join(timeout=2)
+    g.arbiter.close()
+
+
+def _mesh(ndev=8):
+    return make_mesh((ndev, 1), devices=jax.devices()[:ndev])
+
+
+def _tables(rng, n_store, n_catalog, hi=60):
+    return (
+        (rng.randint(1, hi, n_store).astype(np.int32),
+         rng.randint(1, 20, n_store).astype(np.int32)),
+        (rng.randint(1, hi, n_catalog).astype(np.int32),
+         rng.randint(1, 20, n_catalog).astype(np.int32)),
+    )
+
+
+def _oracle(store, catalog):
+    s = set(zip(store[0].tolist(), store[1].tolist()))
+    c = set(zip(catalog[0].tolist(), catalog[1].tolist()))
+    return len(s - c), len(c - s), len(s & c)
+
+
+# ------------------------------------------------------- driver unit tests --
+
+def test_driver_processes_whole_batch(gov):
+    budget = BudgetedResource(gov, 1 << 20)
+    with task_context(gov, 1):
+        out = run_with_split_retry(
+            budget, list(range(10)),
+            nbytes_of=lambda b: 64 * len(b),
+            run=lambda b: sum(b),
+            split=lambda b: [b[: len(b) // 2], b[len(b) // 2:]],
+            combine=sum,
+        )
+    assert out == sum(range(10))
+    assert gov.get_and_reset_num_split_retry(1) == 0
+
+
+def test_driver_injected_split_and_retry(gov):
+    """forceSplitAndRetryOOM -> the batch is actually split (protocol of
+    RmmSparkTest's injection tests, now driving real work)."""
+    budget = BudgetedResource(gov, 1 << 20)
+    seen = []
+    with task_context(gov, 1):
+        gov.force_split_and_retry_oom(num_ooms=1)
+        out = run_with_split_retry(
+            budget, list(range(8)),
+            nbytes_of=lambda b: 64 * len(b),
+            run=lambda b: seen.append(list(b)) or sum(b),
+            split=lambda b: [b[: len(b) // 2], b[len(b) // 2:]],
+            combine=sum,
+        )
+        splits = gov.get_and_reset_num_split_retry(1)
+    assert out == sum(range(8))
+    assert len(seen) == 2, seen  # two halves, each ran once
+    assert splits == 1
+
+
+def test_driver_oversized_batch_splits_until_fit(gov):
+    """A reservation larger than the whole budget escalates through the
+    arbiter (BLOCKED -> BUFN -> SPLIT_THROW via the watchdog) and splits."""
+    budget = BudgetedResource(gov, 1000)
+    ran = []
+    with task_context(gov, 3):
+        out = run_with_split_retry(
+            budget, list(range(16)),
+            nbytes_of=lambda b: 200 * len(b),  # 3200 > 1000 whole
+            run=lambda b: ran.append(len(b)) or sum(b),
+            split=lambda b: [b[: len(b) // 2], b[len(b) // 2:]],
+            combine=sum,
+        )
+        splits = gov.get_and_reset_num_split_retry(3)
+    assert out == sum(range(16))
+    assert all(n * 200 <= 1000 for n in ran), ran
+    assert splits >= 1
+
+
+def test_driver_unsplittable_raises(gov):
+    budget = BudgetedResource(gov, 100)
+    with task_context(gov, 1):
+        with pytest.raises(MaxSplitDepthExceeded):
+            run_with_split_retry(
+                budget, [1],
+                nbytes_of=lambda b: 1000,
+                run=lambda b: 0,
+                split=lambda b: [b],  # cannot split further
+                combine=sum,
+            )
+
+
+def test_driver_injected_retry_oom_retries_same_piece(gov):
+    budget = BudgetedResource(gov, 1 << 20)
+    attempts = []
+    with task_context(gov, 1):
+        gov.force_retry_oom(num_ooms=1)
+        out = run_with_split_retry(
+            budget, [5],
+            nbytes_of=lambda b: 64,
+            run=lambda b: attempts.append(1) or b[0],
+            split=lambda b: [],
+            combine=sum,
+        )
+        retries = gov.get_and_reset_num_retry(1)
+    assert out == 5
+    assert len(attempts) == 1  # RetryOOM fired in acquire, before run
+    assert retries == 1
+
+
+def test_driver_grow_on_capacity_exceeded(gov):
+    budget = BudgetedResource(gov, 1 << 20)
+    caps = []
+
+    def run(piece):
+        caps.append(piece)
+        if piece < 4:
+            raise ShuffleCapacityExceeded(f"cap {piece}")
+        return piece
+
+    out = run_with_split_retry(
+        budget, 1,
+        nbytes_of=lambda c: 64 * c,
+        run=run,
+        split=lambda c: [],
+        combine=lambda r: r[0],
+        grow=lambda c: c * 2,
+    )
+    assert out == 4
+    assert caps == [1, 2, 4]
+
+
+# --------------------------------------------------- governed q97 pipeline --
+
+def test_q97_governed_exact_no_pressure(gov):
+    rng = np.random.RandomState(7)
+    store, catalog = _tables(rng, 300, 200)
+    budget = BudgetedResource(gov, 1 << 30)
+    out = run_distributed_q97(_mesh(), store, catalog, budget=budget, task_id=1)
+    assert (out.store_only, out.catalog_only, out.both) == _oracle(store, catalog)
+
+
+def test_q97_governed_injected_split_exact(gov):
+    """SplitAndRetryOOM mid-query: key-space split keeps the result exact and
+    the per-task metrics show the split retry.  The test owns the task
+    context (the Spark shape — one registered thread runs many ops), arms
+    the injection, and joins the runner with manage_task=False."""
+    rng = np.random.RandomState(8)
+    store, catalog = _tables(rng, 400, 300, hi=200)
+    budget = BudgetedResource(gov, 1 << 30)
+    with task_context(gov, 6):
+        gov.force_split_and_retry_oom(num_ooms=1)
+        out = run_distributed_q97(
+            _mesh(), store, catalog, budget=budget, task_id=6,
+            manage_task=False)
+        splits = gov.get_and_reset_num_split_retry(6)
+    assert (out.store_only, out.catalog_only, out.both) == _oracle(store, catalog)
+    assert splits == 1
+
+
+def test_q97_governed_tight_budget_splits_exact(gov):
+    """Working set bigger than the whole budget: the arbiter escalates to
+    SPLIT_THROW and the runner splits the key space until pieces fit."""
+    rng = np.random.RandomState(9)
+    store, catalog = _tables(rng, 1500, 1200, hi=500)
+    mesh = _mesh()
+    dp = 8
+    full = q97_working_set_bytes(
+        Q97Batch(store[0], store[1], catalog[0], catalog[1],
+                 capacity=100), dp)
+    budget = BudgetedResource(gov, int(full * 0.55))
+    with task_context(gov, 2):
+        out = run_distributed_q97(
+            mesh, store, catalog, budget=budget, task_id=2, capacity=100,
+            manage_task=False)
+        splits = gov.get_and_reset_num_split_retry(2)
+    assert (out.store_only, out.catalog_only, out.both) == _oracle(store, catalog)
+    assert splits >= 1
+    assert budget.used == 0  # everything released
+
+
+def test_q97_governed_skew_grows_capacity_exact(gov):
+    """Skewed keys overflow a tiny shuffle capacity; the grow retry doubles
+    it until the exchange fits, result exact."""
+    rng = np.random.RandomState(10)
+    # heavy skew: 80% of rows share 3 customers
+    n = 600
+    hot = rng.randint(1, 4, int(n * 0.8)).astype(np.int32)
+    cold = rng.randint(4, 300, n - len(hot)).astype(np.int32)
+    s_cust = np.concatenate([hot, cold])
+    s_item = rng.randint(1, 10, n).astype(np.int32)
+    c_cust = rng.permutation(s_cust).astype(np.int32)
+    c_item = rng.randint(1, 10, n).astype(np.int32)
+    store, catalog = (s_cust, s_item), (c_cust, c_item)
+    budget = BudgetedResource(gov, 1 << 30)
+    out = run_distributed_q97(
+        _mesh(), store, catalog, budget=budget, task_id=4, capacity=4)
+    assert (out.store_only, out.catalog_only, out.both) == _oracle(store, catalog)
+
+
+def test_default_budget_rebuilt_after_governor_shutdown():
+    """A cached default budget bound to a shut-down governor must be rebuilt,
+    not drive a closed native arbiter (review r3 finding: NULL-handle
+    segfault)."""
+    from spark_rapids_jni_tpu.mem.governed import (
+        _reset_default_budget_for_tests,
+        default_device_budget,
+    )
+
+    _reset_default_budget_for_tests()
+    try:
+        MemoryGovernor.initialize()
+        b1 = default_device_budget()
+        MemoryGovernor.shutdown()
+        MemoryGovernor.initialize()
+        b2 = default_device_budget()
+        assert b2 is not b1
+        b2.acquire(10)
+        b2.release(10)
+        with pytest.raises(RuntimeError, match="arbiter is closed"):
+            b1.gov.arbiter.state_of(0)
+    finally:
+        MemoryGovernor.shutdown()
+        _reset_default_budget_for_tests()
+
+
+def test_q97_split_batch_is_exact_partition():
+    rng = np.random.RandomState(11)
+    store, catalog = _tables(rng, 100, 80)
+    b = Q97Batch(store[0], store[1], catalog[0], catalog[1], capacity=8)
+    p0, p1 = split_q97_batch(b)
+    assert p0.rows + p1.rows == b.rows
+    # same key -> same side, across tables
+    side = {}
+    for piece, s in ((p0, 0), (p1, 1)):
+        for c, i in zip(piece.s_cust, piece.s_item):
+            assert side.setdefault((int(c), int(i)), s) == s
+        for c, i in zip(piece.c_cust, piece.c_item):
+            assert side.setdefault((int(c), int(i)), s) == s

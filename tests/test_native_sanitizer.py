@@ -18,6 +18,7 @@ _NATIVE = os.path.join(
 
 
 @pytest.mark.skipif(shutil.which("g++") is None, reason="no g++ toolchain")
+@pytest.mark.slow
 def test_arbiter_under_tsan(tmp_path):
     exe = tmp_path / "arbiter_tsan_stress"
     build = subprocess.run(

@@ -3,6 +3,7 @@ JUnit corpus (ParseURITest.java:183-374) plus pinned java.net.URI-derived
 expectations and seeded fuzz inputs."""
 
 import random
+import pytest
 
 
 from spark_rapids_jni_tpu.columnar.column import strings_column
@@ -282,6 +283,7 @@ def test_query_param_extraction():
     ).to_list() == ["3", "50", "12", "2", None]
 
 
+@pytest.mark.slow
 def test_fuzz_vs_oracle():
     rng = random.Random(42)
     schemes = ["http", "https", "ftp", "s3a", "9bad", "ht~tp", ""]
