@@ -5,20 +5,35 @@ from spark_rapids_jni_tpu.models.nds import (
     make_distributed_query_step,
     make_example_batch,
 )
+from spark_rapids_jni_tpu.models.q5 import (
+    Q5Row,
+    make_distributed_q5,
+    q5_local,
+    run_distributed_q5,
+)
 from spark_rapids_jni_tpu.models.q97 import (
     Q97Batch,
     Q97Out,
     make_distributed_q97,
+    make_distributed_q97_columns,
     q97_local,
     run_distributed_q97,
     split_q97_batch,
 )
+from spark_rapids_jni_tpu.models.tpcds import Q5Data, generate_q5_data
 
 __all__ = [
     "QueryStepConfig",
     "QueryStepOut",
+    "Q5Data",
+    "Q5Row",
     "Q97Batch",
     "Q97Out",
+    "generate_q5_data",
+    "make_distributed_q5",
+    "make_distributed_q97_columns",
+    "q5_local",
+    "run_distributed_q5",
     "local_query_step",
     "make_distributed_query_step",
     "make_distributed_q97",

@@ -1,0 +1,308 @@
+"""NDS q5: the three-channel sales/returns rollup (BASELINE config 5).
+
+TPC-DS q5 unions store, catalog and web channel activity over a 14-day
+window, computing per-business-id sales, returns and profit, grouped by
+ROLLUP(channel, id).  The TPU-native plan per channel:
+
+1. **date dim join** (device): membership of each fact row's date_sk in the
+   filtered date_dim window via searchsorted over the (tiny, replicated)
+   dim — the broadcast-join analog of the Spark plan.
+2. **null-key semantics**: fact rows with null dim/date foreign keys drop
+   out of the inner joins, exactly as in SQL.
+3. **partial aggregation** (device): masked ``segment_sum`` into dense
+   per-dim-sk buckets — sales cents, return cents, profit cents, and a
+   contributing-row count.  Money is decimal(7,2) as unscaled int64 cents;
+   sums widen to decimal(17,2) which stays int64-exact (Spark's own sum
+   widening keeps precision+10).
+4. **exchange**: ``psum`` of the partial vectors over the data axis (the
+   aggregation all-reduce — rows never need a shuffle because the dim
+   space is dense and small, the degenerate broadcast-join case).
+5. **rollup** (host, tiny): (channel, id) rows -> channel totals -> grand
+   total, with the string business ids attached from the dim table.
+
+The governed runner admits every launch through the memory arbiter and
+splits fact rows on SplitAndRetryOOM — row splits are exact here because
+every aggregate is additive.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from spark_rapids_jni_tpu.models.tpcds import CHANNELS, Q5Data
+from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS
+
+__all__ = [
+    "Q5Row",
+    "q5_local",
+    "make_distributed_q5",
+    "run_distributed_q5",
+    "q5_rollup",
+]
+
+
+class Q5Row(NamedTuple):
+    """One result row: ROLLUP levels use None for grouped-out columns."""
+
+    channel: object  # str | None
+    id: object  # str | None
+    sales: int  # cents
+    returns_: int
+    profit: int
+
+
+class _ChannelPartials(NamedTuple):
+    sales: jnp.ndarray  # int64[n_dim]
+    returns_: jnp.ndarray
+    profit: jnp.ndarray
+    count: jnp.ndarray  # int32[n_dim] contributing rows (sales+returns)
+
+
+def _window_member(date, date_valid, dim_sk, dim_days, lo, hi):
+    """Inner-join membership of fact date_sk in the filtered date dim."""
+    idx = jnp.clip(jnp.searchsorted(dim_sk, date), 0, dim_sk.shape[0] - 1)
+    hit = dim_sk[idx] == date
+    in_win = (dim_days[idx] >= lo) & (dim_days[idx] < hi)
+    return date_valid & hit & in_win
+
+
+def _masked_segment(values, sk, ok, n_dim, dtype=jnp.int64):
+    """segment_sum of values into 1-based sk buckets, masked rows dropped."""
+    bucket = jnp.where(ok, sk.astype(jnp.int32) - 1, n_dim)
+    return jax.ops.segment_sum(
+        jnp.where(ok, values, 0).astype(dtype), bucket, num_segments=n_dim + 1
+    )[:-1]
+
+
+def _channel_partials(ch, n_dim, dim_sk, dim_days, lo, hi) -> _ChannelPartials:
+    """One shard's partial aggregates for one channel.
+
+    ``ch`` is a dict of this channel's fact arrays (see models/tpcds.py
+    ChannelTables field names).
+    """
+    s_ok = ch["sales_sk_valid"] & (ch["sales_sk"] >= 1) & (
+        ch["sales_sk"] <= n_dim
+    ) & _window_member(ch["sales_date"], ch["sales_date_valid"],
+                       dim_sk, dim_days, lo, hi)
+    r_ok = ch["ret_sk_valid"] & (ch["ret_sk"] >= 1) & (
+        ch["ret_sk"] <= n_dim
+    ) & _window_member(ch["ret_date"], ch["ret_date_valid"],
+                       dim_sk, dim_days, lo, hi)
+
+    sales = _masked_segment(ch["sales_price"], ch["sales_sk"], s_ok, n_dim)
+    profit_s = _masked_segment(ch["sales_profit"], ch["sales_sk"], s_ok, n_dim)
+    returns_ = _masked_segment(ch["ret_amt"], ch["ret_sk"], r_ok, n_dim)
+    loss = _masked_segment(ch["ret_loss"], ch["ret_sk"], r_ok, n_dim)
+    count = (
+        _masked_segment(jnp.ones_like(ch["sales_sk"]), ch["sales_sk"],
+                        s_ok, n_dim, jnp.int32)
+        + _masked_segment(jnp.ones_like(ch["ret_sk"]), ch["ret_sk"],
+                          r_ok, n_dim, jnp.int32)
+    )
+    return _ChannelPartials(sales, returns_, profit_s - loss, count)
+
+
+def _facts_of(ch_tables) -> Dict[str, np.ndarray]:
+    return {
+        "sales_sk": ch_tables.sales_sk,
+        "sales_sk_valid": ch_tables.sales_sk_valid,
+        "sales_date": ch_tables.sales_date,
+        "sales_date_valid": ch_tables.sales_date_valid,
+        "sales_price": ch_tables.sales_price,
+        "sales_profit": ch_tables.sales_profit,
+        "ret_sk": ch_tables.ret_sk,
+        "ret_sk_valid": ch_tables.ret_sk_valid,
+        "ret_date": ch_tables.ret_date,
+        "ret_date_valid": ch_tables.ret_date_valid,
+        "ret_amt": ch_tables.ret_amt,
+        "ret_loss": ch_tables.ret_loss,
+    }
+
+
+def q5_local(data: Q5Data) -> List[Q5Row]:
+    """Single-chip q5: per-channel partials + host rollup."""
+    dim_sk = jnp.asarray(data.date_sk)
+    dim_days = jnp.asarray(data.date_days)
+    per_channel = {}
+    for name in CHANNELS:
+        ch = data.channels[name]
+        parts = _channel_partials(
+            {k: jnp.asarray(v) for k, v in _facts_of(ch).items()},
+            len(ch.dim_sk), dim_sk, dim_days,
+            data.sales_date_lo, data.sales_date_hi,
+        )
+        per_channel[name] = jax.tree.map(np.asarray, parts)
+    return q5_rollup(per_channel, data)
+
+
+def q5_rollup(per_channel: Dict[str, _ChannelPartials],
+              data: Q5Data) -> List[Q5Row]:
+    """ROLLUP(channel, id) formatting: leaf rows, channel totals, grand
+    total — ordered like the SQL output (channel, id, nulls last)."""
+    rows: List[Q5Row] = []
+    g_sales = g_ret = g_prof = 0
+    for name in CHANNELS:
+        p = per_channel[name]
+        ids = data.channels[name].dim_id
+        c_sales = c_ret = c_prof = 0
+        leaf: List[Q5Row] = []
+        for i in range(len(ids)):
+            if int(p.count[i]) == 0:
+                continue  # group absent from the filtered join
+            s, r, pr = int(p.sales[i]), int(p.returns_[i]), int(p.profit[i])
+            leaf.append(Q5Row(name, ids[i], s, r, pr))
+            c_sales += s
+            c_ret += r
+            c_prof += pr
+        rows.extend(sorted(leaf, key=lambda q: q.id))
+        rows.append(Q5Row(name, None, c_sales, c_ret, c_prof))
+        g_sales += c_sales
+        g_ret += c_ret
+        g_prof += c_prof
+    rows.append(Q5Row(None, None, g_sales, g_ret, g_prof))
+    return rows
+
+
+# ------------------------------------------------------------- distributed --
+
+
+def _sharded_q5(channel_facts, dim_sk, dim_days, n_dims: Tuple[int, ...],
+                lo: int, hi: int):
+    """Per-device body: partials for all three channels, psum'd."""
+    out = []
+    for name, n_dim in zip(CHANNELS, n_dims):
+        p = _channel_partials(channel_facts[name], n_dim, dim_sk, dim_days,
+                              lo, hi)
+        out.append(_ChannelPartials(*(
+            jax.lax.psum(x, (DATA_AXIS,)) for x in p
+        )))
+    return tuple(out)
+
+
+def make_distributed_q5(mesh, data: Q5Data):
+    """jit-compiled distributed q5 partials over ``mesh``'s data axis.
+
+    Facts are sharded over DATA_AXIS; the date dim is replicated.  Returns
+    a function of the sharded channel-fact pytree producing replicated
+    per-channel partial vectors (feed to :func:`q5_rollup`).
+    """
+    n_dims = tuple(len(data.channels[n].dim_sk) for n in CHANNELS)
+    body = functools.partial(
+        _sharded_q5,
+        n_dims=n_dims, lo=data.sales_date_lo, hi=data.sales_date_hi,
+    )
+    step = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(), P()),
+        out_specs=tuple(_ChannelPartials(P(), P(), P(), P())
+                        for _ in CHANNELS),
+        check_vma=False,
+    )
+    return jax.jit(step)
+
+
+def _pad_channel(facts: Dict[str, np.ndarray], dp: int) -> Dict[str, np.ndarray]:
+    """Pad fact arrays to a dp multiple; pad rows get invalid keys, so they
+    drop out of the joins like any null-keyed row."""
+    out = {}
+    n_s = len(facts["sales_sk"])
+    n_r = len(facts["ret_sk"])
+    pad_s = (-n_s) % dp
+    pad_r = (-n_r) % dp
+    for k, v in facts.items():
+        pad = pad_s if k.startswith("sales") else pad_r
+        if pad == 0:
+            out[k] = v
+            continue
+        fill = np.zeros(pad, dtype=v.dtype)
+        out[k] = np.concatenate([v, fill])
+    if pad_s:
+        out["sales_sk_valid"][-pad_s:] = False
+    if pad_r:
+        out["ret_sk_valid"][-pad_r:] = False
+    return out
+
+
+def _split_channel(facts: Dict[str, np.ndarray]):
+    """Halve fact rows (exact: all q5 aggregates are additive over rows)."""
+    halves = []
+    n_s = len(facts["sales_sk"])
+    n_r = len(facts["ret_sk"])
+    for side in (0, 1):
+        sel = {}
+        s_sl = slice(0, n_s // 2) if side == 0 else slice(n_s // 2, n_s)
+        r_sl = slice(0, n_r // 2) if side == 0 else slice(n_r // 2, n_r)
+        for k, v in facts.items():
+            sel[k] = v[s_sl] if k.startswith("sales") else v[r_sl]
+        halves.append(sel)
+    return halves
+
+
+def run_distributed_q5(mesh, data: Q5Data, *, budget=None, task_id: int = 0,
+                       manage_task: bool = True) -> List[Q5Row]:
+    """Governed distributed q5 over host data: every launch admitted through
+    the memory arbiter; SplitAndRetryOOM halves fact rows (exact — all
+    aggregates are additive) and partials combine by addition.
+    """
+    import contextlib
+
+    from spark_rapids_jni_tpu.mem.governed import (
+        default_device_budget,
+        run_with_split_retry,
+        task_context,
+    )
+
+    if budget is None:
+        budget = default_device_budget()
+    dp = int(np.prod([mesh.shape[a] for a in (DATA_AXIS,)]))
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    rep = NamedSharding(mesh, P())
+    step = make_distributed_q5(mesh, data)
+    dim_sk = jax.device_put(data.date_sk, rep)
+    dim_days = jax.device_put(data.date_days, rep)
+
+    batch = {n: _facts_of(data.channels[n]) for n in CHANNELS}
+
+    def nbytes_of(b):
+        total = sum(v.nbytes for ch in b.values() for v in ch.values())
+        return total * 3  # inputs + masks/buckets + partials
+
+    def run(b):
+        dev = {
+            n: {k: jax.device_put(np.ascontiguousarray(v), sharding)
+                for k, v in _pad_channel(ch, dp).items()}
+            for n, ch in b.items()
+        }
+        out = step(dev, dim_sk, dim_days)
+        jax.block_until_ready(out)
+        return {n: jax.tree.map(np.asarray, p)
+                for n, p in zip(CHANNELS, out)}
+
+    def split(b):
+        parts = {n: _split_channel(ch) for n, ch in b.items()}
+        return [{n: parts[n][0] for n in b}, {n: parts[n][1] for n in b}]
+
+    def combine(results):
+        acc = results[0]
+        for r in results[1:]:
+            acc = {
+                n: _ChannelPartials(*(a + x for a, x in zip(acc[n], r[n])))
+                for n in acc
+            }
+        return acc
+
+    ctx = (task_context(budget.gov, task_id) if manage_task
+           else contextlib.nullcontext())
+    with ctx:
+        per_channel = run_with_split_retry(
+            budget, batch,
+            nbytes_of=nbytes_of, run=run, split=split, combine=combine,
+        )
+    return q5_rollup(per_channel, data)

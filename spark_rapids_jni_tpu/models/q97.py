@@ -174,6 +174,132 @@ def make_distributed_q97(mesh, capacity: int, with_validity: bool = False):
     return jax.jit(step)
 
 
+# ------------------------------------------------------- nullable columns --
+# q97 over real Column inputs with per-column null validity.  SQL semantics:
+# NULL keys group *within* a side (DISTINCT treats NULLs as one group) but
+# never join *across* sides (NULL = NULL is unknown), so a side's null-key
+# groups count as that side's "only" rows.
+
+_PAIR_SENTINEL = jnp.int64(0x7FFFFFFFFFFFFFFF)
+
+
+def _pair_key(cust, cust_valid, item, item_valid, side: int):
+    """(k_hi, k_lo) 2-limb group key over nullable (cust, item) int32 pairs.
+
+    Each component widens to 33 bits (value | null flag); rows with any
+    null key additionally carry a null marker + the side bit in k_lo so
+    null groups stay side-local (never equal across tables).
+    """
+    # null slots must not leak their underlying data bits into the group key
+    # (invalid data is garbage by contract): normalize them to 0|nullflag
+    c_ext = jnp.where(cust_valid, cust.astype(jnp.int64) & 0xFFFFFFFF,
+                      jnp.int64(1) << 32)
+    i_ext = jnp.where(item_valid, item.astype(jnp.int64) & 0xFFFFFFFF,
+                      jnp.int64(1) << 32)
+    null_any = (~cust_valid) | (~item_valid)
+    marker = jnp.int64((2 | (side & 1)) << 33)
+    k_lo = i_ext | jnp.where(null_any, marker, jnp.int64(0))
+    return c_ext, k_lo
+
+
+def _count_runs_pair(k_hi, k_lo, is_store, valid):
+    """_count_runs generalized to a 2-limb key (lexsorted)."""
+    kh = jnp.where(valid, k_hi, _PAIR_SENTINEL)
+    kl = jnp.where(valid, k_lo, _PAIR_SENTINEL)
+    order = jnp.lexsort((kl, kh))
+    khs = kh[order]
+    kls = kl[order]
+    store_s = jnp.where(valid, is_store, False)[order]
+    cat_s = jnp.where(valid, ~is_store, False)[order]
+
+    n = khs.shape[0]
+    prev_hi = jnp.concatenate([khs[:1] - 1, khs[:-1]])
+    prev_lo = jnp.concatenate([kls[:1] - 1, kls[:-1]])
+    run_start = (khs != prev_hi) | (kls != prev_lo)
+    run_id = jnp.cumsum(run_start.astype(jnp.int32)) - 1
+
+    has_store = jax.ops.segment_max(store_s.astype(jnp.int32), run_id, num_segments=n)
+    has_cat = jax.ops.segment_max(cat_s.astype(jnp.int32), run_id, num_segments=n)
+    run_valid = jax.ops.segment_max(
+        (khs != _PAIR_SENTINEL).astype(jnp.int32), run_id, num_segments=n
+    )
+    has_store = has_store * run_valid
+    has_cat = has_cat * run_valid
+    both = jnp.sum((has_store & has_cat).astype(jnp.int32))
+    store_only = jnp.sum((has_store & (1 - has_cat)).astype(jnp.int32))
+    cat_only = jnp.sum((has_cat & (1 - has_store)).astype(jnp.int32))
+    return store_only, cat_only, both
+
+
+def _sharded_q97_columns(s_cust, s_item, c_cust, c_item, s_rv, c_rv,
+                         capacity: int):
+    """Per-device body over Column pytrees with nullable keys.
+
+    ``s_rv``/``c_rv`` mark padding rows (row does not exist); a null *key*
+    in an existing row is data, handled by the pair-key null semantics.
+    The whole table rides one tagged exchange through the columnar
+    shuffle (parallel/table_shuffle.py).
+    """
+    from spark_rapids_jni_tpu.columnar.column import Column
+    from spark_rapids_jni_tpu.columnar.dtypes import INT64 as _I64
+    from spark_rapids_jni_tpu.parallel.table_shuffle import shuffle_table
+
+    dp = jax.lax.axis_size(DATA_AXIS)
+    skh, skl = _pair_key(s_cust.data, s_cust.is_valid(),
+                         s_item.data, s_item.is_valid(), side=1)
+    ckh, ckl = _pair_key(c_cust.data, c_cust.is_valid(),
+                         c_item.data, c_item.is_valid(), side=0)
+    k_hi = jnp.concatenate([skh, ckh])
+    k_lo = jnp.concatenate([skl, ckl])
+    tag = jnp.concatenate(
+        [jnp.ones(skh.shape, jnp.int8), jnp.zeros(ckh.shape, jnp.int8)]
+    )
+    row_valid = jnp.concatenate([s_rv, c_rv])
+
+    mixed = k_hi ^ (k_lo * jnp.int64(-7046029254386353131))  # golden-ratio mix
+    part = (murmur3_raw_int64(mixed, 42) % jnp.uint32(dp)).astype(jnp.int32)
+    ex = shuffle_table(
+        {
+            "kh": Column(k_hi, None, _I64),
+            "kl": Column(k_lo, None, _I64),
+            "tag": Column(tag, None, _I64),
+        },
+        part, capacity, axis=DATA_AXIS, row_valid=row_valid,
+    )
+    so, co, b = _count_runs_pair(
+        ex.columns["kh"].data, ex.columns["kl"].data,
+        ex.columns["tag"].data == 1, ex.valid,
+    )
+    axes = (DATA_AXIS,)
+    return Q97Out(
+        jax.lax.psum(so, axes),
+        jax.lax.psum(co, axes),
+        jax.lax.psum(b, axes),
+        jax.lax.psum(ex.dropped, axes),
+    )
+
+
+def make_distributed_q97_columns(mesh, capacity: int):
+    """jit-compiled distributed q97 over nullable Column keys.
+
+    Inputs: four int32 Columns (store customer/item, catalog customer/item,
+    each optionally with a validity mask) plus two bool row-valid arrays for
+    padding, all sharded over DATA_AXIS.
+    """
+    def body(s_cust, s_item, c_cust, c_item, s_rv, c_rv):
+        return _sharded_q97_columns(s_cust, s_item, c_cust, c_item,
+                                    s_rv, c_rv, capacity)
+
+    step = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=tuple(P(DATA_AXIS) for _ in range(6)),
+        out_specs=Q97Out(P(), P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(step)
+
+
 # ---------------------------------------------------------------- governed --
 # The host-driven control loop around the jitted step: batch admission through
 # the memory arbiter, key-space split-and-retry, shuffle-capacity-grow retry.
@@ -306,6 +432,8 @@ def run_distributed_q97(
     sharding = NamedSharding(mesh, P(DATA_AXIS))
 
     def run(piece: Q97Batch) -> Q97Out:
+        from spark_rapids_jni_tpu.obs.seam import TRANSFER, seam
+
         sc, sv = _pad_to_multiple(piece.s_cust, dp)
         si, _ = _pad_to_multiple(piece.s_item, dp)
         cc, cv = _pad_to_multiple(piece.c_cust, dp)
@@ -317,8 +445,9 @@ def run_distributed_q97(
             cc, cv = np.zeros(dp, np.int32), np.zeros(dp, bool)
             ci = np.zeros(dp, np.int32)
         step = _q97_step_cached(mesh, piece.capacity)
-        args = [jax.device_put(a, sharding)
-                for a in (sc, si, cc, ci, sv, cv)]
+        with seam(TRANSFER, "q97_batch_upload"):
+            args = [jax.device_put(a, sharding)
+                    for a in (sc, si, cc, ci, sv, cv)]
         out = step(*args)
         jax.block_until_ready(out)
         if int(out.dropped) > 0:

@@ -1,0 +1,129 @@
+"""TPC-DS-shaped synthetic data for the NDS model pipelines (q5, q97).
+
+A small, seeded generator producing the tables q5 touches, with the shapes
+that make TPC-DS data hard: nullable foreign keys, string dimension ids,
+and decimal(7,2) money columns (stored as unscaled int64 cents, the Arrow/
+Spark DECIMAL representation).  Scale factor ``sf`` linearly sizes the fact
+tables; sf=0.01 ~ 1.4k fact rows total, sf=1 ~ 140k.
+
+This stands in for the reference benchmarks' generate_input.cu data layer
+(/root/reference/src/main/cpp/benchmarks/common/generate_input.cu) on the
+NDS side: not a full dsdgen port, but faithful to the column shapes the
+query plans exercise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import numpy as np
+
+__all__ = ["Q5Data", "generate_q5_data", "CHANNELS"]
+
+# (channel label, fact prefix, dim id prefix) for q5's three channel unions
+CHANNELS = ("store", "catalog", "web")
+
+_D0 = 2450815  # d_date_sk epoch base the generator uses (arbitrary julian-ish)
+
+
+@dataclasses.dataclass
+class ChannelTables:
+    """One channel's fact pair + dimension, column-oriented numpy arrays.
+
+    Sales fact: (sk -> dim key, date_sk, ext_sales_price, net_profit);
+    returns fact: (sk, date_sk, return_amt, net_loss).  Money columns are
+    unscaled cents (decimal scale 2).  Nullable columns carry a mask
+    (True == valid), mirroring Column validity.
+    """
+
+    sales_sk: np.ndarray
+    sales_sk_valid: np.ndarray
+    sales_date: np.ndarray
+    sales_date_valid: np.ndarray
+    sales_price: np.ndarray  # int64 cents
+    sales_profit: np.ndarray  # int64 cents
+
+    ret_sk: np.ndarray
+    ret_sk_valid: np.ndarray
+    ret_date: np.ndarray
+    ret_date_valid: np.ndarray
+    ret_amt: np.ndarray
+    ret_loss: np.ndarray
+
+    dim_sk: np.ndarray  # [n_dim] surrogate keys (dense, 1..n)
+    dim_id: list  # [n_dim] business id strings (e.g. AAAAAAAAAABAAAAA-ish)
+
+
+@dataclasses.dataclass
+class Q5Data:
+    channels: Dict[str, ChannelTables]
+    date_sk: np.ndarray  # date_dim surrogate keys
+    date_days: np.ndarray  # d_date as days-since-epoch ints
+    sales_date_lo: int  # the q5 14-day window, as day numbers
+    sales_date_hi: int
+
+
+def _dim_ids(prefix: str, n: int, rng) -> list:
+    # TPC-DS business ids are fixed-width uppercase strings
+    out = []
+    for i in range(n):
+        digits = []
+        v = i
+        for _ in range(8):
+            digits.append(chr(ord("A") + v % 26))
+            v //= 26
+        out.append(prefix + "".join(reversed(digits)))
+    return out
+
+
+def _money(rng, n: int, lo=0, hi=500_00) -> np.ndarray:
+    return rng.randint(lo, hi, n).astype(np.int64)
+
+
+def _nullable(rng, vals: np.ndarray, null_pct: float):
+    valid = rng.rand(len(vals)) >= null_pct
+    return np.where(valid, vals, 0).astype(vals.dtype), valid
+
+
+def generate_q5_data(sf: float = 0.01, seed: int = 0,
+                     null_pct: float = 0.04) -> Q5Data:
+    """Generate the q5 table set at scale factor ``sf``."""
+    rng = np.random.RandomState(seed)
+    n_dates = 120
+    date_sk = np.arange(_D0, _D0 + n_dates, dtype=np.int32)
+    date_days = np.arange(n_dates, dtype=np.int32)
+    lo = 30
+    hi = lo + 14  # q5's 14-day window
+
+    channels: Dict[str, ChannelTables] = {}
+    for ci, name in enumerate(CHANNELS):
+        n_dim = max(3, int(6 * (ci + 1)))
+        n_sales = max(8, int(40_000 * sf) // (ci + 1))
+        n_ret = max(4, n_sales // 8)
+        dim_sk = np.arange(1, n_dim + 1, dtype=np.int32)
+
+        s_sk, s_skv = _nullable(
+            rng, rng.randint(1, n_dim + 1, n_sales).astype(np.int32), null_pct)
+        s_dt, s_dtv = _nullable(
+            rng, rng.randint(_D0, _D0 + n_dates, n_sales).astype(np.int32),
+            null_pct)
+        r_sk, r_skv = _nullable(
+            rng, rng.randint(1, n_dim + 1, n_ret).astype(np.int32), null_pct)
+        r_dt, r_dtv = _nullable(
+            rng, rng.randint(_D0, _D0 + n_dates, n_ret).astype(np.int32),
+            null_pct)
+
+        channels[name] = ChannelTables(
+            sales_sk=s_sk, sales_sk_valid=s_skv,
+            sales_date=s_dt, sales_date_valid=s_dtv,
+            sales_price=_money(rng, n_sales),
+            sales_profit=_money(rng, n_sales, -100_00, 200_00),
+            ret_sk=r_sk, ret_sk_valid=r_skv,
+            ret_date=r_dt, ret_date_valid=r_dtv,
+            ret_amt=_money(rng, n_ret),
+            ret_loss=_money(rng, n_ret, 0, 80_00),
+            dim_sk=dim_sk,
+            dim_id=_dim_ids(name[0].upper(), n_dim, rng),
+        )
+    return Q5Data(channels, date_sk, date_days, lo, hi)

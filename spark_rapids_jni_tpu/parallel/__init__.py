@@ -11,6 +11,13 @@ from spark_rapids_jni_tpu.parallel.shuffle import (
     all_to_all_shuffle,
     bucket_by_partition,
 )
+from spark_rapids_jni_tpu.parallel.table_shuffle import (
+    PaddedStrings,
+    ShuffledTable,
+    materialize_strings,
+    pad_strings,
+    shuffle_table,
+)
 
 __all__ = [
     "DATA_AXIS",
@@ -19,7 +26,12 @@ __all__ = [
     "data_sharding",
     "model_sharding",
     "replicated",
+    "PaddedStrings",
     "ShuffleResult",
+    "ShuffledTable",
     "all_to_all_shuffle",
     "bucket_by_partition",
+    "materialize_strings",
+    "pad_strings",
+    "shuffle_table",
 ]

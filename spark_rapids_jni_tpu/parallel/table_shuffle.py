@@ -1,0 +1,131 @@
+"""Columnar table shuffle: all_to_all of real batches over the device mesh.
+
+The reference built its JCUDF row serialization precisely so rows could be
+exchanged between executors (row_conversion.cu:574 + SURVEY.md §7.8); the
+repo's base shuffle (parallel/shuffle.py) moves only bare fixed-width
+arrays.  This module exchanges *tables*: fixed-width columns with validity,
+DECIMAL128 limb pairs, and string columns.
+
+TPU-idiomatic exchange form: each column rides the all_to_all as one dense
+rectangle — strings as a padded ``bytes[n, width]`` view plus lengths, not
+byte-packed variable-size rows.  XLA needs static shapes either way; the
+padded form keeps every buffer a single contiguous collective payload and
+lands on the receiver already in the framework's device string form (the
+same padded view every string kernel consumes, columnar/buckets.py).  The
+Arrow chars+offsets materialization (dynamic total length) happens at the
+host boundary after the jitted step via ``strings_from_padded``.
+
+Usage: inside ``shard_map`` over the data axis, like ``all_to_all_shuffle``;
+string columns must be pre-converted to :class:`PaddedStrings` with a static
+width (data-dependent ``max_len`` cannot be computed under jit — compute the
+width on host or use a bucket bound).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional
+
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu.columnar.column import (
+    Column,
+    Decimal128Column,
+    StringColumn,
+    strings_from_padded,
+)
+from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS
+from spark_rapids_jni_tpu.parallel.shuffle import all_to_all_shuffle
+
+__all__ = [
+    "PaddedStrings",
+    "ShuffledTable",
+    "pad_strings",
+    "shuffle_table",
+    "materialize_strings",
+]
+
+
+class PaddedStrings(NamedTuple):
+    """Device string form for exchange: dense padded bytes + lengths."""
+
+    bytes: jnp.ndarray  # uint8[n, width]
+    lengths: jnp.ndarray  # int32[n]
+    validity: jnp.ndarray  # bool[n]
+
+
+class ShuffledTable(NamedTuple):
+    columns: Dict[str, object]  # Column / Decimal128Column / PaddedStrings
+    valid: jnp.ndarray  # bool[ndev*capacity] slot occupancy
+    dropped: jnp.ndarray  # int32: local rows lost to capacity overflow
+
+
+def pad_strings(col: StringColumn, width: Optional[int] = None) -> PaddedStrings:
+    """Padded exchange view of a string column.
+
+    ``width`` must be static under jit; defaults to the host-computed max
+    byte length (call outside jit, or pass a bucket bound).
+    """
+    b, lens = col.padded(width)
+    return PaddedStrings(b, lens, col.is_valid())
+
+
+def shuffle_table(
+    columns: Dict[str, object],
+    part: jnp.ndarray,
+    capacity: int,
+    axis: str = DATA_AXIS,
+    row_valid: Optional[jnp.ndarray] = None,
+) -> ShuffledTable:
+    """Exchange a table of columns so each device receives the rows whose
+    ``part`` equals its index along ``axis`` (inside shard_map).
+
+    Per-column null validity survives the exchange; on the receiving side
+    each column's validity is additionally masked with slot occupancy, so
+    pad slots read as nulls rather than garbage.
+    """
+    flat: Dict[str, jnp.ndarray] = {}
+    kinds: Dict[str, tuple] = {}
+    for name, col in columns.items():
+        if isinstance(col, Column):
+            flat[name + ".data"] = col.data
+            flat[name + ".v"] = col.is_valid()
+            kinds[name] = ("fixed", col.dtype)
+        elif isinstance(col, Decimal128Column):
+            flat[name + ".hi"] = col.hi
+            flat[name + ".lo"] = col.lo
+            flat[name + ".v"] = col.is_valid()
+            kinds[name] = ("dec128", col.dtype)
+        elif isinstance(col, PaddedStrings):
+            flat[name + ".bytes"] = col.bytes
+            flat[name + ".len"] = col.lengths
+            flat[name + ".v"] = col.validity
+            kinds[name] = ("strings", None)
+        elif isinstance(col, StringColumn):
+            raise TypeError(
+                f"column {name!r}: convert StringColumn to PaddedStrings "
+                "(pad_strings) before shuffling — padded width must be "
+                "static under jit"
+            )
+        else:
+            raise TypeError(f"column {name!r}: unsupported type {type(col)}")
+
+    res = all_to_all_shuffle(flat, part, capacity, axis, row_valid=row_valid)
+    r = res.columns
+    out: Dict[str, object] = {}
+    for name, (kind, dtype) in kinds.items():
+        v = r[name + ".v"] & res.valid
+        if kind == "fixed":
+            out[name] = Column(r[name + ".data"], v, dtype)
+        elif kind == "dec128":
+            out[name] = Decimal128Column(r[name + ".hi"], r[name + ".lo"], v, dtype)
+        else:
+            out[name] = PaddedStrings(r[name + ".bytes"], r[name + ".len"], v)
+    return ShuffledTable(out, res.valid, res.dropped)
+
+
+def materialize_strings(ps: PaddedStrings) -> StringColumn:
+    """Arrow chars+offsets form of a received padded string column (host
+    boundary: total char count is data-dependent, so call outside jit).
+    Pad-slot rows are nulls with zero length."""
+    lens = jnp.where(ps.validity, ps.lengths, 0)
+    return strings_from_padded(ps.bytes, lens, ps.validity)

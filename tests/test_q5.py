@@ -1,0 +1,119 @@
+"""q5 (three-channel sales/returns rollup) vs an independent pandas oracle.
+
+BASELINE config 5's second half (q97 lives in test_q97*.py).  The oracle
+recomputes the whole query with pandas joins/groupbys from the same
+generated tables — null FK drops, date-window dim join, per-id sums,
+ROLLUP(channel, id).
+"""
+
+import numpy as np
+import pandas as pd
+
+import jax
+
+from spark_rapids_jni_tpu.mem import BudgetedResource, MemoryGovernor, task_context
+from spark_rapids_jni_tpu.models.q5 import (
+    q5_local,
+    run_distributed_q5,
+)
+from spark_rapids_jni_tpu.models.tpcds import CHANNELS, generate_q5_data
+from spark_rapids_jni_tpu.parallel import make_mesh
+
+NDEV = 8
+
+
+def _oracle(data):
+    """pandas re-implementation of the q5 pipeline."""
+    dates = pd.DataFrame({"sk": data.date_sk, "days": data.date_days})
+    window = dates[(dates.days >= data.sales_date_lo)
+                   & (dates.days < data.sales_date_hi)]["sk"]
+    rows = []
+    g = np.zeros(3, np.int64)
+    for name in CHANNELS:
+        ch = data.channels[name]
+        sales = pd.DataFrame({
+            "sk": np.where(ch.sales_sk_valid, ch.sales_sk, -1),
+            "dt": np.where(ch.sales_date_valid, ch.sales_date, -1),
+            "price": ch.sales_price, "profit": ch.sales_profit,
+        })
+        sales = sales[sales.sk.isin(ch.dim_sk) & sales.dt.isin(window)]
+        rets = pd.DataFrame({
+            "sk": np.where(ch.ret_sk_valid, ch.ret_sk, -1),
+            "dt": np.where(ch.ret_date_valid, ch.ret_date, -1),
+            "amt": ch.ret_amt, "loss": ch.ret_loss,
+        })
+        rets = rets[rets.sk.isin(ch.dim_sk) & rets.dt.isin(window)]
+
+        s_agg = sales.groupby("sk")[["price", "profit"]].sum()
+        r_agg = rets.groupby("sk")[["amt", "loss"]].sum()
+        merged = s_agg.join(r_agg, how="outer").fillna(0)
+        c = np.zeros(3, np.int64)
+        leaf = []
+        for sk, row in merged.iterrows():
+            ident = ch.dim_id[int(sk) - 1]
+            s, r = int(row.get("price", 0)), int(row.get("amt", 0))
+            p = int(row.get("profit", 0)) - int(row.get("loss", 0))
+            leaf.append((name, ident, s, r, p))
+            c += (s, r, p)
+        rows.extend(sorted(leaf, key=lambda q: q[1]))
+        rows.append((name, None, int(c[0]), int(c[1]), int(c[2])))
+        g += c
+    rows.append((None, None, int(g[0]), int(g[1]), int(g[2])))
+    return rows
+
+
+def test_q5_local_matches_oracle():
+    data = generate_q5_data(sf=0.02, seed=5)
+    got = [tuple(r) for r in q5_local(data)]
+    assert got == _oracle(data)
+
+
+def test_q5_local_zero_price_group_kept():
+    data = generate_q5_data(sf=0.01, seed=6)
+    ch = data.channels["store"]
+    # force one row to contribute zero cents: group must still appear
+    sel = np.where(ch.sales_sk_valid & ch.sales_date_valid)[0]
+    if len(sel):
+        ch.sales_price[sel[0]] = 0
+    got = [tuple(r) for r in q5_local(data)]
+    assert got == _oracle(data)
+
+
+def test_q5_distributed_matches_local_and_oracle():
+    data = generate_q5_data(sf=0.05, seed=7)
+    mesh = make_mesh((NDEV, 1), devices=jax.devices()[:NDEV])
+    gov = MemoryGovernor(watchdog_period_s=0.02)
+    try:
+        budget = BudgetedResource(gov, 1 << 30)
+        got = [tuple(r) for r in
+               run_distributed_q5(mesh, data, budget=budget, task_id=1)]
+        assert got == _oracle(data)
+        assert got == [tuple(r) for r in q5_local(data)]
+    finally:
+        gov._shutdown.set()
+        gov._watchdog.join(timeout=2)
+        gov.arbiter.close()
+
+
+def test_q5_distributed_split_retry_exact():
+    """Tight budget: fact rows split (additive aggregates) and the result
+    still matches the oracle, with split metrics recorded."""
+    data = generate_q5_data(sf=0.05, seed=8)
+    mesh = make_mesh((NDEV, 1), devices=jax.devices()[:NDEV])
+    gov = MemoryGovernor(watchdog_period_s=0.02)
+    try:
+        total = sum(v.nbytes for n in CHANNELS
+                    for v in vars(data.channels[n]).values()
+                    if isinstance(v, np.ndarray))
+        budget = BudgetedResource(gov, int(total * 1.2))  # < nbytes_of(batch)
+        with task_context(gov, 2):
+            got = [tuple(r) for r in
+                   run_distributed_q5(mesh, data, budget=budget, task_id=2,
+                                      manage_task=False)]
+            splits = gov.get_and_reset_num_split_retry(2)
+        assert got == _oracle(data)
+        assert splits >= 1
+    finally:
+        gov._shutdown.set()
+        gov._watchdog.join(timeout=2)
+        gov.arbiter.close()

@@ -1,0 +1,174 @@
+"""Columnar table shuffle: real batches (validity, strings, decimal128)
+across the device mesh, matching a host oracle.
+
+Closes VERDICT r2 missing #3: the exchange now moves nullable fixed-width
+columns, DECIMAL128 limb pairs, and string columns (as padded byte
+rectangles), not just bare arrays.  Reference intent: row_conversion.cu:574
+exists to serialize rows for exchange; the TPU-native form is dense
+per-column collective payloads.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from spark_rapids_jni_tpu.columnar.column import (
+    Column,
+    Decimal128Column,
+    column,
+    decimal128_column,
+    strings_column,
+)
+from spark_rapids_jni_tpu.columnar.dtypes import INT32
+from spark_rapids_jni_tpu.parallel import (
+    DATA_AXIS,
+    make_mesh,
+    materialize_strings,
+    shuffle_table,
+)
+
+NDEV = 8
+
+
+def _mesh():
+    return make_mesh((NDEV, 1), devices=jax.devices()[:NDEV])
+
+
+def _shuffle_fn(mesh, capacity, width):
+    """jit(shard_map) wrapper: partition by an int column mod ndev."""
+
+    def body(keys, fixed, dec, sbytes, slens, svalid):
+        from spark_rapids_jni_tpu.parallel.table_shuffle import PaddedStrings
+
+        part = (keys.data % NDEV).astype(jnp.int32)
+        ex = shuffle_table(
+            {
+                "k": keys,
+                "x": fixed,
+                "d": dec,
+                "s": PaddedStrings(sbytes, slens, svalid),
+            },
+            part, capacity, axis=DATA_AXIS,
+        )
+        return ex.columns, ex.valid, jax.lax.psum(ex.dropped, DATA_AXIS)
+
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=tuple(P(DATA_AXIS) for _ in range(6)),
+            out_specs=(P(DATA_AXIS), P(DATA_AXIS), P()),
+            check_vma=False,
+        )
+    )
+
+
+@pytest.fixture(scope="module")
+def shuffled():
+    """One shuffled table (all column kinds), shared across assertions."""
+    rng = np.random.RandomState(3)
+    n = 32 * NDEV
+    keys_np = rng.randint(0, 1000, n).astype(np.int32)
+    xs = [None if rng.rand() < 0.2 else int(v)
+          for v in rng.randint(-50, 50, n)]
+    decs = [None if rng.rand() < 0.2 else
+            (int(v) << 64) + int(rng.randint(0, 1 << 30))
+            for v in rng.randint(-5, 5, n)]
+    strs = [None if rng.rand() < 0.2 else
+            ("s%d" % v) * (1 + v % 4) for v in rng.randint(0, 99, n)]
+
+    keys = column([int(k) for k in keys_np], INT32)
+    fixed = column(xs, INT32)
+    dec = decimal128_column(decs, precision=38, scale=2)
+    scol = strings_column(strs)
+    width = max(scol.max_len(), 1)
+
+    mesh = _mesh()
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    put = functools.partial(jax.device_put, device=sharding)
+    sbytes, slens = scol.padded(width)
+
+    capacity = n  # safe: no drops
+    fn = _shuffle_fn(mesh, capacity, width)
+    cols, valid, dropped = fn(
+        jax.tree.map(put, keys),
+        jax.tree.map(put, fixed),
+        jax.tree.map(put, dec),
+        put(sbytes), put(slens), put(scol.is_valid()),
+    )
+    from spark_rapids_jni_tpu.parallel import ShuffledTable
+
+    ex = ShuffledTable(cols, valid, dropped)
+    jax.block_until_ready((cols, valid, dropped))
+    rows = list(zip(keys_np.tolist(), xs, decs, strs))
+    return ex, rows, capacity
+
+
+def _received(ex):
+    """(slot -> device) mapping plus host views of the received table."""
+    valid = np.asarray(ex.valid)
+    k = np.asarray(ex.columns["k"].data)
+    return valid, k
+
+
+def test_no_rows_dropped(shuffled):
+    ex, rows, capacity = shuffled
+    assert int(np.asarray(ex.dropped).sum()) == 0
+    valid, _ = _received(ex)
+    assert valid.sum() == len(rows)
+
+
+def test_rows_land_on_owner_device(shuffled):
+    ex, rows, capacity = shuffled
+    valid, k = _received(ex)
+    # global receive layout: [ndev_recv, ndev_src, capacity] flattened per
+    # device; slot i on device d must hold keys with k % NDEV == d
+    per_dev = NDEV * capacity
+    for d in range(NDEV):
+        sl = slice(d * per_dev, (d + 1) * per_dev)
+        got = k[sl][valid[sl]]
+        assert np.all(got % NDEV == d)
+
+
+def test_fixed_and_decimal_and_strings_match_oracle(shuffled):
+    ex, rows, capacity = shuffled
+    valid, k = _received(ex)
+    x = ex.columns["x"]
+    d = ex.columns["d"]
+    s = materialize_strings(ex.columns["s"])
+
+    x_list = Column(x.data, x.validity, x.dtype).to_list()
+    d_list = Decimal128Column(d.hi, d.lo, d.validity, d.dtype).unscaled_to_list()
+    s_list = s.to_list()
+
+    got = sorted(
+        [(int(k[i]), x_list[i], d_list[i], s_list[i])
+         for i in range(len(valid)) if valid[i]],
+        key=repr,
+    )
+    want = sorted(rows, key=repr)
+    assert got == want
+
+
+def test_null_validity_survives_exchange(shuffled):
+    ex, rows, capacity = shuffled
+    valid, k = _received(ex)
+    x = ex.columns["x"]
+    xv = np.asarray(x.validity)
+    # every pad slot must read as null, not garbage
+    assert not xv[~valid].any()
+    # null fraction of real rows matches the input
+    n_null_in = sum(1 for _, xx, _, _ in rows if xx is None)
+    assert (~xv[valid]).sum() == n_null_in
+
+
+def test_string_column_rejected_without_padding():
+    rng = np.random.RandomState(0)
+    scol = strings_column(["a", "bb"])
+    with pytest.raises(TypeError, match="PaddedStrings"):
+        shuffle_table({"s": scol}, jnp.zeros(2, jnp.int32), 2)
