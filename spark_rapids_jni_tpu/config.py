@@ -144,8 +144,14 @@ register("fault_injector_config_path", "",
          env="SRT_FAULT_INJECTOR_CONFIG_PATH")
 register("json_eval_device", False,
          "Evaluate JSON paths with the jitted lax.scan machine "
-         "(ops/json_eval_device.py) instead of the host numpy machine.",
+         "(ops/json_eval_device.py) instead of the host numpy machine "
+         "(only relevant when json_device_render is off).",
          env="SRT_JSON_EVAL_DEVICE")
+register("json_device_render", True,
+         "Fully device-resident get_json_object: device machine + device "
+         "segment rendering (ops/json_render_device.py); bytes cross to "
+         "host only at final column materialization.  Off = host numpy "
+         "pipeline (the debug oracle).", env="SRT_JSON_DEVICE_RENDER")
 register("watchdog_period_s", 0.1,
          "Memory-governor deadlock-watchdog poll period (the "
          "rmmWatchdogPollingPeriod analog, SparkResourceAdaptor.java:35).",

@@ -20,11 +20,15 @@ including its quirks:
 
 TPU split: the O(n x len) character scan is vectorized lane arithmetic on the
 padded byte matrix (cummax prefix masks replace the warp ballot/shuffle
-choreography).  The final O(n) digits->double assembly runs on host in exact
-binary64 — TPU f64 is float32-pair emulated and would not be bit-exact
-(columnar.column doc).  Digit windows longer than one warp batch (32 chars)
-follow the single-batch accounting rather than the reference's batch-boundary-
-dependent truncation bookkeeping.
+choreography).  The final O(n) digits->double assembly ALSO runs on device —
+TPU f64 is float32-pair emulated and would not be bit-exact, so the binary64
+multiply/divide/convert steps run as exact integer softfloat lane ops
+(utils/softfloat; `_assemble_device`).  The host `_assemble` is kept as the
+equivalence oracle.  The only host interaction is the ANSI error decision
+(one scalar any() sync; row bytes are pulled only on the throw path).
+Digit windows longer than one warp batch (32 chars) follow the single-batch
+accounting rather than the reference's batch-boundary-dependent truncation
+bookkeeping.
 
 Known <=1-ulp divergence: for negative powers (10^-k) our table is the
 correctly-rounded binary64 value, while CUDA's exp10 is occasionally 1 ulp
@@ -34,6 +38,7 @@ where the reference already deviates from Java's correctly-rounded parse.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -41,6 +46,12 @@ from spark_rapids_jni_tpu.columnar.buckets import map_buckets
 from spark_rapids_jni_tpu.columnar.column import Column, StringColumn
 from spark_rapids_jni_tpu.columnar.dtypes import DType, FLOAT64, Kind
 from spark_rapids_jni_tpu.ops.cast_string import CastException
+from spark_rapids_jni_tpu.utils.softfloat import (
+    f64_bits_to_f32_bits,
+    f64_div_bits,
+    f64_mul_bits,
+    u64_to_f64_bits,
+)
 
 MAX_SAFE_DIGITS = 19
 MAX_HOLDING = ((1 << 64) - 1 - 9) // 10  # 1844674407370955160
@@ -75,7 +86,7 @@ _SCAN_FIELDS = [
 
 
 def _scan(col: StringColumn):
-    """Per-row parse fields as a dict of host numpy arrays.
+    """Per-row parse fields as a dict of device arrays.
 
     Runs the padded-sweep kernel per length bucket (columnar/buckets.py) so a
     long outlier doesn't pad the whole column, then scatters fields back.
@@ -85,11 +96,17 @@ def _scan(col: StringColumn):
         _scan_padded,
         [((), dt) for _, dt in _SCAN_FIELDS],
     )
-    return {k: np.asarray(v) for (k, _), v in zip(_SCAN_FIELDS, outs)}
+    return {k: v for (k, _), v in zip(_SCAN_FIELDS, outs)}
 
 
-def _scan_padded(padded, lens):
-    """Padded-view parse sweep over one [n, L] byte rectangle."""
+def _scan_padded(padded, lens, max_exp_digits: int = 4):
+    """Padded-view parse sweep over one [n, L] byte rectangle (jitted alias
+    ``_scan_padded_jit`` below for callers composing it with other jits).
+
+    ``max_exp_digits``: the Spark cast reads at most 4 manual-exponent digits
+    (parse_manual_exp :505) — a cast-only quirk.  JSON number re-rendering
+    passes the full text width instead, with the accumulated value saturated
+    (huge exponents must become 0.0/Infinity, not parse errors)."""
     n, L = padded.shape
     lens = lens.astype(jnp.int32)
     pos_mat = jnp.arange(L, dtype=jnp.int32)[None, :]
@@ -183,14 +200,18 @@ def _scan_padded(padded, lens):
     exp_has_sign = has_exp & ((cs == ord("+")) | (cs == ord("-")))
     exp_neg = exp_has_sign & (cs == ord("-"))
     pd = pe + exp_has_sign.astype(jnp.int32)
-    # up to 4 digit chars considered
+    # up to max_exp_digits digit chars considered; the value saturates so
+    # absurdly long exponents stay order-of-magnitude correct (-> 0.0/inf)
     exp_digits = jnp.zeros((n,), jnp.int32)
     exp_val = jnp.zeros((n,), jnp.int32)
     still = jnp.ones((n,), jnp.bool_)
-    for k in range(4):
+    for k in range(max_exp_digits):
         ck = char_at(pd + k)
         is_d = (ck >= 48) & (ck <= 57) & still & (pd + k < lens)
-        exp_val = jnp.where(is_d, exp_val * 10 + (ck - 48).astype(jnp.int32), exp_val)
+        exp_val = jnp.where(
+            is_d,
+            jnp.minimum(exp_val * 10 + (ck - 48).astype(jnp.int32), 99999),
+            exp_val)
         exp_digits = exp_digits + is_d.astype(jnp.int32)
         still = still & is_d
     p_after_exp = jnp.where(has_exp, pd + exp_digits, stop)
@@ -219,8 +240,114 @@ def _scan_padded(padded, lens):
     return tuple(fields[k].astype(dt) for k, dt in _SCAN_FIELDS)
 
 
+_EXP10_BITS = _EXP10.view(np.int64)
+_POW10_U64 = np.array([10**k for k in range(20)], dtype=np.uint64)
+_NAN_BITS = np.int64(np.float64(np.nan).view(np.int64))
+
+
+def _exp10_bits(k):
+    """binary64 bit pattern of 10^k (same clipped table as _exp10)."""
+    idx = jnp.clip(k + _EXP10_OFFSET, 0, len(_EXP10) - 1)
+    return jnp.asarray(_EXP10_BITS)[idx]
+
+
+@jax.jit
+def _assemble_device(f):
+    """Device replication of the reference's final double assembly
+    (cast_string_to_float.cu:134-199) in exact integer binary64 arithmetic
+    (utils/softfloat) — TPU f64 is emulated, so the bit-exact math runs as
+    uint64 lane ops.  Returns (bits int64, valid, except_) device arrays;
+    the host `_assemble` remains as the debug/equivalence oracle."""
+    lens = f["lens"].astype(jnp.int64)
+    neg = f["negative"]
+    sign_bit = neg.astype(jnp.int64) << jnp.int64(63)
+    n = lens.shape[0]
+
+    valid = jnp.ones((n,), bool)
+    except_ = jnp.zeros((n,), bool)
+
+    nan_rows = f["is_nan"]
+    bad_nan = nan_rows & (lens != 3)
+    valid &= ~bad_nan
+    except_ |= bad_nan
+
+    inf_rows = f["inf3"] & ~nan_rows
+    ok_inf = inf_rows & f["inf_exact"]
+    valid &= ~(inf_rows & ~f["inf_exact"])  # no ANSI error (cu :276)
+
+    plain = ~nan_rows & ~inf_rows
+    seen_digit = (f["n_digit_chars"] > 0) | (f["n_lead_zeros"] > 0)
+    no_digits = plain & ~seen_digit
+    valid &= ~no_digits
+    except_ |= no_digits
+
+    # 19-significant-char accumulation + truncation accounting (:395-445)
+    n_sig = f["n_sig"].astype(jnp.int64)
+    val19 = f["val19"]
+    over = n_sig > 19
+    can_add = over & (val19 <= jnp.uint64(MAX_HOLDING)) & (
+        val19 * jnp.uint64(10) + f["d20"] <= jnp.uint64(MAX_HOLDING)
+    )
+    digits = jnp.where(can_add, val19 * jnp.uint64(10) + f["d20"], val19)
+    real_digits = jnp.minimum(n_sig, 19)
+    truncated = jnp.where(can_add, n_sig - 18, jnp.where(over, n_sig - 19, 0))
+    total_digits = real_digits + truncated
+    exp_base = truncated - jnp.where(
+        f["dot_in_run"], total_digits - f["decimal_pos"].astype(jnp.int64), 0
+    )
+
+    bad_exp = plain & f["has_exp"] & (f["exp_digits"] == 0)
+    valid &= ~bad_exp
+    except_ |= bad_exp
+    manual = jnp.where(f["exp_neg"], -f["exp_val"], f["exp_val"]).astype(jnp.int64)
+    manual = jnp.where(f["has_exp"], manual, 0)
+
+    zero = plain & (digits == 0) & seen_digit
+    bad_zero_tail = zero & f["tail0_nonws"]
+    valid &= ~bad_zero_tail
+    except_ |= bad_zero_tail
+
+    nonzero = plain & (digits != 0)
+    bad_tail = nonzero & f["tail_nonws"]
+    valid &= ~bad_tail
+    except_ |= bad_tail
+
+    # final assembly (:153-199) in softfloat binary64
+    exp_ten = exp_base + manual
+    digits_bits = u64_to_f64_bits(digits) | sign_bit
+    nd = jnp.ones((n,), jnp.int64)  # decimal digit count of `digits`
+    for k in range(1, 20):
+        nd += (digits >= _POW10_U64[k]).astype(jnp.int64)
+
+    too_big = exp_ten > 308
+    sub_shift = -307 - exp_ten
+    subnormal = ~too_big & (sub_shift > 0)
+    dsub = f64_div_bits(digits_bits, _exp10_bits(nd - 1 + sub_shift))
+    res_sub = f64_mul_bits(dsub, _exp10_bits(exp_ten + nd - 1 + sub_shift))
+    e10 = _exp10_bits(jnp.abs(exp_ten))
+    res_norm = jnp.where(
+        exp_ten < 0,
+        f64_div_bits(digits_bits, e10),
+        f64_mul_bits(digits_bits, e10),
+    )
+    inf_bits = sign_bit | jnp.int64(0x7FF0000000000000)
+    res = jnp.where(too_big, inf_bits,
+                    jnp.where(subnormal, res_sub, res_norm))
+
+    out = jnp.zeros((n,), jnp.int64)
+    out = jnp.where(nan_rows, _NAN_BITS, out)
+    out = jnp.where(ok_inf, inf_bits, out)
+    out = jnp.where(zero, sign_bit, out)
+    out = jnp.where(nonzero, res, out)
+    return out, valid, except_
+
+
+_scan_padded_jit = jax.jit(_scan_padded, static_argnums=(2,))
+
+
 def _assemble(f, out_dtype_np):
     """Host: replicate the reference's final double assembly (:134-199)."""
+    f = {k: np.asarray(v) for k, v in f.items()}
     n = f["lens"].shape[0]
     out = np.zeros((n,), np.float64)
     valid = np.ones((n,), bool)
@@ -331,25 +458,23 @@ def string_to_float(
     if dtype.kind not in (Kind.FLOAT32, Kind.FLOAT64):
         raise TypeError("string_to_float produces FLOAT32 or FLOAT64")
     f = _scan(col)
-    np_t = np.float32 if dtype.kind == Kind.FLOAT32 else np.float64
-    out, valid, except_ = _assemble(f, np_t)
+    bits, valid, except_ = _assemble_device(f)
 
-    in_valid = (
-        np.ones((col.size,), bool)
-        if col.validity is None
-        else np.asarray(col.validity)
-    )
-    except_ &= in_valid
-    if ansi_mode and except_.any():
-        row = int(np.nonzero(except_)[0][0])
+    in_valid = col.is_valid()
+    except_ = except_ & in_valid
+    # error control flow is the one host decision: a scalar any() sync, with
+    # the failing row's bytes pulled only on the (exceptional) throw path
+    if ansi_mode and bool(jnp.any(except_)):
+        row = int(jnp.argmax(except_))
         offs = np.asarray(col.offsets)
         bad = bytes(np.asarray(col.chars[offs[row] : offs[row + 1]]))
         raise CastException(bad.decode("utf-8", errors="replace"), row)
 
-    validity_np = valid & in_valid
-    validity = None if validity_np.all() else jnp.asarray(validity_np)
+    validity = valid & in_valid
     if dtype.kind == Kind.FLOAT64:
-        data = jnp.asarray(out.view(np.int64))  # bit-pattern convention
+        data = bits  # bit-pattern convention for FLOAT64 columns
     else:
-        data = jnp.asarray(out)
+        data = jax.lax.bitcast_convert_type(
+            f64_bits_to_f32_bits(bits), jnp.float32
+        )
     return Column(data, validity, dtype)

@@ -26,12 +26,20 @@ and the value is the following token (its span extended to the matching
 close for containers).  Grammar differences from cuDF's tokenizer are
 inherited deliberately from the Spark-JSON dialect of json_parser.cuh
 (single quotes allowed, etc.).
+
+DEVICE RESIDENCY (round 3): classification, pair compaction and the char
+gathers are all jitted; the host sees only scalar decisions (malformed-row
+check, per-bucket pair counts / span widths, output byte totals) — the byte
+payloads go host-side only at final column materialization.
 """
 
 from __future__ import annotations
 
+import functools
+from typing import NamedTuple
+
+import jax
 import jax.numpy as jnp
-import numpy as np
 
 from spark_rapids_jni_tpu.columnar.buckets import padded_buckets
 from spark_rapids_jni_tpu.columnar.column import (
@@ -44,10 +52,115 @@ from spark_rapids_jni_tpu.ops import json_tokenizer as jt
 __all__ = ["from_json", "JsonParsingException"]
 
 _I32 = jnp.int32
+_I64 = jnp.int64
 
 
 class JsonParsingException(ValueError):
     """Malformed JSON in from_json input (maps the reference's throw)."""
+
+
+class _Classified(NamedTuple):
+    bad: jnp.ndarray  # [nr] malformed non-null row
+    is_key: jnp.ndarray  # [nr, T] top-level field names of valid rows
+    krank: jnp.ndarray  # [nr, T] within-row pair rank
+    kstart: jnp.ndarray  # [nr, T] key payload span (quotes stripped)
+    kend: jnp.ndarray
+    vstart: jnp.ndarray  # [nr, T] raw value span
+    vend: jnp.ndarray
+
+
+@jax.jit
+def _classify(kind, start, end, match, ntok, ok, trailing, row_valid
+              ) -> _Classified:
+    """Token-stream classification: which tokens are top-level keys, and the
+    key/value spans of each (device port of the old numpy passes)."""
+    nr, T = kind.shape
+    bad = row_valid & (~ok | trailing)
+
+    tok_idx = jnp.arange(T, dtype=_I32)[None, :]
+    in_tok = tok_idx < ntok[:, None]
+    opens = ((kind == jt.START_OBJECT) | (kind == jt.START_ARRAY)) & in_tok
+    closes = ((kind == jt.END_OBJECT) | (kind == jt.END_ARRAY)) & in_tok
+    depth_after = jnp.cumsum(
+        opens.astype(_I32) - closes.astype(_I32), axis=1)
+    depth_before = depth_after - opens.astype(_I32) + closes.astype(_I32)
+    root_is_obj = (kind[:, 0] == jt.START_OBJECT) & (ntok > 0)
+    is_key = (
+        (kind == jt.FIELD_NAME)
+        & (depth_before == 1)
+        & in_tok
+        & root_is_obj[:, None]
+        & row_valid[:, None]
+        & ~bad[:, None]
+    )
+    krank = jnp.cumsum(is_key.astype(_I32), axis=1) - 1
+
+    vt = jnp.clip(tok_idx + 1, 0, T - 1)
+    vkind = jnp.take_along_axis(kind, vt, axis=1)
+    vstart = jnp.take_along_axis(start, vt, axis=1)
+    vend0 = jnp.take_along_axis(end, vt, axis=1)
+    vmatch = jnp.clip(jnp.take_along_axis(match, vt, axis=1), 0, T - 1)
+    close_end = jnp.take_along_axis(end, vmatch, axis=1)
+    is_str = vkind == jt.VALUE_STRING
+    is_container = (vkind == jt.START_OBJECT) | (vkind == jt.START_ARRAY)
+    vstart = jnp.where(is_str, vstart + 1, vstart)
+    vend = jnp.where(is_container, close_end,
+                     jnp.where(is_str, vend0 - 1, vend0))
+    return _Classified(
+        bad=bad, is_key=is_key, krank=krank,
+        kstart=start + 1, kend=end - 1, vstart=vstart, vend=vend,
+    )
+
+
+class _Pairs(NamedTuple):
+    """Compacted per-bucket pair records ([NP] device arrays)."""
+
+    loc_row: jnp.ndarray  # bucket-local row index
+    glob_row: jnp.ndarray  # full-column row index
+    krank: jnp.ndarray
+    ks: jnp.ndarray
+    ke: jnp.ndarray
+    vs: jnp.ndarray
+    ve: jnp.ndarray
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _compact(cl: _Classified, rows, NP: int) -> _Pairs:
+    nr, T = cl.is_key.shape
+    flat = cl.is_key.reshape(-1)
+    grank = jnp.cumsum(flat.astype(_I64)) - 1
+    slot = jnp.where(flat, grank, NP)
+
+    def put(x, dtype=_I32):
+        return (jnp.zeros((NP,), dtype)
+                .at[slot].set(x.reshape(-1).astype(dtype), mode="drop"))
+
+    loc = jnp.broadcast_to(jnp.arange(nr, dtype=_I32)[:, None], (nr, T))
+    return _Pairs(
+        loc_row=put(loc),
+        glob_row=put(jnp.broadcast_to(rows[:, None], (nr, T))),
+        krank=put(cl.krank),
+        ks=put(cl.kstart), ke=put(cl.kend),
+        vs=put(cl.vstart), ve=put(cl.vend),
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5))
+def _scatter_span_bytes(chars, b_bytes, pairs_sel, dst_off, W: int,
+                        nbytes: int):
+    """Copy each selected pair's [s, e) bytes into chars at dst_off."""
+    loc, s, e = pairs_sel
+    lane = jnp.arange(W, dtype=_I32)[None, :]
+    src = jnp.clip(s[:, None] + lane, 0, b_bytes.shape[1] - 1)
+    mat = b_bytes[loc[:, None], src]
+    span = (e - s).astype(_I32)
+    dst = dst_off.astype(_I64)[:, None] + lane.astype(_I64)
+    in_b = lane < span[:, None]
+    return chars.at[jnp.where(in_b, dst, nbytes)].set(mat, mode="drop")
+
+
+def _pow2(x: int) -> int:
+    return 1 << max(int(x) - 1, 0).bit_length()
 
 
 def from_json(col: StringColumn) -> ListColumn:
@@ -56,7 +169,7 @@ def from_json(col: StringColumn) -> ListColumn:
     Returns ``LIST<STRUCT<STRING,STRING>>`` with the input's validity.
     """
     n = col.size
-    valid = np.asarray(col.is_valid())
+    in_valid = col.is_valid()
     if n == 0:
         empty = StringColumn(
             jnp.zeros((0,), jnp.uint8), jnp.zeros((1,), _I32), None
@@ -65,129 +178,68 @@ def from_json(col: StringColumn) -> ListColumn:
             jnp.zeros((1,), _I32), StructColumn((empty, empty), None), None
         )
 
-    # per-row pair counts + per-bucket pair records
-    pair_counts = np.zeros((n,), np.int64)
-    bucket_recs = []  # (rows_np, kstart, kend, vstart, vend, krank  [np arrays])
+    pair_counts = jnp.zeros((n,), _I64)
+    recs = []  # (bucket, _Pairs, npairs)
     for b in padded_buckets(col):
         ts = jt.tokenize(b.bytes, b.lengths)
-        kind = np.asarray(ts.kind)
-        start = np.asarray(ts.start)
-        end = np.asarray(ts.end)
-        match = np.asarray(ts.match)
-        ntok = np.asarray(ts.n_tokens)
-        ok = np.asarray(ts.ok)
-        trailing = np.asarray(ts.trailing)
-        rows = np.asarray(b.rows)[: b.n_valid]
-        kindv = kind[: b.n_valid]
-        startv = start[: b.n_valid]
-        endv = end[: b.n_valid]
-        matchv = match[: b.n_valid]
-        ntokv = ntok[: b.n_valid]
-
-        rvalid = valid[rows]
-        bad = rvalid & (~ok[: b.n_valid] | trailing[: b.n_valid])
-        if bad.any():
-            r = int(rows[int(np.argmax(bad))])
+        row_valid = in_valid[b.rows] & b.valid_mask()
+        cl = _classify(ts.kind.astype(_I32), ts.start, ts.end, ts.match,
+                       ts.n_tokens.astype(_I32), ts.ok, ts.trailing,
+                       row_valid)
+        if bool(jnp.any(cl.bad)):  # malformed non-null row: whole-op throw
+            r = int(b.rows[int(jnp.argmax(cl.bad))])
             raise JsonParsingException(
                 f"JSON Parser encountered an invalid format at row {r}"
             )
-
-        T = kindv.shape[1]
-        tok_idx = np.arange(T)[None, :]
-        in_tok = tok_idx < ntokv[:, None]
-        opens = np.isin(kindv, (jt.START_OBJECT, jt.START_ARRAY)) & in_tok
-        closes = np.isin(kindv, (jt.END_OBJECT, jt.END_ARRAY)) & in_tok
-        depth_after = np.cumsum(
-            opens.astype(np.int32) - closes.astype(np.int32), axis=1
-        )
-        depth_before = depth_after - opens.astype(np.int32) + closes.astype(
-            np.int32
-        )
-        root_is_obj = (kindv[:, 0] == jt.START_OBJECT) & (ntokv > 0)
-        is_key = (
-            (kindv == jt.FIELD_NAME)
-            & (depth_before == 1)
-            & in_tok
-            & root_is_obj[:, None]
-            & rvalid[:, None]
-        )
-
-        if not is_key.any():
+        npairs = int(jnp.sum(cl.is_key))
+        if npairs == 0:
             continue
-        krank = np.cumsum(is_key, axis=1) - 1
-        ri, ti = np.nonzero(is_key)
-        vt = ti + 1  # value token follows its field name
-        vkind = kindv[ri, vt]
-        vstart = startv[ri, vt]
-        vend = endv[ri, vt]
-        is_str = vkind == jt.VALUE_STRING
-        is_container = np.isin(vkind, (jt.START_OBJECT, jt.START_ARRAY))
-        vstart = np.where(is_str, vstart + 1, vstart)
-        vend = np.where(
-            is_container, endv[ri, matchv[ri, vt]], np.where(is_str, vend - 1, vend)
-        )
-        kstart = startv[ri, ti] + 1  # strip quotes
-        kend = endv[ri, ti] - 1
+        pair_counts = pair_counts.at[b.rows].add(
+            jnp.sum(cl.is_key, axis=1).astype(_I64))
+        recs.append((b, _compact(cl, b.rows, _pow2(npairs)), npairs))
 
-        np.add.at(pair_counts, rows[ri], 1)
-        bucket_recs.append(
-            (b, rows[ri], ri, kstart, kend, vstart, vend, krank[ri, ti])
-        )
+    offsets = jnp.pad(jnp.cumsum(pair_counts), (1, 0))
+    total = int(offsets[-1])  # list-child size is shape-defining
 
-    offsets = np.zeros((n + 1,), np.int64)
-    np.cumsum(pair_counts, out=offsets[1:])
-    total = int(offsets[-1])
-
-    keys = _gather_spans(
-        total, bucket_recs, lambda r: (r[3], r[4]), offsets
-    )
-    values = _gather_spans(
-        total, bucket_recs, lambda r: (r[5], r[6]), offsets
-    )
+    keys = _gather_spans(total, recs, lambda p: (p.ks, p.ke), offsets)
+    values = _gather_spans(total, recs, lambda p: (p.vs, p.ve), offsets)
     return ListColumn(
-        jnp.asarray(offsets.astype(np.int32)),
+        offsets.astype(_I32),
         StructColumn((keys, values), None),
         col.validity,
     )
 
 
-def _gather_spans(total, bucket_recs, get_span, row_offsets) -> StringColumn:
-    """Assemble a StringColumn from per-bucket (row, span) records.
+def _gather_spans(total, recs, get_span, row_offsets) -> StringColumn:
+    """Assemble a StringColumn from per-bucket pair records (device).
 
     Final pair position = row_offsets[row] + within-row rank, so output
-    order is row-major regardless of bucket assignment.
+    order is row-major regardless of bucket assignment.  Host syncs: the
+    output byte total and each bucket's max span width (pow2-padded).
     """
-    lens = np.zeros((max(total, 1),), np.int64)
-    pair_pos = []
-    for rec in bucket_recs:
-        _, rows_ri, _ri, *_ , krank = rec
-        s, e = get_span(rec)
-        pos = row_offsets[rows_ri] + krank
-        lens[pos] = e - s
-        pair_pos.append(pos)
     if total == 0:
         return StringColumn(
             jnp.zeros((0,), jnp.uint8), jnp.zeros((1,), _I32), None
         )
-    offs = np.zeros((total + 1,), np.int64)
-    np.cumsum(lens[:total], out=offs[1:])
+    lens = jnp.zeros((total + 1,), _I64)
+    positions = []
+    for b, p, npairs in recs:
+        s, e = get_span(p)
+        pos = row_offsets[p.glob_row] + p.krank
+        # pad slots beyond npairs carry garbage; mask them to the sink
+        slot_ok = jnp.arange(p.ks.shape[0]) < npairs
+        pos = jnp.where(slot_ok, pos, total)
+        positions.append(pos)
+        lens = lens.at[pos].set((e - s).astype(_I64), mode="drop")
+    offs = jnp.pad(jnp.cumsum(lens[:total]), (1, 0))
     nbytes = int(offs[-1])
     chars = jnp.zeros((max(nbytes, 1),), jnp.uint8)
-    for rec, pos in zip(bucket_recs, pair_pos):
-        b = rec[0]
-        s, e = get_span(rec)
-        bloc = rec[2].astype(np.int32)  # bucket-local row of each pair
-        w = int((e - s).max()) if len(s) else 1
-        w = max(w, 1)
-        lane = jnp.arange(w, dtype=_I32)[None, :]
-        src = jnp.asarray(s.astype(np.int32))[:, None] + lane
-        mat = b.bytes[jnp.asarray(bloc)[:, None], jnp.clip(src, 0, b.width - 1)]
-        span_len = jnp.asarray((e - s).astype(np.int32))
-        dst = jnp.asarray(offs[pos].astype(np.int64))[:, None] + lane.astype(
-            jnp.int64
-        )
-        in_b = lane < span_len[:, None]
-        chars = chars.at[jnp.where(in_b, dst, nbytes)].set(mat, mode="drop")
-    return StringColumn(
-        chars[:nbytes], jnp.asarray(offs.astype(np.int32)), None
-    )
+    for (b, p, npairs), pos in zip(recs, positions):
+        s, e = get_span(p)
+        w = _pow2(max(int(jnp.max(e - s)), 1))
+        chars = _scatter_span_bytes(
+            chars, b.bytes, (p.loc_row, s, e),
+            jnp.where(pos < total, offs[jnp.minimum(pos, total - 1)],
+                      jnp.int64(nbytes)),
+            w, nbytes)
+    return StringColumn(chars[:nbytes], offs.astype(_I32), None)

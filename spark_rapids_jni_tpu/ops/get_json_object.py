@@ -991,6 +991,85 @@ def _render(bi: _ByteInfo, segs, machine, kind, start, end, len_raw, len_esc,
     return out, out_len
 
 
+def _pow2(x: int) -> int:
+    return 1 << max(int(x) - 1, 0).bit_length()
+
+
+def _get_json_object_device(col: StringColumn, ptypes, pargs, names
+                            ) -> StringColumn:
+    """Fully device-resident evaluation: tokenize, byte tables, name match,
+    lax.scan machine, and segment rendering all run jitted; per bucket only
+    three scalars sync to host (float count, float source width, output
+    width), each pow2-padded so the compile-variant set stays bounded.
+    Parity: the single-kernel residency of get_json_object.cu:891.
+    """
+    from spark_rapids_jni_tpu.ops import json_render_device as jrd
+    from spark_rapids_jni_tpu.ops.json_eval_device import MAX_PATH_DEPTH as _MPD
+    from spark_rapids_jni_tpu.ops.json_eval_device import _run_scan
+
+    n = col.size
+    in_valid = col.is_valid()
+    P1 = len(ptypes) + 1
+    ptype_j = jnp.asarray(list(ptypes) + [_P_END], np.int32)
+    parg_j = jnp.asarray(
+        [a if isinstance(a, int) else 0 for a in pargs] + [0], np.int32)
+
+    results = []
+    valid_out = jnp.zeros((n,), bool)
+    for b in padded_buckets(col):
+        ts = jt.tokenize(b.bytes, b.lengths)
+        nr, nv = b.n_rows, b.n_valid
+        kind = ts.kind.astype(jnp.int32)
+        start, end, match = ts.start, ts.end, ts.match
+        ntok = ts.n_tokens.astype(jnp.int32)
+        T = kind.shape[1]
+
+        st_before = _string_states(b.bytes, b.lengths)
+        bi = jrd.byte_info_device(b.bytes, b.lengths, st_before)
+        len_raw, len_esc, has_uni, neg0 = jrd.token_tables_device(
+            bi, kind, start, end)
+        nm = jrd.name_matches_device(bi, kind, start, len_raw, has_uni, names)
+        nm_stack = jnp.concatenate(
+            [jnp.stack(nm) if nm else jnp.zeros((0, nr, T), bool),
+             jnp.zeros((P1 - len(nm), nr, T), bool)])
+
+        F = min(jt.MAX_DEPTH + MAX_PATH_DEPTH + 6, T + 3)
+        G = min(_MPD + 2, F)
+        err, done, dirty_root, (segs, cg, cd, cn) = _run_scan(
+            kind, match, ntok, ts.ok, nm_stack, ptype_j, parg_j, T, F, G)
+        err = err | ~done | (dirty_root <= 0)
+        err = err | ~in_valid[b.rows]
+        err = err | ~b.valid_mask()  # pow2-padding tail rows
+
+        # floats: two scalar syncs pick the compile-bounded slot geometry
+        fmask = kind == jt.VALUE_NUMBER_FLOAT
+        nf_total = int(jnp.sum(fmask))
+        if nf_total:
+            ws = int(jnp.max(jnp.where(fmask, end - start, 0)))
+            NF, WS = _pow2(nf_total), _pow2(max(int(ws), 1))
+            ftext, flen, fidx = jrd.float_texts_device(
+                b.bytes, kind, start, end, NF, WS)
+        else:
+            ftext = jnp.zeros((0, 1), jnp.uint8)
+            flen = jnp.zeros((0,), jnp.int64)
+            fidx = jnp.full((nr, T), -1, jnp.int64)
+
+        stype, sarg, segcum, out_len = jrd.resolve_and_measure(
+            segs, cg, cd, cn, err, kind, len_raw, len_esc, fidx, flen)
+        W = _pow2(max(int(jnp.max(out_len)), 1))  # third scalar sync
+        padded = jrd.render_device(
+            bi, stype, sarg, segcum, out_len, err, kind, start, end,
+            (len_raw, len_esc, neg0), (ftext, flen, fidx), W)
+
+        rvalid = ~err
+        tgt = jnp.where(b.valid_mask(), b.rows, jnp.int32(n))
+        valid_out = valid_out.at[tgt].set(rvalid, mode="drop")
+        results.append((b.rows[:nv], padded[:nv],
+                        out_len[:nv].astype(jnp.int32), nv))
+
+    return strings_from_buckets(n, results, valid_out)
+
+
 def get_json_object(col: StringColumn, path: Sequence[tuple]) -> StringColumn:
     """Evaluate a JSON path over every row (Spark ``get_json_object``).
 
@@ -1005,7 +1084,6 @@ def get_json_object(col: StringColumn, path: Sequence[tuple]) -> StringColumn:
         # get_json_object.cu:958 CUDF_FAIL("JSONPath query exceeds maximum depth")
         raise ValueError("JSONPath query exceeds maximum depth")
     n = col.size
-    in_valid = np.asarray(col.is_valid())
     if n == 0:
         return StringColumn(
             jnp.zeros((0,), jnp.uint8), jnp.zeros((1,), jnp.int32), None
@@ -1014,6 +1092,11 @@ def get_json_object(col: StringColumn, path: Sequence[tuple]) -> StringColumn:
     ptypes = [p[0] for p in path]
     pargs = [p[1] if len(p) > 1 else 0 for p in path]
     names = [p[1] if p[0] == NAMED else None for p in path]
+
+    if config.get("json_device_render"):
+        return _get_json_object_device(col, ptypes, pargs, names)
+
+    in_valid = np.asarray(col.is_valid())
 
     results = []
     valid_out = np.zeros((n,), bool)

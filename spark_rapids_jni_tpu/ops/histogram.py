@@ -58,10 +58,11 @@ def create_histogram_if_valid(
     if values.size != frequencies.size:
         raise ValueError("The input values and frequencies must have the same size.")
 
-    freq = np.asarray(frequencies.data)
-    if (freq < 0).any():
+    # validation decisions are scalar syncs; the frequency bytes stay device
+    freq = frequencies.data
+    if bool(jnp.any(freq < 0)):
         raise ValueError("The input frequencies must not contain negative values.")
-    has_zero = bool((freq == 0).any())
+    has_zero = bool(jnp.any(freq == 0))
     n = values.size
 
     if output_as_lists:
@@ -71,9 +72,14 @@ def create_histogram_if_valid(
             offsets = jnp.arange(n + 1, dtype=jnp.int32)
             return ListColumn(offsets, struct, None)
         keep = freq > 0
-        sizes = keep.astype(np.int32)
-        offsets = jnp.asarray(np.concatenate([[0], np.cumsum(sizes)]).astype(np.int32))
-        gather = jnp.asarray(np.nonzero(keep)[0].astype(np.int32))
+        offsets = jnp.pad(jnp.cumsum(keep.astype(jnp.int32)), (1, 0))
+        total = int(offsets[-1])  # list child size is shape-defining
+        rank = offsets[1:] - 1
+        gather = (
+            jnp.zeros((max(total, 1),), jnp.int32)
+            .at[jnp.where(keep, rank, total)]
+            .set(jnp.arange(n, dtype=jnp.int32), mode="drop")[:total]
+        )
         kept_vals = Column(
             values.data[gather],
             None if values.validity is None else values.validity[gather],
@@ -90,7 +96,7 @@ def create_histogram_if_valid(
     # Nullify zero-frequency values (AND with any existing mask) and force
     # the frequency of EVERY null row (including originally-null values) to 1
     # so downstream MERGE_HISTOGRAM never sees freq 0.
-    pos = jnp.asarray(freq > 0)
+    pos = freq > 0
     validity = pos if values.validity is None else (values.validity & pos)
     fixed_freq = jnp.where(validity, frequencies.data, jnp.int64(1))
     out_vals = Column(values.data, validity, values.dtype)

@@ -1,0 +1,274 @@
+"""Exact IEEE-754 binary64 arithmetic in integer ops (device-safe).
+
+TPU f64 is float32-pair emulated and NOT bit-exact binary64 (columnar.column
+doc), so any op that must reproduce the reference's double math bit-for-bit
+(string->float assembly, JSON number re-rendering) cannot use jnp.float64 on
+device.  This module implements the three operations those paths need as
+pure integer (uint64/int32) lane arithmetic — exact on every backend:
+
+- :func:`u64_to_f64_bits` — u64 -> nearest binary64 (round-to-nearest-even);
+- :func:`f64_mul_bits` — full IEEE multiply incl. subnormal output and
+  overflow-to-inf, single rounding;
+- :func:`f64_div_bits` — IEEE divide via 55-step vectorized long division.
+
+All values travel as int64 *bit patterns* (the framework's FLOAT64 column
+convention).  Inputs are expected finite; zero and inf inputs are handled
+(propagated) but NaN payloads are not preserved beyond the default quiet
+NaN.  Mirrors the arithmetic used by cast_string_to_float.cu:153-199.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "u64_to_f64_bits",
+    "f64_mul_bits",
+    "f64_div_bits",
+    "f64_from_parts",
+    "f64_bits_to_f32_bits",
+]
+
+_U64 = jnp.uint64
+_I64 = jnp.int64
+
+_EXP_MASK = np.int64(0x7FF)
+_MANT_MASK = np.uint64((1 << 52) - 1)
+_IMPLICIT = np.uint64(1 << 52)
+_INF_BITS = np.int64(0x7FF0000000000000)
+
+
+def _u(x):
+    return x.astype(_U64)
+
+
+def _clz64(x):
+    """Count leading zeros of uint64 (64 for x == 0), via binary search."""
+    x = _u(x)
+    n = jnp.zeros(x.shape, jnp.int32)
+    cur = x
+    for shift in (32, 16, 8, 4, 2, 1):
+        big = cur >= (_U64(1) << _U64(shift))
+        n = n + jnp.where(big, 0, shift)
+        cur = jnp.where(big, cur >> _U64(shift), cur)
+    # after loop cur is 0 or 1; if original was 0, n counted 63 -> fix to 64
+    return jnp.where(x == 0, jnp.int32(64), n)
+
+
+def _shr_sticky(m, k):
+    """(m >> k, sticky: any shifted-out bit), k in [0, 63]."""
+    k = k.astype(_U64)
+    kept = m >> k
+    lost = m ^ (kept << k)
+    return kept, lost != 0
+
+
+def _rne(mant_with_grs, sticky_extra):
+    """Round a value carrying 2 extra bits (guard, round/sticky-merged).
+
+    ``mant_with_grs``: mantissa << 2 | guard << 1 | roundbit; plus a bool
+    sticky for anything below.  Returns rounded mantissa (may be 2^53).
+    """
+    mant = mant_with_grs >> _U64(2)
+    guard = (mant_with_grs >> _U64(1)) & _U64(1)
+    rbit = mant_with_grs & _U64(1)
+    sticky = (rbit != 0) | sticky_extra
+    round_up = (guard != 0) & (sticky | ((mant & _U64(1)) != 0))
+    return mant + round_up.astype(_U64)
+
+
+def f64_from_parts(sign, e_unb, mant53, guard, sticky):
+    """Assemble bits from sign (0/1), unbiased exponent of the leading
+    mantissa bit, a 53-bit mantissa with a guard bit and sticky, with RNE,
+    subnormal flushing, and overflow to inf.
+
+    ``mant53`` in [2^52, 2^53); ``e_unb`` is the exponent such that value =
+    mant53 * 2^(e_unb - 52).
+    """
+    sign = sign.astype(_I64)
+    e_b = e_unb.astype(jnp.int32) + 1023
+
+    # subnormal: shift mantissa right so exponent becomes 1 - 1023
+    sub_shift = jnp.clip(1 - e_b, 0, 63)
+    total = _u(mant53) << _U64(2) | _u(guard) << _U64(1)
+    shifted, lost = _shr_sticky(total, sub_shift)
+    mant = _rne(shifted, sticky | lost)
+    e_b = jnp.where(sub_shift > 0, 1, e_b)
+
+    # rounding overflow: mantissa reached 2^53 -> bump exponent
+    ovf = mant >= (_U64(1) << _U64(53))
+    mant = jnp.where(ovf, mant >> _U64(1), mant)
+    e_b = e_b + ovf.astype(jnp.int32)
+
+    # subnormal result: exponent field 0 when mantissa has no implicit bit
+    is_sub = mant < _IMPLICIT
+    exp_field = jnp.where(is_sub, 0, e_b).astype(_I64)
+    inf = e_b >= 2047
+    bits = (sign << _I64(63)) | jnp.where(
+        inf, _INF_BITS,
+        (exp_field << _I64(52)) | (mant & _MANT_MASK).astype(_I64),
+    )
+    zero = mant == 0
+    bits = jnp.where(zero, sign << _I64(63), bits)
+    return bits
+
+
+def u64_to_f64_bits(x) -> jnp.ndarray:
+    """Nearest binary64 of a uint64 (RNE), as int64 bits.  Exact for
+    x < 2^53; matches (double)x elsewhere."""
+    x = _u(x)
+    lz = _clz64(x)
+    bitlen = 64 - lz
+    # place the leading bit at position 52: value = mant * 2^(bitlen-53)
+    left = jnp.clip(53 - bitlen, 0, 63)
+    right = jnp.clip(bitlen - 53, 0, 63)
+    mant_exact = x << left.astype(_U64)
+    kept, lost = _shr_sticky(x, right)
+    shifted_g, lost_g = _shr_sticky(x, jnp.maximum(right - 1, 0))
+    guard = jnp.where(right > 0, shifted_g & _U64(1), _U64(0))
+    below = lost_g & (right > 1)
+    mant = jnp.where(right > 0, kept, mant_exact)
+    bits = f64_from_parts(
+        jnp.zeros(x.shape, _I64), bitlen - 1, mant, guard, below
+    )
+    return jnp.where(x == 0, _I64(0), bits)
+
+
+def _decompose(bits):
+    """(sign, unbiased exp of value's 2^e, 53-bit mantissa, is_zero, is_inf,
+    is_nan); subnormals are normalized into the same (e, mant) form."""
+    b = bits.astype(_I64)
+    sign = (b >> _I64(63)) & _I64(1)
+    e_field = ((b >> _I64(52)) & _EXP_MASK).astype(jnp.int32)
+    frac = _u(b) & _MANT_MASK
+    is_zero = (e_field == 0) & (frac == 0)
+    is_inf = (e_field == 2047) & (frac == 0)
+    is_nan = (e_field == 2047) & (frac != 0)
+    # normal: implicit bit; subnormal: normalize left
+    lz = _clz64(frac)  # for subnormals; frac < 2^52 so lz >= 12
+    sub_shift = jnp.clip(lz - 11, 0, 63)
+    mant = jnp.where(e_field == 0, frac << sub_shift.astype(_U64),
+                     frac | _IMPLICIT)
+    e_unb = jnp.where(
+        e_field == 0, 1 - 1023 - (sub_shift - 0), e_field - 1023
+    ).astype(jnp.int32)
+    return sign, e_unb, mant, is_zero, is_inf, is_nan
+
+
+def _mul_64x64(a, b):
+    """(hi, lo) 128-bit product of two uint64 via 32-bit halves."""
+    mask32 = _U64(0xFFFFFFFF)
+    ah, al = a >> _U64(32), a & mask32
+    bh, bl = b >> _U64(32), b & mask32
+    ll = al * bl
+    lh = al * bh
+    hl = ah * bl
+    hh = ah * bh
+    mid = (ll >> _U64(32)) + (lh & mask32) + (hl & mask32)
+    lo = (mid << _U64(32)) | (ll & mask32)
+    hi = hh + (lh >> _U64(32)) + (hl >> _U64(32)) + (mid >> _U64(32))
+    return hi, lo
+
+
+def f64_mul_bits(a_bits, b_bits) -> jnp.ndarray:
+    """IEEE binary64 multiply on bit patterns (RNE, subnormals, inf)."""
+    sa, ea, ma, za, ia, na = _decompose(a_bits)
+    sb, eb, mb, zb, ib, nb = _decompose(b_bits)
+    s = sa ^ sb
+
+    hi, lo = _mul_64x64(ma, mb)  # product in [2^104, 2^106)
+    # leading bit at 105 or 104: normalize to 53-bit mantissa + guard+sticky
+    top = (hi >> _U64(41)) != 0  # bit 105 set (hi holds bits 64..127)
+    # mant53 = P >> (52 or 53); P = hi*2^64 + lo
+    sh = jnp.where(top, 53, 52).astype(_U64)
+    # P >> sh for sh in {52, 53}: combine hi/lo
+    mant = (hi << (_U64(64) - sh)) | (lo >> sh)
+    guard = (lo >> (sh - _U64(1))) & _U64(1)
+    below_mask = (_U64(1) << (sh - _U64(1))) - _U64(1)
+    sticky = (lo & below_mask) != 0
+    e = ea + eb + top.astype(jnp.int32)
+
+    bits = f64_from_parts(s, e, mant, guard, sticky)
+
+    any_nan = na | nb | (za & ib) | (zb & ia)
+    any_inf = (ia | ib) & ~any_nan
+    any_zero = (za | zb) & ~any_nan & ~any_inf
+    bits = jnp.where(any_zero, s << _I64(63), bits)
+    bits = jnp.where(any_inf, (s << _I64(63)) | _INF_BITS, bits)
+    bits = jnp.where(any_nan, _I64(0x7FF8000000000000), bits)
+    return bits
+
+
+def f64_div_bits(a_bits, b_bits) -> jnp.ndarray:
+    """IEEE binary64 divide on bit patterns (RNE, subnormals, inf)."""
+    sa, ea, ma, za, ia, na = _decompose(a_bits)
+    sb, eb, mb, zb, ib, nb = _decompose(b_bits)
+    s = sa ^ sb
+    e = ea - eb
+
+    # pre-align so quotient lands in [1, 2): if ma < mb, scale ma by 2
+    small = ma < mb
+    ma2 = jnp.where(small, ma << _U64(1), ma)
+    e = e - small.astype(jnp.int32)
+
+    # 54 quotient bits (1 integer + 52 frac + guard) by restoring division;
+    # fori_loop keeps the compiled graph 54x smaller than unrolling
+    import jax
+
+    def _div_step(_, st):
+        rem, q = st
+        ge = rem >= mb
+        q = (q << _U64(1)) | ge.astype(_U64)
+        rem = jnp.where(ge, rem - mb, rem) << _U64(1)
+        return rem, q
+
+    rem, q = jax.lax.fori_loop(
+        0, 54, _div_step, (ma2, jnp.zeros(ma.shape, _U64)))
+    sticky = rem != 0
+    mant = q >> _U64(1)  # 53 bits, leading bit set by construction
+    guard = q & _U64(1)
+
+    bits = f64_from_parts(s, e, mant, guard, sticky)
+
+    any_nan = na | nb | (za & zb) | (ia & ib)
+    div_zero = zb & ~any_nan
+    res_zero = (za | ib) & ~any_nan & ~div_zero
+    res_inf = (ia | div_zero) & ~any_nan
+    bits = jnp.where(res_zero, s << _I64(63), bits)
+    bits = jnp.where(res_inf, (s << _I64(63)) | _INF_BITS, bits)
+    bits = jnp.where(any_nan, _I64(0x7FF8000000000000), bits)
+    return bits
+
+
+def f64_bits_to_f32_bits(bits) -> jnp.ndarray:
+    """(float)d on bit patterns: binary64 -> binary32 with RNE, subnormal
+    flushing, and overflow to inf (C cast semantics)."""
+    sign, e_unb, mant, is_zero, is_inf, is_nan = _decompose(bits)
+    s32 = sign.astype(jnp.int32)
+
+    # f32: 24-bit mantissa, bias 127, exponent field in [1, 254] for normals.
+    # 53 -> 24 bits is a right shift of 29 (+ subnormal shift); keep two of
+    # those bits as guard+round for _rne and fold the rest into sticky.
+    e_b = e_unb + 127
+    sub_shift = jnp.clip(1 - e_b, 0, 34)
+    kept, lost = _shr_sticky(mant, jnp.int32(27) + sub_shift)
+    mant24 = _rne(kept, lost)
+    e_b = jnp.where(sub_shift > 0, 1, e_b)
+
+    ovf = mant24 >= (_U64(1) << _U64(24))
+    mant24 = jnp.where(ovf, mant24 >> _U64(1), mant24)
+    e_b = e_b + ovf.astype(jnp.int32)
+
+    is_sub = mant24 < (_U64(1) << _U64(23))
+    exp_field = jnp.where(is_sub, 0, e_b).astype(jnp.int32)
+    inf = e_b >= 255
+    out = (s32 << 31) | jnp.where(
+        inf, jnp.int32(0x7F800000),
+        (exp_field << 23) | (mant24 & _U64(0x7FFFFF)).astype(jnp.int32),
+    )
+    out = jnp.where(mant24 == 0, s32 << 31, out)
+    out = jnp.where(is_zero, s32 << 31, out)
+    out = jnp.where(is_inf, (s32 << 31) | jnp.int32(0x7F800000), out)
+    out = jnp.where(is_nan, jnp.int32(0x7FC00000), out)
+    return out

@@ -1,0 +1,135 @@
+"""get_json_object slow tiers: fuzz vs oracle + backend equivalence.
+
+Split from test_get_json_object.py so each tier runs in its own interpreter:
+XLA:CPU segfaults sporadically once a process has compiled hundreds of
+modules, and the corpus + fuzz + equivalence tiers together cross that
+threshold (ci/run-tests.sh runs one process per test file).
+"""
+
+import random
+
+import pytest
+
+from spark_rapids_jni_tpu.columnar.column import strings_column
+from spark_rapids_jni_tpu.ops.get_json_object import get_json_object
+
+import json_oracle as jo
+
+from test_get_json_object import WC, idx, named, run
+
+
+# ----------------------------------------------------------------- fuzz ----
+
+def _rand_json(rng, depth=0):
+    r = rng.random()
+    if depth > 3 or r < 0.35:
+        return rng.choice([
+            "123", "-5", "0", "-0", "1.5", "2e3", "-0.25", "true", "false",
+            "null", "'s'", '"t"', '"a b"', "'q\\'x'", '"\\u0041\\u00e9"',
+            '"\\n\\t"', "1e999", "3.14159", "00", "01",  # invalid numbers too
+        ])
+    if r < 0.6:
+        k = rng.randint(0, 3)
+        items = ",".join(_rand_json(rng, depth + 1) for _ in range(k))
+        return "[%s]" % items
+    k = rng.randint(0, 3)
+    names = ["a", "b", "k", "x y", "\\u0041"]
+    fields = ",".join(
+        '"%s":%s' % (rng.choice(names), _rand_json(rng, depth + 1))
+        for _ in range(k)
+    )
+    return "{%s}" % fields
+
+
+_FUZZ_PATHS = [
+    [],
+    [named("a")],
+    [named("a"), named("b")],
+    [idx(0)],
+    [idx(1)],
+    [WC],
+    [WC, WC],
+    [named("a"), WC],
+    [idx(0), WC],
+    [WC, named("k")],
+    [named("k"), idx(1), WC],
+]
+
+
+@pytest.mark.slow
+def test_device_eval_backend_corpus():
+    """The jitted lax.scan evaluator must match the host machine exactly."""
+    from spark_rapids_jni_tpu import config
+
+    rows = [
+        '{"k": "v"}', "{'k' : [0,1,2]}", "[ [0], [10, 11, 12], [2] ]",
+        "[ [11, 12], [21, [221, [2221, [22221, 22222]]]], [31, 32] ]",
+        "[1, [21, 22], 3]", "[1]", "123", "'abc'", "bad", None, "",
+        '{"a":[{"b":1},{"b":2}]}', '{"a": 1.5e2, "b": -0}',
+        r"""'中国\"\'\\\/\b\f\n\r\t\b'""",
+    ]
+    paths = [[], [named("k")], [WC], [WC, WC], [idx(1)], [idx(1), WC],
+             [named("a"), WC, named("b")]]
+    for path in paths:
+        # pin the host pipeline off the device-render default so this
+        # actually compares the lax.scan machine against the host machine
+        with config.override(json_device_render=False):
+            host = run(rows, path)
+            with config.override(json_eval_device=True):
+                dev = run(rows, path)
+        assert dev == host, f"path={path}"
+
+
+@pytest.mark.slow
+def test_device_eval_backend_fuzz():
+    from spark_rapids_jni_tpu import config
+
+    rng = random.Random(7)
+    rows = [_rand_json(rng) for _ in range(120)]
+    for path in _FUZZ_PATHS[:6]:
+        want = [jo.get_json_object(s, path) for s in rows]
+        with config.override(json_device_render=False,
+                             json_eval_device=True):
+            got = run(rows, path)
+        assert got == want, f"path={path}"
+
+
+@pytest.mark.slow
+def test_fuzz_against_oracle():
+    from spark_rapids_jni_tpu import config
+
+    rng = random.Random(42)
+    n = config.get("json_fuzz_rows")
+    rows = [_rand_json(rng) for _ in range(n)]
+    # sprinkle malformed rows
+    for i in range(0, n, 17):
+        rows[i] = rows[i][:-1] if rows[i] else "{"
+    for path in _FUZZ_PATHS:
+        got = run(rows, path)
+        want = [jo.get_json_object(s, path) for s in rows]
+        bad = [(i, rows[i], got[i], want[i])
+               for i in range(n) if got[i] != want[i]]
+        assert not bad, f"path={path}: first mismatches {bad[:5]}"
+
+
+@pytest.mark.slow
+def test_device_render_equals_host_pipeline():
+    """The fully device-resident pipeline (json_device_render, the default)
+    must agree with the host numpy oracle pipeline row-for-row."""
+    from spark_rapids_jni_tpu import config
+
+    rng = random.Random(123)
+    # modest row count: this test compiles BOTH pipelines; keeping the
+    # bucket-geometry set small keeps the per-process XLA module count low
+    rows = [_rand_json(rng) for _ in range(60)]
+    rows += ['{"f": 1.5e300, "g": [2.5, -0.0, 1e-320, 3e400]}',
+             '{"inf": 123456789012345678901234567890.5}',
+             None, "", "   ", "[1,2", '{"a"']
+    col = strings_column(rows)
+    for path in ["$.f", "$.g[*]", "$.a.b"]:
+        with config.override(json_device_render=True):
+            dev = get_json_object(col, path).to_list()
+        with config.override(json_device_render=False):
+            host = get_json_object(col, path).to_list()
+        assert dev == host, (path, [
+            (r, d, h) for r, d, h in zip(rows, dev, host) if d != h][:5])

@@ -1,0 +1,127 @@
+"""softfloat (integer-only binary64) vs the host's exact IEEE float64.
+
+The CPU backend's numpy float64 IS correctly-rounded IEEE binary64, so
+every op is fuzzable bit-for-bit against the hardware result.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu.utils.softfloat import (
+    f64_div_bits,
+    f64_mul_bits,
+    u64_to_f64_bits,
+)
+
+
+def _bits(x: np.ndarray) -> np.ndarray:
+    return x.astype(np.float64).view(np.int64)
+
+
+def _rand_doubles(rng, n, include_special=True):
+    """Random finite doubles across the whole exponent range."""
+    mant = rng.randint(0, 1 << 52, n, dtype=np.int64)
+    exp = rng.randint(1, 2047, n, dtype=np.int64)  # normal
+    sign = rng.randint(0, 2, n, dtype=np.int64) << 63
+    bits = sign | (exp << 52) | mant
+    if include_special:
+        bits[: n // 8] = (bits[: n // 8] & ~(np.int64(0x7FF) << 52))  # subnormal
+        bits[n // 8: n // 8 + 4] = [0, np.int64(1) << 63,  # +-0
+                                    0x7FF0000000000000,
+                                    np.int64(-0x10000000000000)]  # +-inf
+    return bits.view(np.float64)
+
+
+def test_u64_to_f64_exact_and_rounded():
+    rng = np.random.RandomState(1)
+    xs = np.concatenate([
+        np.array([0, 1, 2, (1 << 53) - 1, 1 << 53, (1 << 53) + 1,
+                  (1 << 64) - 1, (1 << 63) + 1, 10**19], dtype=np.uint64),
+        rng.randint(0, 1 << 63, 4000).astype(np.uint64),
+        (rng.randint(0, 1 << 62, 1000).astype(np.uint64) << np.uint64(2))
+        + np.uint64(2),  # force halfway-ish patterns
+    ])
+    got = np.asarray(u64_to_f64_bits(jnp.asarray(xs)))
+    want = xs.astype(np.float64).view(np.int64)
+    bad = got != want
+    assert not bad.any(), (xs[bad][:5], got[bad][:5], want[bad][:5])
+
+
+def test_mul_matches_hardware():
+    rng = np.random.RandomState(2)
+    a = _rand_doubles(rng, 6000)
+    b = _rand_doubles(rng, 6000)
+    got = np.asarray(f64_mul_bits(jnp.asarray(_bits(a)), jnp.asarray(_bits(b))))
+    want = _bits(a * b)
+    nan = np.isnan(a * b)
+    got_f = np.asarray(got).view(np.float64)
+    ok = (got == want) | (nan & (got_f != got_f))
+    bad = ~ok
+    assert not bad.any(), list(zip(a[bad][:5], b[bad][:5], got[bad][:5], want[bad][:5]))
+
+
+def test_mul_subnormal_outputs():
+    rng = np.random.RandomState(3)
+    # products that land in/near the subnormal range
+    a = rng.uniform(1, 2, 3000) * 2.0 ** rng.randint(-540, -500, 3000)
+    b = rng.uniform(1, 2, 3000) * 2.0 ** rng.randint(-540, -500, 3000)
+    got = np.asarray(f64_mul_bits(jnp.asarray(_bits(a)), jnp.asarray(_bits(b))))
+    want = _bits(a * b)
+    assert (got == want).all()
+
+
+def test_div_matches_hardware():
+    rng = np.random.RandomState(4)
+    a = _rand_doubles(rng, 5000, include_special=False)
+    b = _rand_doubles(rng, 5000, include_special=False)
+    got = np.asarray(f64_div_bits(jnp.asarray(_bits(a)), jnp.asarray(_bits(b))))
+    want = _bits(a / b)
+    bad = got != want
+    assert not bad.any(), list(zip(a[bad][:5], b[bad][:5], got[bad][:5], want[bad][:5]))
+
+
+def test_div_pow10_table_domain():
+    """The exact shapes string_to_float uses: digits / 10^k and * 10^k."""
+    rng = np.random.RandomState(5)
+    digits = rng.randint(1, 1 << 63, 4000).astype(np.uint64)
+    k = rng.randint(0, 309, 4000)
+    p10 = (10.0 ** k.astype(np.float64))
+    d_bits = np.asarray(u64_to_f64_bits(jnp.asarray(digits)))
+    d = d_bits.view(np.float64)
+    got_mul = np.asarray(f64_mul_bits(jnp.asarray(d_bits), jnp.asarray(_bits(p10))))
+    got_div = np.asarray(f64_div_bits(jnp.asarray(d_bits), jnp.asarray(_bits(p10))))
+    assert (got_mul == _bits(d * p10)).all()
+    assert (got_div == _bits(d / p10)).all()
+
+
+def test_div_and_mul_special_cases():
+    cases = [
+        (0.0, 5.0), (-0.0, 5.0), (5.0, np.inf), (np.inf, 5.0),
+        (1.0, 3.0), (2.0, 3.0), (1e300, 1e-300), (1e-300, 1e300),
+        (np.float64(5e-324), 2.0), (1.5, np.float64(5e-324)),
+    ]
+    a = np.array([c[0] for c in cases])
+    b = np.array([c[1] for c in cases])
+    gm = np.asarray(f64_mul_bits(jnp.asarray(_bits(a)), jnp.asarray(_bits(b))))
+    gd = np.asarray(f64_div_bits(jnp.asarray(_bits(a)), jnp.asarray(_bits(b))))
+    assert (gm == _bits(a * b)).all(), (gm, _bits(a * b))
+    assert (gd == _bits(a / b)).all(), (gd, _bits(a / b))
+
+
+def test_f64_to_f32_cast():
+    from spark_rapids_jni_tpu.utils.softfloat import f64_bits_to_f32_bits
+
+    rng = np.random.RandomState(6)
+    xs = np.concatenate([
+        _rand_doubles(rng, 4000),
+        rng.uniform(-1, 1, 1000) * 2.0 ** rng.randint(-160, -120, 1000),  # f32-subnormal range
+        np.array([0.0, -0.0, np.inf, -np.inf, 1e39, -1e39, 3.4028236e38,
+                  1.1754944e-38, 1.4e-45, 7e-46]),
+    ])
+    got = np.asarray(f64_bits_to_f32_bits(jnp.asarray(_bits(xs))))
+    with np.errstate(over="ignore"):
+        want = xs.astype(np.float32).view(np.int32)
+    nan = np.isnan(xs)
+    ok = (got == want) | nan
+    assert ok.all(), list(zip(xs[~ok][:5], got[~ok][:5], want[~ok][:5]))
