@@ -275,6 +275,45 @@ def main():
     _stage(detail, "q97_join_count", _q97,
            nbytes=min(n, 1 << 22) * 4 * 4 * 4)
 
+    def _json():
+        from spark_rapids_jni_tpu.columnar.column import strings_from_bytes
+        from spark_rapids_jni_tpu.ops import get_json_object
+
+        nj = min(n, 1 << 18)
+        rows = [
+            b'{"store": {"fruit": [{"weight": %d, "type": "apple"}, '
+            b'{"weight": %d}], "book": "b%d"}, "k%d": %d.5}'
+            % (i % 9, i % 7, i % 100, i % 3, i)
+            for i in range(nj)
+        ]
+        jcol = strings_from_bytes(rows)
+        total_bytes = int(jcol.chars.shape[0])
+
+        def run_path():
+            return get_json_object(jcol, "$.store.fruit[*].weight").chars
+
+        dt = _time(run_path, max(iters // 8, 2))
+        return {"Mrows_per_s": round(nj / dt / 1e6, 2),
+                "GBps": round(total_bytes / dt / 1e9, 3),
+                "roofline_frac": _frac(total_bytes / dt)}
+
+    _stage(detail, "get_json_object", _json,
+           nbytes=min(n, 1 << 18) * 110 * 30)
+
+    def _q5():
+        from spark_rapids_jni_tpu.models import generate_q5_data, q5_local
+
+        sf = min(1.0, max(0.05, n / (1 << 24)))
+        data = generate_q5_data(sf=sf, seed=42)
+        rows_total = sum(
+            len(data.channels[c].sales_sk) + len(data.channels[c].ret_sk)
+            for c in data.channels)
+        dt = _time(lambda: tuple(q5_local(data)), max(iters // 8, 2))
+        return {"Mrows_per_s": round(rows_total / dt / 1e6, 2),
+                "fact_rows": rows_total}
+
+    _stage(detail, "q5_rollup", _q5, nbytes=int(min(n, 1 << 22) * 8))
+
     gov.task_done(0)
     MemoryGovernor.shutdown()
 

@@ -273,3 +273,15 @@ def test_q97_split_batch_is_exact_partition():
             assert side.setdefault((int(c), int(i)), s) == s
         for c, i in zip(piece.c_cust, piece.c_item):
             assert side.setdefault((int(c), int(i)), s) == s
+
+
+@pytest.mark.slow
+def test_q97_monte_carlo_mode():
+    """The monte-carlo q97 workload: concurrent governed queries under a
+    shared tight budget complete exactly with no leaks and no blocked
+    threads (the VERDICT r2 'governed execution under chaos' criterion)."""
+    from spark_rapids_jni_tpu.mem.montecarlo import run_q97_monte_carlo
+
+    stats = run_q97_monte_carlo(n_tasks=3, budget_frac=0.6, seed=1)
+    assert stats.tasks_completed == 3
+    assert stats.ok, stats.failures

@@ -1,0 +1,108 @@
+"""Per-op TPU perf probe: size sweeps + dispatch-overhead isolation.
+
+BENCH_r01 measured murmur3-32 at ~11% of the HBM roofline; this tool
+separates the candidate causes so BENCH_r03's analysis is grounded:
+
+- **size sweep**: throughput vs n isolates fixed dispatch overhead (axon
+  remote dispatch is ~50-100us/call; at n=2^24 & 20 iters that's real).
+- **fusion check**: hash-of-copy vs copy-only shows whether the hash chain
+  itself (pure u32 lane ops) or the memory system bounds the kernel.
+- **donation**: buffer-donated variant removes the output-allocation cost.
+
+Run on the real chip (prints one JSON line per experiment):
+
+    python tools/perf_probe.py [--op murmur3|xxhash64|copy] [--iters 50]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _time(fn, iters, *args):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--op", default="murmur3",
+                    choices=("murmur3", "xxhash64", "copy"))
+    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--max-log2", type=int, default=26)
+    args = ap.parse_args(argv)
+
+    from __graft_entry__ import probe_ambient
+
+    usable, reason = probe_ambient(1, timeout=180)
+    if not usable:
+        print(json.dumps({"error": f"device unusable: {reason}"}))
+        return 1
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_rapids_jni_tpu.columnar import Column, INT32
+    from spark_rapids_jni_tpu.ops import murmur_hash32, xxhash64
+
+    rng = np.random.RandomState(7)
+    results = []
+    for log2 in range(18, args.max_log2 + 1, 2):
+        n = 1 << log2
+        data = jnp.asarray(rng.randint(-(2**31), 2**31, n).astype(np.int32))
+
+        if args.op == "murmur3":
+            fn = jax.jit(lambda d: murmur_hash32(
+                [Column(d, None, INT32)], seed=42).data)
+            bytes_per_row = 8
+        elif args.op == "xxhash64":
+            fn = jax.jit(lambda d: xxhash64(
+                [Column(d, None, INT32)], seed=42).data)
+            bytes_per_row = 12
+        else:
+            fn = jax.jit(lambda d: d + 1)
+            bytes_per_row = 8
+
+        dt = _time(fn, args.iters, data)
+        dt_donated = _time(
+            jax.jit((lambda d: murmur_hash32(
+                [Column(d, None, INT32)], seed=42).data)
+                if args.op == "murmur3" else (lambda d: d + 1),
+                donate_argnums=0),
+            args.iters, jnp.array(data))
+        results.append({
+            "n_log2": log2,
+            "rows_per_s": round(n / dt, 0),
+            "GBps": round(n * bytes_per_row / dt / 1e9, 2),
+            "GBps_donated": round(n * bytes_per_row / dt_donated / 1e9, 2),
+            "us_per_call": round(dt * 1e6, 1),
+        })
+        print(json.dumps(results[-1]), flush=True)
+
+    # fixed overhead estimate: extrapolate us/call to n->0 from two sizes
+    if len(results) >= 2:
+        a, b = results[0], results[-1]
+        na, nb = 1 << a["n_log2"], 1 << b["n_log2"]
+        per_row = (b["us_per_call"] - a["us_per_call"]) / (nb - na)
+        fixed = a["us_per_call"] - per_row * na
+        print(json.dumps({"fixed_overhead_us": round(fixed, 1),
+                          "ns_per_row_marginal": round(per_row * 1e3, 4)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
