@@ -63,13 +63,18 @@ class MemoryGovernor:
     def instance(cls) -> "MemoryGovernor":
         return cls.initialize()
 
+    def close(self):
+        """Stop the watchdog and release the native arbiter (instance-level
+        teardown; `shutdown()` applies it to the singleton)."""
+        self._shutdown.set()
+        self._watchdog.join(timeout=2)
+        self.arbiter.close()
+
     @classmethod
     def shutdown(cls):
         with cls._lock:
             if cls._instance is not None:
-                cls._instance._shutdown.set()
-                cls._instance._watchdog.join(timeout=2)
-                cls._instance.arbiter.close()
+                cls._instance.close()
                 cls._instance = None
 
     def _watch(self, period_s: float):

@@ -232,6 +232,7 @@ def run_q97_monte_carlo(n_tasks: int = 6, budget_frac: float = 0.6,
 
     from spark_rapids_jni_tpu.models.q97 import (
         Q97Batch,
+        q97_host_oracle,
         q97_working_set_bytes,
         run_distributed_q97,
     )
@@ -260,17 +261,12 @@ def run_q97_monte_carlo(n_tasks: int = 6, budget_frac: float = 0.6,
             for s, c in batches)
         budget = BudgetedResource(gov, int(full * budget_frac))
 
-        def oracle(store, catalog):
-            s = set(zip(store[0].tolist(), store[1].tolist()))
-            c = set(zip(catalog[0].tolist(), catalog[1].tolist()))
-            return len(s - c), len(c - s), len(s & c)
-
         def task(tid, store, catalog):
             out = run_distributed_q97(
                 mesh, store, catalog, budget=budget, task_id=tid,
                 capacity=64)
             if (out.store_only, out.catalog_only, out.both) != \
-                    oracle(store, catalog):
+                    q97_host_oracle(store, catalog):
                 with stats_lock:
                     stats.failures.append(f"task {tid}: wrong q97 result")
             with stats_lock:

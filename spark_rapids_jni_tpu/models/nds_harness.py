@@ -1,0 +1,108 @@
+"""NDS end-to-end harness: governed q5 + q97 over TPC-DS-shaped data.
+
+BASELINE config 5 is "NDS TPC-DS q5+q97 end-to-end"; this CLI is the
+framework-native harness for it: generate tables at a scale factor, run
+both queries distributed + governed (every launch admitted through the
+memory arbiter), verify against host oracles, and report wall-clock.
+
+    python -m spark_rapids_jni_tpu.models.nds_harness --sf 0.1 --ndev 8
+
+Prints one JSON line: per-query wall-clock, rows processed, verification
+status.  On a single-device platform it builds a virtual mesh over the
+available devices (ndev capped to the device count).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _q97_tables(sf: float, seed: int):
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    n = max(1000, int(2_800_000 * sf))  # ~SF-proportional pair stream
+    store = (rng.randint(1, max(2, n // 14), n).astype(np.int32),
+             rng.randint(1, 18_000, n).astype(np.int32))
+    catalog = (rng.randint(1, max(2, n // 14), n).astype(np.int32),
+               rng.randint(1, 18_000, n).astype(np.int32))
+    return store, catalog
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="NDS q5+q97 end-to-end harness")
+    ap.add_argument("--sf", type=float, default=0.05)
+    ap.add_argument("--ndev", type=int, default=0, help="0 = all devices")
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--verify", action="store_true",
+                    help="check results against host oracles (slow at big sf)")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from spark_rapids_jni_tpu.mem import BudgetedResource, MemoryGovernor
+    from spark_rapids_jni_tpu.models import (
+        generate_q5_data,
+        q5_local,
+        run_distributed_q5,
+        run_distributed_q97,
+    )
+    from spark_rapids_jni_tpu.parallel import make_mesh
+
+    ndev = args.ndev or len(jax.devices())
+    ndev = min(ndev, len(jax.devices()))
+    mesh = make_mesh((ndev, 1), devices=jax.devices()[:ndev])
+    gov = MemoryGovernor.initialize()
+    budget = BudgetedResource(gov, 8 << 30)
+    out = {"sf": args.sf, "ndev": ndev, "queries": {}}
+
+    try:
+        data = generate_q5_data(sf=args.sf, seed=args.seed)
+        q5_rows_total = sum(
+            len(ch.sales_sk) + len(ch.ret_sk) for ch in data.channels.values())
+        t0 = time.perf_counter()
+        q5_rows = run_distributed_q5(mesh, data, budget=budget, task_id=1)
+        q5_dt = time.perf_counter() - t0
+        q5_ok = (q5_rows == q5_local(data)) if args.verify else None
+        out["queries"]["q5"] = {
+            "wall_s": round(q5_dt, 3),
+            "fact_rows": q5_rows_total,
+            "Mrows_per_s": round(q5_rows_total / q5_dt / 1e6, 2),
+            "result_rows": len(q5_rows),
+            "verified": q5_ok,
+        }
+
+        store, catalog = _q97_tables(args.sf, args.seed)
+        nq = len(store[0]) + len(catalog[0])
+        t0 = time.perf_counter()
+        q97 = run_distributed_q97(mesh, store, catalog, budget=budget,
+                                  task_id=2)
+        q97_dt = time.perf_counter() - t0
+        q97_ok = None
+        if args.verify:
+            from spark_rapids_jni_tpu.models.q97 import q97_host_oracle
+
+            q97_ok = (q97.store_only, q97.catalog_only,
+                      q97.both) == q97_host_oracle(store, catalog)
+        out["queries"]["q97"] = {
+            "wall_s": round(q97_dt, 3),
+            "fact_rows": nq,
+            "Mrows_per_s": round(nq / q97_dt / 1e6, 2),
+            "counts": [int(q97.store_only), int(q97.catalog_only),
+                       int(q97.both)],
+            "verified": q97_ok,
+        }
+        out["total_wall_s"] = round(q5_dt + q97_dt, 3)
+    finally:
+        MemoryGovernor.shutdown()
+
+    print(json.dumps(out))
+    failed = any(q.get("verified") is False for q in out["queries"].values())
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -99,6 +99,15 @@ def _count_runs(keys: jnp.ndarray, is_store: jnp.ndarray, valid: jnp.ndarray):
     return store_only, cat_only, both
 
 
+def q97_host_oracle(store, catalog):
+    """(store_only, catalog_only, both) via host sets — the reference
+    semantics both the NDS harness and the monte-carlo workload verify
+    against (non-null keys)."""
+    s = set(zip(store[0].tolist(), store[1].tolist()))
+    c = set(zip(catalog[0].tolist(), catalog[1].tolist()))
+    return len(s - c), len(c - s), len(s & c)
+
+
 def q97_local(store: tuple, catalog: tuple) -> Q97Out:
     """Single-chip q97 core over (customer_sk, item_sk) int arrays."""
     sk = _composite_key(*store)

@@ -1,3 +1,8 @@
+from spark_rapids_jni_tpu.parallel.multihost import (
+    initialize as initialize_multihost,
+    is_multihost,
+    make_pod_mesh,
+)
 from spark_rapids_jni_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -31,6 +36,9 @@ __all__ = [
     "ShuffledTable",
     "all_to_all_shuffle",
     "bucket_by_partition",
+    "initialize_multihost",
+    "is_multihost",
+    "make_pod_mesh",
     "materialize_strings",
     "pad_strings",
     "shuffle_table",

@@ -19,6 +19,10 @@ from spark_rapids_jni_tpu.ops.get_json_object import (
 
 import json_oracle as jo
 
+# compile-bound on a cold machine (~10 min of XLA variants): slow tier.
+# JSON quick coverage comes from test_from_json (the shared tokenizer).
+pytestmark = pytest.mark.slow
+
 
 def named(n):
     return (NAMED, n.encode() if isinstance(n, str) else n)

@@ -34,9 +34,7 @@ from spark_rapids_jni_tpu.parallel import make_mesh
 def gov():
     g = MemoryGovernor(watchdog_period_s=0.02)
     yield g
-    g._shutdown.set()
-    g._watchdog.join(timeout=2)
-    g.arbiter.close()
+    g.close()
 
 
 def _mesh(ndev=8):

@@ -234,9 +234,7 @@ def test_profiler_real_pipeline_capture(tmp_path):
         budget = BudgetedResource(gov, 1 << 30)
         run_distributed_q97(mesh, store, catalog, budget=budget, task_id=1)
     finally:
-        gov._shutdown.set()
-        gov._watchdog.join(timeout=2)
-        gov.arbiter.close()
+        gov.close()
         Profiler.stop()
         Profiler.shutdown()
 

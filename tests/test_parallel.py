@@ -109,3 +109,26 @@ def test_distributed_matches_single_chip_totals():
     expected = jax.ops.segment_sum(values, bucket, num_segments=cfg.n_buckets)
     got = out.bucket_sums.reshape(8, cfg.n_buckets).sum(axis=0)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(expected))
+
+
+def test_multihost_single_process_noop_and_pod_mesh():
+    """initialize() is a no-op single-process; make_pod_mesh falls back to a
+    flat (data, model) mesh when no slice topology exists (CPU mesh)."""
+    import jax
+
+    from spark_rapids_jni_tpu.parallel import (
+        initialize_multihost,
+        is_multihost,
+        make_pod_mesh,
+    )
+
+    initialize_multihost()  # must not raise or require a coordinator
+    assert not is_multihost()
+    mesh = make_pod_mesh(mp=2)
+    n = len(jax.devices())
+    assert mesh.shape["data"] == n // 2 and mesh.shape["model"] == 2
+    summary_keys = {"process_index", "process_count",
+                    "local_devices", "global_devices"}
+    from spark_rapids_jni_tpu.parallel.multihost import process_summary
+
+    assert set(process_summary()) == summary_keys

@@ -90,9 +90,7 @@ def test_q5_distributed_matches_local_and_oracle():
         assert got == _oracle(data)
         assert got == [tuple(r) for r in q5_local(data)]
     finally:
-        gov._shutdown.set()
-        gov._watchdog.join(timeout=2)
-        gov.arbiter.close()
+        gov.close()
 
 
 def test_q5_distributed_split_retry_exact():
@@ -114,6 +112,4 @@ def test_q5_distributed_split_retry_exact():
         assert got == _oracle(data)
         assert splits >= 1
     finally:
-        gov._shutdown.set()
-        gov._watchdog.join(timeout=2)
-        gov.arbiter.close()
+        gov.close()

@@ -78,17 +78,10 @@ def main(argv=None) -> int:
             bytes_per_row = 8
 
         dt = _time(fn, args.iters, data)
-        dt_donated = _time(
-            jax.jit((lambda d: murmur_hash32(
-                [Column(d, None, INT32)], seed=42).data)
-                if args.op == "murmur3" else (lambda d: d + 1),
-                donate_argnums=0),
-            args.iters, jnp.array(data))
         results.append({
             "n_log2": log2,
             "rows_per_s": round(n / dt, 0),
             "GBps": round(n * bytes_per_row / dt / 1e9, 2),
-            "GBps_donated": round(n * bytes_per_row / dt_donated / 1e9, 2),
             "us_per_call": round(dt * 1e6, 1),
         })
         print(json.dumps(results[-1]), flush=True)
