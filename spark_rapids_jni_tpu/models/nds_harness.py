@@ -41,6 +41,12 @@ def main(argv=None) -> int:
                     help="check results against host oracles (slow at big sf)")
     args = ap.parse_args(argv)
 
+    # join the process group BEFORE the backend is touched: on a multi-host
+    # pod the harness must span every host's devices, not run per-host
+    from spark_rapids_jni_tpu.parallel import initialize_multihost
+
+    initialize_multihost()
+
     import jax
 
     from spark_rapids_jni_tpu.mem import BudgetedResource, MemoryGovernor
@@ -50,11 +56,14 @@ def main(argv=None) -> int:
         run_distributed_q5,
         run_distributed_q97,
     )
-    from spark_rapids_jni_tpu.parallel import make_mesh
+    from spark_rapids_jni_tpu.parallel import make_mesh, make_pod_mesh
 
-    ndev = args.ndev or len(jax.devices())
-    ndev = min(ndev, len(jax.devices()))
-    mesh = make_mesh((ndev, 1), devices=jax.devices()[:ndev])
+    if args.ndev in (0, len(jax.devices())):
+        mesh = make_pod_mesh(mp=1)  # DCN-aware layout over all devices
+        ndev = len(jax.devices())
+    else:  # explicit subset: single-host experimentation path
+        ndev = min(args.ndev, len(jax.devices()))
+        mesh = make_mesh((ndev, 1), devices=jax.devices()[:ndev])
     gov = MemoryGovernor.initialize()
     budget = BudgetedResource(gov, 8 << 30)
     out = {"sf": args.sf, "ndev": ndev, "queries": {}}

@@ -192,19 +192,22 @@ def _string_to_integer_kernel(
     return jnp.where(valid, val, jnp.int64(0)), valid
 
 
-def _raise_if_ansi_error(col: StringColumn, valid_out: np.ndarray):
+def _raise_if_ansi_error(col: StringColumn, valid_out):
     """Mirror validate_ansi_column (cast_string.cu:602-635): first row that was
-    non-null on input but null on output raises CastException."""
-    valid_in = np.asarray(col.is_valid())
-    errors = valid_in & ~valid_out
-    if errors.any():
-        row = int(np.argmax(errors))
-        chars = np.asarray(col.chars)
-        offs = np.asarray(col.offsets)
-        s = bytes(chars[offs[row] : offs[row + 1]]).decode(
-            "utf-8", errors="surrogatepass"
-        )
-        raise CastException(s, row)
+    non-null on input but null on output raises CastException.
+
+    The error decision is one scalar sync; row bytes are pulled only on the
+    (exceptional) throw path."""
+    errors = col.is_valid() & ~jnp.asarray(valid_out)
+    if not bool(jnp.any(errors)):
+        return
+    row = int(jnp.argmax(errors))
+    chars = np.asarray(col.chars)
+    offs = np.asarray(col.offsets)
+    s = bytes(chars[offs[row] : offs[row + 1]]).decode(
+        "utf-8", errors="surrogatepass"
+    )
+    raise CastException(s, row)
 
 
 def string_to_integer(
@@ -236,7 +239,7 @@ def string_to_integer(
     )
     if ansi_mode:
         # the only host sync on the cast path, and only in ANSI mode
-        _raise_if_ansi_error(col, np.asarray(valid))
+        _raise_if_ansi_error(col, valid)
     return Column(val.astype(dtype.jnp_dtype), valid, dtype)
 
 
@@ -613,7 +616,7 @@ def string_to_decimal(
         row_args=[col.is_valid()],
     )
     if ansi_mode:
-        _raise_if_ansi_error(col, np.asarray(valid))
+        _raise_if_ansi_error(col, valid)
     if dtype.kind == Kind.DECIMAL128:
         return Decimal128Column(vh, vl, valid, dtype)
     return Column(vl.astype(jnp.int64).astype(dtype.jnp_dtype), valid, dtype)

@@ -23,6 +23,7 @@ multi-process clusters and tests.
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -32,6 +33,17 @@ from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 __all__ = ["initialize", "is_multihost", "make_pod_mesh", "process_summary"]
 
 _initialized = False
+
+# env markers that indicate a multi-process cluster runtime is present; used
+# to decide whether an "initialize too late" condition is fatal or benign
+_CLUSTER_ENV_MARKERS = (
+    "TPU_WORKER_HOSTNAMES",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+    "JAX_COORDINATOR_ADDRESS",
+    "COORDINATOR_ADDRESS",
+    "SLURM_JOB_ID",
+    "OMPI_MCA_orte_hnp_uri",
+)
 
 
 def initialize(
@@ -50,16 +62,30 @@ def initialize(
     global _initialized
     if _initialized:
         return
+    explicit = any(a is not None for a in
+                   (coordinator_address, num_processes, process_id))
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
-    except Exception:
-        if coordinator_address is not None or num_processes is not None:
-            raise  # explicitly-configured cluster must not silently degrade
-        # no cluster environment detected: single-process no-op
+    except ValueError:
+        # jax raises ValueError specifically when no cluster environment
+        # could be auto-detected; anything else (e.g. an unreachable
+        # coordinator) must propagate rather than silently degrade.
+        if explicit:
+            raise
+        return  # single-process; not latched, a later explicit call works
+    except RuntimeError:
+        # "must be called before any JAX calls": the backend is already up
+        # (module-level device constants initialize it under `python -m`).
+        # Benign for plain single-process use; FATAL when a cluster runtime
+        # is present — degrading there would compute per-host partial
+        # results silently.
+        if explicit or any(m in os.environ for m in _CLUSTER_ENV_MARKERS):
+            raise
+        return
     _initialized = True
 
 

@@ -172,3 +172,38 @@ def test_string_column_rejected_without_padding():
     scol = strings_column(["a", "bb"])
     with pytest.raises(TypeError, match="PaddedStrings"):
         shuffle_table({"s": scol}, jnp.zeros(2, jnp.int32), 2)
+
+
+def test_capacity_overflow_reports_dropped_and_recovers():
+    """Skewed keys overflow a small capacity (dropped > 0, the shuffle-spill
+    signal the governed runners grow on); a doubled capacity recovers all
+    rows — the grow-retry contract for real tables."""
+    rng = np.random.RandomState(9)
+    n = 16 * NDEV
+    # heavy skew: most rows hash to one destination
+    keys_np = np.where(rng.rand(n) < 0.8, 3, rng.randint(0, 1000, n))
+    keys_np = keys_np.astype(np.int32)
+    strs = ["s%d" % v for v in range(n)]
+
+    keys = column([int(k) for k in keys_np], INT32)
+    scol = strings_column(strs)
+    width = max(scol.max_len(), 1)
+    mesh = _mesh()
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    put = functools.partial(jax.device_put, device=sharding)
+    sbytes, slens = scol.padded(width)
+
+    def counts(capacity):
+        fn = _shuffle_fn(mesh, capacity, width)
+        cols, valid, dropped = fn(
+            jax.tree.map(put, keys), jax.tree.map(put, keys),
+            jax.tree.map(put, decimal128_column([0] * n, 38, 2)),
+            put(sbytes), put(slens), put(scol.is_valid()),
+        )
+        return int(np.asarray(dropped)), int(np.asarray(valid).sum())
+
+    small_dropped, small_received = counts(2)
+    assert small_dropped > 0
+    assert small_received == n - small_dropped
+    big_dropped, big_received = counts(n)
+    assert big_dropped == 0 and big_received == n

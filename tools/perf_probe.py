@@ -7,7 +7,6 @@ separates the candidate causes so BENCH_r03's analysis is grounded:
   remote dispatch is ~50-100us/call; at n=2^24 & 20 iters that's real).
 - **fusion check**: hash-of-copy vs copy-only shows whether the hash chain
   itself (pure u32 lane ops) or the memory system bounds the kernel.
-- **donation**: buffer-donated variant removes the output-allocation cost.
 
 Run on the real chip (prints one JSON line per experiment):
 
