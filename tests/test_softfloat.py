@@ -125,3 +125,51 @@ def test_f64_to_f32_cast():
     nan = np.isnan(xs)
     ok = (got == want) | nan
     assert ok.all(), list(zip(xs[~ok][:5], got[~ok][:5], want[~ok][:5]))
+
+
+def test_explicit_rounding_boundaries():
+    """Documented boundary vectors: exact halfway cases, the overflow
+    threshold, and ties at the subnormal floor — the contract corners the
+    random fuzz may never hit."""
+    from spark_rapids_jni_tpu.utils.softfloat import f64_bits_to_f32_bits
+
+    # u64 -> f64 halfway: 2^53+1 is exactly halfway between representables;
+    # RNE picks the even mantissa (2^53).
+    xs = np.array([(1 << 53) + 1, (1 << 53) + 2, (1 << 53) + 3],
+                  dtype=np.uint64)
+    got = np.asarray(u64_to_f64_bits(jnp.asarray(xs)))
+    assert (got == xs.astype(np.float64).view(np.int64)).all()
+
+    # multiply across the overflow threshold: DBL_MAX stays finite, the next
+    # step of the product rounds to inf
+    dmax = np.float64(1.7976931348623157e308)
+    a = np.array([dmax, dmax])
+    b = np.array([1.0, np.nextafter(np.float64(1.0), 2.0)])
+    gm = np.asarray(f64_mul_bits(jnp.asarray(_bits(a)), jnp.asarray(_bits(b))))
+    with np.errstate(over="ignore"):
+        assert (gm == _bits(a * b)).all()
+    assert np.isinf(gm.view(np.float64)[1])
+
+    # ties at the subnormal floor, constructed as PRODUCTS (2^-1075 is not
+    # itself representable): 2^-537 * 2^-538 = 2^-1075, exactly halfway
+    # between 0 and the min subnormal — RNE resolves to 0 (even).
+    # 1.5*2^-537 * 2^-538 = 1.5*2^-1075 rounds up to 5e-324.
+    tiny_a = np.array([2.0**-537, 1.5 * 2.0**-537, 2.0**-536])
+    tiny_b = np.array([2.0**-538, 2.0**-538, 2.0**-538])
+    gd = np.asarray(f64_mul_bits(jnp.asarray(_bits(tiny_a)),
+                                 jnp.asarray(_bits(tiny_b))))
+    assert (gd == _bits(tiny_a * tiny_b)).all()
+    assert gd.view(np.float64)[0] == 0.0
+    assert gd.view(np.float64)[1] == 5e-324
+    assert gd.view(np.float64)[2] == 5e-324  # 2^-1074 exactly
+
+    # f64 -> f32 at the float32 overflow boundary: the largest double that
+    # rounds to FLT_MAX vs the first that rounds to inf
+    f32max = np.float64(3.4028234663852886e38)
+    boundary = np.float64(3.4028235677973366e38)  # halfway to 2^128
+    xs2 = np.array([f32max, np.nextafter(boundary, 0), boundary])
+    g32 = np.asarray(f64_bits_to_f32_bits(jnp.asarray(_bits(xs2))))
+    with np.errstate(over="ignore"):
+        want32 = xs2.astype(np.float32).view(np.int32)
+    assert (g32 == want32).all()
+    assert np.isinf(g32.view(np.float32)[2])
