@@ -207,3 +207,60 @@ def test_capacity_overflow_reports_dropped_and_recovers():
     assert small_received == n - small_dropped
     big_dropped, big_received = counts(n)
     assert big_dropped == 0 and big_received == n
+
+
+def test_jcudf_row_bytes_ride_the_exchange():
+    """SURVEY §7.8's original plan — 'all_to_all of serialized row batches,
+    reuses the row conversion' (row_conversion.cu:574 exists to serialize
+    rows for exchange): JCUDF fixed-width rows are a [n, row_bytes] byte
+    rectangle, which the shuffle moves like any fixed-width column; the
+    receiver deserializes back to columns, nulls intact."""
+    from spark_rapids_jni_tpu.columnar.column import ListColumn
+    from spark_rapids_jni_tpu.columnar.dtypes import INT64, FLOAT64
+    from spark_rapids_jni_tpu.ops.row_conversion import (
+        convert_from_rows,
+        convert_to_rows,
+    )
+
+    rng = np.random.RandomState(4)
+    n = 16 * NDEV
+    keys_np = rng.randint(0, 100, n).astype(np.int64)
+    vals = [None if rng.rand() < 0.25 else float(v)
+            for v in rng.rand(n).round(6)]
+
+    key_col = column([int(k) for k in keys_np], INT64)
+    val_col = column(vals, FLOAT64)
+    [rows_col] = convert_to_rows([key_col, val_col])
+    offs = np.asarray(rows_col.offsets)
+    row_bytes = int(offs[1] - offs[0])
+    rect = jnp.reshape(rows_col.child.data, (n, row_bytes))
+
+    mesh = _mesh()
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+
+    def body(rows_rect, part):
+        from spark_rapids_jni_tpu.parallel import all_to_all_shuffle
+
+        ex = all_to_all_shuffle({"r": rows_rect}, part, n, axis=DATA_AXIS)
+        return ex.columns["r"], ex.valid
+
+    fn = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS)), check_vma=False))
+    part = (keys_np % NDEV).astype(np.int32)
+    recv, valid = fn(jax.device_put(rect, sharding),
+                     jax.device_put(part, sharding))
+    valid_np = np.asarray(valid)
+
+    # compact received rows and deserialize through the same JCUDF layout
+    got_rows = np.asarray(recv)[valid_np]
+    m = got_rows.shape[0]
+    assert m == n
+    flat = jnp.asarray(got_rows.reshape(-1))
+    offsets = jnp.arange(0, (m + 1) * row_bytes, row_bytes, dtype=jnp.int32)
+    back = convert_from_rows(
+        ListColumn(offsets, Column(flat, None, rows_col.child.dtype), None),
+        [INT64, FLOAT64])
+    got = sorted(zip(back[0].to_list(), back[1].to_list()), key=repr)
+    want = sorted(zip(keys_np.tolist(), vals), key=repr)
+    assert got == want
