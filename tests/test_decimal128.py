@@ -238,3 +238,113 @@ class TestAddSub:
         b = decimal128_column([0, 0], 38, 2)
         ov, res = dec.add128(a, b, 1)
         assert res.unscaled_to_list() == [3, -3]
+
+
+# ---- round-3 transcriptions of the remaining DecimalUtilsTest vectors ----
+
+def _dstr(s):
+    """Java BigDecimal string -> (unscaled int, scale)."""
+    from decimal import Decimal
+
+    sign, digits, exp = Decimal(s).as_tuple()
+    unscaled = int("".join(map(str, digits))) * (-1 if sign else 1)
+    return unscaled, -exp
+
+
+def _dcol(strings, precision=38):
+    vals_scales = [_dstr(s) for s in strings]
+    scales = {sc for _, sc in vals_scales}
+    assert len(scales) == 1, f"mixed scales in column fixture: {scales}"
+    return decimal128_column([v for v, _ in vals_scales], precision,
+                             scales.pop())
+
+
+class TestReferenceVectors:
+    def test_remainder2(self):  # DecimalUtilsTest.remainder2
+        lhs = _dcol(["-80968577325845461854951721352418610.13",
+                     "-80968577325845461854951721352418610.13",
+                     "-66686472768705331734321352506496901.71"])
+        rhs = _dcol(["6749200345857154099505910298895800952.1",
+                     "-6749200345857154099505910298895800952.1",
+                     "-43880265997097383351377368851255372.5"])
+        expected = ["-80968577325845461854951721352418610.13",
+                    "-80968577325845461854951721352418610.13",
+                    "-22806206771607948382943983655241529.21"]
+        check(dec.remainder128(lhs, rhs, 2),
+              [(False, _dstr(e)[0]) for e in expected])
+
+    def test_remainder7(self):  # DecimalUtilsTest.remainder7
+        lhs = _dcol(["5776949384953805890688943467625198736"])
+        rhs = _dcol(["-67337920196996830.354487679299"])
+        check(dec.remainder128(lhs, rhs, 7),
+              [(False, _dstr("16310460742282291.8108019")[0])])
+
+    def test_remainder10(self):  # DecimalUtilsTest.remainder10
+        lhs = _dcol(["5776949384953805890688943467625198736"])
+        rhs = _dcol(["-6733792019699683035.4487679299"])
+        check(dec.remainder128(lhs, rhs, 10),
+              [(False, _dstr("3585222007130884413.9709383255")[0])])
+
+    def test_div21(self):  # DecimalUtilsTest.div21
+        lhs = _dcol(["60250054953505368.439892586764888491018",
+                     "91910085134512953.335347579448489062875",
+                     "51312633107598808.869351260608653423886"])
+        rhs = _dcol(["97982875273794447.385070145919990343867",
+                     "94478503341597285.814104936062234698349",
+                     "92266075543848323.800466593082956765923"])
+        expected = ["0.614904", "0.972815", "0.556138"]
+        check(dec.divide128(lhs, rhs, 6),
+              [(False, _dstr(e)[0]) for e in expected])
+
+    def test_add_precision38_scale10_overflow(self):
+        # DecimalUtilsTest.addPrecision38ScaleNeg10WithOverflow
+        lhs = _dcol(["9191008513307131620269245301.1615457290",
+                     "-9191008513307131620269245301.1615457290"])
+        rhs = _dcol(["9447850332473678680446404122.5624623187",
+                     "-9447850332473678680446404122.5624623187"])
+        check(dec.add128(lhs, rhs, 10), [(True, None), (True, None)])
+
+    def test_add_different_scales(self):  # DecimalUtilsTest.addDifferentScales
+        lhs = _dcol(["9191008513307131620269245301.1615457290",
+                     "-9191008513307131620269245301.1615457290",
+                     "577694938495380589068894346.7625198736",
+                     "-7949989536398283250841565918.6123449781",
+                     "-569260079419403643627836417.1451349695",
+                     "4268696962649098725873162852.3422176564",
+                     "948521076935839001259204571.1574829065",
+                     "-9299778357834801251892834048.0026057082",
+                     "8127384240098008972235509102.7063990819",
+                     "-1012433127481465711031073593.0625063701"])
+        rhs = _dcol(["451635271134476686911387864.48",
+                     "-9037370400215680718822505020.06",
+                     "-200173438757934601210092407.67",
+                     "3022290197578200820919308997.64",
+                     "388221337108432989001879408.73",
+                     "-9119163961520067341639997328.82",
+                     "7732813484881363300406806463.83",
+                     "5941454871287785414686091453.79",
+                     "-357209139972312354271434821.33",
+                     "-857448828702886587693936536.21"])
+        expected = ["9642643784441608307180633165.641545729",
+                    "-18228378913522812339091750321.221545729",
+                    "377521499737445987858801939.092519874",
+                    "-4927699338820082429922256920.972344978",
+                    "-181038742310970654625957008.415134970",
+                    "-4850466998870968615766834476.477782344",
+                    "8681334561817202301666011034.987482907",
+                    "-3358323486547015837206742594.212605708",
+                    "7770175100125696617964074281.376399082",
+                    "-1869881956184352298725010129.272506370"]
+        check(dec.add128(lhs, rhs, 9),
+              [(False, _dstr(e)[0]) for e in expected])
+
+    def test_arith_overflow_singles(self):
+        # mulTestOverflow / addTestOverflow / subTestOverflow
+        big = _dcol(["50000000000000000000000000000000000000"])
+        two = _dcol(["2"])
+        check(dec.multiply128(big, two, 0), [(True, None)])
+        nines = _dcol(["99999999999999999999999999999999999999"])
+        one = _dcol(["1"])
+        check(dec.add128(nines, one, 0), [(True, None)])
+        neg_nines = _dcol(["-99999999999999999999999999999999999999"])
+        check(dec.subtract128(neg_nines, one, 0), [(True, None)])
