@@ -37,13 +37,26 @@ _initialized = False
 # env markers that indicate a multi-process cluster runtime is present; used
 # to decide whether an "initialize too late" condition is fatal or benign
 _CLUSTER_ENV_MARKERS = (
-    "TPU_WORKER_HOSTNAMES",
     "MEGASCALE_COORDINATOR_ADDRESS",
     "JAX_COORDINATOR_ADDRESS",
     "COORDINATOR_ADDRESS",
     "SLURM_JOB_ID",
     "OMPI_MCA_orte_hnp_uri",
 )
+
+
+def _cluster_env_present() -> bool:
+    """True when the environment names a genuinely multi-process cluster.
+
+    TPU_WORKER_HOSTNAMES needs a value check, not a presence check: TPU
+    runtimes (including single-chip tunnels) set it to the one local host,
+    and a one-entry list is exactly the single-process case this module
+    must treat as benign.
+    """
+    if any(m in os.environ for m in _CLUSTER_ENV_MARKERS):
+        return True
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return len([h for h in hosts.split(",") if h.strip()]) > 1
 
 
 def initialize(
@@ -83,7 +96,7 @@ def initialize(
         # Benign for plain single-process use; FATAL when a cluster runtime
         # is present — degrading there would compute per-host partial
         # results silently.
-        if explicit or any(m in os.environ for m in _CLUSTER_ENV_MARKERS):
+        if explicit or _cluster_env_present():
             raise
         return
     _initialized = True

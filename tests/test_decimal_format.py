@@ -39,6 +39,7 @@ def test_format_floats64_gtest_vectors():
                    "11,234,000,000.00000", "-0.00000"]
 
 
+@pytest.mark.slow
 def test_format_float_specials_and_rounding():
     got = format_float(column([float("inf"), float("-inf")], FLOAT64), 2).to_list()
     assert got == ["∞", "-∞"]
@@ -81,11 +82,13 @@ def _oracle(unscaled, scale):
     ]
 
 
+@pytest.mark.slow
 def test_decimal_simple_gtest():
     got = decimal_to_string(_dec_col(list(range(11)), 9, 0)).to_list()
     assert got == ["0", "1", "2", "3", "4", "5", "6", "7", "8", "9", "10"]
 
 
+@pytest.mark.slow
 def test_decimal_scientific_edge_gtest():
     # cast_decimal_to_string.cpp ScientificEdge :55-85
     assert decimal_to_string(_dec_col([0, 100000000], 18, 6)).to_list() == [
@@ -109,6 +112,7 @@ def test_decimal128_values():
     assert got == _oracle(vals, 10)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("precision,scale", [(9, 0), (9, 4), (18, 2), (38, 0),
                                              (38, 6), (38, 37), (38, -2)])
 def test_decimal_fuzz_vs_python_decimal(precision, scale):

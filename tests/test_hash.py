@@ -331,6 +331,7 @@ def test_decimal128_hash_vs_oracle():
 # --- randomized cross-checks vs oracle -------------------------------------------
 
 
+@pytest.mark.slow
 def test_random_strings_vs_oracle():
     rng = random.Random(1234)
     strs = []

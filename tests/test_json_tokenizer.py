@@ -8,6 +8,8 @@ verdict for every row.
 
 import random
 
+import pytest
+
 import numpy as np
 
 import json_oracle as jo
@@ -111,6 +113,7 @@ CORPUS = [
 ]
 
 
+@pytest.mark.slow
 def test_tokenizer_corpus_matches_oracle():
     got = run_tokenizer(CORPUS)
     for s, (toks, ok) in zip(CORPUS, got):
@@ -164,6 +167,7 @@ def _mutate(rng, s: bytes) -> bytes:
     return s[:i] + bytes([rng.randrange(32, 127)]) + s[i:]
 
 
+@pytest.mark.slow
 def test_tokenizer_fuzz_matches_oracle():
     rng = random.Random(42)
     strs = []

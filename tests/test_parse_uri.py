@@ -192,29 +192,35 @@ def _check(data, needle=None, needles=None):
         assert got == want, f"part {name}"
 
 
+@pytest.mark.slow
 def test_spark_corpus():
     _check(SPARK_DATA)
 
 
+@pytest.mark.slow
 def test_spark_corpus_query_literal():
     _check(SPARK_DATA, needle="query")
 
 
+@pytest.mark.slow
 def test_spark_corpus_query_column():
     assert len(SPARK_DATA) == len(SPARK_QUERIES)
     _check(SPARK_DATA, needles=SPARK_QUERIES)
 
 
+@pytest.mark.slow
 def test_utf8_corpus():
     _check(UTF8_DATA)
     _check(UTF8_DATA, needle="query")
 
 
+@pytest.mark.slow
 def test_ip4_corpus():
     _check(IP4_DATA)
     _check(IP4_DATA, needle="query")
 
 
+@pytest.mark.slow
 def test_ip6_corpus():
     _check(IP6_DATA)
     _check(IP6_DATA, needle="query")
@@ -259,6 +265,7 @@ def test_pinned_java_uri_expectations():
     ]
 
 
+@pytest.mark.slow
 def test_query_param_extraction():
     data = [
         "https://www.nvidia.com/path?param0=1&param2=3&param4=5%206",
