@@ -24,29 +24,31 @@ roofline under "detail".
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 NOMINAL_BASELINE_ROWS_PER_S = 1.0e9  # order-of-magnitude GPU figure, config 1
 
 
+_TIMING_INFO = {}  # stage key -> raw two-point timing detail
+_CURRENT_STAGE = [None]
+
+
 def _time(fn, iters, *args):
-    out = fn(*args)
-    _block(out)  # compile + warm
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    _block(out)
-    return (time.perf_counter() - t0) / iters
+    """Steady-state s/call via two-point marginal timing (obs/timing.py).
 
+    ``block_until_ready`` does not sync through the axon tunnel (it reports
+    up to 25x the physical HBM bandwidth), so all bench numbers come from
+    scalar-materialization sync + marginal subtraction; the raw points are
+    kept in ``_TIMING_INFO`` and surfaced under each stage's detail.
+    """
+    from spark_rapids_jni_tpu.obs.timing import time_marginal
 
-def _block(out):
-    import jax
-
-    for leaf in jax.tree_util.tree_leaves(out):
-        if hasattr(leaf, "block_until_ready"):
-            leaf.block_until_ready()
+    lo = max(2, iters // 4)
+    hi = max(lo + 3, iters)
+    dt, info = time_marginal(lambda: fn(*args), lo, hi)
+    _TIMING_INFO[_CURRENT_STAGE[0]] = info
+    return dt
 
 
 
@@ -65,6 +67,7 @@ def _stage(detail, key, fn, nbytes=0):
     )
 
     budget = default_device_budget()
+    _CURRENT_STAGE[0] = key
     try:
         detail[key] = run_with_split_retry(
             budget, None,
@@ -73,6 +76,9 @@ def _stage(detail, key, fn, nbytes=0):
             split=lambda _b: [],
             combine=lambda rs: rs[0],
         )
+        info = _TIMING_INFO.pop(key, None)
+        if info is not None and isinstance(detail[key], dict):
+            detail[key]["timing"] = info
     except Exception as e:  # noqa: BLE001 - reported, never fatal
         detail[key] = {"error": repr(e)[:300]}
 

@@ -1,0 +1,53 @@
+"""obs/timing: tunnel-safe sync + marginal timing (see module docstring).
+
+The real motivation is the axon remote platform where block_until_ready
+lies; on the CPU backend these tests pin the API contract and the
+fallback behavior, not tunnel semantics.
+"""
+
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu.obs.timing import device_sync, time_marginal
+
+
+def test_device_sync_handles_pytrees_and_dtypes():
+    tree = {
+        "f": jnp.arange(8, dtype=jnp.float32),
+        "i": jnp.arange(8, dtype=jnp.int64),
+        "b": jnp.arange(8) % 2 == 0,
+        "empty": jnp.zeros((0,), jnp.float32),
+        "host": 3.5,  # non-array leaf must be ignored
+    }
+    device_sync(tree)  # must not raise
+
+
+def test_time_marginal_positive_and_info():
+    x = jnp.arange(1024, dtype=jnp.float32)
+    dt, info = time_marginal(lambda: x + 1.0, 2, 6)
+    assert dt > 0
+    assert info["iters"] == [2, 6]
+    assert info["method"] in ("marginal", "amortized-fallback")
+    assert info["t_hi_s"] >= 0
+
+
+def test_time_marginal_fallback_is_amortized():
+    # A no-op fn on tiny data can produce a non-positive subtraction on a
+    # noisy host; force the fallback by syncing with a clock we control.
+    ticks = iter([0.0, 0.0, 10.0, 10.0, 10.0, 10.0])
+
+    calls = []
+
+    def fake_sync(_out):
+        calls.append(1)
+
+    import spark_rapids_jni_tpu.obs.timing as timing
+
+    real = timing.time.perf_counter
+    seq = iter([0.0, 10.0, 10.0, 10.0])  # t_lo = 10s, t_hi = 0s -> negative
+    timing.time.perf_counter = lambda: next(seq)
+    try:
+        dt, info = time_marginal(lambda: 1, 2, 4, sync=fake_sync)
+    finally:
+        timing.time.perf_counter = real
+    assert info["method"] == "amortized-fallback"
+    assert dt == info["amortized_s_per_call"]

@@ -25,15 +25,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _time(fn, iters, *args):
-    import jax
+    # block_until_ready does not sync through the axon tunnel; use the
+    # scalar-sync + marginal-subtraction recipe (obs/timing.py docstring).
+    from spark_rapids_jni_tpu.obs.timing import time_marginal
 
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
+    lo = max(2, iters // 4)
+    dt, _info = time_marginal(lambda: fn(*args), lo, max(lo + 3, iters))
+    return dt
 
 
 def main(argv=None) -> int:
