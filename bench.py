@@ -42,11 +42,9 @@ def _time(fn, iters, *args):
     scalar-materialization sync + marginal subtraction; the raw points are
     kept in ``_TIMING_INFO`` and surfaced under each stage's detail.
     """
-    from spark_rapids_jni_tpu.obs.timing import time_marginal
+    from spark_rapids_jni_tpu.obs.timing import time_marginal_for_iters
 
-    lo = max(2, iters // 4)
-    hi = max(lo + 3, iters)
-    dt, info = time_marginal(lambda: fn(*args), lo, hi)
+    dt, info = time_marginal_for_iters(lambda: fn(*args), iters)
     _TIMING_INFO[_CURRENT_STAGE[0]] = info
     return dt
 

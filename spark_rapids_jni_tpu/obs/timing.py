@@ -35,7 +35,7 @@ from __future__ import annotations
 import time
 from typing import Any, Callable, Tuple
 
-__all__ = ["device_sync", "time_marginal"]
+__all__ = ["device_sync", "time_marginal", "time_marginal_for_iters"]
 
 
 def device_sync(tree: Any) -> None:
@@ -97,3 +97,17 @@ def time_marginal(
         info["method"] = "amortized-fallback"
         return amortized, info
     return marginal, info
+
+
+def time_marginal_for_iters(fn: Callable[[], Any], iters: int):
+    """`time_marginal` with the two points derived from a caller's legacy
+    iteration budget.  Cheap stages (small ``iters``) stay cheap: total
+    calls ~= 2*iters + 1, never more than ~1.3x the pre-marginal loop for
+    large ``iters``.  Single place for the derivation so bench.py and
+    tools/ cannot drift apart.
+    """
+    if iters <= 4:
+        lo, hi = 1, max(3, iters)
+    else:
+        lo, hi = max(2, iters // 4), iters
+    return time_marginal(fn, lo, hi)

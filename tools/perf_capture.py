@@ -38,7 +38,7 @@ PROBE = (
 )
 
 SWEEP = r"""
-import json, sys, time
+import json, sys
 sys.path.insert(0, {repo!r})
 import jax, jax.numpy as jnp, numpy as np
 from spark_rapids_jni_tpu.obs.timing import time_marginal
@@ -59,7 +59,7 @@ for log2 in {sizes}:
             [Column(d, None, INT32)], seed=42).data), 12),
     )
     for name, (f, bpr) in ops.items():
-        if name not in {ops_on!r}:
+        if name not in {ops_on!r}:  # ops_on is a tuple of op names
             continue
         dt, info = time_marginal(lambda: f(d32), 5, 25)
         emit({{"stage": "sweep", "op": name, "n_log2": log2,
@@ -83,11 +83,27 @@ def _run(tag: str, code: list, timeout: float) -> bool:
     try:
         res = subprocess.run(code, capture_output=True, text=True,
                              timeout=timeout, cwd=REPO)
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        # salvage whatever the stage managed to emit before wedging —
+        # losing completed measurements is the one failure mode this tool
+        # exists to prevent
+        out = e.stdout or b""
+        if isinstance(out, bytes):
+            out = out.decode("utf-8", "replace")
+        _salvage(tag, out)
         _append({"stage": tag, "error": f"timeout after {timeout}s"})
         return False
     ok = res.returncode == 0
-    for line in (res.stdout or "").splitlines():
+    _salvage(tag, res.stdout or "")
+    if not ok:
+        tail = (res.stderr or "").strip().splitlines()[-1:]
+        _append({"stage": tag, "error": (tail or ["nonzero exit"])[0][:300],
+                 "wall_s": round(time.time() - t0, 1)})
+    return ok
+
+
+def _salvage(tag: str, stdout: str) -> None:
+    for line in stdout.splitlines():
         line = line.strip()
         if line.startswith("{"):
             try:
@@ -96,11 +112,6 @@ def _run(tag: str, code: list, timeout: float) -> bool:
                 continue
             rec.setdefault("stage", tag)
             _append(rec)
-    if not ok:
-        tail = (res.stderr or "").strip().splitlines()[-1:]
-        _append({"stage": tag, "error": (tail or ["nonzero exit"])[0][:300],
-                 "wall_s": round(time.time() - t0, 1)})
-    return ok
 
 
 def probe(timeout: float = 150.0) -> bool:
@@ -114,8 +125,10 @@ def probe(timeout: float = 150.0) -> bool:
 
 def capture_once() -> bool:
     """One full staged capture; returns True if the headline bench landed."""
-    sweep_small = SWEEP.format(repo=REPO, sizes=[20, 22], ops_on="copy murmur3 xxhash64")
-    sweep_big = SWEEP.format(repo=REPO, sizes=[24, 26], ops_on="copy murmur3")
+    sweep_small = SWEEP.format(repo=REPO, sizes=[20, 22],
+                               ops_on=("copy", "murmur3", "xxhash64"))
+    sweep_big = SWEEP.format(repo=REPO, sizes=[24, 26],
+                             ops_on=("copy", "murmur3"))
     ok = _run("sweep-small", [sys.executable, "-c", sweep_small], 900)
     if ok:
         _run("sweep-big", [sys.executable, "-c", sweep_big], 900)

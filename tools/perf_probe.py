@@ -19,7 +19,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -27,10 +26,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def _time(fn, iters, *args):
     # block_until_ready does not sync through the axon tunnel; use the
     # scalar-sync + marginal-subtraction recipe (obs/timing.py docstring).
-    from spark_rapids_jni_tpu.obs.timing import time_marginal
+    from spark_rapids_jni_tpu.obs.timing import time_marginal_for_iters
 
-    lo = max(2, iters // 4)
-    dt, _info = time_marginal(lambda: fn(*args), lo, max(lo + 3, iters))
+    dt, _info = time_marginal_for_iters(lambda: fn(*args), iters)
     return dt
 
 
