@@ -152,6 +152,17 @@ register("json_device_render", True,
          "segment rendering (ops/json_render_device.py); bytes cross to "
          "host only at final column materialization.  Off = host numpy "
          "pipeline (the debug oracle).", env="SRT_JSON_DEVICE_RENDER")
+register("json_overlap_bytes", 64 << 20,
+         "Padded-input byte budget per overlap group in device "
+         "get_json_object: all buckets in a group issue their programs "
+         "before any scalar sync, so one tunnel round-trip serves the "
+         "group. 1 = serial per-bucket syncs.",
+         env="SRT_JSON_OVERLAP_BYTES")
+register("hash_backend", "xla",
+         "Backend for murmur3 fixed-width column contributions: 'xla' "
+         "(fused elementwise ops) or 'pallas' (VMEM-blocked kernels, "
+         "ops/hash_pallas.py; interpret-mode off-TPU).",
+         env="SRT_HASH_BACKEND")
 register("watchdog_period_s", 0.1,
          "Memory-governor deadlock-watchdog poll period (the "
          "rmmWatchdogPollingPeriod analog, SparkResourceAdaptor.java:35).",

@@ -1,0 +1,132 @@
+"""Pallas TPU kernels for the murmur3 fixed-width hot path.
+
+The XLA path in ``hashing.py`` expresses the Spark murmur3 chain as ~10
+fused elementwise u32 ops; XLA handles that well, but it leaves tiling to
+the compiler and re-materializes the running-hash vector between column
+contributions at HBM.  These kernels express one column *contribution*
+(running hash in, updated hash out — the unit from which
+``murmur_hash32`` chains columns, reference murmur_hash.cu:44-48) as a
+single VMEM-resident Pallas kernel:
+
+- ``mm_hash_int_pallas``  == hashing._mm_hash_int  (one 4-byte round + fmix)
+- ``mm_hash_long_pallas`` == hashing._mm_hash_long (two rounds + fmix)
+
+Everything is uint32 lane arithmetic — no 64-bit types enter the kernel
+(the TPU x64 rewrite has no 64-bit bitcast; int64 inputs are split into
+u32 limbs *outside* with plain shifts, which the rewrite does support).
+
+Off-TPU the kernels run in Pallas interpret mode, so correctness is
+CI-testable on the CPU mesh; selection is via the ``hash_backend`` config
+flag ("xla" default, "pallas" to route murmur3 fixed-width contributions
+here).  On hardware the two backends are A/B benchable
+(tools/perf_capture.py sweep).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_U32 = jnp.uint32
+
+# VMEM block: 512 sublane-rows x 128 lanes of u32 = 256 KiB per operand.
+_BLOCK_ROWS = 512
+_LANES = 128
+_TILE = _BLOCK_ROWS * _LANES
+
+
+def _use_interpret() -> bool:
+    # Mosaic lowering needs a real TPU; everywhere else (CPU mesh tests,
+    # debugging) the interpreter executes the same kernel semantics.
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+# ---- kernel bodies (u32 lane math, mirrors hashing.py primitives) --------
+
+
+def _mix_k1(k1):
+    k1 = k1 * _U32(0xCC9E2D51)
+    k1 = (k1 << _U32(15)) | (k1 >> _U32(17))
+    return k1 * _U32(0x1B873593)
+
+
+def _mix_h1(h1, k1):
+    h1 = h1 ^ k1
+    h1 = (h1 << _U32(13)) | (h1 >> _U32(19))
+    return h1 * _U32(5) + _U32(0xE6546B64)
+
+
+def _fmix(h, length_u32):
+    h = h ^ length_u32
+    h = h ^ (h >> _U32(16))
+    h = h * _U32(0x85EBCA6B)
+    h = h ^ (h >> _U32(13))
+    h = h * _U32(0xC2B2AE35)
+    return h ^ (h >> _U32(16))
+
+
+def _int_kernel(v_ref, h_ref, out_ref):
+    out_ref[:] = _fmix(_mix_h1(h_ref[:], _mix_k1(v_ref[:])), _U32(4))
+
+
+def _long_kernel(lo_ref, hi_ref, h_ref, out_ref):
+    h = _mix_h1(h_ref[:], _mix_k1(lo_ref[:]))
+    h = _mix_h1(h, _mix_k1(hi_ref[:]))
+    out_ref[:] = _fmix(h, _U32(8))
+
+
+# ---- blocking helpers -----------------------------------------------------
+
+
+def _to_blocks(x_u32: jnp.ndarray) -> jnp.ndarray:
+    """[n] u32 -> [R, 128] u32, R a multiple of _BLOCK_ROWS (zero padded)."""
+    n = x_u32.shape[0]
+    pad = (-n) % _TILE
+    if pad:
+        x_u32 = jnp.pad(x_u32, (0, pad))
+    return x_u32.reshape(-1, _LANES)
+
+
+@functools.partial(jax.jit, static_argnames=("n_inputs",))
+def _launch(n_inputs, *flat_u32):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    blocks = [_to_blocks(x) for x in flat_u32]
+    rows = blocks[0].shape[0]
+    kernel = _int_kernel if n_inputs == 2 else _long_kernel
+    spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        kernel,
+        grid=(rows // _BLOCK_ROWS,),
+        in_specs=[spec] * n_inputs,
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((rows, _LANES), _U32),
+        interpret=_use_interpret(),
+    )(*blocks)
+    return out.reshape(-1)
+
+
+def mm_hash_int_pallas(v_i32: jnp.ndarray, h_u32: jnp.ndarray) -> jnp.ndarray:
+    """Pallas twin of hashing._mm_hash_int (Spark Murmur3.hashInt round)."""
+    n = v_i32.shape[0]
+    if n == 0:
+        return jnp.zeros((0,), _U32)
+    h = jnp.broadcast_to(jnp.asarray(h_u32, _U32), (n,))  # scalar seeds ok
+    return _launch(2, v_i32.astype(_U32), h)[:n]
+
+
+def mm_hash_long_pallas(v_i64: jnp.ndarray, h_u32: jnp.ndarray) -> jnp.ndarray:
+    """Pallas twin of hashing._mm_hash_long; 64-bit split happens out here
+    (shifts only — safe under the u32-pair x64 rewrite)."""
+    n = v_i64.shape[0]
+    if n == 0:
+        return jnp.zeros((0,), _U32)
+    v = v_i64.astype(jnp.uint64)
+    lo = (v & jnp.uint64(0xFFFFFFFF)).astype(_U32)
+    hi = (v >> jnp.uint64(32)).astype(_U32)
+    h = jnp.broadcast_to(jnp.asarray(h_u32, _U32), (n,))
+    return _launch(3, lo, hi, h)[:n]

@@ -91,16 +91,30 @@ def _mm_fmix(h, length):
 
 def _mm_hash_int(v_i32, h):
     """Spark Murmur3.hashInt: one mix round + fmix(4)."""
+    if _pallas_backend():
+        from spark_rapids_jni_tpu.ops.hash_pallas import mm_hash_int_pallas
+
+        return mm_hash_int_pallas(v_i32, h)
     return _mm_fmix(_mm_mix_h1(h, _mm_mix_k1(v_i32.astype(_U32))), _U32(4))
 
 
 def _mm_hash_long(v_i64, h):
+    if _pallas_backend():
+        from spark_rapids_jni_tpu.ops.hash_pallas import mm_hash_long_pallas
+
+        return mm_hash_long_pallas(v_i64, h)
     v = v_i64.astype(_U64)
     low = (v & _U64(0xFFFFFFFF)).astype(_U32)
     high = (v >> _U64(32)).astype(_U32)
     h = _mm_mix_h1(h, _mm_mix_k1(low))
     h = _mm_mix_h1(h, _mm_mix_k1(high))
     return _mm_fmix(h, _U32(8))
+
+
+def _pallas_backend() -> bool:
+    from spark_rapids_jni_tpu import config
+
+    return config.get("hash_backend") == "pallas"
 
 
 def _mm_hash_bytes(padded: jnp.ndarray, lens: jnp.ndarray, h):
