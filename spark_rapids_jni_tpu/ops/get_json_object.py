@@ -998,9 +998,15 @@ def _pow2(x: int) -> int:
 def _get_json_object_device(col: StringColumn, ptypes, pargs, names
                             ) -> StringColumn:
     """Fully device-resident evaluation: tokenize, byte tables, name match,
-    lax.scan machine, and segment rendering all run jitted; per bucket only
-    three scalars sync to host (float count, float source width, output
-    width), each pow2-padded so the compile-variant set stays bounded.
+    lax.scan machine, and segment rendering all run jitted.  Only three
+    scalars per bucket ever reach the host (float count, float source
+    width, output width), each pow2-padded so the compile-variant set
+    stays bounded — and those syncs are *batched across buckets*: every
+    bucket's phase-1 program is issued before the first scalar pull, so
+    one tunnel round-trip (~70 ms on axon) serves a whole group of buckets
+    instead of serializing 3 syncs x buckets with the device.  Groups are
+    capped by ``json_overlap_bytes`` of padded input so holding several
+    buckets' token tables concurrently cannot blow HBM.
     Parity: the single-kernel residency of get_json_object.cu:891.
     """
     from spark_rapids_jni_tpu.ops import json_render_device as jrd
@@ -1014,58 +1020,107 @@ def _get_json_object_device(col: StringColumn, ptypes, pargs, names
     parg_j = jnp.asarray(
         [a if isinstance(a, int) else 0 for a in pargs] + [0], np.int32)
 
+    # group buckets so phase intermediates stay bounded (~10-15x the padded
+    # input bytes live at once within a group)
+    group_budget = max(int(config.get("json_overlap_bytes")), 1)
+    groups, cur, cur_bytes = [], [], 0
+    for b in padded_buckets(col):
+        bbytes = int(b.bytes.shape[0]) * int(b.bytes.shape[1])
+        if cur and cur_bytes + bbytes > group_budget:
+            groups.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(b)
+        cur_bytes += bbytes
+    if cur:
+        groups.append(cur)
+
     results = []
     valid_out = jnp.zeros((n,), bool)
-    for b in padded_buckets(col):
-        ts = jt.tokenize(b.bytes, b.lengths)
-        nr, nv = b.n_rows, b.n_valid
-        kind = ts.kind.astype(jnp.int32)
-        start, end, match = ts.start, ts.end, ts.match
-        ntok = ts.n_tokens.astype(jnp.int32)
-        T = kind.shape[1]
+    for group in groups:
+        # ---- phase 1 (no sync): tokenize + scan + float-geometry scalars
+        ph1 = []
+        for b in group:
+            ts = jt.tokenize(b.bytes, b.lengths)
+            nr = b.n_rows
+            kind = ts.kind.astype(jnp.int32)
+            start, end = ts.start, ts.end
+            ntok = ts.n_tokens.astype(jnp.int32)
+            T = kind.shape[1]
 
-        st_before = _string_states(b.bytes, b.lengths)
-        bi = jrd.byte_info_device(b.bytes, b.lengths, st_before)
-        len_raw, len_esc, has_uni, neg0 = jrd.token_tables_device(
-            bi, kind, start, end)
-        nm = jrd.name_matches_device(bi, kind, start, len_raw, has_uni, names)
-        nm_stack = jnp.concatenate(
-            [jnp.stack(nm) if nm else jnp.zeros((0, nr, T), bool),
-             jnp.zeros((P1 - len(nm), nr, T), bool)])
+            st_before = _string_states(b.bytes, b.lengths)
+            bi = jrd.byte_info_device(b.bytes, b.lengths, st_before)
+            len_raw, len_esc, has_uni, neg0 = jrd.token_tables_device(
+                bi, kind, start, end)
+            nm = jrd.name_matches_device(
+                bi, kind, start, len_raw, has_uni, names)
+            nm_stack = jnp.concatenate(
+                [jnp.stack(nm) if nm else jnp.zeros((0, nr, T), bool),
+                 jnp.zeros((P1 - len(nm), nr, T), bool)])
 
-        F = min(jt.MAX_DEPTH + MAX_PATH_DEPTH + 6, T + 3)
-        G = min(_MPD + 2, F)
-        err, done, dirty_root, (segs, cg, cd, cn) = _run_scan(
-            kind, match, ntok, ts.ok, nm_stack, ptype_j, parg_j, T, F, G)
-        err = err | ~done | (dirty_root <= 0)
-        err = err | ~in_valid[b.rows]
-        err = err | ~b.valid_mask()  # pow2-padding tail rows
+            F = min(jt.MAX_DEPTH + MAX_PATH_DEPTH + 6, T + 3)
+            G = min(_MPD + 2, F)
+            err, done, dirty_root, (segs, cg, cd, cn) = _run_scan(
+                kind, ts.match, ntok, ts.ok, nm_stack, ptype_j, parg_j,
+                T, F, G)
+            err = err | ~done | (dirty_root <= 0)
+            err = err | ~in_valid[b.rows]
+            err = err | ~b.valid_mask()  # pow2-padding tail rows
 
-        # floats: two scalar syncs pick the compile-bounded slot geometry
-        fmask = kind == jt.VALUE_NUMBER_FLOAT
-        nf_total = int(jnp.sum(fmask))
-        if nf_total:
-            ws = int(jnp.max(jnp.where(fmask, end - start, 0)))
-            NF, WS = _pow2(nf_total), _pow2(max(int(ws), 1))
-            ftext, flen, fidx = jrd.float_texts_device(
-                b.bytes, kind, start, end, NF, WS)
-        else:
-            ftext = jnp.zeros((0, 1), jnp.uint8)
-            flen = jnp.zeros((0,), jnp.int64)
-            fidx = jnp.full((nr, T), -1, jnp.int64)
+            fmask = kind == jt.VALUE_NUMBER_FLOAT
+            if fmask.size:
+                nf_dev = jnp.sum(fmask, dtype=jnp.int32)
+                ws_dev = jnp.max(
+                    jnp.where(fmask, end - start, 0)).astype(jnp.int32)
+            else:
+                nf_dev = ws_dev = jnp.int32(0)
+            ph1.append(dict(
+                b=b, bi=bi, kind=kind, start=start, end=end, err=err,
+                segs=(segs, cg, cd, cn), len_raw=len_raw, len_esc=len_esc,
+                neg0=neg0, nf=nf_dev, ws=ws_dev))
 
-        stype, sarg, segcum, out_len = jrd.resolve_and_measure(
-            segs, cg, cd, cn, err, kind, len_raw, len_esc, fidx, flen)
-        W = _pow2(max(int(jnp.max(out_len)), 1))  # third scalar sync
-        padded = jrd.render_device(
-            bi, stype, sarg, segcum, out_len, err, kind, start, end,
-            (len_raw, len_esc, neg0), (ftext, flen, fidx), W)
+        # one batched sync: every bucket's (nf, ws) in a single pull
+        geom = np.asarray(
+            jnp.stack([jnp.stack([p["nf"], p["ws"]]) for p in ph1]))
 
-        rvalid = ~err
-        tgt = jnp.where(b.valid_mask(), b.rows, jnp.int32(n))
-        valid_out = valid_out.at[tgt].set(rvalid, mode="drop")
-        results.append((b.rows[:nv], padded[:nv],
-                        out_len[:nv].astype(jnp.int32), nv))
+        # ---- phase 2 (no sync): float slots + measure + out-width scalar
+        for p, (nf_total, ws) in zip(ph1, geom):
+            b, kind = p["b"], p["kind"]
+            nr = b.n_rows
+            if nf_total:
+                NF, WS = _pow2(int(nf_total)), _pow2(max(int(ws), 1))
+                ftext, flen, fidx = jrd.float_texts_device(
+                    b.bytes, kind, p["start"], p["end"], NF, WS)
+            else:
+                ftext = jnp.zeros((0, 1), jnp.uint8)
+                flen = jnp.zeros((0,), jnp.int64)
+                fidx = jnp.full((nr, kind.shape[1]), -1, jnp.int64)
+
+            segs, cg, cd, cn = p["segs"]
+            stype, sarg, segcum, out_len = jrd.resolve_and_measure(
+                segs, cg, cd, cn, p["err"], kind, p["len_raw"],
+                p["len_esc"], fidx, flen)
+            p.update(floats=(ftext, flen, fidx), stype=stype, sarg=sarg,
+                     segcum=segcum, out_len=out_len,
+                     wmax=jnp.max(out_len).astype(jnp.int32))
+
+        # second batched sync: all output widths at once
+        wmaxes = np.asarray(jnp.stack([p["wmax"] for p in ph1]))
+
+        # ---- phase 3: render (width now static per bucket)
+        for p, wmax in zip(ph1, wmaxes):
+            b = p["b"]
+            nv = b.n_valid
+            W = _pow2(max(int(wmax), 1))
+            padded = jrd.render_device(
+                p["bi"], p["stype"], p["sarg"], p["segcum"], p["out_len"],
+                p["err"], p["kind"], p["start"], p["end"],
+                (p["len_raw"], p["len_esc"], p["neg0"]), p["floats"], W)
+
+            rvalid = ~p["err"]
+            tgt = jnp.where(b.valid_mask(), b.rows, jnp.int32(n))
+            valid_out = valid_out.at[tgt].set(rvalid, mode="drop")
+            results.append((b.rows[:nv], padded[:nv],
+                            p["out_len"][:nv].astype(jnp.int32), nv))
 
     return strings_from_buckets(n, results, valid_out)
 

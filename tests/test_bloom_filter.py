@@ -96,6 +96,7 @@ class SparkBloomOracle:
         return out
 
 
+@pytest.mark.slow
 def test_put_probe_matches_oracle_including_false_positives():
     rng = np.random.RandomState(23)
     inserted = [int(v) for v in rng.randint(-(2**63), 2**63, size=200, dtype=np.int64)]
@@ -136,6 +137,7 @@ def test_deserialize_roundtrip_and_validation():
         bloom_filter_deserialize(buf + b"\x00")  # length mismatch
 
 
+@pytest.mark.slow
 def test_merge():
     a = bloom_filter_put(bloom_filter_create(3, 8), column([1, 2, 3], INT64))
     b = bloom_filter_put(bloom_filter_create(3, 8), column([100, 200], INT64))
@@ -179,6 +181,7 @@ def test_create_validation():
         bloom_filter_create(0, 8)
 
 
+@pytest.mark.slow
 def test_repeated_put_of_same_value_is_idempotent():
     """Regression: scatter-add must not carry into already-set bits."""
     bf = bloom_filter_create(3, 4)

@@ -5,6 +5,8 @@ instead of O(n * max_len), compiled shapes are powers of two, and
 reassembly (map_buckets scatter / strings_from_buckets) is order-exact.
 """
 
+import pytest
+
 import random
 
 import jax.numpy as jnp
@@ -95,6 +97,7 @@ def test_map_buckets_row_args():
     assert out.tolist() == [12, 119, 32]
 
 
+@pytest.mark.slow
 def test_strings_from_buckets_roundtrip():
     rng = random.Random(3)
     strs = ["w" * rng.randrange(0, 500) for _ in range(123)]

@@ -124,6 +124,7 @@ def unscaled(strs, precision, scale, **kw):
     return col.unscaled_to_list()
 
 
+@pytest.mark.slow
 class TestCastToDecimal:
     # CastStringsTest.castToDecimalTest:162 (cudf scales {0,0,-1} == spark {0,0,1})
     def test_strip(self):

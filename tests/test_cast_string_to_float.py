@@ -18,6 +18,7 @@ def run(vals, dtype=FLOAT64, ansi=False):
     return string_to_float(strings_column(vals), ansi, dtype).to_list()
 
 
+@pytest.mark.slow
 def test_simple_double():
     vals = ["-1.8946e-10", "0001", "0000.123", "123", "123.45", "45.123",
             "-45.123", "0.45123", "-0.45123"]
@@ -26,6 +27,7 @@ def test_simple_double():
         assert g == float(s), (s, g)
 
 
+@pytest.mark.slow
 def test_large_digit_truncation():
     # >19 digits: the reference truncates with its own accounting
     got = run(["9999999999999999999", "18446744073709551609",
@@ -36,6 +38,7 @@ def test_large_digit_truncation():
     assert got[3] == -18446744073709551609.0
 
 
+@pytest.mark.slow
 def test_inf_nan():
     got = run(["NaN", "-Infinity", "inf", "Infinity", "-inf", "-nan", "nan"])
     assert math.isnan(got[0])
@@ -52,6 +55,7 @@ def test_invalid_values_are_null():
     assert run(vals) == [None] * len(vals)
 
 
+@pytest.mark.slow
 def test_ansi_raises_with_row():
     for bad in ["A", ".", "e"]:
         with pytest.raises(CastException) as ei:
@@ -61,6 +65,7 @@ def test_ansi_raises_with_row():
     assert run(["infx"], ansi=True) == [None]
 
 
+@pytest.mark.slow
 def test_tricky_values():
     """The exact TrickyValues vectors (cast_string.cpp:642-695)."""
     vals = ["7f", "\riNf", "1.3e5ef", "1.3e+7f", "9\n", "46037e\t", "8d",
@@ -163,6 +168,7 @@ def test_subnormal():
     assert got[2] == 0.0
 
 
+@pytest.mark.slow
 def test_device_assemble_equals_host_oracle():
     """The integer-softfloat device assembly must agree bit-for-bit with the
     host binary64 oracle on a wide mixed corpus."""

@@ -1,5 +1,7 @@
 """Column data model tests."""
 
+import pytest
+
 import numpy as np
 import jax.numpy as jnp
 
@@ -37,6 +39,7 @@ def test_decimal128_roundtrip():
     assert col.unscaled_to_list() == vals
 
 
+@pytest.mark.slow
 def test_bitmask_pack_unpack():
     rng = np.random.RandomState(0)
     for n in (0, 1, 7, 8, 9, 63, 64, 100):

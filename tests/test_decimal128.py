@@ -40,6 +40,7 @@ def check(result_pair, expected, unscaled=True):
             assert got_val[i] == eval_, (i, got_val[i], eval_)
 
 
+@pytest.mark.slow
 class TestMultiply:
     @pytest.mark.parametrize("interim", [True, False])
     def test_random(self, interim):
@@ -88,6 +89,7 @@ class TestMultiply:
         check(dec.multiply128(a, b, 6), expected)
 
 
+@pytest.mark.slow
 class TestDivide:
     def test_reference_div_complex(self):
         # DecimalUtilsTest.java divComplex: 1e32 / 3.0...(scale 37) at spark
@@ -171,6 +173,7 @@ class TestDivide:
                 assert q.to_list()[i] == ev64
 
 
+@pytest.mark.slow
 class TestRemainder:
     def test_exact_math(self):
         # 451635271134476686911387864.48 % -961.110 at scale 3; the
@@ -259,6 +262,7 @@ def _dcol(strings, precision=38):
                              scales.pop())
 
 
+@pytest.mark.slow
 class TestReferenceVectors:
     def test_remainder2(self):  # DecimalUtilsTest.remainder2
         lhs = _dcol(["-80968577325845461854951721352418610.13",

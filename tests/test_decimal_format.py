@@ -18,6 +18,7 @@ from spark_rapids_jni_tpu.ops.cast_decimal_to_string import decimal_to_string
 from spark_rapids_jni_tpu.ops.format_float import format_float
 
 
+@pytest.mark.slow
 def test_format_floats32_gtest_vectors():
     vals = [100.0, 654321.25, -12761.125, 0.0, 5.0, -4.0, float("nan"),
             123456789012.34, -0.0]
@@ -27,6 +28,7 @@ def test_format_floats32_gtest_vectors():
                    "-0.00000"]
 
 
+@pytest.mark.slow
 def test_format_floats64_gtest_vectors():
     vals = [100.0, 654321.25, -12761.125, 1.123456789123456789,
             0.000000000000000000123456789123456789, 0.0, 5.0, -4.0,
@@ -55,6 +57,7 @@ def test_format_float_empty_column():
     assert format_float(column([], FLOAT64), 2).to_list() == []
 
 
+@pytest.mark.slow
 def test_format_float_nulls_and_validation():
     assert format_float(column([1.5, None], FLOAT64), 1).to_list() == ["1.5", None]
     from spark_rapids_jni_tpu.columnar import INT32
@@ -99,12 +102,14 @@ def test_decimal_scientific_edge_gtest():
         "0E-8", "10.00000000"]
 
 
+@pytest.mark.slow
 def test_decimal_negative_scale_scientific():
     # spark negative scale (cudf positive) is always scientific
     got = decimal_to_string(_dec_col([21, -30, 5], 9, -1)).to_list()
     assert got == _oracle([21, -30, 5], -1) == ["2.1E+2", "-3.0E+2", "5E+1"]
 
 
+@pytest.mark.slow
 def test_decimal128_values():
     vals = [12345678901234567890123456789012345678, -1, 0, None,
             -(10**37), 10**30 + 7]

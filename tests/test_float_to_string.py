@@ -49,6 +49,7 @@ def java_double_to_string(v):
     return sign + out
 
 
+@pytest.mark.slow
 def test_from_floats32_gtest_vectors():
     vals = [100.0, 654321.25, -12761.125, 0.0, 5.0, -4.0, float("nan"),
             123456789012.34, -0.0]
@@ -57,6 +58,7 @@ def test_from_floats32_gtest_vectors():
                    "NaN", "1.2345679E11", "-0.0"]
 
 
+@pytest.mark.slow
 def test_from_floats64_gtest_vectors():
     vals = [100.0, 654321.25, -12761.125, 1.123456789123456789,
             0.000000000000000000123456789123456789, 0.0, 5.0, -4.0,
@@ -78,17 +80,20 @@ def test_specials_and_boundaries():
                    "2.2250738585072014E-308"]
 
 
+@pytest.mark.slow
 def test_nulls_pass_through():
     got = float_to_string(column([1.5, None], FLOAT64)).to_list()
     assert got == ["1.5", None]
 
 
+@pytest.mark.slow
 def test_oracle_agreement_on_vectors():
     vals = [100.0, 654321.25, -12761.125, 1e7, 1e-3, 9e-4, 0.001, 123.456]
     got = float_to_string(column(vals, FLOAT64)).to_list()
     assert got == [java_double_to_string(v) for v in vals]
 
 
+@pytest.mark.slow
 def test_fuzz_double_vs_oracle():
     rng = np.random.RandomState(53)
     bits = rng.randint(0, 2**64, size=2000, dtype=np.uint64)
@@ -103,6 +108,7 @@ def test_fuzz_double_vs_oracle():
         assert float(g.replace("E", "e")) == float(v)
 
 
+@pytest.mark.slow
 def test_fuzz_float_roundtrip():
     rng = np.random.RandomState(59)
     bits = rng.randint(0, 2**32, size=2000, dtype=np.uint32)
@@ -120,6 +126,7 @@ def test_fuzz_float_roundtrip():
             assert np.float32(shorter.replace("E", "e")) != v, (g, shorter)
 
 
+@pytest.mark.slow
 def test_subnormal_doubles():
     vals = [5e-324, 1e-310, 2.2250738585072009e-308]
     got = float_to_string(column(vals, FLOAT64)).to_list()

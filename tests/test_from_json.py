@@ -28,6 +28,7 @@ def materialize(lst):
     return out
 
 
+@pytest.mark.slow
 def test_extract_raw_map_basic():
     # MapUtilsTest.java testExtractRawMapFromJsonString
     s1 = (
@@ -58,6 +59,7 @@ def test_extract_raw_map_basic():
     ]
 
 
+@pytest.mark.slow
 def test_extract_raw_map_utf8():
     s1 = (
         '{"Zipcóde" : 704 , "ZípCodeTypé" : "STANDARD" ,'
@@ -84,6 +86,7 @@ def test_extract_raw_map_utf8():
     ]
 
 
+@pytest.mark.slow
 def test_nested_keys_not_extracted():
     col = c.strings_column(['{"a":{"x":1,"y":2},"b":[{"z":3}],"c":7}'])
     got = materialize(from_json(col))
@@ -94,12 +97,14 @@ def test_nested_keys_not_extracted():
     ]
 
 
+@pytest.mark.slow
 def test_non_object_rows_give_empty_lists():
     col = c.strings_column(["[1,2,3]", '"str"', "42", "true", "{}"])
     got = materialize(from_json(col))
     assert got == [[], [], [], [], []]
 
 
+@pytest.mark.slow
 def test_escapes_stay_raw():
     col = c.strings_column(['{"k\\t1":"v\\n2"}'])
     got = materialize(from_json(col))
@@ -124,6 +129,7 @@ def test_null_rows_skip_validation():
     assert got == [None, [("a", "1")]]
 
 
+@pytest.mark.slow
 def test_skewed_row_lengths():
     big = '{"k":"' + "x" * 3000 + '"}'
     col = c.strings_column(['{"a":1}', big, "{}"])

@@ -270,3 +270,20 @@ def test_mixed_length_buckets():
         rows.append('{"k": "%s", "pad": "%s"}' % (f"v{i}", pad))
     got = run(rows, [named("k")])
     assert got == [f"v{i}" for i in range(50)]
+
+
+def test_overlap_grouping_matches_serial():
+    # the batched-sync bucket overlap (json_overlap_bytes) must be purely
+    # a scheduling change: group-of-all vs one-bucket-per-group identical
+    from spark_rapids_jni_tpu import config
+
+    rows = []
+    for i in range(40):
+        pad = "y" * (i * 11 % 150)
+        rows.append('{"k": [%d, %d.25], "pad": "%s"}' % (i, i, pad))
+    path = [named("k")]
+    with config.override(json_overlap_bytes=1):
+        serial = run(rows, path)
+    with config.override(json_overlap_bytes=1 << 30):
+        grouped = run(rows, path)
+    assert serial == grouped

@@ -174,6 +174,7 @@ def test_q97_governed_exact_no_pressure(gov):
     assert (out.store_only, out.catalog_only, out.both) == _oracle(store, catalog)
 
 
+@pytest.mark.slow
 def test_q97_governed_injected_split_exact(gov):
     """SplitAndRetryOOM mid-query: key-space split keeps the result exact and
     the per-task metrics show the split retry.  The test owns the task
@@ -192,6 +193,7 @@ def test_q97_governed_injected_split_exact(gov):
     assert splits == 1
 
 
+@pytest.mark.slow
 def test_q97_governed_tight_budget_splits_exact(gov):
     """Working set bigger than the whole budget: the arbiter escalates to
     SPLIT_THROW and the runner splits the key space until pieces fit."""
@@ -213,6 +215,7 @@ def test_q97_governed_tight_budget_splits_exact(gov):
     assert budget.used == 0  # everything released
 
 
+@pytest.mark.slow
 def test_q97_governed_skew_grows_capacity_exact(gov):
     """Skewed keys overflow a tiny shuffle capacity; the grow retry doubles
     it until the exchange fits, result exact."""

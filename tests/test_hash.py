@@ -45,6 +45,7 @@ F64_MAX = struct.unpack("<d", struct.pack("<Q", 0x7FEFFFFFFFFFFFFF))[0]
 # --- murmur3-32 vectors (HashTest.java:47-151) -----------------------------------
 
 
+@pytest.mark.slow
 def test_murmur_strings():
     col = c.strings_column(
         ["a", "B\nc", "dE\"Ā\tā 휠휡\\Fg2'", LONG_STR,
@@ -180,6 +181,7 @@ def test_murmur_int_lists():
     assert result.to_list() == expected.to_list()
 
 
+@pytest.mark.slow
 def test_murmur_string_lists():
     strs = [None, "a", "B\n", "", "dE\"Ā\tā", " 휠휡",
             "A very long (greater than 128 bytes/char string) to test a multi"
@@ -200,6 +202,7 @@ def test_murmur_string_lists():
 # --- xxhash64 vectors (HashTest.java:266-430) ------------------------------------
 
 
+@pytest.mark.slow
 def test_xxhash64_strings():
     col = c.strings_column(
         ["a", "B\nc", "dE\"Ā\tā 휠휡\\Fg2'", LONG_STR,
@@ -316,6 +319,7 @@ def test_xxhash64_mixed():
 # --- decimal128 (bigdecimal byte path) vs oracle ---------------------------------
 
 
+@pytest.mark.slow
 def test_decimal128_hash_vs_oracle():
     vals = [0, 1, -1, 255, -255, 10**20, -(10**20), (1 << 127) - 1, -(1 << 127),
             0x00FF, 0x7F, -0x80, -0x100, 12345678901234567890123456789012345678]
@@ -434,6 +438,7 @@ def test_murmur_deep_list_vs_oracle():
         assert got[r] == oracle.to_signed32(h), f"row {r}"
 
 
+@pytest.mark.slow
 def test_skewed_string_lengths_hash():
     # one 4KB outlier among many short rows: bucketing must keep this exact
     rng = random.Random(3)

@@ -80,10 +80,12 @@ def run_and_compare(hists, percentages, dtype=INT32):
         assert g == pytest.approx(w, abs=0, rel=0) if w else g == [], (h, g, w)
 
 
+@pytest.mark.slow
 def test_percentile_basic_median():
     run_and_compare([[(1, 2), (2, 1), (3, 1)]], [0.5])
 
 
+@pytest.mark.slow
 def test_percentile_multiple_percentages():
     hists = [
         [(10, 1), (20, 3), (30, 2)],
@@ -93,6 +95,7 @@ def test_percentile_multiple_percentages():
     run_and_compare(hists, [0.0, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0])
 
 
+@pytest.mark.slow
 def test_percentile_random_vs_oracle():
     rng = np.random.RandomState(17)
     hists = []
@@ -109,6 +112,7 @@ def test_percentile_float64_values():
     run_and_compare(hists, [0.5, 0.9], dtype=FLOAT64)
 
 
+@pytest.mark.slow
 def test_percentile_null_values_ignored():
     # One null element per histogram, sorted last, excluded from interpolation.
     hists_with_null = [[(None, 1), (1, 2), (5, 2)], [(None, 3)]]
@@ -120,6 +124,7 @@ def test_percentile_null_values_ignored():
     assert got == pytest.approx(percentile_oracle([(1, 2), (5, 2)], [0.5])[0])
 
 
+@pytest.mark.slow
 def test_percentile_flat_output_with_nulls():
     inp = make_histograms([[(4, 2)], [(None, 1)]])
     out = percentile_from_histogram(inp, [0.5], output_as_list=False)

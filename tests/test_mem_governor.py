@@ -261,6 +261,7 @@ def test_block_time_metric(gov):
             f.result(timeout=15)
 
 
+@pytest.mark.slow
 def test_livelock_cap_raises_real_oom(gov):
     arb, tid = gov.arbiter, current_thread_id()
     gov.current_thread_is_dedicated_to_task(1)

@@ -88,6 +88,7 @@ def test_profiler_inactive_records_nothing():
     assert list(parse_capture(sink.getvalue())) == []
 
 
+@pytest.mark.slow
 def test_convert_cli(tmp_path):
     path = tmp_path / "c.srtp"
     Profiler.init(str(path))
@@ -207,6 +208,7 @@ def test_env_var_activation(tmp_path, monkeypatch):
         ops.xxhash64([column([1], INT32)])
 
 
+@pytest.mark.slow
 def test_profiler_real_pipeline_capture(tmp_path):
     """Golden-shape test over a REAL profiled run: a governed distributed
     q97 under the profiler must capture op, transfer, and collective ranges

@@ -30,6 +30,7 @@ def test_bucket_by_partition_ranks():
         assert s // 4 == p
 
 
+@pytest.mark.slow
 def test_bucket_by_partition_overflow():
     part = jnp.zeros(5, dtype=jnp.int32)
     slot, in_cap, counts = bucket_by_partition(part, 2, capacity=3)
@@ -37,6 +38,7 @@ def test_bucket_by_partition_overflow():
 
 
 @pytest.mark.parametrize("ndev", [2, 4, 8])
+@pytest.mark.slow
 def test_all_to_all_shuffle_routes_rows(ndev):
     mesh = make_mesh((ndev, 1), devices=jax.devices()[:ndev])
     n_local = 16
@@ -73,6 +75,7 @@ def test_all_to_all_shuffle_routes_rows(ndev):
 
 
 @pytest.mark.parametrize("shape", [(8, 1), (4, 2), (2, 4)])
+@pytest.mark.slow
 def test_distributed_query_step(shape):
     dp, mp = shape
     mesh = make_mesh(shape)
@@ -92,6 +95,7 @@ def test_distributed_query_step(shape):
     assert int(out.probe_hits) == rows
 
 
+@pytest.mark.slow
 def test_distributed_matches_single_chip_totals():
     mesh = make_mesh((8, 1))
     cfg = QueryStepConfig(n_buckets=64, bloom_bits=1 << 12, bloom_hashes=3)

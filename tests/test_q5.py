@@ -18,6 +18,7 @@ from spark_rapids_jni_tpu.models.q5 import (
 )
 from spark_rapids_jni_tpu.models.tpcds import CHANNELS, generate_q5_data
 from spark_rapids_jni_tpu.parallel import make_mesh
+import pytest
 
 NDEV = 8
 
@@ -62,12 +63,14 @@ def _oracle(data):
     return rows
 
 
+@pytest.mark.slow
 def test_q5_local_matches_oracle():
     data = generate_q5_data(sf=0.02, seed=5)
     got = [tuple(r) for r in q5_local(data)]
     assert got == _oracle(data)
 
 
+@pytest.mark.slow
 def test_q5_local_zero_price_group_kept():
     data = generate_q5_data(sf=0.01, seed=6)
     ch = data.channels["store"]
@@ -79,6 +82,7 @@ def test_q5_local_zero_price_group_kept():
     assert got == _oracle(data)
 
 
+@pytest.mark.slow
 def test_q5_distributed_matches_local_and_oracle():
     data = generate_q5_data(sf=0.05, seed=7)
     mesh = make_mesh((NDEV, 1), devices=jax.devices()[:NDEV])

@@ -20,6 +20,7 @@ def _oracle(store, catalog):
     return len(s - c), len(c - s), len(s & c)
 
 
+@pytest.mark.slow
 def test_q97_local_matches_oracle():
     rng = np.random.RandomState(7)
     store = _gen(rng, 500, 40, 25)
@@ -45,6 +46,7 @@ def test_q97_empty_and_disjoint():
 
 
 @pytest.mark.parametrize("shape", [(8, 1), (4, 2)])
+@pytest.mark.slow
 def test_q97_distributed_matches_oracle(shape):
     if len(jax.devices()) < shape[0] * shape[1]:
         pytest.skip("needs 8 devices")
@@ -61,6 +63,7 @@ def test_q97_distributed_matches_oracle(shape):
     assert int(out.dropped) == 0
 
 
+@pytest.mark.slow
 def test_q97_capacity_overflow_reported():
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 devices")

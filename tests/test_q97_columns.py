@@ -14,6 +14,7 @@ from spark_rapids_jni_tpu.columnar.column import Column, column
 from spark_rapids_jni_tpu.columnar.dtypes import INT32
 from spark_rapids_jni_tpu.models.q97 import make_distributed_q97_columns
 from spark_rapids_jni_tpu.parallel import DATA_AXIS, make_mesh
+import pytest
 
 NDEV = 8
 
@@ -74,6 +75,7 @@ def _gen(rng, n, null_pct=0.15, hi=40):
     return cust, item
 
 
+@pytest.mark.slow
 def test_nullable_q97_matches_sql_oracle():
     rng = np.random.RandomState(21)
     store = _gen(rng, 40 * NDEV)
@@ -81,6 +83,7 @@ def test_nullable_q97_matches_sql_oracle():
     assert _run(store, catalog) == _oracle(store, catalog)
 
 
+@pytest.mark.slow
 def test_nullable_q97_no_nulls_agrees_with_plain_path():
     rng = np.random.RandomState(22)
     store = _gen(rng, 16 * NDEV, null_pct=0.0)
@@ -98,6 +101,7 @@ def test_nullable_q97_no_nulls_agrees_with_plain_path():
     assert got == (int(loc.store_only), int(loc.catalog_only), int(loc.both))
 
 
+@pytest.mark.slow
 def test_all_null_sides():
     """Every store row has a null key: nothing can join."""
     rng = np.random.RandomState(23)
@@ -119,6 +123,7 @@ def test_same_null_pair_both_sides_does_not_join():
     assert so == 1 and co == 1
 
 
+@pytest.mark.slow
 def test_null_slots_with_garbage_data_group_correctly():
     """Invalid slots may hold arbitrary data bits (review r3 finding): two
     logically-(NULL, i) rows with different garbage must form ONE group."""

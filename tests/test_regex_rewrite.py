@@ -5,6 +5,7 @@ import random
 
 from spark_rapids_jni_tpu.columnar.column import strings_column
 from spark_rapids_jni_tpu.ops import literal_range_pattern
+import pytest
 
 
 def _oracle(s, prefix, range_len, start, end):
@@ -34,6 +35,7 @@ def test_literal_range_pattern_chinese():
     assert got == [False, True, True, False]
 
 
+@pytest.mark.slow
 def test_literal_range_pattern_nulls_and_fuzz():
     rng = random.Random(7)
     alphabet = "ab1英伟9x"

@@ -95,6 +95,7 @@ def test_layout_matches_javadoc_example():
     assert starts == [0, 2, 4] and voff == 8 and spr == 9
 
 
+@pytest.mark.slow
 def test_fixed_width_bytes_exact():
     cols = [
         column([True, False, None], BOOL),
@@ -119,6 +120,7 @@ def test_decimal128_bytes_exact():
     assert _batch_rows_bytes(batch) == want
 
 
+@pytest.mark.slow
 def test_strings_bytes_exact():
     cols = [
         column([1, 2, 3], INT32),
@@ -131,6 +133,7 @@ def test_strings_bytes_exact():
     assert _batch_rows_bytes(batch) == jcudf_oracle(rows, dtypes)
 
 
+@pytest.mark.slow
 def test_round_trip_mixed():
     rng = np.random.RandomState(5)
     n = 257
@@ -153,6 +156,7 @@ def test_round_trip_mixed():
         assert orig.to_list() == b.to_list()
 
 
+@pytest.mark.slow
 def test_round_trip_decimal128():
     vals = [3, -(10**30), None, 10**37, -7]
     cols = [decimal128_column(vals, 38, 4)]
@@ -162,6 +166,7 @@ def test_round_trip_decimal128():
     assert back[0].dtype.scale == 4
 
 
+@pytest.mark.slow
 def test_many_columns_validity():
     # >8 columns exercises multiple validity bytes
     n = 20
@@ -178,6 +183,7 @@ def test_many_columns_validity():
         assert orig.to_list() == b.to_list()
 
 
+@pytest.mark.slow
 def test_batching_splits_on_32_row_boundaries():
     n = 100
     cols = [column(list(range(n)), INT64)]
@@ -205,6 +211,7 @@ def test_oversized_row_raises():
         convert_to_rows([column([1, 2], INT64)], max_batch_bytes=8)
 
 
+@pytest.mark.slow
 def test_fixed_width_optimized_limits():
     with pytest.raises(TypeError):
         convert_to_rows_fixed_width_optimized([strings_column(["a"])])
@@ -215,6 +222,7 @@ def test_fixed_width_optimized_limits():
     assert convert_from_rows_fixed_width_optimized(ok[0], [INT32])[0].to_list() == [1, 2]
 
 
+@pytest.mark.slow
 def test_row_alignment():
     [batch] = convert_to_rows([column([1], INT8), strings_column(["abc"])])
     offs = np.asarray(batch.offsets)

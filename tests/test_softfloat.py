@@ -8,6 +8,8 @@ import numpy as np
 
 import jax.numpy as jnp
 
+import pytest
+
 from spark_rapids_jni_tpu.utils.softfloat import (
     f64_div_bits,
     f64_mul_bits,
@@ -33,6 +35,7 @@ def _rand_doubles(rng, n, include_special=True):
     return bits.view(np.float64)
 
 
+@pytest.mark.slow
 def test_u64_to_f64_exact_and_rounded():
     rng = np.random.RandomState(1)
     xs = np.concatenate([
@@ -48,6 +51,7 @@ def test_u64_to_f64_exact_and_rounded():
     assert not bad.any(), (xs[bad][:5], got[bad][:5], want[bad][:5])
 
 
+@pytest.mark.slow
 def test_mul_matches_hardware():
     rng = np.random.RandomState(2)
     a = _rand_doubles(rng, 6000)
@@ -61,6 +65,7 @@ def test_mul_matches_hardware():
     assert not bad.any(), list(zip(a[bad][:5], b[bad][:5], got[bad][:5], want[bad][:5]))
 
 
+@pytest.mark.slow
 def test_mul_subnormal_outputs():
     rng = np.random.RandomState(3)
     # products that land in/near the subnormal range
@@ -71,6 +76,7 @@ def test_mul_subnormal_outputs():
     assert (got == want).all()
 
 
+@pytest.mark.slow
 def test_div_matches_hardware():
     rng = np.random.RandomState(4)
     a = _rand_doubles(rng, 5000, include_special=False)
@@ -81,6 +87,7 @@ def test_div_matches_hardware():
     assert not bad.any(), list(zip(a[bad][:5], b[bad][:5], got[bad][:5], want[bad][:5]))
 
 
+@pytest.mark.slow
 def test_div_pow10_table_domain():
     """The exact shapes string_to_float uses: digits / 10^k and * 10^k."""
     rng = np.random.RandomState(5)
@@ -95,6 +102,7 @@ def test_div_pow10_table_domain():
     assert (got_div == _bits(d / p10)).all()
 
 
+@pytest.mark.slow
 def test_div_and_mul_special_cases():
     cases = [
         (0.0, 5.0), (-0.0, 5.0), (5.0, np.inf), (np.inf, 5.0),
@@ -109,6 +117,7 @@ def test_div_and_mul_special_cases():
     assert (gd == _bits(a / b)).all(), (gd, _bits(a / b))
 
 
+@pytest.mark.slow
 def test_f64_to_f32_cast():
     from spark_rapids_jni_tpu.utils.softfloat import f64_bits_to_f32_bits
 

@@ -116,6 +116,7 @@ def _received(ex):
     return valid, k
 
 
+@pytest.mark.slow
 def test_no_rows_dropped(shuffled):
     ex, rows, capacity = shuffled
     assert int(np.asarray(ex.dropped).sum()) == 0
@@ -174,6 +175,7 @@ def test_string_column_rejected_without_padding():
         shuffle_table({"s": scol}, jnp.zeros(2, jnp.int32), 2)
 
 
+@pytest.mark.slow
 def test_capacity_overflow_reports_dropped_and_recovers():
     """Skewed keys overflow a small capacity (dropped > 0, the shuffle-spill
     signal the governed runners grow on); a doubled capacity recovers all
@@ -209,6 +211,7 @@ def test_capacity_overflow_reports_dropped_and_recovers():
     assert big_dropped == 0 and big_received == n
 
 
+@pytest.mark.slow
 def test_jcudf_row_bytes_ride_the_exchange():
     """SURVEY §7.8's original plan — 'all_to_all of serialized row batches,
     reuses the row conversion' (row_conversion.cu:574 exists to serialize

@@ -104,6 +104,7 @@ def test_interleave_single_column_identity_bytes():
     "dtype,width_bits,lo,hi",
     [(INT8, 8, -128, 128), (INT16, 16, -(2**15), 2**15), (INT64, 64, -(2**63), 2**63)],
 )
+@pytest.mark.slow
 def test_interleave_other_widths(dtype, width_bits, lo, hi):
     rng = np.random.RandomState(9)
     a = [int(v) for v in rng.randint(lo, hi, size=30)]
