@@ -1,0 +1,74 @@
+"""Worker process for the 2-process multihost test (not a test module).
+
+Each process joins the JAX process group through the framework's own
+``multihost.initialize`` (explicit coordinator args — the CPU-cluster /
+test path), builds the pod mesh, and runs the distributed q97 query step
+over globally-sharded inputs.  Prints one JSON line with the process
+summary and the q97 totals; the parent test asserts both processes agree
+with the local oracle.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    pid = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    coord = sys.argv[3]
+
+    from spark_rapids_jni_tpu.parallel import multihost
+
+    multihost.initialize(coordinator_address=coord,
+                         num_processes=nproc, process_id=pid)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_rapids_jni_tpu.models.q97 import make_distributed_q97, q97_local
+
+    assert multihost.is_multihost()
+    mesh = multihost.make_pod_mesh(mp=1, axis_names=("data", "model"))
+    ndev = len(jax.devices())
+
+    # identical global inputs in every process (deterministic seed); each
+    # process donates its local shards via make_array_from_callback
+    rng = np.random.RandomState(11)
+    rows = 512
+    glb = [rng.randint(1, 50, rows).astype(np.int32) for _ in range(4)]
+
+    spec = jax.sharding.PartitionSpec("data")
+    sharding = jax.sharding.NamedSharding(mesh, spec)
+
+    def to_global(a):
+        return jax.make_array_from_callback(
+            a.shape, sharding, lambda idx: a[idx])
+
+    args = [to_global(a) for a in glb]
+    step = make_distributed_q97(mesh, capacity=rows)
+    out = step(*args)
+    got = {
+        "store_only": int(out.store_only),
+        "catalog_only": int(out.catalog_only),
+        "both": int(out.both),
+        "dropped": int(out.dropped),
+    }
+    want_out = q97_local((jnp.asarray(glb[0]), jnp.asarray(glb[1])),
+                         (jnp.asarray(glb[2]), jnp.asarray(glb[3])))
+    want = {
+        "store_only": int(want_out.store_only),
+        "catalog_only": int(want_out.catalog_only),
+        "both": int(want_out.both),
+        "dropped": 0,
+    }
+    print(json.dumps({"proc": pid, "summary": multihost.process_summary(),
+                      "got": got, "want": want, "ndev": ndev}), flush=True)
+    return 0 if got == want else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,81 @@
+"""Two real OS processes form a JAX process group and run distributed q97.
+
+This is the closest a single box gets to the multi-host claim: each
+process owns 2 virtual CPU devices, ``multihost.initialize`` joins them
+through a real coordinator, ``make_pod_mesh`` spans all 4 global devices,
+and the SAME shard_map q97 program that runs single-process executes with
+cross-process collectives.  (On a pod, the identical code path rides
+ICI/DCN — SURVEY.md §2.3's planning note.)
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_group_runs_distributed_q97():
+    # one retry with a fresh port: _free_port's close-then-bind window can
+    # race another process on a shared box
+    try:
+        _run_group_once()
+    except Exception:
+        _run_group_once()
+
+
+def _run_group_once():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    for k in [k for k in env if k.startswith("TPU_")]:
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["SRT_REEXECED"] = "1"  # boot_cpu_mesh must not re-exec the workers
+
+    coord = f"127.0.0.1:{_free_port()}"
+    worker = os.path.join(_HERE, "multihost_worker.py")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(pid), "2", coord],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            try:
+                out, err = p.communicate(timeout=600)
+            except subprocess.TimeoutExpired:
+                pytest.fail("multihost worker hung")
+            assert p.returncode == 0, err.strip().splitlines()[-5:]
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        # a failure on worker 0 must not leak worker 1 blocked on the
+        # dead coordinator for the rest of the session
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+
+    for rec in outs:
+        assert rec["got"] == rec["want"], rec
+        assert rec["summary"]["process_count"] == 2
+        assert rec["summary"]["local_devices"] == 2
+        assert rec["summary"]["global_devices"] == 4
+    # the two processes saw the same global result
+    assert outs[0]["got"] == outs[1]["got"]
