@@ -33,7 +33,8 @@ from spark_rapids_jni_tpu.utils import int256
 
 from spark_rapids_jni_tpu.ops.float_to_string import (
     _decimal_length_u64,
-    _POW10_U64 as _P10_U64,
+    digit_from_table,
+    digit_table_u64,
 )
 
 _U64 = jnp.uint64
@@ -53,11 +54,14 @@ def _digits_1919(h19, l19):
     )
 
 
-def _digit_1919(h19, l19, k):
-    """digit k (from the right) of h19 * 10^19 + l19 as uint8 char."""
-    lo_d = (l19 // _P10_U64[jnp.clip(k, 0, 19)]) % _U64(10)
-    hi_d = (h19 // _P10_U64[jnp.clip(k - 19, 0, 19)]) % _U64(10)
-    return jnp.where(k < 19, lo_d, hi_d).astype(jnp.uint8) + jnp.uint8(ord("0"))
+def _digit_table_1919(h19, l19) -> jnp.ndarray:
+    """``[n, 39]`` uint8 digits (from the right) of h19 * 10^19 + l19.
+
+    Two constant-divisor digit tables concatenated — replaces per-grid-cell
+    u64 division with a variable power-of-10 (the axon compile pathology;
+    see float_to_string.digit_table_u64)."""
+    return jnp.concatenate(
+        [digit_table_u64(l19, 19), digit_table_u64(h19, 20)], axis=-1)
 
 
 def _split_1919(hi, lo):
@@ -132,13 +136,13 @@ def decimal_to_string(col) -> StringColumn:
     p = jnp.arange(MAX_LEN, dtype=_I32)[None, :]
     sC, ilC, KC = s[:, None], il[:, None], K[:, None]
     in_int = (p >= sC) & (p < sC + ilC)
-    int_digit = _digit_1919(
-        ih19[:, None], il19[:, None], ilC - 1 - (p - sC)
-    )
+    int_digit = digit_from_table(
+        _digit_table_1919(ih19, il19), ilC - 1 - (p - sC))
     dot_pos = sC + ilC
     frac_t = p - (dot_pos + 1)
     in_frac = has_dot[:, None] & (frac_t >= 0) & (frac_t < KC)
-    frac_digit = _digit_1919(fh19[:, None], fl19[:, None], KC - 1 - frac_t)
+    frac_digit = digit_from_table(
+        _digit_table_1919(fh19, fl19), KC - 1 - frac_t)
     pE = dot_pos + jnp.where(has_dot, 1 + K, 0)[:, None]
     exp_t = p - (pE + 2)
     elenC = elen[:, None]

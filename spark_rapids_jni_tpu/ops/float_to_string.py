@@ -51,6 +51,36 @@ def _u64(x):
     return jnp.asarray(x, dtype=jnp.uint64)
 
 
+def digit_table_u64(v, maxd: int = 20) -> jnp.ndarray:
+    """``[n, maxd]`` uint8 decimal digits of u64 ``v``, index k = digit from
+    the RIGHT (ones digit at k=0), zero-padded above the value's length.
+
+    Built by an unrolled divide-by-constant-10 chain: each step is a
+    strength-reduced multiply-high, so the whole table costs ~maxd cheap row
+    ops.  Renderers then *gather* from it per output position — replacing
+    per-grid-cell ``v // 10^k`` with a variable k, whose emulated-u64
+    general division is the dominant term in the axon TPU compile-time
+    pathology on the string-rendering ops (docs/PERF.md)."""
+    ten = _U64(10)
+    cols = []
+    for _ in range(maxd):
+        cols.append((v % ten).astype(jnp.uint8))
+        v = v // ten
+    return jnp.stack(cols, axis=-1)
+
+
+def digit_from_table(tab: jnp.ndarray, k) -> jnp.ndarray:
+    """ASCII digit chars gathered at (broadcast) right-index ``k``; out-of-
+    range k clamps (callers mask those positions anyway)."""
+    maxd = tab.shape[-1]
+    kc = jnp.clip(k, 0, maxd - 1)
+    if kc.ndim == tab.ndim - 1:
+        kc = kc[..., None]
+        return jnp.take_along_axis(tab, kc, axis=-1)[..., 0] + jnp.uint8(
+            ord("0"))
+    return jnp.take_along_axis(tab, kc, axis=-1) + jnp.uint8(ord("0"))
+
+
 def _umul128(a, b):
     """(hi, lo) of the full 128-bit product of two u64 lane arrays."""
     a_lo, a_hi = a & _M32, a >> _U64(32)
@@ -338,11 +368,10 @@ def _emit(output, exp10, negative, special_id, is_float):
     plain_big = normal & ~sci & (exp >= 0) & (exp + 1 >= olength)
     plain_mid = normal & ~sci & (exp >= 0) & (exp + 1 < olength)
     sci_m = normal & sci
+    out_tab = digit_table_u64(output, max_digits)
     for k in range(max_digits):
         have = olength > k
-        digit = (
-            (output // _POW10_U64[jnp.clip(olength - 1 - k, 0, 19)]) % _U64(10)
-        ).astype(jnp.uint8) + jnp.uint8(ord("0"))
+        digit = digit_from_table(out_tab, olength - 1 - k)
         kk = _I32(k)
         writes.append(put(s + kk + (1 if k > 0 else 0), digit, sci_m & have))
         writes.append(put(s + 2 + (-exp - 1) + kk, digit, plain_neg & have))

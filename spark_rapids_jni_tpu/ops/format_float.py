@@ -33,6 +33,8 @@ from spark_rapids_jni_tpu.ops.float_to_string import (
     _d2d,
     _decimal_length_u64,
     _f2d,
+    digit_from_table,
+    digit_table_u64,
 )
 from spark_rapids_jni_tpu.utils.floatbits import f32_to_bits
 
@@ -49,11 +51,9 @@ def _round_half_even(value, olength, digits):
     return jnp.where(digits >= olength, value, num + inc.astype(jnp.uint64))
 
 
-def _digit_at(value, k):
-    """decimal digit k (from the right) of u64 ``value`` as uint8 char."""
-    return ((value // _POW10_U64[jnp.clip(k, 0, 19)]) % _U64(10)).astype(
-        jnp.uint8
-    ) + jnp.uint8(ord("0"))
+# (Per-row digit tables + gathers — digit_table_u64/digit_from_table in the
+# import block above — replace the per-grid-cell u64 division with a variable
+# power-of-10 divisor that dominated the axon compile-time pathology.)
 
 
 def format_float(col: Column, digits: int, width_hint: int = 0) -> StringColumn:
@@ -171,6 +171,8 @@ def format_float(col: Column, digits: int, width_hint: int = 0) -> StringColumn:
         jnp.uint8(ord("-")),
     )
     out = jnp.zeros((n, width), jnp.uint8)
+    tab_r1 = digit_table_u64(r1)
+    tab_dec3 = digit_table_u64(dec3)
 
     # branch 1 grid
     in_zeros = (p >= sC + 2) & (p < sC + 2 + nz[:, None])
@@ -187,7 +189,7 @@ def format_float(col: Column, digits: int, width_hint: int = 0) -> StringColumn:
                 jnp.where(carry1[:, None] & (p == carrier_pos[:, None]), ONE, ZERO),
                 jnp.where(
                     in_val1,
-                    _digit_at(r1[:, None], aol1[:, None] - 1 - j1),
+                    digit_from_table(tab_r1, aol1[:, None] - 1 - j1),
                     ZERO,  # trailing zeros
                 ),
             ),
@@ -195,7 +197,7 @@ def format_float(col: Column, digits: int, width_hint: int = 0) -> StringColumn:
     )
 
     # branches 2/3 grid: integer section with commas, then '.', fraction
-    int_val = jnp.where(b2[:, None], output[:, None], int3[:, None])
+    tab_int = digit_table_u64(jnp.where(b2, output, int3))
     z = jnp.where(b2, z2, 0)[:, None]
     fl = int_fl[:, None]
     q = fl - 1 - (p - sC)  # distance from right within the integer section
@@ -203,14 +205,14 @@ def format_float(col: Column, digits: int, width_hint: int = 0) -> StringColumn:
     is_comma = in_int & (q % 4 == 3)
     dr = q - q // 4  # digit index from the right
     int_digit = jnp.where(
-        dr < z, ZERO, _digit_at(int_val, jnp.maximum(dr - z, 0))
+        dr < z, ZERO, digit_from_table(tab_int, jnp.maximum(dr - z, 0))
     )
     frac_t = p - (sC + fl + 1)  # fraction digit index (0-based)
     in_frac = (frac_t >= 0) & (frac_t < D)
     # branch 2 fraction is all zeros; branch 3: temp_d digits then zeros
     frac_digit = jnp.where(
         b3[:, None] & (frac_t < temp_d[:, None]),
-        _digit_at(dec3[:, None], temp_d[:, None] - 1 - frac_t),
+        digit_from_table(tab_dec3, temp_d[:, None] - 1 - frac_t),
         ZERO,
     )
     ch23 = jnp.where(

@@ -30,12 +30,16 @@ def _free_port() -> int:
 
 
 def test_two_process_group_runs_distributed_q97():
-    # one retry with a fresh port: _free_port's close-then-bind window can
-    # race another process on a shared box
+    # one retry with a fresh port, ONLY for the _free_port close-then-bind
+    # race; real failures (wrong results, hangs) must surface first-run
     try:
         _run_group_once()
-    except Exception:
-        _run_group_once()
+    except AssertionError as e:
+        markers = ("Address already in use", "Failed to bind", "UNAVAILABLE")
+        if any(m in str(e) for m in markers):
+            _run_group_once()
+        else:
+            raise
 
 
 def _run_group_once():
