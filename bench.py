@@ -318,6 +318,18 @@ def main():
 
     _stage(detail, "q5_rollup", _q5, nbytes=int(min(n, 1 << 22) * 8))
 
+    def _q3():
+        from spark_rapids_jni_tpu.models import generate_q3_data, q3_local
+
+        sf = min(1.0, max(0.05, n / (1 << 24)))
+        data = generate_q3_data(sf=sf, seed=42)
+        rows_total = len(data.ss_item_sk)
+        dt = _time(lambda: tuple(q3_local(data)), max(iters // 8, 2))
+        return {"Mrows_per_s": round(rows_total / dt / 1e6, 2),
+                "fact_rows": rows_total}
+
+    _stage(detail, "q3_star_join", _q3, nbytes=int(min(n, 1 << 22) * 8))
+
     gov.task_done(0)
     MemoryGovernor.shutdown()
 

@@ -1,0 +1,208 @@
+"""NDS q3: star join (store_sales x item x date_dim) + grouped aggregation.
+
+    select d_year, i_brand_id, i_brand, sum(ss_ext_sales_price)
+    from date_dim, store_sales, item
+    where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+      and i_manufact_id = M and d_moy = 11
+    group by d_year, i_brand_id, i_brand
+    order by d_year, sum_agg desc, i_brand_id
+
+Third query pattern in the models family (q97 = shuffle join-count, q5 =
+broadcast rollup): a selective dimension FILTER pushed through two dense
+dimension joins into one grouped money aggregation.  TPU shape: both
+dimensions are dense surrogate-keyed, so each join is a replicated-table
+gather; the group key (d_year, i_brand_id) lives in a small dense product
+space, so the aggregation is one masked segment-sum into a
+[n_years * n_brands] grid and the distributed form psums that grid over
+the data axis — no row exchange, same as q5's partials.
+
+Money stays unscaled int64 cents (decimal scale 2) end to end; brand
+STRINGS materialize only in the host-formatted result rows.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.sharding import PartitionSpec as P
+
+from spark_rapids_jni_tpu.models.tpcds import Q3Data
+from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS
+
+__all__ = ["Q3Row", "q3_local", "make_distributed_q3", "run_distributed_q3"]
+
+
+class Q3Row(NamedTuple):
+    d_year: int
+    brand_id: int
+    brand: str
+    sum_agg: int  # cents
+
+
+class _Partials(NamedTuple):
+    sums: jnp.ndarray  # [n_years * n_brands] int64 cents
+    counts: jnp.ndarray  # [n_years * n_brands] int32
+
+
+def _partials(ss_item, ss_item_v, ss_date, ss_date_v, price,
+              item_brand, item_manufact, date_year, date_moy,
+              *, n_brands: int, year0: int, n_years: int,
+              date_sk0: int, manufact_id: int, moy: int) -> _Partials:
+    """Device body over [rows] facts; dims are replicated dense tables."""
+    i_idx = jnp.clip(ss_item - 1, 0, item_brand.shape[0] - 1)
+    d_idx = jnp.clip(ss_date - date_sk0, 0, date_year.shape[0] - 1)
+    ok = (
+        ss_item_v & ss_date_v
+        & (item_manufact[i_idx] == manufact_id)
+        & (date_moy[d_idx] == moy)
+    )
+    brand = item_brand[i_idx].astype(jnp.int32)  # 1-based
+    year_off = (date_year[d_idx] - year0).astype(jnp.int32)
+    group = jnp.clip(year_off, 0, n_years - 1) * n_brands + (brand - 1)
+    ngroups = n_years * n_brands
+    sums = jnp.zeros((ngroups,), jnp.int64).at[group].add(
+        jnp.where(ok, price, 0), mode="drop")
+    counts = jnp.zeros((ngroups,), jnp.int32).at[group].add(
+        jnp.where(ok, 1, 0), mode="drop")
+    return _Partials(sums, counts)
+
+
+def _format(parts: _Partials, data: Q3Data, year0: int) -> List[Q3Row]:
+    """Host: drop empty groups, order by (d_year, sum desc, brand_id)."""
+    n_brands = len(data.brand_names)
+    sums = np.asarray(parts.sums)
+    counts = np.asarray(parts.counts)
+    rows: List[Q3Row] = []
+    for g in np.nonzero(counts)[0]:
+        year = year0 + int(g) // n_brands
+        b = int(g) % n_brands + 1
+        rows.append(Q3Row(year, b, data.brand_names[b - 1], int(sums[g])))
+    rows.sort(key=lambda r: (r.d_year, -r.sum_agg, r.brand_id))
+    return rows
+
+
+def _geometry(data: Q3Data):
+    year0 = int(data.date_year.min())
+    n_years = int(data.date_year.max()) - year0 + 1
+    return dict(
+        n_brands=len(data.brand_names), year0=year0, n_years=n_years,
+        date_sk0=int(data.date_sk[0]), manufact_id=data.manufact_id,
+        moy=data.moy,
+    )
+
+
+def _facts(data: Q3Data) -> dict:
+    return dict(
+        ss_item=data.ss_item_sk, ss_item_v=data.ss_item_sk_valid,
+        ss_date=data.ss_sold_date_sk, ss_date_v=data.ss_sold_date_sk_valid,
+        price=data.ss_ext_sales_price,
+    )
+
+
+def _dims(data: Q3Data) -> dict:
+    # raw numpy: q3_local's jnp ops take them directly, and
+    # run_distributed_q3 device_puts them with a replicated sharding
+    # (no device->host->device round-trip)
+    return dict(
+        item_brand=data.item_brand_id,
+        item_manufact=data.item_manufact_id,
+        date_year=data.date_year,
+        date_moy=data.date_moy,
+    )
+
+
+def q3_local(data: Q3Data) -> List[Q3Row]:
+    """Single-chip q3."""
+    geo = _geometry(data)
+    parts = _partials(
+        *(jnp.asarray(v) for v in _facts(data).values()),
+        **{k: jnp.asarray(v) for k, v in _dims(data).items()}, **geo)
+    return _format(parts, data, geo["year0"])
+
+
+def make_distributed_q3(mesh, data: Q3Data):
+    """jit-compiled distributed q3 partials: facts sharded over DATA_AXIS,
+    dims replicated, group grid psum'd (the q5 partials pattern)."""
+    geo = _geometry(data)
+
+    def body(ss_item, ss_item_v, ss_date, ss_date_v, price,
+             item_brand, item_manufact, date_year, date_moy):
+        p = _partials(ss_item, ss_item_v, ss_date, ss_date_v, price,
+                      item_brand, item_manufact, date_year, date_moy, **geo)
+        return _Partials(*(jax.lax.psum(x, (DATA_AXIS,)) for x in p))
+
+    step = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(DATA_AXIS),) * 5 + (P(),) * 4,
+        out_specs=_Partials(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(step)
+
+
+def _pad_facts(facts: dict, dp: int) -> dict:
+    n = len(facts["ss_item"])
+    pad = (-n) % dp
+    if pad == 0:
+        return facts
+    out = {k: np.concatenate([v, np.zeros(pad, v.dtype)])
+           for k, v in facts.items()}
+    out["ss_item_v"][-pad:] = False
+    out["ss_date_v"][-pad:] = False
+    return out
+
+
+def _split_facts(facts: dict):
+    n = len(facts["ss_item"])
+    return [{k: v[:n // 2] for k, v in facts.items()},
+            {k: v[n // 2:] for k, v in facts.items()}]
+
+
+def run_distributed_q3(mesh, data: Q3Data, *, budget=None, task_id: int = 0,
+                       manage_task: bool = True) -> List[Q3Row]:
+    """Governed distributed q3: launches admitted through the memory
+    arbiter; SplitAndRetryOOM halves fact rows (exact: sums/counts are
+    additive) and partials combine by addition."""
+    import contextlib
+
+    from spark_rapids_jni_tpu.mem.governed import (
+        default_device_budget,
+        run_with_split_retry,
+        task_context,
+    )
+
+    from jax.sharding import NamedSharding
+
+    geo = _geometry(data)
+    dp = mesh.shape[DATA_AXIS]
+    step = make_distributed_q3(mesh, data)
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    rep = NamedSharding(mesh, P())
+    dims = {k: jax.device_put(v, rep) for k, v in _dims(data).items()}
+
+    def nbytes_of(facts):
+        return sum(v.nbytes for v in facts.values()) * 3
+
+    def run(facts):
+        padded = _pad_facts(facts, dp)
+        dev = [jax.device_put(np.ascontiguousarray(v), sharding)
+               for v in padded.values()]
+        out = step(*dev, *dims.values())
+        return _Partials(*(np.asarray(x) for x in out))
+
+    def combine(results):
+        return _Partials(*(sum(r[i] for r in results)
+                           for i in range(len(results[0]))))
+
+    budget = budget if budget is not None else default_device_budget()
+    ctx = (task_context(budget.gov, task_id) if manage_task
+           else contextlib.nullcontext())
+    with ctx:
+        parts = run_with_split_retry(
+            budget, _facts(data), nbytes_of=nbytes_of, run=run,
+            split=_split_facts, combine=combine)
+    return _format(parts, data, geo["year0"])

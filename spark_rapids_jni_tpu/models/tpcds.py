@@ -19,7 +19,8 @@ from typing import Dict
 
 import numpy as np
 
-__all__ = ["Q5Data", "generate_q5_data", "CHANNELS"]
+__all__ = ["Q3Data", "Q5Data", "generate_q3_data", "generate_q5_data",
+           "CHANNELS"]
 
 # (channel label, fact prefix, dim id prefix) for q5's three channel unions
 CHANNELS = ("store", "catalog", "web")
@@ -127,3 +128,67 @@ def generate_q5_data(sf: float = 0.01, seed: int = 0,
             dim_id=_dim_ids(name[0].upper(), n_dim, rng),
         )
     return Q5Data(channels, date_sk, date_days, lo, hi)
+
+
+@dataclasses.dataclass
+class Q3Data:
+    """q3 table set: store_sales fact + item and date_dim dimensions.
+
+    item: dense surrogate keys 1..n_items, a brand string per item (many
+    items share a brand), and a manufacturer id (the query's filter).
+    date_dim: dense keys with (d_year, d_moy) attributes.
+    """
+
+    ss_item_sk: np.ndarray
+    ss_item_sk_valid: np.ndarray
+    ss_sold_date_sk: np.ndarray
+    ss_sold_date_sk_valid: np.ndarray
+    ss_ext_sales_price: np.ndarray  # int64 cents (decimal scale 2)
+
+    item_sk: np.ndarray  # [n_items] dense 1..n
+    item_brand_id: np.ndarray  # [n_items] int32
+    item_manufact_id: np.ndarray  # [n_items] int32
+    brand_names: list  # [n_brands] strings; brand_id b -> brand_names[b-1]
+
+    date_sk: np.ndarray  # [n_dates] dense keys (from _D0)
+    date_year: np.ndarray
+    date_moy: np.ndarray
+
+    manufact_id: int  # the query's i_manufact_id literal
+    moy: int  # the query's d_moy literal
+
+
+def generate_q3_data(sf: float = 0.01, seed: int = 0,
+                     null_pct: float = 0.04) -> Q3Data:
+    """Generate the q3 table set at scale factor ``sf``."""
+    rng = np.random.RandomState(seed + 3)
+    n_items = max(12, int(200 * sf))
+    n_brands = max(5, n_items // 4)
+    n_manufact = 8
+    n_dates = 3 * 365
+    n_sales = max(16, int(120_000 * sf))
+
+    item_sk = np.arange(1, n_items + 1, dtype=np.int32)
+    item_brand_id = rng.randint(1, n_brands + 1, n_items).astype(np.int32)
+    item_manufact_id = rng.randint(1, n_manufact + 1, n_items).astype(np.int32)
+    brand_names = [f"corpbrand #{b}" for b in range(1, n_brands + 1)]
+
+    date_sk = np.arange(_D0, _D0 + n_dates, dtype=np.int32)
+    date_year = (1998 + np.arange(n_dates) // 365).astype(np.int32)
+    date_moy = (1 + (np.arange(n_dates) % 365) // 31).astype(np.int32)
+
+    i_sk, i_v = _nullable(
+        rng, rng.randint(1, n_items + 1, n_sales).astype(np.int32), null_pct)
+    d_sk, d_v = _nullable(
+        rng, rng.randint(_D0, _D0 + n_dates, n_sales).astype(np.int32),
+        null_pct)
+
+    return Q3Data(
+        ss_item_sk=i_sk, ss_item_sk_valid=i_v,
+        ss_sold_date_sk=d_sk, ss_sold_date_sk_valid=d_v,
+        ss_ext_sales_price=_money(rng, n_sales),
+        item_sk=item_sk, item_brand_id=item_brand_id,
+        item_manufact_id=item_manufact_id, brand_names=brand_names,
+        date_sk=date_sk, date_year=date_year, date_moy=date_moy,
+        manufact_id=int(rng.randint(1, n_manufact + 1)), moy=11,
+    )
