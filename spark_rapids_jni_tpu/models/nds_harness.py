@@ -1,9 +1,10 @@
-"""NDS end-to-end harness: governed q5 + q97 over TPC-DS-shaped data.
+"""NDS end-to-end harness: governed q5 + q97 (+ q3) over TPC-DS-shaped data.
 
 BASELINE config 5 is "NDS TPC-DS q5+q97 end-to-end"; this CLI is the
 framework-native harness for it: generate tables at a scale factor, run
-both queries distributed + governed (every launch admitted through the
+the queries distributed + governed (every launch admitted through the
 memory arbiter), verify against host oracles, and report wall-clock.
+q3 (star join + grouped agg) rides along as the third query pattern.
 
     python -m spark_rapids_jni_tpu.models.nds_harness --sf 0.1 --ndev 8
 
@@ -33,7 +34,8 @@ def _q97_tables(sf: float, seed: int):
 
 
 def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(description="NDS q5+q97 end-to-end harness")
+    ap = argparse.ArgumentParser(
+        description="NDS q5+q97 (+q3) end-to-end harness")
     ap.add_argument("--sf", type=float, default=0.05)
     ap.add_argument("--ndev", type=int, default=0, help="0 = all devices")
     ap.add_argument("--seed", type=int, default=42)
@@ -51,8 +53,11 @@ def main(argv=None) -> int:
 
     from spark_rapids_jni_tpu.mem import BudgetedResource, MemoryGovernor
     from spark_rapids_jni_tpu.models import (
+        generate_q3_data,
         generate_q5_data,
+        q3_local,
         q5_local,
+        run_distributed_q3,
         run_distributed_q5,
         run_distributed_q97,
     )
@@ -104,7 +109,21 @@ def main(argv=None) -> int:
                        int(q97.both)],
             "verified": q97_ok,
         }
-        out["total_wall_s"] = round(q5_dt + q97_dt, 3)
+
+        q3_data = generate_q3_data(sf=args.sf, seed=args.seed)
+        n3 = len(q3_data.ss_item_sk)
+        t0 = time.perf_counter()
+        q3_rows = run_distributed_q3(mesh, q3_data, budget=budget, task_id=3)
+        q3_dt = time.perf_counter() - t0
+        q3_ok = (q3_rows == q3_local(q3_data)) if args.verify else None
+        out["queries"]["q3"] = {
+            "wall_s": round(q3_dt, 3),
+            "fact_rows": n3,
+            "Mrows_per_s": round(n3 / q3_dt / 1e6, 2),
+            "result_rows": len(q3_rows),
+            "verified": q3_ok,
+        }
+        out["total_wall_s"] = round(q5_dt + q97_dt + q3_dt, 3)
     finally:
         MemoryGovernor.shutdown()
 

@@ -1,0 +1,70 @@
+"""bench.py's dead-tunnel fallback: replay banked hardware captures.
+
+The axon tunnel flaps; tools/perf_capture.py banks any live-window
+measurement (stamped with the capture commit) into PERF_CAPTURE.jsonl.
+When the driver's end-of-round bench finds the device unusable it must
+replay the freshest banked line ONLY when that capture ran at the current
+HEAD (so the headline always measures the code being judged), mark the
+output with top-level ``replayed: true``, and surface stale-commit
+captures in detail without using them as the headline.
+"""
+
+import json
+
+import bench
+
+
+HEAD = "deadbeef"
+
+
+def _arm(tmp_path, monkeypatch, lines):
+    p = tmp_path / "PERF_CAPTURE.jsonl"
+    p.write_text("".join(json.dumps(x) + "\n" for x in lines))
+    monkeypatch.setattr(bench, "PERF_CAPTURE_PATH", str(p))
+    monkeypatch.setattr(bench, "_git_head", lambda: HEAD)
+
+
+def test_same_commit_bench_line_replays(tmp_path, monkeypatch):
+    _arm(tmp_path, monkeypatch, [
+        {"stage": "bench", "metric": "murmur3_32_int32_throughput",
+         "value": 88.8, "unit": "Grows/s", "vs_baseline": 88.8,
+         "detail": {"murmur3_int32": {}}, "ts": 2.0, "commit": HEAD},
+    ])
+    r = bench._replay_capture("probe hung")
+    assert r["value"] == 88.8
+    assert r["replayed"] is True
+    assert r["detail"]["capture_commit"] == HEAD
+    assert "probe hung" in r["detail"]["replay_reason"]
+    assert "stage" not in r  # capture-pipeline fields never leak out
+
+
+def test_stale_commit_capture_is_reported_not_replayed(tmp_path, monkeypatch):
+    _arm(tmp_path, monkeypatch, [
+        {"stage": "bench", "value": 9.9, "unit": "Grows/s",
+         "ts": 3.0, "commit": "0ld"},
+    ])
+    r = bench._replay_capture("x")
+    assert r["value"] is None
+    assert r["detail"]["stale_capture"]["value"] == 9.9
+    assert r["detail"]["stale_capture"]["commit"] == "0ld"
+
+
+def test_sweep_reconstruction_same_commit_only(tmp_path, monkeypatch):
+    _arm(tmp_path, monkeypatch, [
+        {"stage": "sweep", "op": "murmur3", "n_log2": 24,
+         "Grows_s": 55.5, "ts": 1.0, "commit": HEAD},
+        # a prior replay output must never be re-banked as fresh
+        {"stage": "bench", "value": 9.9, "ts": 3.0, "commit": HEAD,
+         "replayed": True},
+    ])
+    r = bench._replay_capture("x")
+    assert r["value"] == 55.5
+    assert r["replayed"] is True
+    assert "sweep" in r["detail"]["source"]
+
+
+def test_null_when_nothing_banked(tmp_path, monkeypatch):
+    _arm(tmp_path, monkeypatch, [{"stage": "probe", "alive": False}])
+    r = bench._replay_capture("dead")
+    assert r["value"] is None
+    assert "dead" in r["detail"]["error"]

@@ -70,8 +70,19 @@ for log2 in {sizes}:
 """
 
 
+def _head_commit() -> str:
+    try:
+        r = subprocess.run(["git", "rev-parse", "HEAD"], cwd=REPO,
+                           capture_output=True, text=True, timeout=10)
+        return r.stdout.strip() if r.returncode == 0 else ""
+    except Exception:
+        return ""
+
+
 def _append(rec: dict) -> None:
     rec["ts"] = time.time()
+    # stamp the code version so bench.py's replay can refuse stale numbers
+    rec.setdefault("commit", _head_commit())
     with open(OUT, "a") as f:
         f.write(json.dumps(rec) + "\n")
     print(json.dumps(rec), flush=True)
