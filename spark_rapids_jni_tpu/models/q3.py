@@ -33,7 +33,8 @@ from jax.sharding import PartitionSpec as P
 from spark_rapids_jni_tpu.models.tpcds import Q3Data
 from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS
 
-__all__ = ["Q3Row", "q3_local", "make_distributed_q3", "run_distributed_q3"]
+__all__ = ["Q3Row", "q3_local", "make_distributed_q3", "run_distributed_q3",
+           "q3_working_set_bytes"]
 
 
 class Q3Row(NamedTuple):
@@ -156,6 +157,15 @@ def _pad_facts(facts: dict, dp: int) -> dict:
     return out
 
 
+def q3_working_set_bytes(facts_or_data) -> int:
+    """Reserved bytes for one governed q3 attempt over the given facts
+    (inputs + masks/buckets + partials headroom) — the single source of
+    truth for run_distributed_q3's admission and for tests sizing budgets."""
+    facts = (facts_or_data if isinstance(facts_or_data, dict)
+             else _facts(facts_or_data))
+    return sum(v.nbytes for v in facts.values()) * 3
+
+
 def _split_facts(facts: dict):
     n = len(facts["ss_item"])
     return [{k: v[:n // 2] for k, v in facts.items()},
@@ -184,8 +194,7 @@ def run_distributed_q3(mesh, data: Q3Data, *, budget=None, task_id: int = 0,
     rep = NamedSharding(mesh, P())
     dims = {k: jax.device_put(v, rep) for k, v in _dims(data).items()}
 
-    def nbytes_of(facts):
-        return sum(v.nbytes for v in facts.values()) * 3
+    nbytes_of = q3_working_set_bytes
 
     def run(facts):
         padded = _pad_facts(facts, dp)
