@@ -21,6 +21,10 @@ from spark_rapids_jni_tpu.mem.arbiter import (
     STATE_UNKNOWN,
     current_thread_id,
 )
+from spark_rapids_jni_tpu.mem.spill import (
+    SpillableBuffer,
+    SpillPool,
+)
 from spark_rapids_jni_tpu.mem.exceptions import (
     CpuRetryOOM,
     CpuSplitAndRetryOOM,
@@ -78,6 +82,8 @@ __all__ = [
     "STATE_RUNNING",
     "STATE_SPLIT_THROW",
     "STATE_UNKNOWN",
+    "SpillPool",
+    "SpillableBuffer",
     "ThreadRemovedError",
     "current_thread_id",
 ]

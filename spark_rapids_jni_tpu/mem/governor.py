@@ -197,6 +197,21 @@ class BudgetedResource:
         self.used = 0
         self.is_cpu = is_cpu
         self._lock = threading.Lock()
+        self._spill_handlers = []
+
+    def register_spill_handler(self, handler) -> None:
+        """``handler(shortfall_bytes) -> freed_bytes``: consulted between a
+        failed reservation and the BLOCKED/BUFN escalation — the analog of
+        the reference event handler's onAllocFailure spill ladder
+        (RmmSpark.java:402-416 step 1: 'memory is freed by spilling')."""
+        self._spill_handlers.append(handler)
+
+    def unregister_spill_handler(self, handler) -> None:
+        """Detach a handler (a closing SpillPool); missing is a no-op."""
+        try:
+            self._spill_handlers.remove(handler)
+        except ValueError:
+            pass
 
     def _try_reserve(self, nbytes: int) -> bool:
         with self._lock:
@@ -205,8 +220,28 @@ class BudgetedResource:
             self.used += nbytes
             return True
 
+    def _spill_for(self, nbytes: int) -> bool:
+        """Ask registered spill handlers to free the shortfall; True if any
+        bytes were reclaimed (caller then retries the reservation)."""
+        if nbytes > self.limit:
+            return False  # can never fit: don't wipe the cache for nothing
+        with self._lock:
+            shortfall = self.used + nbytes - self.limit
+        if shortfall <= 0:
+            return True
+        freed = 0
+        for h in self._spill_handlers:
+            freed += h(shortfall - freed)
+            if freed >= shortfall:
+                break
+        return freed > 0
+
     def acquire(self, nbytes: int) -> int:
-        """Reserve ``nbytes``; blocks/raises RetryOOM per the state machine."""
+        """Reserve ``nbytes``; blocks/raises RetryOOM per the state machine.
+
+        Order on pressure matches the reference ladder: spill handlers
+        first (reclaim idle cached data), then the arbiter's BLOCKED/BUFN
+        escalation."""
         arb = self.gov.arbiter
         tid = current_thread_id()
         while True:
@@ -214,6 +249,11 @@ class BudgetedResource:
             try:
                 if self._try_reserve(nbytes):
                     arb.post_alloc_success(tid, is_cpu=self.is_cpu, was_recursive=likely_spill)
+                    return nbytes
+                if (self._spill_handlers and self._spill_for(nbytes)
+                        and self._try_reserve(nbytes)):
+                    arb.post_alloc_success(tid, is_cpu=self.is_cpu,
+                                           was_recursive=likely_spill)
                     return nbytes
                 raise OutOfBudget(f"out of budget: {nbytes} requested, "
                                   f"{self.limit - self.used} available")

@@ -1,0 +1,202 @@
+"""Spill-to-host staging for budget-governed device data.
+
+The reference's recovery ladder on allocation failure is: ask the spill
+framework to free device memory (RmmEventHandler.onAllocFailure -> stores
+spill to host), retry, and only then escalate to Retry/SplitAndRetry
+(protocol doc RmmSpark.java:402-416; the arbiter's recursive-alloc
+detection, SparkResourceAdaptorJni.cpp:1244-1261, exists precisely for
+allocations made *while* spilling).  This module is the TPU-native rung:
+
+- :class:`SpillableBuffer` — a budget-accounted device array that can move
+  to host numpy (releasing its reservation) and back on demand;
+- :class:`SpillPool` — LRU registry; ``spill_until(nbytes)`` frees budget
+  by spilling least-recently-used unpinned buffers;
+- ``BudgetedResource.register_spill_handler`` (mem/governor.py) calls the
+  pool between a failed reservation and the BLOCKED/BUFN escalation, so a
+  tenant under pressure first reclaims idle cached data — exactly where
+  the reference consults its spill store.
+
+Pinning: ``with buf.use() as arr:`` marks the buffer in-use; pinned
+buffers are never spilled (spilling one would free budget while the
+borrowed device array is still live — accounting drift).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["SpillableBuffer", "SpillPool"]
+
+
+class SpillableBuffer:
+    """A device array whose HBM reservation can be reclaimed.
+
+    States: DEVICE (budget held, ``_dev`` set) or HOST (budget released,
+    ``_host`` set).  All transitions run under the owning pool's lock via
+    the pool's methods; ``use()`` re-admits through the budget (which may
+    itself spill *other* buffers or block under the arbiter protocol).
+    """
+
+    def __init__(self, pool: "SpillPool", array) -> None:
+        import jax
+
+        self._pool = pool
+        self.nbytes = int(array.nbytes)
+        self._dev: Optional[jax.Array] = None
+        self._host: Optional[np.ndarray] = None
+        self._pins = 0
+        self._seq = 0  # LRU clock value, maintained by the pool
+        host = np.asarray(array)
+        self._host = host  # upload happens on first use()
+
+    @property
+    def spilled(self) -> bool:
+        return self._dev is None
+
+    def use(self):
+        """Context manager yielding the device array, pinned while open."""
+        return _Pinned(self)
+
+    def spill(self) -> int:
+        """Move to host and release the reservation (pool lock held by
+        caller or single-threaded test).  Returns bytes freed."""
+        return self._pool._spill_one(self)
+
+
+class _Pinned:
+    def __init__(self, buf: SpillableBuffer) -> None:
+        self._buf = buf
+
+    def __enter__(self):
+        return self._buf._pool._pin(self._buf)
+
+    def __exit__(self, *exc) -> None:
+        self._buf._pool._unpin(self._buf)
+
+
+class SpillPool:
+    """LRU spill registry bound to one :class:`BudgetedResource`.
+
+    Registers itself as the budget's spill handler: when a reservation
+    fails, the budget asks ``spill_until(shortfall)`` before escalating
+    to the arbiter's BLOCKED/BUFN path.
+    """
+
+    def __init__(self, budget) -> None:
+        self._budget = budget
+        self._lock = threading.RLock()
+        self._buffers: List[SpillableBuffer] = []
+        self._clock = 0
+        self.spill_count = 0
+        self.spilled_bytes = 0
+        budget.register_spill_handler(self.spill_until)
+
+    # ---- user API --------------------------------------------------------
+
+    def add(self, array) -> SpillableBuffer:
+        """Adopt ``array`` as spillable cached data.  Starts HOST-side
+        (no budget held) — the first ``use()`` admits it."""
+        buf = SpillableBuffer(self, array)
+        with self._lock:
+            self._buffers.append(buf)
+        return buf
+
+    def device_bytes(self) -> int:
+        with self._lock:
+            return sum(b.nbytes for b in self._buffers if not b.spilled)
+
+    def remove(self, buf: SpillableBuffer) -> None:
+        """Deregister a buffer, releasing its reservation if resident;
+        dropping a resident buffer without this would leak its budget.
+        Not a spill: no D2H copy happens and no spill metric is counted —
+        the data is being discarded, not staged."""
+        with self._lock:
+            if not buf.spilled and buf._pins > 0:
+                raise RuntimeError("cannot remove a pinned buffer")
+            resident = not buf.spilled
+            buf._dev = None
+            buf._host = None
+            if buf in self._buffers:
+                self._buffers.remove(buf)
+        if resident:
+            self._budget.release(buf.nbytes)
+
+    def close(self) -> None:
+        """Release every resident buffer and detach from the budget —
+        per-query pools must not accumulate on a long-lived budget."""
+        with self._lock:
+            bufs = list(self._buffers)
+        for b in bufs:
+            self.remove(b)
+        self._budget.unregister_spill_handler(self.spill_until)
+
+    # ---- budget hook -----------------------------------------------------
+
+    def spill_until(self, nbytes: int) -> int:
+        """Spill least-recently-used unpinned device buffers until
+        ``nbytes`` are freed (or no candidates remain).  Returns freed."""
+        freed = 0
+        while freed < nbytes:
+            with self._lock:
+                cands = [b for b in self._buffers
+                         if not b.spilled and b._pins == 0]
+                if not cands:
+                    break
+                victim = min(cands, key=lambda b: b._seq)
+                freed += self._spill_one(victim)
+        return freed
+
+    # ---- internals (pool lock) ------------------------------------------
+
+    def _spill_one(self, buf: SpillableBuffer) -> int:
+        with self._lock:
+            if buf.spilled or buf._pins > 0:
+                return 0
+            buf._host = np.asarray(buf._dev)
+            buf._dev = None
+            self.spill_count += 1
+            self.spilled_bytes += buf.nbytes
+        self._budget.release(buf.nbytes)
+        return buf.nbytes
+
+    def _pin(self, buf: SpillableBuffer):
+        import jax.numpy as jnp
+
+        with self._lock:
+            self._clock += 1
+            buf._seq = self._clock
+            if not buf.spilled:
+                buf._pins += 1
+                return buf._dev
+            host = buf._host
+        # HOST -> DEVICE admission, OPTIMISTIC: no per-buffer lock is held
+        # across the (possibly blocking) acquire — blocking must happen
+        # inside the arbiter where the deadlock watchdog can see and break
+        # it.  Two racers may both admit; the loser releases its duplicate
+        # reservation immediately (bounded, brief over-reservation instead
+        # of a watchdog-invisible Python-lock deadlock).
+        self._budget.acquire(buf.nbytes)
+        try:
+            dev = jnp.asarray(host)
+        except BaseException:
+            self._budget.release(buf.nbytes)  # never leak the reservation
+            raise
+        with self._lock:
+            if buf._dev is None:
+                buf._dev = dev
+                buf._host = None
+                won = True
+            else:
+                won = False
+            buf._pins += 1
+            out = buf._dev
+        if not won:
+            self._budget.release(buf.nbytes)
+        return out
+
+    def _unpin(self, buf: SpillableBuffer) -> None:
+        with self._lock:
+            buf._pins = max(0, buf._pins - 1)

@@ -1,0 +1,146 @@
+"""Spill-to-host staging under the budget (mem/spill.py).
+
+The reference ladder on allocation failure: spill idle device data first,
+escalate to the arbiter (BLOCKED/BUFN/split) only if that is not enough
+(RmmSpark.java:402-416). These tests drive that ladder end to end.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.mem import BudgetedResource, MemoryGovernor
+from spark_rapids_jni_tpu.mem.spill import SpillPool
+
+
+@pytest.fixture
+def gov():
+    g = MemoryGovernor(watchdog_period_s=0.02)
+    yield g
+    g.close()
+
+
+def _budget(gov, nbytes):
+    b = BudgetedResource(gov, nbytes)
+    gov.current_thread_is_dedicated_to_task(0)
+    return b
+
+
+def test_buffer_roundtrip_and_lru_spill(gov):
+    budget = _budget(gov, 4096 + 512)  # room for ONE 4096-B buffer
+    pool = SpillPool(budget)
+    a = pool.add(np.arange(1024, dtype=np.float32))  # 4096 B
+    b = pool.add(np.arange(1024, 2048, dtype=np.float32))
+
+    with a.use() as arr:
+        assert float(arr[3]) == 3.0
+    assert not a.spilled and budget.used == 4096
+
+    # admitting b exceeds the limit -> the pool spills a (LRU, unpinned)
+    with b.use() as arr:
+        assert float(arr[0]) == 1024.0
+        assert a.spilled, "LRU buffer must have been spilled to fit b"
+    assert pool.spill_count == 1
+
+    # a comes back transparently (spilling b in turn)
+    with a.use() as arr:
+        assert float(arr[1023]) == 1023.0
+    assert b.spilled
+    assert budget.used == pool.device_bytes()
+
+
+def test_pinned_buffers_never_spill(gov):
+    budget = _budget(gov, 4096 + 512)
+    pool = SpillPool(budget)
+    a = pool.add(np.zeros(1024, np.float32))
+    with a.use():
+        # nothing else can spill `a`; a too-large direct acquire must
+        # escalate through the arbiter (retry/split signals) instead
+        from spark_rapids_jni_tpu.mem.exceptions import (
+            GpuRetryOOM,
+            GpuSplitAndRetryOOM,
+        )
+
+        with pytest.raises((GpuRetryOOM, GpuSplitAndRetryOOM)):
+            budget.acquire(4096)
+    assert not a.spilled
+    assert pool.spill_count == 0
+
+
+def test_direct_reservation_spills_idle_cache(gov):
+    """A plain working-set acquire (no pool involvement) reclaims idle
+    cached buffers instead of blocking/splitting."""
+    budget = _budget(gov, 8192)
+    pool = SpillPool(budget)
+    a = pool.add(np.zeros(1024, np.float32))
+    with a.use():
+        pass  # resident, idle: 4096 of 8192 used
+    budget.acquire(6000)  # does not fit beside the cache -> spills it
+    assert a.spilled
+    assert pool.spill_count == 1
+    budget.release(6000)
+
+
+def test_remove_releases_and_rejects_pinned(gov):
+    budget = _budget(gov, 1 << 20)
+    pool = SpillPool(budget)
+    a = pool.add(np.zeros(256, np.float32))
+    with a.use():
+        with pytest.raises(RuntimeError):
+            pool.remove(a)
+    pool.remove(a)
+    assert budget.used == 0
+    assert pool.device_bytes() == 0
+
+
+def test_concurrent_pins_single_admission(gov):
+    """Two threads pinning the same spilled buffer must admit it once
+    (no double reservation)."""
+    budget = _budget(gov, 1 << 20)
+    pool = SpillPool(budget)
+    a = pool.add(np.arange(2048, dtype=np.int32))
+    errs = []
+    hold = threading.Barrier(2, timeout=30)
+
+    def worker():
+        try:
+            gov.current_thread_is_dedicated_to_task(1)
+            hold.wait()
+            with a.use() as arr:
+                assert int(arr[7]) == 7
+        except Exception as e:  # noqa: BLE001
+            errs.append(repr(e))
+
+    ts = [threading.Thread(target=worker) for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+        assert not t.is_alive()
+    assert not errs, errs
+    assert budget.used == a.nbytes  # exactly one admission
+
+
+def test_close_detaches_and_oversized_request_spares_cache(gov):
+    budget = _budget(gov, 8192)
+    pool = SpillPool(budget)
+    a = pool.add(np.zeros(1024, np.float32))
+    with a.use():
+        pass  # resident, idle
+    # an unsatisfiable request must NOT wipe the warm cache before
+    # escalating (it can never fit anyway)
+    from spark_rapids_jni_tpu.mem.exceptions import (
+        GpuRetryOOM,
+        GpuSplitAndRetryOOM,
+    )
+    from spark_rapids_jni_tpu.mem.governor import OutOfBudget
+
+    with pytest.raises((GpuRetryOOM, GpuSplitAndRetryOOM, OutOfBudget)):
+        budget.acquire(8192 + 1)
+    assert not a.spilled
+    assert pool.spill_count == 0
+
+    pool.close()
+    assert budget.used == 0
+    assert budget._spill_handlers == []
