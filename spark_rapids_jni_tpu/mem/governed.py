@@ -211,13 +211,22 @@ def _attempt(gov, budget, piece, nbytes_of, run):
     """
     nbytes = int(nbytes_of(piece))
     gov.start_retry_block()
+    retries = 0
     try:
         while True:
             try:
                 with reservation(budget, nbytes):
                     return run(piece)
             except RetryOOM:
-                # arbiter blocked us until ready; same piece, try again
+                # arbiter blocked us until ready; same piece, try again.
+                # The native 500-cap counts BUFN-path throws only, so
+                # injected/self-escalated RetryOOMs (the wasted-wake
+                # livelock breaker) are bounded here, mirroring the
+                # reference's retry limit -> real OOM.
+                retries += 1
+                if retries >= 500:
+                    raise OutOfBudget(
+                        "retry limit exceeded (500) for one piece")
                 continue
     finally:
         gov.end_retry_block()

@@ -236,6 +236,19 @@ class BudgetedResource:
                 break
         return freed > 0
 
+    # Wasted block/wake cycles before a thread self-escalates to RetryOOM.
+    # A woken thread that still cannot reserve re-blocks silently; a lively
+    # low-footprint tenant (e.g. a shuffle thread cycling tiny buffers)
+    # keeps every task looking "alive" to the deadlock detector while the
+    # blocked threads hold-and-wait forever.  After this many futile wakes
+    # the thread arms a RetryOOM injection and re-enters pre_alloc in
+    # RUNNING state (post_alloc_failed(blocking=False)), so the throw uses
+    # the normal, metric-counted injection path with no phantom-BLOCKED
+    # entry left in the arbiter; the caller then rolls its held
+    # allocations back to spillable state per the protocol
+    # (RmmSpark.java:402-416 step 2) and the system can make progress.
+    WASTED_WAKE_LIMIT = 50
+
     def acquire(self, nbytes: int) -> int:
         """Reserve ``nbytes``; blocks/raises RetryOOM per the state machine.
 
@@ -244,6 +257,7 @@ class BudgetedResource:
         escalation."""
         arb = self.gov.arbiter
         tid = current_thread_id()
+        wasted = 0
         while True:
             likely_spill = arb.pre_alloc(tid, is_cpu=self.is_cpu, blocking=True)
             try:
@@ -258,8 +272,15 @@ class BudgetedResource:
                 raise OutOfBudget(f"out of budget: {nbytes} requested, "
                                   f"{self.limit - self.used} available")
             except OutOfBudget:
+                wasted += 1
+                escalate = wasted >= self.WASTED_WAKE_LIMIT
+                if escalate:
+                    self.gov.force_retry_oom(
+                        thread_id=tid, num_ooms=1,
+                        oom_filter=OOM_CPU if self.is_cpu else OOM_GPU)
                 if not arb.post_alloc_failed(
-                    tid, is_cpu=self.is_cpu, is_oom=True, blocking=True,
+                    tid, is_cpu=self.is_cpu, is_oom=True,
+                    blocking=not escalate,  # escalation path must NOT park
                     was_recursive=likely_spill,
                 ):
                     raise

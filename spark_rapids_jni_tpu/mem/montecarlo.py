@@ -52,6 +52,7 @@ class MonteCarloConfig:
     seed: int = 0
     max_task_retries: int = 1000
     duration_s: Optional[float] = None  # wall-clock cap: stop issuing tasks
+    spill_buffers: int = 0              # shared spillable cache buffers
 
 
 @dataclasses.dataclass
@@ -63,6 +64,8 @@ class MonteCarloStats:
     peak_used: int = 0
     leaked_bytes: int = 0
     blocked_at_end: int = 0
+    cache_pins: int = 0
+    cache_spills: int = 0
     failures: List[str] = dataclasses.field(default_factory=list)
 
     @property
@@ -89,10 +92,12 @@ class _Task:
                        for _ in range(cfg.allocs_per_task)]
 
     def run(self, gov: MemoryGovernor, budget: BudgetedResource,
-            stats: "MonteCarloStats", stats_lock: threading.Lock) -> None:
+            stats: "MonteCarloStats", stats_lock: threading.Lock,
+            cache=None) -> None:
         gov.current_thread_is_dedicated_to_task(self.task_id)
         held: List[int] = []
         sizes = list(self.sizes)
+        rng = random.Random(self.cfg.seed * 7919 + self.task_id)
         try:
             attempts = 0
             while attempts < self.cfg.max_task_retries:
@@ -108,6 +113,19 @@ class _Task:
                         held.append(budget.acquire(size))
                         with stats_lock:
                             stats.peak_used = max(stats.peak_used, budget.used)
+                        if cache and rng.random() < 0.3:
+                            # pin a shared spillable buffer mid-program: its
+                            # re-admission competes with every tenant's
+                            # allocs and may spill LRU peers; the content
+                            # check catches any corruption across staging
+                            bi = rng.randrange(len(cache))
+                            with cache[bi].use() as arr:
+                                if int(arr[0]) != bi:  # not assert: survives -O
+                                    raise RuntimeError(
+                                        f"cache corrupted: buffer {bi} "
+                                        f"reads {int(arr[0])}")
+                            with stats_lock:
+                                stats.cache_pins += 1
                         # steady-state: drop some early allocations
                         if len(held) > 4:
                             budget.release(held.pop(0))
@@ -176,8 +194,22 @@ def run_monte_carlo(cfg: MonteCarloConfig) -> MonteCarloStats:
     stats = MonteCarloStats()
     stats_lock = threading.Lock()
     gov = MemoryGovernor.initialize()
+    spill_pool = None
+    cache = None
     try:
         budget = BudgetedResource(gov, cfg.budget_bytes)
+        if cfg.spill_buffers:
+            import numpy as np
+
+            from spark_rapids_jni_tpu.mem.spill import SpillPool
+
+            spill_pool = SpillPool(budget)
+            # each buffer ~1/8 of a task's peak, first element = its index
+            nelem = max(16, cfg.task_max_bytes // 8 // 8)
+            cache = []
+            for bi in range(cfg.spill_buffers):
+                arr = np.full(nelem, bi, dtype=np.int64)
+                cache.append(spill_pool.add(arr))
         tasks = [_Task(i, cfg, rng) for i in range(cfg.n_tasks)]
         stop = threading.Event()
         shufflers = []
@@ -198,7 +230,7 @@ def run_monte_carlo(cfg: MonteCarloConfig) -> MonteCarloStats:
                 if deadline and time.monotonic() > deadline:
                     break
                 futures.append(pool.submit(
-                    task.run, gov, budget, stats, stats_lock))
+                    task.run, gov, budget, stats, stats_lock, cache))
             for f in futures:
                 try:
                     f.result(timeout=120)
@@ -207,6 +239,9 @@ def run_monte_carlo(cfg: MonteCarloConfig) -> MonteCarloStats:
         stop.set()
         for t in shufflers:
             t.join(timeout=10)
+        if spill_pool is not None:
+            stats.cache_spills = spill_pool.spill_count
+            spill_pool.close()  # releases resident cache reservations
         stats.leaked_bytes = budget.used
         stats.blocked_at_end = gov.arbiter.total_blocked_or_bufn()
     finally:
@@ -301,6 +336,9 @@ def main(argv=None) -> int:
     ap.add_argument("--inject-pct", type=float, default=5.0)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--duration-s", type=float, default=None)
+    ap.add_argument("--spill-buffers", type=int, default=0,
+                    help="shared spillable cache buffers pinned randomly "
+                         "mid-program (exercises the spill ladder)")
     ap.add_argument("--workload", choices=("alloc", "q97"), default="alloc",
                     help="alloc: synthetic reserve/release chaos; q97: real "
                     "governed distributed q97 under a shared tight budget")
@@ -320,12 +358,14 @@ def main(argv=None) -> int:
         task_max_bytes=args.task_max_mib << 20,
         allocs_per_task=args.allocs, skewed=args.skewed,
         inject_retry_pct=args.inject_pct, seed=args.seed,
-        duration_s=args.duration_s)
+        duration_s=args.duration_s, spill_buffers=args.spill_buffers)
     stats = run_monte_carlo(cfg)
     print(f"tasks_completed={stats.tasks_completed} retries={stats.retries} "
           f"splits={stats.splits} injected={stats.injected} "
           f"peak_used={stats.peak_used} leaked={stats.leaked_bytes} "
-          f"blocked_at_end={stats.blocked_at_end} ok={stats.ok}")
+          f"blocked_at_end={stats.blocked_at_end} "
+          f"cache_pins={stats.cache_pins} cache_spills={stats.cache_spills} "
+          f"ok={stats.ok}")
     for f in stats.failures:
         print("FAILURE:", f, file=sys.stderr)
     return 0 if stats.ok else 1

@@ -33,3 +33,23 @@ def test_monte_carlo_no_injection_deterministic():
     assert stats.ok, stats.failures
     assert stats.tasks_completed == 6
     assert stats.injected == 0
+
+
+def test_monte_carlo_spillable_cache():
+    """Shared spillable cache under multi-tenant chaos: pins verify buffer
+    content across staging round-trips, the run must spill (tight budget),
+    and accounting ends clean."""
+    from spark_rapids_jni_tpu.mem.montecarlo import (
+        MonteCarloConfig,
+        run_monte_carlo,
+    )
+
+    cfg = MonteCarloConfig(
+        n_tasks=6, n_threads=3, n_shuffle_threads=1,
+        budget_bytes=4 << 20, task_max_bytes=6 << 20,
+        allocs_per_task=20, skewed=True, inject_retry_pct=10,
+        seed=3, spill_buffers=6)
+    stats = run_monte_carlo(cfg)
+    assert stats.ok, stats
+    assert stats.cache_pins > 0
+    assert stats.cache_spills > 0, "tight budget must force cache spills"

@@ -144,3 +144,54 @@ def test_close_detaches_and_oversized_request_spares_cache(gov):
     pool.close()
     assert budget.used == 0
     assert budget._spill_handlers == []
+
+
+def test_wasted_wake_livelock_breaker(gov):
+    """A lively small tenant masks deadlock detection (its releases keep
+    waking the starving thread, which silently re-blocks while holding its
+    earlier allocations).  After WASTED_WAKE_LIMIT futile wakes the
+    starving thread must get a REAL RetryOOM through the arbiter instead
+    of hold-and-waiting forever."""
+    import time
+
+    from spark_rapids_jni_tpu.mem.exceptions import GpuRetryOOM
+
+    budget = BudgetedResource(gov, 1000)
+    stop = threading.Event()
+    outcome = {}
+
+    def starver():
+        gov.current_thread_is_dedicated_to_task(1)
+        try:
+            budget.acquire(800)  # hold-and-wait: 300 more can never fit
+            try:
+                budget.acquire(300)
+                outcome["r"] = "acquired?!"
+            except GpuRetryOOM:
+                outcome["r"] = "retry-oom"
+            finally:
+                budget.release(800)
+        finally:
+            gov.task_done(1)
+
+    def lively():
+        gov.current_thread_is_dedicated_to_task(2)
+        try:
+            while not stop.is_set():
+                budget.acquire(50)
+                budget.release(50)
+                time.sleep(0.001)
+        finally:
+            gov.task_done(2)
+
+    ts = threading.Thread(target=starver)
+    tl = threading.Thread(target=lively)
+    ts.start()
+    tl.start()
+    ts.join(timeout=60)
+    alive = ts.is_alive()
+    stop.set()
+    tl.join(timeout=30)
+    assert not alive, "starving thread livelocked (no self-escalation)"
+    assert outcome.get("r") == "retry-oom", outcome
+    assert budget.used == 0
