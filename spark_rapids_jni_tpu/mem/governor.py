@@ -264,11 +264,23 @@ class BudgetedResource:
                 if self._try_reserve(nbytes):
                     arb.post_alloc_success(tid, is_cpu=self.is_cpu, was_recursive=likely_spill)
                     return nbytes
-                if (self._spill_handlers and self._spill_for(nbytes)
-                        and self._try_reserve(nbytes)):
-                    arb.post_alloc_success(tid, is_cpu=self.is_cpu,
-                                           was_recursive=likely_spill)
-                    return nbytes
+                if self._spill_handlers:
+                    try:
+                        spilled = self._spill_for(nbytes)
+                    except BaseException:
+                        # a spill failure (incl. injected faults at the
+                        # SPILL seam) must not escape mid-protocol: close
+                        # the alloc bracket first so the thread returns to
+                        # RUNNING and the next pre_alloc is not misread as
+                        # a recursive/spill allocation
+                        arb.post_alloc_failed(
+                            tid, is_cpu=self.is_cpu, is_oom=False,
+                            blocking=False, was_recursive=likely_spill)
+                        raise
+                    if spilled and self._try_reserve(nbytes):
+                        arb.post_alloc_success(tid, is_cpu=self.is_cpu,
+                                               was_recursive=likely_spill)
+                        return nbytes
                 raise OutOfBudget(f"out of budget: {nbytes} requested, "
                                   f"{self.limit - self.used} available")
             except OutOfBudget:

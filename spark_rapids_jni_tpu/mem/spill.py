@@ -28,6 +28,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from spark_rapids_jni_tpu.obs import seam as _seam
+
 __all__ = ["SpillableBuffer", "SpillPool"]
 
 
@@ -155,7 +157,8 @@ class SpillPool:
         with self._lock:
             if buf.spilled or buf._pins > 0:
                 return 0
-            buf._host = np.asarray(buf._dev)
+            with _seam.seam(_seam.SPILL, f"spill:{buf.nbytes}B"):
+                buf._host = np.asarray(buf._dev)
             buf._dev = None
             self.spill_count += 1
             self.spilled_bytes += buf.nbytes
@@ -180,7 +183,8 @@ class SpillPool:
         # of a watchdog-invisible Python-lock deadlock).
         self._budget.acquire(buf.nbytes)
         try:
-            dev = jnp.asarray(host)
+            with _seam.seam(_seam.SPILL, f"readmit:{buf.nbytes}B"):
+                dev = jnp.asarray(host)
         except BaseException:
             self._budget.release(buf.nbytes)  # never leak the reservation
             raise

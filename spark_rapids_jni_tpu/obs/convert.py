@@ -21,7 +21,7 @@ from typing import Iterator
 
 from spark_rapids_jni_tpu.obs.profiler import MAGIC, VERSION
 
-_CATEGORY_NAMES = ["op", "transfer", "collective", "alloc", "marker"]
+_CATEGORY_NAMES = ["op", "transfer", "collective", "alloc", "marker", "spill"]
 
 
 def parse_capture(data: bytes) -> Iterator[dict]:

@@ -139,7 +139,8 @@ class FaultInjector:
     # -- config ------------------------------------------------------------
     def _load(self, config: dict) -> None:
         rules = {}
-        for cat in (_seam.OP, _seam.TRANSFER, _seam.COLLECTIVE, _seam.ALLOC):
+        for cat in (_seam.OP, _seam.TRANSFER, _seam.COLLECTIVE, _seam.ALLOC,
+                    _seam.SPILL):
             cat_spec = config.get(cat, {})
             rules[cat] = {name: _Rule(spec) for name, spec in cat_spec.items()}
         with self._lock:

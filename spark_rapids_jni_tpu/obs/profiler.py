@@ -43,7 +43,7 @@ MAGIC = b"SRTP"
 VERSION = 1
 
 _CATEGORIES = {_seam.OP: 0, _seam.TRANSFER: 1, _seam.COLLECTIVE: 2,
-               _seam.ALLOC: 3, "marker": 4}
+               _seam.ALLOC: 3, "marker": 4, _seam.SPILL: 5}
 
 _R_STRING, _R_RANGE, _R_INSTANT, _R_COUNTER = 0, 1, 2, 3
 

@@ -11,7 +11,9 @@ checks.
 
 Categories mirror the activity kinds the reference captures: ``op`` (kernel
 launches), ``transfer`` (host<->device movement), ``collective`` (multi-chip
-exchange), ``alloc`` (memory governance).
+exchange), ``alloc`` (memory governance), ``spill`` (host-staging traffic,
+mem/spill.py — the reference profiles its spill store's device<->host copies
+the same way, as MEMCPY activity).
 """
 
 from __future__ import annotations
@@ -20,12 +22,14 @@ import contextlib
 import functools
 from typing import Callable, Optional
 
-__all__ = ["seam", "instrument", "OP", "TRANSFER", "COLLECTIVE", "ALLOC"]
+__all__ = ["seam", "instrument", "OP", "TRANSFER", "COLLECTIVE", "ALLOC",
+           "SPILL"]
 
 OP = "op"
 TRANSFER = "transfer"
 COLLECTIVE = "collective"
 ALLOC = "alloc"
+SPILL = "spill"
 
 # registered sinks; None = inactive (checked without locks on the hot path)
 _injector: Optional[Callable[[str, str], None]] = None  # may raise

@@ -195,3 +195,62 @@ def test_wasted_wake_livelock_breaker(gov):
     assert not alive, "starving thread livelocked (no self-escalation)"
     assert outcome.get("r") == "retry-oom", outcome
     assert budget.used == 0
+
+
+def test_spill_traffic_visible_at_the_seam(gov):
+    """Spill and readmit cross the instrumented seam (SPILL category), so
+    profiler captures and fault injection see staging traffic like the
+    reference's CUPTI MEMCPY records."""
+    from spark_rapids_jni_tpu.obs import seam
+
+    budget = _budget(gov, 4096 + 512)
+    pool = SpillPool(budget)
+    a = pool.add(np.arange(1024, dtype=np.float32))
+    b = pool.add(np.ones(1024, np.float32))
+    events = []
+    seam._set_injector(lambda cat, name: events.append((cat, name)))
+    try:
+        with a.use():
+            pass
+        with b.use():  # spills a, readmits b
+            pass
+        with a.use():  # readmits a, spills b
+            pass
+    finally:
+        seam._set_injector(None)
+    spills = [n for c, n in events if c == seam.SPILL]
+    assert any(n.startswith("spill:") for n in spills), events
+    assert any(n.startswith("readmit:") for n in spills), events
+
+
+def test_injected_spill_fault_keeps_arbiter_protocol_consistent(gov):
+    """A fault injected at the SPILL seam mid-ladder must close the alloc
+    bracket before propagating: the thread returns to RUNNING and a later
+    acquire works normally (no recursive-alloc misread, no stuck ALLOC)."""
+    from spark_rapids_jni_tpu.mem.arbiter import STATE_RUNNING
+    from spark_rapids_jni_tpu.mem import current_thread_id
+    from spark_rapids_jni_tpu.obs import seam
+
+    budget = _budget(gov, 4096 + 512)
+    pool = SpillPool(budget)
+    a = pool.add(np.zeros(1024, np.float32))
+    with a.use():
+        pass  # resident, idle: spill candidate
+
+    class Boom(Exception):
+        pass
+
+    def inject(cat, name):
+        if cat == seam.SPILL and name.startswith("spill:"):
+            raise Boom(name)
+
+    seam._set_injector(inject)
+    try:
+        with pytest.raises(Boom):
+            budget.acquire(4096)  # needs the cache spilled -> fault fires
+    finally:
+        seam._set_injector(None)
+    assert gov.arbiter.state_of(current_thread_id()) == STATE_RUNNING
+    budget.acquire(400)  # protocol intact: a fitting acquire still works
+    budget.release(400)
+    assert not a.spilled  # the faulted spill left the buffer resident
