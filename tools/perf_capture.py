@@ -70,6 +70,28 @@ for log2 in {sizes}:
 """
 
 
+def _stage_env() -> dict:
+    """Stage subprocess env with the persistent XLA compilation cache ON.
+
+    The first live-tunnel window of round 3 was mostly consumed by remote
+    compiles (~20-40 s per kernel); caching lets a later window spend its
+    minutes measuring instead.  A TPU-specific cache dir avoids the CPU
+    loader's machine-feature segfault documented in tests/conftest.py (the
+    cache stays off for the CPU test suite).
+    """
+    env = dict(os.environ)
+    # only cache when the platform is explicitly pinned to an accelerator:
+    # an unpinned env could silently fall back to CPU mid-window and poison
+    # the TPU cache dir with CPU entries (the conftest segfault class)
+    plat = env.get("JAX_PLATFORMS", "")
+    if plat and plat != "cpu":
+        env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                       os.path.join(REPO, ".jax_cache_tpu"))
+        env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+        env.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+    return env
+
+
 def _head_commit() -> str:
     try:
         r = subprocess.run(["git", "rev-parse", "HEAD"], cwd=REPO,
@@ -93,7 +115,7 @@ def _run(tag: str, code: list, timeout: float) -> bool:
     t0 = time.time()
     try:
         res = subprocess.run(code, capture_output=True, text=True,
-                             timeout=timeout, cwd=REPO)
+                             timeout=timeout, cwd=REPO, env=_stage_env())
     except subprocess.TimeoutExpired as e:
         # salvage whatever the stage managed to emit before wedging —
         # losing completed measurements is the one failure mode this tool
@@ -128,7 +150,8 @@ def _salvage(tag: str, stdout: str) -> None:
 def probe(timeout: float = 150.0) -> bool:
     try:
         r = subprocess.run([sys.executable, "-c", PROBE], timeout=timeout,
-                           capture_output=True, text=True, cwd=REPO)
+                           capture_output=True, text=True, cwd=REPO,
+                           env=_stage_env())
         return r.returncode == 0
     except subprocess.TimeoutExpired:
         return False
