@@ -80,13 +80,22 @@ def _long_kernel(lo_ref, hi_ref, h_ref, out_ref):
 # ---- blocking helpers -----------------------------------------------------
 
 
-def _to_blocks(x_u32: jnp.ndarray) -> jnp.ndarray:
-    """[n] u32 -> [R, 128] u32, R a multiple of _BLOCK_ROWS (zero padded)."""
-    n = x_u32.shape[0]
-    pad = (-n) % _TILE
+def _block_rows_for(n: int) -> int:
+    """Row-block height for n elements: full _BLOCK_ROWS for large inputs,
+    a pow2-rounded smaller block for small ones so a few-hundred-row
+    length bucket doesn't pad (and compute over) a 65k-lane tile."""
+    rows_needed = max(1, -(-n // _LANES))
+    return min(_BLOCK_ROWS, max(8, 1 << (rows_needed - 1).bit_length()))
+
+
+def _to_blocks(x, dtype, block_rows: int) -> jnp.ndarray:
+    """[n] -> [R, 128] of ``dtype``, R a multiple of block_rows (0-pad)."""
+    x = jnp.asarray(x, dtype)
+    n = x.shape[0]
+    pad = (-n) % (block_rows * _LANES)
     if pad:
-        x_u32 = jnp.pad(x_u32, (0, pad))
-    return x_u32.reshape(-1, _LANES)
+        x = jnp.pad(x, (0, pad))
+    return x.reshape(-1, _LANES)
 
 
 @functools.partial(jax.jit, static_argnames=("n_inputs",))
@@ -94,20 +103,84 @@ def _launch(n_inputs, *flat_u32):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    blocks = [_to_blocks(x) for x in flat_u32]
+    br = _block_rows_for(flat_u32[0].shape[0])
+    blocks = [_to_blocks(x, _U32, br) for x in flat_u32]
     rows = blocks[0].shape[0]
     kernel = _int_kernel if n_inputs == 2 else _long_kernel
-    spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
+    spec = pl.BlockSpec((br, _LANES), lambda i: (i, 0),
                         memory_space=pltpu.VMEM)
     out = pl.pallas_call(
         kernel,
-        grid=(rows // _BLOCK_ROWS,),
+        grid=(rows // br,),
         in_specs=[spec] * n_inputs,
         out_specs=spec,
         out_shape=jax.ShapeDtypeStruct((rows, _LANES), _U32),
         interpret=_use_interpret(),
     )(*blocks)
     return out.reshape(-1)
+
+
+def _bytes_words_kernel(words_ref, h_ref, nw_ref, out_ref):
+    """One murmur word round for one (row-block, word) grid step.
+
+    TPU grids execute sequentially with the word index as the
+    fastest-varying dimension, so ``out_ref`` (same block for every w of a
+    row block) carries the running hash across the whole word loop in
+    VMEM — the lax.scan path re-materializes that carry through the XLA
+    loop instead.
+    """
+    import jax.experimental.pallas as pl
+
+    w = pl.program_id(1)
+
+    @pl.when(w == 0)
+    def _():
+        out_ref[:] = h_ref[:]
+
+    word = words_ref[0]
+    h = out_ref[:]
+    upd = _mix_h1(h, _mix_k1(word))
+    out_ref[:] = jnp.where(w < nw_ref[:], upd, h)
+
+
+def mm_bytes_words_pallas(words: jnp.ndarray, nwords: jnp.ndarray,
+                          h_u32: jnp.ndarray) -> jnp.ndarray:
+    """All aligned-word murmur rounds of hashUnsafeBytes as one Pallas
+    kernel: ``words`` [n, Lw] u32, ``nwords`` [n] valid-word counts,
+    ``h_u32`` [n] running hashes -> updated [n] hashes.  The <=3 tail-byte
+    rounds + fmix stay in the caller (hashing._mm_bytes_tail)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, lw = words.shape
+    if n == 0 or lw == 0:
+        return jnp.broadcast_to(jnp.asarray(h_u32, _U32), (n,))
+
+    br = _block_rows_for(n)
+    h2 = _to_blocks(jnp.broadcast_to(jnp.asarray(h_u32, _U32), (n,)),
+                    _U32, br)
+    nw2 = _to_blocks(nwords, jnp.int32, br)
+    rows = h2.shape[0]
+    # words -> [Lw, R, 128] so each grid step streams one word-column block
+    wpad = jnp.pad(words, ((0, rows * _LANES - n), (0, 0)))
+    w3 = wpad.T.reshape(lw, rows, _LANES)
+
+    row_spec = pl.BlockSpec((br, _LANES), lambda i, w: (i, 0),
+                            memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        _bytes_words_kernel,
+        grid=(rows // br, lw),
+        in_specs=[
+            pl.BlockSpec((1, br, _LANES), lambda i, w: (w, i, 0),
+                         memory_space=pltpu.VMEM),
+            row_spec,
+            row_spec,
+        ],
+        out_specs=row_spec,
+        out_shape=jax.ShapeDtypeStruct((rows, _LANES), _U32),
+        interpret=_use_interpret(),
+    )(w3, h2, nw2)
+    return out.reshape(-1)[:n]
 
 
 def mm_hash_int_pallas(v_i32: jnp.ndarray, h_u32: jnp.ndarray) -> jnp.ndarray:

@@ -117,24 +117,49 @@ def _pallas_backend() -> bool:
     return config.get("hash_backend") == "pallas"
 
 
+def _mm_bytes_words(padded: jnp.ndarray):
+    """[n, L] u8 -> ([n, Lw] u32 little-endian words, padded-to-x4 bytes)."""
+    n, max_len = padded.shape
+    pad = (-max_len) % 4
+    if pad:
+        padded = jnp.pad(padded, ((0, 0), (0, pad)))
+    nwords_max = padded.shape[1] // 4
+    b = padded.astype(_U32).reshape(n, nwords_max, 4)
+    words = b[:, :, 0] | (b[:, :, 1] << _U32(8)) | (b[:, :, 2] << _U32(16)) | (
+        b[:, :, 3] << _U32(24)
+    )
+    return words, padded
+
+
+def _mm_bytes_tail(padded: jnp.ndarray, lens: jnp.ndarray, nwords, h):
+    """The <=3 sign-extended tail-byte rounds + fmix (the Spark deviation);
+    shared by the XLA scan and the Pallas word kernel."""
+    tail_start = nwords * 4
+    for j in range(3):
+        idx = jnp.clip(tail_start + j, 0, padded.shape[1] - 1)
+        byte = jnp.take_along_axis(padded, idx[:, None], axis=1)[:, 0]
+        sbyte = byte.astype(jnp.int8).astype(jnp.int32).astype(_U32)
+        upd = _mm_mix_h1(h, _mm_mix_k1(sbyte))
+        h = jnp.where(tail_start + j < lens, upd, h)
+    return _mm_fmix(h, lens.astype(_U32))
+
+
 def _mm_hash_bytes(padded: jnp.ndarray, lens: jnp.ndarray, h):
     """Spark Murmur3.hashUnsafeBytes over a dense [n, L] byte matrix.
 
     Aligned 4-byte little-endian words get the standard round; the <=3 tail bytes
     are each sign-extended and given a full round (the Spark deviation).
     """
-    n, max_len = padded.shape
-    pad = (-max_len) % 4
-    if pad:
-        padded = jnp.pad(padded, ((0, 0), (0, pad)))
-    nwords_max = padded.shape[1] // 4
     lens = lens.astype(jnp.int32)
     nwords = lens // 4
+    words, padded = _mm_bytes_words(padded)
+    nwords_max = words.shape[1]
 
-    b = padded.astype(_U32).reshape(n, nwords_max, 4)
-    words = b[:, :, 0] | (b[:, :, 1] << _U32(8)) | (b[:, :, 2] << _U32(16)) | (
-        b[:, :, 3] << _U32(24)
-    )
+    if _pallas_backend():
+        from spark_rapids_jni_tpu.ops.hash_pallas import mm_bytes_words_pallas
+
+        h = mm_bytes_words_pallas(words, nwords, h)
+        return _mm_bytes_tail(padded, lens, nwords, h)
 
     def word_step(hc, w_idx):
         w = words[:, w_idx]
@@ -144,15 +169,7 @@ def _mm_hash_bytes(padded: jnp.ndarray, lens: jnp.ndarray, h):
     if nwords_max:
         h, _ = jax.lax.scan(word_step, h, jnp.arange(nwords_max))
 
-    tail_start = nwords * 4
-    for j in range(3):
-        idx = jnp.clip(tail_start + j, 0, padded.shape[1] - 1)
-        byte = jnp.take_along_axis(padded, idx[:, None], axis=1)[:, 0]
-        sbyte = byte.astype(jnp.int8).astype(jnp.int32).astype(_U32)
-        upd = _mm_mix_h1(h, _mm_mix_k1(sbyte))
-        h = jnp.where(tail_start + j < lens, upd, h)
-
-    return _mm_fmix(h, lens.astype(_U32))
+    return _mm_bytes_tail(padded, lens, nwords, h)
 
 
 # ---------------------------------------------------------------------------

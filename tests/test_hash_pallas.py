@@ -82,3 +82,76 @@ def test_bloom_filter_works_under_pallas_backend():
         bf2 = bloom_filter_put(bloom_filter_create(3, 1 << 10), keys)
         got = bloom_filter_probe(keys, bf2).to_list()
     assert got == want == [True] * 10
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("maxlen", [3, 9, 40])
+def test_bytes_word_kernel_bit_exact(maxlen):
+    from spark_rapids_jni_tpu.ops.hash_pallas import mm_bytes_words_pallas
+    from spark_rapids_jni_tpu.ops.hashing import _mm_bytes_words
+
+    rng = np.random.RandomState(maxlen)
+    n = 700
+    lens = rng.randint(0, maxlen + 1, n).astype(np.int32)
+    padded = rng.randint(0, 256, (n, maxlen)).astype(np.uint8)
+    h = jnp.asarray(rng.randint(0, 2**32, n, dtype=np.uint64).astype(np.uint32))
+    words, _p = _mm_bytes_words(jnp.asarray(padded))
+    nwords = jnp.asarray(lens // 4)
+
+    got = mm_bytes_words_pallas(words, nwords, h)
+
+    # oracle: the scan path's word phase
+    import jax
+
+    def step(hc, w_idx):
+        from spark_rapids_jni_tpu.ops.hashing import _mm_mix_h1, _mm_mix_k1
+        upd = _mm_mix_h1(hc, _mm_mix_k1(words[:, w_idx]))
+        return jnp.where(w_idx < nwords, upd, hc), None
+
+    want = h
+    if words.shape[1]:
+        want, _ = jax.lax.scan(step, h, jnp.arange(words.shape[1]))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_backend_flag_routes_string_hash():
+    from spark_rapids_jni_tpu.columnar import strings_column
+
+    rows = ["", "a", "abc", "abcd", "hello world", "x" * 37, None]
+    col = strings_column(rows)
+    want = murmur_hash32([col], seed=42).to_list()
+    with config.override(hash_backend="pallas"):
+        got = murmur_hash32([col], seed=42).to_list()
+    assert got == want
+
+
+@pytest.mark.slow
+def test_bytes_word_kernel_multi_row_block():
+    # rows // block_rows > 1: the carry re-init (pl.when w==0) and output
+    # revisiting must be correct per row block, not just for block 0
+    from spark_rapids_jni_tpu.ops.hash_pallas import (
+        _block_rows_for,
+        _LANES,
+        mm_bytes_words_pallas,
+    )
+    from spark_rapids_jni_tpu.ops.hashing import _mm_bytes_words
+
+    n = _TILE + 999  # > one full 512x128 block of rows
+    assert -(-n // _LANES) > _block_rows_for(n)
+    rng = np.random.RandomState(5)
+    lens = rng.randint(0, 7, n).astype(np.int32)
+    padded = rng.randint(0, 256, (n, 6)).astype(np.uint8)
+    h = jnp.asarray(rng.randint(0, 2**32, n, dtype=np.uint64).astype(np.uint32))
+    words, _p = _mm_bytes_words(jnp.asarray(padded))
+    nwords = jnp.asarray(lens // 4)
+    got = mm_bytes_words_pallas(words, nwords, h)
+
+    import jax
+
+    def step(hc, w_idx):
+        from spark_rapids_jni_tpu.ops.hashing import _mm_mix_h1, _mm_mix_k1
+        upd = _mm_mix_h1(hc, _mm_mix_k1(words[:, w_idx]))
+        return jnp.where(w_idx < nwords, upd, hc), None
+
+    want, _ = jax.lax.scan(step, h, jnp.arange(words.shape[1]))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

@@ -84,7 +84,8 @@ def _stage_env() -> dict:
     # an unpinned env could silently fall back to CPU mid-window and poison
     # the TPU cache dir with CPU entries (the conftest segfault class)
     plat = env.get("JAX_PLATFORMS", "")
-    if plat and plat != "cpu":
+    tokens = {t.strip() for t in plat.split(",") if t.strip()}
+    if tokens and "cpu" not in tokens:  # accelerator-ONLY pin, no fallback
         env.setdefault("JAX_COMPILATION_CACHE_DIR",
                        os.path.join(REPO, ".jax_cache_tpu"))
         env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
