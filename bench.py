@@ -237,10 +237,14 @@ def main():
     # ---- config 1: murmur3-32 on INT32 (XLA and Pallas A/B) ---------------
     mm_rows_s = 0.0
 
+    _mm_cache = {}
+
     def _murmur(backend):
         nonlocal mm_rows_s
-        data = jnp.asarray(
-            rng.randint(-(2**31), 2**31, size=n).astype(np.int32))
+        if "data" not in _mm_cache:  # built under the first stage's budget
+            _mm_cache["data"] = jnp.asarray(
+                rng.randint(-(2**31), 2**31, size=n).astype(np.int32))
+        data = _mm_cache["data"]
         with config.override(hash_backend=backend):
             hash_col = jax.jit(
                 lambda d: murmur_hash32([Column(d, None, INT32)],
@@ -259,11 +263,15 @@ def main():
 
     ns_h = min(n, 1 << 20)
 
+    _ms_cache = {}
+
     def _murmur_strings(backend):
         from spark_rapids_jni_tpu.columnar.column import strings_from_bytes
 
-        rows = [b"k%08d-%s" % (i, b"x" * (i % 24)) for i in range(ns_h)]
-        scol = strings_from_bytes(rows)
+        if "col" not in _ms_cache:  # shared across the two backend stages
+            rows = [b"k%08d-%s" % (i, b"x" * (i % 24)) for i in range(ns_h)]
+            _ms_cache["col"] = strings_from_bytes(rows)
+        scol = _ms_cache["col"]
         total_bytes = int(scol.chars.shape[0])
         with config.override(hash_backend=backend):
             dt = _time(lambda: murmur_hash32([scol], seed=42).data,

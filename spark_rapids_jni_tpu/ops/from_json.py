@@ -40,6 +40,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from spark_rapids_jni_tpu.columnar.buckets import padded_buckets
 from spark_rapids_jni_tpu.columnar.column import (
@@ -178,25 +179,46 @@ def from_json(col: StringColumn) -> ListColumn:
             jnp.zeros((1,), _I32), StructColumn((empty, empty), None), None
         )
 
-    pair_counts = jnp.zeros((n,), _I64)
-    recs = []  # (bucket, _Pairs, npairs)
+    # phase 1 (no sync): tokenize + classify every bucket, collecting the
+    # control scalars (any-bad, bad-row id, pair count) on device; ONE
+    # batched pull then drives the host-side control flow — the same
+    # cross-bucket sync batching as device get_json_object
+    ph = []
     for b in padded_buckets(col):
         ts = jt.tokenize(b.bytes, b.lengths)
         row_valid = in_valid[b.rows] & b.valid_mask()
         cl = _classify(ts.kind.astype(_I32), ts.start, ts.end, ts.match,
                        ts.n_tokens.astype(_I32), ts.ok, ts.trailing,
                        row_valid)
-        if bool(jnp.any(cl.bad)):  # malformed non-null row: whole-op throw
-            r = int(b.rows[int(jnp.argmax(cl.bad))])
+        if cl.bad.size:
+            any_bad = jnp.any(cl.bad).astype(_I64)
+            bad_row = b.rows[jnp.argmax(cl.bad)].astype(_I64)
+        else:
+            any_bad = bad_row = jnp.int64(0)
+        ph.append((b, cl, jnp.stack(
+            [any_bad, bad_row, jnp.sum(cl.is_key).astype(_I64)])))
+
+    geom = (np.asarray(jnp.stack([p[2] for p in ph]))
+            if ph else np.zeros((0, 3), np.int64))
+
+    pair_counts = jnp.zeros((n,), _I64)
+    recs = []  # (bucket, _Pairs, npairs)
+    for i, (any_bad, bad_row, npairs) in enumerate(geom):
+        b, cl, _ = ph[i]
+        ph[i] = None  # free this bucket's [nr,T] classification matrices:
+        # only the compacted [NP] pair records survive past this loop, so
+        # peak device memory stays one-bucket-deep like the pre-batch code
+        if any_bad:  # malformed non-null row: whole-op throw
             raise JsonParsingException(
-                f"JSON Parser encountered an invalid format at row {r}"
+                f"JSON Parser encountered an invalid format at row "
+                f"{int(bad_row)}"
             )
-        npairs = int(jnp.sum(cl.is_key))
         if npairs == 0:
             continue
         pair_counts = pair_counts.at[b.rows].add(
             jnp.sum(cl.is_key, axis=1).astype(_I64))
-        recs.append((b, _compact(cl, b.rows, _pow2(npairs)), npairs))
+        recs.append((b, _compact(cl, b.rows, _pow2(int(npairs))),
+                     int(npairs)))
 
     offsets = jnp.pad(jnp.cumsum(pair_counts), (1, 0))
     total = int(offsets[-1])  # list-child size is shape-defining
@@ -232,11 +254,15 @@ def _gather_spans(total, recs, get_span, row_offsets) -> StringColumn:
         positions.append(pos)
         lens = lens.at[pos].set((e - s).astype(_I64), mode="drop")
     offs = jnp.pad(jnp.cumsum(lens[:total]), (1, 0))
-    nbytes = int(offs[-1])
+    # one batched pull: the byte total + every bucket's max span width
+    widths_dev = [jnp.max(get_span(p)[1] - get_span(p)[0]).astype(_I64)
+                  for _b, p, _np in recs]
+    pulled = np.asarray(jnp.stack([offs[-1]] + widths_dev))
+    nbytes = int(pulled[0])
     chars = jnp.zeros((max(nbytes, 1),), jnp.uint8)
-    for (b, p, npairs), pos in zip(recs, positions):
+    for (b, p, npairs), pos, wmax in zip(recs, positions, pulled[1:]):
         s, e = get_span(p)
-        w = _pow2(max(int(jnp.max(e - s)), 1))
+        w = _pow2(max(int(wmax), 1))
         chars = _scatter_span_bytes(
             chars, b.bytes, (p.loc_row, s, e),
             jnp.where(pos < total, offs[jnp.minimum(pos, total - 1)],
