@@ -260,6 +260,7 @@ def main():
     _stage(detail, "murmur3_int32", lambda: _murmur("xla"), nbytes=n * 8 * 2)
     _stage(detail, "murmur3_int32_pallas", lambda: _murmur("pallas"),
            nbytes=n * 8 * 2)
+    _mm_cache.clear()  # the shared input must not outlive its stages
 
     ns_h = min(n, 1 << 20)
 
@@ -284,6 +285,7 @@ def main():
            nbytes=ns_h * 40 * 3)
     _stage(detail, "murmur3_strings_pallas",
            lambda: _murmur_strings("pallas"), nbytes=ns_h * 40 * 3)
+    _ms_cache.clear()
 
     # ---- config 2: string<->float -----------------------------------------
     ns = min(n, 1 << 20)  # host-orchestrated: smaller working set

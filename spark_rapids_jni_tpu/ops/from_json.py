@@ -179,11 +179,38 @@ def from_json(col: StringColumn) -> ListColumn:
             jnp.zeros((1,), _I32), StructColumn((empty, empty), None), None
         )
 
-    # phase 1 (no sync): tokenize + classify every bucket, collecting the
-    # control scalars (any-bad, bad-row id, pair count) on device; ONE
-    # batched pull then drives the host-side control flow — the same
-    # cross-bucket sync batching as device get_json_object
-    ph = []
+    # phase 1 (no sync within a group): tokenize + classify a GROUP of
+    # buckets, collecting the control scalars (any-bad, bad-row id, pair
+    # count) on device; one batched pull per group drives the host-side
+    # control flow — the same cross-bucket sync batching as device
+    # get_json_object, with the same byte-budget grouping so holding
+    # several buckets' [nr,T] classification matrices at once cannot blow
+    # HBM (json_overlap_bytes; 1 = serial, the pre-batch peak).
+    from spark_rapids_jni_tpu import config
+
+    group_budget = max(int(config.get("json_overlap_bytes")), 1)
+    pair_counts = jnp.zeros((n,), _I64)
+    recs = []  # (bucket, _Pairs, npairs)
+
+    def _drain(group):
+        nonlocal pair_counts
+        geom = np.asarray(jnp.stack([g[2] for g in group]))
+        for i, (any_bad, bad_row, npairs) in enumerate(geom):
+            b, cl, _ = group[i]
+            group[i] = None  # free the [nr,T] matrices as we go
+            if any_bad:  # malformed non-null row: whole-op throw
+                raise JsonParsingException(
+                    f"JSON Parser encountered an invalid format at row "
+                    f"{int(bad_row)}"
+                )
+            if npairs == 0:
+                continue
+            pair_counts = pair_counts.at[b.rows].add(
+                jnp.sum(cl.is_key, axis=1).astype(_I64))
+            recs.append((b, _compact(cl, b.rows, _pow2(int(npairs))),
+                         int(npairs)))
+
+    group, group_bytes = [], 0
     for b in padded_buckets(col):
         ts = jt.tokenize(b.bytes, b.lengths)
         row_valid = in_valid[b.rows] & b.valid_mask()
@@ -195,30 +222,15 @@ def from_json(col: StringColumn) -> ListColumn:
             bad_row = b.rows[jnp.argmax(cl.bad)].astype(_I64)
         else:
             any_bad = bad_row = jnp.int64(0)
-        ph.append((b, cl, jnp.stack(
+        bbytes = int(b.bytes.shape[0]) * int(b.bytes.shape[1])
+        if group and group_bytes + bbytes > group_budget:
+            _drain(group)
+            group, group_bytes = [], 0
+        group.append((b, cl, jnp.stack(
             [any_bad, bad_row, jnp.sum(cl.is_key).astype(_I64)])))
-
-    geom = (np.asarray(jnp.stack([p[2] for p in ph]))
-            if ph else np.zeros((0, 3), np.int64))
-
-    pair_counts = jnp.zeros((n,), _I64)
-    recs = []  # (bucket, _Pairs, npairs)
-    for i, (any_bad, bad_row, npairs) in enumerate(geom):
-        b, cl, _ = ph[i]
-        ph[i] = None  # free this bucket's [nr,T] classification matrices:
-        # only the compacted [NP] pair records survive past this loop, so
-        # peak device memory stays one-bucket-deep like the pre-batch code
-        if any_bad:  # malformed non-null row: whole-op throw
-            raise JsonParsingException(
-                f"JSON Parser encountered an invalid format at row "
-                f"{int(bad_row)}"
-            )
-        if npairs == 0:
-            continue
-        pair_counts = pair_counts.at[b.rows].add(
-            jnp.sum(cl.is_key, axis=1).astype(_I64))
-        recs.append((b, _compact(cl, b.rows, _pow2(int(npairs))),
-                     int(npairs)))
+        group_bytes += bbytes
+    if group:
+        _drain(group)
 
     offsets = jnp.pad(jnp.cumsum(pair_counts), (1, 0))
     total = int(offsets[-1])  # list-child size is shape-defining

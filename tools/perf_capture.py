@@ -41,6 +41,7 @@ SWEEP = r"""
 import json, sys
 sys.path.insert(0, {repo!r})
 import jax, jax.numpy as jnp, numpy as np
+from spark_rapids_jni_tpu import config
 from spark_rapids_jni_tpu.obs.timing import time_marginal
 from spark_rapids_jni_tpu.columnar import Column, INT32, INT64
 from spark_rapids_jni_tpu.ops import murmur_hash32, xxhash64
@@ -51,10 +52,14 @@ def emit(d): print(json.dumps(d), flush=True)
 for log2 in {sizes}:
     n = 1 << log2
     d32 = jnp.asarray(rng.randint(-(2**31), 2**31, n).astype(np.int32))
+    def _mm_pallas(d):
+        with config.override(hash_backend="pallas"):
+            return murmur_hash32([Column(d, None, INT32)], seed=42).data
     ops = dict(
         copy=(jax.jit(lambda d: d + 1), 8),
         murmur3=(jax.jit(lambda d: murmur_hash32(
             [Column(d, None, INT32)], seed=42).data), 8),
+        murmur3_pallas=(jax.jit(_mm_pallas), 8),
         xxhash64=(jax.jit(lambda d: xxhash64(
             [Column(d, None, INT32)], seed=42).data), 12),
     )
@@ -160,10 +165,12 @@ def probe(timeout: float = 150.0) -> bool:
 
 def capture_once() -> bool:
     """One full staged capture; returns True if the headline bench landed."""
-    sweep_small = SWEEP.format(repo=REPO, sizes=[20, 22],
-                               ops_on=("copy", "murmur3", "xxhash64"))
-    sweep_big = SWEEP.format(repo=REPO, sizes=[24, 26],
-                             ops_on=("copy", "murmur3"))
+    sweep_small = SWEEP.format(
+        repo=REPO, sizes=[20, 22],
+        ops_on=("copy", "murmur3", "murmur3_pallas", "xxhash64"))
+    sweep_big = SWEEP.format(
+        repo=REPO, sizes=[24, 26],
+        ops_on=("copy", "murmur3", "murmur3_pallas"))
     ok = _run("sweep-small", [sys.executable, "-c", sweep_small], 900)
     if ok:
         _run("sweep-big", [sys.executable, "-c", sweep_big], 900)
