@@ -28,7 +28,7 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python -m spark_rapids_jni_tpu.mem.montecarlo \
     --tasks 16 --threads 8 --shuffle-threads 2 \
     --budget-mib 8 --task-max-mib 6 --allocs 40 --skewed --inject-pct 10 \
-    --seed "${FUZZ_SEED:-0}"
+    --spill-buffers 6 --seed "${FUZZ_SEED:-0}"
 
 python -c "
 from __graft_entry__ import dryrun_multichip
