@@ -97,18 +97,53 @@ def _git_head() -> str:
         return ""
 
 
+# paths whose changes cannot affect measured performance; a banked capture
+# stays replayable across commits touching only these (the driver's
+# end-of-round snapshot commit of telemetry/docs must not invalidate the
+# round's hardware numbers)
+_PERF_NEUTRAL = ("docs/", "PERF_CAPTURE.jsonl", "PROGRESS.jsonl",
+                 "README.md", "VERDICT.md", "ADVICE.md", "BENCH_",
+                 "MULTICHIP_", "COPYCHECK", ".gitignore")
+
+
+def _same_code(commit: str, head: str) -> bool:
+    """True when no performance-relevant file differs between the capture
+    commit and HEAD (equal commits trivially qualify)."""
+    if not commit or not head:
+        return False
+    if commit == head:
+        return True
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            ["git", "diff", "--name-only", commit, head],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        if r.returncode != 0:
+            return False
+        return all(
+            any(p.startswith(pref) for pref in _PERF_NEUTRAL)
+            for p in r.stdout.splitlines() if p.strip())
+    except Exception:
+        return False
+
+
 def _replay_capture(reason: str):
     """Fallback when the tunnel is dead at bench time: replay the newest
     hardware measurement tools/perf_capture.py banked during the round —
-    but ONLY if it was captured at the current HEAD commit, so a replayed
-    headline always measures the code being judged.  Replays carry a
-    top-level ``"replayed": true`` plus capture timestamp/commit in
-    detail; stale-commit captures are reported in detail with a null
-    headline.  Preference: same-commit banked bench line, else a headline
-    reconstructed from a same-commit murmur3 sweep, else null.
+    but ONLY if no performance-relevant file changed between the capture
+    commit and HEAD (_same_code; equal commits trivially qualify, and the
+    driver's end-of-round telemetry/docs snapshot commit stays neutral),
+    so a replayed headline always measures the code being judged.
+    Replays carry a top-level ``"replayed": true`` plus capture
+    timestamp/commit in detail; stale captures are reported in detail
+    with a null headline.  Preference: freshest replayable banked bench
+    line, else a headline reconstructed from a replayable murmur3 sweep,
+    else null.
     """
     head = _git_head()
-    bench_rec = sweep_rec = stale = None
+    bench_cands, sweep_cands = [], []
     try:
         with open(PERF_CAPTURE_PATH) as f:
             for line in f:
@@ -116,20 +151,30 @@ def _replay_capture(reason: str):
                     rec = json.loads(line)
                 except ValueError:
                     continue
-                fresh = bool(head) and rec.get("commit") == head
                 if (rec.get("stage") == "bench"
                         and rec.get("value") is not None
                         and not rec.get("replayed")):
-                    if fresh:
-                        bench_rec = rec
-                    else:
-                        stale = rec
-                elif (rec.get("stage") == "sweep" and fresh
+                    bench_cands.append(rec)
+                elif (rec.get("stage") == "sweep"
                       and rec.get("op") == "murmur3"
                       and rec.get("n_log2", 0) >= 22):
-                    sweep_rec = rec
+                    sweep_cands.append(rec)
     except OSError:
         pass
+
+    # freshness check only for actual candidates, newest first, memoized
+    # per commit (each check may spawn one git subprocess)
+    memo = {}
+
+    def _fresh(rec):
+        c = rec.get("commit", "")
+        if c not in memo:
+            memo[c] = _same_code(c, head)
+        return memo[c]
+
+    bench_rec = next((r for r in reversed(bench_cands) if _fresh(r)), None)
+    sweep_rec = next((r for r in reversed(sweep_cands) if _fresh(r)), None)
+    stale = bench_cands[-1] if bench_cands and bench_rec is None else None
     why = f"device unusable at bench time: {reason}"
     if bench_rec is not None:
         out = {k: bench_rec.get(k) for k in

@@ -3,10 +3,11 @@
 The axon tunnel flaps; tools/perf_capture.py banks any live-window
 measurement (stamped with the capture commit) into PERF_CAPTURE.jsonl.
 When the driver's end-of-round bench finds the device unusable it must
-replay the freshest banked line ONLY when that capture ran at the current
-HEAD (so the headline always measures the code being judged), mark the
-output with top-level ``replayed: true``, and surface stale-commit
-captures in detail without using them as the headline.
+replay the freshest banked line ONLY when no performance-relevant file
+changed between the capture commit and HEAD (equal commits trivially
+qualify; the driver's doc/telemetry snapshot commit stays neutral), mark
+the output with top-level ``replayed: true``, and surface stale captures
+in detail without using them as the headline.
 """
 
 import json
@@ -68,3 +69,33 @@ def test_null_when_nothing_banked(tmp_path, monkeypatch):
     r = bench._replay_capture("dead")
     assert r["value"] is None
     assert "dead" in r["detail"]["error"]
+
+
+def test_doc_only_commits_keep_captures_replayable(tmp_path, monkeypatch):
+    """The driver's end-of-round snapshot commit (telemetry/docs only) must
+    not invalidate the round's banked hardware numbers."""
+    _arm(tmp_path, monkeypatch, [
+        {"stage": "bench", "metric": "murmur3_32_int32_throughput",
+         "value": 42.0, "unit": "Grows/s", "vs_baseline": 42.0,
+         "detail": {}, "ts": 2.0, "commit": "cap111"},
+    ])
+    calls = {}
+
+    def fake_same_code(commit, head):
+        calls["args"] = (commit, head)
+        return commit == "cap111" and head == HEAD  # doc-only diff: True
+    monkeypatch.setattr(bench, "_same_code", fake_same_code)
+    r = bench._replay_capture("probe hung")
+    assert calls["args"] == ("cap111", HEAD)
+    assert r["value"] == 42.0 and r["replayed"] is True
+
+
+def test_same_code_path_filter():
+    assert bench._same_code("x", "x")
+    assert not bench._same_code("", "y")
+    # the path filter itself
+    neutral = ["docs/PERF.md", "PERF_CAPTURE.jsonl", "README.md"]
+    hot = ["spark_rapids_jni_tpu/ops/hashing.py"]
+    pn = bench._PERF_NEUTRAL
+    assert all(any(p.startswith(x) for x in pn) for p in neutral)
+    assert not any(any(p.startswith(x) for x in pn) for p in hot)
