@@ -67,6 +67,113 @@ def _fmix(h, length_u32):
     return h ^ (h >> _U32(16))
 
 
+# ---- u64-as-u32-limb-pair arithmetic (xxhash64 kernels) -------------------
+# No 64-bit types exist inside Mosaic on this TPU; every u64 op is spelled
+# in u32 lanes, multiplies via 16-bit splits (four 16x16->32 partials).
+# Constants stay PYTHON ints (Pallas rejects closed-over array constants);
+# the helpers accept int or u32-array operands interchangeably.
+
+
+def _lo16(x):
+    return x & 0xFFFF
+
+
+def _hi16(x):
+    return x >> 16
+
+
+def _mul32_full(a, b):
+    """(hi, lo) u32 pair = full 64-bit product of two u32 lanes/ints."""
+    a0, a1 = _lo16(a), _hi16(a)
+    b0, b1 = _lo16(b), _hi16(b)
+    p00 = a0 * b0
+    p01 = a0 * b1
+    p10 = a1 * b0
+    p11 = a1 * b1
+    mid = _hi16(p00) + _lo16(p01) + _lo16(p10)
+    lo = _lo16(p00) | (_lo16(mid) << 16)
+    hi = p11 + _hi16(p01) + _hi16(p10) + _hi16(mid)
+    return hi, lo
+
+
+def _mul64(ah, al, bh, bl):
+    """Low 64 bits of a 64x64 product, as a (hi, lo) u32 pair."""
+    hi, lo = _mul32_full(al, bl)
+    return hi + al * bh + ah * bl, lo
+
+
+def _add64(ah, al, bh, bl):
+    lo = al + bl
+    carry = (lo < al).astype(_U32)
+    return ah + bh + carry, lo
+
+
+def _rotl64(h, l, r: int):
+    if r == 32:
+        return l, h
+    if r < 32:
+        return ((h << r) | (l >> (32 - r)), (l << r) | (h >> (32 - r)))
+    r -= 32
+    return ((l << r) | (h >> (32 - r)), (h << r) | (l >> (32 - r)))
+
+
+def _shr64(h, l, r: int):
+    if r == 32:
+        return jnp.zeros_like(h), h
+    if r < 32:
+        return h >> r, (l >> r) | (h << (32 - r))
+    return jnp.zeros_like(h), h >> (r - 32)
+
+
+def _xor64(ah, al, bh, bl):
+    return ah ^ bh, al ^ bl
+
+
+def _p(v: int):
+    return v >> 32, v & 0xFFFFFFFF
+
+
+# xxhash64 primes as (hi, lo) int pairs (hashing.py _XX_P*)
+_XP1 = _p(0x9E3779B185EBCA87)
+_XP2 = _p(0xC2B2AE3D27D4EB4F)
+_XP3 = _p(0x165667B19E3779F9)
+_XP4 = _p(0x85EBCA77C2B2AE63)
+_XP5_PLUS_4 = _p((0x27D4EB2F165667C5 + 4) & ((1 << 64) - 1))
+_XP5_PLUS_8 = _p((0x27D4EB2F165667C5 + 8) & ((1 << 64) - 1))
+
+
+def _xx_finalize_pair(h, l):
+    h, l = _xor64(h, l, *_shr64(h, l, 33))
+    h, l = _mul64(h, l, *_XP2)
+    h, l = _xor64(h, l, *_shr64(h, l, 29))
+    h, l = _mul64(h, l, *_XP3)
+    return _xor64(h, l, *_shr64(h, l, 32))
+
+
+def _xx4_kernel(v_ref, sh_ref, sl_ref, oh_ref, ol_ref):
+    """xxhash64 of one 4-byte value per lane (hashing._xx_hash_fixed4)."""
+    h, l = _add64(sh_ref[:], sl_ref[:], *_XP5_PLUS_4)
+    wh, wl = _mul64(jnp.zeros_like(h), v_ref[:], *_XP1)
+    h, l = _xor64(h, l, wh, wl)
+    h, l = _rotl64(h, l, 23)
+    h, l = _mul64(h, l, *_XP2)
+    h, l = _add64(h, l, *_XP3)
+    oh_ref[:], ol_ref[:] = _xx_finalize_pair(h, l)
+
+
+def _xx8_kernel(vh_ref, vl_ref, sh_ref, sl_ref, oh_ref, ol_ref):
+    """xxhash64 of one 8-byte value per lane (hashing._xx_hash_fixed8)."""
+    h, l = _add64(sh_ref[:], sl_ref[:], *_XP5_PLUS_8)
+    kh, kl = _mul64(vh_ref[:], vl_ref[:], *_XP2)
+    kh, kl = _rotl64(kh, kl, 31)
+    kh, kl = _mul64(kh, kl, *_XP1)
+    h, l = _xor64(h, l, kh, kl)
+    h, l = _rotl64(h, l, 27)
+    h, l = _mul64(h, l, *_XP1)
+    h, l = _add64(h, l, *_XP4)
+    oh_ref[:], ol_ref[:] = _xx_finalize_pair(h, l)
+
+
 def _int_kernel(v_ref, h_ref, out_ref):
     out_ref[:] = _fmix(_mix_h1(h_ref[:], _mix_k1(v_ref[:])), _U32(4))
 
@@ -98,26 +205,39 @@ def _to_blocks(x, dtype, block_rows: int) -> jnp.ndarray:
     return x.reshape(-1, _LANES)
 
 
-@functools.partial(jax.jit, static_argnames=("n_inputs",))
-def _launch(n_inputs, *flat_u32):
+_KERNELS = {  # name -> (kernel_fn, n_outputs); one launch scaffold for all
+    "mm_int": (_int_kernel, 1),
+    "mm_long": (_long_kernel, 1),
+    "xx4": (_xx4_kernel, 2),
+    "xx8": (_xx8_kernel, 2),
+}
+
+
+@functools.partial(jax.jit, static_argnames=("kern",))
+def _launch(kern, *flat_u32):
+    """Shared row-block launch scaffold for every elementwise hash kernel:
+    one place owns block sizing, VMEM specs, grid, and interpret gating."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    kernel, n_out = _KERNELS[kern]
     br = _block_rows_for(flat_u32[0].shape[0])
     blocks = [_to_blocks(x, _U32, br) for x in flat_u32]
     rows = blocks[0].shape[0]
-    kernel = _int_kernel if n_inputs == 2 else _long_kernel
     spec = pl.BlockSpec((br, _LANES), lambda i: (i, 0),
                         memory_space=pltpu.VMEM)
+    shape = jax.ShapeDtypeStruct((rows, _LANES), _U32)
     out = pl.pallas_call(
         kernel,
         grid=(rows // br,),
-        in_specs=[spec] * n_inputs,
-        out_specs=spec,
-        out_shape=jax.ShapeDtypeStruct((rows, _LANES), _U32),
+        in_specs=[spec] * len(blocks),
+        out_specs=spec if n_out == 1 else (spec,) * n_out,
+        out_shape=shape if n_out == 1 else (shape,) * n_out,
         interpret=_use_interpret(),
     )(*blocks)
-    return out.reshape(-1)
+    if n_out == 1:
+        return out.reshape(-1)
+    return tuple(o.reshape(-1) for o in out)
 
 
 def _bytes_words_kernel(words_ref, h_ref, nw_ref, out_ref):
@@ -183,13 +303,52 @@ def mm_bytes_words_pallas(words: jnp.ndarray, nwords: jnp.ndarray,
     return out.reshape(-1)[:n]
 
 
+
+
+
+def _seed_limbs(seed, n):
+    s = jnp.broadcast_to(jnp.asarray(seed, jnp.uint64), (n,))
+    return ((s >> jnp.uint64(32)).astype(_U32),
+            (s & jnp.uint64(0xFFFFFFFF)).astype(_U32))
+
+
+def _pair_to_u64(oh, ol, n):
+    return ((oh[:n].astype(jnp.uint64) << jnp.uint64(32))
+            | ol[:n].astype(jnp.uint64))
+
+
+def xx_hash_fixed4_pallas(v_u32: jnp.ndarray, seed) -> jnp.ndarray:
+    """Pallas twin of hashing._xx_hash_fixed4; all 64-bit arithmetic runs
+    as u32 limb pairs in VMEM (16-bit-split multiplies) instead of the
+    XLA x64 rewrite's generic emulation."""
+    n = v_u32.shape[0]
+    if n == 0:
+        return jnp.zeros((0,), jnp.uint64)
+    sh, sl = _seed_limbs(seed, n)
+    oh, ol = _launch("xx4", v_u32.astype(_U32), sh, sl)
+    return _pair_to_u64(oh, ol, n)
+
+
+def xx_hash_fixed8_pallas(v_u64: jnp.ndarray, seed) -> jnp.ndarray:
+    """Pallas twin of hashing._xx_hash_fixed8 (8-byte values)."""
+    n = v_u64.shape[0]
+    if n == 0:
+        return jnp.zeros((0,), jnp.uint64)
+    v = jnp.asarray(v_u64, jnp.uint64)
+    vh = (v >> jnp.uint64(32)).astype(_U32)
+    vl = (v & jnp.uint64(0xFFFFFFFF)).astype(_U32)
+    sh, sl = _seed_limbs(seed, n)
+    oh, ol = _launch("xx8", vh, vl, sh, sl)
+    return _pair_to_u64(oh, ol, n)
+
+
 def mm_hash_int_pallas(v_i32: jnp.ndarray, h_u32: jnp.ndarray) -> jnp.ndarray:
     """Pallas twin of hashing._mm_hash_int (Spark Murmur3.hashInt round)."""
     n = v_i32.shape[0]
     if n == 0:
         return jnp.zeros((0,), _U32)
     h = jnp.broadcast_to(jnp.asarray(h_u32, _U32), (n,))  # scalar seeds ok
-    return _launch(2, v_i32.astype(_U32), h)[:n]
+    return _launch("mm_int", v_i32.astype(_U32), h)[:n]
 
 
 def mm_hash_long_pallas(v_i64: jnp.ndarray, h_u32: jnp.ndarray) -> jnp.ndarray:
@@ -202,4 +361,4 @@ def mm_hash_long_pallas(v_i64: jnp.ndarray, h_u32: jnp.ndarray) -> jnp.ndarray:
     lo = (v & jnp.uint64(0xFFFFFFFF)).astype(_U32)
     hi = (v >> jnp.uint64(32)).astype(_U32)
     h = jnp.broadcast_to(jnp.asarray(h_u32, _U32), (n,))
-    return _launch(3, lo, hi, h)[:n]
+    return _launch("mm_long", lo, hi, h)[:n]

@@ -199,11 +199,19 @@ def _xx_finalize(h):
 
 
 def _xx_hash_fixed4(v_u32, seed):
+    if _pallas_backend():
+        from spark_rapids_jni_tpu.ops.hash_pallas import xx_hash_fixed4_pallas
+
+        return xx_hash_fixed4_pallas(v_u32, seed)
     h64 = seed + _XX_P5 + _U64(4)
     return _xx_finalize(_xx_round4(h64, v_u32.astype(_U64) & _U64(0xFFFFFFFF)))
 
 
 def _xx_hash_fixed8(v_u64, seed):
+    if _pallas_backend():
+        from spark_rapids_jni_tpu.ops.hash_pallas import xx_hash_fixed8_pallas
+
+        return xx_hash_fixed8_pallas(v_u64, seed)
     h64 = seed + _XX_P5 + _U64(8)
     return _xx_finalize(_xx_round8(h64, v_u64))
 

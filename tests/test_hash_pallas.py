@@ -155,3 +155,57 @@ def test_bytes_word_kernel_multi_row_block():
 
     want, _ = jax.lax.scan(step, h, jnp.arange(words.shape[1]))
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n", [1, 255, 4099])
+def test_xx_fixed4_bit_exact(n):
+    from spark_rapids_jni_tpu.ops.hash_pallas import xx_hash_fixed4_pallas
+    from spark_rapids_jni_tpu.ops.hashing import _xx_hash_fixed4
+
+    rng = np.random.RandomState(n)
+    v = jnp.asarray(rng.randint(0, 2**32, n, dtype=np.uint64).astype(np.uint32))
+    seeds = jnp.asarray(rng.randint(0, 2**64, n, dtype=np.uint64))
+    got = xx_hash_fixed4_pallas(v, seeds)
+    want = _xx_hash_fixed4(v, seeds)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # scalar seed + boundary values
+    edge = jnp.asarray(np.array([0, 0xFFFFFFFF, 1], np.uint32))
+    g2 = xx_hash_fixed4_pallas(edge, jnp.uint64(42))
+    w2 = _xx_hash_fixed4(edge, jnp.uint64(42))
+    np.testing.assert_array_equal(np.asarray(g2), np.asarray(w2))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n", [1, 300, 5000])
+def test_xx_fixed8_bit_exact(n):
+    from spark_rapids_jni_tpu.ops.hash_pallas import xx_hash_fixed8_pallas
+    from spark_rapids_jni_tpu.ops.hashing import _xx_hash_fixed8
+
+    rng = np.random.RandomState(n + 1)
+    v = jnp.asarray(rng.randint(0, 2**64, n, dtype=np.uint64))
+    seeds = jnp.asarray(rng.randint(0, 2**64, n, dtype=np.uint64))
+    got = xx_hash_fixed8_pallas(v, seeds)
+    want = _xx_hash_fixed8(v, seeds)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    edge = jnp.asarray(np.array([0, (1 << 64) - 1, 1 << 63], np.uint64))
+    g2 = xx_hash_fixed8_pallas(edge, jnp.uint64(42))
+    w2 = _xx_hash_fixed8(edge, jnp.uint64(42))
+    np.testing.assert_array_equal(np.asarray(g2), np.asarray(w2))
+
+
+def test_backend_flag_routes_xxhash64_columns():
+    rows = 500
+    rng = np.random.RandomState(9)
+    from spark_rapids_jni_tpu.ops import xxhash64
+
+    cols = [
+        Column(jnp.asarray(rng.randint(-(2**31), 2**31, rows).astype(np.int32)),
+               jnp.asarray(rng.rand(rows) < 0.9), INT32),
+        Column(jnp.asarray(rng.randint(-(2**63), 2**63, rows, dtype=np.int64)),
+               None, INT64),
+    ]
+    want = xxhash64(cols, seed=42).to_list()
+    with config.override(hash_backend="pallas"):
+        got = xxhash64(cols, seed=42).to_list()
+    assert got == want

@@ -55,6 +55,9 @@ for log2 in {sizes}:
     def _mm_pallas(d):
         with config.override(hash_backend="pallas"):
             return murmur_hash32([Column(d, None, INT32)], seed=42).data
+    def _xx_pallas(d):
+        with config.override(hash_backend="pallas"):
+            return xxhash64([Column(d, None, INT32)], seed=42).data
     ops = dict(
         copy=(jax.jit(lambda d: d + 1), 8),
         murmur3=(jax.jit(lambda d: murmur_hash32(
@@ -62,6 +65,7 @@ for log2 in {sizes}:
         murmur3_pallas=(jax.jit(_mm_pallas), 8),
         xxhash64=(jax.jit(lambda d: xxhash64(
             [Column(d, None, INT32)], seed=42).data), 12),
+        xxhash64_pallas=(jax.jit(_xx_pallas), 12),
     )
     for name, (f, bpr) in ops.items():
         if name not in {ops_on!r}:  # ops_on is a tuple of op names
@@ -167,7 +171,8 @@ def capture_once() -> bool:
     """One full staged capture; returns True if the headline bench landed."""
     sweep_small = SWEEP.format(
         repo=REPO, sizes=[20, 22],
-        ops_on=("copy", "murmur3", "murmur3_pallas", "xxhash64"))
+        ops_on=("copy", "murmur3", "murmur3_pallas", "xxhash64",
+                "xxhash64_pallas"))
     sweep_big = SWEEP.format(
         repo=REPO, sizes=[24, 26],
         ops_on=("copy", "murmur3", "murmur3_pallas"))
