@@ -254,3 +254,34 @@ def test_injected_spill_fault_keeps_arbiter_protocol_consistent(gov):
     budget.acquire(400)  # protocol intact: a fitting acquire still works
     budget.release(400)
     assert not a.spilled  # the faulted spill left the buffer resident
+
+
+def test_config_driven_fault_injection_on_spill_category(gov):
+    """The public JSON fault-injection path targets spill traffic: a
+    'spill' rule fires on the staging copy, propagates cleanly through
+    the spill ladder (alloc bracket closed), and the system keeps
+    working after the count is exhausted."""
+    from spark_rapids_jni_tpu.obs.faultinj import (
+        FaultInjector,
+        InjectedException,
+    )
+
+    budget = _budget(gov, 8192)
+    pool = SpillPool(budget)
+    a = pool.add(np.zeros(1024, np.float32))
+    with a.use():
+        pass  # resident, idle spill candidate
+
+    FaultInjector.install({
+        "spill": {"*": {"injectionType": "exception",
+                        "interceptionCount": 1}},
+    })
+    try:
+        with pytest.raises(InjectedException):
+            budget.acquire(6000)  # needs the cache spilled -> rule fires
+        # count exhausted: the same acquire now spills and succeeds
+        budget.acquire(6000)
+        budget.release(6000)
+        assert a.spilled
+    finally:
+        FaultInjector.uninstall()
