@@ -180,6 +180,8 @@ def _replay_capture(reason: str):
         out = {k: bench_rec.get(k) for k in
                ("metric", "value", "unit", "vs_baseline")}
         out["replayed"] = True
+        # provenance must survive consumers that drop unknown keys
+        out["unit"] = f"{out.get('unit') or 'Grows/s'} (replayed)"
         detail = dict(bench_rec.get("detail") or {})
         detail["replayed_from_ts"] = bench_rec.get("ts")
         detail["capture_commit"] = bench_rec.get("commit")
@@ -191,7 +193,7 @@ def _replay_capture(reason: str):
         return {
             "metric": "murmur3_32_int32_throughput",
             "value": round(rows_s / 1e9, 4),
-            "unit": "Grows/s",
+            "unit": "Grows/s (replayed)",
             "vs_baseline": round(rows_s / NOMINAL_BASELINE_ROWS_PER_S, 4),
             "replayed": True,
             "detail": {

@@ -260,6 +260,11 @@ class BudgetedResource:
         wasted = 0
         while True:
             likely_spill = arb.pre_alloc(tid, is_cpu=self.is_cpu, blocking=True)
+            # True once post_alloc_failed has run for THIS pre_alloc (the
+            # spill-failure path below); the outer OutOfBudget handler must
+            # then re-raise instead of closing the bracket a second time —
+            # a double post_alloc_failed corrupts arbiter thread state.
+            bracket_closed = False
             try:
                 if self._try_reserve(nbytes):
                     arb.post_alloc_success(tid, is_cpu=self.is_cpu, was_recursive=likely_spill)
@@ -273,6 +278,7 @@ class BudgetedResource:
                         # the alloc bracket first so the thread returns to
                         # RUNNING and the next pre_alloc is not misread as
                         # a recursive/spill allocation
+                        bracket_closed = True
                         arb.post_alloc_failed(
                             tid, is_cpu=self.is_cpu, is_oom=False,
                             blocking=False, was_recursive=likely_spill)
@@ -284,6 +290,11 @@ class BudgetedResource:
                 raise OutOfBudget(f"out of budget: {nbytes} requested, "
                                   f"{self.limit - self.used} available")
             except OutOfBudget:
+                if bracket_closed:
+                    # originated inside _spill_for (a handler that itself
+                    # allocates budget, per the recursive-alloc protocol);
+                    # the bracket is already closed — just propagate
+                    raise
                 wasted += 1
                 escalate = wasted >= self.WASTED_WAKE_LIMIT
                 if escalate:

@@ -189,16 +189,28 @@ class SpillPool:
             self._budget.release(buf.nbytes)  # never leak the reservation
             raise
         with self._lock:
-            if buf._dev is None:
-                buf._dev = dev
-                buf._host = None
-                won = True
-            else:
+            if buf not in self._buffers:
+                # remove()/close() raced the unlocked admission above: the
+                # buffer is orphaned, so installing _dev would leak this
+                # reservation forever (remove() saw it spilled and released
+                # nothing; _unpin never releases).  Drop it and fail.
+                removed = True
                 won = False
-            buf._pins += 1
-            out = buf._dev
+            else:
+                removed = False
+                if buf._dev is None:
+                    buf._dev = dev
+                    buf._host = None
+                    won = True
+                else:
+                    won = False
+                buf._pins += 1
+                out = buf._dev
         if not won:
             self._budget.release(buf.nbytes)
+        if removed:
+            raise RuntimeError("spillable buffer was removed from its pool "
+                               "during host->device re-admission")
         return out
 
     def _unpin(self, buf: SpillableBuffer) -> None:

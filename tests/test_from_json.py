@@ -28,6 +28,15 @@ def materialize(lst):
     return out
 
 
+def test_from_json_canary():
+    """Quick-tier canary: one tiny fixed case so a tokenizer/from_json
+    regression fails QUICK=1, not just full CI (larger vector suites below
+    stay in the slow tier for compile cost)."""
+    col = c.strings_column(['{"a": 1}', None])
+    got = materialize(from_json(col))
+    assert got == [[("a", "1")], None]
+
+
 @pytest.mark.slow
 def test_extract_raw_map_basic():
     # MapUtilsTest.java testExtractRawMapFromJsonString

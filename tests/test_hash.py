@@ -45,6 +45,21 @@ F64_MAX = struct.unpack("<d", struct.pack("<Q", 0x7FEFFFFFFFFFFFFF))[0]
 # --- murmur3-32 vectors (HashTest.java:47-151) -----------------------------------
 
 
+def test_murmur_strings_canary():
+    """Quick-tier canary: two reference string vector rows (HashTest.java)
+    so a string-path regression fails QUICK=1, not just full CI."""
+    col = c.strings_column(["a", None])
+    out = murmur_hash32([col], seed=42)
+    assert out.to_list() == [1485273170, 42]
+
+
+def test_xxhash64_strings_canary():
+    """Quick-tier canary: one reference xxhash64 string vector row."""
+    col = c.strings_column(["a", None])
+    out = xxhash64([col])
+    assert out.to_list() == [-8582455328737087284, 42]
+
+
 @pytest.mark.slow
 def test_murmur_strings():
     col = c.strings_column(

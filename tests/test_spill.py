@@ -285,3 +285,55 @@ def test_config_driven_fault_injection_on_spill_category(gov):
         assert a.spilled
     finally:
         FaultInjector.uninstall()
+
+
+def test_spill_handler_raising_oob_closes_bracket_once(gov):
+    """Round-3 advisor (medium): a spill handler that itself raises
+    OutOfBudget (e.g. a future handler allocating host budget while
+    staging, per the recursive-alloc protocol) must close the arbiter
+    alloc bracket exactly once.  Before the fix the BaseException path
+    ran post_alloc_failed and the re-raise was then caught by the outer
+    OutOfBudget handler, double-closing the bracket and corrupting the
+    thread's arbiter state."""
+    from spark_rapids_jni_tpu.mem import current_thread_id
+    from spark_rapids_jni_tpu.mem.arbiter import STATE_RUNNING
+    from spark_rapids_jni_tpu.mem.governor import OutOfBudget
+
+    budget = _budget(gov, 4096)
+
+    def greedy_handler(shortfall):
+        raise OutOfBudget("host staging budget exhausted")
+
+    budget.register_spill_handler(greedy_handler)
+    budget.acquire(3000)
+    with pytest.raises(OutOfBudget, match="staging"):
+        budget.acquire(3000)  # reserve fails -> handler raises mid-ladder
+    assert gov.arbiter.state_of(current_thread_id()) == STATE_RUNNING
+    budget.acquire(1000)  # bracket closed exactly once: protocol intact
+    budget.release(1000)
+    budget.release(3000)
+
+
+def test_remove_racing_readmission_releases_reservation(gov):
+    """Round-3 advisor (low): remove() racing a concurrent host->device
+    re-admission must not leak the re-admission's budget reservation.
+    The seam injector deterministically lands remove() inside _pin's
+    unlocked window (after acquire, before the final install lock)."""
+    from spark_rapids_jni_tpu.obs import seam
+
+    budget = _budget(gov, 8192)
+    pool = SpillPool(budget)
+    a = pool.add(np.zeros(1024, np.float32))  # HOST-side: no budget held
+
+    def inject(cat, name):
+        if cat == seam.SPILL and name.startswith("readmit:"):
+            pool.remove(a)
+
+    seam._set_injector(inject)
+    try:
+        with pytest.raises(RuntimeError, match="removed"):
+            with a.use():
+                pass
+    finally:
+        seam._set_injector(None)
+    assert budget.used == 0, "orphaned re-admission leaked its reservation"
