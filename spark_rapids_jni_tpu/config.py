@@ -142,11 +142,10 @@ register("fault_injector_config_path", "",
          "JSON config that arms the fault injector at import "
          "(obs/faultinj.py; the FAULT_INJECTOR_CONFIG_PATH analog).",
          env="SRT_FAULT_INJECTOR_CONFIG_PATH")
-register("json_eval_device", False,
-         "Evaluate JSON paths with the jitted lax.scan machine "
-         "(ops/json_eval_device.py) instead of the host numpy machine "
-         "(only relevant when json_device_render is off).",
-         env="SRT_JSON_EVAL_DEVICE")
+# NOTE: the round-2 "json_eval_device" flag (device scan + host render, a
+# third evaluator shadowed by json_device_render) was removed in round 4;
+# its lax.scan machine lives on as ops/json_scan.py, the core of the
+# device-render product path below.
 register("json_device_render", True,
          "Fully device-resident get_json_object: device machine + device "
          "segment rendering (ops/json_render_device.py); bytes cross to "

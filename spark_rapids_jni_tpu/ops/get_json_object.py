@@ -1010,8 +1010,7 @@ def _get_json_object_device(col: StringColumn, ptypes, pargs, names
     Parity: the single-kernel residency of get_json_object.cu:891.
     """
     from spark_rapids_jni_tpu.ops import json_render_device as jrd
-    from spark_rapids_jni_tpu.ops.json_eval_device import MAX_PATH_DEPTH as _MPD
-    from spark_rapids_jni_tpu.ops.json_eval_device import _run_scan
+    from spark_rapids_jni_tpu.ops.json_scan import _run_scan
 
     n = col.size
     in_valid = col.is_valid()
@@ -1058,7 +1057,7 @@ def _get_json_object_device(col: StringColumn, ptypes, pargs, names
                  jnp.zeros((P1 - len(nm), nr, T), bool)])
 
             F = min(jt.MAX_DEPTH + MAX_PATH_DEPTH + 6, T + 3)
-            G = min(_MPD + 2, F)
+            G = min(MAX_PATH_DEPTH + 2, F)
             err, done, dirty_root, (segs, cg, cd, cn) = _run_scan(
                 kind, ts.match, ntok, ts.ok, nm_stack, ptype_j, parg_j,
                 T, F, G)
@@ -1158,17 +1157,13 @@ def get_json_object(col: StringColumn, path: Sequence[tuple]) -> StringColumn:
     for b in padded_buckets(col):
         ts = jt.tokenize(b.bytes, b.lengths)
         # one device->host transfer per token array; host paths use slices
-        kind_f = np.asarray(ts.kind).astype(np.int32)
-        match_f = np.asarray(ts.match)
-        ntok_f = np.asarray(ts.n_tokens).astype(np.int64)
-        ok_f = np.asarray(ts.ok)
-        nr, nv = b.n_rows, b.n_valid
-        kind = kind_f[:nv]
+        nv = b.n_valid
+        kind = np.asarray(ts.kind).astype(np.int32)[:nv]
         start = np.asarray(ts.start)[:nv]
         end = np.asarray(ts.end)[:nv]
-        match = match_f[:nv]
-        ntok = ntok_f[:nv]
-        ok = ok_f[:nv]
+        match = np.asarray(ts.match)[:nv]
+        ntok = np.asarray(ts.n_tokens).astype(np.int64)[:nv]
+        ok = np.asarray(ts.ok)[:nv]
         rows_np = np.asarray(b.rows)[:nv]
 
         bi = _byte_info(b.bytes, b.lengths, n_valid=nv)
@@ -1176,24 +1171,8 @@ def get_json_object(col: StringColumn, path: Sequence[tuple]) -> StringColumn:
         nm = _name_matches(bi, kind, start, end, names, len_raw, has_uni)
         ftext, flen, fidx = _float_texts(bi, kind, start, end)
 
-        if config.get("json_eval_device"):
-            from spark_rapids_jni_tpu.ops.json_eval_device import run_device
-
-            # scan on the full pow2-padded bucket (bounded compile-variant
-            # set); the padding tail has ok=False so it idles, and outputs
-            # are sliced back to the real rows below
-            nm_full = [np.pad(a, ((0, nr - nv), (0, 0))) for a in nm]
-            m, segs = run_device(kind_f, match_f, ntok_f, ok_f,
-                                 ptypes, pargs, nm_full)
-            m.err = m.err[:nv]
-            m.dirty_root = m.dirty_root[:nv]
-            m.n = nv
-            segs = [sg[:nv] for sg in segs]
-            m.res_dirty = {g: v[:nv] for g, v in m.res_dirty.items()}
-            m.res_nc = {g: v[:nv] for g, v in m.res_nc.items()}
-        else:
-            m = _Machine(kind, start, end, match, ntok, ok, ptypes, pargs, nm)
-            segs = m.run()
+        m = _Machine(kind, start, end, match, ntok, ok, ptypes, pargs, nm)
+        segs = m.run()
         m.err |= m.dirty_root <= 0
         m.err |= ~np.asarray(in_valid)[rows_np]
         padded, out_len = _render(bi, segs, m, kind, start, end,

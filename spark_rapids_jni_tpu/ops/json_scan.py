@@ -1,22 +1,23 @@
-"""Device-side JSON path evaluation: the lockstep token machine as lax.scan.
+"""The JSON path machine as a jitted lax.scan — core of the device pipeline.
 
-A jitted translation of ops/get_json_object.py's host ``_Machine`` —
+A device translation of ops/get_json_object.py's host ``_Machine`` —
 the explicit-stack form of evaluate_path (get_json_object.cu:360-394) with
 every row advancing one token (or one frame return) per scan step.  State is
 a pytree of [n]- and [n, F]-shaped arrays; frame/generator stack updates are
 one-hot writes at the stack pointer.  Shapes (n, T, F, G, S) all derive from
 the pow2 bucket geometry, so the compiled-variant set stays bounded.
 
-Selected via the ``json_eval_device`` config flag; both backends emit the
-identical segment stream, so the renderer and all corpus/fuzz tests are
-shared.  Equivalence with the host machine is asserted directly in
-tests/test_get_json_object.py.
+``_run_scan`` is consumed by the fully device-resident product path
+(ops/json_render_device.py via _get_json_object_device); the host numpy
+machine remains the debug oracle (``json_device_render=False``).  A third,
+host-rendered wrapper around this scan (the round-2 ``json_eval_device``
+A/B arm) was removed in round 4: equivalence of the product path against
+the host oracle is asserted end to end in tests/test_get_json_object*.
 """
 
 from __future__ import annotations
 
 import functools
-from types import SimpleNamespace
 
 import jax
 import jax.numpy as jnp
@@ -25,7 +26,6 @@ import numpy as np
 from spark_rapids_jni_tpu.ops import json_tokenizer as jt
 from spark_rapids_jni_tpu.ops.get_json_object import (
     INDEX,
-    MAX_PATH_DEPTH,
     NAMED,
     WILDCARD,
     _C_CLOSE_ARR,
@@ -451,46 +451,3 @@ def _run_scan(kind, match, ntok, ok, nm_stack, ptype, parg,
     )
     st, ys = jax.lax.scan(step, init, jnp.arange(S, dtype=_I32))
     return st["err"], st["done"], st["dirty_root"], ys
-
-
-def run_device(kind, match, ntok, ok, path_types, path_args, name_match):
-    """Drop-in device replacement for the host _Machine: same result shape."""
-    n, T = kind.shape
-    P1 = len(path_types) + 1
-    ptype = np.asarray(list(path_types) + [_P_END], np.int32)
-    parg = np.asarray(
-        [a if isinstance(a, int) else 0 for a in path_args] + [0], np.int32)
-    if name_match:
-        nm_stack = np.stack(name_match).astype(bool)
-        nm_stack = np.concatenate(
-            [nm_stack, np.zeros((P1 - len(name_match), n, T), bool)])
-    else:
-        nm_stack = np.zeros((P1, n, T), bool)
-
-    F = min(jt.MAX_DEPTH + MAX_PATH_DEPTH + 6, T + 3)
-    G = min(MAX_PATH_DEPTH + 2, F)
-    err, done, dirty_root, (segs, close_grp, close_dirty, close_nc) = \
-        _run_scan(jnp.asarray(kind), jnp.asarray(match),
-                  jnp.asarray(ntok.astype(np.int32)),
-                  jnp.asarray(np.asarray(ok, bool)), jnp.asarray(nm_stack),
-                  jnp.asarray(ptype), jnp.asarray(parg), T, F, G)
-
-    err = np.asarray(err) | ~np.asarray(done)
-    segs_np = np.asarray(segs)  # [S, n, 2, 2]
-    seg_list = [segs_np[i].astype(np.int32) for i in range(segs_np.shape[0])]
-
-    res_dirty = {}
-    res_nc = {}
-    cg = np.asarray(close_grp)
-    cd = np.asarray(close_dirty)
-    cn = np.asarray(close_nc)
-    steps, rows = np.nonzero(cg >= 0)
-    for srow, r in zip(steps, rows):
-        g = int(cg[srow, r])
-        res_dirty.setdefault(g, np.zeros(n, np.int64))[r] = cd[srow, r]
-        res_nc.setdefault(g, np.zeros(n, bool))[r] = cn[srow, r]
-
-    return SimpleNamespace(
-        n=n, T=T, err=err, dirty_root=np.asarray(dirty_root).astype(np.int64),
-        res_dirty=res_dirty, res_nc=res_nc,
-    ), seg_list

@@ -57,8 +57,10 @@ _FUZZ_PATHS = [
 
 
 @pytest.mark.slow
-def test_device_eval_backend_corpus():
-    """The jitted lax.scan evaluator must match the host machine exactly."""
+def test_device_scan_machine_corpus():
+    """The device pipeline (whose core is the ops/json_scan.py lax.scan
+    machine) must match the host machine pipeline exactly on the corpus
+    that used to drive the removed json_eval_device A/B arm."""
     from spark_rapids_jni_tpu import config
 
     rows = [
@@ -71,27 +73,11 @@ def test_device_eval_backend_corpus():
     paths = [[], [named("k")], [WC], [WC, WC], [idx(1)], [idx(1), WC],
              [named("a"), WC, named("b")]]
     for path in paths:
-        # pin the host pipeline off the device-render default so this
-        # actually compares the lax.scan machine against the host machine
+        with config.override(json_device_render=True):
+            dev = run(rows, path)
         with config.override(json_device_render=False):
             host = run(rows, path)
-            with config.override(json_eval_device=True):
-                dev = run(rows, path)
         assert dev == host, f"path={path}"
-
-
-@pytest.mark.slow
-def test_device_eval_backend_fuzz():
-    from spark_rapids_jni_tpu import config
-
-    rng = random.Random(7)
-    rows = [_rand_json(rng) for _ in range(120)]
-    for path in _FUZZ_PATHS[:6]:
-        want = [jo.get_json_object(s, path) for s in rows]
-        with config.override(json_device_render=False,
-                             json_eval_device=True):
-            got = run(rows, path)
-        assert got == want, f"path={path}"
 
 
 @pytest.mark.slow
