@@ -1,4 +1,5 @@
-"""Host-side IO tooling (parquet footer parse/filter/serialize)."""
+"""Host-side IO tooling (parquet footer parse/filter/serialize + the
+split-planned reader that consumes the filtered footer)."""
 
 from spark_rapids_jni_tpu.io.parquet_footer import (
     ListElement,
@@ -8,6 +9,11 @@ from spark_rapids_jni_tpu.io.parquet_footer import (
     StructElement,
     ValueElement,
 )
+from spark_rapids_jni_tpu.io.parquet_read import (
+    plan_byte_splits,
+    plan_split,
+    read_split,
+)
 
 __all__ = [
     "ListElement",
@@ -16,4 +22,7 @@ __all__ = [
     "StructBuilder",
     "StructElement",
     "ValueElement",
+    "plan_byte_splits",
+    "plan_split",
+    "read_split",
 ]

@@ -533,7 +533,10 @@ def _invalid_file_offset(start_index, pre_start_index, pre_compressed_size):
 
 
 def _filter_groups(row_groups: List[TStruct], part_offset: int,
-                   part_length: int) -> List[TStruct]:
+                   part_length: int) -> List[int]:
+    """Indices of row groups whose byte midpoint lands inside the split
+    (filter_groups, NativeParquetJni.cpp:584): every group belongs to
+    exactly one split, so byte-range splits partition a file's groups."""
     pre_start_index = 0
     pre_compressed_size = 0
     first_column_with_metadata = True
@@ -542,7 +545,7 @@ def _filter_groups(row_groups: List[TStruct], part_offset: int,
         first_column_with_metadata = bool(cols) and _has(cols[0], 3)
 
     out = []
-    for rg in row_groups:
+    for i, rg in enumerate(row_groups):
         cols = _get(rg, 1, (0, []))[1]
         if first_column_with_metadata:
             start_index = _chunk_offset(cols[0])
@@ -561,7 +564,7 @@ def _filter_groups(row_groups: List[TStruct], part_offset: int,
                 _get(_get(c, 3, []), 7, 0) for c in cols)
         mid_point = start_index + total_size // 2
         if part_offset <= mid_point < part_offset + part_length:
-            out.append(rg)
+            out.append(i)
     return out
 
 
@@ -570,10 +573,17 @@ def _filter_groups(row_groups: List[TStruct], part_offset: int,
 # --------------------------------------------------------------------------
 
 class ParquetFooter:
-    """A parsed + filtered parquet footer (FileMetaData)."""
+    """A parsed + filtered parquet footer (FileMetaData).
 
-    def __init__(self, fields: TStruct):
+    ``kept_group_indexes`` records which ORIGINAL row-group indices
+    survived the split filter in :meth:`read_and_filter` — the plan an
+    external columnar reader needs to seek by group without re-parsing
+    the footer (io/parquet_read.py consumes it)."""
+
+    def __init__(self, fields: TStruct,
+                 kept_group_indexes: Optional[List[int]] = None):
         self._fields = fields
+        self.kept_group_indexes = kept_group_indexes or []
 
     @staticmethod
     def read_and_filter(buffer: bytes, part_offset: int, part_length: int,
@@ -611,8 +621,10 @@ class ParquetFooter:
             meta = _set(meta, 7, _T_LIST, (etype, new_orders))
 
         row_groups = _get(meta, 4, (_T_STRUCT, []))[1]
+        keep = list(range(len(row_groups)))
         if part_length >= 0:
-            row_groups = _filter_groups(row_groups, part_offset, part_length)
+            keep = _filter_groups(row_groups, part_offset, part_length)
+            row_groups = [row_groups[i] for i in keep]
         # prune each group's chunks to the surviving columns
         new_groups = []
         for rg in row_groups:
@@ -625,7 +637,38 @@ class ParquetFooter:
         # deliberately so the serialized footer is self-consistent)
         meta = _set(meta, 3, _T_I64,
                     sum(_get(rg, 3, 0) for rg in new_groups))
-        return ParquetFooter(meta)
+        return ParquetFooter(meta, kept_group_indexes=keep)
+
+    @staticmethod
+    def split_group_indexes(buffer: bytes, part_offset: int,
+                            part_length: int) -> List[int]:
+        """Original row-group indices whose midpoint lands in the split —
+        the plan a reader uses to materialize ONLY those groups (the
+        filter_groups selection of NativeParquetJni.cpp:584, exposed as
+        indices so an external columnar reader can seek by group)."""
+        meta, _ = _read_struct(bytes(buffer), 0)
+        row_groups = _get(meta, 4, (_T_STRUCT, []))[1]
+        return _filter_groups(row_groups, part_offset, part_length)
+
+    @property
+    def column_names(self) -> List[str]:
+        """Top-level column names surviving the prune, in file order
+        (what a reader passes as its column projection)."""
+        schema = _get(self._fields, 2, (0, []))[1]
+        if not schema:
+            return []
+        out, i = [], 1
+        n_top = _Elem(schema[0]).num_children
+        while len(out) < n_top and i < len(schema):
+            e = _Elem(schema[i])
+            out.append(e.name)
+            # skip this element's whole subtree to reach the next sibling
+            remaining = e.num_children
+            i += 1
+            while remaining > 0 and i < len(schema):
+                remaining += _Elem(schema[i]).num_children - 1
+                i += 1
+        return out
 
     @property
     def num_rows(self) -> int:

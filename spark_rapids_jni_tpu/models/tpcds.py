@@ -20,7 +20,7 @@ from typing import Dict
 import numpy as np
 
 __all__ = ["Q3Data", "Q5Data", "generate_q3_data", "generate_q5_data",
-           "CHANNELS"]
+           "generate_q97_tables", "write_q97_parquet", "CHANNELS"]
 
 # (channel label, fact prefix, dim id prefix) for q5's three channel unions
 CHANNELS = ("store", "catalog", "web")
@@ -192,3 +192,51 @@ def generate_q3_data(sf: float = 0.01, seed: int = 0,
         date_sk=date_sk, date_year=date_year, date_moy=date_moy,
         manufact_id=int(rng.randint(1, n_manufact + 1)), moy=11,
     )
+
+
+def generate_q97_tables(sf: float, seed: int):
+    """The q97 fact pair: (customer_sk, item_sk) int32 arrays per channel,
+    ~SF-proportional (SF1 store_sales is ~2.9M rows)."""
+    rng = np.random.RandomState(seed)
+    n = max(1000, int(2_800_000 * sf))
+    store = (rng.randint(1, max(2, n // 14), n).astype(np.int32),
+             rng.randint(1, 18_000, n).astype(np.int32))
+    catalog = (rng.randint(1, max(2, n // 14), n).astype(np.int32),
+               rng.randint(1, 18_000, n).astype(np.int32))
+    return store, catalog
+
+
+def write_q97_parquet(outdir: str, sf: float = 0.05, seed: int = 42,
+                      rows_per_group: int = 65536):
+    """Write the q97 fact pair as multi-row-group parquet files.
+
+    Each file carries the two join keys plus money columns the query does
+    NOT touch — so split planning via the footer (row-group midpoint
+    filter) and column pruning are both load-bearing when the NDS harness
+    reads these back (``nds_harness --input``).  Returns the two paths.
+    """
+    import os
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    os.makedirs(outdir, exist_ok=True)
+    store, catalog = generate_q97_tables(sf, seed)
+    rng = np.random.RandomState(seed + 97)
+    paths = {}
+    for name, prefix, (cust, item) in (
+            ("store_sales", "ss", store), ("catalog_sales", "cs", catalog)):
+        n = len(cust)
+        table = pa.table({
+            f"{prefix}_customer_sk": pa.array(cust, pa.int32()),
+            f"{prefix}_item_sk": pa.array(item, pa.int32()),
+            # pruned by the q97 read schema: never materialized
+            f"{prefix}_ext_sales_price": pa.array(
+                _money(rng, n), pa.int64()),
+            f"{prefix}_net_profit": pa.array(
+                rng.rand(n) * 100.0, pa.float64()),
+        })
+        path = os.path.join(outdir, f"{name}.parquet")
+        pq.write_table(table, path, row_group_size=rows_per_group)
+        paths[name] = path
+    return paths["store_sales"], paths["catalog_sales"]
