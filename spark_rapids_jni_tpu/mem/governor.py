@@ -195,6 +195,7 @@ class BudgetedResource:
         self.gov = governor
         self.limit = limit_bytes
         self.used = 0
+        self.peak = 0  # high-water mark of `used`; see reset_peak()
         self.is_cpu = is_cpu
         self._lock = threading.Lock()
         self._spill_handlers = []
@@ -218,7 +219,17 @@ class BudgetedResource:
             if self.used + nbytes > self.limit:
                 return False
             self.used += nbytes
+            if self.used > self.peak:
+                self.peak = self.used
             return True
+
+    def reset_peak(self) -> int:
+        """Return the reservation high-water mark and restart it from the
+        current level (per-query peak reporting in the NDS harness)."""
+        with self._lock:
+            p = self.peak
+            self.peak = self.used
+            return p
 
     def _spill_for(self, nbytes: int) -> bool:
         """Ask registered spill handlers to free the shortfall; True if any

@@ -22,15 +22,55 @@ import time
 
 
 def _q97_tables(sf: float, seed: int):
+    from spark_rapids_jni_tpu.models.tpcds import generate_q97_tables
+
+    return generate_q97_tables(sf, seed)
+
+
+def _q97_tables_from_parquet(input_dir: str, n_splits: int):
+    """Read the q97 fact pair from parquet, splits planned by the footer.
+
+    Each file is cut into byte-range splits; the thrift footer filter
+    (io/parquet_footer.py midpoint rule) decides which row groups each
+    split reads, and the schema prune limits decoding to the two join
+    keys — the money columns in the files are never materialized
+    (NativeParquetJni.cpp:584 filter_groups feeding the columnar reader).
+    """
+    import os
+
     import numpy as np
 
-    rng = np.random.RandomState(seed)
-    n = max(1000, int(2_800_000 * sf))  # ~SF-proportional pair stream
-    store = (rng.randint(1, max(2, n // 14), n).astype(np.int32),
-             rng.randint(1, 18_000, n).astype(np.int32))
-    catalog = (rng.randint(1, max(2, n // 14), n).astype(np.int32),
-               rng.randint(1, 18_000, n).astype(np.int32))
-    return store, catalog
+    from spark_rapids_jni_tpu.io import (
+        StructElement,
+        ValueElement,
+        plan_byte_splits,
+        read_split,
+    )
+
+    out = []
+    for name, prefix in (("store_sales", "ss"), ("catalog_sales", "cs")):
+        path = os.path.join(input_dir, f"{name}.parquet")
+        schema = (StructElement.builder()
+                  .add_child(f"{prefix}_customer_sk", ValueElement())
+                  .add_child(f"{prefix}_item_sk", ValueElement())
+                  .build())
+        cust_parts, item_parts = [], []
+        for off, length in plan_byte_splits(path, n_splits):
+            part = read_split(path, off, length, schema, as_numpy=True)
+            cust, cust_valid = part[f"{prefix}_customer_sk"]
+            item, item_valid = part[f"{prefix}_item_sk"]
+            # q97 joins NON-NULL keys only (q97_host_oracle semantics):
+            # a NULL key must be excluded, not counted as key 0
+            keep = np.ones(len(cust), bool)
+            if cust_valid is not None:
+                keep &= cust_valid
+            if item_valid is not None:
+                keep &= item_valid
+            cust_parts.append(np.asarray(cust)[keep])
+            item_parts.append(np.asarray(item)[keep])
+        out.append((np.concatenate(cust_parts).astype(np.int32),
+                    np.concatenate(item_parts).astype(np.int32)))
+    return out[0], out[1]
 
 
 def main(argv=None) -> int:
@@ -41,7 +81,22 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--verify", action="store_true",
                     help="check results against host oracles (slow at big sf)")
+    ap.add_argument("--input", default="",
+                    help="read the q97 fact pair from parquet files in DIR "
+                         "(tpcds.write_q97_parquet layout); each file is "
+                         "split-planned through io/parquet_footer")
+    ap.add_argument("--splits", type=int, default=2,
+                    help="byte-range splits per parquet file (--input mode)")
+    ap.add_argument("--stream-chunk-rows", type=int, default=0,
+                    help="run q97 out-of-core: generate facts in chunks of "
+                         "this many rows and grace-hash them through disk "
+                         "buckets (models/streaming.py); 0 = in-memory")
+    ap.add_argument("--buckets", type=int, default=16,
+                    help="key-space buckets for --stream-chunk-rows mode")
     args = ap.parse_args(argv)
+    if args.input and args.stream_chunk_rows > 0:
+        ap.error("--input and --stream-chunk-rows are mutually exclusive: "
+                 "streamed q97 generates its facts, it does not read parquet")
 
     # join the process group BEFORE the backend is touched: on a multi-host
     # pod the harness must span every host's devices, not run per-host
@@ -72,8 +127,12 @@ def main(argv=None) -> int:
     gov = MemoryGovernor.initialize()
     budget = BudgetedResource(gov, 8 << 30)
     out = {"sf": args.sf, "ndev": ndev, "queries": {}}
+    if args.input:
+        out["input"] = args.input
+        out["splits_per_file"] = args.splits
 
     try:
+        budget.reset_peak()
         data = generate_q5_data(sf=args.sf, seed=args.seed)
         q5_rows_total = sum(
             len(ch.sales_sk) + len(ch.ret_sk) for ch in data.channels.values())
@@ -87,28 +146,62 @@ def main(argv=None) -> int:
             "Mrows_per_s": round(q5_rows_total / q5_dt / 1e6, 2),
             "result_rows": len(q5_rows),
             "verified": q5_ok,
+            "peak_reserved_bytes": budget.reset_peak(),
         }
 
-        store, catalog = _q97_tables(args.sf, args.seed)
-        nq = len(store[0]) + len(catalog[0])
-        t0 = time.perf_counter()
-        q97 = run_distributed_q97(mesh, store, catalog, budget=budget,
-                                  task_id=2)
-        q97_dt = time.perf_counter() - t0
-        q97_ok = None
-        if args.verify:
-            from spark_rapids_jni_tpu.models.q97 import q97_host_oracle
+        if args.stream_chunk_rows > 0:
+            import tempfile
 
-            q97_ok = (q97.store_only, q97.catalog_only,
-                      q97.both) == q97_host_oracle(store, catalog)
-        out["queries"]["q97"] = {
-            "wall_s": round(q97_dt, 3),
-            "fact_rows": nq,
-            "Mrows_per_s": round(nq / q97_dt / 1e6, 2),
-            "counts": [int(q97.store_only), int(q97.catalog_only),
-                       int(q97.both)],
-            "verified": q97_ok,
-        }
+            from spark_rapids_jni_tpu.models.streaming import (
+                generate_q97_chunks,
+                run_streaming_q97,
+            )
+
+            t0 = time.perf_counter()
+            with tempfile.TemporaryDirectory(prefix="nds_shuffle_") as td:
+                counts, q97_ok, stats = run_streaming_q97(
+                    mesh,
+                    generate_q97_chunks(args.sf, args.seed,
+                                        args.stream_chunk_rows),
+                    tmpdir=td, n_buckets=args.buckets, budget=budget,
+                    task_id=2, verify=args.verify)
+            q97_dt = time.perf_counter() - t0
+            nq = stats["rows_in"]
+            out["queries"]["q97"] = {
+                "wall_s": round(q97_dt, 3),
+                "fact_rows": nq,
+                "Mrows_per_s": round(nq / q97_dt / 1e6, 2),
+                "counts": list(counts),
+                "verified": q97_ok,
+                "streamed": stats,
+                "peak_reserved_bytes": budget.reset_peak(),
+            }
+        else:
+            if args.input:
+                store, catalog = _q97_tables_from_parquet(args.input,
+                                                          args.splits)
+            else:
+                store, catalog = _q97_tables(args.sf, args.seed)
+            nq = len(store[0]) + len(catalog[0])
+            t0 = time.perf_counter()
+            q97 = run_distributed_q97(mesh, store, catalog, budget=budget,
+                                      task_id=2)
+            q97_dt = time.perf_counter() - t0
+            q97_ok = None
+            if args.verify:
+                from spark_rapids_jni_tpu.models.q97 import q97_host_oracle
+
+                q97_ok = (q97.store_only, q97.catalog_only,
+                          q97.both) == q97_host_oracle(store, catalog)
+            out["queries"]["q97"] = {
+                "wall_s": round(q97_dt, 3),
+                "fact_rows": nq,
+                "Mrows_per_s": round(nq / q97_dt / 1e6, 2),
+                "counts": [int(q97.store_only), int(q97.catalog_only),
+                           int(q97.both)],
+                "verified": q97_ok,
+                "peak_reserved_bytes": budget.reset_peak(),
+            }
 
         q3_data = generate_q3_data(sf=args.sf, seed=args.seed)
         n3 = len(q3_data.ss_item_sk)
@@ -122,6 +215,7 @@ def main(argv=None) -> int:
             "Mrows_per_s": round(n3 / q3_dt / 1e6, 2),
             "result_rows": len(q3_rows),
             "verified": q3_ok,
+            "peak_reserved_bytes": budget.reset_peak(),
         }
         out["total_wall_s"] = round(q5_dt + q97_dt + q3_dt, 3)
     finally:
