@@ -5,23 +5,38 @@ Parses the SRTP capture format (obs/profiler.py) and emits either JSON lines
 the role NVTXT output plays for the reference
 (spark_rapids_profile_converter.cpp:106-116).
 
+With ``--device-trace DIR`` (the ``xplane_dir`` handed to Profiler.init),
+the jax.profiler perfetto export found under ``DIR/plugins/profile/*/`` is
+merged into the chrome output: host seam ranges and on-device kernel
+events interleave on one timeline, the role the reference's per-kernel
+device activity records play in its capture stream (profiler.fbs:124-287,
+ProfilerJni.cpp:366).  Device events sit under shifted pids so tracks
+stay distinguishable; alignment uses the wall/monotonic clock anchor the
+profiler banks at start() when the device clock looks wall-based, else
+falls back to aligning both streams at their first event.
+
 Usage::
 
     python -m spark_rapids_jni_tpu.obs.convert capture.srtp --format json
-    python -m spark_rapids_jni_tpu.obs.convert capture.srtp --format chrome -o trace.json
+    python -m spark_rapids_jni_tpu.obs.convert capture.srtp --format chrome \
+        --device-trace /tmp/xplane -o trace.json
 """
 
 from __future__ import annotations
 
 import argparse
+import glob
+import gzip
 import json
+import os
 import struct
 import sys
-from typing import Iterator
+from typing import Iterator, List, Optional
 
-from spark_rapids_jni_tpu.obs.profiler import MAGIC, VERSION
+from spark_rapids_jni_tpu.obs.profiler import CLOCK_ANCHOR, MAGIC, VERSION
 
-_CATEGORY_NAMES = ["op", "transfer", "collective", "alloc", "marker", "spill"]
+_CATEGORY_NAMES = ["op", "transfer", "collective", "alloc", "marker",
+                   "spill", "compile"]
 
 
 def parse_capture(data: bytes) -> Iterator[dict]:
@@ -85,12 +100,74 @@ def to_chrome(events) -> dict:
     return {"traceEvents": out}
 
 
+# pid offset for merged device tracks: SRTP host events are pid 0
+_DEVICE_PID_BASE = 1000
+
+
+def load_device_trace(xplane_dir: str) -> List[dict]:
+    """Raw trace events from the newest jax.profiler perfetto export under
+    ``xplane_dir`` ([] when no run was captured there)."""
+    cands = sorted(
+        glob.glob(os.path.join(xplane_dir, "plugins", "profile", "*",
+                               "perfetto_trace.json.gz"))
+        + glob.glob(os.path.join(xplane_dir, "plugins", "profile", "*",
+                                 "*.trace.json.gz")),
+        key=os.path.getmtime)
+    if not cands:
+        return []
+    with gzip.open(cands[-1], "rt") as f:
+        doc = json.load(f)
+    evs = doc.get("traceEvents", doc if isinstance(doc, list) else [])
+    return [e for e in evs if isinstance(e, dict)]
+
+
+def merge_device_events(chrome: dict, dev_events: List[dict],
+                        wall_minus_mono_ns: Optional[int]) -> dict:
+    """Interleave device trace events into a chrome trace built from SRTP.
+
+    Complete ('X') device events are remapped to pids >= 1000; metadata
+    ('M') events ride along so track names survive.  If the device clock
+    reads as wall time and the capture carries the clock anchor, events
+    are placed exactly on the host monotonic timeline; otherwise both
+    streams are aligned at their first event.
+    """
+    host = chrome["traceEvents"]
+    xs = [e for e in dev_events if e.get("ph") == "X" and "ts" in e]
+    if not xs:
+        return chrome
+    dev_min_us = min(e["ts"] for e in xs)
+    host_min_us = min((e["ts"] for e in host if "ts" in e), default=0.0)
+
+    shift_us = host_min_us - dev_min_us  # fallback: align first events
+    if wall_minus_mono_ns is not None:
+        exact = -wall_minus_mono_ns / 1e3  # wall us -> monotonic us
+        # trust the anchor only when it lands the device stream inside an
+        # hour of the host stream (i.e. the device ts really is wall time)
+        if abs((dev_min_us + exact) - host_min_us) < 3600e6:
+            shift_us = exact
+
+    for e in dev_events:
+        ph = e.get("ph")
+        if ph not in ("X", "M"):
+            continue
+        m = dict(e)
+        m["pid"] = _DEVICE_PID_BASE + int(e.get("pid", 0))
+        if ph == "X":
+            m["ts"] = e["ts"] + shift_us
+            m.setdefault("cat", "device")
+        host.append(m)
+    return chrome
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Convert an SRTP profiler capture to JSON or chrome trace")
     ap.add_argument("capture")
     ap.add_argument("--format", choices=["json", "chrome"], default="json")
     ap.add_argument("-o", "--output", default="-")
+    ap.add_argument("--device-trace", default="",
+                    help="xplane_dir of the run: merge the jax.profiler "
+                         "perfetto export into the chrome trace")
     args = ap.parse_args(argv)
 
     with open(args.capture, "rb") as f:
@@ -102,7 +179,16 @@ def main(argv=None) -> int:
             for e in events:
                 out.write(json.dumps(e) + "\n")
         else:
-            json.dump(to_chrome(events), out)
+            evs = list(events)
+            chrome = to_chrome(evs)
+            if args.device_trace:
+                anchor = next(
+                    (e["value"] for e in evs
+                     if e["type"] == "counter" and e["name"] == CLOCK_ANCHOR),
+                    None)
+                chrome = merge_device_events(
+                    chrome, load_device_trace(args.device_trace), anchor)
+            json.dump(chrome, out)
     finally:
         if out is not sys.stdout:
             out.close()

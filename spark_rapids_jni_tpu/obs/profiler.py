@@ -37,13 +37,18 @@ from typing import Optional
 
 from spark_rapids_jni_tpu.obs import seam as _seam
 
-__all__ = ["Profiler", "MAGIC", "VERSION"]
+__all__ = ["Profiler", "MAGIC", "VERSION", "CLOCK_ANCHOR"]
 
 MAGIC = b"SRTP"
 VERSION = 1
 
+# counter emitted at start(): wall-clock ns minus monotonic ns, letting the
+# converter place wall-stamped device events on the monotonic host timeline
+CLOCK_ANCHOR = "__clock_wall_minus_mono_ns"
+
 _CATEGORIES = {_seam.OP: 0, _seam.TRANSFER: 1, _seam.COLLECTIVE: 2,
-               _seam.ALLOC: 3, "marker": 4, _seam.SPILL: 5}
+               _seam.ALLOC: 3, "marker": 4, _seam.SPILL: 5,
+               _seam.COMPILE: 6}
 
 _R_STRING, _R_RANGE, _R_INSTANT, _R_COUNTER = 0, 1, 2, 3
 
@@ -153,10 +158,18 @@ class Profiler:
             if not _st.initialized:
                 raise RuntimeError("profiler not initialized")
             _st.active = True
+        # clock-domain anchor: SRTP ranges are monotonic-ns, the device
+        # timeline (XPlane/perfetto) is wall-ns — bank the offset so the
+        # converter can map device events into the host timebase exactly
+        Profiler.counter(CLOCK_ANCHOR,
+                         time.time_ns() - time.monotonic_ns())
         if _st.xplane_dir is not None:
             import jax
 
-            jax.profiler.start_trace(_st.xplane_dir)
+            # the perfetto trace-event export is what obs/convert.py merges
+            # into the durable chrome trace (device kernel timeline)
+            jax.profiler.start_trace(_st.xplane_dir,
+                                     create_perfetto_trace=True)
 
     @staticmethod
     def stop() -> None:
