@@ -189,7 +189,10 @@ def run_distributed_q3(mesh, data: Q3Data, *, budget=None, task_id: int = 0,
 
     geo = _geometry(data)
     dp = mesh.shape[DATA_AXIS]
-    step = make_distributed_q3(mesh, data)
+    from spark_rapids_jni_tpu.obs.seam import COMPILE, seam as _seam_cm
+
+    with _seam_cm(COMPILE, "q3_step"):
+        step = make_distributed_q3(mesh, data)
     sharding = NamedSharding(mesh, P(DATA_AXIS))
     rep = NamedSharding(mesh, P())
     dims = {k: jax.device_put(v, rep) for k, v in _dims(data).items()}
@@ -197,10 +200,14 @@ def run_distributed_q3(mesh, data: Q3Data, *, budget=None, task_id: int = 0,
     nbytes_of = q3_working_set_bytes
 
     def run(facts):
+        from spark_rapids_jni_tpu.obs.seam import COLLECTIVE, TRANSFER, seam
+
         padded = _pad_facts(facts, dp)
-        dev = [jax.device_put(np.ascontiguousarray(v), sharding)
-               for v in padded.values()]
-        out = step(*dev, *dims.values())
+        with seam(TRANSFER, "q3_batch_upload"):
+            dev = [jax.device_put(np.ascontiguousarray(v), sharding)
+                   for v in padded.values()]
+        with seam(COLLECTIVE, "launch:q3_step"):
+            out = step(*dev, *dims.values())
         return _Partials(*(np.asarray(x) for x in out))
 
     def combine(results):

@@ -264,7 +264,10 @@ def run_distributed_q5(mesh, data: Q5Data, *, budget=None, task_id: int = 0,
     dp = int(np.prod([mesh.shape[a] for a in (DATA_AXIS,)]))
     sharding = NamedSharding(mesh, P(DATA_AXIS))
     rep = NamedSharding(mesh, P())
-    step = make_distributed_q5(mesh, data)
+    from spark_rapids_jni_tpu.obs.seam import COMPILE, seam as _seam_cm
+
+    with _seam_cm(COMPILE, "q5_step"):
+        step = make_distributed_q5(mesh, data)
     dim_sk = jax.device_put(data.date_sk, rep)
     dim_days = jax.device_put(data.date_days, rep)
 
@@ -275,13 +278,17 @@ def run_distributed_q5(mesh, data: Q5Data, *, budget=None, task_id: int = 0,
         return total * 3  # inputs + masks/buckets + partials
 
     def run(b):
-        dev = {
-            n: {k: jax.device_put(np.ascontiguousarray(v), sharding)
-                for k, v in _pad_channel(ch, dp).items()}
-            for n, ch in b.items()
-        }
-        out = step(dev, dim_sk, dim_days)
-        jax.block_until_ready(out)
+        from spark_rapids_jni_tpu.obs.seam import COLLECTIVE, TRANSFER, seam
+
+        with seam(TRANSFER, "q5_batch_upload"):
+            dev = {
+                n: {k: jax.device_put(np.ascontiguousarray(v), sharding)
+                    for k, v in _pad_channel(ch, dp).items()}
+                for n, ch in b.items()
+            }
+        with seam(COLLECTIVE, "launch:q5_step"):
+            out = step(dev, dim_sk, dim_days)
+            jax.block_until_ready(out)
         return {n: jax.tree.map(np.asarray, p)
                 for n, p in zip(CHANNELS, out)}
 

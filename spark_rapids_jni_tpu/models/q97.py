@@ -378,7 +378,13 @@ def q97_working_set_bytes(batch: Q97Batch, dp: int) -> int:
 
 @functools.lru_cache(maxsize=32)
 def _q97_step_cached(mesh, capacity: int):
-    return make_distributed_q97(mesh, capacity, with_validity=True)
+    from spark_rapids_jni_tpu.obs.seam import COMPILE, seam
+
+    # cache miss == a step build (and, on first launch, an XLA compile):
+    # a chaos rule on the 'compile' category fails it like the reference's
+    # CUDA-API injector fails a module load
+    with seam(COMPILE, f"q97_step:cap{capacity}"):
+        return make_distributed_q97(mesh, capacity, with_validity=True)
 
 
 def _pad_to_multiple(arr: np.ndarray, mult: int, fill=0):
@@ -441,7 +447,7 @@ def run_distributed_q97(
     sharding = NamedSharding(mesh, P(DATA_AXIS))
 
     def run(piece: Q97Batch) -> Q97Out:
-        from spark_rapids_jni_tpu.obs.seam import TRANSFER, seam
+        from spark_rapids_jni_tpu.obs.seam import COLLECTIVE, TRANSFER, seam
 
         sc, sv = _pad_to_multiple(piece.s_cust, dp)
         si, _ = _pad_to_multiple(piece.s_item, dp)
@@ -457,8 +463,11 @@ def run_distributed_q97(
         with seam(TRANSFER, "q97_batch_upload"):
             args = [jax.device_put(a, sharding)
                     for a in (sc, si, cc, ci, sv, cv)]
-        out = step(*args)
-        jax.block_until_ready(out)
+        # the step IS the collective exchange (tagged all_to_all): a chaos
+        # rule on 'collective' fails the launch like a wedged collective
+        with seam(COLLECTIVE, "launch:q97_step"):
+            out = step(*args)
+            jax.block_until_ready(out)
         if int(out.dropped) > 0:
             raise ShuffleCapacityExceeded(
                 f"{int(out.dropped)} rows overflowed capacity {piece.capacity}")

@@ -41,6 +41,7 @@ injector arms itself — mirroring the driver-level ``CUDA_INJECTION64_PATH``
 
 from __future__ import annotations
 
+import fnmatch
 import json
 import os
 import random
@@ -140,7 +141,7 @@ class FaultInjector:
     def _load(self, config: dict) -> None:
         rules = {}
         for cat in (_seam.OP, _seam.TRANSFER, _seam.COLLECTIVE, _seam.ALLOC,
-                    _seam.SPILL):
+                    _seam.SPILL, _seam.COMPILE):
             cat_spec = config.get(cat, {})
             rules[cat] = {name: _Rule(spec) for name, spec in cat_spec.items()}
         with self._lock:
@@ -165,7 +166,16 @@ class FaultInjector:
             cat_rules = self._rules.get(category)
             if not cat_rules:
                 return
-            rule = cat_rules.get(name) or cat_rules.get("*")
+            # precedence: exact name, then glob patterns (the reference
+            # matches interceptionMatchPattern regexes the same way),
+            # then the catch-all
+            rule = cat_rules.get(name)
+            if rule is None:
+                rule = next(
+                    (r for pat, r in cat_rules.items()
+                     if pat != "*" and pat != name
+                     and fnmatch.fnmatchcase(name, pat)),
+                    None) or cat_rules.get("*")
             if rule is None:
                 return
             fault = rule.fire(self._rng, name)

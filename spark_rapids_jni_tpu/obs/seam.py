@@ -13,7 +13,15 @@ Categories mirror the activity kinds the reference captures: ``op`` (kernel
 launches), ``transfer`` (host<->device movement), ``collective`` (multi-chip
 exchange), ``alloc`` (memory governance), ``spill`` (host-staging traffic,
 mem/spill.py — the reference profiles its spill store's device<->host copies
-the same way, as MEMCPY activity).
+the same way, as MEMCPY activity), ``compile`` (step build / XLA
+compilation — the reference's CUPTI hook sees module loads the same way,
+and its CUDA-API injector can fail them, faultinj.cu:32).
+
+The ``transfer``/``collective``/``compile`` crossings sit BENEATH the op
+layer, in the runtime paths of the distributed models (batch upload, step
+launch, step build), so chaos can simulate a failing device transfer, a
+wedged collective, or a failed compile mid-governed-query — the failure
+modes the CUPTI-level injector reaches in the reference.
 """
 
 from __future__ import annotations
@@ -23,13 +31,14 @@ import functools
 from typing import Callable, Optional
 
 __all__ = ["seam", "instrument", "OP", "TRANSFER", "COLLECTIVE", "ALLOC",
-           "SPILL"]
+           "SPILL", "COMPILE"]
 
 OP = "op"
 TRANSFER = "transfer"
 COLLECTIVE = "collective"
 ALLOC = "alloc"
 SPILL = "spill"
+COMPILE = "compile"
 
 # registered sinks; None = inactive (checked without locks on the hot path)
 _injector: Optional[Callable[[str, str], None]] = None  # may raise
