@@ -334,6 +334,35 @@ def main():
            lambda: _murmur_strings("pallas"), nbytes=ns_h * 40 * 3)
     _ms_cache.clear()
 
+    # ---- internal shuffle-placement hash A/B (partition_hash flag) --------
+    _ph_cache = {}
+
+    def _partition_hash(backend):
+        from spark_rapids_jni_tpu.ops.hashing import (
+            murmur3_raw_int64,
+            partition_mix32,
+        )
+
+        if "keys" not in _ph_cache:
+            _ph_cache["keys"] = jnp.asarray(
+                rng.randint(-(2**62), 2**62, size=n, dtype=np.int64))
+        keys = _ph_cache["keys"]
+        raw = (murmur3_raw_int64 if backend == "murmur3"
+               else partition_mix32)
+        fn = jax.jit(lambda d: (raw(d) % jnp.uint32(8)).astype(jnp.int32))
+        # pin the murmur leg to XLA so the A/B compares the two MIXES on
+        # one backend, not XLA-vs-whatever SRT_HASH_BACKEND selects
+        with config.override(hash_backend="xla"):
+            dt = _time(fn, iters, keys)
+        return {"Grows_per_s": round(n / dt / 1e9, 3),
+                "roofline_frac": _frac((n / dt) * 12)}
+
+    _stage(detail, "partition_murmur3", lambda: _partition_hash("murmur3"),
+           nbytes=n * 12 * 2)
+    _stage(detail, "partition_mix32", lambda: _partition_hash("mix32"),
+           nbytes=n * 12 * 2)
+    _ph_cache.clear()
+
     # ---- config 2: string<->float -----------------------------------------
     ns = min(n, 1 << 20)  # host-orchestrated: smaller working set
 

@@ -162,6 +162,12 @@ register("hash_backend", "xla",
          "(fused elementwise ops) or 'pallas' (VMEM-blocked kernels, "
          "ops/hash_pallas.py; interpret-mode off-TPU).",
          env="SRT_HASH_BACKEND")
+register("partition_hash", "murmur3",
+         "Internal shuffle-placement hash (parallel/shuffle.partition_of, "
+         "read at trace time): 'murmur3' (Spark's placement hash) or "
+         "'mix32' (pure-u32 mix, ~1/3 the multiplies; placement is never "
+         "user-visible so Spark compatibility does not bind here).",
+         env="SRT_PARTITION_HASH")
 register("watchdog_period_s", 0.1,
          "Memory-governor deadlock-watchdog poll period (the "
          "rmmWatchdogPollingPeriod analog, SparkResourceAdaptor.java:35).",

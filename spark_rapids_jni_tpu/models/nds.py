@@ -31,7 +31,7 @@ from jax.sharding import PartitionSpec as P
 
 from spark_rapids_jni_tpu.ops.hashing import murmur3_raw_int64, xxhash64_raw_int64
 from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
-from spark_rapids_jni_tpu.parallel.shuffle import all_to_all_shuffle
+from spark_rapids_jni_tpu.parallel.shuffle import all_to_all_shuffle, partition_of
 
 
 class QueryStepConfig(NamedTuple):
@@ -116,8 +116,7 @@ def _sharded_step(keys, values, cfg: QueryStepConfig):
     probe_hits = jax.lax.psum((set_total == cfg.bloom_hashes).sum(), DATA_AXIS)
 
     # 3. shuffle rows to their hash-owner partition (the sp/ep-style all_to_all)
-    h = murmur3_raw_int64(keys, 42)
-    part = (h % jnp.uint32(dp)).astype(jnp.int32)
+    part = partition_of(keys, dp)
     capacity = cfg.shuffle_capacity or n_local
     shuffled = all_to_all_shuffle(
         {"keys": keys, "values": values}, part, capacity, axis=DATA_AXIS

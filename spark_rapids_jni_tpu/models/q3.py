@@ -208,6 +208,8 @@ def run_distributed_q3(mesh, data: Q3Data, *, budget=None, task_id: int = 0,
                    for v in padded.values()]
         with seam(COLLECTIVE, "launch:q3_step"):
             out = step(*dev, *dims.values())
+            jax.block_until_ready(out)  # async dispatch: keep the
+            # execution inside the launch range, as q5/q97 do
         return _Partials(*(np.asarray(x) for x in out))
 
     def combine(results):

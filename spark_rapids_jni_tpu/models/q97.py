@@ -38,9 +38,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from spark_rapids_jni_tpu.ops.hashing import murmur3_raw_int64
 from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS
-from spark_rapids_jni_tpu.parallel.shuffle import all_to_all_shuffle
+from spark_rapids_jni_tpu.parallel.shuffle import all_to_all_shuffle, partition_of
 
 
 class Q97Out(NamedTuple):
@@ -137,7 +136,7 @@ def _sharded_q97(s_cust, s_item, c_cust, c_item, capacity: int,
         sv = jnp.ones(sk.shape, bool) if s_valid is None else s_valid
         cv = jnp.ones(ck.shape, bool) if c_valid is None else c_valid
         row_valid = jnp.concatenate([sv, cv])
-    part = (murmur3_raw_int64(keys, 42) % jnp.uint32(dp)).astype(jnp.int32)
+    part = partition_of(keys, dp)
     ex = all_to_all_shuffle(
         {"k": keys, "tag": tag}, part, capacity, axis=DATA_AXIS,
         row_valid=row_valid,
@@ -266,7 +265,7 @@ def _sharded_q97_columns(s_cust, s_item, c_cust, c_item, s_rv, c_rv,
     row_valid = jnp.concatenate([s_rv, c_rv])
 
     mixed = k_hi ^ (k_lo * jnp.int64(-7046029254386353131))  # golden-ratio mix
-    part = (murmur3_raw_int64(mixed, 42) % jnp.uint32(dp)).astype(jnp.int32)
+    part = partition_of(mixed, dp)
     ex = shuffle_table(
         {
             "kh": Column(k_hi, None, _I64),

@@ -523,6 +523,23 @@ def xxhash64_raw_int64(data: jnp.ndarray, seed: int = DEFAULT_XXHASH64_SEED) -> 
     return _xx_hash_fixed8(data.astype(jnp.int64).astype(_U64), s)
 
 
+def partition_mix32(data: jnp.ndarray) -> jnp.ndarray:
+    """Cheap 32-bit mix of an int64 key vector for shuffle PARTITIONING.
+
+    Pure uint32 lane arithmetic — one k1-mix of each half + fmix32, about
+    a third of murmur3_raw_int64's multiply count and none of xxhash64's
+    emulated u64 limb math (docs/PERF.md "structural facts").  NOT
+    Spark-compatible and never user-visible: partition placement only
+    needs every participant to agree, which internal exchanges get by
+    construction.  The reference is likewise free on this seam — Spark
+    compatibility binds murmur3/xxhash64 only where hashes reach users."""
+    v = data.astype(jnp.int64).astype(_U64)
+    low = (v & _U64(0xFFFFFFFF)).astype(_U32)
+    high = (v >> _U64(32)).astype(_U32)
+    h = _mm_mix_k1(low) ^ _rotl32(_mm_mix_k1(high), 13)
+    return _mm_fmix(h, _U32(8))
+
+
 # ---------------------------------------------------------------------------
 # public API (mirrors Hash.java:40-91)
 # ---------------------------------------------------------------------------

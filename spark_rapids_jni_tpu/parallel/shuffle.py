@@ -34,6 +34,29 @@ class ShuffleResult(NamedTuple):
     dropped: jnp.ndarray  # int32 scalar: rows lost to capacity overflow (local)
 
 
+def partition_of(keys: jnp.ndarray, n_parts: int) -> jnp.ndarray:
+    """Owning partition of each int64 key: the internal placement hash.
+
+    Backend from the ``partition_hash`` config flag, read at TRACE time
+    (a cached jitted step keeps the backend it was traced with):
+    ``murmur3`` (default; Spark's placement hash) or ``mix32``
+    (ops/hashing.partition_mix32 — pure u32 lane math, ~1/3 the multiply
+    count; placement only needs every participant to agree, which one
+    traced program guarantees).  The A/B lives in bench.py's
+    partition-hash stage; flip the default to the measured winner."""
+    from spark_rapids_jni_tpu import config
+    from spark_rapids_jni_tpu.ops.hashing import (
+        murmur3_raw_int64,
+        partition_mix32,
+    )
+
+    if config.get("partition_hash") == "mix32":
+        h = partition_mix32(keys)
+    else:
+        h = murmur3_raw_int64(keys, 42)
+    return (h % jnp.uint32(n_parts)).astype(jnp.int32)
+
+
 def bucket_by_partition(part: jnp.ndarray, n_parts: int, capacity: int):
     """Assign each local row a slot in a [n_parts, capacity] send layout.
 

@@ -136,3 +136,49 @@ def test_multihost_single_process_noop_and_pod_mesh():
     from spark_rapids_jni_tpu.parallel.multihost import process_summary
 
     assert set(process_summary()) == summary_keys
+
+
+def test_partition_mix32_placement_backend():
+    """The cheap mix32 placement hash (partition_hash config): spreads
+    dense keys, is deterministic, and a distributed q97 traced under it
+    still matches the host oracle — placement choice can never change
+    results, only where rows land."""
+    import numpy as np
+
+    from spark_rapids_jni_tpu import config
+    from spark_rapids_jni_tpu.models.q97 import (
+        make_distributed_q97,
+        q97_host_oracle,
+    )
+    from spark_rapids_jni_tpu.ops.hashing import partition_mix32
+    from spark_rapids_jni_tpu.parallel.shuffle import partition_of
+
+    rng = np.random.RandomState(2)
+    # dense TPC-DS-ish packed pairs (the worst case for a weak mix)
+    cust = rng.randint(1, 4000, 8192).astype(np.int64)
+    item = rng.randint(1, 18000, 8192).astype(np.int64)
+    keys = jnp.asarray((cust << 32) | item)
+    h1 = np.asarray(partition_mix32(keys))
+    h2 = np.asarray(partition_mix32(jnp.asarray(np.asarray(keys))))
+    assert np.array_equal(h1, h2)
+    counts = np.bincount(h1 % 8, minlength=8)
+    assert counts.max() < 2 * len(cust) / 8, counts
+
+    with config.override(partition_hash="mix32"):
+        part = np.asarray(jax.jit(
+            lambda k: partition_of(k, 8))(keys))
+        assert np.array_equal(part, h1 % 8)
+
+        mesh = make_mesh((8, 1))
+        n = 8 * 64
+        s = (jnp.asarray(cust[:n].astype(np.int32)),
+             jnp.asarray(item[:n].astype(np.int32)))
+        c = (jnp.asarray(cust[n:2 * n].astype(np.int32)),
+             jnp.asarray(item[n:2 * n].astype(np.int32)))
+        step = make_distributed_q97(mesh, capacity=2 * n)
+        out = step(*s, *c)  # traced INSIDE the override: mix32 placement
+    want = q97_host_oracle((np.asarray(s[0]), np.asarray(s[1])),
+                           (np.asarray(c[0]), np.asarray(c[1])))
+    assert (int(out.store_only), int(out.catalog_only),
+            int(out.both)) == want
+    assert int(out.dropped) == 0
