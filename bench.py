@@ -320,7 +320,7 @@ def main():
             rows = [b"k%08d-%s" % (i, b"x" * (i % 24)) for i in range(ns_h)]
             _ms_cache["col"] = strings_from_bytes(rows)
         scol = _ms_cache["col"]
-        total_bytes = int(scol.chars.shape[0])
+        total_bytes = int(scol.offsets[-1])
         with config.override(hash_backend=backend):
             dt = _time(lambda: murmur_hash32([scol], seed=42).data,
                        max(iters // 4, 3))
@@ -488,7 +488,7 @@ def main():
             for i in range(nj)
         ]
         jcol = strings_from_bytes(rows)
-        total_bytes = int(jcol.chars.shape[0])
+        total_bytes = int(jcol.offsets[-1])
 
         def run_path():
             return get_json_object(jcol, "$.store.fruit[*].weight").chars

@@ -31,7 +31,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from spark_rapids_jni_tpu.columnar.column import StringColumn
+from spark_rapids_jni_tpu.columnar.column import StringColumn, next_pow2
 
 __all__ = [
     "PaddedBucket",
@@ -72,10 +72,6 @@ class PaddedBucket:
         return jnp.arange(self.n_rows, dtype=jnp.int32) < self.n_valid
 
 
-def _next_pow2(x: int) -> int:
-    return 1 << max(int(x) - 1, 0).bit_length() if x > 1 else 1
-
-
 def _next_pow2_arr(v: np.ndarray) -> np.ndarray:
     """Element-wise next power of two for v >= 1 (exact, no float log)."""
     v = v.astype(np.uint32) - 1
@@ -104,7 +100,7 @@ def length_buckets(
     for w in sorted(set(widths.tolist())):
         rows_np = np.nonzero(widths == w)[0].astype(np.int32)
         n_valid = len(rows_np)
-        n_rows = _next_pow2(n_valid) if round_rows else n_valid
+        n_rows = next_pow2(n_valid) if round_rows else n_valid
         if n_rows > n_valid:
             rows_np = np.concatenate(
                 [rows_np, np.full(n_rows - n_valid, rows_np[-1], np.int32)]
@@ -214,17 +210,20 @@ def strings_from_buckets(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(lens_full, dtype=jnp.int32)]
     )
     total = int(offsets[-1])
-    chars = jnp.zeros((max(total, 1),), dtype=jnp.uint8)
+    # pow2 over-allocation: a bounded set of buffer shapes keeps the
+    # backend's per-shape executable cache bounded too (StringColumn
+    # contract; logical byte count is offsets[-1])
+    cap = next_pow2(total)
+    chars = jnp.zeros((cap,), dtype=jnp.uint8)
     for rows, padded, lens, n_valid in results:
         nb, w = padded.shape
         mask = jnp.arange(nb, dtype=jnp.int32) < n_valid
-        row_start = jnp.where(mask, offsets[:-1][rows], jnp.int32(total))
+        row_start = jnp.where(mask, offsets[:-1][rows], jnp.int32(cap))
         pos = row_start[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]
         in_bounds = (
             jnp.arange(w, dtype=jnp.int32)[None, :] < lens[:, None]
         ) & mask[:, None]
-        chars = chars.at[jnp.where(in_bounds, pos, total)].set(
+        chars = chars.at[jnp.where(in_bounds, pos, cap)].set(
             padded, mode="drop"
         )
-    chars = chars[:total]
     return StringColumn(chars, offsets, validity)

@@ -121,9 +121,17 @@ class Decimal128Column:
 
 @dataclasses.dataclass
 class StringColumn:
-    """UTF-8 string column: Arrow chars+offsets layout."""
+    """UTF-8 string column: Arrow chars+offsets layout.
 
-    chars: jnp.ndarray  # uint8[total_bytes]
+    ``chars`` may be OVER-ALLOCATED to a power of two (zero-filled tail):
+    constructors quantize the buffer so eager ops over it compile a
+    bounded set of shape variants — a long-lived executor seeing
+    arbitrary exact char totals would otherwise permanently cache one
+    XLA executable per distinct total (soak-tool finding, tools/soak.py).
+    The logical byte count is ``offsets[-1]``, never ``chars.shape[0]``.
+    """
+
+    chars: jnp.ndarray  # uint8[cap >= total_bytes], pow2 cap
     offsets: jnp.ndarray  # int32[n+1]
     validity: Optional[jnp.ndarray]
 
@@ -284,6 +292,13 @@ def decimal128_column(
     )
 
 
+def next_pow2(total: int) -> int:
+    """Next power of two (min 1): the canonical buffer-capacity quantizer
+    — bounds the set of shapes eager ops ever see to ~log2(max) variants
+    (StringColumn contract; also used by bucket geometry)."""
+    return 1 << max(0, int(total) - 1).bit_length() if total > 1 else 1
+
+
 @instrument(TRANSFER, "strings_column")
 def strings_column(values: Sequence[Optional[str]]) -> StringColumn:
     """Build a StringColumn from python strings (None == null).
@@ -297,7 +312,9 @@ def strings_column(values: Sequence[Optional[str]]) -> StringColumn:
         b = b"" if v is None else v.encode("utf-8", errors="surrogatepass")
         bufs.append(b)
         offsets.append(offsets[-1] + len(b))
-    chars = np.frombuffer(b"".join(bufs), dtype=np.uint8).copy()
+    joined = b"".join(bufs)
+    chars = np.zeros((next_pow2(len(joined)),), np.uint8)
+    chars[:len(joined)] = np.frombuffer(joined, dtype=np.uint8)
     return StringColumn(
         jnp.asarray(chars),
         jnp.asarray(np.array(offsets, dtype=np.int32)),
@@ -314,7 +331,9 @@ def strings_from_bytes(values: Sequence[Optional[bytes]]) -> StringColumn:
         b = b"" if v is None else v
         bufs.append(b)
         offsets.append(offsets[-1] + len(b))
-    chars = np.frombuffer(b"".join(bufs), dtype=np.uint8).copy()
+    joined = b"".join(bufs)
+    chars = np.zeros((next_pow2(len(joined)),), np.uint8)
+    chars[:len(joined)] = np.frombuffer(joined, dtype=np.uint8)
     return StringColumn(
         jnp.asarray(chars),
         jnp.asarray(np.array(offsets, dtype=np.int32)),
@@ -336,11 +355,11 @@ def strings_from_padded(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(lengths, dtype=jnp.int32)]
     )
     total = int(offsets[-1])  # concrete only outside jit; see note below
+    cap = next_pow2(total)  # bounded shape-variant set (see StringColumn)
     flat_idx = offsets[:-1, None] + jnp.arange(max_len, dtype=jnp.int32)[None, :]
     in_bounds = jnp.arange(max_len, dtype=jnp.int32)[None, :] < lengths[:, None]
-    chars = jnp.zeros((max(total, 1),), dtype=jnp.uint8)
-    chars = chars.at[jnp.where(in_bounds, flat_idx, total)].set(
+    chars = jnp.zeros((cap,), dtype=jnp.uint8)
+    chars = chars.at[jnp.where(in_bounds, flat_idx, cap)].set(
         padded, mode="drop", unique_indices=False
     )
-    chars = chars[:total]
     return StringColumn(chars, offsets, validity)

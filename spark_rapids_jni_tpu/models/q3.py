@@ -24,6 +24,8 @@ from __future__ import annotations
 
 from typing import List, NamedTuple
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -127,22 +129,33 @@ def q3_local(data: Q3Data) -> List[Q3Row]:
 
 def make_distributed_q3(mesh, data: Q3Data):
     """jit-compiled distributed q3 partials: facts sharded over DATA_AXIS,
-    dims replicated, group grid psum'd (the q5 partials pattern)."""
-    geo = _geometry(data)
+    dims replicated, group grid psum'd (the q5 partials pattern).
 
-    def body(ss_item, ss_item_v, ss_date, ss_date_v, price,
-             item_brand, item_manufact, date_year, date_moy):
-        p = _partials(ss_item, ss_item_v, ss_date, ss_date_v, price,
-                      item_brand, item_manufact, date_year, date_moy, **geo)
-        return _Partials(*(jax.lax.psum(x, (DATA_AXIS,)) for x in p))
+    LRU-cached on (mesh, geometry) like q97/q5: one traced program per
+    geometry, not a fresh jit wrapper per call (soak-tool finding)."""
+    return _q3_step_cached(mesh, tuple(sorted(_geometry(data).items())))
 
-    step = jax.shard_map(
-        body, mesh=mesh,
-        in_specs=(P(DATA_AXIS),) * 5 + (P(),) * 4,
-        out_specs=_Partials(P(), P()),
-        check_vma=False,
-    )
-    return jax.jit(step)
+
+@functools.lru_cache(maxsize=32)
+def _q3_step_cached(mesh, geo_items: tuple):
+    from spark_rapids_jni_tpu.obs.seam import COMPILE, seam
+
+    geo = dict(geo_items)
+    with seam(COMPILE, "q3_step"):
+        def body(ss_item, ss_item_v, ss_date, ss_date_v, price,
+                 item_brand, item_manufact, date_year, date_moy):
+            p = _partials(ss_item, ss_item_v, ss_date, ss_date_v, price,
+                          item_brand, item_manufact, date_year, date_moy,
+                          **geo)
+            return _Partials(*(jax.lax.psum(x, (DATA_AXIS,)) for x in p))
+
+        step = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(DATA_AXIS),) * 5 + (P(),) * 4,
+            out_specs=_Partials(P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(step)
 
 
 def _pad_facts(facts: dict, dp: int) -> dict:
@@ -189,10 +202,7 @@ def run_distributed_q3(mesh, data: Q3Data, *, budget=None, task_id: int = 0,
 
     geo = _geometry(data)
     dp = mesh.shape[DATA_AXIS]
-    from spark_rapids_jni_tpu.obs.seam import COMPILE, seam as _seam_cm
-
-    with _seam_cm(COMPILE, "q3_step"):
-        step = make_distributed_q3(mesh, data)
+    step = make_distributed_q3(mesh, data)  # LRU-cached; COMPILE seam inside
     sharding = NamedSharding(mesh, P(DATA_AXIS))
     rep = NamedSharding(mesh, P())
     dims = {k: jax.device_put(v, rep) for k, v in _dims(data).items()}

@@ -191,21 +191,33 @@ def make_distributed_q5(mesh, data: Q5Data):
     Facts are sharded over DATA_AXIS; the date dim is replicated.  Returns
     a function of the sharded channel-fact pytree producing replicated
     per-channel partial vectors (feed to :func:`q5_rollup`).
+
+    The step depends on ``data`` only through small scalars, so it is
+    LRU-cached like q97's: an executor looping over many batches of one
+    geometry must reuse ONE traced program, not leak a fresh jit wrapper
+    (and its compiled-executable cache entry) per call — the soak tool
+    caught exactly that as ~3 MB RSS per iteration (tools/soak.py).
     """
     n_dims = tuple(len(data.channels[n].dim_sk) for n in CHANNELS)
-    body = functools.partial(
-        _sharded_q5,
-        n_dims=n_dims, lo=data.sales_date_lo, hi=data.sales_date_hi,
-    )
-    step = jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(P(DATA_AXIS), P(), P()),
-        out_specs=tuple(_ChannelPartials(P(), P(), P(), P())
-                        for _ in CHANNELS),
-        check_vma=False,
-    )
-    return jax.jit(step)
+    return _q5_step_cached(mesh, n_dims, data.sales_date_lo,
+                           data.sales_date_hi)
+
+
+@functools.lru_cache(maxsize=32)
+def _q5_step_cached(mesh, n_dims: tuple, lo: int, hi: int):
+    from spark_rapids_jni_tpu.obs.seam import COMPILE, seam
+
+    with seam(COMPILE, "q5_step"):
+        body = functools.partial(_sharded_q5, n_dims=n_dims, lo=lo, hi=hi)
+        step = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS), P(), P()),
+            out_specs=tuple(_ChannelPartials(P(), P(), P(), P())
+                            for _ in CHANNELS),
+            check_vma=False,
+        )
+        return jax.jit(step)
 
 
 def _pad_channel(facts: Dict[str, np.ndarray], dp: int) -> Dict[str, np.ndarray]:
@@ -264,10 +276,7 @@ def run_distributed_q5(mesh, data: Q5Data, *, budget=None, task_id: int = 0,
     dp = int(np.prod([mesh.shape[a] for a in (DATA_AXIS,)]))
     sharding = NamedSharding(mesh, P(DATA_AXIS))
     rep = NamedSharding(mesh, P())
-    from spark_rapids_jni_tpu.obs.seam import COMPILE, seam as _seam_cm
-
-    with _seam_cm(COMPILE, "q5_step"):
-        step = make_distributed_q5(mesh, data)
+    step = make_distributed_q5(mesh, data)  # LRU-cached; COMPILE seam inside
     dim_sk = jax.device_put(data.date_sk, rep)
     dim_days = jax.device_put(data.date_days, rep)
 

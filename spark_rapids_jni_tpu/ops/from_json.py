@@ -43,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_rapids_jni_tpu.columnar.buckets import padded_buckets
+from spark_rapids_jni_tpu.columnar.column import next_pow2
 from spark_rapids_jni_tpu.columnar.column import (
     ListColumn,
     StringColumn,
@@ -160,10 +161,6 @@ def _scatter_span_bytes(chars, b_bytes, pairs_sel, dst_off, W: int,
     return chars.at[jnp.where(in_b, dst, nbytes)].set(mat, mode="drop")
 
 
-def _pow2(x: int) -> int:
-    return 1 << max(int(x) - 1, 0).bit_length()
-
-
 def from_json(col: StringColumn) -> ListColumn:
     """Extract raw top-level key/value pairs per row.
 
@@ -207,7 +204,7 @@ def from_json(col: StringColumn) -> ListColumn:
                 continue
             pair_counts = pair_counts.at[b.rows].add(
                 jnp.sum(cl.is_key, axis=1).astype(_I64))
-            recs.append((b, _compact(cl, b.rows, _pow2(int(npairs))),
+            recs.append((b, _compact(cl, b.rows, next_pow2(int(npairs))),
                          int(npairs)))
 
     group, group_bytes = [], 0
@@ -271,13 +268,14 @@ def _gather_spans(total, recs, get_span, row_offsets) -> StringColumn:
                   for _b, p, _np in recs]
     pulled = np.asarray(jnp.stack([offs[-1]] + widths_dev))
     nbytes = int(pulled[0])
-    chars = jnp.zeros((max(nbytes, 1),), jnp.uint8)
+    cap = next_pow2(nbytes)  # bounded shape-variant set (StringColumn)
+    chars = jnp.zeros((cap,), jnp.uint8)
     for (b, p, npairs), pos, wmax in zip(recs, positions, pulled[1:]):
         s, e = get_span(p)
-        w = _pow2(max(int(wmax), 1))
+        w = next_pow2(max(int(wmax), 1))
         chars = _scatter_span_bytes(
             chars, b.bytes, (p.loc_row, s, e),
             jnp.where(pos < total, offs[jnp.minimum(pos, total - 1)],
-                      jnp.int64(nbytes)),
-            w, nbytes)
-    return StringColumn(chars[:nbytes], offs.astype(_I32), None)
+                      jnp.int64(cap)),
+            w, cap)
+    return StringColumn(chars, offs.astype(_I32), None)

@@ -44,7 +44,7 @@ from spark_rapids_jni_tpu.columnar.buckets import (
     strings_from_buckets,
 )
 from spark_rapids_jni_tpu import config
-from spark_rapids_jni_tpu.columnar.column import Column, StringColumn
+from spark_rapids_jni_tpu.columnar.column import Column, StringColumn, next_pow2
 from spark_rapids_jni_tpu.columnar.dtypes import FLOAT64
 from spark_rapids_jni_tpu.ops import json_tokenizer as jt
 from spark_rapids_jni_tpu.ops.float_to_string import float_to_string
@@ -991,10 +991,6 @@ def _render(bi: _ByteInfo, segs, machine, kind, start, end, len_raw, len_esc,
     return out, out_len
 
 
-def _pow2(x: int) -> int:
-    return 1 << max(int(x) - 1, 0).bit_length()
-
-
 def _get_json_object_device(col: StringColumn, ptypes, pargs, names
                             ) -> StringColumn:
     """Fully device-resident evaluation: tokenize, byte tables, name match,
@@ -1086,7 +1082,7 @@ def _get_json_object_device(col: StringColumn, ptypes, pargs, names
             b, kind = p["b"], p["kind"]
             nr = b.n_rows
             if nf_total:
-                NF, WS = _pow2(int(nf_total)), _pow2(max(int(ws), 1))
+                NF, WS = next_pow2(int(nf_total)), next_pow2(max(int(ws), 1))
                 ftext, flen, fidx = jrd.float_texts_device(
                     b.bytes, kind, p["start"], p["end"], NF, WS)
             else:
@@ -1109,7 +1105,7 @@ def _get_json_object_device(col: StringColumn, ptypes, pargs, names
         for p, wmax in zip(ph1, wmaxes):
             b = p["b"]
             nv = b.n_valid
-            W = _pow2(max(int(wmax), 1))
+            W = next_pow2(max(int(wmax), 1))
             padded = jrd.render_device(
                 p["bi"], p["stype"], p["sarg"], p["segcum"], p["out_len"],
                 p["err"], p["kind"], p["start"], p["end"],

@@ -24,6 +24,8 @@ from __future__ import annotations
 
 from typing import Sequence, Union
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -149,17 +151,35 @@ def _mm_hash_bytes(padded: jnp.ndarray, lens: jnp.ndarray, h):
 
     Aligned 4-byte little-endian words get the standard round; the <=3 tail bytes
     are each sign-extended and given a full round (the Spark deviation).
-    """
-    lens = lens.astype(jnp.int32)
-    nwords = lens // 4
-    words, padded = _mm_bytes_words(padded)
-    nwords_max = words.shape[1]
 
+    The XLA path runs under ONE module-level jit: the scan body closes
+    over per-call arrays, and tracing it eagerly on every call leaks a
+    fresh trace-cache entry each time (soak-tool finding — a long-lived
+    executor grew without bound); under the cached jit it compiles once
+    per byte-matrix geometry.
+    """
     if _pallas_backend():
+        lens = lens.astype(jnp.int32)
+        nwords = lens // 4
+        words, padded = _mm_bytes_words(padded)
+
         from spark_rapids_jni_tpu.ops.hash_pallas import mm_bytes_words_pallas
 
         h = mm_bytes_words_pallas(words, nwords, h)
         return _mm_bytes_tail(padded, lens, nwords, h)
+    return _mm_bytes_jit()(padded, lens, h)
+
+
+@functools.lru_cache(maxsize=1)
+def _mm_bytes_jit():
+    return jax.jit(_mm_hash_bytes_xla)
+
+
+def _mm_hash_bytes_xla(padded: jnp.ndarray, lens: jnp.ndarray, h):
+    lens = lens.astype(jnp.int32)
+    nwords = lens // 4
+    words, padded = _mm_bytes_words(padded)
+    nwords_max = words.shape[1]
 
     def word_step(hc, w_idx):
         w = words[:, w_idx]
@@ -217,7 +237,17 @@ def _xx_hash_fixed8(v_u64, seed):
 
 
 def _xx_hash_bytes(padded: jnp.ndarray, lens: jnp.ndarray, seed):
-    """XXH64 over a dense [n, L] byte matrix with per-row lengths (xxhash64.cu:110-177)."""
+    """XXH64 over a dense [n, L] byte matrix (xxhash64.cu:110-177); cached
+    module-level jit for the same trace-leak reason as _mm_hash_bytes."""
+    return _xx_bytes_jit()(padded, lens, seed)
+
+
+@functools.lru_cache(maxsize=1)
+def _xx_bytes_jit():
+    return jax.jit(_xx_hash_bytes_xla)
+
+
+def _xx_hash_bytes_xla(padded: jnp.ndarray, lens: jnp.ndarray, seed):
     n, max_len = padded.shape
     pad = (-max_len) % 32
     if pad:
@@ -442,7 +472,6 @@ def _hash_list(col: ListColumn, h, *, mm: bool):
     if int(lens_np.max()) == 0:
         return h
     child_valid = child.is_valid()
-    csize = max(child.size, 1)
     if isinstance(child, StringColumn):
         # Per-step transient gather widths instead of one resident
         # [child_n, global_max] pad: each list bucket pads leaf strings only
@@ -451,7 +480,6 @@ def _hash_list(col: ListColumn, h, *, mm: bool):
         clens_np = (coffs_np[1:] - coffs_np[:-1]).astype(np.int32)
         cstarts = child.offsets[:-1]
         clens = child.offsets[1:] - child.offsets[:-1]
-        nchars = max(int(child.chars.shape[0]), 1)
         starts_np = np.asarray(starts)
         # per-list-row max leaf byte length (0 for empty spans)
         safe_starts = np.minimum(starts_np, max(len(clens_np) - 1, 0))
@@ -472,38 +500,90 @@ def _hash_list(col: ListColumn, h, *, mm: bool):
         blen = jnp.where(real, (ends - starts)[rows], 0)
         bvalid = row_valid[rows] & real
         hb = h[rows]
+        # bucket kernels are module-cached jits (keyed on child kind +
+        # static widths): the scan body closes over bucket arrays, so an
+        # eager per-call trace would leak a trace-cache entry per call
+        # (same soak finding as _mm_hash_bytes)
+        backend = _pallas_backend()  # part of each cache key: the traced
+        # program bakes the backend choice, so a config.override must not
+        # silently reuse the other backend's executable
         if isinstance(child, StringColumn):
             w_child = max(int(row_max_leaf[rows_np[:n_real]].max()), 1)
-            lane = jnp.arange(w_child, dtype=jnp.int32)[None, :]
-
-        def elem_step(hc, j):
-            idx = jnp.clip(bstart + j, 0, csize - 1)
-            if isinstance(child, StringColumn):
-                s0 = cstarts[idx]
-                l0 = clens[idx]
-                pos = jnp.clip(s0[:, None] + lane, 0, nchars - 1)
-                eb = jnp.where(lane < l0[:, None], child.chars[pos], jnp.uint8(0))
-                upd = (
-                    _mm_hash_bytes(eb, l0, hc)
-                    if mm
-                    else _xx_hash_bytes(eb, l0, hc)
-                )
-            elif isinstance(child, Decimal128Column):
-                g = Decimal128Column(
-                    child.hi[idx], child.lo[idx], None, child.dtype
-                )
-                upd = _hash_element(g, hc, mm=mm)
-            else:
-                upd = _hash_element(
-                    Column(child.data[idx], None, child.dtype), hc, mm=mm
-                )
-            ok = bvalid & (j < blen) & child_valid[idx]
-            return jnp.where(ok, upd, hc), None
-
-        hb, _ = jax.lax.scan(elem_step, hb, jnp.arange(w))
+            hb = _list_scan_string_jit(mm, w, w_child, backend)(
+                bstart, blen, bvalid, hb, cstarts, clens, child.chars,
+                child_valid)
+        elif isinstance(child, Decimal128Column):
+            hb = _list_scan_dec128_jit(mm, w, child.dtype, backend)(
+                bstart, blen, bvalid, hb, child.hi, child.lo, child_valid)
+        else:
+            hb = _list_scan_fixed_jit(mm, w, child.dtype, backend)(
+                bstart, blen, bvalid, hb, child.data, child_valid)
         tgt = jnp.where(real, rows, jnp.int32(n))
         h = h.at[tgt].set(hb, mode="drop")
     return h
+
+
+@functools.lru_cache(maxsize=256)
+def _list_scan_string_jit(mm: bool, w: int, w_child: int,
+                          backend: bool):
+    @jax.jit
+    def run(bstart, blen, bvalid, hb, cstarts, clens, chars, child_valid):
+        csize = max(cstarts.shape[0], 1)
+        nchars = max(chars.shape[0], 1)
+        lane = jnp.arange(w_child, dtype=jnp.int32)[None, :]
+
+        def elem_step(hc, j):
+            idx = jnp.clip(bstart + j, 0, csize - 1)
+            s0 = cstarts[idx]
+            l0 = clens[idx]
+            pos = jnp.clip(s0[:, None] + lane, 0, nchars - 1)
+            eb = jnp.where(lane < l0[:, None], chars[pos], jnp.uint8(0))
+            upd = _mm_hash_bytes(eb, l0, hc) if mm else _xx_hash_bytes(
+                eb, l0, hc)
+            ok = bvalid & (j < blen) & child_valid[idx]
+            return jnp.where(ok, upd, hc), None
+
+        hb2, _ = jax.lax.scan(elem_step, hb, jnp.arange(w))
+        return hb2
+
+    return run
+
+
+@functools.lru_cache(maxsize=256)
+def _list_scan_dec128_jit(mm: bool, w: int, dtype, backend: bool):
+    @jax.jit
+    def run(bstart, blen, bvalid, hb, hi, lo, child_valid):
+        csize = max(hi.shape[0], 1)
+
+        def elem_step(hc, j):
+            idx = jnp.clip(bstart + j, 0, csize - 1)
+            g = Decimal128Column(hi[idx], lo[idx], None, dtype)
+            upd = _hash_element(g, hc, mm=mm)
+            ok = bvalid & (j < blen) & child_valid[idx]
+            return jnp.where(ok, upd, hc), None
+
+        hb2, _ = jax.lax.scan(elem_step, hb, jnp.arange(w))
+        return hb2
+
+    return run
+
+
+@functools.lru_cache(maxsize=256)
+def _list_scan_fixed_jit(mm: bool, w: int, dtype, backend: bool):
+    @jax.jit
+    def run(bstart, blen, bvalid, hb, data, child_valid):
+        csize = max(data.shape[0], 1)
+
+        def elem_step(hc, j):
+            idx = jnp.clip(bstart + j, 0, csize - 1)
+            upd = _hash_element(Column(data[idx], None, dtype), hc, mm=mm)
+            ok = bvalid & (j < blen) & child_valid[idx]
+            return jnp.where(ok, upd, hc), None
+
+        hb2, _ = jax.lax.scan(elem_step, hb, jnp.arange(w))
+        return hb2
+
+    return run
 
 
 # ---------------------------------------------------------------------------
