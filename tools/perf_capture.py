@@ -29,7 +29,11 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OUT = os.path.join(REPO, "PERF_CAPTURE.jsonl")
+# SRT_PERF_CAPTURE_OUT redirects banking for the end-to-end pipeline test
+# (tests/test_perf_capture_e2e.py) — the production default stays the repo
+# root file bench.py replays from.
+OUT = (os.environ.get("SRT_PERF_CAPTURE_OUT")
+       or os.path.join(REPO, "PERF_CAPTURE.jsonl"))
 
 PROBE = (
     "import jax, jax.numpy as jnp\n"
@@ -168,16 +172,36 @@ def probe(timeout: float = 150.0) -> bool:
 
 
 def capture_once() -> bool:
-    """One full staged capture; returns True if the headline bench landed."""
+    """One full staged capture; returns True if the headline bench landed.
+
+    SRT_PERF_SWEEP_SIZES (comma-separated log2 sizes) shrinks the sweep —
+    and skips the big tier — so the e2e pipeline test can exercise the
+    REAL probe->sweep->bank->bench path on the CPU mesh in minutes.
+    """
+    size_env = os.environ.get("SRT_PERF_SWEEP_SIZES", "")
+    small, big = [20, 22], [24, 26]
+    if size_env:
+        try:
+            parsed = [int(x) for x in size_env.replace(";", ",").split(",")
+                      if x.strip()]
+        except ValueError:
+            # malformed override must NOT kill the loop mid-open-window;
+            # bank the problem and sweep the defaults
+            _append({"stage": "sweep",
+                     "error": f"bad SRT_PERF_SWEEP_SIZES={size_env!r}; "
+                              "using defaults"})
+            parsed = []
+        if parsed:
+            small, big = parsed, []
     sweep_small = SWEEP.format(
-        repo=REPO, sizes=[20, 22],
+        repo=REPO, sizes=small,
         ops_on=("copy", "murmur3", "murmur3_pallas", "xxhash64",
                 "xxhash64_pallas"))
-    sweep_big = SWEEP.format(
-        repo=REPO, sizes=[24, 26],
-        ops_on=("copy", "murmur3", "murmur3_pallas"))
     ok = _run("sweep-small", [sys.executable, "-c", sweep_small], 900)
-    if ok:
+    if ok and big:
+        sweep_big = SWEEP.format(
+            repo=REPO, sizes=big,
+            ops_on=("copy", "murmur3", "murmur3_pallas"))
         _run("sweep-big", [sys.executable, "-c", sweep_big], 900)
     return _run("bench", [sys.executable, os.path.join(REPO, "bench.py")], 3600)
 
