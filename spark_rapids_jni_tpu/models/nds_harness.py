@@ -157,6 +157,9 @@ def main(argv=None) -> int:
                 run_streaming_q97,
             )
 
+            # the host-side bucket staging is governed through the
+            # arbiter's CPU path, like the reference's is_for_cpu ladder
+            host_budget = BudgetedResource(gov, 4 << 30, is_cpu=True)
             t0 = time.perf_counter()
             with tempfile.TemporaryDirectory(prefix="nds_shuffle_") as td:
                 counts, q97_ok, stats = run_streaming_q97(
@@ -164,7 +167,7 @@ def main(argv=None) -> int:
                     generate_q97_chunks(args.sf, args.seed,
                                         args.stream_chunk_rows),
                     tmpdir=td, n_buckets=args.buckets, budget=budget,
-                    task_id=2, verify=args.verify)
+                    host_budget=host_budget, task_id=2, verify=args.verify)
             q97_dt = time.perf_counter() - t0
             nq = stats["rows_in"]
             out["queries"]["q97"] = {

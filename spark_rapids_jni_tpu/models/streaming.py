@@ -148,6 +148,7 @@ def run_streaming_q97(
     tmpdir: str,
     n_buckets: int = 16,
     budget=None,
+    host_budget=None,
     task_id: int = 0,
     verify: bool = False,
 ) -> Tuple[Tuple[int, int, int], Optional[bool], Dict[str, int]]:
@@ -157,9 +158,17 @@ def run_streaming_q97(
     ``verified`` is per-bucket host-set oracle agreement (None when
     ``verify`` is off) — bucket-local sets are the whole point: the
     oracle's working set is also bounded by the bucket size.
+
+    ``host_budget`` (a ``BudgetedResource(..., is_cpu=True)``) governs the
+    HOST-side bucket materialization: each bucket's row bytes are reserved
+    through the arbiter's CPU path before the bucket is read back, so a
+    multi-tenant host blocks/wakes on pinned-host pressure exactly like
+    device pressure (the reference governs CPU allocations through the
+    same state machine — SparkResourceAdaptorJni.cpp is_for_cpu paths).
     """
     from spark_rapids_jni_tpu.mem.governed import (
         default_device_budget,
+        run_with_split_retry,
         task_context,
     )
     from spark_rapids_jni_tpu.models.q97 import (
@@ -183,23 +192,42 @@ def run_streaming_q97(
         cap = default_q97_capacity(shuffle.max_bucket_rows(), dp)
         totals = [0, 0, 0]
         verified: Optional[bool] = True if verify else None
+        def run_bucket(b: int):
+            store_b = shuffle.read("store", b)
+            cat_b = shuffle.read("catalog", b)
+            out = run_distributed_q97(
+                mesh, store_b, cat_b, budget=budget, task_id=task_id,
+                capacity=cap, manage_task=False)
+            got = (int(out.store_only), int(out.catalog_only), int(out.both))
+            oracle_ok = True
+            if verify:
+                s = set(zip(store_b[0].tolist(), store_b[1].tolist()))
+                c = set(zip(cat_b[0].tolist(), cat_b[1].tolist()))
+                oracle_ok = got == (len(s - c), len(c - s), len(s & c))
+            return got, oracle_ok
+
         with task_context(budget.gov, task_id):
             for b in range(n_buckets):
-                store_b = shuffle.read("store", b)
-                cat_b = shuffle.read("catalog", b)
-                if not len(store_b[0]) and not len(cat_b[0]):
+                bucket_rows = (shuffle.rows.get(("store", b), 0)
+                               + shuffle.rows.get(("catalog", b), 0))
+                if bucket_rows == 0:
                     continue
-                out = run_distributed_q97(
-                    mesh, store_b, cat_b, budget=budget, task_id=task_id,
-                    capacity=cap, manage_task=False)
-                got = (int(out.store_only), int(out.catalog_only),
-                       int(out.both))
-                if verify:
-                    s = set(zip(store_b[0].tolist(), store_b[1].tolist()))
-                    c = set(zip(cat_b[0].tolist(), cat_b[1].tolist()))
-                    want = (len(s - c), len(c - s), len(s & c))
-                    if got != want:
-                        verified = False
+                if host_budget is not None:
+                    # the canonical retry driver brackets the host
+                    # reservation: a RetryOOM from multi-tenant host
+                    # pressure (wasted-wake self-escalation) re-runs this
+                    # bucket instead of crashing the whole stream
+                    got, oracle_ok = run_with_split_retry(
+                        host_budget, b,
+                        nbytes_of=lambda _b: bucket_rows * 8,  # 2x int32/row
+                        run=run_bucket,
+                        split=lambda _b: [],
+                        combine=lambda rs: rs[0],
+                    )
+                else:
+                    got, oracle_ok = run_bucket(b)
+                if verify and not oracle_ok:
+                    verified = False
                 for i in range(3):
                     totals[i] += got[i]
         stats = {
@@ -208,6 +236,11 @@ def run_streaming_q97(
             "max_bucket_rows": shuffle.max_bucket_rows(),
             "capacity": cap,
         }
+        if host_budget is not None:
+            # snapshot, NOT reset_peak(): the budget may be shared by
+            # concurrent tenants, and mutating a caller-owned high-water
+            # mark would race; this is the global peak so far by contract
+            stats["host_peak_reserved"] = host_budget.peak
         return tuple(totals), verified, stats
     finally:
         shuffle.close()

@@ -102,12 +102,15 @@ def test_streaming_q97_matches_global_oracle(tmp_path):
                np.concatenate([i for s, _, i in chunks if s == "catalog"]))
     want = q97_host_oracle(store, catalog)
 
-    MemoryGovernor.initialize()
+    from spark_rapids_jni_tpu.mem import BudgetedResource
+
+    gov = MemoryGovernor.initialize()
     _reset_default_budget_for_tests()
+    host_budget = BudgetedResource(gov, 1 << 30, is_cpu=True)
     try:
         counts, verified, stats = run_streaming_q97(
             mesh, iter(chunks), tmpdir=str(tmp_path / "shuf"),
-            n_buckets=8, task_id=5, verify=True)
+            n_buckets=8, host_budget=host_budget, task_id=5, verify=True)
     finally:
         MemoryGovernor.shutdown()
     assert verified is True
@@ -115,6 +118,9 @@ def test_streaming_q97_matches_global_oracle(tmp_path):
     assert stats["rows_in"] == len(store[0]) + len(catalog[0])
     assert stats["max_bucket_rows"] < stats["rows_in"], \
         "bucketing must actually bound the per-piece working set"
+    # host staging went through the arbiter's CPU path and closed cleanly
+    assert stats["host_peak_reserved"] > 0
+    assert host_budget.used == 0
 
 
 @pytest.mark.slow
@@ -136,3 +142,54 @@ def test_nds_harness_sf1_streamed(capsys):
     assert qs["q97"]["streamed"]["max_bucket_rows"] < 2 * 2_800_000
     for q in ("q5", "q97", "q3"):
         assert qs[q]["peak_reserved_bytes"] > 0
+
+
+def test_two_tenants_contend_on_host_budget(tmp_path):
+    """Two streamed q97 tenants share ONE tight host budget (CPU arbiter
+    path): pressure must resolve by blocking/waking through the state
+    machine — both finish with correct counts, nothing leaks, no hang.
+    The budget fits roughly one tenant's bucket at a time."""
+    import threading
+
+    import jax
+
+    from spark_rapids_jni_tpu.mem import BudgetedResource, MemoryGovernor
+    from spark_rapids_jni_tpu.models.q97 import q97_host_oracle
+    from spark_rapids_jni_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh((len(jax.devices()), 1))
+    gov = MemoryGovernor(watchdog_period_s=0.02)
+    dev_budget = BudgetedResource(gov, 1 << 30)
+    # ~4 buckets/tenant of ~1000 rows -> ~8000 B/bucket; two concurrent
+    # tenants at 12 KB must sometimes block each other, never deadlock
+    host_budget = BudgetedResource(gov, 12 << 10, is_cpu=True)
+
+    results = {}
+
+    def tenant(tid):
+        chunks = list(generate_q97_chunks(sf=0.001, seed=tid, chunk_rows=700))
+        store = (np.concatenate([c for s, c, _ in chunks if s == "store"]),
+                 np.concatenate([i for s, _, i in chunks if s == "store"]))
+        cat = (np.concatenate([c for s, c, _ in chunks if s == "catalog"]),
+               np.concatenate([i for s, _, i in chunks if s == "catalog"]))
+        counts, _v, stats = run_streaming_q97(
+            mesh, iter(chunks), tmpdir=str(tmp_path / f"t{tid}"),
+            n_buckets=4, budget=dev_budget, host_budget=host_budget,
+            task_id=tid)
+        results[tid] = (counts, q97_host_oracle(store, cat), stats)
+
+    try:
+        threads = [threading.Thread(target=tenant, args=(t,))
+                   for t in (21, 22)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert all(not t.is_alive() for t in threads), "tenant hung"
+    finally:
+        gov.close()
+    assert set(results) == {21, 22}
+    for tid, (counts, want, stats) in results.items():
+        assert counts == want, f"tenant {tid}"
+        assert stats["host_peak_reserved"] > 0
+    assert host_budget.used == 0, "host reservations must all be released"

@@ -186,8 +186,9 @@ def capture_once() -> bool:
                       if x.strip()]
         except ValueError:
             # malformed override must NOT kill the loop mid-open-window;
-            # bank the problem and sweep the defaults
-            _append({"stage": "sweep",
+            # bank the problem (own stage: 'sweep' records stay
+            # homogeneous for consumers) and sweep the defaults
+            _append({"stage": "config-error",
                      "error": f"bad SRT_PERF_SWEEP_SIZES={size_env!r}; "
                               "using defaults"})
             parsed = []
