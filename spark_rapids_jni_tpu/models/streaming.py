@@ -68,6 +68,11 @@ class ExternalKeyShuffle:
         self.n_buckets = n_buckets
         self.columns = columns
         self.rows: Dict[Tuple[str, int], int] = {}
+        # per-bucket hash modulus: initial buckets live at n_buckets;
+        # split_bucket refines b -> (b, b+M) at modulus 2M (hash % M == b
+        # implies hash % 2M in {b, b+M}, so refinement is consistent
+        # across both sides — recursive grace hash)
+        self._modulus: Dict[int, int] = {}
         os.makedirs(tmpdir, exist_ok=True)
 
     def _path(self, side: str, bucket: int, col: str) -> str:
@@ -98,6 +103,59 @@ class ExternalKeyShuffle:
             else:
                 out.append(np.zeros((0,), np.int32))
         return tuple(out)
+
+    def split_bucket(self, bucket: int,
+                     chunk_rows: int = 1 << 18) -> Tuple[int, int]:
+        """Refine one bucket into two on DISK with bounded memory.
+
+        Rows whose pair hash lands on ``bucket`` at modulus ``2M`` stay;
+        the rest move to bucket ``bucket + M`` (files streamed in
+        ``chunk_rows`` chunks — never the whole bucket in memory).  The
+        recursive-grace-hash rung: a bucket that cannot fit the host
+        budget splits into two that can, and per-bucket q97 counts stay
+        additive because the refinement is key-space consistent.
+        """
+        m = self._modulus.get(bucket, self.n_buckets)
+        new_bucket = bucket + m
+        for side in ("store", "catalog"):
+            if (side, bucket) not in self.rows:
+                continue
+            readers = [open(self._path(side, bucket, c), "rb")
+                       for c in self.columns]
+            keep_paths = [self._path(side, bucket, c) + ".keep"
+                          for c in self.columns]
+            keeps = [open(p, "wb") for p in keep_paths]
+            moved = 0
+            kept = 0
+            try:
+                while True:
+                    chunk = [np.frombuffer(r.read(chunk_rows * 4), np.int32)
+                             for r in readers]
+                    if not len(chunk[0]):
+                        break
+                    stay = bucket_of_pairs(chunk[0], chunk[1],
+                                           2 * m) == bucket
+                    for col, arr, keep in zip(self.columns, chunk, keeps):
+                        keep.write(np.ascontiguousarray(
+                            arr[stay], np.int32).tobytes())
+                        with open(self._path(side, new_bucket, col),
+                                  "ab") as mv:
+                            mv.write(np.ascontiguousarray(
+                                arr[~stay], np.int32).tobytes())
+                    kept += int(stay.sum())
+                    moved += int((~stay).sum())
+            finally:
+                for f in readers + keeps:
+                    f.close()
+            for col, keep_path in zip(self.columns, keep_paths):
+                os.replace(keep_path, self._path(side, bucket, col))
+            self.rows[(side, bucket)] = kept
+            if moved:
+                self.rows[(side, new_bucket)] = (
+                    self.rows.get((side, new_bucket), 0) + moved)
+        self._modulus[bucket] = 2 * m
+        self._modulus[new_bucket] = 2 * m
+        return bucket, new_bucket
 
     def max_bucket_rows(self) -> int:
         """Largest combined (store+catalog) bucket — sizes the shuffle
@@ -206,23 +264,38 @@ def run_streaming_q97(
                 oracle_ok = got == (len(s - c), len(c - s), len(s & c))
             return got, oracle_ok
 
+        def piece_rows(b: int) -> int:
+            return (shuffle.rows.get(("store", b), 0)
+                    + shuffle.rows.get(("catalog", b), 0))
+
+        n_splits = [0]
+
+        def split_piece(b: int):
+            # recursive grace hash: re-partition the oversized bucket on
+            # disk into two key-space-consistent halves (counts stay
+            # additive); run_with_split_retry then reserves each half
+            n_splits[0] += 1
+            return shuffle.split_bucket(b)
+
+        def combine_pieces(rs):
+            return (tuple(sum(r[0][i] for r in rs) for i in range(3)),
+                    all(r[1] for r in rs))
+
         with task_context(budget.gov, task_id):
             for b in range(n_buckets):
-                bucket_rows = (shuffle.rows.get(("store", b), 0)
-                               + shuffle.rows.get(("catalog", b), 0))
-                if bucket_rows == 0:
+                if piece_rows(b) == 0:
                     continue
                 if host_budget is not None:
                     # the canonical retry driver brackets the host
-                    # reservation: a RetryOOM from multi-tenant host
-                    # pressure (wasted-wake self-escalation) re-runs this
-                    # bucket instead of crashing the whole stream
+                    # reservation: RetryOOM from multi-tenant pressure
+                    # re-runs the bucket; an over-budget bucket splits on
+                    # disk instead of crashing the stream
                     got, oracle_ok = run_with_split_retry(
                         host_budget, b,
-                        nbytes_of=lambda _b: bucket_rows * 8,  # 2x int32/row
+                        nbytes_of=lambda bb: piece_rows(bb) * 8,  # 2x i32
                         run=run_bucket,
-                        split=lambda _b: [],
-                        combine=lambda rs: rs[0],
+                        split=split_piece,
+                        combine=combine_pieces,
                     )
                 else:
                     got, oracle_ok = run_bucket(b)
@@ -241,6 +314,7 @@ def run_streaming_q97(
             # concurrent tenants, and mutating a caller-owned high-water
             # mark would race; this is the global peak so far by contract
             stats["host_peak_reserved"] = host_budget.peak
+            stats["bucket_splits"] = n_splits[0]
         return tuple(totals), verified, stats
     finally:
         shuffle.close()

@@ -193,3 +193,68 @@ def test_two_tenants_contend_on_host_budget(tmp_path):
         assert counts == want, f"tenant {tid}"
         assert stats["host_peak_reserved"] > 0
     assert host_budget.used == 0, "host reservations must all be released"
+
+
+def test_oversized_bucket_splits_on_disk(tmp_path):
+    """A bucket that cannot fit the host budget must SPLIT recursively on
+    disk (key-space-consistent grace-hash refinement) and still produce
+    the exact global answer — not crash the stream."""
+    import jax
+
+    from spark_rapids_jni_tpu.mem import BudgetedResource, MemoryGovernor
+    from spark_rapids_jni_tpu.models.q97 import q97_host_oracle
+    from spark_rapids_jni_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh((len(jax.devices()), 1))
+    chunks = list(generate_q97_chunks(sf=0.002, seed=9, chunk_rows=2000))
+    store = (np.concatenate([c for s, c, _ in chunks if s == "store"]),
+             np.concatenate([i for s, _, i in chunks if s == "store"]))
+    cat = (np.concatenate([c for s, c, _ in chunks if s == "catalog"]),
+           np.concatenate([i for s, _, i in chunks if s == "catalog"]))
+    want = q97_host_oracle(store, cat)
+
+    gov = MemoryGovernor(watchdog_period_s=0.02)
+    dev_budget = BudgetedResource(gov, 1 << 30)
+    # 2 buckets over 11200 rows -> ~5600 rows * 8 B ~= 45 KB per bucket;
+    # a 24 KB host budget CANNOT fit one, forcing >=1 disk split each
+    host_budget = BudgetedResource(gov, 24 << 10, is_cpu=True)
+    try:
+        counts, verified, stats = run_streaming_q97(
+            mesh, iter(chunks), tmpdir=str(tmp_path / "shuf"),
+            n_buckets=2, budget=dev_budget, host_budget=host_budget,
+            task_id=31, verify=True)
+    finally:
+        gov.close()
+    assert counts == want
+    assert verified is True
+    assert stats["bucket_splits"] >= 2, stats
+    assert host_budget.used == 0
+    assert host_budget.peak <= 24 << 10, "split pieces must fit the budget"
+
+
+def test_split_bucket_disk_refinement(tmp_path):
+    """ExternalKeyShuffle.split_bucket: rows re-partition consistently,
+    nothing lost, both sides agree on placement."""
+    shuffle = ExternalKeyShuffle(str(tmp_path), n_buckets=2)
+    rng = np.random.RandomState(4)
+    sent = {}
+    for side in ("store", "catalog"):
+        cust = rng.randint(1, 500, 4000).astype(np.int32)
+        item = rng.randint(1, 300, 4000).astype(np.int32)
+        shuffle.append(side, bucket_of_pairs(cust, item, 2), (cust, item))
+        sent[side] = set(zip(cust.tolist(), item.tolist()))
+
+    b0_rows = shuffle.rows[("store", 0)]
+    lo, hi = shuffle.split_bucket(0, chunk_rows=512)
+    assert (lo, hi) == (0, 2)
+    assert shuffle.rows[("store", 0)] + shuffle.rows[("store", 2)] == b0_rows
+    for side in ("store", "catalog"):
+        got = set()
+        for b in (0, 1, 2):
+            cust_b, item_b = shuffle.read(side, b)
+            if b in (0, 2):
+                # refined placement: hash % 4 must equal the bucket id
+                assert np.all(bucket_of_pairs(cust_b, item_b, 4) == b)
+            got |= set(zip(cust_b.tolist(), item_b.tolist()))
+        assert got == sent[side], "split must move rows, never lose them"
+    shuffle.close()

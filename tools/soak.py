@@ -118,6 +118,24 @@ def main(argv=None) -> int:
                      % (i, i * 7, rng.randint(99)) for i in range(256)]
             get_json_object(c.strings_from_bytes(jrows), "$.a.b[*]")
 
+            # broader op families every 4th iter (string parse + URI):
+            # same endurance contract, different kernels
+            if it % 4 == 0:
+                from spark_rapids_jni_tpu.ops import (
+                    parse_uri_protocol,
+                    string_to_float,
+                )
+
+                fcol = c.strings_from_bytes(
+                    [b"%d.%04de%+03d" % (rng.randint(9999), i, i % 30 - 15)
+                     for i in range(256)])
+                string_to_float(fcol, ansi_mode=False,
+                                dtype=c.FLOAT64).data.block_until_ready()
+                ucol = c.strings_from_bytes(
+                    [b"https://h%03d.example.com/p/%d?q=%d"
+                     % (i, rng.randint(999), i) for i in range(256)])
+                parse_uri_protocol(ucol)
+
             wall = time.perf_counter() - t0
             rss = _rss_mb()
             if rss0 is None:
