@@ -84,12 +84,46 @@ def reservation(budget: BudgetedResource, nbytes: int):
     block (another task holds the budget), raise RetryOOM/SplitAndRetryOOM
     (escalation decided this thread must retry or split), or raise
     OutOfBudget (non-retryable; request exceeds the whole budget).
+
+    The acquire crosses the ALLOC seam — the allocation-interception
+    point of the reference's chaos/profiling stack (faultinj.cu hooks the
+    allocator; CUPTI sees malloc activity): the profiler records the
+    admission (including any blocked wait) as a range plus a budget-used
+    counter, and a chaos rule on ``alloc``/``reserve:*`` injects an
+    allocation failure INSIDE the retry protocol.
     """
-    budget.acquire(nbytes)
+    from spark_rapids_jni_tpu.obs import seam as _seam
+
+    # lock-free hot-path gate, same flags seam() itself checks: with the
+    # profiler and injector both inactive this adds zero locks/formatting
+    # to the admission path (incl. the up-to-500 RetryOOM retry loop)
+    if _seam._profiler_range is None and _seam._injector is None:
+        budget.acquire(nbytes)
+        try:
+            yield
+        finally:
+            budget.release(nbytes)
+        return
+
+    from spark_rapids_jni_tpu.obs.profiler import Profiler
+
+    ctr = "cpu_budget_used" if budget.is_cpu else "device_budget_used"
+
+    def _emit():
+        # sample + timestamp under the budget lock so concurrent tenants'
+        # counter points can never reorder against the values they carry
+        with budget._lock:
+            Profiler.counter(ctr, budget.used)
+
+    with _seam.seam(_seam.ALLOC,
+                    f"reserve:{'cpu' if budget.is_cpu else 'dev'}:{nbytes}"):
+        budget.acquire(nbytes)
+        _emit()
     try:
         yield
     finally:
         budget.release(nbytes)
+        _emit()
 
 
 _NO_BUDGET_LOCK = threading.Lock()

@@ -131,3 +131,24 @@ def test_compile_fault_aborts_cleanly(gov):
                               task_id=4, capacity=171)
     assert (int(out.store_only), int(out.catalog_only),
             int(out.both)) == q97_host_oracle(store, catalog)
+
+
+def test_alloc_seam_fault_retries_to_completion(gov):
+    """An injected RetryOOM at the ALLOC seam (budget admission — the
+    reference's allocator-interception point, faultinj.cu hooking the
+    CUDA allocator) drives the normal retry protocol to the correct
+    answer."""
+    store, catalog = _tables(seed=9)
+    budget = BudgetedResource(gov, 1 << 30)
+    FaultInjector.install({
+        "alloc": {"reserve:dev:*": {"injectionType": "retry_oom",
+                                    "interceptionCount": 2}},
+    })
+    try:
+        out = run_distributed_q97(_mesh(), store, catalog,
+                                  budget=budget, task_id=5)
+    finally:
+        FaultInjector.uninstall()
+    assert (int(out.store_only), int(out.catalog_only),
+            int(out.both)) == q97_host_oracle(store, catalog)
+    assert budget.used == 0

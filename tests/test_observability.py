@@ -252,10 +252,14 @@ def test_profiler_real_pipeline_capture(tmp_path):
     ranges = [e for e in events if e["type"] == "range"]
     assert ranges, "no ranges captured"
     cats = {e["category"] for e in ranges}
-    # the q97 pipeline crosses the collective seam (all_to_all) and the
-    # transfer seam (device_put/materialization)
+    # the q97 pipeline crosses the collective seam (all_to_all), the
+    # transfer seam (device_put/materialization), and the ALLOC seam
+    # (budget admission — the reference's allocator-interception point)
     assert "collective" in cats, cats
     assert "transfer" in cats, cats
+    assert "alloc" in cats, cats
+    counters = [e for e in events if e["type"] == "counter"]
+    assert any(e["name"] == "device_budget_used" for e in counters)
     for e in ranges:
         assert e["start_ns"] <= e["end_ns"], e
     # nesting sanity per thread: a range overlapping its parent must nest
