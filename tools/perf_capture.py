@@ -83,6 +83,37 @@ for log2 in {sizes}:
 """
 
 
+TRACE_PROBE = r"""
+import json, os, sys, tempfile
+sys.path.insert(0, {repo!r})
+from spark_rapids_jni_tpu import columnar as c
+from spark_rapids_jni_tpu.obs import Profiler
+from spark_rapids_jni_tpu.obs.convert import _DEVICE_PID_BASE
+from spark_rapids_jni_tpu.obs.convert import main as convert_main
+from spark_rapids_jni_tpu.ops import murmur_hash32
+
+with tempfile.TemporaryDirectory(prefix="srt_trace_probe_") as td:
+    cap = os.path.join(td, "c.srtp")
+    xd = os.path.join(td, "x")
+    out = os.path.join(td, "m.json")
+    Profiler.init(cap, xplane_dir=xd)
+    Profiler.start()
+    col = c.column(list(range(4096)), c.INT32)
+    murmur_hash32([col], seed=42).data.block_until_ready()
+    Profiler.stop()
+    Profiler.shutdown()
+    convert_main([cap, "--format", "chrome", "--device-trace", xd, "-o", out])
+    evs = json.load(open(out))["traceEvents"]
+    dev = [e for e in evs
+           if e.get("pid", 0) >= _DEVICE_PID_BASE and e.get("ph") == "X"]
+    host = [e for e in evs
+            if e.get("pid", 0) < _DEVICE_PID_BASE and e.get("ph") == "X"]
+print(json.dumps({{"stage": "device-trace", "device_events": len(dev),
+                   "host_ranges": len(host),
+                   "merged_ok": bool(dev and host)}}))
+"""
+
+
 def _stage_env() -> dict:
     """Stage subprocess env with the persistent XLA compilation cache ON.
 
@@ -204,6 +235,13 @@ def capture_once() -> bool:
             repo=REPO, sizes=big,
             ops_on=("copy", "murmur3", "murmur3_pallas"))
         _run("sweep-big", [sys.executable, "-c", sweep_big], 900)
+    if ok and big:
+        # device-timeline capture on the REAL backend (full tier only —
+        # the shrunken-sweep e2e test path skips it, like sweep-big):
+        # proves the jax.profiler perfetto export + converter merge
+        # (obs/convert.py) works against actual hardware kernels
+        _run("device-trace",
+             [sys.executable, "-c", TRACE_PROBE.format(repo=REPO)], 600)
     return _run("bench", [sys.executable, os.path.join(REPO, "bench.py")], 3600)
 
 
