@@ -209,6 +209,7 @@ def run_streaming_q97(
     host_budget=None,
     task_id: int = 0,
     verify: bool = False,
+    bucket_owner: Optional[Tuple[int, int]] = None,
 ) -> Tuple[Tuple[int, int, int], Optional[bool], Dict[str, int]]:
     """Out-of-core governed distributed q97 over streamed fact chunks.
 
@@ -223,6 +224,13 @@ def run_streaming_q97(
     multi-tenant host blocks/wakes on pinned-host pressure exactly like
     device pressure (the reference governs CPU allocations through the
     same state machine — SparkResourceAdaptorJni.cpp is_for_cpu paths).
+
+    ``bucket_owner=(proc_id, nprocs)`` restricts execution to the buckets
+    this participant OWNS (``b % nprocs == proc_id``) — the pod-scale
+    deployment shape: host groups partition the bucket space, per-owner
+    counts stay additive, and the global answer is the sum of the owners'
+    results (tests/streaming_worker.py drives this across two real OS
+    processes).
     """
     from spark_rapids_jni_tpu.mem.governed import (
         default_device_budget,
@@ -235,15 +243,27 @@ def run_streaming_q97(
     )
     from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS
 
+    if bucket_owner is not None:
+        proc_id, nprocs = bucket_owner
+        if not (0 <= proc_id < nprocs):
+            raise ValueError(f"bucket_owner {bucket_owner}: need "
+                             "0 <= proc_id < nprocs")
     if budget is None:
         budget = default_device_budget()
     shuffle = ExternalKeyShuffle(tmpdir, n_buckets)
     rows_in = 0
     try:
         for side, cust, item in chunks:
-            shuffle.append(side, bucket_of_pairs(cust, item, n_buckets),
-                           (cust, item))
+            ids = bucket_of_pairs(cust, item, n_buckets)
             rows_in += len(cust)
+            if bucket_owner is not None:
+                # spool ONLY owned buckets: (nprocs-1)/nprocs of the
+                # shuffle disk IO is someone else's and never read here
+                mine = (ids % bucket_owner[1]) == bucket_owner[0]
+                if not mine.any():
+                    continue
+                ids, cust, item = ids[mine], cust[mine], item[mine]
+            shuffle.append(side, ids, (cust, item))
 
         dp = mesh.shape[DATA_AXIS]
         # ONE capacity for every bucket piece -> one compiled step reused
@@ -283,6 +303,9 @@ def run_streaming_q97(
 
         with task_context(budget.gov, task_id):
             for b in range(n_buckets):
+                if bucket_owner is not None and \
+                        b % bucket_owner[1] != bucket_owner[0]:
+                    continue
                 if piece_rows(b) == 0:
                     continue
                 if host_budget is not None:

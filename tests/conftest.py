@@ -37,3 +37,20 @@ if os.environ.get("SRT_JAX_CACHE") == "1":
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def scrubbed_cpu_env(device_count: int = 8) -> dict:
+    """Env for subprocess workers pinned to a virtual CPU mesh: strips the
+    axon TPU tunnel vars (a dead tunnel hangs `import jax` otherwise) and
+    suppresses the boot_cpu_mesh re-exec.  Single source of truth for
+    every multi-process test (the scrub recipe must not drift apart)."""
+    import os as _os
+
+    env = dict(_os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    for k in [k for k in env if k.startswith("TPU_")]:
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={device_count}"
+    env["SRT_REEXECED"] = "1"
+    return env

@@ -1,0 +1,53 @@
+"""Worker for the two-process bucket-ownership streaming test (not a
+test module).
+
+Each OS process is one 'host group' of the pod-scale plan: it streams
+the SAME deterministic chunk stream, grace-hashes it to disk, and
+executes ONLY the buckets it owns (``b % nprocs == proc_id``) on its own
+CPU mesh.  Prints one JSON line with its partial counts; the parent sums
+the owners' partials and checks the global oracle — per-owner counts are
+additive because a pair lands in exactly one bucket.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    proc_id, nprocs = int(sys.argv[1]), int(sys.argv[2])
+    sf, chunk_rows, buckets = (float(sys.argv[3]), int(sys.argv[4]),
+                               int(sys.argv[5]))
+
+    import jax
+
+    from spark_rapids_jni_tpu.mem import BudgetedResource, MemoryGovernor
+    from spark_rapids_jni_tpu.models.streaming import (
+        generate_q97_chunks,
+        run_streaming_q97,
+    )
+    from spark_rapids_jni_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh((len(jax.devices()), 1))
+    gov = MemoryGovernor.initialize()
+    try:
+        budget = BudgetedResource(gov, 1 << 30)
+        host_budget = BudgetedResource(gov, 1 << 28, is_cpu=True)
+        with tempfile.TemporaryDirectory(prefix=f"owner{proc_id}_") as td:
+            counts, _v, stats = run_streaming_q97(
+                mesh, generate_q97_chunks(sf, seed=13, chunk_rows=chunk_rows),
+                tmpdir=td, n_buckets=buckets, budget=budget,
+                host_budget=host_budget, task_id=1,
+                bucket_owner=(proc_id, nprocs))
+    finally:
+        MemoryGovernor.shutdown()
+    print(json.dumps({"proc": proc_id, "counts": list(counts),
+                      "rows_in": stats["rows_in"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

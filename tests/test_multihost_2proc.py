@@ -43,13 +43,9 @@ def test_two_process_group_runs_distributed_q97():
 
 
 def _run_group_once():
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    for k in [k for k in env if k.startswith("TPU_")]:
-        env.pop(k, None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-    env["SRT_REEXECED"] = "1"  # boot_cpu_mesh must not re-exec the workers
+    from conftest import scrubbed_cpu_env
+
+    env = scrubbed_cpu_env(2)  # boot_cpu_mesh must not re-exec the workers
 
     coord = f"127.0.0.1:{_free_port()}"
     worker = os.path.join(_HERE, "multihost_worker.py")

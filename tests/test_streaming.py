@@ -258,3 +258,49 @@ def test_split_bucket_disk_refinement(tmp_path):
             got |= set(zip(cust_b.tolist(), item_b.tolist()))
         assert got == sent[side], "split must move rows, never lose them"
     shuffle.close()
+
+
+@pytest.mark.slow
+def test_bucket_ownership_partitions_across_processes():
+    """The pod-scale deployment shape: two OS processes ('host groups')
+    each execute only the buckets they OWN over the same chunk stream;
+    the sum of their partials equals the global q97 answer."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    from spark_rapids_jni_tpu.models.q97 import q97_host_oracle
+
+    sf, chunk_rows, buckets = 0.002, 2000, 8
+    chunks = list(generate_q97_chunks(sf, seed=13, chunk_rows=chunk_rows))
+    store = (np.concatenate([c for s, c, _ in chunks if s == "store"]),
+             np.concatenate([i for s, _, i in chunks if s == "store"]))
+    cat = (np.concatenate([c for s, c, _ in chunks if s == "catalog"]),
+           np.concatenate([i for s, _, i in chunks if s == "catalog"]))
+    want = q97_host_oracle(store, cat)
+
+    from conftest import scrubbed_cpu_env
+
+    env = scrubbed_cpu_env(8)
+
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "streaming_worker.py")
+    totals = [0, 0, 0]
+    rows_seen = set()
+    # sequential on the 1-core box: the contract under test is the
+    # bucket-space partitioning, not wall-clock parallelism
+    for pid in (0, 1):
+        r = subprocess.run(
+            [sys.executable, worker, str(pid), "2", str(sf),
+             str(chunk_rows), str(buckets)],
+            env=env, capture_output=True, text=True, timeout=600)
+        assert r.returncode == 0, r.stderr[-1500:]
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+        assert out["proc"] == pid
+        rows_seen.add(out["rows_in"])
+        for i in range(3):
+            totals[i] += out["counts"][i]
+    assert tuple(totals) == want, (totals, want)
+    # each owner saw the full stream but executed only its buckets
+    assert rows_seen == {len(store[0]) + len(cat[0])}
