@@ -10,7 +10,7 @@ separates the candidate causes so BENCH_r03's analysis is grounded:
 
 Run on the real chip (prints one JSON line per experiment):
 
-    python tools/perf_probe.py [--op murmur3|xxhash64|copy] [--iters 50]
+    python tools/perf_probe.py [--op murmur3|xxhash64|copy|partition_murmur3|partition_mix32] [--iters 50]
 """
 
 from __future__ import annotations
@@ -35,7 +35,8 @@ def _time(fn, iters, *args):
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--op", default="murmur3",
-                    choices=("murmur3", "xxhash64", "copy"))
+                    choices=("murmur3", "xxhash64", "copy",
+                             "partition_murmur3", "partition_mix32"))
     ap.add_argument("--iters", type=int, default=50)
     ap.add_argument("--max-log2", type=int, default=26)
     args = ap.parse_args(argv)
@@ -53,17 +54,34 @@ def main(argv=None) -> int:
 
     from spark_rapids_jni_tpu.columnar import Column, INT32
     from spark_rapids_jni_tpu.ops import murmur_hash32, xxhash64
+    from spark_rapids_jni_tpu.ops.hashing import (
+        murmur3_raw_int64,
+        partition_mix32,
+    )
 
     rng = np.random.RandomState(7)
     results = []
     for log2 in range(18, args.max_log2 + 1, 2):
         n = 1 << log2
-        data = jnp.asarray(rng.randint(-(2**31), 2**31, n).astype(np.int32))
+        if args.op in ("partition_murmur3", "partition_mix32"):
+            data = jnp.asarray(
+                rng.randint(-(2**62), 2**62, n, dtype=np.int64))
+        else:
+            data = jnp.asarray(
+                rng.randint(-(2**31), 2**31, n).astype(np.int32))
 
         if args.op == "murmur3":
             fn = jax.jit(lambda d: murmur_hash32(
                 [Column(d, None, INT32)], seed=42).data)
             bytes_per_row = 8
+        elif args.op in ("partition_murmur3", "partition_mix32"):
+            # the placement-hash A/B at probe granularity: int64 keys ->
+            # int32 partitions (the partition_hash flag decision data)
+            raw = (murmur3_raw_int64 if args.op == "partition_murmur3"
+                   else partition_mix32)
+            fn = jax.jit(
+                lambda d: (raw(d) % jnp.uint32(8)).astype(jnp.int32))
+            bytes_per_row = 12
         elif args.op == "xxhash64":
             fn = jax.jit(lambda d: xxhash64(
                 [Column(d, None, INT32)], seed=42).data)
@@ -72,7 +90,16 @@ def main(argv=None) -> int:
             fn = jax.jit(lambda d: d + 1)
             bytes_per_row = 8
 
-        dt = _time(fn, args.iters, data)
+        if args.op in ("partition_murmur3", "partition_mix32"):
+            # pin the murmur leg to XLA so the A/B compares the two MIXES,
+            # not XLA-vs-whatever SRT_HASH_BACKEND selects (bench.py does
+            # the same for its partition stages)
+            from spark_rapids_jni_tpu import config
+
+            with config.override(hash_backend="xla"):
+                dt = _time(fn, args.iters, data)
+        else:
+            dt = _time(fn, args.iters, data)
         results.append({
             "n_log2": log2,
             "rows_per_s": round(n / dt, 0),
