@@ -183,6 +183,9 @@ def _replay_capture(reason: str):
         # provenance must survive consumers that drop unknown keys
         out["unit"] = f"{out.get('unit') or 'Grows/s'} (replayed)"
         detail = dict(bench_rec.get("detail") or {})
+        recs = _recommend(detail)
+        if recs:
+            detail["recommendations"] = recs
         detail["replayed_from_ts"] = bench_rec.get("ts")
         detail["capture_commit"] = bench_rec.get("commit")
         detail["replay_reason"] = why
@@ -215,6 +218,28 @@ def _replay_capture(reason: str):
         "metric": "murmur3_32_int32_throughput", "value": None,
         "unit": "Grows/s", "vs_baseline": None, "detail": detail,
     }
+
+
+def _recommend(detail: dict) -> dict:
+    """Measured A/B winners -> config-flag recommendations (>=5% margin
+    to flip away from a default; ties keep it).  Read by whoever consumes
+    BENCH_r*.json / banked captures: the r3 verdict's 'flip the default
+    to the measured winner' step, made explicit in the output."""
+    recs = {}
+
+    def rate(stage):
+        v = detail.get(stage)
+        return v.get("Grows_per_s") if isinstance(v, dict) else None
+
+    # `is not None`: a measured 0.0 (catastrophically slow backend) is
+    # the clearest possible verdict, not a missing stage
+    mm_x, mm_p = rate("murmur3_int32"), rate("murmur3_int32_pallas")
+    if mm_x is not None and mm_p is not None:
+        recs["hash_backend"] = "pallas" if mm_p > 1.05 * mm_x else "xla"
+    pm, px = rate("partition_murmur3"), rate("partition_mix32")
+    if pm is not None and px is not None:
+        recs["partition_hash"] = "mix32" if px > 1.05 * pm else "murmur3"
+    return recs
 
 
 def main():
@@ -529,6 +554,10 @@ def main():
 
     gov.task_done(0)
     MemoryGovernor.shutdown()
+
+    recs = _recommend(detail)
+    if recs:
+        detail["recommendations"] = recs
 
     measured = mm_rows_s > 0
     print(json.dumps({

@@ -99,3 +99,51 @@ def test_same_code_path_filter():
     pn = bench._PERF_NEUTRAL
     assert all(any(p.startswith(x) for x in pn) for p in neutral)
     assert not any(any(p.startswith(x) for x in pn) for p in hot)
+
+
+def test_recommendations_from_ab_stages():
+    """bench._recommend flips a flag only on a >=5% measured win and
+    stays silent when a stage is missing or errored."""
+    import bench
+
+    assert bench._recommend({}) == {}
+    assert bench._recommend({
+        "murmur3_int32": {"Grows_per_s": 10.0},
+        "murmur3_int32_pallas": {"Grows_per_s": 11.0},
+        "partition_murmur3": {"Grows_per_s": 2.0},
+        "partition_mix32": {"Grows_per_s": 2.05},
+    }) == {"hash_backend": "pallas", "partition_hash": "murmur3"}
+    # errored stage (no rate key) contributes nothing
+    assert bench._recommend({
+        "murmur3_int32": {"Grows_per_s": 10.0},
+        "murmur3_int32_pallas": {"error": "compile timeout"},
+        "partition_murmur3": {"Grows_per_s": 2.0},
+        "partition_mix32": {"Grows_per_s": 3.0},
+    }) == {"partition_hash": "mix32"}
+
+
+def test_recommendation_zero_rate_and_replay(tmp_path, monkeypatch, capsys):
+    """A measured 0.0 is a verdict, not a missing stage; and replayed
+    bench results carry recommendations derived from the banked detail."""
+    import json
+
+    import bench
+
+    assert bench._recommend({
+        "murmur3_int32": {"Grows_per_s": 10.0},
+        "murmur3_int32_pallas": {"Grows_per_s": 0.0},
+    }) == {"hash_backend": "xla"}
+
+    cap = tmp_path / "cap.jsonl"
+    head = bench._git_head()
+    cap.write_text(json.dumps({
+        "stage": "bench", "metric": "murmur3_32_int32_throughput",
+        "value": 9.9, "unit": "Grows/s", "vs_baseline": 9.9,
+        "commit": head, "ts": 1.0,
+        "detail": {"murmur3_int32": {"Grows_per_s": 9.9},
+                   "murmur3_int32_pallas": {"Grows_per_s": 12.0}},
+    }) + "\n")
+    monkeypatch.setattr(bench, "PERF_CAPTURE_PATH", str(cap))
+    r = bench._replay_capture("test")
+    assert r["replayed"] is True
+    assert r["detail"]["recommendations"] == {"hash_backend": "pallas"}
