@@ -159,8 +159,12 @@ def _q3_step_cached(mesh, geo_items: tuple):
 
 
 def _pad_facts(facts: dict, dp: int) -> dict:
+    """dp-aligned pow2-quantized padding (bounded compile variants);
+    pad rows carry False validity."""
+    from spark_rapids_jni_tpu.parallel.shuffle import quantized_rows
+
     n = len(facts["ss_item"])
-    pad = (-n) % dp
+    pad = quantized_rows(n, dp) - n
     if pad == 0:
         return facts
     out = {k: np.concatenate([v, np.zeros(pad, v.dtype)])
@@ -170,13 +174,18 @@ def _pad_facts(facts: dict, dp: int) -> dict:
     return out
 
 
-def q3_working_set_bytes(facts_or_data) -> int:
+def q3_working_set_bytes(facts_or_data, dp: int = 1) -> int:
     """Reserved bytes for one governed q3 attempt over the given facts
     (inputs + masks/buckets + partials headroom) — the single source of
-    truth for run_distributed_q3's admission and for tests sizing budgets."""
+    truth for run_distributed_q3's admission and for tests sizing
+    budgets.  With ``dp``, row counts are the quantized (padded) lengths
+    run() actually uploads."""
+    from spark_rapids_jni_tpu.parallel.shuffle import quantized_rows
+
     facts = (facts_or_data if isinstance(facts_or_data, dict)
              else _facts(facts_or_data))
-    return sum(v.nbytes for v in facts.values()) * 3
+    return sum(quantized_rows(len(v), dp) * v.itemsize
+               for v in facts.values()) * 3
 
 
 def _split_facts(facts: dict):
@@ -207,7 +216,8 @@ def run_distributed_q3(mesh, data: Q3Data, *, budget=None, task_id: int = 0,
     rep = NamedSharding(mesh, P())
     dims = {k: jax.device_put(v, rep) for k, v in _dims(data).items()}
 
-    nbytes_of = q3_working_set_bytes
+    def nbytes_of(f):
+        return q3_working_set_bytes(f, dp)
 
     def run(facts):
         from spark_rapids_jni_tpu.obs.seam import COLLECTIVE, TRANSFER, seam

@@ -221,13 +221,16 @@ def _q5_step_cached(mesh, n_dims: tuple, lo: int, hi: int):
 
 
 def _pad_channel(facts: Dict[str, np.ndarray], dp: int) -> Dict[str, np.ndarray]:
-    """Pad fact arrays to a dp multiple; pad rows get invalid keys, so they
-    drop out of the joins like any null-keyed row."""
+    """Pad fact arrays to the dp-aligned pow2-quantized length (bounded
+    compile variants, parallel.shuffle.quantized_rows); pad rows get
+    invalid keys, so they drop out of the joins like any null-keyed row."""
+    from spark_rapids_jni_tpu.parallel.shuffle import quantized_rows
+
     out = {}
     n_s = len(facts["sales_sk"])
     n_r = len(facts["ret_sk"])
-    pad_s = (-n_s) % dp
-    pad_r = (-n_r) % dp
+    pad_s = quantized_rows(n_s, dp) - n_s
+    pad_r = quantized_rows(n_r, dp) - n_r
     for k, v in facts.items():
         pad = pad_s if k.startswith("sales") else pad_r
         if pad == 0:
@@ -283,7 +286,11 @@ def run_distributed_q5(mesh, data: Q5Data, *, budget=None, task_id: int = 0,
     batch = {n: _facts_of(data.channels[n]) for n in CHANNELS}
 
     def nbytes_of(b):
-        total = sum(v.nbytes for ch in b.values() for v in ch.values())
+        # quantized (padded) lengths: what run() actually uploads
+        from spark_rapids_jni_tpu.parallel.shuffle import quantized_rows
+
+        total = sum(quantized_rows(len(v), dp) * v.itemsize
+                    for ch in b.values() for v in ch.values())
         return total * 3  # inputs + masks/buckets + partials
 
     def run(b):

@@ -368,8 +368,13 @@ def split_q97_batch(batch: Q97Batch):
 
 def q97_working_set_bytes(batch: Q97Batch, dp: int) -> int:
     """Global working-set estimate: inputs + key/tag/valid stream + the
-    [dp, capacity] send/recv exchange buffers + sort-merge workspace."""
-    n = batch.rows
+    [dp, capacity] send/recv exchange buffers + sort-merge workspace.
+    Row terms use the QUANTIZED (padded) lengths run() actually uploads,
+    so admission covers the real device footprint."""
+    from spark_rapids_jni_tpu.parallel.shuffle import quantized_rows
+
+    n = (quantized_rows(len(batch.s_cust), dp)
+         + quantized_rows(len(batch.c_cust), dp))
     per_row = 8 + 1 + 1  # key int64 + tag int8 + row_valid bool
     slots = dp * dp * batch.capacity
     return n * (8 + per_row) + 2 * slots * per_row + 2 * slots * 10
@@ -387,7 +392,12 @@ def _q97_step_cached(mesh, capacity: int):
 
 
 def _pad_to_multiple(arr: np.ndarray, mult: int, fill=0):
-    pad = (-len(arr)) % mult
+    """Pad to the dp-aligned POW2-QUANTIZED batch length (bounded compile
+    variants — see parallel.shuffle.quantized_rows); pad rows are
+    validity-masked out."""
+    from spark_rapids_jni_tpu.parallel.shuffle import quantized_rows
+
+    pad = quantized_rows(len(arr), mult) - len(arr)
     if pad == 0:
         return arr, np.ones(len(arr), bool)
     padded = np.concatenate([arr, np.full(pad, fill, dtype=arr.dtype)])
@@ -396,9 +406,15 @@ def _pad_to_multiple(arr: np.ndarray, mult: int, fill=0):
 
 
 def default_q97_capacity(total_rows: int, dp: int) -> int:
-    """Safe-ish default per-(sender,dest) bucket bound: uniform share with a
-    2x skew margin (overflow is recoverable via the grow retry)."""
-    return max(16, int(2 * total_rows / (dp * dp)) if dp > 1 else total_rows)
+    """Safe-ish default per-(sender,dest) bucket bound: uniform share with
+    a 2x skew margin (overflow is recoverable via the grow retry),
+    pow2-rounded so data-dependent totals reuse one compiled step
+    (capacity is a static shape parameter — the streamed-soak compiler
+    OOM came from one executable per distinct capacity)."""
+    from spark_rapids_jni_tpu.columnar.column import next_pow2
+
+    raw = max(16, int(2 * total_rows / (dp * dp)) if dp > 1 else total_rows)
+    return next_pow2(raw)
 
 
 def run_distributed_q97(
@@ -448,16 +464,12 @@ def run_distributed_q97(
     def run(piece: Q97Batch) -> Q97Out:
         from spark_rapids_jni_tpu.obs.seam import COLLECTIVE, TRANSFER, seam
 
+        # _pad_to_multiple quantizes to >= dp rows, so empty inputs come
+        # back as dp all-invalid rows — no empty-array special case
         sc, sv = _pad_to_multiple(piece.s_cust, dp)
         si, _ = _pad_to_multiple(piece.s_item, dp)
         cc, cv = _pad_to_multiple(piece.c_cust, dp)
         ci, _ = _pad_to_multiple(piece.c_item, dp)
-        if len(sc) == 0:
-            sc, sv = np.zeros(dp, np.int32), np.zeros(dp, bool)
-            si = np.zeros(dp, np.int32)
-        if len(cc) == 0:
-            cc, cv = np.zeros(dp, np.int32), np.zeros(dp, bool)
-            ci = np.zeros(dp, np.int32)
         step = _q97_step_cached(mesh, piece.capacity)
         with seam(TRANSFER, "q97_batch_upload"):
             args = [jax.device_put(a, sharding)

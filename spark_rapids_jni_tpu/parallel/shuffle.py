@@ -57,6 +57,21 @@ def partition_of(keys: jnp.ndarray, n_parts: int) -> jnp.ndarray:
     return (h % jnp.uint32(n_parts)).astype(jnp.int32)
 
 
+def quantized_rows(n: int, mult: int) -> int:
+    """Batch length that is a ``mult`` multiple AND pow2-quantized:
+    ``mult * next_pow2(ceil(n / mult))`` (min one block).
+
+    Data-dependent exact batch lengths compile one executable per
+    distinct value, which a long-lived executor accumulates until the
+    compiler OOMs (the streamed-soak LLVM allocation failure after ~500
+    out-of-core runs); quantizing bounds the variant set to
+    O(log max_rows) per geometry.  Padding rows are validity-masked by
+    the callers, so more padding never changes results."""
+    from spark_rapids_jni_tpu.columnar.column import next_pow2
+
+    return mult * next_pow2(max(1, -(-int(n) // mult)))
+
+
 def bucket_by_partition(part: jnp.ndarray, n_parts: int, capacity: int):
     """Assign each local row a slot in a [n_parts, capacity] send layout.
 
