@@ -6,9 +6,10 @@ the classic external hash shuffle the reference relies on Spark for:
 
 - facts are *generated/ingested in chunks* (bounded host memory),
 - each chunk's rows are routed to a key-space bucket by a stable hash of
-  the join key and appended to that bucket's spill file (columnar raw
-  bytes, append-only — the host analog of parallel/table_shuffle.py's
-  device exchange),
+  the join key and appended to that bucket's spill file — JCUDF row
+  batches carrying the FULL table (validity, strings, decimal128) through
+  io/spill.py's ExternalTableShuffle, the host analog of
+  parallel/table_shuffle.py's device exchange,
 - each bucket then fits in memory by construction (total/n_buckets) and
   is executed as one governed distributed query piece; per-bucket results
   are additive because a (customer, item) pair lands in exactly one
@@ -18,161 +19,53 @@ On a pod the same plan maps bucket -> host group and spill file ->
 ICI/DCN all_to_all (parallel/table_shuffle.py); here the seam between
 "route rows" and "execute bucket" is identical, just disk-backed.
 Parity: the reference delegates exactly this to Spark's external shuffle
-(RapidsShuffleManager); q97 itself is
-src/main/java: same join-count semantics as models/q97.py.
+(RapidsShuffleManager) carrying its JCUDF row batches
+(row_conversion.cu:574); q97 itself is src/main/java: same join-count
+semantics as models/q97.py.
 """
 
 from __future__ import annotations
 
-import os
 from typing import Dict, Iterable, Iterator, Optional, Tuple
 
 import numpy as np
 
+from spark_rapids_jni_tpu.io.spill import ExternalTableShuffle, pair_mix64
+
 __all__ = [
-    "ExternalKeyShuffle",
+    "ExternalTableShuffle",
     "generate_q97_chunks",
     "run_streaming_q97",
     "bucket_of_pairs",
+    "q97_spill_shuffle",
 ]
 
 
 def bucket_of_pairs(cust: np.ndarray, item: np.ndarray,
                     n_buckets: int) -> np.ndarray:
     """Stable key-space bucket of (customer, item) int32 pairs: splitmix64
-    finalizer over the packed pair.  Any fixed mix works — both sides must
-    agree, nothing else — but it must be *well mixed*: TPC-DS surrogate
-    keys are dense integers, and `pair % n` would put all of one customer
-    in one bucket."""
-    with np.errstate(over="ignore"):
-        k = ((cust.astype(np.int64).astype(np.uint64) << np.uint64(32))
-             | (item.astype(np.int64).astype(np.uint64) & np.uint64(0xFFFFFFFF)))
-        k = (k ^ (k >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
-        k = (k ^ (k >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
-        k = k ^ (k >> np.uint64(31))
-        return (k % np.uint64(n_buckets)).astype(np.int64)
+    finalizer over the packed pair (io/spill.py pair_mix64).  Any fixed mix
+    works — both sides must agree, nothing else — but it must be *well
+    mixed*: TPC-DS surrogate keys are dense integers, and ``pair % n``
+    would put all of one customer in one bucket."""
+    return (pair_mix64(cust, item) % np.uint64(n_buckets)).astype(np.int64)
 
 
-class ExternalKeyShuffle:
-    """Disk-backed key-space partitioner for columnar int32 row chunks.
+def _pair_key_hash(cols) -> np.ndarray:
+    """ExternalTableShuffle key hash for the q97 (cust, item) int32 pair —
+    identical mix to :func:`bucket_of_pairs`, so bucket placement agrees
+    with the ownership filter and the legacy tests' expectations."""
+    return pair_mix64(np.asarray(cols[0].data), np.asarray(cols[1].data))
 
-    ``append(side, bucket_ids, cols)`` routes a chunk's rows to per-
-    (side, bucket) spill files (raw little-endian int32, append-only);
-    ``read(side, bucket)`` materializes one bucket.  Peak host memory is
-    one chunk during routing plus one bucket during execution.
-    """
 
-    def __init__(self, tmpdir: str, n_buckets: int,
-                 columns: Tuple[str, ...] = ("cust", "item")):
-        self.dir = tmpdir
-        self.n_buckets = n_buckets
-        self.columns = columns
-        self.rows: Dict[Tuple[str, int], int] = {}
-        # per-bucket hash modulus: initial buckets live at n_buckets;
-        # split_bucket refines b -> (b, b+M) at modulus 2M (hash % M == b
-        # implies hash % 2M in {b, b+M}, so refinement is consistent
-        # across both sides — recursive grace hash)
-        self._modulus: Dict[int, int] = {}
-        os.makedirs(tmpdir, exist_ok=True)
+def q97_spill_shuffle(tmpdir: str, n_buckets: int) -> ExternalTableShuffle:
+    """The q97 fact-pair spill shuffle: two non-null int32 key columns in
+    JCUDF rows, routed by the pair hash."""
+    from spark_rapids_jni_tpu.columnar.dtypes import INT32
 
-    def _path(self, side: str, bucket: int, col: str) -> str:
-        return os.path.join(self.dir, f"{side}.{bucket:04d}.{col}.bin")
-
-    def append(self, side: str, bucket_ids: np.ndarray,
-               cols: Tuple[np.ndarray, ...]) -> None:
-        order = np.argsort(bucket_ids, kind="stable")
-        sorted_ids = bucket_ids[order]
-        # one contiguous slice per bucket present in the chunk
-        uniq, starts = np.unique(sorted_ids, return_index=True)
-        ends = np.append(starts[1:], len(sorted_ids))
-        for b, s, e in zip(uniq.tolist(), starts.tolist(), ends.tolist()):
-            for name, col in zip(self.columns, cols):
-                with open(self._path(side, b, name), "ab") as f:
-                    f.write(np.ascontiguousarray(
-                        col[order[s:e]], dtype=np.int32).tobytes())
-            key = (side, int(b))
-            self.rows[key] = self.rows.get(key, 0) + int(e - s)
-
-    def read(self, side: str, bucket: int) -> Tuple[np.ndarray, ...]:
-        out = []
-        for name in self.columns:
-            path = self._path(side, bucket, name)
-            if os.path.exists(path):
-                with open(path, "rb") as f:
-                    out.append(np.frombuffer(f.read(), np.int32))
-            else:
-                out.append(np.zeros((0,), np.int32))
-        return tuple(out)
-
-    def split_bucket(self, bucket: int,
-                     chunk_rows: int = 1 << 18) -> Tuple[int, int]:
-        """Refine one bucket into two on DISK with bounded memory.
-
-        Rows whose pair hash lands on ``bucket`` at modulus ``2M`` stay;
-        the rest move to bucket ``bucket + M`` (files streamed in
-        ``chunk_rows`` chunks — never the whole bucket in memory).  The
-        recursive-grace-hash rung: a bucket that cannot fit the host
-        budget splits into two that can, and per-bucket q97 counts stay
-        additive because the refinement is key-space consistent.
-        """
-        m = self._modulus.get(bucket, self.n_buckets)
-        new_bucket = bucket + m
-        for side in ("store", "catalog"):
-            if (side, bucket) not in self.rows:
-                continue
-            readers = [open(self._path(side, bucket, c), "rb")
-                       for c in self.columns]
-            keep_paths = [self._path(side, bucket, c) + ".keep"
-                          for c in self.columns]
-            keeps = [open(p, "wb") for p in keep_paths]
-            moved = 0
-            kept = 0
-            try:
-                while True:
-                    chunk = [np.frombuffer(r.read(chunk_rows * 4), np.int32)
-                             for r in readers]
-                    if not len(chunk[0]):
-                        break
-                    stay = bucket_of_pairs(chunk[0], chunk[1],
-                                           2 * m) == bucket
-                    for col, arr, keep in zip(self.columns, chunk, keeps):
-                        keep.write(np.ascontiguousarray(
-                            arr[stay], np.int32).tobytes())
-                        with open(self._path(side, new_bucket, col),
-                                  "ab") as mv:
-                            mv.write(np.ascontiguousarray(
-                                arr[~stay], np.int32).tobytes())
-                    kept += int(stay.sum())
-                    moved += int((~stay).sum())
-            finally:
-                for f in readers + keeps:
-                    f.close()
-            for col, keep_path in zip(self.columns, keep_paths):
-                os.replace(keep_path, self._path(side, bucket, col))
-            self.rows[(side, bucket)] = kept
-            if moved:
-                self.rows[(side, new_bucket)] = (
-                    self.rows.get((side, new_bucket), 0) + moved)
-        self._modulus[bucket] = 2 * m
-        self._modulus[new_bucket] = 2 * m
-        return bucket, new_bucket
-
-    def max_bucket_rows(self) -> int:
-        """Largest combined (store+catalog) bucket — sizes the shuffle
-        capacity once so every bucket reuses ONE compiled step."""
-        per_bucket: Dict[int, int] = {}
-        for (_side, b), n in self.rows.items():
-            per_bucket[b] = per_bucket.get(b, 0) + n
-        return max(per_bucket.values(), default=0)
-
-    def close(self) -> None:
-        for (side, b) in list(self.rows):
-            for name in self.columns:
-                try:
-                    os.remove(self._path(side, b, name))
-                except OSError:
-                    pass
-        self.rows.clear()
+    return ExternalTableShuffle(
+        tmpdir, n_buckets, [INT32, INT32], key_indices=(0, 1),
+        key_hash=_pair_key_hash)
 
 
 def generate_q97_chunks(sf: float, seed: int, chunk_rows: int
@@ -219,19 +112,22 @@ def run_streaming_q97(
     oracle's working set is also bounded by the bucket size.
 
     ``host_budget`` (a ``BudgetedResource(..., is_cpu=True)``) governs the
-    HOST-side bucket materialization: each bucket's row bytes are reserved
-    through the arbiter's CPU path before the bucket is read back, so a
-    multi-tenant host blocks/wakes on pinned-host pressure exactly like
-    device pressure (the reference governs CPU allocations through the
-    same state machine — SparkResourceAdaptorJni.cpp is_for_cpu paths).
+    HOST-side bucket materialization: each bucket's ACTUAL spill-file bytes
+    are reserved through the arbiter's CPU path before the bucket is read
+    back, so a multi-tenant host blocks/wakes on pinned-host pressure
+    exactly like device pressure (the reference governs CPU allocations
+    through the same state machine — SparkResourceAdaptorJni.cpp is_for_cpu
+    paths).
 
     ``bucket_owner=(proc_id, nprocs)`` restricts execution to the buckets
     this participant OWNS (``b % nprocs == proc_id``) — the pod-scale
     deployment shape: host groups partition the bucket space, per-owner
     counts stay additive, and the global answer is the sum of the owners'
-    results (tests/streaming_worker.py drives this across two real OS
+    results (tests/streaming_worker.py drives this across real OS
     processes).
     """
+    from spark_rapids_jni_tpu.columnar.column import Column
+    from spark_rapids_jni_tpu.columnar.dtypes import INT32
     from spark_rapids_jni_tpu.mem.governed import (
         default_device_budget,
         run_with_split_retry,
@@ -250,29 +146,39 @@ def run_streaming_q97(
                              "0 <= proc_id < nprocs")
     if budget is None:
         budget = default_device_budget()
-    shuffle = ExternalKeyShuffle(tmpdir, n_buckets)
+    shuffle = q97_spill_shuffle(tmpdir, n_buckets)
     rows_in = 0
     try:
         for side, cust, item in chunks:
-            ids = bucket_of_pairs(cust, item, n_buckets)
             rows_in += len(cust)
+            hashes = pair_mix64(cust, item)
             if bucket_owner is not None:
                 # spool ONLY owned buckets: (nprocs-1)/nprocs of the
                 # shuffle disk IO is someone else's and never read here
+                ids = (hashes % np.uint64(n_buckets)).astype(np.int64)
                 mine = (ids % bucket_owner[1]) == bucket_owner[0]
                 if not mine.any():
                     continue
-                ids, cust, item = ids[mine], cust[mine], item[mine]
-            shuffle.append(side, ids, (cust, item))
+                cust, item, hashes = cust[mine], item[mine], hashes[mine]
+            shuffle.append(
+                side,
+                [Column(cust, None, INT32), Column(item, None, INT32)],
+                hashes=hashes)
 
         dp = mesh.shape[DATA_AXIS]
         # ONE capacity for every bucket piece -> one compiled step reused
         cap = default_q97_capacity(shuffle.max_bucket_rows(), dp)
         totals = [0, 0, 0]
         verified: Optional[bool] = True if verify else None
+
+        def read_pair(side: str, b: int):
+            cols = shuffle.read(side, b)
+            return (np.asarray(cols[0].data, np.int32),
+                    np.asarray(cols[1].data, np.int32))
+
         def run_bucket(b: int):
-            store_b = shuffle.read("store", b)
-            cat_b = shuffle.read("catalog", b)
+            store_b = read_pair("store", b)
+            cat_b = read_pair("catalog", b)
             out = run_distributed_q97(
                 mesh, store_b, cat_b, budget=budget, task_id=task_id,
                 capacity=cap, manage_task=False)
@@ -283,10 +189,6 @@ def run_streaming_q97(
                 c = set(zip(cat_b[0].tolist(), cat_b[1].tolist()))
                 oracle_ok = got == (len(s - c), len(c - s), len(s & c))
             return got, oracle_ok
-
-        def piece_rows(b: int) -> int:
-            return (shuffle.rows.get(("store", b), 0)
-                    + shuffle.rows.get(("catalog", b), 0))
 
         n_splits = [0]
 
@@ -306,16 +208,17 @@ def run_streaming_q97(
                 if bucket_owner is not None and \
                         b % bucket_owner[1] != bucket_owner[0]:
                     continue
-                if piece_rows(b) == 0:
+                if shuffle.bucket_rows(b) == 0:
                     continue
                 if host_budget is not None:
                     # the canonical retry driver brackets the host
-                    # reservation: RetryOOM from multi-tenant pressure
-                    # re-runs the bucket; an over-budget bucket splits on
-                    # disk instead of crashing the stream
+                    # reservation — sized by the bucket's ACTUAL spill-file
+                    # bytes: RetryOOM from multi-tenant pressure re-runs
+                    # the bucket; an over-budget bucket splits on disk
+                    # instead of crashing the stream
                     got, oracle_ok = run_with_split_retry(
                         host_budget, b,
-                        nbytes_of=lambda bb: piece_rows(bb) * 8,  # 2x i32
+                        nbytes_of=shuffle.bucket_nbytes,
                         run=run_bucket,
                         split=split_piece,
                         combine=combine_pieces,

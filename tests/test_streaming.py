@@ -9,12 +9,23 @@ extends BASELINE config 5 toward SF100.
 import numpy as np
 import pytest
 
+from spark_rapids_jni_tpu.columnar import INT32, Column
 from spark_rapids_jni_tpu.models.streaming import (
-    ExternalKeyShuffle,
     bucket_of_pairs,
     generate_q97_chunks,
+    q97_spill_shuffle,
     run_streaming_q97,
 )
+
+
+def _pair_cols(cust, item):
+    return [Column(cust, None, INT32), Column(item, None, INT32)]
+
+
+def _read_pair(shuffle, side, b):
+    cols = shuffle.read(side, b)
+    return (np.asarray(cols[0].data, np.int32),
+            np.asarray(cols[1].data, np.int32))
 
 
 def test_bucket_hash_stable_and_spread():
@@ -37,14 +48,14 @@ def test_bucket_hash_stable_and_spread():
 
 
 def test_external_shuffle_roundtrip(tmp_path):
-    shuffle = ExternalKeyShuffle(str(tmp_path), n_buckets=8)
+    shuffle = q97_spill_shuffle(str(tmp_path), 8)
     rng = np.random.RandomState(1)
     all_rows = {"store": [], "catalog": []}
     for _ in range(5):  # five chunks per side
         for side in ("store", "catalog"):
             cust = rng.randint(1, 400, 1000).astype(np.int32)
             item = rng.randint(1, 300, 1000).astype(np.int32)
-            shuffle.append(side, bucket_of_pairs(cust, item, 8), (cust, item))
+            shuffle.append(side, _pair_cols(cust, item))
             all_rows[side].append((cust, item))
 
     for side in ("store", "catalog"):
@@ -54,7 +65,7 @@ def test_external_shuffle_roundtrip(tmp_path):
         got = set()
         n_read = 0
         for b in range(8):
-            cust_b, item_b = shuffle.read(side, b)
+            cust_b, item_b = _read_pair(shuffle, side, b)
             assert len(cust_b) == len(item_b)
             n_read += len(cust_b)
             # every row must sit in ITS bucket
@@ -64,7 +75,7 @@ def test_external_shuffle_roundtrip(tmp_path):
         assert got == want
     assert shuffle.max_bucket_rows() > 0
     shuffle.close()
-    assert shuffle.read("store", 0)[0].size == 0
+    assert _read_pair(shuffle, "store", 0)[0].size == 0
 
 
 def test_generate_q97_chunks_bounded_and_complete():
@@ -160,9 +171,11 @@ def test_two_tenants_contend_on_host_budget(tmp_path):
     mesh = make_mesh((len(jax.devices()), 1))
     gov = MemoryGovernor(watchdog_period_s=0.02)
     dev_budget = BudgetedResource(gov, 1 << 30)
-    # ~4 buckets/tenant of ~1000 rows -> ~8000 B/bucket; two concurrent
-    # tenants at 12 KB must sometimes block each other, never deadlock
-    host_budget = BudgetedResource(gov, 12 << 10, is_cpu=True)
+    # ~4 buckets/tenant of ~1400 rows at 16 B/row JCUDF spill -> ~22 KB
+    # per bucket; a 32 KB budget fits ONE bucket but not two, so the
+    # tenants contend by blocking/waking through the state machine —
+    # never by splitting (pinned below) and never deadlocking
+    host_budget = BudgetedResource(gov, 32 << 10, is_cpu=True)
 
     results = {}
 
@@ -192,6 +205,8 @@ def test_two_tenants_contend_on_host_budget(tmp_path):
     for tid, (counts, want, stats) in results.items():
         assert counts == want, f"tenant {tid}"
         assert stats["host_peak_reserved"] > 0
+        assert stats["bucket_splits"] == 0, \
+            "this test covers the pure block/wake path, not splits"
     assert host_budget.used == 0, "host reservations must all be released"
 
 
@@ -215,8 +230,9 @@ def test_oversized_bucket_splits_on_disk(tmp_path):
 
     gov = MemoryGovernor(watchdog_period_s=0.02)
     dev_budget = BudgetedResource(gov, 1 << 30)
-    # 2 buckets over 11200 rows -> ~5600 rows * 8 B ~= 45 KB per bucket;
-    # a 24 KB host budget CANNOT fit one, forcing >=1 disk split each
+    # 2 buckets over 11200 rows -> ~5600 rows * 16 B JCUDF ~= 90 KB per
+    # bucket; a 24 KB host budget forces TWO recursive split levels
+    # (90 -> 45 -> 22.5 KB) before a piece fits
     host_budget = BudgetedResource(gov, 24 << 10, is_cpu=True)
     try:
         counts, verified, stats = run_streaming_q97(
@@ -233,15 +249,15 @@ def test_oversized_bucket_splits_on_disk(tmp_path):
 
 
 def test_split_bucket_disk_refinement(tmp_path):
-    """ExternalKeyShuffle.split_bucket: rows re-partition consistently,
-    nothing lost, both sides agree on placement."""
-    shuffle = ExternalKeyShuffle(str(tmp_path), n_buckets=2)
+    """split_bucket on the q97 pair shuffle: rows re-partition
+    consistently, nothing lost, both sides agree on placement."""
+    shuffle = q97_spill_shuffle(str(tmp_path), 2)
     rng = np.random.RandomState(4)
     sent = {}
     for side in ("store", "catalog"):
         cust = rng.randint(1, 500, 4000).astype(np.int32)
         item = rng.randint(1, 300, 4000).astype(np.int32)
-        shuffle.append(side, bucket_of_pairs(cust, item, 2), (cust, item))
+        shuffle.append(side, _pair_cols(cust, item))
         sent[side] = set(zip(cust.tolist(), item.tolist()))
 
     b0_rows = shuffle.rows[("store", 0)]
@@ -251,7 +267,7 @@ def test_split_bucket_disk_refinement(tmp_path):
     for side in ("store", "catalog"):
         got = set()
         for b in (0, 1, 2):
-            cust_b, item_b = shuffle.read(side, b)
+            cust_b, item_b = _read_pair(shuffle, side, b)
             if b in (0, 2):
                 # refined placement: hash % 4 must equal the bucket id
                 assert np.all(bucket_of_pairs(cust_b, item_b, 4) == b)

@@ -1,0 +1,273 @@
+"""General external table spill (io/spill.py): host JCUDF codec byte-compat
+with the device row conversion, and the disk grace-hash shuffle over FULL
+columnar tables (validity + strings + decimal128), recursive split included.
+
+Parity target: the reference spills/exchanges JCUDF row batches through
+Spark's external shuffle (row_conversion.cu:574, RowConversion.java:44-51);
+here the same wire format backs the disk grace hash.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import columnar as c
+from spark_rapids_jni_tpu.io.spill import (
+    ExternalTableShuffle,
+    chained_key_hash,
+    decode_jcudf_rows,
+    encode_jcudf_rows,
+    pair_mix64,
+    splitmix64,
+)
+
+
+def _rich_table():
+    """One table exercising every spillable shape: nullable ints, strings
+    (empty / multibyte / null), decimal128 (negative, null), bool, float64
+    bit-pattern, float32, int16."""
+    return [
+        c.column([3, None, -7, 2147483647, 0, -1], c.INT32),
+        c.strings_column(["", "héllo", None, "x" * 37, "tail", "píñata"]),
+        c.decimal128_column(
+            [10**30, None, -(10**25) - 7, 0, -1, 42], 38, 4),
+        c.column([True, False, None, True, True, False], c.BOOL),
+        c.column([1.5, -0.0, None, 3.25e300, float("inf"), -2.5],
+                 c.FLOAT64),
+        c.column([1.5, 2.5, -3.5, None, 0.0, 9.0], c.FLOAT32),
+        c.column([None, 2, -3, 4, 5, -32768], c.INT16),
+        c.column([10**17, None, -(10**15), 0, 7, -7], c.INT64),
+    ]
+
+
+def _table_lists(cols):
+    out = []
+    for col in cols:
+        if isinstance(col, c.Decimal128Column):
+            out.append(col.unscaled_to_list())
+        else:
+            out.append(col.to_list())
+    return out
+
+
+def test_host_codec_roundtrip_rich_schema():
+    cols = _rich_table()
+    buf, sizes = encode_jcudf_rows(cols)
+    assert sizes.shape == (6,)
+    assert int(sizes.sum()) == buf.shape[0]
+    assert np.all(sizes % 8 == 0), "rows pad to JCUDF_ROW_ALIGNMENT"
+    offsets = np.zeros(7, np.int64)
+    np.cumsum(sizes, out=offsets[1:])
+    back = decode_jcudf_rows(buf, offsets, [col.dtype for col in cols])
+    assert _table_lists(back) == _table_lists(cols)
+
+
+def test_host_codec_select_decodes_only_keys():
+    cols = _rich_table()
+    buf, sizes = encode_jcudf_rows(cols)
+    offsets = np.zeros(7, np.int64)
+    np.cumsum(sizes, out=offsets[1:])
+    out = decode_jcudf_rows(buf, offsets, [col.dtype for col in cols],
+                            select=(0, 7))
+    assert out[1] is None and out[2] is None
+    assert out[0].to_list() == cols[0].to_list()
+    assert out[7].to_list() == cols[7].to_list()
+
+
+def test_host_codec_matches_device_row_conversion():
+    """The spill wire format IS the device JCUDF row format: host-encoded
+    bytes must equal ops.row_conversion.convert_to_rows output, and host
+    decode must read device-produced rows."""
+    from spark_rapids_jni_tpu.ops.row_conversion import convert_to_rows
+
+    cols = _rich_table()
+    host_buf, host_sizes = encode_jcudf_rows(cols)
+    batches = convert_to_rows(cols)
+    assert len(batches) == 1
+    dev_offsets = np.asarray(batches[0].offsets).astype(np.int64)
+    dev_flat = np.asarray(batches[0].child.data)[: dev_offsets[-1]]
+    assert np.array_equal(np.diff(dev_offsets), host_sizes)
+    assert np.array_equal(dev_flat, host_buf)
+
+    back = decode_jcudf_rows(dev_flat, dev_offsets,
+                             [col.dtype for col in cols])
+    assert _table_lists(back) == _table_lists(cols)
+
+
+def test_host_codec_empty_and_fixed_only():
+    cols = [c.column([], c.INT32), c.column([], c.INT64)]
+    buf, sizes = encode_jcudf_rows(cols)
+    assert buf.shape == (0,) and sizes.shape == (0,)
+    back = decode_jcudf_rows(buf, np.zeros(1, np.int64),
+                             [col.dtype for col in cols])
+    assert back[0].to_list() == [] and back[1].to_list() == []
+
+    cols = [c.column([1, 2, 3], c.INT32)]
+    buf, sizes = encode_jcudf_rows(cols)
+    # int32 (4B, aligned) + 1 validity byte -> 5 -> padded to 8
+    assert np.all(sizes == 8)
+
+
+def test_chained_key_hash_null_and_spread():
+    # null slots must hash by their null-ness, not their garbage data bytes
+    a = c.Column(np.array([7, 99, 3], np.int32),
+                 np.array([True, False, True]), c.INT32)
+    b = c.Column(np.array([7, -1, 3], np.int32),
+                 np.array([True, False, True]), c.INT32)
+    assert np.array_equal(chained_key_hash([a]), chained_key_hash([b]))
+    # ...but a null differs from the same value non-null
+    d = c.Column(np.array([7, 99, 3], np.int32), None, c.INT32)
+    assert chained_key_hash([a])[1] != chained_key_hash([d])[1]
+    assert chained_key_hash([a])[0] == chained_key_hash([d])[0]
+
+    # dense keys spread: no bucket > 2x uniform over 16 buckets
+    dense = c.Column(np.arange(20_000, dtype=np.int32), None, c.INT32)
+    h = chained_key_hash([dense]) % np.uint64(16)
+    counts = np.bincount(h.astype(np.int64), minlength=16)
+    assert counts.max() < 2 * (20_000 / 16)
+
+    # splitmix64 sanity: deterministic, no trivial fixed point at 1..n
+    x = np.arange(1, 100, dtype=np.uint64)
+    assert np.array_equal(splitmix64(x), splitmix64(x.copy()))
+    assert not np.any(splitmix64(x) == x)
+
+
+def _chunk(rng, n):
+    key = rng.randint(1, 500, n).astype(np.int32)
+    payload = [None if rng.rand() < 0.1 else f"p{int(k)}-{i}"
+               for i, k in enumerate(key)]
+    money = [None if rng.rand() < 0.1 else int(k) * 10**20 - 7
+             for k in key]
+    flag = [bool(k % 3 == 0) for k in key]
+    return [
+        c.column(key.tolist(), c.INT32),
+        c.strings_column(payload),
+        c.decimal128_column(money, 38, 2),
+        c.column(flag, c.BOOL),
+    ]
+
+
+def _row_tuples(cols):
+    lists = _table_lists(cols)
+    return list(zip(*lists)) if lists[0] else []
+
+
+SCHEMA = [c.INT32, c.STRING, c.decimal(38, 2), c.BOOL]
+
+
+def test_external_table_shuffle_roundtrip_nulls_strings(tmp_path):
+    """Full-table spill: strings, decimal128 and validity survive the disk
+    round trip; every row lands in ITS bucket; nothing lost or duplicated
+    (host-oracle multiset comparison)."""
+    shuffle = ExternalTableShuffle(
+        str(tmp_path), n_buckets=8, dtypes=SCHEMA, key_indices=(0,))
+    rng = np.random.RandomState(7)
+    sent = {"left": [], "right": []}
+    for _ in range(4):
+        for side in ("left", "right"):
+            cols = _chunk(rng, 700)
+            sent[side].extend(_row_tuples(cols))
+            shuffle.append(side, cols)
+
+    for side in ("left", "right"):
+        got = []
+        n_read = 0
+        for b in range(8):
+            cols_b = shuffle.read(side, b)
+            rows = _row_tuples(cols_b)
+            n_read += len(rows)
+            # every row must sit in ITS bucket (key column routing)
+            if rows:
+                h = chained_key_hash([cols_b[0]])
+                assert np.all((h % np.uint64(8)).astype(np.int64) == b)
+            got.extend(rows)
+        assert n_read == len(sent[side]), "no row lost or duplicated"
+        assert sorted(map(repr, got)) == sorted(map(repr, sent[side]))
+
+    # accounting: actual file bytes, visible per bucket
+    total = sum(shuffle.bucket_nbytes(b) for b in range(8))
+    import os
+
+    disk = sum(os.path.getsize(os.path.join(str(tmp_path), f))
+               for f in os.listdir(str(tmp_path)))
+    assert total == disk > 0
+    shuffle.close()
+    assert shuffle.read("left", 0)[0].to_list() == []
+
+
+def test_external_table_shuffle_recursive_split(tmp_path):
+    """split_bucket with a general (strings included) schema: placement
+    refines consistently on BOTH sides at each doubled modulus, rows move
+    verbatim, and a second (recursive) split of the same bucket works."""
+    shuffle = ExternalTableShuffle(
+        str(tmp_path), n_buckets=2, dtypes=SCHEMA, key_indices=(0,))
+    rng = np.random.RandomState(11)
+    sent = {}
+    for side in ("left", "right"):
+        cols = _chunk(rng, 3000)
+        sent[side] = _row_tuples(cols)
+        shuffle.append(side, cols)
+
+    b0 = shuffle.bucket_rows(0)
+    lo, hi = shuffle.split_bucket(0, chunk_rows=512)
+    assert (lo, hi) == (0, 2)
+    assert shuffle.bucket_rows(0) + shuffle.bucket_rows(2) == b0
+
+    # recursive: refine bucket 0 again (modulus 4 -> 8)
+    lo2, hi2 = shuffle.split_bucket(0, chunk_rows=512)
+    assert (lo2, hi2) == (0, 4)
+
+    for side in ("left", "right"):
+        got = []
+        for b, mod in ((0, 8), (1, 2), (2, 4), (4, 8)):
+            cols_b = shuffle.read(side, b)
+            rows = _row_tuples(cols_b)
+            if rows:
+                h = chained_key_hash([cols_b[0]])
+                assert np.all((h % np.uint64(mod)).astype(np.int64) == b), \
+                    f"side={side} bucket={b} modulus={mod}"
+            got.extend(rows)
+        assert sorted(map(repr, got)) == sorted(map(repr, sent[side])), \
+            "split must move rows, never lose them"
+    shuffle.close()
+
+
+def test_append_after_split_is_rejected(tmp_path):
+    shuffle = ExternalTableShuffle(
+        str(tmp_path), n_buckets=2, dtypes=[c.INT32], key_indices=(0,))
+    shuffle.append("left", [c.column([1, 2, 3, 4], c.INT32)])
+    shuffle.split_bucket(0)
+    with pytest.raises(ValueError):
+        shuffle.append("left", [c.column([5], c.INT32)])
+    shuffle.close()
+
+
+def test_pair_mix64_matches_bucket_of_pairs():
+    from spark_rapids_jni_tpu.models.streaming import bucket_of_pairs
+
+    rng = np.random.RandomState(3)
+    cust = rng.randint(1, 5000, 1000).astype(np.int32)
+    item = rng.randint(1, 18000, 1000).astype(np.int32)
+    assert np.array_equal(
+        bucket_of_pairs(cust, item, 16),
+        (pair_mix64(cust, item) % np.uint64(16)).astype(np.int64))
+
+
+def test_fixed_width_schema_has_no_len_file(tmp_path):
+    """Fixed-row schemas skip the .len sidecar: row size is a constant."""
+    import os
+
+    shuffle = ExternalTableShuffle(
+        str(tmp_path), n_buckets=2, dtypes=[c.INT32, c.INT32],
+        key_indices=(0, 1))
+    shuffle.append("s", [c.column([1, 2, 3], c.INT32),
+                         c.column([4, 5, 6], c.INT32)])
+    files = os.listdir(str(tmp_path))
+    assert any(f.endswith(".rows") for f in files)
+    assert not any(f.endswith(".len") for f in files)
+    # 2x int32 (8B) + 1 validity byte -> 9 -> padded to 16
+    assert shuffle.fixed_row_size == 16
+    back = shuffle.read("s", int(
+        (chained_key_hash([c.column([1], c.INT32),
+                           c.column([4], c.INT32)]) % np.uint64(2))[0]))
+    assert (1, 4) in set(zip(back[0].to_list(), back[1].to_list()))
+    shuffle.close()
