@@ -133,33 +133,61 @@ def main(argv=None) -> int:
 
     try:
         budget.reset_peak()
-        data = generate_q5_data(sf=args.sf, seed=args.seed)
-        q5_rows_total = sum(
-            len(ch.sales_sk) + len(ch.ret_sk) for ch in data.channels.values())
-        t0 = time.perf_counter()
-        q5_rows = run_distributed_q5(mesh, data, budget=budget, task_id=1)
-        q5_dt = time.perf_counter() - t0
-        q5_ok = (q5_rows == q5_local(data)) if args.verify else None
-        out["queries"]["q5"] = {
-            "wall_s": round(q5_dt, 3),
-            "fact_rows": q5_rows_total,
-            "Mrows_per_s": round(q5_rows_total / q5_dt / 1e6, 2),
-            "result_rows": len(q5_rows),
-            "verified": q5_ok,
-            "peak_reserved_bytes": budget.reset_peak(),
-        }
-
         if args.stream_chunk_rows > 0:
             import tempfile
 
             from spark_rapids_jni_tpu.models.streaming import (
+                generate_q5_chunks,
                 generate_q97_chunks,
+                run_streaming_q5,
                 run_streaming_q97,
             )
 
-            # the host-side bucket staging is governed through the
-            # arbiter's CPU path, like the reference's is_for_cpu ladder
-            host_budget = BudgetedResource(gov, 4 << 30, is_cpu=True)
+            # host-side bucket staging is governed through the arbiter's
+            # CPU path, like the reference's is_for_cpu ladder; one budget
+            # PER QUERY so each reported host peak is that query's own
+            def host_budget():
+                return BudgetedResource(gov, 4 << 30, is_cpu=True)
+
+            t0 = time.perf_counter()
+            with tempfile.TemporaryDirectory(prefix="nds_q5_shuffle_") as td:
+                q5_rows, q5_ok, q5_stats = run_streaming_q5(
+                    mesh,
+                    generate_q5_chunks(args.sf, args.seed,
+                                       args.stream_chunk_rows),
+                    tmpdir=td, n_buckets=args.buckets, budget=budget,
+                    host_budget=host_budget(), task_id=1,
+                    verify=args.verify)
+            q5_dt = time.perf_counter() - t0
+            q5_rows_total = q5_stats["rows_in"]
+            out["queries"]["q5"] = {
+                "wall_s": round(q5_dt, 3),
+                "fact_rows": q5_rows_total,
+                "Mrows_per_s": round(q5_rows_total / q5_dt / 1e6, 2),
+                "result_rows": len(q5_rows),
+                "verified": q5_ok,
+                "streamed": q5_stats,
+                "peak_reserved_bytes": budget.reset_peak(),
+            }
+        else:
+            data = generate_q5_data(sf=args.sf, seed=args.seed)
+            q5_rows_total = sum(
+                len(ch.sales_sk) + len(ch.ret_sk)
+                for ch in data.channels.values())
+            t0 = time.perf_counter()
+            q5_rows = run_distributed_q5(mesh, data, budget=budget, task_id=1)
+            q5_dt = time.perf_counter() - t0
+            q5_ok = (q5_rows == q5_local(data)) if args.verify else None
+            out["queries"]["q5"] = {
+                "wall_s": round(q5_dt, 3),
+                "fact_rows": q5_rows_total,
+                "Mrows_per_s": round(q5_rows_total / q5_dt / 1e6, 2),
+                "result_rows": len(q5_rows),
+                "verified": q5_ok,
+                "peak_reserved_bytes": budget.reset_peak(),
+            }
+
+        if args.stream_chunk_rows > 0:
             t0 = time.perf_counter()
             with tempfile.TemporaryDirectory(prefix="nds_shuffle_") as td:
                 counts, q97_ok, stats = run_streaming_q97(
@@ -167,7 +195,7 @@ def main(argv=None) -> int:
                     generate_q97_chunks(args.sf, args.seed,
                                         args.stream_chunk_rows),
                     tmpdir=td, n_buckets=args.buckets, budget=budget,
-                    host_budget=host_budget, task_id=2, verify=args.verify)
+                    host_budget=host_budget(), task_id=2, verify=args.verify)
             q97_dt = time.perf_counter() - t0
             nq = stats["rows_in"]
             out["queries"]["q97"] = {

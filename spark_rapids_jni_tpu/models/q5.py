@@ -43,7 +43,11 @@ __all__ = [
     "q5_local",
     "make_distributed_q5",
     "run_distributed_q5",
+    "run_q5_partials",
     "q5_rollup",
+    "q5_host_channel_partials",
+    "ChannelPartials",
+    "add_partials",
 ]
 
 
@@ -57,11 +61,27 @@ class Q5Row(NamedTuple):
     profit: int
 
 
-class _ChannelPartials(NamedTuple):
+class ChannelPartials(NamedTuple):
+    """Per-dim-sk partial aggregates of one channel — ADDITIVE over any
+    disjoint row partition (the invariant row splits and the streamed
+    bucket pipeline rely on)."""
+
     sales: jnp.ndarray  # int64[n_dim]
     returns_: jnp.ndarray
     profit: jnp.ndarray
     count: jnp.ndarray  # int32[n_dim] contributing rows (sales+returns)
+
+
+_ChannelPartials = ChannelPartials
+
+
+def add_partials(
+    a: Dict[str, ChannelPartials], b: Dict[str, ChannelPartials]
+) -> Dict[str, ChannelPartials]:
+    """Element-wise sum of per-channel partial dicts (the additivity every
+    split/bucket combine relies on)."""
+    return {name: ChannelPartials(*(x + y for x, y in zip(a[name], b[name])))
+            for name in a}
 
 
 def _window_member(date, date_valid, dim_sk, dim_days, lo, hi):
@@ -138,18 +158,20 @@ def q5_local(data: Q5Data) -> List[Q5Row]:
             data.sales_date_lo, data.sales_date_hi,
         )
         per_channel[name] = jax.tree.map(np.asarray, parts)
-    return q5_rollup(per_channel, data)
+    return q5_rollup(per_channel,
+                     {n: data.channels[n].dim_id for n in CHANNELS})
 
 
 def q5_rollup(per_channel: Dict[str, _ChannelPartials],
-              data: Q5Data) -> List[Q5Row]:
+              dim_ids: Dict[str, List[str]]) -> List[Q5Row]:
     """ROLLUP(channel, id) formatting: leaf rows, channel totals, grand
-    total — ordered like the SQL output (channel, id, nulls last)."""
+    total — ordered like the SQL output (channel, id, nulls last).
+    ``dim_ids`` maps channel -> business-id strings (dim_sk order)."""
     rows: List[Q5Row] = []
     g_sales = g_ret = g_prof = 0
     for name in CHANNELS:
         p = per_channel[name]
-        ids = data.channels[name].dim_id
+        ids = dim_ids[name]
         c_sales = c_ret = c_prof = 0
         leaf: List[Q5Row] = []
         for i in range(len(ids)):
@@ -260,11 +282,27 @@ def _split_channel(facts: Dict[str, np.ndarray]):
     return halves
 
 
-def run_distributed_q5(mesh, data: Q5Data, *, budget=None, task_id: int = 0,
-                       manage_task: bool = True) -> List[Q5Row]:
-    """Governed distributed q5 over host data: every launch admitted through
-    the memory arbiter; SplitAndRetryOOM halves fact rows (exact — all
-    aggregates are additive) and partials combine by addition.
+def run_q5_partials(
+    mesh,
+    batch: Dict[str, Dict[str, np.ndarray]],
+    *,
+    date_sk: np.ndarray,
+    date_days: np.ndarray,
+    n_dims: Tuple[int, ...],
+    lo: int,
+    hi: int,
+    budget=None,
+    task_id: int = 0,
+    manage_task: bool = True,
+) -> Dict[str, _ChannelPartials]:
+    """Governed distributed q5 PARTIALS over a host fact batch.
+
+    ``batch`` maps channel -> fact-array dict (the _facts_of field names);
+    the step is LRU-cached on (mesh, n_dims, lo, hi), so every caller with
+    one dim geometry — in-memory q5, every bucket of streamed q5 — reuses
+    ONE compiled program.  Every launch is admitted through the memory
+    arbiter; SplitAndRetryOOM halves fact rows (exact — all aggregates are
+    additive) and partials combine by addition.
     """
     import contextlib
 
@@ -279,11 +317,9 @@ def run_distributed_q5(mesh, data: Q5Data, *, budget=None, task_id: int = 0,
     dp = int(np.prod([mesh.shape[a] for a in (DATA_AXIS,)]))
     sharding = NamedSharding(mesh, P(DATA_AXIS))
     rep = NamedSharding(mesh, P())
-    step = make_distributed_q5(mesh, data)  # LRU-cached; COMPILE seam inside
-    dim_sk = jax.device_put(data.date_sk, rep)
-    dim_days = jax.device_put(data.date_days, rep)
-
-    batch = {n: _facts_of(data.channels[n]) for n in CHANNELS}
+    step = _q5_step_cached(mesh, tuple(n_dims), lo, hi)
+    dim_sk = jax.device_put(date_sk, rep)
+    dim_days = jax.device_put(date_days, rep)
 
     def nbytes_of(b):
         # quantized (padded) lengths: what run() actually uploads
@@ -315,17 +351,68 @@ def run_distributed_q5(mesh, data: Q5Data, *, budget=None, task_id: int = 0,
     def combine(results):
         acc = results[0]
         for r in results[1:]:
-            acc = {
-                n: _ChannelPartials(*(a + x for a, x in zip(acc[n], r[n])))
-                for n in acc
-            }
+            acc = add_partials(acc, r)
         return acc
 
     ctx = (task_context(budget.gov, task_id) if manage_task
            else contextlib.nullcontext())
     with ctx:
-        per_channel = run_with_split_retry(
+        return run_with_split_retry(
             budget, batch,
             nbytes_of=nbytes_of, run=run, split=split, combine=combine,
         )
-    return q5_rollup(per_channel, data)
+
+
+def run_distributed_q5(mesh, data: Q5Data, *, budget=None, task_id: int = 0,
+                       manage_task: bool = True) -> List[Q5Row]:
+    """Governed distributed q5 over host data: partials via
+    :func:`run_q5_partials`, then the host rollup."""
+    per_channel = run_q5_partials(
+        mesh,
+        {n: _facts_of(data.channels[n]) for n in CHANNELS},
+        date_sk=data.date_sk,
+        date_days=data.date_days,
+        n_dims=tuple(len(data.channels[n].dim_sk) for n in CHANNELS),
+        lo=data.sales_date_lo,
+        hi=data.sales_date_hi,
+        budget=budget,
+        task_id=task_id,
+        manage_task=manage_task,
+    )
+    return q5_rollup(per_channel,
+                     {n: data.channels[n].dim_id for n in CHANNELS})
+
+
+def q5_host_channel_partials(facts: Dict[str, np.ndarray], n_dim: int,
+                             date_sk: np.ndarray, date_days: np.ndarray,
+                             lo: int, hi: int) -> _ChannelPartials:
+    """Host (numpy) oracle for one channel's partial vectors — the same
+    join/filter/segment-sum semantics as the device body, int64-exact.
+    Bucket-local by construction: its working set is the rows it is given
+    (how streamed q5 verifies per bucket without a global materialize)."""
+    def member(date, dvalid):
+        idx = np.clip(np.searchsorted(date_sk, date), 0, len(date_sk) - 1)
+        hit = date_sk[idx] == date
+        in_win = (date_days[idx] >= lo) & (date_days[idx] < hi)
+        return dvalid & hit & in_win
+
+    def seg(values, sk, ok, dtype=np.int64):
+        acc = np.zeros(n_dim, dtype)
+        np.add.at(acc, sk[ok].astype(np.int64) - 1, values[ok].astype(dtype))
+        return acc
+
+    s_ok = (facts["sales_sk_valid"] & (facts["sales_sk"] >= 1)
+            & (facts["sales_sk"] <= n_dim)
+            & member(facts["sales_date"], facts["sales_date_valid"]))
+    r_ok = (facts["ret_sk_valid"] & (facts["ret_sk"] >= 1)
+            & (facts["ret_sk"] <= n_dim)
+            & member(facts["ret_date"], facts["ret_date_valid"]))
+    sales = seg(facts["sales_price"], facts["sales_sk"], s_ok)
+    profit_s = seg(facts["sales_profit"], facts["sales_sk"], s_ok)
+    returns_ = seg(facts["ret_amt"], facts["ret_sk"], r_ok)
+    loss = seg(facts["ret_loss"], facts["ret_sk"], r_ok)
+    count = (seg(np.ones_like(facts["sales_sk"]), facts["sales_sk"], s_ok,
+                 np.int32)
+             + seg(np.ones_like(facts["ret_sk"]), facts["ret_sk"], r_ok,
+                   np.int32))
+    return _ChannelPartials(sales, returns_, profit_s - loss, count)

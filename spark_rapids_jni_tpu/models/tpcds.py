@@ -19,8 +19,9 @@ from typing import Dict
 
 import numpy as np
 
-__all__ = ["Q3Data", "Q5Data", "generate_q3_data", "generate_q5_data",
-           "generate_q97_tables", "write_q97_parquet", "CHANNELS"]
+__all__ = ["Q3Data", "Q5Data", "Q5Dims", "q5_dims", "generate_q3_data",
+           "generate_q5_data", "generate_q97_tables", "write_q97_parquet",
+           "CHANNELS"]
 
 # (channel label, fact prefix, dim id prefix) for q5's three channel unions
 CHANNELS = ("store", "catalog", "web")
@@ -87,22 +88,67 @@ def _nullable(rng, vals: np.ndarray, null_pct: float):
     return np.where(valid, vals, 0).astype(vals.dtype), valid
 
 
+@dataclasses.dataclass
+class Q5Dims:
+    """The q5 dimension side: date_dim + per-channel business dims.
+
+    Deterministic and sf-independent (dims are tiny; facts scale), so a
+    streamed producer and a bucket executor can each rebuild them without
+    exchanging anything — the replicated-broadcast-dim shape of the plan.
+    """
+
+    date_sk: np.ndarray
+    date_days: np.ndarray
+    sales_date_lo: int
+    sales_date_hi: int
+    dim_sk: Dict[str, np.ndarray]
+    dim_id: Dict[str, list]
+
+    @property
+    def n_dims(self):
+        return tuple(len(self.dim_sk[n]) for n in CHANNELS)
+
+    def channel_size(self, name: str) -> int:
+        return len(self.dim_sk[name])
+
+
+def q5_dims() -> Q5Dims:
+    """Build the (deterministic) q5 dimension tables."""
+    n_dates = 120
+    lo = 30
+    dim_sk = {}
+    dim_id = {}
+    for ci, name in enumerate(CHANNELS):
+        n_dim = max(3, int(6 * (ci + 1)))
+        dim_sk[name] = np.arange(1, n_dim + 1, dtype=np.int32)
+        dim_id[name] = _dim_ids(name[0].upper(), n_dim, None)
+    return Q5Dims(
+        date_sk=np.arange(_D0, _D0 + n_dates, dtype=np.int32),
+        date_days=np.arange(n_dates, dtype=np.int32),
+        sales_date_lo=lo,
+        sales_date_hi=lo + 14,  # q5's 14-day window
+        dim_sk=dim_sk,
+        dim_id=dim_id,
+    )
+
+
 def generate_q5_data(sf: float = 0.01, seed: int = 0,
                      null_pct: float = 0.04) -> Q5Data:
     """Generate the q5 table set at scale factor ``sf``."""
     rng = np.random.RandomState(seed)
-    n_dates = 120
-    date_sk = np.arange(_D0, _D0 + n_dates, dtype=np.int32)
-    date_days = np.arange(n_dates, dtype=np.int32)
-    lo = 30
-    hi = lo + 14  # q5's 14-day window
+    dims = q5_dims()
+    date_sk = dims.date_sk
+    date_days = dims.date_days
+    n_dates = len(date_sk)
+    lo = dims.sales_date_lo
+    hi = dims.sales_date_hi
 
     channels: Dict[str, ChannelTables] = {}
     for ci, name in enumerate(CHANNELS):
-        n_dim = max(3, int(6 * (ci + 1)))
+        n_dim = dims.channel_size(name)
         n_sales = max(8, int(40_000 * sf) // (ci + 1))
         n_ret = max(4, n_sales // 8)
-        dim_sk = np.arange(1, n_dim + 1, dtype=np.int32)
+        dim_sk = dims.dim_sk[name]
 
         s_sk, s_skv = _nullable(
             rng, rng.randint(1, n_dim + 1, n_sales).astype(np.int32), null_pct)
@@ -125,7 +171,7 @@ def generate_q5_data(sf: float = 0.01, seed: int = 0,
             ret_amt=_money(rng, n_ret),
             ret_loss=_money(rng, n_ret, 0, 80_00),
             dim_sk=dim_sk,
-            dim_id=_dim_ids(name[0].upper(), n_dim, rng),
+            dim_id=dims.dim_id[name],
         )
     return Q5Data(channels, date_sk, date_days, lo, hi)
 

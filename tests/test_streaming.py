@@ -276,6 +276,119 @@ def test_split_bucket_disk_refinement(tmp_path):
     shuffle.close()
 
 
+def _assemble_q5(chunks):
+    """Concatenate streamed q5 chunks into a Q5Data for the global oracle."""
+    from spark_rapids_jni_tpu.models.tpcds import (
+        CHANNELS,
+        ChannelTables,
+        Q5Data,
+        q5_dims,
+    )
+
+    dims = q5_dims()
+    acc = {}
+    for channel, kind, ch in chunks:
+        acc.setdefault((channel, kind), []).append(ch)
+
+    def cat(channel, kind, field):
+        parts = [c[field] for c in acc.get((channel, kind), [])]
+        return np.concatenate(parts) if parts else np.zeros(0, np.int32)
+
+    channels = {}
+    for name in CHANNELS:
+        channels[name] = ChannelTables(
+            sales_sk=cat(name, "sales", "sk"),
+            sales_sk_valid=cat(name, "sales", "sk_valid"),
+            sales_date=cat(name, "sales", "date"),
+            sales_date_valid=cat(name, "sales", "date_valid"),
+            sales_price=cat(name, "sales", "m1"),
+            sales_profit=cat(name, "sales", "m2"),
+            ret_sk=cat(name, "ret", "sk"),
+            ret_sk_valid=cat(name, "ret", "sk_valid"),
+            ret_date=cat(name, "ret", "date"),
+            ret_date_valid=cat(name, "ret", "date_valid"),
+            ret_amt=cat(name, "ret", "m1"),
+            ret_loss=cat(name, "ret", "m2"),
+            dim_sk=dims.dim_sk[name],
+            dim_id=dims.dim_id[name],
+        )
+    return Q5Data(channels, dims.date_sk, dims.date_days,
+                  dims.sales_date_lo, dims.sales_date_hi)
+
+
+@pytest.mark.slow
+def test_streaming_q5_matches_global_oracle(tmp_path):
+    """Streamed q5 over disk buckets must equal q5_local over the SAME
+    concatenated chunk stream (additive partials over disjoint buckets),
+    and every bucket must pass its local numpy-partials oracle."""
+    import jax
+
+    from spark_rapids_jni_tpu.mem import BudgetedResource, MemoryGovernor
+    from spark_rapids_jni_tpu.models.q5 import q5_local
+    from spark_rapids_jni_tpu.models.streaming import (
+        generate_q5_chunks,
+        run_streaming_q5,
+    )
+    from spark_rapids_jni_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh((len(jax.devices()), 1))
+    chunks = list(generate_q5_chunks(sf=0.5, seed=6, chunk_rows=3000))
+    want = q5_local(_assemble_q5(chunks))
+
+    gov = MemoryGovernor.initialize()
+    host_budget = BudgetedResource(gov, 1 << 30, is_cpu=True)
+    try:
+        rows, verified, stats = run_streaming_q5(
+            mesh, iter(chunks), tmpdir=str(tmp_path / "q5shuf"),
+            n_buckets=4, host_budget=host_budget, task_id=7, verify=True)
+    finally:
+        MemoryGovernor.shutdown()
+    assert verified is True
+    assert rows == want
+    assert stats["rows_in"] == sum(len(c[2]["sk"]) for c in chunks)
+    assert stats["max_bucket_rows"] < stats["rows_in"]
+    assert stats["host_peak_reserved"] > 0
+    assert host_budget.used == 0
+
+
+@pytest.mark.slow
+def test_streaming_q5_oversized_bucket_splits(tmp_path):
+    """An over-budget q5 bucket must recursively split on disk and still
+    produce the exact global rollup (partials additive under ANY row
+    partition)."""
+    import jax
+
+    from spark_rapids_jni_tpu.mem import BudgetedResource, MemoryGovernor
+    from spark_rapids_jni_tpu.models.q5 import q5_local
+    from spark_rapids_jni_tpu.models.streaming import (
+        generate_q5_chunks,
+        run_streaming_q5,
+    )
+    from spark_rapids_jni_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh((len(jax.devices()), 1))
+    chunks = list(generate_q5_chunks(sf=0.5, seed=8, chunk_rows=3000))
+    want = q5_local(_assemble_q5(chunks))
+
+    gov = MemoryGovernor(watchdog_period_s=0.02)
+    dev_budget = BudgetedResource(gov, 1 << 30)
+    # sf=0.5 -> ~36k rows over 2 buckets at 32 B/row JCUDF -> ~580 KB per
+    # bucket; a 192 KB host budget forces recursive disk splits
+    host_budget = BudgetedResource(gov, 192 << 10, is_cpu=True)
+    try:
+        rows, verified, stats = run_streaming_q5(
+            mesh, iter(chunks), tmpdir=str(tmp_path / "q5shuf"),
+            n_buckets=2, budget=dev_budget, host_budget=host_budget,
+            task_id=8, verify=True)
+    finally:
+        gov.close()
+    assert rows == want
+    assert verified is True
+    assert stats["bucket_splits"] >= 2, stats
+    assert host_budget.used == 0
+    assert host_budget.peak <= 192 << 10
+
+
 @pytest.mark.slow
 def test_bucket_ownership_partitions_across_processes():
     """The pod-scale deployment shape: two OS processes ('host groups')
