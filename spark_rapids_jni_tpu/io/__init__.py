@@ -10,6 +10,7 @@ from spark_rapids_jni_tpu.io.parquet_footer import (
     ValueElement,
 )
 from spark_rapids_jni_tpu.io.parquet_read import (
+    iter_split_batches,
     plan_byte_splits,
     plan_split,
     read_split,
@@ -22,6 +23,7 @@ __all__ = [
     "StructBuilder",
     "StructElement",
     "ValueElement",
+    "iter_split_batches",
     "plan_byte_splits",
     "plan_split",
     "read_split",

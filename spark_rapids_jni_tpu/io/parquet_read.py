@@ -27,7 +27,8 @@ import numpy as np
 
 from spark_rapids_jni_tpu.io.parquet_footer import ParquetFooter, StructElement
 
-__all__ = ["footer_bytes", "plan_byte_splits", "read_split", "SplitPlan"]
+__all__ = ["footer_bytes", "plan_byte_splits", "read_split",
+           "iter_split_batches", "SplitPlan"]
 
 _MAGIC = b"PAR1"
 
@@ -120,6 +121,30 @@ def _arrow_to_column(arr):
                     None if validity is None else jnp.asarray(validity), dt)
 
 
+def _table_columns(table, columns, as_numpy: bool) -> Dict[str, object]:
+    """One decoded arrow table -> framework Columns (or, with
+    ``as_numpy``, raw ``(values, validity)`` host pairs)."""
+    import pyarrow as pa
+
+    out: Dict[str, object] = {}
+    for name in columns:
+        col = table.column(name)
+        if as_numpy:
+            arr = col.combine_chunks() if isinstance(
+                col, pa.ChunkedArray) else col
+            valid: Optional[np.ndarray] = None
+            if arr.null_count:
+                valid = np.asarray(arr.is_valid())
+            if pa.types.is_string(arr.type):
+                vals = [v.as_py() if v.is_valid else None for v in arr]
+            else:
+                vals = arr.fill_null(0).to_numpy()
+            out[name] = (vals, valid)
+        else:
+            out[name] = _arrow_to_column(col)
+    return out
+
+
 def read_split(path: str, part_offset: int, part_length: int,
                schema: StructElement, ignore_case: bool = False,
                as_numpy: bool = False) -> Dict[str, object]:
@@ -147,22 +172,33 @@ def read_split(path: str, part_offset: int, part_length: int,
         raise AssertionError(
             f"{path}: footer planned {plan.num_rows} rows, "
             f"decoder produced {table.num_rows}")
-    out: Dict[str, object] = {}
-    for name in plan.columns:
-        col = table.column(name)
-        if as_numpy:
-            import pyarrow as pa
+    return _table_columns(table, plan.columns, as_numpy)
 
-            arr = col.combine_chunks() if isinstance(
-                col, pa.ChunkedArray) else col
-            valid: Optional[np.ndarray] = None
-            if arr.null_count:
-                valid = np.asarray(arr.is_valid())
-            if pa.types.is_string(arr.type):
-                vals = [v.as_py() if v.is_valid else None for v in arr]
-            else:
-                vals = arr.fill_null(0).to_numpy()
-            out[name] = (vals, valid)
-        else:
-            out[name] = _arrow_to_column(col)
-    return out
+
+def iter_split_batches(path: str, part_offset: int, part_length: int,
+                       schema: StructElement, ignore_case: bool = False,
+                       as_numpy: bool = False):
+    """Chunked scan of one split: yield ONE decoded batch per surviving
+    row group, never materializing the whole split.
+
+    This is the composition of the footer planner with out-of-core
+    execution: each batch feeds the external grace-hash shuffle
+    (io/spill.py) with host memory bounded by a single row group — the
+    reason the reference's footer filter exists is to plan scans of files
+    too big to hold (NativeParquetJni.cpp:584 filter_groups handing the
+    filtered footer to a chunked reader).  The split's planned row count
+    is re-checked across the yielded batches.
+    """
+    import pyarrow.parquet as pq
+
+    plan = plan_split(path, part_offset, part_length, schema, ignore_case)
+    pf = pq.ParquetFile(path)
+    got = 0
+    for g in plan.group_indexes:
+        table = pf.read_row_group(g, columns=plan.columns)
+        got += table.num_rows
+        yield _table_columns(table, plan.columns, as_numpy)
+    if got != plan.num_rows:
+        raise AssertionError(
+            f"{path}: footer planned {plan.num_rows} rows, "
+            f"chunked decoder produced {got}")

@@ -27,14 +27,20 @@ def _q97_tables(sf: float, seed: int):
     return generate_q97_tables(sf, seed)
 
 
-def _q97_tables_from_parquet(input_dir: str, n_splits: int):
-    """Read the q97 fact pair from parquet, splits planned by the footer.
+def q97_parquet_chunks(input_dir: str, n_splits: int):
+    """Stream the q97 fact pair from parquet as ``(side, cust, item)``
+    chunks, ONE ROW GROUP AT A TIME — the composition of the footer
+    planner with the out-of-core shuffle.
 
-    Each file is cut into byte-range splits; the thrift footer filter
-    (io/parquet_footer.py midpoint rule) decides which row groups each
-    split reads, and the schema prune limits decoding to the two join
-    keys — the money columns in the files are never materialized
-    (NativeParquetJni.cpp:584 filter_groups feeding the columnar reader).
+    Every file is cut into footer-planned byte-range splits (each row
+    group belongs to exactly one split, so iterating every split sees
+    each row exactly once); the thrift footer filter (io/parquet_footer.py
+    midpoint rule) decides which row groups each split reads, the schema
+    prune limits decoding to the two join keys (money columns never
+    materialize — NativeParquetJni.cpp:584 filter_groups feeding the
+    columnar reader), and host memory is bounded by one row group.
+    NULL keys are excluded (q97_host_oracle semantics) — this generator
+    is the single owner of that filter for both --input modes.
     """
     import os
 
@@ -43,34 +49,51 @@ def _q97_tables_from_parquet(input_dir: str, n_splits: int):
     from spark_rapids_jni_tpu.io import (
         StructElement,
         ValueElement,
+        iter_split_batches,
         plan_byte_splits,
-        read_split,
     )
 
-    out = []
-    for name, prefix in (("store_sales", "ss"), ("catalog_sales", "cs")):
+    for name, prefix, side in (("store_sales", "ss", "store"),
+                               ("catalog_sales", "cs", "catalog")):
         path = os.path.join(input_dir, f"{name}.parquet")
         schema = (StructElement.builder()
                   .add_child(f"{prefix}_customer_sk", ValueElement())
                   .add_child(f"{prefix}_item_sk", ValueElement())
                   .build())
-        cust_parts, item_parts = [], []
         for off, length in plan_byte_splits(path, n_splits):
-            part = read_split(path, off, length, schema, as_numpy=True)
-            cust, cust_valid = part[f"{prefix}_customer_sk"]
-            item, item_valid = part[f"{prefix}_item_sk"]
-            # q97 joins NON-NULL keys only (q97_host_oracle semantics):
-            # a NULL key must be excluded, not counted as key 0
-            keep = np.ones(len(cust), bool)
-            if cust_valid is not None:
-                keep &= cust_valid
-            if item_valid is not None:
-                keep &= item_valid
-            cust_parts.append(np.asarray(cust)[keep])
-            item_parts.append(np.asarray(item)[keep])
-        out.append((np.concatenate(cust_parts).astype(np.int32),
-                    np.concatenate(item_parts).astype(np.int32)))
-    return out[0], out[1]
+            for batch in iter_split_batches(path, off, length, schema,
+                                            as_numpy=True):
+                cust, cust_valid = batch[f"{prefix}_customer_sk"]
+                item, item_valid = batch[f"{prefix}_item_sk"]
+                cust = np.asarray(cust)
+                item = np.asarray(item)
+                keep = cust_valid
+                if item_valid is not None:
+                    keep = item_valid if keep is None else keep & item_valid
+                if keep is not None:
+                    cust, item = cust[keep], item[keep]
+                yield (side,
+                       cust.astype(np.int32, copy=False),
+                       item.astype(np.int32, copy=False))
+
+
+def _q97_tables_from_parquet(input_dir: str, n_splits: int):
+    """Materialize the q97 fact pair from parquet (the in-memory --input
+    mode): a per-side concatenate over :func:`q97_parquet_chunks`, so the
+    footer planning / pruning / NULL-key semantics have one owner."""
+    import numpy as np
+
+    parts = {"store": ([], []), "catalog": ([], [])}
+    for side, cust, item in q97_parquet_chunks(input_dir, n_splits):
+        parts[side][0].append(cust)
+        parts[side][1].append(item)
+
+    def cat(side):
+        custs, items = parts[side]
+        return (np.concatenate(custs) if custs else np.zeros(0, np.int32),
+                np.concatenate(items) if items else np.zeros(0, np.int32))
+
+    return cat("store"), cat("catalog")
 
 
 def main(argv=None) -> int:
@@ -88,15 +111,14 @@ def main(argv=None) -> int:
     ap.add_argument("--splits", type=int, default=2,
                     help="byte-range splits per parquet file (--input mode)")
     ap.add_argument("--stream-chunk-rows", type=int, default=0,
-                    help="run q97 out-of-core: generate facts in chunks of "
-                         "this many rows and grace-hash them through disk "
-                         "buckets (models/streaming.py); 0 = in-memory")
+                    help="run q5+q97 out-of-core: facts flow in bounded "
+                         "chunks through disk grace-hash buckets "
+                         "(models/streaming.py); 0 = in-memory.  Generated "
+                         "facts chunk at this many rows; with --input, q97 "
+                         "chunks at parquet row-group granularity instead")
     ap.add_argument("--buckets", type=int, default=16,
                     help="key-space buckets for --stream-chunk-rows mode")
     args = ap.parse_args(argv)
-    if args.input and args.stream_chunk_rows > 0:
-        ap.error("--input and --stream-chunk-rows are mutually exclusive: "
-                 "streamed q97 generates its facts, it does not read parquet")
 
     # join the process group BEFORE the backend is touched: on a multi-host
     # pod the harness must span every host's devices, not run per-host
@@ -188,12 +210,17 @@ def main(argv=None) -> int:
             }
 
         if args.stream_chunk_rows > 0:
+            if args.input:
+                # footer-planned parquet scan feeding the disk shuffle:
+                # chunk = one surviving row group per byte-range split
+                q97_chunks = q97_parquet_chunks(args.input, args.splits)
+            else:
+                q97_chunks = generate_q97_chunks(args.sf, args.seed,
+                                                 args.stream_chunk_rows)
             t0 = time.perf_counter()
             with tempfile.TemporaryDirectory(prefix="nds_shuffle_") as td:
                 counts, q97_ok, stats = run_streaming_q97(
-                    mesh,
-                    generate_q97_chunks(args.sf, args.seed,
-                                        args.stream_chunk_rows),
+                    mesh, q97_chunks,
                     tmpdir=td, n_buckets=args.buckets, budget=budget,
                     host_budget=host_budget(), task_id=2, verify=args.verify)
             q97_dt = time.perf_counter() - t0

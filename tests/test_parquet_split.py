@@ -154,6 +154,137 @@ def test_oversubscribed_splits_still_partition(q97_files):
     assert sorted(flat) == list(range(n_groups))
 
 
+def test_iter_split_batches_row_group_chunks(q97_files):
+    """The chunked scan yields one batch per surviving row group, covers
+    every row exactly once across splits, and matches the one-shot
+    read_split materialization."""
+    import pyarrow.parquet as pq
+
+    from spark_rapids_jni_tpu.io import iter_split_batches
+
+    store_path, _ = q97_files
+    pf = pq.ParquetFile(store_path)
+    group_rows = [pf.metadata.row_group(i).num_rows
+                  for i in range(pf.num_row_groups)]
+
+    all_rows = []
+    n_batches = 0
+    for off, length in plan_byte_splits(store_path, 2):
+        split_rows = []
+        for batch in iter_split_batches(store_path, off, length,
+                                        _keys_schema("ss"), as_numpy=True):
+            n_batches += 1
+            cust = np.asarray(batch["ss_customer_sk"][0])
+            item = np.asarray(batch["ss_item_sk"][0])
+            assert len(cust) <= max(group_rows), \
+                "a batch must never exceed one row group"
+            split_rows.extend(zip(cust.tolist(), item.tolist()))
+        whole = read_split(store_path, off, length, _keys_schema("ss"),
+                           as_numpy=True)
+        want = list(zip(whole["ss_customer_sk"][0].tolist(),
+                        whole["ss_item_sk"][0].tolist()))
+        assert split_rows == want, "chunked == one-shot, in order"
+        all_rows.extend(split_rows)
+    assert n_batches == pf.num_row_groups
+    assert len(all_rows) == pf.metadata.num_rows, "each row exactly once"
+
+
+def test_q97_parquet_chunks_exactly_once_and_null_free(q97_files, tmp_path):
+    """The harness chunk source covers both sides completely (row-group
+    granularity) and drops NULL-keyed rows."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_jni_tpu.models.nds_harness import q97_parquet_chunks
+
+    input_dir = __import__("os").path.dirname(q97_files[0])
+    per_side = {"store": 0, "catalog": 0}
+    for side, cust, item in q97_parquet_chunks(input_dir, 3):
+        assert cust.dtype == np.int32 and item.dtype == np.int32
+        per_side[side] += len(cust)
+    assert per_side["store"] == pq.ParquetFile(
+        q97_files[0]).metadata.num_rows
+    assert per_side["catalog"] == pq.ParquetFile(
+        q97_files[1]).metadata.num_rows
+
+    # null keys dropped at the chunk source
+    for name, prefix in (("store_sales", "ss"), ("catalog_sales", "cs")):
+        table = pa.table({
+            f"{prefix}_customer_sk": pa.array([1, None, 3, 4], pa.int32()),
+            f"{prefix}_item_sk": pa.array([10, 20, None, 40], pa.int32()),
+        })
+        pq.write_table(table, str(tmp_path / f"{name}.parquet"),
+                       row_group_size=2)
+    rows = {"store": set(), "catalog": set()}
+    for side, cust, item in q97_parquet_chunks(str(tmp_path), 2):
+        rows[side] |= set(zip(cust.tolist(), item.tolist()))
+    assert rows["store"] == rows["catalog"] == {(1, 10), (4, 40)}
+
+
+@pytest.mark.slow
+def test_q97_streamed_from_parquet_matches_oracle(q97_files):
+    """VERDICT r4 #4 done criterion: q97 out-of-core FROM multi-row-group
+    parquet, footer-planned across 2 simulated executors (byte-range
+    splits), each row seen exactly once, verified — the scan partitions
+    by footer, the disk grace hash reunifies the buckets."""
+    import os
+    import tempfile
+
+    import jax
+
+    from spark_rapids_jni_tpu.mem import BudgetedResource, MemoryGovernor
+    from spark_rapids_jni_tpu.models.nds_harness import q97_parquet_chunks
+    from spark_rapids_jni_tpu.models.q97 import q97_host_oracle
+    from spark_rapids_jni_tpu.models.streaming import run_streaming_q97
+    from spark_rapids_jni_tpu.parallel.mesh import make_mesh
+
+    store_path, catalog_path = q97_files
+    input_dir = os.path.dirname(store_path)
+    whole = {}
+    for path, prefix in ((store_path, "ss"), (catalog_path, "cs")):
+        part = read_split(path, *plan_byte_splits(path, 1)[0],
+                          schema=_keys_schema(prefix), as_numpy=True)
+        whole[prefix] = (part[f"{prefix}_customer_sk"][0].astype(np.int32),
+                         part[f"{prefix}_item_sk"][0].astype(np.int32))
+    want = q97_host_oracle(whole["ss"], whole["cs"])
+
+    mesh = make_mesh((len(jax.devices()), 1))
+    gov = MemoryGovernor.initialize()
+    host_budget = BudgetedResource(gov, 1 << 30, is_cpu=True)
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            counts, verified, stats = run_streaming_q97(
+                mesh, q97_parquet_chunks(input_dir, 2),
+                tmpdir=td, n_buckets=8, host_budget=host_budget,
+                task_id=9, verify=True)
+    finally:
+        MemoryGovernor.shutdown()
+    assert verified is True
+    assert counts == want
+    assert stats["rows_in"] == len(whole["ss"][0]) + len(whole["cs"][0])
+
+
+@pytest.mark.slow
+def test_nds_harness_input_streamed_mode(q97_files, capsys):
+    """--input composes with --stream-chunk-rows: q97 runs out-of-core
+    from footer-planned parquet row groups, verified end to end."""
+    import json
+    import os
+
+    from spark_rapids_jni_tpu.models import nds_harness
+
+    input_dir = os.path.dirname(q97_files[0])
+    rc = nds_harness.main(["--sf", "0.002", "--input", input_dir,
+                           "--splits", "2", "--stream-chunk-rows", "2000",
+                           "--buckets", "4", "--verify"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert out["queries"]["q97"]["verified"] is True
+    assert out["queries"]["q97"]["streamed"]["n_buckets"] == 4
+    assert out["queries"]["q5"]["verified"] is True
+    assert "streamed" in out["queries"]["q5"]
+
+
 def test_harness_parquet_read_excludes_null_keys(tmp_path):
     """NULL join keys in parquet must be excluded from q97, not counted
     as key 0 (q97_host_oracle non-null semantics)."""
