@@ -101,6 +101,29 @@ def _arrow_to_column(arr):
     validity = None
     if arr.null_count:
         validity = np.asarray(arr.is_valid())
+
+    if pa.types.is_decimal128(t):
+        # Parquet DECIMAL(p, s) -> unscaled storage by precision, the same
+        # storage rule as dtypes.decimal(): int32/int64 Columns for p<=9 /
+        # p<=18, two's-complement limb pairs above (the reference's pruner
+        # round-trips the decimal Tag tree, NativeParquetJni.cpp:102-109).
+        # Arrow decimal128 buffers are 16-byte little-endian two's
+        # complement; decode limbs straight from the buffer.
+        n = len(arr)
+        raw = np.frombuffer(arr.buffers()[1], np.uint8)
+        raw = raw[arr.offset * 16:(arr.offset + n) * 16].reshape(n, 16)
+        lo = raw[:, :8].copy().view("<u8").ravel()
+        hi = raw[:, 8:].copy().view("<i8").ravel()
+        dt = c.decimal(t.precision, t.scale)
+        jval = None if validity is None else jnp.asarray(validity)
+        if dt.kind == c.Kind.DECIMAL128:
+            return c.Decimal128Column(
+                jnp.asarray(hi), jnp.asarray(lo), jval, dt)
+        # p<=18 fits the low limb exactly (int64 two's complement)
+        unscaled = lo.view("<i8")
+        if dt.kind == c.Kind.DECIMAL32:
+            unscaled = unscaled.astype(np.int32)
+        return c.Column(jnp.asarray(unscaled), jval, dt)
     if pa.types.is_int32(t):
         np_vals, dt = arr.fill_null(0).to_numpy().astype(np.int32), c.INT32
     elif pa.types.is_int64(t):
@@ -138,7 +161,13 @@ def _table_columns(table, columns, as_numpy: bool) -> Dict[str, object]:
             if pa.types.is_string(arr.type):
                 vals = [v.as_py() if v.is_valid else None for v in arr]
             else:
-                vals = arr.fill_null(0).to_numpy()
+                filled = arr.fill_null(0)
+                try:
+                    # decimals need an explicit copy (object array of
+                    # decimal.Decimal); numeric types stay zero-copy
+                    vals = filled.to_numpy(zero_copy_only=False)
+                except TypeError:  # ChunkedArray.to_numpy always copies
+                    vals = filled.to_numpy()
             out[name] = (vals, valid)
         else:
             out[name] = _arrow_to_column(col)

@@ -10,6 +10,7 @@ from spark_rapids_jni_tpu.models.q3 import (
     make_distributed_q3,
     q3_local,
     run_distributed_q3,
+    run_distributed_q3_columns,
 )
 from spark_rapids_jni_tpu.models.q5 import (
     Q5Row,
@@ -47,6 +48,7 @@ __all__ = [
     "make_distributed_q3",
     "q3_local",
     "run_distributed_q3",
+    "run_distributed_q3_columns",
     "make_distributed_q5",
     "make_distributed_q97_columns",
     "q5_local",

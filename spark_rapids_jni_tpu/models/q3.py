@@ -36,6 +36,7 @@ from spark_rapids_jni_tpu.models.tpcds import Q3Data
 from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS
 
 __all__ = ["Q3Row", "q3_local", "make_distributed_q3", "run_distributed_q3",
+           "run_distributed_q3_columns", "q3_columns_host_oracle",
            "q3_working_set_bytes"]
 
 
@@ -244,3 +245,226 @@ def run_distributed_q3(mesh, data: Q3Data, *, budget=None, task_id: int = 0,
             budget, _facts(data), nbytes_of=nbytes_of, run=run,
             split=_split_facts, combine=combine)
     return _format(parts, data, geo["year0"])
+
+
+# ----------------------------------------------------------- columns variant
+# The real TPC-DS q3 selects i_brand (a STRING) and sums a DECIMAL money
+# column.  This variant puts both through the flagship governed distributed
+# path: ss_ext_sales_price flows as a Decimal128Column whose per-group SUM
+# is accumulated in 128-bit limb arithmetic on device (no int64 overflow at
+# any magnitude — reference decimal_utils.cu:32 chunked math, here as
+# 32-bit-safe segment sums recombined after the psum), and the brand
+# dimension is a device StringColumn whose result rows are RENDERED through
+# the string machinery (padded gather + strings_from_padded), not a host
+# list lookup.
+
+
+class _DecPartials(NamedTuple):
+    hi: jnp.ndarray  # int64[n_groups] high limb of the decimal sum
+    lo: jnp.ndarray  # uint64[n_groups] low limb
+    counts: jnp.ndarray  # int32[n_groups]
+
+
+def _dec_partials(ss_item, ss_date, price, item_brand, item_manufact,
+                  date_year, date_moy, *, n_brands: int, year0: int,
+                  n_years: int, date_sk0: int, manufact_id: int,
+                  moy: int) -> _DecPartials:
+    """Device body: 128-bit grouped money sum over nullable Columns.
+
+    The low limb is decomposed into 32-bit halves so segment sums stay
+    int64-exact for any batch under 2^31 rows; halves recombine into
+    (hi, lo) AFTER the cross-device psum (the psum is linear in the
+    decomposed sums).
+    """
+    i_idx = jnp.clip(ss_item.data - 1, 0, item_brand.shape[0] - 1)
+    d_idx = jnp.clip(ss_date.data - date_sk0, 0, date_year.shape[0] - 1)
+    ok = (
+        ss_item.is_valid() & ss_date.is_valid() & price.is_valid()
+        & (item_manufact[i_idx] == manufact_id)
+        & (date_moy[d_idx] == moy)
+    )
+    brand = item_brand[i_idx].astype(jnp.int32)
+    year_off = (date_year[d_idx] - year0).astype(jnp.int32)
+    group = jnp.clip(year_off, 0, n_years - 1) * n_brands + (brand - 1)
+    ngroups = n_years * n_brands
+
+    lo0 = (price.lo & jnp.uint64(0xFFFFFFFF)).astype(jnp.int64)
+    lo1 = (price.lo >> jnp.uint64(32)).astype(jnp.int64)
+
+    def seg(values, dtype=jnp.int64):
+        return jnp.zeros((ngroups,), dtype).at[group].add(
+            jnp.where(ok, values, 0), mode="drop")
+
+    s0 = jax.lax.psum(seg(lo0), (DATA_AXIS,))
+    s1 = jax.lax.psum(seg(lo1), (DATA_AXIS,))
+    sh = jax.lax.psum(seg(price.hi), (DATA_AXIS,))
+    counts = jax.lax.psum(seg(1, jnp.int32), (DATA_AXIS,))
+
+    # recombine: total = sh*2^64 + s1*2^32 + s0 (mod 2^128), s0/s1 >= 0
+    u = s1 + (s0 >> 32)
+    lo = ((u.astype(jnp.uint64) & jnp.uint64(0xFFFFFFFF))
+          << jnp.uint64(32)) | (s0.astype(jnp.uint64)
+                                & jnp.uint64(0xFFFFFFFF))
+    hi = sh + (u >> 32)
+    return _DecPartials(hi, lo, counts)
+
+
+@functools.lru_cache(maxsize=32)
+def _q3_columns_step_cached(mesh, geo_items: tuple):
+    from spark_rapids_jni_tpu.obs.seam import COMPILE, seam
+
+    geo = dict(geo_items)
+    with seam(COMPILE, "q3_columns_step"):
+        def body(ss_item, ss_date, price, item_brand, item_manufact,
+                 date_year, date_moy):
+            return _dec_partials(ss_item, ss_date, price, item_brand,
+                                 item_manufact, date_year, date_moy, **geo)
+
+        step = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(DATA_AXIS),) * 3 + (P(),) * 4,
+            out_specs=_DecPartials(P(), P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(step)
+
+
+def _price_limbs(price: np.ndarray):
+    """int64 cents -> two's-complement (hi, lo) 64-bit limb arrays."""
+    lo = price.astype(np.int64).view(np.uint64)
+    hi = np.where(price < 0, np.int64(-1), np.int64(0))
+    return hi, lo
+
+
+def q3_columns_host_oracle(data: Q3Data) -> List[Q3Row]:
+    """Arbitrary-precision host oracle (python ints — exact at magnitudes
+    where the int64 oracle in q3_local would overflow)."""
+    geo = _geometry(data)
+    sums: dict = {}
+    counts: dict = {}
+    for i in range(len(data.ss_item_sk)):
+        if not (data.ss_item_sk_valid[i] and data.ss_sold_date_sk_valid[i]):
+            continue
+        isk = int(data.ss_item_sk[i])
+        dsk = int(data.ss_sold_date_sk[i]) - geo["date_sk0"]
+        if not (1 <= isk <= len(data.item_sk)) or \
+                not (0 <= dsk < len(data.date_year)):
+            continue
+        if int(data.item_manufact_id[isk - 1]) != geo["manufact_id"]:
+            continue
+        if int(data.date_moy[dsk]) != geo["moy"]:
+            continue
+        key = (int(data.date_year[dsk]), int(data.item_brand_id[isk - 1]))
+        sums[key] = sums.get(key, 0) + int(data.ss_ext_sales_price[i])
+        counts[key] = counts.get(key, 0) + 1
+    rows = [Q3Row(y, b, data.brand_names[b - 1], s)
+            for (y, b), s in sums.items()]
+    rows.sort(key=lambda r: (r.d_year, -r.sum_agg, r.brand_id))
+    return rows
+
+
+def run_distributed_q3_columns(mesh, data: Q3Data, *, budget=None,
+                               task_id: int = 0,
+                               manage_task: bool = True) -> List[Q3Row]:
+    """Governed distributed q3 with Decimal128Column money and a
+    StringColumn brand dimension.
+
+    Same protocol as :func:`run_distributed_q3` (admission, RetryOOM,
+    row-split SplitAndRetryOOM) but per-group sums are exact at ANY
+    magnitude (128-bit limbs; combine in python ints), and the result
+    brand strings are gathered from the device StringColumn via the
+    padded-view machinery.
+    """
+    import contextlib
+
+    from spark_rapids_jni_tpu.columnar.column import (
+        Column,
+        Decimal128Column,
+        strings_column,
+        strings_from_padded,
+    )
+    from spark_rapids_jni_tpu.columnar.dtypes import INT32, decimal
+    from spark_rapids_jni_tpu.mem.governed import (
+        default_device_budget,
+        run_with_split_retry,
+        task_context,
+    )
+
+    from jax.sharding import NamedSharding
+
+    geo = _geometry(data)
+    dp = mesh.shape[DATA_AXIS]
+    step = _q3_columns_step_cached(mesh, tuple(sorted(geo.items())))
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    rep = NamedSharding(mesh, P())
+    dims = {k: jax.device_put(v, rep) for k, v in _dims(data).items()}
+    brands = strings_column(data.brand_names)  # the STRING dimension
+
+    hi0, lo0 = _price_limbs(data.ss_ext_sales_price)
+    facts = dict(
+        ss_item=data.ss_item_sk, ss_item_v=data.ss_item_sk_valid,
+        ss_date=data.ss_sold_date_sk, ss_date_v=data.ss_sold_date_sk_valid,
+        price_hi=hi0, price_lo=lo0,
+    )
+
+    def nbytes_of(f):
+        return q3_working_set_bytes(f, dp)
+
+    def run(f):
+        from spark_rapids_jni_tpu.obs.seam import COLLECTIVE, TRANSFER, seam
+
+        padded = _pad_facts(f, dp)
+        with seam(TRANSFER, "q3_columns_batch_upload"):
+            put = lambda v: jax.device_put(  # noqa: E731
+                np.ascontiguousarray(v), sharding)
+            ss_item = Column(put(padded["ss_item"]),
+                             put(padded["ss_item_v"]), INT32)
+            ss_date = Column(put(padded["ss_date"]),
+                             put(padded["ss_date_v"]), INT32)
+            price = Decimal128Column(
+                put(padded["price_hi"]), put(padded["price_lo"]),
+                None, decimal(38, 2))
+        with seam(COLLECTIVE, "launch:q3_columns_step"):
+            out = step(ss_item, ss_date, price, *dims.values())
+            jax.block_until_ready(out)
+        hi = np.asarray(out.hi)
+        lo = np.asarray(out.lo)
+        sums = [int(h) * (1 << 64) + int(x)
+                for h, x in zip(hi.astype(np.int64), lo.astype(np.uint64))]
+        return sums, np.asarray(out.counts)
+
+    def combine(results):
+        sums = [sum(r[0][g] for r in results)
+                for g in range(len(results[0][0]))]
+        counts = sum(r[1] for r in results)
+        return sums, counts
+
+    budget = budget if budget is not None else default_device_budget()
+    ctx = (task_context(budget.gov, task_id) if manage_task
+           else contextlib.nullcontext())
+    with ctx:
+        sums, counts = run_with_split_retry(
+            budget, facts, nbytes_of=nbytes_of, run=run,
+            split=_split_facts, combine=combine)
+
+    # result assembly: brand strings RENDERED from the device StringColumn.
+    # The gather length is pow2-quantized (pad rows gather row 0, sliced
+    # off after) so a long-lived executor sees a bounded shape-variant set,
+    # not one cached executable per distinct non-empty-group count.
+    from spark_rapids_jni_tpu.columnar.column import next_pow2
+
+    n_brands = len(data.brand_names)
+    groups = np.nonzero(counts)[0]
+    n_sel = len(groups)
+    sel_np = np.zeros(next_pow2(max(n_sel, 1)), np.int32)
+    sel_np[:n_sel] = (groups % n_brands).astype(np.int32)
+    padded, lens = brands.padded()
+    sel = jnp.asarray(sel_np)
+    rendered = strings_from_padded(padded[sel], lens[sel]).to_list()[:n_sel]
+    rows = [
+        Q3Row(geo["year0"] + int(g) // n_brands, int(g) % n_brands + 1,
+              name, sums[int(g)])
+        for g, name in zip(groups, rendered)
+    ]
+    rows.sort(key=lambda r: (r.d_year, -r.sum_agg, r.brand_id))
+    return rows

@@ -285,6 +285,70 @@ def test_nds_harness_input_streamed_mode(q97_files, capsys):
     assert "streamed" in out["queries"]["q5"]
 
 
+def test_parquet_decimal_roundtrip_with_nulls(tmp_path):
+    """Parquet DECIMAL(p, s) written then read back through the split
+    reader decodes to the framework's unscaled storage — int32/int64
+    Columns for p<=9/p<=18, Decimal128Column above — with validity intact
+    (VERDICT r4 #7; NativeParquetJni.cpp:102 decimal Tag tree parity)."""
+    import decimal as pydec
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_jni_tpu import columnar as c
+    from spark_rapids_jni_tpu.io import StructElement, ValueElement
+
+    def dec(s):
+        return None if s is None else pydec.Decimal(s)
+
+    small = [dec("12345.67"), None, dec("-0.01"), dec("99999.99")]
+    mid = [None, dec("9999999999999.99"), dec("-1234567890.05"), dec("0.00")]
+    big = [dec("9" * 28 + "." + "9" * 10), dec("-" + "8" * 20 + ".5"),
+           None, dec("0.0000000001")]
+    table = pa.table({
+        "m_small": pa.array(small, pa.decimal128(7, 2)),
+        "m_mid": pa.array(mid, pa.decimal128(15, 2)),
+        "m_big": pa.array(big, pa.decimal128(38, 10)),
+        "k": pa.array([1, 2, 3, 4], pa.int32()),
+    })
+    path = str(tmp_path / "money.parquet")
+    pq.write_table(table, path, row_group_size=2)
+
+    schema = (StructElement.builder()
+              .add_child("m_small", ValueElement())
+              .add_child("m_mid", ValueElement())
+              .add_child("m_big", ValueElement())
+              .build())
+    out = {}
+    for off, length in plan_byte_splits(path, 2):
+        part = read_split(path, off, length, schema)
+        for name, col in part.items():
+            out.setdefault(name, []).append(col)
+
+    def unscaled(vals, scale):
+        # exact scaleb: the default Decimal context would round 38-digit
+        # values to 28 significant digits
+        with pydec.localcontext() as ctx:
+            ctx.prec = 80
+            return [None if v is None else int(v.scaleb(scale))
+                    for v in vals]
+
+    got_small = [v for col in out["m_small"] for v in col.to_list()]
+    assert isinstance(out["m_small"][0], c.Column)
+    assert out["m_small"][0].dtype.kind == c.Kind.DECIMAL32
+    assert out["m_small"][0].dtype.scale == 2
+    assert got_small == unscaled(small, 2)
+
+    got_mid = [v for col in out["m_mid"] for v in col.to_list()]
+    assert out["m_mid"][0].dtype.kind == c.Kind.DECIMAL64
+    assert got_mid == unscaled(mid, 2)
+
+    assert isinstance(out["m_big"][0], c.Decimal128Column)
+    assert out["m_big"][0].dtype.precision == 38
+    got_big = [v for col in out["m_big"] for v in col.unscaled_to_list()]
+    assert got_big == unscaled(big, 10)
+
+
 def test_harness_parquet_read_excludes_null_keys(tmp_path):
     """NULL join keys in parquet must be excluded from q97, not counted
     as key 0 (q97_host_oracle non-null semantics)."""

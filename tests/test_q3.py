@@ -72,3 +72,84 @@ def test_q3_governed_split_still_exact():
         splits = budget.gov.get_and_reset_num_split_retry(7)
     assert got == _oracle(data)
     assert splits >= 1, "the injected split must actually have happened"
+
+
+def test_q3_columns_matches_local_with_negatives():
+    """The columns variant (Decimal128 money + device StringColumn brand
+    render) must equal the int64 path, including negative money."""
+    import dataclasses
+
+    import numpy as np
+
+    from spark_rapids_jni_tpu.models import run_distributed_q3_columns
+    from spark_rapids_jni_tpu.parallel.mesh import make_mesh
+
+    base = generate_q3_data(sf=0.02, seed=5)
+    rng = np.random.RandomState(2)
+    price = base.ss_ext_sales_price.copy()
+    neg = rng.rand(len(price)) < 0.3
+    price[neg] = -price[neg] - 1
+    data = dataclasses.replace(base, ss_ext_sales_price=price)
+
+    mesh = make_mesh((8, 1))
+    got = [tuple(r) for r in run_distributed_q3_columns(mesh, data)]
+    assert got == [tuple(r) for r in q3_local(data)]
+    assert got, "filter should not be empty at this sf/seed"
+
+
+@pytest.mark.slow
+def test_q3_columns_128bit_sums_beyond_int64():
+    """Group sums beyond int64 range: the 128-bit limb accumulation must
+    stay exact where the int64 path would wrap (verified against an
+    arbitrary-precision python oracle)."""
+    import dataclasses
+
+    import numpy as np
+
+    from spark_rapids_jni_tpu.models import run_distributed_q3_columns
+    from spark_rapids_jni_tpu.models.q3 import q3_columns_host_oracle
+    from spark_rapids_jni_tpu.parallel.mesh import make_mesh
+
+    base = generate_q3_data(sf=0.05, seed=9)
+    # ~62-bit prices: a handful of rows per group overflow int64 sums
+    price = np.full(len(base.ss_ext_sales_price), (1 << 62) + 12345,
+                    np.int64)
+    data = dataclasses.replace(base, ss_ext_sales_price=price)
+
+    mesh = make_mesh((8, 1))
+    got = run_distributed_q3_columns(mesh, data)
+    want = q3_columns_host_oracle(data)
+    assert [tuple(r) for r in got] == [tuple(r) for r in want]
+    assert any(r.sum_agg > (1 << 63) for r in got), \
+        "the fixture must actually exceed int64 (else this proves nothing)"
+
+
+@pytest.mark.slow
+def test_q3_columns_governed_split_still_exact():
+    """SplitAndRetryOOM on the columns variant: python-int combine across
+    split pieces stays exact."""
+    import dataclasses
+
+    import numpy as np
+
+    from spark_rapids_jni_tpu.mem.governed import (
+        default_device_budget,
+        task_context,
+    )
+    from spark_rapids_jni_tpu.models import run_distributed_q3_columns
+    from spark_rapids_jni_tpu.models.q3 import q3_columns_host_oracle
+    from spark_rapids_jni_tpu.parallel.mesh import make_mesh
+
+    base = generate_q3_data(sf=0.05, seed=9)
+    price = np.full(len(base.ss_ext_sales_price), (1 << 61) + 7, np.int64)
+    data = dataclasses.replace(base, ss_ext_sales_price=price)
+    mesh = make_mesh((8, 1))
+    budget = default_device_budget()
+    with task_context(budget.gov, 11):
+        budget.gov.force_split_and_retry_oom(num_ooms=1)
+        got = run_distributed_q3_columns(
+            mesh, data, budget=budget, task_id=11, manage_task=False)
+        splits = budget.gov.get_and_reset_num_split_retry(11)
+    assert [tuple(r) for r in got] == \
+        [tuple(r) for r in q3_columns_host_oracle(data)]
+    assert splits >= 1
