@@ -75,18 +75,31 @@ def _partials(ss_item, ss_item_v, ss_date, ss_date_v, price,
     return _Partials(sums, counts)
 
 
-def _format(parts: _Partials, data: Q3Data, year0: int) -> List[Q3Row]:
-    """Host: drop empty groups, order by (d_year, sum desc, brand_id)."""
-    n_brands = len(data.brand_names)
-    sums = np.asarray(parts.sums)
-    counts = np.asarray(parts.counts)
-    rows: List[Q3Row] = []
-    for g in np.nonzero(counts)[0]:
-        year = year0 + int(g) // n_brands
-        b = int(g) % n_brands + 1
-        rows.append(Q3Row(year, b, data.brand_names[b - 1], int(sums[g])))
+def _assemble_rows(counts: np.ndarray, sum_of, year0: int, n_brands: int,
+                   render_brands) -> List[Q3Row]:
+    """Shared result assembly: drop empty groups, decode the group grid
+    (year = year0 + g//n_brands, brand = g%n_brands + 1), attach brand
+    names via ``render_brands(zero_based_idx_array)``, order by
+    (d_year, sum desc, brand_id) — ONE owner of the grid layout and sort
+    rule for both the int64 and the decimal-columns variants."""
+    groups = np.nonzero(counts)[0]
+    names = render_brands((groups % n_brands).astype(np.int32))
+    rows = [
+        Q3Row(year0 + int(g) // n_brands, int(g) % n_brands + 1,
+              name, sum_of(int(g)))
+        for g, name in zip(groups, names)
+    ]
     rows.sort(key=lambda r: (r.d_year, -r.sum_agg, r.brand_id))
     return rows
+
+
+def _format(parts: _Partials, data: Q3Data, year0: int) -> List[Q3Row]:
+    """Host: int64-partials formatting (host-list brand lookup)."""
+    sums = np.asarray(parts.sums)
+    return _assemble_rows(
+        np.asarray(parts.counts), lambda g: int(sums[g]), year0,
+        len(data.brand_names),
+        lambda idx: [data.brand_names[i] for i in idx])
 
 
 def _geometry(data: Q3Data):
@@ -251,9 +264,12 @@ def run_distributed_q3(mesh, data: Q3Data, *, budget=None, task_id: int = 0,
 # The real TPC-DS q3 selects i_brand (a STRING) and sums a DECIMAL money
 # column.  This variant puts both through the flagship governed distributed
 # path: ss_ext_sales_price flows as a Decimal128Column whose per-group SUM
-# is accumulated in 128-bit limb arithmetic on device (no int64 overflow at
-# any magnitude — reference decimal_utils.cu:32 chunked math, here as
-# 32-bit-safe segment sums recombined after the psum), and the brand
+# is accumulated in 128-bit limb arithmetic on device — exact mod 2^128,
+# i.e. for every total that fits int128 (reference decimal_utils.cu:32
+# chunked math; here the unsigned low limb is decomposed into 32-bit-safe
+# segment sums recombined after the psum, while the top limb accumulates
+# with ordinary wrapping int64 adds, which ARE mod-2^64 adds and therefore
+# modularly correct for the high limb at any magnitude).  The brand
 # dimension is a device StringColumn whose result rows are RENDERED through
 # the string machinery (padded gather + strings_from_padded), not a host
 # list lookup.
@@ -271,10 +287,13 @@ def _dec_partials(ss_item, ss_date, price, item_brand, item_manufact,
                   moy: int) -> _DecPartials:
     """Device body: 128-bit grouped money sum over nullable Columns.
 
-    The low limb is decomposed into 32-bit halves so segment sums stay
-    int64-exact for any batch under 2^31 rows; halves recombine into
-    (hi, lo) AFTER the cross-device psum (the psum is linear in the
-    decomposed sums).
+    The low limb is decomposed into 32-bit halves so its carries are
+    recoverable (segment sums stay int64-exact for any batch under 2^31
+    rows); halves recombine into (hi, lo) AFTER the cross-device psum
+    (the psum is linear in the decomposed sums).  The HIGH limb needs no
+    decomposition: it is the top limb, so a wrapping int64 accumulation
+    is exactly the required mod-2^64 arithmetic — intermediate wraps
+    cannot corrupt a total that fits int128.
     """
     i_idx = jnp.clip(ss_item.data - 1, 0, item_brand.shape[0] - 1)
     d_idx = jnp.clip(ss_date.data - date_sk0, 0, date_year.shape[0] - 1)
@@ -370,10 +389,11 @@ def run_distributed_q3_columns(mesh, data: Q3Data, *, budget=None,
     StringColumn brand dimension.
 
     Same protocol as :func:`run_distributed_q3` (admission, RetryOOM,
-    row-split SplitAndRetryOOM) but per-group sums are exact at ANY
-    magnitude (128-bit limbs; combine in python ints), and the result
-    brand strings are gathered from the device StringColumn via the
-    padded-view machinery.
+    row-split SplitAndRetryOOM) but per-group sums are exact for every
+    total that fits int128 — far beyond the int64 path's range (128-bit
+    limbs on device; combine in python ints) — and the result brand
+    strings are gathered from the device StringColumn via the padded-view
+    machinery.
     """
     import contextlib
 
@@ -447,24 +467,21 @@ def run_distributed_q3_columns(mesh, data: Q3Data, *, budget=None,
             budget, facts, nbytes_of=nbytes_of, run=run,
             split=_split_facts, combine=combine)
 
-    # result assembly: brand strings RENDERED from the device StringColumn.
-    # The gather length is pow2-quantized (pad rows gather row 0, sliced
-    # off after) so a long-lived executor sees a bounded shape-variant set,
-    # not one cached executable per distinct non-empty-group count.
+    # result assembly shares _assemble_rows; brand strings are RENDERED
+    # from the device StringColumn.  The gather length is pow2-quantized
+    # (pad rows gather row 0, sliced off after) so a long-lived executor
+    # sees a bounded shape-variant set, not one cached executable per
+    # distinct non-empty-group count.
     from spark_rapids_jni_tpu.columnar.column import next_pow2
 
-    n_brands = len(data.brand_names)
-    groups = np.nonzero(counts)[0]
-    n_sel = len(groups)
-    sel_np = np.zeros(next_pow2(max(n_sel, 1)), np.int32)
-    sel_np[:n_sel] = (groups % n_brands).astype(np.int32)
-    padded, lens = brands.padded()
-    sel = jnp.asarray(sel_np)
-    rendered = strings_from_padded(padded[sel], lens[sel]).to_list()[:n_sel]
-    rows = [
-        Q3Row(geo["year0"] + int(g) // n_brands, int(g) % n_brands + 1,
-              name, sums[int(g)])
-        for g, name in zip(groups, rendered)
-    ]
-    rows.sort(key=lambda r: (r.d_year, -r.sum_agg, r.brand_id))
-    return rows
+    def render_brands(idx: np.ndarray):
+        n_sel = len(idx)
+        sel_np = np.zeros(next_pow2(max(n_sel, 1)), np.int32)
+        sel_np[:n_sel] = idx
+        padded, lens = brands.padded()
+        sel = jnp.asarray(sel_np)
+        return strings_from_padded(
+            padded[sel], lens[sel]).to_list()[:n_sel]
+
+    return _assemble_rows(counts, lambda g: sums[g], geo["year0"],
+                          len(data.brand_names), render_brands)

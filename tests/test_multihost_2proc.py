@@ -29,20 +29,31 @@ def _free_port() -> int:
     return port
 
 
-def test_two_process_group_runs_distributed_q97():
+def _run_group_with_port_retry(nproc: int):
     # one retry with a fresh port, ONLY for the _free_port close-then-bind
     # race; real failures (wrong results, hangs) must surface first-run
     try:
-        _run_group_once()
+        _run_group_once(nproc)
     except AssertionError as e:
         markers = ("Address already in use", "Failed to bind", "UNAVAILABLE")
         if any(m in str(e) for m in markers):
-            _run_group_once()
+            _run_group_once(nproc)
         else:
             raise
 
 
-def _run_group_once():
+def test_two_process_group_runs_distributed_q97():
+    _run_group_with_port_retry(2)
+
+
+def test_four_process_group_runs_distributed_q97():
+    """Pod-shape evidence past 2 processes: a 4-process group (8 global
+    devices) runs the same shard_map program with cross-process
+    collectives (SURVEY §2.3 planning note; VERDICT r4 #9)."""
+    _run_group_with_port_retry(4)
+
+
+def _run_group_once(nproc: int):
     from conftest import scrubbed_cpu_env
 
     env = scrubbed_cpu_env(2)  # boot_cpu_mesh must not re-exec the workers
@@ -51,10 +62,10 @@ def _run_group_once():
     worker = os.path.join(_HERE, "multihost_worker.py")
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, str(pid), "2", coord],
+            [sys.executable, worker, str(pid), str(nproc), coord],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True)
-        for pid in (0, 1)
+        for pid in range(nproc)
     ]
     outs = []
     try:
@@ -66,7 +77,7 @@ def _run_group_once():
             assert p.returncode == 0, err.strip().splitlines()[-5:]
             outs.append(json.loads(out.strip().splitlines()[-1]))
     finally:
-        # a failure on worker 0 must not leak worker 1 blocked on the
+        # a failure on worker 0 must not leak the others blocked on the
         # dead coordinator for the rest of the session
         for q in procs:
             if q.poll() is None:
@@ -74,8 +85,8 @@ def _run_group_once():
 
     for rec in outs:
         assert rec["got"] == rec["want"], rec
-        assert rec["summary"]["process_count"] == 2
+        assert rec["summary"]["process_count"] == nproc
         assert rec["summary"]["local_devices"] == 2
-        assert rec["summary"]["global_devices"] == 4
-    # the two processes saw the same global result
-    assert outs[0]["got"] == outs[1]["got"]
+        assert rec["summary"]["global_devices"] == 2 * nproc
+    # every process saw the same global result
+    assert all(rec["got"] == outs[0]["got"] for rec in outs)

@@ -153,3 +153,48 @@ def test_q3_columns_governed_split_still_exact():
     assert [tuple(r) for r in got] == \
         [tuple(r) for r in q3_columns_host_oracle(data)]
     assert splits >= 1
+
+
+def test_q3_dec_partials_hi_limb_wrap_is_modular_exact():
+    """The top limb accumulates with wrapping int64 adds; this is exact
+    mod 2^64 — a group whose intermediate hi-limb sum crosses the int64
+    boundary (A + A with hi(A)=2^62, then -A) must still produce the
+    exact int128 total A."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from spark_rapids_jni_tpu.columnar.column import (
+        Column,
+        decimal128_column,
+    )
+    from spark_rapids_jni_tpu.columnar.dtypes import INT32
+    from spark_rapids_jni_tpu.models.q3 import _q3_columns_step_cached
+    from spark_rapids_jni_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh((8, 1))
+    A = (1 << 126) + 5
+    assert 2 * (A >> 64) > (1 << 63) - 1, \
+        "fixture must force an intermediate int64 wrap in the hi sums"
+    prices = decimal128_column([A, A, -A, 0, 0, 0, 0, 0], 38, 2)
+    ones = np.ones(8, np.int32)
+    geo = dict(n_brands=1, year0=2000, n_years=1, date_sk0=0,
+               manufact_id=1, moy=11)
+    step = _q3_columns_step_cached(mesh, tuple(sorted(geo.items())))
+
+    sharded = NamedSharding(mesh, P("data"))
+    rep = NamedSharding(mesh, P())
+    put = lambda x, s: jax.device_put(x, s)  # noqa: E731
+    out = step(
+        Column(put(ones, sharded), None, INT32),
+        Column(put(np.zeros(8, np.int32), sharded), None, INT32),
+        jax.tree.map(lambda x: put(x, sharded), prices),
+        put(np.asarray([1], np.int32), rep),
+        put(np.asarray([1], np.int32), rep),
+        put(np.asarray([2000], np.int32), rep),
+        put(np.asarray([11], np.int32), rep),
+    )
+    jax.block_until_ready(out)
+    total = int(np.asarray(out.hi)[0]) * (1 << 64) + int(np.asarray(out.lo)[0])
+    assert total == A, (total, A)
+    assert int(np.asarray(out.counts)[0]) == 8

@@ -390,10 +390,13 @@ def test_streaming_q5_oversized_bucket_splits(tmp_path):
 
 
 @pytest.mark.slow
-def test_bucket_ownership_partitions_across_processes():
-    """The pod-scale deployment shape: two OS processes ('host groups')
+@pytest.mark.parametrize("nprocs,buckets", [(2, 8), (4, 10)])
+def test_bucket_ownership_partitions_across_processes(nprocs, buckets):
+    """The pod-scale deployment shape: N OS processes ('host groups')
     each execute only the buckets they OWN over the same chunk stream;
-    the sum of their partials equals the global q97 answer."""
+    the sum of their partials equals the global q97 answer.  The (4, 10)
+    case has an owner count that does NOT divide n_buckets, so owners
+    carry unequal bucket shares ({0,4,8}, {1,5,9}, {2,6}, {3,7})."""
     import json
     import os
     import subprocess
@@ -401,7 +404,7 @@ def test_bucket_ownership_partitions_across_processes():
 
     from spark_rapids_jni_tpu.models.q97 import q97_host_oracle
 
-    sf, chunk_rows, buckets = 0.002, 2000, 8
+    sf, chunk_rows = 0.002, 2000
     chunks = list(generate_q97_chunks(sf, seed=13, chunk_rows=chunk_rows))
     store = (np.concatenate([c for s, c, _ in chunks if s == "store"]),
              np.concatenate([i for s, _, i in chunks if s == "store"]))
@@ -419,9 +422,9 @@ def test_bucket_ownership_partitions_across_processes():
     rows_seen = set()
     # sequential on the 1-core box: the contract under test is the
     # bucket-space partitioning, not wall-clock parallelism
-    for pid in (0, 1):
+    for pid in range(nprocs):
         r = subprocess.run(
-            [sys.executable, worker, str(pid), "2", str(sf),
+            [sys.executable, worker, str(pid), str(nprocs), str(sf),
              str(chunk_rows), str(buckets)],
             env=env, capture_output=True, text=True, timeout=600)
         assert r.returncode == 0, r.stderr[-1500:]
