@@ -46,6 +46,16 @@ def main(argv=None) -> int:
     ap.add_argument("--iters", type=int, default=0,
                     help="stop after N iterations instead of a deadline")
     ap.add_argument("-o", "--output", default="-")
+    ap.add_argument("--vary", action="store_true",
+                    help="draw batch sizes per iteration (pow2-lattice "
+                         "workout: RSS must PLATEAU once the bounded "
+                         "shape-variant set saturates, not grow linearly)")
+    ap.add_argument("--profile", default="",
+                    help="run with the profiler ON, writing the seam-range "
+                         "trace to this path (profiler-on endurance)")
+    ap.add_argument("--stream-every", type=int, default=0,
+                    help="every N iters run a full streamed-q97 lifecycle "
+                         "(spill -> governed buckets -> close)")
     args = ap.parse_args(argv)
 
     import numpy as np
@@ -76,8 +86,13 @@ def main(argv=None) -> int:
     mesh = make_mesh((len(jax.devices()), 1))
     gov = MemoryGovernor.initialize()
     budget = BudgetedResource(gov, 4 << 30)
+    if args.profile:
+        from spark_rapids_jni_tpu.obs.profiler import Profiler
+
+        Profiler.init(args.profile)
+        Profiler.start()
     deadline = time.time() + args.minutes * 60
-    n97 = 4096  # fixed shapes: steady state must not recompile
+    n97_fixed = 4096  # fixed shapes: steady state must not recompile
     rss0 = None
     it = 0
     samples = []
@@ -86,6 +101,15 @@ def main(argv=None) -> int:
             it += 1
             rng = np.random.RandomState(it)
             t0 = time.perf_counter()
+
+            if args.vary:
+                # log-uniform batch sizes: the executor's real life — the
+                # pow2 quantizers must bound the compile-variant set
+                n97 = int(2 ** rng.uniform(10, 15))
+                n_str = int(2 ** rng.uniform(7, 10))
+            else:
+                n97 = n97_fixed
+                n_str = 512
 
             store = (rng.randint(1, 300, n97).astype(np.int32),
                      rng.randint(1, 500, n97).astype(np.int32))
@@ -98,21 +122,50 @@ def main(argv=None) -> int:
                 emit({"iter": it, "error": "q97 mismatch", "got": got})
                 return 1
 
-            q5d = generate_q5_data(sf=0.002, seed=it)
+            q5_sf = float(rng.uniform(0.001, 0.02)) if args.vary else 0.002
+            q5d = generate_q5_data(sf=q5_sf, seed=it)
             if run_distributed_q5(mesh, q5d, budget=budget,
                                   task_id=it) != q5_local(q5d):
                 emit({"iter": it, "error": "q5 mismatch"})
                 return 1
-            q3d = generate_q3_data(sf=0.01, seed=it)
+            q3_sf = float(rng.uniform(0.005, 0.05)) if args.vary else 0.01
+            q3d = generate_q3_data(sf=q3_sf, seed=it)
             if run_distributed_q3(mesh, q3d, budget=budget,
                                   task_id=it) != q3_local(q3d):
                 emit({"iter": it, "error": "q3 mismatch"})
                 return 1
 
-            # op batch at fixed bucket geometry (64-byte bucket)
+            if args.stream_every and it % args.stream_every == 0:
+                # full out-of-core lifecycle: spill files + governed
+                # buckets + close; a leak here compounds per query
+                import tempfile
+
+                from spark_rapids_jni_tpu.models.streaming import (
+                    generate_q97_chunks,
+                    run_streaming_q97,
+                )
+
+                host_budget = BudgetedResource(gov, 1 << 28, is_cpu=True)
+                with tempfile.TemporaryDirectory(prefix="soak_shuf_") as td:
+                    _counts, s_ver, s_stats = run_streaming_q97(
+                        mesh,
+                        generate_q97_chunks(sf=0.0005, seed=it,
+                                            chunk_rows=700),
+                        tmpdir=td, n_buckets=4, budget=budget,
+                        host_budget=host_budget, task_id=100000 + it,
+                        verify=True)
+                if s_ver is not True:
+                    emit({"iter": it, "error": "streamed q97 mismatch"})
+                    return 1
+                if host_budget.used != 0:
+                    emit({"iter": it, "error": "streamed host leak",
+                          "used": host_budget.used})
+                    return 1
+
+            # op batch (64-byte bucket geometry; rows vary with --vary)
             scol = c.strings_from_bytes(
                 [b"k%08d-%020d" % (rng.randint(1 << 30), i)
-                 for i in range(512)])
+                 for i in range(n_str)])
             murmur_hash32([scol], seed=42).data.block_until_ready()
             jrows = [b'{"a": {"b": [%d, %d]}, "c": "x%d"}'
                      % (i, i * 7, rng.randint(99)) for i in range(256)]
@@ -150,19 +203,31 @@ def main(argv=None) -> int:
             if not args.iters and time.time() > deadline:
                 break
     finally:
+        if args.profile:
+            from spark_rapids_jni_tpu.obs.profiler import Profiler
+
+            Profiler.stop()
+            Profiler.shutdown()
         MemoryGovernor.shutdown()
 
-    # linear RSS drift over the steady-state tail (drop warmup third)
+    def _drift(window):
+        if len(window) < 2:
+            return 0.0
+        ts = np.array([s[0] for s in window])
+        rs = np.array([s[1] for s in window])
+        return float(np.polyfit(ts - ts[0], rs, 1)[0]) * 3600.0
+
+    # linear RSS drift over the steady-state tail (drop warmup third),
+    # plus the LAST-third window alone: with --vary, warmup includes the
+    # whole pow2-lattice fill, so only the tail window shows whether RSS
+    # plateaus (asymptotic) or keeps climbing (a real leak)
     tail = samples[len(samples) // 3:]
-    drift = 0.0
-    if len(tail) >= 2:
-        ts = np.array([s[0] for s in tail])
-        rs = np.array([s[1] for s in tail])
-        drift = float(np.polyfit(ts - ts[0], rs, 1)[0]) * 3600.0
+    tail_window = samples[2 * len(samples) // 3:]
     emit({"summary": True, "iters": it,
           "rss_start_mb": round(rss0 or 0, 1),
           "rss_end_mb": round(samples[-1][1], 1),
-          "rss_drift_mb_per_h": round(drift, 2),
+          "rss_drift_mb_per_h": round(_drift(tail), 2),
+          "tail_window_drift_mb_per_h": round(_drift(tail_window), 2),
           "steady_wall_s": round(
               float(np.median([s[2] for s in tail])), 3) if tail else None})
     if out is not sys.stdout:
