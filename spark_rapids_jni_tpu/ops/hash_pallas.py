@@ -28,8 +28,15 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 _U32 = jnp.uint32
+
+# Index-map constants must be explicitly 32-bit: under jax_enable_x64 a
+# plain Python ``0`` lowers as i64 and Mosaic rejects the index-map
+# function ("failed to legalize 'func.return' (i32, i64)") — reproduced
+# and fixed against the live v5e backend (round 5).
+_I0 = np.int32(0)
 
 # VMEM block: 512 sublane-rows x 128 lanes of u32 = 256 KiB per operand.
 _BLOCK_ROWS = 512
@@ -224,7 +231,7 @@ def _launch(kern, *flat_u32):
     br = _block_rows_for(flat_u32[0].shape[0])
     blocks = [_to_blocks(x, _U32, br) for x in flat_u32]
     rows = blocks[0].shape[0]
-    spec = pl.BlockSpec((br, _LANES), lambda i: (i, 0),
+    spec = pl.BlockSpec((br, _LANES), lambda i: (i, _I0),
                         memory_space=pltpu.VMEM)
     shape = jax.ShapeDtypeStruct((rows, _LANES), _U32)
     out = pl.pallas_call(
@@ -285,13 +292,13 @@ def mm_bytes_words_pallas(words: jnp.ndarray, nwords: jnp.ndarray,
     wpad = jnp.pad(words, ((0, rows * _LANES - n), (0, 0)))
     w3 = wpad.T.reshape(lw, rows, _LANES)
 
-    row_spec = pl.BlockSpec((br, _LANES), lambda i, w: (i, 0),
+    row_spec = pl.BlockSpec((br, _LANES), lambda i, w: (i, _I0),
                             memory_space=pltpu.VMEM)
     out = pl.pallas_call(
         _bytes_words_kernel,
         grid=(rows // br, lw),
         in_specs=[
-            pl.BlockSpec((1, br, _LANES), lambda i, w: (w, i, 0),
+            pl.BlockSpec((1, br, _LANES), lambda i, w: (w, i, _I0),
                          memory_space=pltpu.VMEM),
             row_spec,
             row_spec,

@@ -74,7 +74,18 @@ for log2 in {sizes}:
     for name, (f, bpr) in ops.items():
         if name not in {ops_on!r}:  # ops_on is a tuple of op names
             continue
-        dt, info = time_marginal(lambda: f(d32), 5, 25)
+        # one op failing (e.g. a Pallas kernel that doesn't lower on this
+        # backend yet) must not cost the rest of the sweep a live-tunnel
+        # window: bank the real error line per-op and keep sweeping
+        try:
+            dt, info = time_marginal(lambda: f(d32), 5, 25)
+        except Exception as e:
+            # distinct stage: "sweep" records stay homogeneous (all carry
+            # Grows_s) for bench.py's replay selector and the e2e test
+            msg = str(e).strip().replace(chr(10), " | ")
+            emit({{"stage": "sweep-error", "op": name, "n_log2": log2,
+                  "error": f"{{type(e).__name__}}: {{msg[:500]}}"}})
+            continue
         emit({{"stage": "sweep", "op": name, "n_log2": log2,
               "us_per_call": round(dt * 1e6, 1),
               "Grows_s": round(n / dt / 1e9, 3),
@@ -124,6 +135,9 @@ def _stage_env() -> dict:
     cache stays off for the CPU test suite).
     """
     env = dict(os.environ)
+    # full tracebacks: the banked per-op/stage error line must be the real
+    # failure, not JAX's "frames removed" footer (round-5 sweep lesson)
+    env.setdefault("JAX_TRACEBACK_FILTERING", "off")
     # only cache when the platform is explicitly pinned to an accelerator:
     # an unpinned env could silently fall back to CPU mid-window and poison
     # the TPU cache dir with CPU entries (the conftest segfault class)
