@@ -14,9 +14,10 @@ big-endian Spark wire format (12-byte header {version=1, numHashes, numLongs}
 + numLongs big-endian int64s) exists only in ``serialize``/``deserialize``.
 Byte-level interchange with Spark/the reference is exact.
 
-Put uses sort + first-occurrence dedup + scatter-add (each distinct bit
-contributes one power of two, so add == or) instead of atomicOr, which has no
-TPU equivalent; probe is a pure gather + AND-reduce.
+Put scatter-``set``s each bit into a transient byte-per-bit array (set is
+idempotent, so duplicates need no dedup) and packs 64 bits/word with
+weighted row-sums, instead of atomicOr, which has no TPU equivalent; probe
+is per-hash 1-D gathers + AND-reduce.
 """
 
 from __future__ import annotations
@@ -68,15 +69,20 @@ def bloom_filter_create(num_hashes: int, bloom_filter_longs: int) -> BloomFilter
 
 
 def _bit_indices(values: jnp.ndarray, num_hashes: int, num_bits: int) -> jnp.ndarray:
-    """[n, num_hashes] bloom bit indices of int64 values (BloomFilterImpl.java:87-94).
+    """[num_hashes, n] bloom bit indices of int64 values (BloomFilterImpl.java:87-94).
 
     h1 = murmur3(long, 0); h2 = murmur3(long, h1); combined_i = h1 + i*h2
     (int32 wraparound), index = (combined < 0 ? ~combined : combined) % num_bits.
+
+    Hash-major layout: with n minor the TPU (8,128) tiling pads only the
+    small hash axis.  The value-major [n, num_hashes] orientation padded
+    each 3-wide row to a full tile — a measured 42.7x HBM expansion that
+    OOMed the v5e at n=2^24 (32 GB requested for a 768 MB gather).
     """
     h1 = _mm_hash_long(values, jnp.uint32(0)).astype(jnp.int32)
     h2 = _mm_hash_long(values, h1.astype(jnp.uint32)).astype(jnp.int32)
     ks = jnp.arange(1, num_hashes + 1, dtype=jnp.int32)
-    combined = h1[:, None] + ks[None, :] * h2[:, None]  # int32 wrap
+    combined = h1[None, :] + ks[:, None] * h2[None, :]  # int32 wrap
     positive = jnp.where(combined < 0, ~combined, combined)
     return (positive.astype(jnp.int64) % num_bits).astype(jnp.int64)
 
@@ -84,29 +90,31 @@ def _bit_indices(values: jnp.ndarray, num_hashes: int, num_bits: int) -> jnp.nda
 def bloom_filter_put(bloom_filter: BloomFilter, input: Column) -> BloomFilter:
     """Insert an INT64 column's non-null values; returns the updated filter.
 
-    Functional (returns a new pytree) rather than in-place atomicOr: scatter
-    the deduplicated bit masks with add (distinct powers of two sum == or).
+    Functional (returns a new pytree) rather than in-place atomicOr:
+    scatter-``set`` each bit into a num_bits-wide bit array (set is
+    idempotent, so duplicate bits need no dedup), then pack 64 bits/word
+    with a weighted row-sum (distinct powers of two sum == or).  Replaces
+    an earlier sort + first-occurrence-dedup + scatter-add design: the
+    50M-element sort dominated put at 2^24 keys (3.4 -> 53 Mrows/s
+    measured on the v5e, exact parity).
     """
     if input.dtype.kind != Kind.INT64:
         raise TypeError("bloom_filter_put requires an INT64 column")
     idx = _bit_indices(input.data, bloom_filter.num_hashes, bloom_filter.num_bits)
     if input.validity is not None:
-        # Route null rows' bits to a sentinel beyond the filter (dropped below).
-        idx = jnp.where(input.validity[:, None], idx, jnp.int64(bloom_filter.num_bits))
-    flat = jnp.sort(idx.reshape(-1))
-    first = jnp.concatenate(
-        [jnp.ones((1,), jnp.bool_), flat[1:] != flat[:-1]]
-    )
-    keep = first & (flat < bloom_filter.num_bits)
-    masks = jnp.where(
-        keep,
-        jnp.uint64(1) << (flat.astype(jnp.uint64) & jnp.uint64(63)),
-        jnp.uint64(0),
-    )
-    words = jnp.where(keep, flat >> 6, jnp.int64(0))  # masked-out rows add 0
-    # Scatter into a fresh zero array (dedup makes add == or there), then OR
-    # with the existing filter — adding into already-set bits would carry.
-    batch = jnp.zeros_like(bloom_filter.longs).at[words].add(masks, mode="drop")
+        # Route null rows' bits to a sentinel beyond the filter; the
+        # out-of-bounds scatter mode drops them.
+        idx = jnp.where(input.validity[None, :], idx, jnp.int64(bloom_filter.num_bits))
+    flat = idx.reshape(-1)
+    # Transient cost is per-BIT (uint8 bit array + two u32 half-packs),
+    # so huge runtime filters stay ~6 bytes/bit of HBM, not 12+.
+    bits = jnp.zeros((bloom_filter.num_bits,), jnp.uint8).at[flat].set(
+        1, mode="drop")
+    halves = bits.reshape(-1, 2, 32).astype(jnp.uint32)
+    w32 = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+    packed = (halves * w32[None, None, :]).sum(axis=2)  # [num_longs, 2]
+    batch = (packed[:, 0].astype(jnp.uint64)
+             | (packed[:, 1].astype(jnp.uint64) << jnp.uint64(32)))
     return dataclasses.replace(bloom_filter, longs=bloom_filter.longs | batch)
 
 
@@ -118,9 +126,14 @@ def bloom_filter_probe(input: Column, bloom_filter: BloomFilter) -> Column:
     if input.dtype.kind != Kind.INT64:
         raise TypeError("bloom_filter_probe requires an INT64 column")
     idx = _bit_indices(input.data, bloom_filter.num_hashes, bloom_filter.num_bits)
-    words = bloom_filter.longs[idx >> 6]
-    bits = (words >> (idx.astype(jnp.uint64) & jnp.uint64(63))) & jnp.uint64(1)
-    present = jnp.all(bits == 1, axis=1)
+    # Statically unrolled per-hash 1-D gathers: every intermediate stays
+    # [n] (clean lane tiling); num_hashes is a small static int.
+    present = None
+    for i in range(bloom_filter.num_hashes):
+        ii = idx[i]
+        words = bloom_filter.longs[ii >> 6]
+        hit = (words >> (ii.astype(jnp.uint64) & jnp.uint64(63))) & jnp.uint64(1)
+        present = (hit == 1) if present is None else (present & (hit == 1))
     return Column(present, input.validity, BOOL)
 
 
