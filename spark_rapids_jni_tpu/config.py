@@ -160,13 +160,19 @@ register("json_overlap_bytes", 64 << 20,
 register("hash_backend", "xla",
          "Backend for murmur3 fixed-width column contributions: 'xla' "
          "(fused elementwise ops) or 'pallas' (VMEM-blocked kernels, "
-         "ops/hash_pallas.py; interpret-mode off-TPU).",
+         "ops/hash_pallas.py; interpret-mode off-TPU). Default measured "
+         "on the v5e (round 5): XLA wins at bench size (78.2 vs 43.0 "
+         "Grows/s at 2^24; bench A/B in PERF_CAPTURE.jsonl), pallas "
+         "leads in a mid-size window (2^22) — see docs/PERF.md.",
          env="SRT_HASH_BACKEND")
 register("partition_hash", "murmur3",
          "Internal shuffle-placement hash (parallel/shuffle.partition_of, "
          "read at trace time): 'murmur3' (Spark's placement hash) or "
          "'mix32' (pure-u32 mix, ~1/3 the multiplies; placement is never "
-         "user-visible so Spark compatibility does not bind here).",
+         "user-visible so Spark compatibility does not bind here). "
+         "Default measured on the v5e (round 5): murmur3 23.9 vs mix32 "
+         "22.7 Grows/s — the multiply savings don't show at HBM-bound "
+         "sizes, so the Spark-compatible hash stays default.",
          env="SRT_PARTITION_HASH")
 register("watchdog_period_s", 0.1,
          "Memory-governor deadlock-watchdog poll period (the "
