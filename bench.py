@@ -519,7 +519,10 @@ def main():
             return get_json_object(jcol, "$.store.fruit[*].weight").chars
 
         dt = _time(run_path, max(iters // 8, 2))
-        return {"Mrows_per_s": round(nj / dt / 1e6, 2),
+        # rows_per_s too: this stage runs at krows/s on the axon backend
+        # (docs/PERF.md round-5), where 2-decimal Mrows/s reads as 0.0
+        return {"Mrows_per_s": round(nj / dt / 1e6, 4),
+                "rows_per_s": round(nj / dt, 1),
                 "GBps": round(total_bytes / dt / 1e9, 3),
                 "roofline_frac": _frac(total_bytes / dt)}
 

@@ -1047,7 +1047,7 @@ def _get_json_object_device(col: StringColumn, ptypes, pargs, names
             len_raw, len_esc, has_uni, neg0 = jrd.token_tables_device(
                 bi, kind, start, end)
             nm = jrd.name_matches_device(
-                bi, kind, start, len_raw, has_uni, names)
+                bi, kind, start, len_raw, has_uni, end, names)
             nm_stack = jnp.concatenate(
                 [jnp.stack(nm) if nm else jnp.zeros((0, nr, T), bool),
                  jnp.zeros((P1 - len(nm), nr, T), bool)])

@@ -217,33 +217,67 @@ def token_tables_device(bi: DByteInfo, kind, start, end):
 
 
 @functools.partial(jax.jit, static_argnums=(6,))
-def _name_match_one(bi: DByteInfo, kind, start, len_raw, has_uni, _unused,
+def _name_match_one(bi: DByteInfo, kind, start, len_raw, has_uni, end,
                     name: bytes):
-    """[n, T] bool: token payload unescapes to exactly ``name``."""
+    """[n, T] bool: token payload unescapes to exactly ``name``.
+
+    Two paths under one runtime ``lax.cond``:
+
+    - **fast** (no escape in any candidate payload, the overwhelmingly
+      common case): a [n, L] match table built from ``len(name)`` static
+      byte-shift compares (pure vector ops), then a single gather per
+      token.  A payload with no escapes and no unicode emits its raw
+      bytes verbatim, so raw-width == m plus byte equality is exact.
+    - **slow** (some candidate has a 2-byte escape): the original
+      per-character searchsorted walk through the cum_u emission mapping.
+
+    The round-5 device profile showed the searchsorted walk was 64% of a
+    warm get_json_object call on the v5e (134 s of 208 s at 2^18 rows) —
+    per-(token, char) gathers scalarize on TPU.  The fast path replaces
+    ~8 gather rounds per character with one gather per name.
+    """
     n, T = kind.shape
     L = bi.b.shape[1]
     rows = jnp.arange(n, dtype=_I64)[:, None]
-    is_str = (kind == jt.VALUE_STRING) | (kind == jt.FIELD_NAME)
+    # FIELD_NAME only: name matches are consumed solely at field-name
+    # tokens (the object-field step), and gating on string VALUES too
+    # would let a common escaped value disable the fast path batch-wide.
+    is_str = kind == jt.FIELD_NAME
     m = len(name)
     ok = is_str & ~has_uni & (len_raw == m)
     if m == 0:
         return ok
     ps = jnp.minimum(start.astype(_I64) + 1, L)
-    base = bi.cum_u[rows, ps]
-    for q, ch in enumerate(name):
-        tgt = base + q
-        si = jnp.minimum(_searchsorted_rows(bi.cum_u[:, 1:], tgt), L - 1)
-        k = (tgt - bi.cum_u[rows, si]).astype(_I32)
-        got = _emission_byte(bi, jnp.broadcast_to(rows, si.shape), si, k,
-                             escaped=False)
-        ok = ok & (got == ch)
-    return ok
+    raw_w = end.astype(_I64) - start.astype(_I64) - 2  # quoted payload width
+    no_esc = raw_w == m  # every non-unicode escape shrinks 2 raw -> 1 emitted
+
+    def fast(_):
+        bpad = jnp.pad(bi.b, ((0, 0), (0, m)))
+        table = jnp.ones((n, L), bool)
+        for q, ch in enumerate(name):
+            table = table & (bpad[:, q:q + L] == ch)
+        hit = jnp.take_along_axis(table, jnp.minimum(ps, L - 1), axis=1)
+        return ok & no_esc & hit
+
+    def slow(_):
+        base = bi.cum_u[rows, ps]
+        acc = ok
+        for q, ch in enumerate(name):
+            tgt = base + q
+            si = jnp.minimum(_searchsorted_rows(bi.cum_u[:, 1:], tgt), L - 1)
+            k = (tgt - bi.cum_u[rows, si]).astype(_I32)
+            got = _emission_byte(bi, jnp.broadcast_to(rows, si.shape), si, k,
+                                 escaped=False)
+            acc = acc & (got == ch)
+        return acc
+
+    return jax.lax.cond(jnp.any(ok & ~no_esc), slow, fast, 0)
 
 
-def name_matches_device(bi, kind, start, len_raw, has_uni, names):
+def name_matches_device(bi, kind, start, len_raw, has_uni, end, names):
     return [
         jnp.zeros(kind.shape, bool) if nm is None
-        else _name_match_one(bi, kind, start, len_raw, has_uni, 0, nm)
+        else _name_match_one(bi, kind, start, len_raw, has_uni, end, nm)
         for nm in names
     ]
 
