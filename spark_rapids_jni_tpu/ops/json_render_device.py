@@ -69,11 +69,19 @@ class DByteInfo(NamedTuple):
     cum_uni: jnp.ndarray
 
 
+# one-hot row read (rationale + measurement in its docstring)
+_take_rows = jt._take_rows
+
+
 def _searchsorted_rows(a, v):
-    """Per-row searchsorted-right: a [n, L] row-sorted, v [n, W] -> [n, W]."""
-    return jax.vmap(
-        functools.partial(jnp.searchsorted, side="right")
-    )(a, v)
+    """Per-row searchsorted-right: a [n, L] row-sorted, v [n, W] -> [n, W].
+
+    Implemented as a count of ``a[i, :] <= v[i, w]`` rather than binary
+    search: per-row dynamic gathers scalarize on TPU (round-5 device
+    profile), while the O(L*W) compare-and-sum is pure vector work that
+    XLA fuses without materializing the [n, L, W] intermediate.
+    """
+    return (a[:, :, None] <= v[:, None, :]).sum(axis=1, dtype=_I32)
 
 
 @jax.jit
@@ -145,13 +153,19 @@ def _utf8_byte(cp, ulen, k):
 
 
 def _emission_byte(bi: DByteInfo, ri, si, k, escaped: bool):
-    """Device port of get_json_object._emission_byte (same case logic)."""
-    c = bi.b[ri, si]
-    u = bi.cls_u[ri, si]
-    esc = bi.cls_esc[ri, si]
+    """Device port of get_json_object._emission_byte (same case logic).
+
+    ``ri`` is retained for signature stability but unused: all source
+    reads go through the one-hot ``_take_rows`` (si is row-aligned).
+    """
+    del ri
+    c = _take_rows(bi.b, si)
+    u = _take_rows(bi.cls_u, si)
+    esc = _take_rows(bi.cls_esc, si)
     if not escaped:
         out = jnp.where(esc, _UNESC_J[c], c)
-        out = jnp.where(u, _utf8_byte(bi.cp[ri, si], bi.ulen[ri, si], k), out)
+        out = jnp.where(u, _utf8_byte(_take_rows(bi.cp, si),
+                                      _take_rows(bi.ulen, si), k), out)
         return out.astype(_U8)
     is_ctrl = c < 32
     short = jnp.where(is_ctrl, _CTRL_SHORT_J[jnp.minimum(c, _U8(31))], _U8(0))
@@ -174,7 +188,8 @@ def _emission_byte(bi: DByteInfo, ri, si, k, escaped: bool):
     esc_out = jnp.where(two, jnp.where(k == 0, _U8(ord("\\")), c), _UNESC_J[c])
     esc_out = jnp.where((c == ord('"')) & (k == 1), _U8(ord('"')), esc_out)
     out = jnp.where(esc, esc_out, out)
-    out = jnp.where(u, _utf8_byte(bi.cp[ri, si], bi.ulen[ri, si], k), out)
+    out = jnp.where(u, _utf8_byte(_take_rows(bi.cp, si),
+                                  _take_rows(bi.ulen, si), k), out)
     return out.astype(_U8)
 
 
@@ -185,20 +200,19 @@ def token_tables_device(bi: DByteInfo, kind, start, end):
     L = bi.b.shape[1]
     s64 = start.astype(_I64)
     e64 = end.astype(_I64)
-    rows = jnp.arange(n, dtype=_I64)[:, None]
 
     is_str = (kind == jt.VALUE_STRING) | (kind == jt.FIELD_NAME)
     ps = jnp.minimum(s64 + 1, L)
     pe = jnp.clip(e64 - 1, 0, L)
-    pay_u = bi.cum_u[rows, pe] - bi.cum_u[rows, ps]
-    pay_e = bi.cum_e[rows, pe] - bi.cum_e[rows, ps]
-    has_uni = (bi.cum_uni[rows, pe] - bi.cum_uni[rows, ps]) > 0
+    pay_u = _take_rows(bi.cum_u, pe) - _take_rows(bi.cum_u, ps)
+    pay_e = _take_rows(bi.cum_e, pe) - _take_rows(bi.cum_e, ps)
+    has_uni = (_take_rows(bi.cum_uni, pe) - _take_rows(bi.cum_uni, ps)) > 0
 
     span = e64 - s64
     is_int = kind == jt.VALUE_NUMBER_INT
     neg0 = is_int & (span == 2) \
-        & (bi.b[rows, jnp.minimum(s64, L - 1)] == ord("-")) \
-        & (bi.b[rows, jnp.minimum(s64 + 1, L - 1)] == ord("0"))
+        & (_take_rows(bi.b, jnp.minimum(s64, L - 1)) == ord("-")) \
+        & (_take_rows(bi.b, jnp.minimum(s64 + 1, L - 1)) == ord("0"))
 
     one = (kind == jt.START_OBJECT) | (kind == jt.END_OBJECT) | \
         (kind == jt.START_ARRAY) | (kind == jt.END_ARRAY)
@@ -238,7 +252,6 @@ def _name_match_one(bi: DByteInfo, kind, start, len_raw, has_uni, end,
     """
     n, T = kind.shape
     L = bi.b.shape[1]
-    rows = jnp.arange(n, dtype=_I64)[:, None]
     # FIELD_NAME only: name matches are consumed solely at field-name
     # tokens (the object-field step), and gating on string VALUES too
     # would let a common escaped value disable the fast path batch-wide.
@@ -256,18 +269,17 @@ def _name_match_one(bi: DByteInfo, kind, start, len_raw, has_uni, end,
         table = jnp.ones((n, L), bool)
         for q, ch in enumerate(name):
             table = table & (bpad[:, q:q + L] == ch)
-        hit = jnp.take_along_axis(table, jnp.minimum(ps, L - 1), axis=1)
+        hit = _take_rows(table, jnp.minimum(ps, L - 1))
         return ok & no_esc & hit
 
     def slow(_):
-        base = bi.cum_u[rows, ps]
+        base = _take_rows(bi.cum_u, ps)
         acc = ok
         for q, ch in enumerate(name):
             tgt = base + q
             si = jnp.minimum(_searchsorted_rows(bi.cum_u[:, 1:], tgt), L - 1)
-            k = (tgt - bi.cum_u[rows, si]).astype(_I32)
-            got = _emission_byte(bi, jnp.broadcast_to(rows, si.shape), si, k,
-                                 escaped=False)
+            k = (tgt - _take_rows(bi.cum_u, si)).astype(_I32)
+            got = _emission_byte(bi, None, si, k, escaped=False)
             acc = acc & (got == ch)
         return acc
 
@@ -306,7 +318,10 @@ def _float_gather(b, kind, start, end, NF: int, WS: int):
 
     lane = jnp.arange(WS, dtype=_I64)[None, :]
     src = jnp.clip(fs[:, None] + lane, 0, L - 1)
-    raw = b[frow[:, None], src]
+    # whole-row gather (contiguous, embedding-shaped — TPU-friendly),
+    # then the one-hot in-row read; the fused 2-D b[frow, src] gather
+    # scalarized (round-5 profile: 4.4 s of the warm call)
+    raw = _take_rows(b[frow], src)
     flen_src = (fe - fs).astype(_I32)
     raw = jnp.where(lane < flen_src[:, None], raw, _U8(0))
     return raw, flen_src, fidx
@@ -338,9 +353,9 @@ def _float_render(bits):
     out_len = jnp.where(is_inf, lens + 2, lens)
     lane_w = jnp.arange(_FLOAT_W, dtype=_I64)[None, :]
     srcpos = jnp.clip(lane_w - is_inf[:, None], 0, padded.shape[1] - 1)
-    gathered = jnp.take_along_axis(
+    gathered = _take_rows(
         jnp.pad(padded, ((0, 0), (0, max(_FLOAT_W - padded.shape[1], 0)))),
-        srcpos, axis=1)
+        srcpos)
     in_text = (lane_w >= is_inf[:, None]) & \
         (lane_w < (lens + is_inf)[:, None])
     ftext = jnp.where(in_text, gathered, _U8(0))
@@ -404,13 +419,12 @@ def resolve_and_measure(segs, close_grp, close_dirty, close_nc, err,
     res_seen = jnp.zeros((n, S + 1), bool).at[
         rowsSn.reshape(-1), g.reshape(-1)].set(True, mode="drop")
 
-    rows = jnp.arange(n, dtype=_I32)[:, None]
     is_open = stype == _SEG_COND_OPEN
     is_close = stype == _SEG_COND_CLOSE
     gi = jnp.clip(sarg, 0, S)
-    seen = res_seen[rows, gi]
-    d = res_dirty[rows, gi]
-    nc = res_nc[rows, gi]
+    seen = _take_rows(res_seen, gi)
+    d = _take_rows(res_dirty, gi)
+    nc = _take_rows(res_nc, gi)
     open_id = jnp.where(
         d > 1, jnp.where(nc, _CONSTS.index(b",["), _CONSTS.index(b"[")),
         jnp.where((d == 1) & nc, _CONSTS.index(b","), _CONSTS.index(b"")))
@@ -427,13 +441,13 @@ def resolve_and_measure(segs, close_grp, close_dirty, close_nc, err,
     slen = jnp.zeros((n, S * 2), _I64)
     slen = jnp.where(stype == _SEG_CONST,
                      _CONST_LEN_J[jnp.clip(sarg, 0, len(_CONSTS) - 1)], slen)
-    slen = jnp.where(stype == _SEG_RAW_TOK, len_raw[rows, targ], slen)
-    slen = jnp.where(stype == _SEG_ESC_TOK, len_esc[rows, targ], slen)
-    is_float_tok = kind[rows, targ] == jt.VALUE_NUMBER_FLOAT
+    slen = jnp.where(stype == _SEG_RAW_TOK, _take_rows(len_raw, targ), slen)
+    slen = jnp.where(stype == _SEG_ESC_TOK, _take_rows(len_esc, targ), slen)
+    is_float_tok = _take_rows(kind, targ) == jt.VALUE_NUMBER_FLOAT
     tok_ref = (stype == _SEG_RAW_TOK) | (stype == _SEG_ESC_TOK)
     f_sel = tok_ref & is_float_tok
     NF = flen.shape[0]
-    fi = jnp.clip(fidx[rows, targ], 0, max(NF - 1, 0))
+    fi = jnp.clip(_take_rows(fidx, targ), 0, max(NF - 1, 0))
     if NF:
         slen = jnp.where(f_sel, flen[fi], slen)
 
@@ -453,17 +467,16 @@ def render_device(bi: DByteInfo, stype, sarg, segcum, out_len, err,
     T = kind.shape[1]
     L = bi.b.shape[1]
     S2 = stype.shape[1]
-    rows = jnp.arange(n, dtype=_I64)[:, None]
 
     j = jnp.broadcast_to(jnp.arange(W, dtype=_I64)[None, :], (n, W))
     si = jnp.minimum(_searchsorted_rows(segcum, j), S2 - 1)
-    prev = jnp.where(si > 0, segcum[rows, jnp.maximum(si - 1, 0)], 0)
+    prev = jnp.where(si > 0, _take_rows(segcum, jnp.maximum(si - 1, 0)), 0)
     d = j - prev
-    st = stype[rows, si]
-    sa = sarg[rows, si]
+    st = _take_rows(stype, si)
+    sa = _take_rows(sarg, si)
     ta = jnp.clip(sa, 0, T - 1)
-    tk = kind[rows, ta]
-    ts = start[rows, ta].astype(_I64)
+    tk = _take_rows(kind, ta)
+    ts = _take_rows(start, ta).astype(_I64)
 
     out = jnp.zeros((n, W), _U8)
     cm = st == _SEG_CONST
@@ -481,8 +494,8 @@ def render_device(bi: DByteInfo, stype, sarg, segcum, out_len, err,
     escm = st == _SEG_ESC_TOK
 
     im = tokm & is_int
-    n0 = neg0[rows, ta]
-    src_byte = bi.b[rows, jnp.clip(ts + d, 0, L - 1)]
+    n0 = _take_rows(neg0, ta)
+    src_byte = _take_rows(bi.b, jnp.clip(ts + d, 0, L - 1))
     out = jnp.where(im, jnp.where(n0, _U8(ord("0")), src_byte), out)
     sm = tokm & (one_char | lit)
     out = jnp.where(sm, src_byte, out)
@@ -490,7 +503,7 @@ def render_device(bi: DByteInfo, stype, sarg, segcum, out_len, err,
     NF = flen.shape[0]
     if NF:
         fm = tokm & is_float
-        fi2 = jnp.clip(fidx[rows, ta], 0, NF - 1)
+        fi2 = jnp.clip(_take_rows(fidx, ta), 0, NF - 1)
         out = jnp.where(
             fm, ftext[fi2, jnp.clip(d, 0, ftext.shape[1] - 1)], out)
 
@@ -498,23 +511,21 @@ def render_device(bi: DByteInfo, stype, sarg, segcum, out_len, err,
     ps = jnp.minimum(ts + 1, L)
     # raw (unescape) variant
     rm = strm & ~escm
-    base_u = bi.cum_u[rows, ps]
+    base_u = _take_rows(bi.cum_u, ps)
     tgt = base_u + d
     siU = jnp.minimum(_searchsorted_rows(bi.cum_u[:, 1:], tgt), L - 1)
-    kU = (tgt - bi.cum_u[rows, siU]).astype(_I32)
-    rbyte = _emission_byte(bi, jnp.broadcast_to(rows, siU.shape), siU, kU,
-                           False)
+    kU = (tgt - _take_rows(bi.cum_u, siU)).astype(_I32)
+    rbyte = _emission_byte(bi, None, siU, kU, False)
     out = jnp.where(rm, rbyte, out)
     # escaped variant: quote + payload + quote
     em = strm & escm
-    elen = len_esc[rows, ta]
+    elen = _take_rows(len_esc, ta)
     quote = (d == 0) | (d == elen - 1)
-    base_e = bi.cum_e[rows, ps]
+    base_e = _take_rows(bi.cum_e, ps)
     tgt_e = jnp.maximum(base_e + (d - 1), 0)
     siE = jnp.minimum(_searchsorted_rows(bi.cum_e[:, 1:], tgt_e), L - 1)
-    kE = (tgt_e - bi.cum_e[rows, siE]).astype(_I32)
-    ebyte = _emission_byte(bi, jnp.broadcast_to(rows, siE.shape), siE, kE,
-                           True)
+    kE = (tgt_e - _take_rows(bi.cum_e, siE)).astype(_I32)
+    ebyte = _emission_byte(bi, None, siE, kE, True)
     out = jnp.where(em, jnp.where(quote, _U8(ord('"')), ebyte), out)
 
     in_bounds = j < out_len[:, None]

@@ -79,9 +79,14 @@ def _run_scan(kind, match, ntok, ok, nm_stack, ptype, parg,
     rowsF = jnp.arange(F, dtype=_I32)[None, :]
     rowsG = jnp.arange(G, dtype=_I32)[None, :]
 
+    # All per-row "read at dynamic index" helpers delegate to the shared
+    # one-hot contraction (jt._take_rows): per-row dynamic gathers
+    # scalarize on TPU (round-5 device profile — they made this scan
+    # ~137 ms/step), while select+reduce over the small axis vectorizes.
+    rowsT = jnp.arange(T, dtype=_I32)[None, :]
+
     def top(arr, fp):
-        return jnp.take_along_axis(
-            arr, jnp.clip(fp, 0, F - 1)[:, None], axis=1)[:, 0]
+        return jt._take_rows(arr, jnp.clip(fp, 0, F - 1)[:, None])[:, 0]
 
     def set_top(arr, fp, mask, val):
         sel = (rowsF == jnp.clip(fp, 0, F - 1)[:, None]) & mask[:, None]
@@ -89,8 +94,7 @@ def _run_scan(kind, match, ntok, ok, nm_stack, ptype, parg,
         return jnp.where(sel, val[:, None], arr)
 
     def gtop(arr, gp):
-        return jnp.take_along_axis(
-            arr, jnp.clip(gp, 0, G - 1)[:, None], axis=1)[:, 0]
+        return jt._take_rows(arr, jnp.clip(gp, 0, G - 1)[:, None])[:, 0]
 
     def set_gtop(arr, gp, mask, val):
         sel = (rowsG == jnp.clip(gp, 0, G - 1)[:, None]) & mask[:, None]
@@ -98,12 +102,10 @@ def _run_scan(kind, match, ntok, ok, nm_stack, ptype, parg,
         return jnp.where(sel, val[:, None], arr)
 
     def kind_at(idx):
-        return jnp.take_along_axis(
-            kind, jnp.clip(idx, 0, T - 1)[:, None], axis=1)[:, 0]
+        return jt._take_rows(kind, jnp.clip(idx, 0, T - 1)[:, None])[:, 0]
 
     def match_at(idx):
-        return jnp.take_along_axis(
-            match, jnp.clip(idx, 0, T - 1)[:, None], axis=1)[:, 0]
+        return jt._take_rows(match, jnp.clip(idx, 0, T - 1)[:, None])[:, 0]
 
     def step(st, s):
         seg = jnp.zeros((n, 2, 2), _I32)
@@ -200,10 +202,12 @@ def _run_scan(kind, match, ntok, ok, nm_stack, ptype, parg,
         c4_field = c4 & ~close_obj
         # per-row name match at (path level, current token)
         lvl = jnp.clip(fpath, 0, P1 - 1)
-        nm_tok = jnp.take_along_axis(
-            nm_stack,
-            jnp.clip(st["tcur"], 0, T - 1)[None, :, None], axis=2)[:, :, 0]
-        nm = jnp.take_along_axis(nm_tok, lvl[None, :], axis=0)[0]
+        sel_t = rowsT[None, :, :] == jnp.clip(
+            st["tcur"], 0, T - 1)[None, :, None]          # [1, n, T]
+        nm_tok = jnp.where(sel_t, nm_stack, False).sum(axis=2) > 0  # [P1, n]
+        sel_p = (jnp.arange(P1, dtype=_I32)[:, None]
+                 == lvl[None, :])                          # [P1, n]
+        nm = jnp.where(sel_p, nm_tok, False).sum(axis=0) > 0
         found = fflag
         hit = c4_field & nm & ~found
         miss = c4_field & ~hit
