@@ -15,7 +15,7 @@ import sys
 
 MAX_LINE = 100
 ROOTS = ["spark_rapids_jni_tpu", "tests", "bench.py", "__graft_entry__.py",
-         "boot_cpu_mesh.py", "ci"]
+         "boot_cpu_mesh.py", "ci", "tools"]
 
 
 def iter_py_files(repo_root: str):

@@ -182,3 +182,9 @@ register("device_budget_bytes", 8 << 30,
          "Default HBM working-set admission budget for governed execution "
          "(mem/governed.py); the RMM pool-size analog.",
          env="SRT_DEVICE_BUDGET_BYTES")
+register("serve_workers", 4,
+         "Worker threads in the serving engine's executor pool "
+         "(serve/executor.py).", env="SRT_SERVE_WORKERS")
+register("serve_queue_size", 64,
+         "Admission-queue bound: submits past this depth are rejected "
+         "with backpressure (serve/queue.py).", env="SRT_SERVE_QUEUE_SIZE")

@@ -39,6 +39,7 @@ from spark_rapids_jni_tpu.mem.exceptions import (
 )
 from spark_rapids_jni_tpu.mem.governed import (
     MaxSplitDepthExceeded,
+    attempt_once,
     default_device_budget,
     reservation,
     run_with_split_retry,
@@ -54,6 +55,7 @@ __all__ = [
     "Arbiter",
     "BudgetedResource",
     "MaxSplitDepthExceeded",
+    "attempt_once",
     "default_device_budget",
     "reservation",
     "run_with_split_retry",

@@ -48,6 +48,7 @@ __all__ = [
     "task_context",
     "reservation",
     "run_with_split_retry",
+    "attempt_once",
     "default_device_budget",
     "MaxSplitDepthExceeded",
     "ShuffleCapacityExceeded",
@@ -236,12 +237,21 @@ def run_with_split_retry(
     return combine(results)
 
 
-def _attempt(gov, budget, piece, nbytes_of, run):
+def attempt_once(gov, budget, piece, nbytes_of, run, *,
+                 on_retry: Optional[Callable[[int], None]] = None,
+                 max_retries: int = 500):
     """One retry-block around one piece.
 
     Returns run's result; raises SplitAndRetryOOM / terminal OutOfBudget
     (request larger than the whole budget) for the caller to split, and
     passes ShuffleCapacityExceeded through for the caller to grow.
+
+    Public because it is the protocol bracket EVERY single-piece admission
+    goes through — :func:`run_with_split_retry` for inline splitting, and
+    the serving engine (serve/executor.py), which splits by re-queueing
+    halves instead.  ``on_retry(count)`` is called after each RetryOOM
+    (serve metrics / deadline checks); an exception it raises aborts the
+    attempt with the retry block closed cleanly.
     """
     nbytes = int(nbytes_of(piece))
     gov.start_retry_block()
@@ -258,9 +268,14 @@ def _attempt(gov, budget, piece, nbytes_of, run):
                 # livelock breaker) are bounded here, mirroring the
                 # reference's retry limit -> real OOM.
                 retries += 1
-                if retries >= 500:
+                if on_retry is not None:
+                    on_retry(retries)
+                if retries >= max_retries:
                     raise OutOfBudget(
-                        "retry limit exceeded (500) for one piece")
+                        f"retry limit exceeded ({max_retries}) for one piece")
                 continue
     finally:
         gov.end_retry_block()
+
+
+_attempt = attempt_once

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from spark_rapids_jni_tpu.ops.hashing import murmur3_raw_int64, xxhash64_raw_int64
-from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, axis_size, shard_map
 from spark_rapids_jni_tpu.parallel.shuffle import all_to_all_shuffle, partition_of
 
 
@@ -85,8 +85,8 @@ def local_query_step(keys: jnp.ndarray, values: jnp.ndarray, cfg: QueryStepConfi
 
 def _sharded_step(keys, values, cfg: QueryStepConfig):
     """The body run per device under shard_map over (data, model)."""
-    dp = jax.lax.axis_size(DATA_AXIS)
-    mp = jax.lax.axis_size(MODEL_AXIS)
+    dp = axis_size(DATA_AXIS)
+    mp = axis_size(MODEL_AXIS)
     m_idx = jax.lax.axis_index(MODEL_AXIS)
     n_local = keys.shape[0]
 
@@ -141,7 +141,7 @@ def _sharded_step(keys, values, cfg: QueryStepConfig):
 
 def make_distributed_query_step(mesh, cfg: QueryStepConfig):
     """jit-compiled full distributed step over ``mesh`` (axes data, model)."""
-    step = jax.shard_map(
+    step = shard_map(
         functools.partial(_sharded_step, cfg=cfg),
         mesh=mesh,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS)),

@@ -33,7 +33,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from spark_rapids_jni_tpu.models.tpcds import Q3Data
-from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS
+from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS, shard_map
 
 __all__ = ["Q3Row", "q3_local", "make_distributed_q3", "run_distributed_q3",
            "run_distributed_q3_columns", "q3_columns_host_oracle",
@@ -163,7 +163,7 @@ def _q3_step_cached(mesh, geo_items: tuple):
                           **geo)
             return _Partials(*(jax.lax.psum(x, (DATA_AXIS,)) for x in p))
 
-        step = jax.shard_map(
+        step = shard_map(
             body, mesh=mesh,
             in_specs=(P(DATA_AXIS),) * 5 + (P(),) * 4,
             out_specs=_Partials(P(), P()),
@@ -339,7 +339,7 @@ def _q3_columns_step_cached(mesh, geo_items: tuple):
             return _dec_partials(ss_item, ss_date, price, item_brand,
                                  item_manufact, date_year, date_moy, **geo)
 
-        step = jax.shard_map(
+        step = shard_map(
             body, mesh=mesh,
             in_specs=(P(DATA_AXIS),) * 3 + (P(),) * 4,
             out_specs=_DecPartials(P(), P(), P()),

@@ -36,7 +36,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from spark_rapids_jni_tpu.models.tpcds import CHANNELS, Q5Data
-from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS
+from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS, shard_map
 
 __all__ = [
     "Q5Row",
@@ -231,7 +231,7 @@ def _q5_step_cached(mesh, n_dims: tuple, lo: int, hi: int):
 
     with seam(COMPILE, "q5_step"):
         body = functools.partial(_sharded_q5, n_dims=n_dims, lo=lo, hi=hi)
-        step = jax.shard_map(
+        step = shard_map(
             body,
             mesh=mesh,
             in_specs=(P(DATA_AXIS), P(), P()),

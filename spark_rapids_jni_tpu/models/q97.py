@@ -38,7 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS
+from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS, axis_size, shard_map
 from spark_rapids_jni_tpu.parallel.shuffle import all_to_all_shuffle, partition_of
 
 
@@ -121,7 +121,7 @@ def q97_local(store: tuple, catalog: tuple) -> Q97Out:
 
 def _sharded_q97(s_cust, s_item, c_cust, c_item, capacity: int,
                  s_valid=None, c_valid=None):
-    dp = jax.lax.axis_size(DATA_AXIS)
+    dp = axis_size(DATA_AXIS)
     sk = _composite_key(s_cust, s_item)
     ck = _composite_key(c_cust, c_item)
 
@@ -172,7 +172,7 @@ def make_distributed_q97(mesh, capacity: int, with_validity: bool = False):
     else:
         body = functools.partial(_sharded_q97, capacity=capacity)
         in_specs = tuple(P(DATA_AXIS) for _ in range(4))
-    step = jax.shard_map(
+    step = shard_map(
         body,
         mesh=mesh,
         in_specs=in_specs,
@@ -252,7 +252,7 @@ def _sharded_q97_columns(s_cust, s_item, c_cust, c_item, s_rv, c_rv,
     from spark_rapids_jni_tpu.columnar.dtypes import INT64 as _I64
     from spark_rapids_jni_tpu.parallel.table_shuffle import shuffle_table
 
-    dp = jax.lax.axis_size(DATA_AXIS)
+    dp = axis_size(DATA_AXIS)
     skh, skl = _pair_key(s_cust.data, s_cust.is_valid(),
                          s_item.data, s_item.is_valid(), side=1)
     ckh, ckl = _pair_key(c_cust.data, c_cust.is_valid(),
@@ -298,7 +298,7 @@ def make_distributed_q97_columns(mesh, capacity: int):
         return _sharded_q97_columns(s_cust, s_item, c_cust, c_item,
                                     s_rv, c_rv, capacity)
 
-    step = jax.shard_map(
+    step = shard_map(
         body,
         mesh=mesh,
         in_specs=tuple(P(DATA_AXIS) for _ in range(6)),
@@ -417,6 +417,52 @@ def default_q97_capacity(total_rows: int, dp: int) -> int:
     return next_pow2(raw)
 
 
+def run_q97_piece(mesh, piece: Q97Batch, *, sharding=None) -> Q97Out:
+    """One device launch of one q97 (sub-)batch — pad, upload, exchange.
+
+    The single-attempt core shared by :func:`run_distributed_q97` (which
+    splits inline via run_with_split_retry) and the serving engine's q97
+    handler (serve/executor.py, which splits by re-queueing halves).
+    Raises :class:`ShuffleCapacityExceeded` when rows overflowed the
+    piece's static exchange capacity (the caller grows and re-runs).
+    """
+    from spark_rapids_jni_tpu.mem.governed import ShuffleCapacityExceeded
+    from spark_rapids_jni_tpu.obs.seam import COLLECTIVE, TRANSFER, seam
+
+    dp = mesh.shape[DATA_AXIS]
+    if sharding is None:
+        sharding = NamedSharding(mesh, P(DATA_AXIS))
+    # _pad_to_multiple quantizes to >= dp rows, so empty inputs come
+    # back as dp all-invalid rows — no empty-array special case
+    sc, sv = _pad_to_multiple(piece.s_cust, dp)
+    si, _ = _pad_to_multiple(piece.s_item, dp)
+    cc, cv = _pad_to_multiple(piece.c_cust, dp)
+    ci, _ = _pad_to_multiple(piece.c_item, dp)
+    step = _q97_step_cached(mesh, piece.capacity)
+    with seam(TRANSFER, "q97_batch_upload"):
+        args = [jax.device_put(a, sharding)
+                for a in (sc, si, cc, ci, sv, cv)]
+    # the step IS the collective exchange (tagged all_to_all): a chaos
+    # rule on 'collective' fails the launch like a wedged collective
+    with seam(COLLECTIVE, "launch:q97_step"):
+        out = step(*args)
+        jax.block_until_ready(out)
+    if int(out.dropped) > 0:
+        raise ShuffleCapacityExceeded(
+            f"{int(out.dropped)} rows overflowed capacity {piece.capacity}")
+    return out
+
+
+def combine_q97_outs(outs) -> Q97Out:
+    """Sum partial presence counts (additive across key-space pieces)."""
+    return Q97Out(
+        sum(int(o.store_only) for o in outs),
+        sum(int(o.catalog_only) for o in outs),
+        sum(int(o.both) for o in outs),
+        0,
+    )
+
+
 def run_distributed_q97(
     mesh,
     store,
@@ -444,7 +490,6 @@ def run_distributed_q97(
     ops); the default registers/ends ``task_id`` itself.
     """
     from spark_rapids_jni_tpu.mem.governed import (
-        ShuffleCapacityExceeded,
         default_device_budget,
         run_with_split_retry,
         task_context,
@@ -462,35 +507,7 @@ def run_distributed_q97(
     sharding = NamedSharding(mesh, P(DATA_AXIS))
 
     def run(piece: Q97Batch) -> Q97Out:
-        from spark_rapids_jni_tpu.obs.seam import COLLECTIVE, TRANSFER, seam
-
-        # _pad_to_multiple quantizes to >= dp rows, so empty inputs come
-        # back as dp all-invalid rows — no empty-array special case
-        sc, sv = _pad_to_multiple(piece.s_cust, dp)
-        si, _ = _pad_to_multiple(piece.s_item, dp)
-        cc, cv = _pad_to_multiple(piece.c_cust, dp)
-        ci, _ = _pad_to_multiple(piece.c_item, dp)
-        step = _q97_step_cached(mesh, piece.capacity)
-        with seam(TRANSFER, "q97_batch_upload"):
-            args = [jax.device_put(a, sharding)
-                    for a in (sc, si, cc, ci, sv, cv)]
-        # the step IS the collective exchange (tagged all_to_all): a chaos
-        # rule on 'collective' fails the launch like a wedged collective
-        with seam(COLLECTIVE, "launch:q97_step"):
-            out = step(*args)
-            jax.block_until_ready(out)
-        if int(out.dropped) > 0:
-            raise ShuffleCapacityExceeded(
-                f"{int(out.dropped)} rows overflowed capacity {piece.capacity}")
-        return out
-
-    def combine(outs) -> Q97Out:
-        return Q97Out(
-            sum(int(o.store_only) for o in outs),
-            sum(int(o.catalog_only) for o in outs),
-            sum(int(o.both) for o in outs),
-            0,
-        )
+        return run_q97_piece(mesh, piece, sharding=sharding)
 
     import contextlib
 
@@ -502,7 +519,7 @@ def run_distributed_q97(
             nbytes_of=lambda b: q97_working_set_bytes(b, dp),
             run=run,
             split=split_q97_batch,
-            combine=combine,
+            combine=combine_q97_outs,
             grow=lambda b: dataclasses.replace(b, capacity=2 * b.capacity),
             max_split_depth=max_split_depth,
         )

@@ -10,6 +10,7 @@ from spark_rapids_jni_tpu.obs.seam import (
     ALLOC,
     COLLECTIVE,
     OP,
+    SERVE,
     TRANSFER,
     instrument,
 )
@@ -23,6 +24,7 @@ __all__ = [
     "FaultInjector",
     "OP",
     "Profiler",
+    "SERVE",
     "TRANSFER",
     "install_from_env",
     "instrument",

@@ -36,7 +36,7 @@ from typing import Iterator, List, Optional
 from spark_rapids_jni_tpu.obs.profiler import CLOCK_ANCHOR, MAGIC, VERSION
 
 _CATEGORY_NAMES = ["op", "transfer", "collective", "alloc", "marker",
-                   "spill", "compile"]
+                   "spill", "compile", "serve"]
 
 
 def parse_capture(data: bytes) -> Iterator[dict]:

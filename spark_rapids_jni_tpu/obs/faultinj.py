@@ -141,7 +141,7 @@ class FaultInjector:
     def _load(self, config: dict) -> None:
         rules = {}
         for cat in (_seam.OP, _seam.TRANSFER, _seam.COLLECTIVE, _seam.ALLOC,
-                    _seam.SPILL, _seam.COMPILE):
+                    _seam.SPILL, _seam.COMPILE, _seam.SERVE):
             cat_spec = config.get(cat, {})
             rules[cat] = {name: _Rule(spec) for name, spec in cat_spec.items()}
         with self._lock:

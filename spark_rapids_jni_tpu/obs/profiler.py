@@ -48,7 +48,7 @@ CLOCK_ANCHOR = "__clock_wall_minus_mono_ns"
 
 _CATEGORIES = {_seam.OP: 0, _seam.TRANSFER: 1, _seam.COLLECTIVE: 2,
                _seam.ALLOC: 3, "marker": 4, _seam.SPILL: 5,
-               _seam.COMPILE: 6}
+               _seam.COMPILE: 6, _seam.SERVE: 7}
 
 _R_STRING, _R_RANGE, _R_INSTANT, _R_COUNTER = 0, 1, 2, 3
 

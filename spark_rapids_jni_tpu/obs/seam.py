@@ -22,16 +22,23 @@ layer, in the runtime paths of the distributed models (batch upload, step
 launch, step build), so chaos can simulate a failing device transfer, a
 wedged collective, or a failed compile mid-governed-query — the failure
 modes the CUPTI-level injector reaches in the reference.
+
+The ``serve`` crossing sits ABOVE the op layer, around each admitted
+request's handler execution in the serving engine (serve/executor.py) —
+inside the retry bracket, so an injected RetryOOM/SplitAndRetryOOM at this
+seam drives the same protocol a mid-query device fault does, and the
+profiler sees one range per served request.
 """
 
 from __future__ import annotations
 
 import contextlib
 import functools
+import threading
 from typing import Callable, Optional
 
 __all__ = ["seam", "instrument", "OP", "TRANSFER", "COLLECTIVE", "ALLOC",
-           "SPILL", "COMPILE"]
+           "SPILL", "COMPILE", "SERVE"]
 
 OP = "op"
 TRANSFER = "transfer"
@@ -39,10 +46,19 @@ COLLECTIVE = "collective"
 ALLOC = "alloc"
 SPILL = "spill"
 COMPILE = "compile"
+SERVE = "serve"
 
 # registered sinks; None = inactive (checked without locks on the hot path)
 _injector: Optional[Callable[[str, str], None]] = None  # may raise
 _profiler_range: Optional[Callable[[str, str], "contextlib.AbstractContextManager"]] = None
+# category -> threading.Lock held across the crossing; None = inactive.
+# The serving engine installs {COLLECTIVE: lock}: the single-process CPU
+# collective runtime wedges when two threads launch rendezvous programs
+# concurrently, so multi-threaded serving serializes collective launches
+# HERE — beneath every model runner's budget reservation, which keeps the
+# lock order (budget, then launch) acyclic by construction.
+_serializers: Optional[dict] = None
+_install_lock = threading.Lock()
 
 
 def _set_injector(fn: Optional[Callable[[str, str], None]]) -> None:
@@ -55,18 +71,46 @@ def _set_profiler(fn) -> None:
     _profiler_range = fn
 
 
+def serialize_category(category: str) -> None:
+    """Install (idempotently) a crossing lock for ``category``.
+
+    Reentrant: a launch crossing (``seam(COLLECTIVE, "launch:...")``)
+    re-enters on the same thread when the step traces through an
+    ``@instrument(COLLECTIVE, ...)``-wrapped collective at compile time.
+    The read-modify-write is guarded: two engines constructed
+    concurrently must end up sharing ONE lock per category, or the
+    serialization this exists for is void.
+    """
+    global _serializers
+    with _install_lock:
+        cur = dict(_serializers or {})
+        if category not in cur:
+            cur[category] = threading.RLock()
+        _serializers = cur
+
+
 @contextlib.contextmanager
 def seam(category: str, name: str):
     """Cross the instrumented dispatch boundary."""
     inj = _injector
     if inj is not None:
         inj(category, name)  # may raise an injected fault
+    sers = _serializers
+    lock = sers.get(category) if sers is not None else None
     prof = _profiler_range
-    if prof is None:
-        yield
+    if lock is None:
+        if prof is None:
+            yield
+            return
+        with prof(category, name):
+            yield
         return
-    with prof(category, name):
-        yield
+    with lock:
+        if prof is None:
+            yield
+            return
+        with prof(category, name):
+            yield
 
 
 def instrument(category: str, name: str):
@@ -75,7 +119,8 @@ def instrument(category: str, name: str):
     def deco(fn):
         @functools.wraps(fn)
         def wrapped(*args, **kwargs):
-            if _injector is None and _profiler_range is None:
+            if (_injector is None and _profiler_range is None
+                    and _serializers is None):
                 return fn(*args, **kwargs)
             with seam(category, name):
                 return fn(*args, **kwargs)

@@ -87,34 +87,75 @@ def _bit_indices(values: jnp.ndarray, num_hashes: int, num_bits: int) -> jnp.nda
     return (positive.astype(jnp.int64) % num_bits).astype(jnp.int64)
 
 
+# Path-selection threshold for put: the scatter-set path materializes
+# ~1.25 bytes/BIT of transient HBM (uint8 bit array + two u32 half-packs)
+# no matter how few values are inserted, while the sort+dedup path costs
+# ~10 bytes per inserted INDEX (int64 sort + word/contrib streams).  The
+# break-even is num_bits ~ 8x the index count; below it the dense scatter
+# wins (big inserts into a filter they mostly fill), above it a small
+# batch into a huge filter must NOT allocate byte-per-bit (a 1-Grow
+# runtime filter is 1 GB+ of transient for a 1k-row insert otherwise).
+_SCATTER_BITS_PER_INDEX = 8
+
+
+def _put_scatter_bits(flat: jnp.ndarray, num_bits: int) -> jnp.ndarray:
+    """uint64[num_longs] via a byte-per-bit scatter-``set`` + 64x pack.
+
+    ``set`` is idempotent, so duplicate bits need no dedup; out-of-range
+    sentinel indices (null rows) drop.  Replaced an earlier always-on
+    sort design: the 50M-element sort dominated put at 2^24 keys
+    (3.4 -> 53 Mrows/s measured on the v5e, exact parity).
+    """
+    bits = jnp.zeros((num_bits,), jnp.uint8).at[flat].set(1, mode="drop")
+    halves = bits.reshape(-1, 2, 32).astype(jnp.uint32)
+    w32 = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+    packed = (halves * w32[None, None, :]).sum(axis=2)  # [num_longs, 2]
+    return (packed[:, 0].astype(jnp.uint64)
+            | (packed[:, 1].astype(jnp.uint64) << jnp.uint64(32)))
+
+
+def _put_sorted(flat: jnp.ndarray, num_bits: int) -> jnp.ndarray:
+    """uint64[num_longs] via sort + first-occurrence dedup + scatter-add.
+
+    Transient HBM scales with the INDEX count, not the filter width:
+    dedup guarantees each bit contributes once, so the per-word sum of
+    distinct powers of two equals the bitwise or.  Sentinel indices
+    (>= num_bits, the null-row route) sort to the top and their word
+    index (== num_longs) drops in the scatter.
+    """
+    s = jnp.sort(flat)
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), s[1:] != s[:-1]]) & (s < num_bits)
+    word = s >> 6
+    contrib = jnp.where(
+        first,
+        jnp.uint64(1) << (s & 63).astype(jnp.uint64),
+        jnp.uint64(0))
+    return jnp.zeros((num_bits // 64,), jnp.uint64).at[word].add(
+        contrib, mode="drop")
+
+
 def bloom_filter_put(bloom_filter: BloomFilter, input: Column) -> BloomFilter:
     """Insert an INT64 column's non-null values; returns the updated filter.
 
-    Functional (returns a new pytree) rather than in-place atomicOr:
-    scatter-``set`` each bit into a num_bits-wide bit array (set is
-    idempotent, so duplicate bits need no dedup), then pack 64 bits/word
-    with a weighted row-sum (distinct powers of two sum == or).  Replaces
-    an earlier sort + first-occurrence-dedup + scatter-add design: the
-    50M-element sort dominated put at 2^24 keys (3.4 -> 53 Mrows/s
-    measured on the v5e, exact parity).
+    Functional (returns a new pytree) rather than in-place atomicOr, with
+    the transient-memory shape picked from the static geometry: dense
+    inserts scatter-``set`` a byte-per-bit array, sparse inserts into a
+    large filter sort+dedup their indices instead (transient bounded by
+    the insert size, not the filter width) — both bit-exact vs Spark.
     """
     if input.dtype.kind != Kind.INT64:
         raise TypeError("bloom_filter_put requires an INT64 column")
     idx = _bit_indices(input.data, bloom_filter.num_hashes, bloom_filter.num_bits)
     if input.validity is not None:
-        # Route null rows' bits to a sentinel beyond the filter; the
-        # out-of-bounds scatter mode drops them.
+        # Route null rows' bits to a sentinel beyond the filter; both
+        # paths drop out-of-range indices.
         idx = jnp.where(input.validity[None, :], idx, jnp.int64(bloom_filter.num_bits))
     flat = idx.reshape(-1)
-    # Transient cost is per-BIT (uint8 bit array + two u32 half-packs),
-    # so huge runtime filters stay ~6 bytes/bit of HBM, not 12+.
-    bits = jnp.zeros((bloom_filter.num_bits,), jnp.uint8).at[flat].set(
-        1, mode="drop")
-    halves = bits.reshape(-1, 2, 32).astype(jnp.uint32)
-    w32 = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
-    packed = (halves * w32[None, None, :]).sum(axis=2)  # [num_longs, 2]
-    batch = (packed[:, 0].astype(jnp.uint64)
-             | (packed[:, 1].astype(jnp.uint64) << jnp.uint64(32)))
+    if bloom_filter.num_bits <= _SCATTER_BITS_PER_INDEX * flat.shape[0]:
+        batch = _put_scatter_bits(flat, bloom_filter.num_bits)
+    else:
+        batch = _put_sorted(flat, bloom_filter.num_bits)
     return dataclasses.replace(bloom_filter, longs=bloom_filter.longs | batch)
 
 

@@ -411,7 +411,12 @@ def _name_matches(bi: _ByteInfo, kind, start, end, names: Sequence[bytes],
     n, T = kind.shape
     rows = np.arange(n, dtype=np.int64)[:, None]
     L = bi.b.shape[1]
-    is_str = (kind == jt.VALUE_STRING) | (kind == jt.FIELD_NAME)
+    # FIELD_NAME only: the machine consumes name matches solely at the
+    # object-field step (CASE4 reads name_match at a FIELD_NAME token),
+    # and the device matcher (json_render_device.py _name_match_one) is
+    # narrowed the same way — the fuzz tier asserts host/device parity
+    # on these tables, so the gates must not diverge.
+    is_str = kind == jt.FIELD_NAME
     out = []
     for name in names:
         if name is None:

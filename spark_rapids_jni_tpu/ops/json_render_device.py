@@ -235,26 +235,34 @@ def _name_match_one(bi: DByteInfo, kind, start, len_raw, has_uni, end,
                     name: bytes):
     """[n, T] bool: token payload unescapes to exactly ``name``.
 
-    Two paths under one runtime ``lax.cond``:
+    Two paths, selected PER ROW:
 
-    - **fast** (no escape in any candidate payload, the overwhelmingly
-      common case): a [n, L] match table built from ``len(name)`` static
-      byte-shift compares (pure vector ops), then a single gather per
-      token.  A payload with no escapes and no unicode emits its raw
-      bytes verbatim, so raw-width == m plus byte equality is exact.
-    - **slow** (some candidate has a 2-byte escape): the original
-      per-character searchsorted walk through the cum_u emission mapping.
+    - **fast** (no escape in the row's candidate payloads, the
+      overwhelmingly common case): a [n, L] match table built from
+      ``len(name)`` static byte-shift compares (pure vector ops), then a
+      single gather per token.  A payload with no escapes and no unicode
+      emits its raw bytes verbatim, so raw-width == m plus byte equality
+      is exact.
+    - **slow** (the row has a candidate with a 2-byte escape): the
+      original per-character searchsorted walk through the cum_u
+      emission mapping.
 
     The round-5 device profile showed the searchsorted walk was 64% of a
     warm get_json_object call on the v5e (134 s of 208 s at 2^18 rows) —
     per-(token, char) gathers scalarize on TPU.  The fast path replaces
-    ~8 gather rounds per character with one gather per name.
+    ~8 gather rounds per character with one gather per name.  Selection
+    is per-row (an outer ``lax.cond`` still skips the slow walk entirely
+    when NO row needs it): one escaped field name routes only ITS row
+    through the escape-aware walk — every clean row keeps the fast
+    table result, instead of the whole batch changing path.
     """
     n, T = kind.shape
     L = bi.b.shape[1]
     # FIELD_NAME only: name matches are consumed solely at field-name
     # tokens (the object-field step), and gating on string VALUES too
-    # would let a common escaped value disable the fast path batch-wide.
+    # would let a common escaped value route rows down the slow path;
+    # the host matcher (get_json_object.py _name_matches) is narrowed
+    # identically — the fuzz tier asserts parity on these tables.
     is_str = kind == jt.FIELD_NAME
     m = len(name)
     ok = is_str & ~has_uni & (len_raw == m)
@@ -263,6 +271,9 @@ def _name_match_one(bi: DByteInfo, kind, start, len_raw, has_uni, end,
     ps = jnp.minimum(start.astype(_I64) + 1, L)
     raw_w = end.astype(_I64) - start.astype(_I64) - 2  # quoted payload width
     no_esc = raw_w == m  # every non-unicode escape shrinks 2 raw -> 1 emitted
+    # rows with an escaped same-emitted-width candidate (the only tokens
+    # where fast and slow can disagree)
+    need_slow = jnp.any(ok & ~no_esc, axis=1)
 
     def fast(_):
         bpad = jnp.pad(bi.b, ((0, 0), (0, m)))
@@ -272,7 +283,7 @@ def _name_match_one(bi: DByteInfo, kind, start, len_raw, has_uni, end,
         hit = _take_rows(table, jnp.minimum(ps, L - 1))
         return ok & no_esc & hit
 
-    def slow(_):
+    def mixed(_):
         base = _take_rows(bi.cum_u, ps)
         acc = ok
         for q, ch in enumerate(name):
@@ -281,9 +292,9 @@ def _name_match_one(bi: DByteInfo, kind, start, len_raw, has_uni, end,
             k = (tgt - _take_rows(bi.cum_u, si)).astype(_I32)
             got = _emission_byte(bi, None, si, k, escaped=False)
             acc = acc & (got == ch)
-        return acc
+        return jnp.where(need_slow[:, None], acc, fast(0))
 
-    return jax.lax.cond(jnp.any(ok & ~no_esc), slow, fast, 0)
+    return jax.lax.cond(jnp.any(need_slow), mixed, fast, 0)
 
 
 def name_matches_device(bi, kind, start, len_raw, has_uni, end, names):

@@ -10,6 +10,7 @@ from spark_rapids_jni_tpu.parallel.mesh import (
     data_sharding,
     model_sharding,
     replicated,
+    shard_map,
 )
 from spark_rapids_jni_tpu.parallel.shuffle import (
     ShuffleResult,
@@ -31,6 +32,7 @@ __all__ = [
     "data_sharding",
     "model_sharding",
     "replicated",
+    "shard_map",
     "PaddedStrings",
     "ShuffleResult",
     "ShuffledTable",

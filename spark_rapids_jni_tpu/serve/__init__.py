@@ -1,0 +1,58 @@
+"""Multi-tenant query serving: admission control, batching, backpressure.
+
+The front door the ROADMAP's "heavy traffic from millions of users" asks
+for: many concurrent client sessions drive queries through the bounded
+admission queue into a worker pool, where every request is bracketed
+through the memory governor's retry protocol (mem/) exactly like a Spark
+task — the serving-level composition of the SparkResourceAdaptor state
+machine this repo reproduces (PAPER.md §2).
+
+    engine = ServingEngine(mesh=mesh, workers=4, queue_size=64,
+                           builtin_handlers=True)
+    sess = engine.open_session(priority=1, byte_budget=1 << 30)
+    resp = engine.submit(sess, "q97", (store, catalog), deadline_s=10)
+    out = resp.result(timeout=30)   # or Backpressure raised at submit
+    engine.shutdown()
+
+Layers: serve.session (tenants -> governor task ids), serve.queue (bounded
+priority queue + deadlines + backpressure), serve.executor (worker pool,
+governed execution, split re-queueing, micro-batching), serve.metrics
+(counters + latency histograms, exported through the obs seam).
+"""
+
+from spark_rapids_jni_tpu.serve.executor import (
+    HandlerContext,
+    QueryHandler,
+    ServingEngine,
+    register_builtin_handlers,
+)
+from spark_rapids_jni_tpu.serve.metrics import LatencyHistogram, ServeMetrics
+from spark_rapids_jni_tpu.serve.queue import (
+    AdmissionQueue,
+    Backpressure,
+    Request,
+    RequestTimeout,
+    Response,
+)
+from spark_rapids_jni_tpu.serve.session import (
+    Session,
+    SessionBudgetExceeded,
+    SessionRegistry,
+)
+
+__all__ = [
+    "AdmissionQueue",
+    "Backpressure",
+    "HandlerContext",
+    "LatencyHistogram",
+    "QueryHandler",
+    "Request",
+    "RequestTimeout",
+    "Response",
+    "ServeMetrics",
+    "ServingEngine",
+    "Session",
+    "SessionBudgetExceeded",
+    "SessionRegistry",
+    "register_builtin_handlers",
+]

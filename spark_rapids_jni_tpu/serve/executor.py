@@ -1,0 +1,623 @@
+"""The serving engine: worker pool + governed execution + split re-queueing.
+
+Composition point of the whole stack: requests admitted by the bounded
+queue (serve/queue.py) are executed by a pool of worker threads, each
+request bracketed through the memory governor exactly like a Spark task —
+dedicated-thread registration (``task_context``), retry-block + working-set
+reservation (``attempt_once``, the same protocol driver mem/governed.py
+uses), and the reference's OOM protocol (RmmSpark.java:402-416) honored at
+the serving level:
+
+- ``RetryOOM``   -> the same request re-attempts in place (bounded, with
+  the deadline checked between attempts);
+- ``SplitAndRetryOOM`` / an over-budget working set -> the request's
+  payload is SPLIT and the halves are RE-QUEUED as first-class requests
+  (force-admitted: rejecting an admitted request's halves would lose work);
+  a join object combines the halves' results into the parent's response;
+- micro-batching: compatible small requests (same handler, batch-capable,
+  not post-split) ride one device launch; a batch that draws a split
+  signal is disbanded back into individual requests instead of split.
+
+Every handler execution crosses ``seam(SERVE, "handle:<name>")`` — the
+profiler sees one range per served request and the chaos injector can fail
+or OOM a request mid-protocol (test_serve_chaos.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from typing import Any, Callable, List, Optional, Sequence
+
+from spark_rapids_jni_tpu.mem.exceptions import RetryOOM, SplitAndRetryOOM
+from spark_rapids_jni_tpu.mem.governed import (
+    ShuffleCapacityExceeded,
+    attempt_once,
+    default_device_budget,
+    task_context,
+)
+from spark_rapids_jni_tpu.mem.governor import MemoryGovernor, OutOfBudget
+from spark_rapids_jni_tpu.obs.seam import SERVE, seam
+from spark_rapids_jni_tpu.serve.metrics import ServeMetrics
+from spark_rapids_jni_tpu.serve.queue import (
+    CANCELLED,
+    ERROR,
+    OK,
+    TIMED_OUT,
+    AdmissionQueue,
+    Backpressure,
+    Request,
+    RequestTimeout,
+    Response,
+)
+from spark_rapids_jni_tpu.serve.session import (
+    Session,
+    SessionBudgetExceeded,
+    SessionRegistry,
+)
+
+__all__ = ["HandlerContext", "QueryHandler", "ServingEngine",
+           "register_builtin_handlers"]
+
+
+@dataclasses.dataclass(frozen=True)
+class HandlerContext:
+    """What a handler sees of the engine (one admitted request's view)."""
+
+    mesh: Any
+    budget: Any
+    gov: MemoryGovernor
+    task_id: int
+
+
+@dataclasses.dataclass
+class QueryHandler:
+    """A registered query type.
+
+    ``fn(payload, ctx)`` runs the work; ``nbytes_of(payload)`` estimates
+    the working set the executor reserves before launch.  Optional hooks:
+
+    - ``split``/``combine``: enable split-requeue on SplitAndRetryOOM;
+    - ``grow``: re-attempt with grown buffers on ShuffleCapacityExceeded
+      (the exchange-overflow retry);
+    - ``batch``/``unbatch``: enable micro-batching (``batch(payloads)``
+      merges, ``unbatch(result, payloads)`` redistributes);
+    - ``self_governed``: fn drives its own admission (the models/ runners,
+      which internally run run_with_split_retry) — the executor supplies
+      only the task context and skips its own reservation bracket.
+    """
+
+    name: str
+    fn: Callable[[Any, HandlerContext], Any]
+    nbytes_of: Callable[[Any], int] = lambda payload: 0
+    split: Optional[Callable[[Any], Sequence[Any]]] = None
+    combine: Optional[Callable[[List[Any]], Any]] = None
+    grow: Optional[Callable[[Any], Any]] = None
+    batch: Optional[Callable[[List[Any]], Any]] = None
+    unbatch: Optional[Callable[[Any, List[Any]], List[Any]]] = None
+    self_governed: bool = False
+    max_batch: int = 8
+    max_grows: int = 8
+
+
+class _SplitJoin:
+    """Combines re-queued halves' results into the parent's response."""
+
+    def __init__(self, parent: Request, combine: Callable, n: int,
+                 finish: Callable):
+        self.parent = parent
+        self.combine = combine
+        self.slots: List[Any] = [None] * n
+        self.remaining = n
+        self.error: Optional[BaseException] = None
+        self.error_status = ERROR
+        self._lock = threading.Lock()
+        self._finish = finish  # engine._finish (metrics + session credit)
+
+    def deliver(self, slot: int, status: str, value: Any,
+                error: Optional[BaseException]) -> None:
+        with self._lock:
+            if status == OK:
+                self.slots[slot] = value
+            elif self.error is None:
+                self.error, self.error_status = error, status
+            self.remaining -= 1
+            done = self.remaining == 0
+        if not done:
+            return
+        if self.error is None:
+            try:
+                self._finish(self.parent, OK, value=self.combine(self.slots))
+            except Exception as e:  # noqa: BLE001 - combine failure
+                self._finish(self.parent, ERROR, error=e)
+        else:
+            self._finish(self.parent, self.error_status, error=self.error)
+
+
+class ServingEngine:
+    """Multi-tenant front door over one mesh + one governed budget."""
+
+    def __init__(self, *, mesh=None, gov: Optional[MemoryGovernor] = None,
+                 budget=None, workers: Optional[int] = None,
+                 queue_size: Optional[int] = None,
+                 default_deadline_s: Optional[float] = 30.0,
+                 micro_batch_max: int = 8, max_split_depth: int = 8,
+                 builtin_handlers: bool = False):
+        from spark_rapids_jni_tpu import config
+
+        if workers is None:
+            workers = int(config.get("serve_workers"))
+        if queue_size is None:
+            queue_size = int(config.get("serve_queue_size"))
+        if mesh is None and builtin_handlers:
+            from spark_rapids_jni_tpu.parallel import make_mesh
+
+            mesh = make_mesh()
+        self.mesh = mesh
+        self.gov = gov if gov is not None else MemoryGovernor.instance()
+        self.budget = (budget if budget is not None
+                       else default_device_budget(self.gov))
+        self.default_deadline_s = default_deadline_s
+        self.micro_batch_max = micro_batch_max
+        self.max_split_depth = max_split_depth
+        # Multi-threaded serving over one process-local device group:
+        # concurrent collective launches wedge the single-process CPU
+        # rendezvous runtime, so collective crossings serialize at the
+        # seam (inside every runner's budget reservation — lock order
+        # budget -> launch, acyclic).  Idempotent and process-global.
+        from spark_rapids_jni_tpu.obs import seam as _seam
+
+        _seam.serialize_category(_seam.COLLECTIVE)
+        self.metrics = ServeMetrics()
+        self.sessions = SessionRegistry()
+        self.queue = AdmissionQueue(
+            queue_size,
+            retry_after_hint=self._retry_after,
+            on_timeout=self._on_queue_timeout,
+        )
+        self._seq = itertools.count()
+        self._handlers: dict = {}
+        self._ewma_lock = threading.Lock()
+        self._ewma_service_s = 0.05
+        if builtin_handlers:
+            register_builtin_handlers(self)
+        self._workers = [
+            threading.Thread(target=self._worker_loop, daemon=True,
+                             name=f"serve-worker-{i}")
+            for i in range(workers)
+        ]
+        for t in self._workers:
+            t.start()
+
+    # -- registration / sessions -------------------------------------------
+    def register(self, handler: QueryHandler) -> None:
+        if handler.name in self._handlers:
+            raise ValueError(f"handler {handler.name!r} already registered")
+        if (handler.batch is None) != (handler.unbatch is None):
+            raise ValueError("batch and unbatch must be provided together")
+        if handler.split is not None and handler.combine is None:
+            raise ValueError("split requires combine")
+        self._handlers[handler.name] = handler
+
+    def open_session(self, name: Optional[str] = None, *, priority: int = 0,
+                     byte_budget: Optional[int] = None) -> Session:
+        return self.sessions.open(name, priority=priority,
+                                  byte_budget=byte_budget)
+
+    def close_session(self, session: Session) -> None:
+        self.sessions.close(session)
+
+    # -- the producer surface ----------------------------------------------
+    def submit(self, session: Session, handler: str, payload: Any, *,
+               priority: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> Response:
+        """Admit one request; returns its :class:`Response`.
+
+        Raises :class:`Backpressure` (queue full — retry after the hint) or
+        :class:`SessionBudgetExceeded` (the session is over its byte
+        budget) — both clean rejections; the request never queues.
+        """
+        h = self._handlers.get(handler)
+        if h is None:
+            raise KeyError(f"no handler {handler!r} registered")
+        nbytes = int(h.nbytes_of(payload))
+        try:
+            session.charge(nbytes)
+        except SessionBudgetExceeded:
+            self.metrics.count("rejected_session", session.session_id)
+            raise
+        dl = deadline_s if deadline_s is not None else self.default_deadline_s
+        req = Request(
+            handler=handler, payload=payload,
+            session_id=session.session_id,
+            priority=priority if priority is not None else session.priority,
+            deadline=(time.monotonic() + dl) if dl is not None else None,
+            seq=next(self._seq),
+            task_id=self.sessions.next_task_id(),
+        )
+        req.charge_bytes = nbytes
+        req.session = session
+        try:
+            self.queue.submit(req)
+        except Backpressure:
+            session.credit(nbytes)
+            self.metrics.count("rejected_full", session.session_id)
+            raise
+        except BaseException:  # closed queue (shutdown): no charge leaks
+            session.credit(nbytes)
+            raise
+        self.metrics.count("submitted", session.session_id)
+        self.metrics.set_depth(self.queue.depth())
+        return req.response
+
+    # -- lifecycle ----------------------------------------------------------
+    def shutdown(self, drain: bool = True, timeout: float = 60.0) -> None:
+        """Stop serving.  ``drain=True`` waits for queued + in-flight work
+        first; anything still queued after the wait (or with drain=False)
+        completes as cancelled — never silently lost."""
+        deadline = time.monotonic() + timeout
+        if drain:
+            # queued + popped-but-unfinished under ONE lock: no window
+            # where an in-flight request is invisible to the drain
+            self.queue.wait_idle(timeout=timeout)
+        dropped = self.queue.close()
+        for req in dropped:
+            self._credit(req)
+            self.metrics.count("cancelled", req.session_id)
+            if req.join is not None:  # cancelled halves still join (above)
+                req.join.deliver(req.join_slot, CANCELLED, None,
+                                 req.response.error)
+        for t in self._workers:
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+        self.metrics.set_depth(0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+    # -- internals ----------------------------------------------------------
+    def _retry_after(self, depth: int) -> float:
+        with self._ewma_lock:
+            per_req = self._ewma_service_s
+        return min(5.0, max(0.005, per_req * depth / max(len(self._workers), 1)))
+
+    def _credit(self, req: Request) -> None:
+        sess = getattr(req, "session", None)
+        if sess is not None:
+            sess.credit(getattr(req, "charge_bytes", 0))
+            req.session = None  # credit exactly once
+
+    def _on_queue_timeout(self, req: Request) -> None:
+        """Queue-side expiry (response already completed by the queue)."""
+        self._credit(req)
+        self.metrics.count("timed_out", req.session_id)
+        if req.join is not None:  # an expired split half still joins: the
+            # parent must reach a terminal state, not hang on the slot
+            req.join.deliver(req.join_slot, TIMED_OUT, None,
+                             req.response.error)
+
+    def _finish(self, req: Request, status: str, value: Any = None,
+                error: Optional[BaseException] = None) -> None:
+        """Single terminal-state owner: completes the response (first
+        completion wins), credits the session, counts, delivers joins."""
+        first = req.response._complete(status, value=value, error=error)
+        if not first:
+            return
+        self._credit(req)
+        counter = {OK: "completed", TIMED_OUT: "timed_out",
+                   CANCELLED: "cancelled"}.get(status, "failed")
+        self.metrics.count(counter, req.session_id)
+        if req.join is not None:
+            req.join.deliver(req.join_slot, status, value, error)
+
+    def _worker_loop(self) -> None:
+        while True:
+            req = self.queue.pop()
+            if req is None:
+                return  # queue closed and drained
+            self.metrics.set_depth(self.queue.depth())
+            t0 = time.monotonic()
+            # _serve returns every popped member to the queue's
+            # outstanding count itself (incl. batch mates); on an
+            # unexpected escape only the primary is outstanding here
+            try:
+                self._serve(req)
+            except Exception as e:  # noqa: BLE001 - never kill the worker
+                self._finish(req, ERROR, error=e)
+            finally:
+                dt = time.monotonic() - t0
+                with self._ewma_lock:
+                    self._ewma_service_s = (0.8 * self._ewma_service_s
+                                            + 0.2 * dt)
+                self.metrics.publish()
+
+    def _gather_batch(self, req: Request, h: QueryHandler) -> List[Request]:
+        """Pull compatible queued requests to ride this launch."""
+        if (h.batch is None or h.self_governed or req.no_batch
+                or self.micro_batch_max <= 1):
+            return [req]
+        limit = min(h.max_batch, self.micro_batch_max) - 1
+        mates = self.queue.pop_compatible(
+            lambda r: r.handler == req.handler and not r.no_batch, limit)
+        if mates:
+            self.metrics.set_depth(self.queue.depth())
+        return [req] + mates
+
+    def _serve(self, req: Request) -> None:
+        group = [req]
+        try:
+            group = self._serve_group(req)
+        finally:
+            # every popped member is terminal or re-queued by now: return
+            # them to the queue's outstanding count (the drain watches it)
+            self.queue.task_done(len(group))
+
+    def _serve_group(self, req: Request) -> List[Request]:
+        h = self._handlers[req.handler]
+        now_ns = time.monotonic_ns()
+        group = self._gather_batch(req, h)
+        for r in group:
+            if r.response.admitted_ns == 0:  # re-served requests (split
+                # halves got fresh responses; disbanded mates did not)
+                # keep their first admission stamp and count once
+                r.response.admitted_ns = now_ns
+                self.metrics.count("admitted", r.session_id)
+                self.metrics.record_wait(now_ns - r.response.submitted_ns)
+        if len(group) > 1:
+            self.metrics.count("batched", n=len(group))
+            try:
+                payload = h.batch([r.payload for r in group])
+            except Exception as e:  # noqa: BLE001 - mates were popped too:
+                # every member must reach a terminal state, not just req
+                for r in group:
+                    self._finish(r, ERROR, error=e)
+                return group
+        else:
+            payload = req.payload
+        # the grow retry mutates this so a later split divides the GROWN
+        # payload — halves inherit the discovered exchange capacity
+        state = {"payload": payload}
+
+        ctx = HandlerContext(self.mesh, self.budget, self.gov, req.task_id)
+
+        def run(p):
+            with seam(SERVE, f"handle:{h.name}"):
+                return h.fn(p, ctx)
+
+        def on_retry(count: int) -> None:
+            self.metrics.count("retried", req.session_id)
+            if any(r.expired() for r in group):
+                raise RequestTimeout(
+                    f"deadline expired after {count} retries "
+                    f"(handler={h.name})")
+            # a REAL RetryOOM already paid an arbiter block; an injected
+            # one re-enters immediately — pace the loop so a request's
+            # deadline, not the 500-retry cap, decides its fate
+            time.sleep(0.001)
+
+        run_t0 = time.monotonic_ns()
+        try:
+            with task_context(self.gov, req.task_id):
+                if h.self_governed:
+                    result = run(state["payload"])
+                else:
+                    result = self._governed_attempt(h, state, run, on_retry)
+        except RequestTimeout as e:
+            for r in group:
+                if r.expired():
+                    self._finish(r, TIMED_OUT, error=e)
+                else:  # batch-mate with time left: runs again alone
+                    self._requeue(r, no_batch=True)
+            return group
+        except (SplitAndRetryOOM, OutOfBudget) as e:
+            if isinstance(e, OutOfBudget):
+                try:
+                    fits = (int(h.nbytes_of(state["payload"]))
+                            <= self.budget.limit)
+                except Exception:  # noqa: BLE001 - broken estimator: fail,
+                    fits = True    # don't split on garbage
+                if fits:
+                    # arbiter declared it non-retryable at a size that
+                    # fits: a real OOM (retry-cap/livelock), as in
+                    # mem/governed.py
+                    for r in group:
+                        self._finish(r, ERROR, error=e)
+                    return group
+            self._split_requeue(group, h, e, payload=state["payload"])
+            return group
+        except RetryOOM as e:
+            # only reachable from self_governed handlers that exhausted
+            # their internal protocol — surface as a failure
+            for r in group:
+                self._finish(r, ERROR, error=e)
+            return group
+        except Exception as e:  # noqa: BLE001 - handler failure
+            for r in group:
+                self._finish(r, ERROR, error=e)
+            return group
+
+        run_ns = time.monotonic_ns() - run_t0
+        if len(group) > 1:
+            try:
+                parts = h.unbatch(result, [r.payload for r in group])
+            except Exception as e:  # noqa: BLE001
+                for r in group:
+                    self._finish(r, ERROR, error=e)
+                return group
+            for r, value in zip(group, parts):
+                self.metrics.record_run(run_ns)
+                self._finish(r, OK, value=value)
+        else:
+            self.metrics.record_run(run_ns)
+            self._finish(req, OK, value=result)
+        return group
+
+    def _governed_attempt(self, h: QueryHandler, state: dict, run, on_retry):
+        """attempt_once + the exchange-grow retry (capacity overflow).
+
+        ``state["payload"]`` carries the grown payload back to the caller
+        so a subsequent split divides the grown batch, not the original.
+        """
+        grows = 0
+        while True:
+            try:
+                return attempt_once(self.gov, self.budget, state["payload"],
+                                    h.nbytes_of, run, on_retry=on_retry)
+            except ShuffleCapacityExceeded:
+                if h.grow is None or grows >= h.max_grows:
+                    raise
+                grows += 1
+                state["payload"] = h.grow(state["payload"])
+
+    def _requeue(self, req: Request, *, no_batch: bool = False) -> None:
+        req.no_batch = req.no_batch or no_batch
+        try:
+            self.queue.submit(req, force=True)
+        except BaseException as e:  # closed mid-shutdown: terminal, not lost
+            self._finish(req, ERROR, error=e)
+
+    def _split_requeue(self, group: List[Request], h: QueryHandler,
+                       err: BaseException, *, payload: Any = None) -> None:
+        """SplitAndRetryOOM at the serving level.
+
+        A micro-batch disbands: each member re-queues alone (the batch WAS
+        the split unit).  A single request splits its payload; the halves
+        re-queue as first-class requests joined back into the parent's
+        response.  Force-admitted in both cases: these requests were
+        already admitted once, and bouncing them off a full queue would
+        lose accepted work (test_serve_chaos.py pins this under a full
+        queue + injected OOMs).
+        """
+        if len(group) > 1:
+            self.metrics.count("split_requeued", n=len(group))
+            for r in group:
+                self._requeue(r, no_batch=True)
+            return
+        req = group[0]
+        if h.split is None:
+            self._finish(req, ERROR, error=err)
+            return
+        if req.split_depth >= self.max_split_depth:
+            self._finish(req, ERROR, error=MemoryError(
+                f"split depth {req.split_depth} reached and the request "
+                f"still does not fit"))
+            return
+        # split the (possibly capacity-grown) payload the attempt actually
+        # ran with, so halves inherit the discovered exchange capacity
+        parts = list(h.split(payload if payload is not None
+                             else req.payload))
+        if len(parts) <= 1:
+            self._finish(req, ERROR,
+                         error=MemoryError("request is not splittable"))
+            return
+        join = _SplitJoin(req, h.combine, len(parts), self._finish)
+        self.metrics.count("split_requeued", req.session_id, n=len(parts))
+        for slot, part in enumerate(parts):
+            child = Request(
+                handler=req.handler, payload=part,
+                session_id=req.session_id, priority=req.priority,
+                deadline=req.deadline, seq=next(self._seq),
+                task_id=self.sessions.next_task_id(),
+                split_depth=req.split_depth + 1,
+                no_batch=True, join=join, join_slot=slot,
+            )
+            try:
+                self.queue.submit(child, force=True)
+            except BaseException as e:  # closed mid-shutdown
+                self._finish(child, ERROR, error=e)
+
+
+# --------------------------------------------------------------- builtins --
+
+def register_builtin_handlers(engine: ServingEngine) -> None:
+    """The models/ query pipelines and an ops/ kernel as query handlers.
+
+    - ``q97``: executor-governed — the engine reserves the working set,
+      splits the key space by re-queueing halves, grows the exchange on
+      capacity overflow (payload: ``(store, catalog)`` table pair or a
+      prepared ``Q97Batch``).
+    - ``q5`` / ``q3``: self-governed — the distributed runners drive their
+      own inline split-retry under the engine's task context (payload:
+      ``Q5Data`` / ``Q3Data``).
+    - ``hash32``: a batchable pure op (murmur3 over an int64 array) — the
+      micro-batching demonstration payload (payload: 1-D numpy int64).
+    """
+    import numpy as np
+
+    from spark_rapids_jni_tpu.models.q97 import (
+        Q97Batch,
+        combine_q97_outs,
+        default_q97_capacity,
+        q97_working_set_bytes,
+        run_q97_piece,
+        split_q97_batch,
+    )
+    from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS
+
+    dp = engine.mesh.shape[DATA_AXIS]
+
+    def as_batch(payload) -> Q97Batch:
+        if isinstance(payload, Q97Batch):
+            return payload
+        store, catalog = payload
+        total = len(store[0]) + len(catalog[0])
+        return Q97Batch(
+            np.asarray(store[0], np.int32), np.asarray(store[1], np.int32),
+            np.asarray(catalog[0], np.int32),
+            np.asarray(catalog[1], np.int32),
+            capacity=default_q97_capacity(total, dp))
+
+    engine.register(QueryHandler(
+        name="q97",
+        fn=lambda p, ctx: run_q97_piece(engine.mesh, as_batch(p)),
+        nbytes_of=lambda p: q97_working_set_bytes(as_batch(p), dp),
+        split=lambda p: split_q97_batch(as_batch(p)),
+        combine=combine_q97_outs,
+        grow=lambda p: dataclasses.replace(
+            as_batch(p), capacity=2 * as_batch(p).capacity),
+    ))
+
+    def run_q5(p, ctx):
+        from spark_rapids_jni_tpu.models import run_distributed_q5
+
+        return run_distributed_q5(engine.mesh, p, budget=ctx.budget,
+                                  task_id=ctx.task_id, manage_task=False)
+
+    def run_q3(p, ctx):
+        from spark_rapids_jni_tpu.models import run_distributed_q3
+
+        return run_distributed_q3(engine.mesh, p, budget=ctx.budget,
+                                  task_id=ctx.task_id, manage_task=False)
+
+    engine.register(QueryHandler(name="q5", fn=run_q5, self_governed=True))
+    engine.register(QueryHandler(name="q3", fn=run_q3, self_governed=True))
+
+    def run_hash(p, ctx):
+        import jax.numpy as jnp
+
+        from spark_rapids_jni_tpu.columnar.column import Column
+        from spark_rapids_jni_tpu.columnar.dtypes import INT64
+        from spark_rapids_jni_tpu.ops.hashing import murmur_hash32
+
+        col = Column(jnp.asarray(np.asarray(p, np.int64)), None, INT64)
+        out = murmur_hash32([col], seed=42)
+        return np.asarray(out.data)
+
+    def unbatch_hash(result, payloads):
+        sizes = [len(p) for p in payloads]
+        offs = np.cumsum([0] + sizes)
+        return [result[offs[i]:offs[i + 1]] for i in range(len(sizes))]
+
+    engine.register(QueryHandler(
+        name="hash32",
+        fn=run_hash,
+        nbytes_of=lambda p: 16 * len(p),  # int64 in + int32 out + slack
+        batch=lambda ps: np.concatenate(
+            [np.asarray(p, np.int64) for p in ps]),
+        unbatch=unbatch_hash,
+        max_batch=16,
+    ))

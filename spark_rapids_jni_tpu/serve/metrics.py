@@ -1,0 +1,157 @@
+"""Serving metrics: per-session and global counters + latency histograms.
+
+The serving analog of the governor's per-task metrics (RmmSpark.java:533-590
+getAndReset* counters): every admission decision and every lifecycle edge of
+a request increments a named counter, and queue-wait / run latencies land in
+log2-bucketed histograms cheap enough to live on the hot path.
+
+Export path: the same ``obs`` seam the rest of the framework uses — when the
+profiler is active, :meth:`ServeMetrics.publish` emits the live counters as
+profiler COUNTER records (and the executor's per-request SERVE seam ranges
+carry the latencies), so the soak/convert tooling sees serving events in the
+same capture stream as op ranges and budget counters.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, Optional
+
+__all__ = ["LatencyHistogram", "ServeMetrics"]
+
+
+class LatencyHistogram:
+    """Log2-bucketed latency histogram over nanoseconds.
+
+    Bucket ``i`` counts samples in ``[2^i, 2^(i+1))`` ns; percentile
+    estimates take the upper edge of the covering bucket (conservative,
+    and exact enough for p50/p99 serving dashboards).  Lock-free reads
+    are not needed — every record happens under the owning
+    :class:`ServeMetrics` lock.
+    """
+
+    NBUCKETS = 64
+
+    def __init__(self):
+        self.counts = [0] * self.NBUCKETS
+        self.total = 0
+        self.sum_ns = 0
+
+    def record(self, ns: int) -> None:
+        ns = max(int(ns), 0)
+        self.counts[min(max(ns, 1).bit_length() - 1, self.NBUCKETS - 1)] += 1
+        self.total += 1
+        self.sum_ns += ns
+
+    def percentile_ns(self, p: float) -> int:
+        """Upper-edge estimate of the ``p``-th percentile (0 < p <= 100)."""
+        if self.total == 0:
+            return 0
+        rank = max(1, int(round(self.total * p / 100.0)))
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= rank:
+                return 1 << (i + 1)
+        return 1 << self.NBUCKETS  # pragma: no cover - unreachable
+
+    def mean_ns(self) -> float:
+        return self.sum_ns / self.total if self.total else 0.0
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "count": self.total,
+            "mean_ms": round(self.mean_ns() / 1e6, 3),
+            "p50_ms": round(self.percentile_ns(50) / 1e6, 3),
+            "p99_ms": round(self.percentile_ns(99) / 1e6, 3),
+        }
+
+
+# counter names every engine maintains (a fixed vocabulary so dashboards
+# and tests never chase typos)
+COUNTERS = (
+    "submitted",        # requests accepted into the queue
+    "rejected_full",    # backpressure: queue at capacity
+    "rejected_session", # session cap: working set over the session budget
+    "admitted",         # popped by a worker and bracketed into the governor
+    "completed",        # handler result delivered
+    "failed",           # handler raised a non-protocol error
+    "timed_out",        # deadline expired (in queue or between retries)
+    "retried",          # RetryOOM re-attempts inside the bracket
+    "split_requeued",   # SplitAndRetryOOM -> halves re-queued
+    "batched",          # requests that rode a micro-batch launch
+    "cancelled",        # queue shut down with the request still waiting
+)
+
+
+class ServeMetrics:
+    """Global + per-session serving counters and latency histograms."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._global: Dict[str, int] = defaultdict(int)
+        self._per_session: Dict[str, Dict[str, int]] = {}
+        self.queue_wait = LatencyHistogram()
+        self.run_latency = LatencyHistogram()
+        self._depth = 0
+
+    # -- recording ----------------------------------------------------------
+    def count(self, name: str, session_id: Optional[str] = None,
+              n: int = 1) -> None:
+        with self._lock:
+            self._global[name] += n
+            if session_id is not None:
+                sess = self._per_session.setdefault(
+                    session_id, defaultdict(int))
+                sess[name] += n
+
+    def record_wait(self, ns: int) -> None:
+        with self._lock:
+            self.queue_wait.record(ns)
+
+    def record_run(self, ns: int) -> None:
+        with self._lock:
+            self.run_latency.record(ns)
+
+    def set_depth(self, depth: int) -> None:
+        with self._lock:
+            self._depth = depth
+
+    # -- reading ------------------------------------------------------------
+    def get(self, name: str, session_id: Optional[str] = None) -> int:
+        with self._lock:
+            if session_id is not None:
+                return self._per_session.get(session_id, {}).get(name, 0)
+            return self._global.get(name, 0)
+
+    def snapshot(self) -> dict:
+        """One JSON-able dict: global counters, latency summaries, and the
+        per-session counter tables (the serve_bench emission payload)."""
+        with self._lock:
+            return {
+                "counters": {k: self._global.get(k, 0) for k in COUNTERS},
+                "queue_depth": self._depth,
+                "queue_wait": self.queue_wait.snapshot(),
+                "run_latency": self.run_latency.snapshot(),
+                "sessions": {
+                    sid: dict(c) for sid, c in self._per_session.items()
+                },
+            }
+
+    def publish(self) -> None:
+        """Emit the live global counters + queue depth into the profiler
+        capture.  Gated on the seam's lock-free profiler flag first: this
+        runs once per served request, and with the profiler detached it
+        must cost two attribute reads, not a dozen global-lock no-ops."""
+        from spark_rapids_jni_tpu.obs import seam as _seam
+
+        if _seam._profiler_range is None:
+            return
+        from spark_rapids_jni_tpu.obs.profiler import Profiler
+
+        with self._lock:
+            items = [("serve_" + k, v) for k, v in self._global.items()]
+            items.append(("serve_queue_depth", self._depth))
+        for name, value in items:
+            Profiler.counter(name, value)

@@ -203,6 +203,61 @@ def test_deserialize_rejects_bad_num_hashes():
         bloom_filter_deserialize(buf)
 
 
+def test_large_filter_small_batch_uses_index_bounded_path():
+    """Regression for the put transient-HBM blowup: a small insert into a
+    large filter must route the sort+dedup path (transient scales with
+    the insert size, not the filter width) and stay bit-exact — the
+    scatter path's byte-per-bit array allocated ~1 byte/bit regardless
+    of insert size (1 GB+ transient for a 1k-row insert at Grow scale).
+    """
+    from spark_rapids_jni_tpu.ops.bloom_filter import (
+        _SCATTER_BITS_PER_INDEX,
+        _bit_indices,
+        _put_scatter_bits,
+        _put_sorted,
+    )
+
+    rng = np.random.RandomState(77)
+    vals = [int(v) for v in rng.randint(-(2**63), 2**63, size=60,
+                                        dtype=np.int64)]
+    num_longs = 1 << 15  # 2^21 bits >> 60 * 3 indices -> sorted path
+    bf = bloom_filter_create(3, num_longs)
+    assert bf.num_bits > _SCATTER_BITS_PER_INDEX * len(vals) * 3
+    out = bloom_filter_put(bf, column(vals + [None], INT64))
+
+    oracle = SparkBloomOracle(3, num_longs)
+    for v in vals:
+        oracle.put(v)
+    assert [int(x) for x in np.asarray(out.longs)] == \
+        [l & 0xFFFFFFFFFFFFFFFF for l in oracle.longs]
+
+    # both internal paths agree word-for-word on the same index stream
+    import jax.numpy as jnp
+
+    idx = _bit_indices(jnp.asarray(np.array(vals, np.int64)), 3, bf.num_bits)
+    flat = idx.reshape(-1)
+    np.testing.assert_array_equal(
+        np.asarray(_put_sorted(flat, bf.num_bits)),
+        np.asarray(_put_scatter_bits(flat, bf.num_bits)))
+    # no false negatives through the public probe
+    assert bloom_filter_probe(column(vals, INT64), out).to_list() == \
+        [True] * len(vals)
+
+
+def test_put_path_threshold_boundary():
+    """Dense inserts keep the scatter path; both sides of the threshold
+    produce identical filters for identical data."""
+    rng = np.random.RandomState(78)
+    vals = [int(v) for v in rng.randint(-(2**40), 2**40, size=512,
+                                        dtype=np.int64)]
+    dense = bloom_filter_put(bloom_filter_create(3, 8), column(vals, INT64))
+    oracle = SparkBloomOracle(3, 8)
+    for v in vals:
+        oracle.put(v)
+    assert [int(x) for x in np.asarray(dense.longs)] == \
+        [l & 0xFFFFFFFFFFFFFFFF for l in oracle.longs]
+
+
 def test_put_is_jittable():
     import jax
 

@@ -80,6 +80,90 @@ def test_device_scan_machine_corpus():
         assert dev == host, f"path={path}"
 
 
+def test_name_matcher_host_device_parity():
+    """The host and device name matchers must gate identically: both are
+    FIELD_NAME-only (a VALUE_STRING that happens to spell a path name
+    must not light up either table), and the device per-row fast/slow
+    selection must agree with the host walk on every token — including
+    2-byte escapes, the \\u-never-matches quirk, and rows that mix
+    escaped and clean field names."""
+    import importlib
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_rapids_jni_tpu.columnar.column import strings_column
+    from spark_rapids_jni_tpu.ops import json_render_device as jrd
+    from spark_rapids_jni_tpu.ops import json_tokenizer as jt
+
+    # the ops package re-exports the FUNCTION under the module's name, so
+    # the module object must come through importlib
+    g = importlib.import_module("spark_rapids_jni_tpu.ops.get_json_object")
+
+    rows = [
+        '{"a": 1, "k": 2}',                 # clean names
+        '{"a\\tb": 3}',                     # 2-byte escape in a name
+        '{"x": "a", "y": "a\\tb"}',         # VALUES spelling the names
+        '{"\\u0061": 4}',                   # \\u never matches
+        '{"a": {"a\\tb": 5, "k": [1]}}',    # escaped + clean in one row
+        '{"ab": 6, "a\\\\b": 7}',           # width decoys
+        "[1, 2]", "{}", "bad",
+    ]
+    names = [b"a", b"a\tb", None, b"k"]
+    col = strings_column(rows)
+    for b in g.padded_buckets(col):
+        ts = jt.tokenize(b.bytes, b.lengths)
+        nv = b.n_valid
+        kind_h = np.asarray(ts.kind).astype(np.int32)[:nv]
+        start_h = np.asarray(ts.start)[:nv]
+        end_h = np.asarray(ts.end)[:nv]
+        bi_h = g._byte_info(b.bytes, b.lengths, n_valid=nv)
+        len_raw, _le, has_uni, _n0 = g._token_tables(
+            bi_h, kind_h, start_h, end_h)
+        nm_h = g._name_matches(bi_h, kind_h, start_h, end_h, names,
+                               len_raw, has_uni)
+
+        st_before = g._string_states(b.bytes, b.lengths)
+        bi_d = jrd.byte_info_device(b.bytes, b.lengths, st_before)
+        kind_d = ts.kind.astype(jnp.int32)
+        lr_d, _led, hu_d, _n0d = jrd.token_tables_device(
+            bi_d, kind_d, ts.start, ts.end)
+        nm_d = jrd.name_matches_device(bi_d, kind_d, ts.start, lr_d, hu_d,
+                                       ts.end, names)
+        for name, h, d in zip(names, nm_h, nm_d):
+            np.testing.assert_array_equal(
+                h, np.asarray(d)[:nv],
+                err_msg=f"host/device divergence for name {name!r}")
+
+
+def test_mixed_escape_rows_stay_exact():
+    """One escaped field name among clean rows: per-row path selection in
+    the device matcher must keep every row's answer identical to the host
+    pipeline (the batch-wide cond this replaces was exact too — this pins
+    the per-row rewrite against both pipelines and the oracle)."""
+    from spark_rapids_jni_tpu import config
+    from spark_rapids_jni_tpu.columnar.column import strings_column
+    from spark_rapids_jni_tpu.ops.get_json_object import get_json_object
+
+    rows = (['{"a": %d}' % i for i in range(12)]
+            + ['{"a\\tb": 99, "a": 13}']       # the escape
+            + ['{"a": {"c": %d}}' % i for i in range(4)])
+    col = strings_column(rows)
+    for path in ["$.a", "$.a.c"]:
+        with config.override(json_device_render=True):
+            dev = get_json_object(col, path).to_list()
+        with config.override(json_device_render=False):
+            host = get_json_object(col, path).to_list()
+        assert dev == host, (path, list(zip(rows, dev, host)))
+    # the escaped row still matches its own escaped name end-to-end
+    from spark_rapids_jni_tpu.ops.get_json_object import NAMED
+
+    for flag in (True, False):
+        with config.override(json_device_render=flag):
+            out = get_json_object(col, [(NAMED, b"a\tb")]).to_list()
+        assert out == [None] * 12 + ["99"] + [None] * 4
+
+
 @pytest.mark.slow
 def test_fuzz_against_oracle():
     from spark_rapids_jni_tpu import config

@@ -10,6 +10,7 @@ from spark_rapids_jni_tpu.parallel import (
     all_to_all_shuffle,
     bucket_by_partition,
     make_mesh,
+    shard_map,
 )
 from spark_rapids_jni_tpu.models import (
     QueryStepConfig,
@@ -58,7 +59,7 @@ def test_all_to_all_shuffle_routes_rows(ndev):
         return ok[None], n_recv[None], res.dropped[None]
 
     f = jax.jit(
-        jax.shard_map(
+        shard_map(
             body,
             mesh=mesh,
             in_specs=(P("data"), P("data")),

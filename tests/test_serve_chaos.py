@@ -1,0 +1,276 @@
+"""Chaos through a loaded server: fault injection + queue pressure.
+
+The satellite the ISSUE pins: injected GpuRetryOOM while the admission
+queue is FULL must not deadlock and must not drop requests — every request
+reaches a terminal state (success, backpressure rejection at submit, or a
+clean timeout), the worker pool stays alive, and the device budget drains
+to zero.  Plus the serve-seam injection tier: the chaos injector firing at
+``seam(SERVE, "handle:<name>")`` drives the same retry/split/abort protocol
+a mid-query device fault does (test_chaos_device.py's contract, one level
+up).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.mem import BudgetedResource, MemoryGovernor
+from spark_rapids_jni_tpu.obs.faultinj import FaultInjector, InjectedException
+from spark_rapids_jni_tpu.serve import (
+    Backpressure,
+    QueryHandler,
+    RequestTimeout,
+    ServingEngine,
+)
+
+
+@pytest.fixture
+def gov():
+    g = MemoryGovernor(watchdog_period_s=0.02)
+    yield g
+    g.close()
+
+
+def _engine(gov, **kw):
+    kw.setdefault("workers", 2)
+    kw.setdefault("queue_size", 4)
+    kw.setdefault("default_deadline_s", 60.0)
+    budget = BudgetedResource(gov, kw.pop("budget_bytes", 1 << 20))
+    return ServingEngine(gov=gov, budget=budget, **kw)
+
+
+def test_retry_oom_under_full_queue_no_deadlock_no_drops(gov):
+    """The headline chaos case: a small queue loaded well past capacity by
+    concurrent clients while every reservation has a chance of an injected
+    RetryOOM.  Invariant: submitted + rejected == attempted, every
+    submitted request completes, nothing hangs, the budget drains."""
+    eng = _engine(gov, workers=2, queue_size=4)
+    try:
+        eng.register(QueryHandler(
+            name="work",
+            fn=lambda p, ctx: time.sleep(0.002) or p * 2,
+            nbytes_of=lambda p: 256,
+            split=lambda p: [p, p],  # never used: 256 always fits
+            combine=lambda rs: rs[0]))
+        FaultInjector.install({
+            "seed": 7,
+            "alloc": {"reserve:dev:*": {"percent": 30,
+                                        "injectionType": "retry_oom"}},
+        })
+        results = {}
+        rejected = [0]
+        lock = threading.Lock()
+
+        def client(ci):
+            for i in range(10):
+                key = (ci, i)
+                try:
+                    r = eng.submit(eng.sessions.get(f"c{ci}"), "work", i)
+                except Backpressure:
+                    with lock:
+                        rejected[0] += 1
+                    time.sleep(0.005)
+                    continue
+                got = r.result(timeout=120)
+                with lock:
+                    results[key] = got
+
+        for ci in range(6):
+            eng.open_session(f"c{ci}")
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+            assert not t.is_alive(), "client hung: serving deadlocked"
+
+        # zero lost: every attempt is accounted as completed or rejected
+        assert len(results) + rejected[0] == 60
+        assert all(results[(ci, i)] == i * 2 for ci, i in results)
+        assert eng.metrics.get("completed") == len(results)
+        assert eng.metrics.get("rejected_full") == rejected[0]
+        assert eng.metrics.get("retried") >= 1, "chaos never fired"
+        assert eng.budget.used == 0
+    finally:
+        FaultInjector.uninstall()
+        eng.shutdown()
+
+
+def test_governor_pressure_with_splits_under_load(gov):
+    """Queue pressure + a budget too small for whole payloads: requests
+    split through the requeue path (force-admitted past the full queue)
+    while fresh submits bounce — no deadlock, exact results."""
+    eng = _engine(gov, workers=2, queue_size=3, budget_bytes=1000)
+    try:
+        eng.register(QueryHandler(
+            name="sum",
+            fn=lambda p, ctx: sum(p),
+            nbytes_of=lambda p: 200 * len(p),
+            split=lambda p: [p[:len(p) // 2], p[len(p) // 2:]],
+            combine=sum))
+        sessions = [eng.open_session(f"t{i}") for i in range(4)]
+        outcomes = []
+        lock = threading.Lock()
+
+        def client(sess):
+            for _ in range(4):
+                payload = list(range(16))  # 3200 bytes: must split twice
+                for _ in range(40):
+                    try:
+                        r = eng.submit(sess, "sum", payload)
+                    except Backpressure as bp:
+                        time.sleep(min(bp.retry_after_s, 0.05))
+                        continue
+                    with lock:
+                        outcomes.append(r.result(timeout=120))
+                    break
+                else:
+                    with lock:
+                        outcomes.append("rejected")
+
+        threads = [threading.Thread(target=client, args=(s,))
+                   for s in sessions]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+            assert not t.is_alive(), "client hung under split pressure"
+        assert len(outcomes) == 16
+        done = [o for o in outcomes if o != "rejected"]
+        assert all(o == sum(range(16)) for o in done)
+        assert done, "every request bounced: no forward progress"
+        assert eng.metrics.get("split_requeued") >= 2
+        assert eng.budget.used == 0
+    finally:
+        eng.shutdown()
+
+
+def test_serve_seam_retry_oom_drives_protocol(gov):
+    """An injected RetryOOM at the SERVE seam (inside the retry bracket,
+    around the handler body) retries to the correct answer."""
+    eng = _engine(gov, workers=1)
+    try:
+        calls = []
+        eng.register(QueryHandler(
+            name="work", fn=lambda p, ctx: calls.append(1) or p + 1,
+            nbytes_of=lambda p: 64))
+        FaultInjector.install({
+            "serve": {"handle:work": {"injectionType": "retry_oom",
+                                      "interceptionCount": 2}},
+        })
+        s = eng.open_session()
+        assert eng.submit(s, "work", 41).result(timeout=60) == 42
+        assert eng.metrics.get("retried") == 2
+        assert eng.budget.used == 0
+    finally:
+        FaultInjector.uninstall()
+        eng.shutdown()
+
+
+def test_serve_seam_hard_fault_aborts_cleanly(gov):
+    """A non-retryable injected exception at the SERVE seam fails THAT
+    request and leaves the engine serving."""
+    eng = _engine(gov, workers=1)
+    try:
+        eng.register(QueryHandler(name="work", fn=lambda p, ctx: p,
+                                  nbytes_of=lambda p: 64))
+        FaultInjector.install({
+            "serve": {"handle:work": {"injectionType": "exception",
+                                      "interceptionCount": 1}},
+        })
+        s = eng.open_session()
+        r = eng.submit(s, "work", 1)
+        with pytest.raises(InjectedException):
+            r.result(timeout=60)
+        assert eng.budget.used == 0
+        # the engine is intact: the next request succeeds
+        assert eng.submit(s, "work", 2).result(timeout=60) == 2
+    finally:
+        FaultInjector.uninstall()
+        eng.shutdown()
+
+
+def test_serve_seam_split_oom_requeues_halves(gov):
+    """An injected SplitAndRetryOOM at the SERVE seam splits via the
+    requeue path and joins the halves exactly."""
+    eng = _engine(gov, workers=1)
+    try:
+        eng.register(QueryHandler(
+            name="sum",
+            fn=lambda p, ctx: sum(p),
+            nbytes_of=lambda p: 8 * len(p),
+            split=lambda p: [p[:len(p) // 2], p[len(p) // 2:]],
+            combine=sum))
+        FaultInjector.install({
+            "serve": {"handle:sum": {"injectionType": "split_oom",
+                                     "interceptionCount": 1}},
+        })
+        s = eng.open_session()
+        assert eng.submit(s, "sum", list(range(10))).result(timeout=60) \
+            == sum(range(10))
+        assert eng.metrics.get("split_requeued") == 2
+        assert eng.budget.used == 0
+    finally:
+        FaultInjector.uninstall()
+        eng.shutdown()
+
+
+def test_timeout_under_chaos_is_clean(gov):
+    """Endless injected RetryOOMs + a short deadline: the request times
+    out cleanly between retries instead of spinning forever."""
+    eng = _engine(gov, workers=1)
+    try:
+        eng.register(QueryHandler(name="work", fn=lambda p, ctx: p,
+                                  nbytes_of=lambda p: 64))
+        FaultInjector.install({
+            "serve": {"handle:work": {"injectionType": "retry_oom"}},
+        })
+        s = eng.open_session()
+        r = eng.submit(s, "work", 1, deadline_s=0.3)
+        with pytest.raises(RequestTimeout):
+            r.result(timeout=60)
+        assert eng.metrics.get("timed_out") == 1
+        assert eng.budget.used == 0
+        FaultInjector.uninstall()
+        # chaos off: the engine still serves
+        assert eng.submit(s, "work", 5).result(timeout=60) == 5
+    finally:
+        FaultInjector.uninstall()
+        eng.shutdown()
+
+
+def test_q97_chaos_transfer_fault_through_engine(gov):
+    """The device-level chaos tier driven THROUGH the serving engine: an
+    injected RetryOOM at the q97 upload TRANSFER seam mid-served-query
+    retries to the exact answer (test_chaos_device.py's first case, with
+    the serving layer owning the protocol)."""
+    import jax
+
+    from spark_rapids_jni_tpu.models.q97 import q97_host_oracle
+    from spark_rapids_jni_tpu.parallel import make_mesh
+
+    mesh = make_mesh((len(jax.devices()), 1))
+    budget = BudgetedResource(gov, 1 << 30)
+    eng = ServingEngine(gov=gov, budget=budget, mesh=mesh, workers=2,
+                        queue_size=8, builtin_handlers=True)
+    try:
+        rng = np.random.RandomState(11)
+        store = (rng.randint(1, 40, 160).astype(np.int32),
+                 rng.randint(1, 12, 160).astype(np.int32))
+        catalog = (rng.randint(1, 40, 120).astype(np.int32),
+                   rng.randint(1, 12, 120).astype(np.int32))
+        FaultInjector.install({
+            "transfer": {"q97_batch_upload": {"injectionType": "retry_oom",
+                                              "interceptionCount": 1}},
+        })
+        s = eng.open_session()
+        out = eng.submit(s, "q97", (store, catalog)).result(timeout=180)
+        got = (int(out.store_only), int(out.catalog_only), int(out.both))
+        assert got == q97_host_oracle(store, catalog)
+        assert eng.budget.used == 0
+    finally:
+        FaultInjector.uninstall()
+        eng.shutdown()

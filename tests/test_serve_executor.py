@@ -1,0 +1,397 @@
+"""Executor tier: governed execution, the OOM protocol, micro-batching.
+
+Drives the serving engine with toy handlers (fast, deterministic) plus the
+built-in q97 pipeline, asserting the serving-level retry protocol
+(RmmSpark.java:402-416 lifted to requests — serve/executor.py module doc):
+RetryOOM re-attempts in place, SplitAndRetryOOM re-queues split halves and
+joins their results, capacity overflow grows, batches disband on split
+signals, and everything lands in a terminal state with the budget clean.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from spark_rapids_jni_tpu.mem import BudgetedResource, MemoryGovernor
+from spark_rapids_jni_tpu.models.q97 import q97_host_oracle
+from spark_rapids_jni_tpu.parallel import make_mesh
+from spark_rapids_jni_tpu.serve import QueryHandler, ServingEngine
+
+
+@pytest.fixture
+def gov():
+    g = MemoryGovernor(watchdog_period_s=0.02)
+    yield g
+    g.close()
+
+
+def _engine(gov, budget_bytes=1 << 30, **kw):
+    budget = BudgetedResource(gov, budget_bytes)
+    kw.setdefault("workers", 2)
+    kw.setdefault("queue_size", 32)
+    kw.setdefault("default_deadline_s", 30.0)
+    return ServingEngine(gov=gov, budget=budget, **kw)
+
+
+def _sum_handler(**kw):
+    """Splittable toy: payload = list[int], result = sum."""
+    return QueryHandler(
+        name="sum",
+        fn=lambda p, ctx: sum(p),
+        nbytes_of=lambda p: 64 * len(p),
+        split=lambda p: [p[:len(p) // 2], p[len(p) // 2:]],
+        combine=sum,
+        **kw)
+
+
+def test_completes_and_reserves_through_governor(gov):
+    eng = _engine(gov)
+    try:
+        eng.register(_sum_handler())
+        s = eng.open_session()
+        r = eng.submit(s, "sum", list(range(10)))
+        assert r.result(timeout=30) == 45
+        assert eng.budget.used == 0
+        assert eng.metrics.get("completed") == 1
+        assert eng.budget.peak >= 64 * 10  # the working set WAS reserved
+    finally:
+        eng.shutdown()
+
+
+def test_unknown_handler_raises(gov):
+    eng = _engine(gov)
+    try:
+        s = eng.open_session()
+        with pytest.raises(KeyError):
+            eng.submit(s, "nope", 1)
+    finally:
+        eng.shutdown()
+
+
+def test_handler_error_completes_as_failure(gov):
+    eng = _engine(gov)
+    try:
+        def boom(p, ctx):
+            raise ValueError("bad payload")
+
+        eng.register(QueryHandler(name="boom", fn=boom))
+        s = eng.open_session()
+        r = eng.submit(s, "boom", None)
+        with pytest.raises(ValueError, match="bad payload"):
+            r.result(timeout=30)
+        assert eng.metrics.get("failed") == 1
+        assert eng.budget.used == 0
+    finally:
+        eng.shutdown()
+
+
+def test_injected_retry_oom_retries_same_request(gov):
+    """An injected RetryOOM against the worker's reservation (the ALLOC
+    seam — the allocator-interception point): the request retries in
+    place and completes, the RmmSparkTest injection shape one level up."""
+    from spark_rapids_jni_tpu.obs.faultinj import FaultInjector
+
+    eng = _engine(gov, workers=1)
+    try:
+        attempts = []
+
+        def record(p, ctx):
+            attempts.append(1)
+            return sum(p)
+
+        eng.register(QueryHandler(name="sum", fn=record,
+                                  nbytes_of=lambda p: 64 * len(p)))
+        FaultInjector.install({
+            "alloc": {"reserve:dev:*": {"injectionType": "retry_oom",
+                                        "interceptionCount": 1}},
+        })
+        s = eng.open_session()
+        r = eng.submit(s, "sum", [1, 2, 3])
+        assert r.result(timeout=30) == 6
+        assert len(attempts) == 1  # RetryOOM fired at admission, before fn
+        assert eng.metrics.get("retried") == 1
+        assert eng.budget.used == 0
+    finally:
+        FaultInjector.uninstall()
+        eng.shutdown()
+
+
+def test_split_requeues_halves_and_joins_result(gov):
+    """An injected SplitAndRetryOOM at admission splits the payload into
+    re-queued halves whose results join back into the parent response."""
+    from spark_rapids_jni_tpu.obs.faultinj import FaultInjector
+
+    eng = _engine(gov, workers=1)
+    try:
+        pieces = []
+        eng.register(_sum_handler())
+        h = eng._handlers["sum"]
+        inner = h.fn
+        h.fn = lambda p, ctx: pieces.append(list(p)) or inner(p, ctx)
+        FaultInjector.install({
+            "alloc": {"reserve:dev:*": {"injectionType": "split_oom",
+                                        "interceptionCount": 1}},
+        })
+        s = eng.open_session()
+        r = eng.submit(s, "sum", list(range(8)))
+        assert r.result(timeout=30) == sum(range(8))
+        assert pieces == [[0, 1, 2, 3], [4, 5, 6, 7]]  # halves, in order
+        assert eng.metrics.get("split_requeued") == 2
+        assert eng.budget.used == 0
+    finally:
+        FaultInjector.uninstall()
+        eng.shutdown()
+
+
+def test_oversized_request_splits_until_fit(gov):
+    """A working set larger than the whole device budget splits via the
+    arbiter's escalation (BLOCKED -> BUFN -> SPLIT_THROW), recursively,
+    and the join tree still produces the exact result."""
+    eng = _engine(gov, budget_bytes=1000, workers=2)
+    try:
+        ran = []
+        eng.register(QueryHandler(
+            name="sum",
+            fn=lambda p, ctx: ran.append(len(p)) or sum(p),
+            nbytes_of=lambda p: 200 * len(p),
+            split=lambda p: [p[:len(p) // 2], p[len(p) // 2:]],
+            combine=sum))
+        s = eng.open_session()
+        r = eng.submit(s, "sum", list(range(16)))  # 3200 bytes > 1000
+        assert r.result(timeout=60) == sum(range(16))
+        assert all(n * 200 <= 1000 for n in ran), ran
+        assert eng.metrics.get("split_requeued") >= 2
+        assert eng.budget.used == 0
+    finally:
+        eng.shutdown()
+
+
+def test_unsplittable_oversized_request_fails_cleanly(gov):
+    eng = _engine(gov, budget_bytes=100)
+    try:
+        eng.register(QueryHandler(
+            name="big", fn=lambda p, ctx: p, nbytes_of=lambda p: 1000))
+        s = eng.open_session()
+        r = eng.submit(s, "big", 1)
+        with pytest.raises(MemoryError):
+            r.result(timeout=30)
+        assert eng.budget.used == 0
+    finally:
+        eng.shutdown()
+
+
+def test_capacity_grow_retry(gov):
+    """ShuffleCapacityExceeded -> handler.grow -> re-attempt (the exchange
+    overflow retry at the serving level)."""
+    from spark_rapids_jni_tpu.mem.governed import ShuffleCapacityExceeded
+
+    eng = _engine(gov)
+    try:
+        caps = []
+
+        def run(p, ctx):
+            caps.append(p)
+            if p < 4:
+                raise ShuffleCapacityExceeded(f"cap {p}")
+            return p
+
+        eng.register(QueryHandler(name="grow", fn=run,
+                                  grow=lambda p: p * 2))
+        s = eng.open_session()
+        assert eng.submit(s, "grow", 1).result(timeout=30) == 4
+        assert caps == [1, 2, 4]
+    finally:
+        eng.shutdown()
+
+
+def test_micro_batching_merges_compatible_requests(gov):
+    """Queued same-handler requests ride one launch; results redistribute
+    exactly."""
+    eng = _engine(gov, workers=1)  # one worker => the queue backs up
+    try:
+        launches = []
+
+        def run(p, ctx):
+            launches.append(len(p))
+            time.sleep(0.02)  # let the queue fill behind the first launch
+            return [x * 2 for x in p]
+
+        eng.register(QueryHandler(
+            name="dbl", fn=run,
+            nbytes_of=lambda p: 8 * len(p),
+            batch=lambda ps: [x for p in ps for x in p],
+            unbatch=lambda res, ps: [
+                res[sum(len(q) for q in ps[:i]):
+                    sum(len(q) for q in ps[:i + 1])]
+                for i in range(len(ps))],
+            max_batch=8))
+        s = eng.open_session()
+        resps = [eng.submit(s, "dbl", [i, i + 10]) for i in range(6)]
+        outs = [r.result(timeout=30) for r in resps]
+        assert outs == [[2 * i, 2 * (i + 10)] for i in range(6)]
+        assert len(launches) < 6  # some requests shared a launch
+        assert eng.metrics.get("batched") >= 2
+    finally:
+        eng.shutdown()
+
+
+def test_deadline_expires_in_queue(gov):
+    eng = _engine(gov, workers=1)
+    try:
+        eng.register(QueryHandler(
+            name="slow", fn=lambda p, ctx: time.sleep(p) or p))
+        s = eng.open_session()
+        blocker = eng.submit(s, "slow", 0.5)
+        doomed = eng.submit(s, "slow", 0.0, deadline_s=0.05)
+        from spark_rapids_jni_tpu.serve import RequestTimeout
+
+        with pytest.raises(RequestTimeout):
+            doomed.result(timeout=30)
+        assert blocker.result(timeout=30) == 0.5
+        assert eng.metrics.get("timed_out") == 1
+        assert s.inflight_requests == 0  # bytes credited back on timeout
+    finally:
+        eng.shutdown()
+
+
+def test_shutdown_drains_then_cancels(gov):
+    eng = _engine(gov, workers=1)
+    try:
+        eng.register(QueryHandler(name="id", fn=lambda p, ctx: p))
+        s = eng.open_session()
+        resps = [eng.submit(s, "id", i) for i in range(5)]
+    finally:
+        eng.shutdown(drain=True)
+    assert [r.result(timeout=1) for r in resps] == list(range(5))
+    # post-shutdown submits fail cleanly
+    with pytest.raises(RuntimeError):
+        eng.submit(s, "id", 9)
+
+
+def test_expired_split_half_still_joins_parent(gov):
+    """Review regression: a split half that expires while QUEUED completes
+    through the queue's timeout path — which must still deliver its join
+    slot, or the parent response hangs forever."""
+    from spark_rapids_jni_tpu.mem.exceptions import SplitAndRetryOOM
+
+    eng = _engine(gov, workers=1)
+    try:
+        eng.register(_sum_handler())
+        h = eng._handlers["sum"]
+        s = eng.open_session()
+        from spark_rapids_jni_tpu.serve.queue import Request
+
+        parent = Request(
+            handler="sum", payload=[1, 2, 3, 4],
+            session_id=s.session_id, priority=0,
+            deadline=time.monotonic() - 0.01,  # halves inherit: born dead
+            seq=10**6, task_id=eng.sessions.next_task_id())
+        eng._split_requeue([parent], h, SplitAndRetryOOM("test"))
+        assert parent.response.wait(timeout=10), \
+            "parent never completed: join slot lost on queue timeout"
+        assert parent.response.status == "timed_out"
+    finally:
+        eng.shutdown()
+
+
+def test_batch_merge_failure_fails_all_members(gov):
+    """Review regression: h.batch() raising must complete EVERY popped
+    member (the mates left the queue with the primary)."""
+    eng = _engine(gov, workers=1)
+    try:
+        def bad_batch(ps):
+            raise RuntimeError("merge broke")
+
+        eng.register(QueryHandler(
+            name="b",
+            fn=lambda p, ctx: time.sleep(0.05) or p,
+            batch=bad_batch,
+            unbatch=lambda res, ps: [res] * len(ps)))
+        s = eng.open_session()
+        resps = [eng.submit(s, "b", i) for i in range(4)]
+        for r in resps:
+            assert r.wait(timeout=30), "a batch member was stranded"
+            assert r.status in ("ok", "error")
+        # at least one group actually merged (and failed) en route
+        assert any(r.status == "error" for r in resps)
+    finally:
+        eng.shutdown()
+
+
+# ------------------------------------------------------ built-in handlers --
+
+def test_builtin_q97_exact(gov):
+    mesh = make_mesh((len(jax.devices()), 1))
+    eng = _engine(gov, mesh=mesh, builtin_handlers=True)
+    try:
+        rng = np.random.RandomState(3)
+        store = (rng.randint(1, 40, 300).astype(np.int32),
+                 rng.randint(1, 12, 300).astype(np.int32))
+        catalog = (rng.randint(1, 40, 220).astype(np.int32),
+                   rng.randint(1, 12, 220).astype(np.int32))
+        s = eng.open_session()
+        out = eng.submit(s, "q97", (store, catalog)).result(timeout=120)
+        got = (int(out.store_only), int(out.catalog_only), int(out.both))
+        assert got == q97_host_oracle(store, catalog)
+        assert eng.budget.used == 0
+    finally:
+        eng.shutdown()
+
+
+def test_builtin_q97_split_requeue_exact(gov):
+    """Tight budget: the q97 working set splits by key space through the
+    REQUEUE path (not the inline driver) and stays exact."""
+    from spark_rapids_jni_tpu.models.q97 import (
+        Q97Batch,
+        default_q97_capacity,
+        q97_working_set_bytes,
+    )
+
+    mesh = make_mesh((len(jax.devices()), 1))
+    rng = np.random.RandomState(4)
+    store = (rng.randint(1, 300, 1200).astype(np.int32),
+             rng.randint(1, 20, 1200).astype(np.int32))
+    catalog = (rng.randint(1, 300, 1000).astype(np.int32),
+               rng.randint(1, 20, 1000).astype(np.int32))
+    # the working set at the capacity the handler itself will pick, so
+    # the 0.55x budget provably does not fit the whole batch
+    cap0 = default_q97_capacity(2200, 8)
+    full = q97_working_set_bytes(
+        Q97Batch(store[0], store[1], catalog[0], catalog[1],
+                 capacity=cap0), 8)
+    eng = _engine(gov, mesh=mesh, budget_bytes=int(full * 0.55),
+                  builtin_handlers=True)
+    try:
+        s = eng.open_session()
+        out = eng.submit(s, "q97", (store, catalog)).result(timeout=300)
+        got = (int(out.store_only), int(out.catalog_only), int(out.both))
+        assert got == q97_host_oracle(store, catalog)
+        assert eng.metrics.get("split_requeued") >= 2
+        assert eng.budget.used == 0
+    finally:
+        eng.shutdown()
+
+
+def test_builtin_hash32_batches(gov):
+    mesh = make_mesh((len(jax.devices()), 1))
+    eng = _engine(gov, mesh=mesh, workers=1, builtin_handlers=True)
+    try:
+        from spark_rapids_jni_tpu.columnar.column import Column
+        from spark_rapids_jni_tpu.columnar.dtypes import INT64
+        from spark_rapids_jni_tpu.ops.hashing import murmur_hash32
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(5)
+        payloads = [rng.randint(0, 1 << 40, 32) for _ in range(5)]
+        s = eng.open_session()
+        resps = [eng.submit(s, "hash32", p) for p in payloads]
+        for p, r in zip(payloads, resps):
+            want = np.asarray(murmur_hash32(
+                [Column(jnp.asarray(p.astype(np.int64)), None, INT64)],
+                seed=42).data)
+            np.testing.assert_array_equal(r.result(timeout=60), want)
+    finally:
+        eng.shutdown()

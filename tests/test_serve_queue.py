@@ -1,0 +1,164 @@
+"""Admission queue unit tier: bounds, backpressure, priorities, deadlines.
+
+Pins the queue contract the serving engine builds on (serve/queue.py module
+doc): reject-don't-drop at capacity, priority-then-FIFO pop order, expired
+requests completing as timed-out (a terminal state, never a silent loss),
+and close() cancelling everything still queued.
+"""
+
+import threading
+import time
+
+import pytest
+
+from spark_rapids_jni_tpu.serve.queue import (
+    AdmissionQueue,
+    Backpressure,
+    Request,
+    RequestTimeout,
+    Response,
+)
+
+
+def _req(seq, *, priority=0, deadline=None, handler="h", no_batch=False):
+    return Request(handler=handler, payload=seq, session_id="s",
+                   priority=priority, deadline=deadline, seq=seq,
+                   task_id=seq, no_batch=no_batch)
+
+
+def test_fifo_within_priority():
+    q = AdmissionQueue(8)
+    for i in range(4):
+        q.submit(_req(i))
+    assert [q.pop().payload for _ in range(4)] == [0, 1, 2, 3]
+
+
+def test_higher_priority_pops_first():
+    q = AdmissionQueue(8)
+    q.submit(_req(0, priority=0))
+    q.submit(_req(1, priority=5))
+    q.submit(_req(2, priority=1))
+    assert [q.pop().payload for _ in range(3)] == [1, 2, 0]
+
+
+def test_full_queue_rejects_with_retry_after():
+    q = AdmissionQueue(2, retry_after_hint=lambda depth: 0.125 * depth)
+    q.submit(_req(0))
+    q.submit(_req(1))
+    with pytest.raises(Backpressure) as ei:
+        q.submit(_req(2))
+    assert ei.value.retry_after_s == pytest.approx(0.25)
+    assert q.depth() == 2  # the rejected request never queued
+
+
+def test_force_submit_bypasses_bound():
+    """Split-requeues must never bounce off a full queue (they carry an
+    already-admitted request's work)."""
+    q = AdmissionQueue(1)
+    q.submit(_req(0))
+    q.submit(_req(1), force=True)
+    assert q.depth() == 2
+
+
+def test_expired_request_completes_timed_out_on_pop():
+    q = AdmissionQueue(8)
+    dead = _req(0, deadline=time.monotonic() - 0.01)
+    live = _req(1)
+    q.submit(dead)
+    q.submit(live)
+    got = q.pop()
+    assert got.payload == 1
+    assert dead.response.status == "timed_out"
+    with pytest.raises(RequestTimeout):
+        dead.response.result(timeout=0)
+
+
+def test_on_timeout_callback_fires():
+    seen = []
+    q = AdmissionQueue(8, on_timeout=seen.append)
+    q.submit(_req(0, deadline=time.monotonic() - 0.01))
+    q.submit(_req(1))
+    q.pop()
+    assert [r.seq for r in seen] == [0]
+
+
+def test_pop_blocks_until_submit():
+    q = AdmissionQueue(8)
+    got = []
+
+    def consumer():
+        got.append(q.pop())
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.05)
+    assert not got  # parked
+    q.submit(_req(7))
+    t.join(timeout=5)
+    assert not t.is_alive() and got[0].payload == 7
+
+
+def test_pop_timeout_returns_none():
+    q = AdmissionQueue(8)
+    t0 = time.monotonic()
+    assert q.pop(timeout=0.05) is None
+    assert time.monotonic() - t0 < 2
+
+
+def test_pop_compatible_gathers_matching_only():
+    q = AdmissionQueue(16)
+    for i in range(3):
+        q.submit(_req(i, handler="a"))
+    q.submit(_req(3, handler="b"))
+    q.submit(_req(4, handler="a", no_batch=True))
+    first = q.pop()
+    assert first.handler == "a"
+    mates = q.pop_compatible(
+        lambda r: r.handler == "a" and not r.no_batch, limit=8)
+    assert sorted(r.payload for r in mates) == [1, 2]
+    # the rest (b, and the no_batch a) still pop normally
+    rest = {q.pop().payload for _ in range(2)}
+    assert rest == {3, 4}
+
+
+def test_pop_compatible_respects_limit():
+    q = AdmissionQueue(16)
+    for i in range(5):
+        q.submit(_req(i))
+    q.pop()
+    assert len(q.pop_compatible(lambda r: True, limit=2)) == 2
+    assert q.depth() == 2
+
+
+def test_close_cancels_everything_queued():
+    q = AdmissionQueue(8)
+    reqs = [_req(i) for i in range(3)]
+    for r in reqs:
+        q.submit(r)
+    dropped = q.close()
+    assert len(dropped) == 3
+    for r in reqs:
+        assert r.response.status == "cancelled"
+        with pytest.raises(RuntimeError):
+            r.response.result(timeout=0)
+    with pytest.raises(RuntimeError):
+        q.submit(_req(9))
+    assert q.pop() is None  # consumers drain out
+
+
+def test_close_wakes_blocked_consumers():
+    q = AdmissionQueue(8)
+    out = []
+    t = threading.Thread(target=lambda: out.append(q.pop()))
+    t.start()
+    time.sleep(0.05)
+    q.close()
+    t.join(timeout=5)
+    assert not t.is_alive() and out == [None]
+
+
+def test_response_completes_once():
+    r = Response()
+    assert r._complete("ok", value=1)
+    assert not r._complete("error", error=RuntimeError("late"))
+    assert r.result() == 1

@@ -1,0 +1,135 @@
+"""Session tier: tenant identity, byte budgets, task-id mapping.
+
+Pins serve/session.py: per-session in-flight byte budgets reject cleanly
+at submit (before queueing), task ids stay engine-global monotonic (arbiter
+age priority), priorities flow from session to request, and closed sessions
+stop admitting.
+"""
+
+import pytest
+
+from spark_rapids_jni_tpu.mem import MemoryGovernor
+from spark_rapids_jni_tpu.serve import (
+    QueryHandler,
+    ServingEngine,
+    SessionBudgetExceeded,
+    SessionRegistry,
+)
+
+
+@pytest.fixture
+def engine():
+    from spark_rapids_jni_tpu.mem import BudgetedResource
+
+    gov = MemoryGovernor(watchdog_period_s=0.05)
+    budget = BudgetedResource(gov, 1 << 30)
+    eng = ServingEngine(gov=gov, budget=budget, workers=2, queue_size=16,
+                        default_deadline_s=10.0)
+    eng.register(QueryHandler(
+        name="echo", fn=lambda p, ctx: p,
+        nbytes_of=lambda p: int(p.get("nbytes", 0))
+        if isinstance(p, dict) else 0))
+    yield eng
+    eng.shutdown()
+    gov.close()
+
+
+# ------------------------------------------------------------- registry ----
+
+def test_registry_allocates_unique_ids_and_tasks():
+    reg = SessionRegistry()
+    a = reg.open()
+    b = reg.open()
+    assert a.session_id != b.session_id
+    assert reg.get(a.session_id) is a
+    tids = [reg.next_task_id() for _ in range(5)]
+    assert tids == sorted(tids) and len(set(tids)) == 5
+
+
+def test_registry_rejects_duplicate_open():
+    reg = SessionRegistry()
+    reg.open("tenant")
+    with pytest.raises(ValueError):
+        reg.open("tenant")
+
+
+def test_session_charge_credit_accounting():
+    reg = SessionRegistry()
+    s = reg.open(byte_budget=100)
+    s.charge(60)
+    assert (s.inflight_bytes, s.inflight_requests) == (60, 1)
+    with pytest.raises(SessionBudgetExceeded):
+        s.charge(50)  # 60 + 50 > 100
+    s.credit(60)
+    s.charge(50)  # fits now
+    assert s.inflight_bytes == 50
+
+
+def test_oversized_single_request_rejected_outright():
+    reg = SessionRegistry()
+    s = reg.open(byte_budget=100)
+    with pytest.raises(SessionBudgetExceeded):
+        s.charge(101)
+    assert s.inflight_bytes == 0
+
+
+# ------------------------------------------------- engine-level behavior ---
+
+def test_session_budget_rejects_at_submit(engine):
+    s = engine.open_session(byte_budget=1000)
+    with pytest.raises(SessionBudgetExceeded):
+        engine.submit(s, "echo", {"nbytes": 2000})
+    assert engine.metrics.get("rejected_session", s.session_id) == 1
+    assert engine.metrics.get("submitted", s.session_id) == 0
+
+
+def test_session_bytes_credited_after_completion(engine):
+    s = engine.open_session(byte_budget=1000)
+    r = engine.submit(s, "echo", {"nbytes": 800})
+    assert r.result(timeout=30) == {"nbytes": 800}
+    deadline = __import__("time").monotonic() + 5
+    while s.inflight_bytes and __import__("time").monotonic() < deadline:
+        __import__("time").sleep(0.01)
+    assert (s.inflight_bytes, s.inflight_requests) == (0, 0)
+    # the budget is whole again: a full-budget request is admitted
+    assert engine.submit(s, "echo", {"nbytes": 1000}).result(timeout=30)
+
+
+def test_closed_session_rejects_submit(engine):
+    s = engine.open_session("closing")
+    engine.close_session(s)
+    with pytest.raises(RuntimeError, match="closed"):
+        engine.submit(s, "echo", {})
+
+
+def test_request_inherits_session_priority(engine):
+    hi = engine.open_session(priority=7)
+    r = engine.submit(hi, "echo", {"x": 1})
+    assert r.result(timeout=30) == {"x": 1}
+    lo = engine.open_session(priority=0)
+    r2 = engine.submit(lo, "echo", {}, priority=3)  # explicit override
+    assert r2.result(timeout=30) == {}
+
+
+def test_task_ids_monotonic_across_sessions(engine):
+    a = engine.open_session()
+    b = engine.open_session()
+    ra = engine.submit(a, "echo", {})
+    rb = engine.submit(b, "echo", {})
+    ra.result(timeout=30)
+    rb.result(timeout=30)
+    # the registry hands out strictly increasing ids across tenants
+    assert engine.sessions.next_task_id() > 2
+
+
+def test_per_session_metrics_isolated(engine):
+    a = engine.open_session("tenant-a")
+    b = engine.open_session("tenant-b")
+    for _ in range(3):
+        engine.submit(a, "echo", {}).result(timeout=30)
+    engine.submit(b, "echo", {}).result(timeout=30)
+    assert engine.metrics.get("completed", "tenant-a") == 3
+    assert engine.metrics.get("completed", "tenant-b") == 1
+    snap = engine.metrics.snapshot()
+    assert snap["sessions"]["tenant-a"]["submitted"] == 3
+    assert snap["counters"]["completed"] >= 4

@@ -10,7 +10,8 @@ separates the candidate causes so BENCH_r03's analysis is grounded:
 
 Run on the real chip (prints one JSON line per experiment):
 
-    python tools/perf_probe.py [--op murmur3|xxhash64|copy|partition_murmur3|partition_mix32] [--iters 50]
+    python tools/perf_probe.py [--iters 50] \
+        [--op murmur3|xxhash64|copy|partition_murmur3|partition_mix32]
 """
 
 from __future__ import annotations

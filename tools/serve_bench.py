@@ -1,0 +1,163 @@
+"""Closed-loop load generator for the serving engine (BENCH_serve).
+
+N client threads drive the engine closed-loop (each client waits for its
+response — or a backpressure rejection — before submitting the next
+request), over a mixed workload: governed distributed q97 queries plus
+batchable hash ops, with a spread of session priorities and per-session
+byte budgets.  On Backpressure a client honors the ``retry_after_s`` hint
+and re-submits (bounded attempts), so the bench exercises the reject/retry
+loop a real front end would run.
+
+The zero-lost-requests invariant is the headline assertion: every logical
+request ends in exactly one of {succeeded, rejected (backpressure, retries
+exhausted), timed_out} — nothing hangs, nothing disappears.
+
+Run (CPU mesh):
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/serve_bench.py --clients 32 --requests 200
+
+Prints ONE json line (name=BENCH_serve): p50/p99 queue-wait and run
+latency, admitted/rejected/retried/timed-out counts, client-side outcome
+tally, and wall-clock throughput.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="serving-engine load generator")
+    ap.add_argument("--clients", type=int, default=32)
+    ap.add_argument("--requests", type=int, default=200,
+                    help="total logical requests across all clients")
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--queue-size", type=int, default=32)
+    ap.add_argument("--deadline-s", type=float, default=60.0)
+    ap.add_argument("--q97-rows", type=int, default=512,
+                    help="rows per side of each q97 request")
+    ap.add_argument("--hash-frac", type=float, default=0.5,
+                    help="fraction of requests that are hash32 ops "
+                         "(the rest are q97 queries)")
+    ap.add_argument("--max-retries", type=int, default=50,
+                    help="backpressure re-submits before a request counts "
+                         "as finally rejected")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    from spark_rapids_jni_tpu.mem import BudgetedResource, MemoryGovernor
+    from spark_rapids_jni_tpu.models.q97 import q97_host_oracle
+    from spark_rapids_jni_tpu.parallel import make_mesh
+    from spark_rapids_jni_tpu.serve import (
+        Backpressure,
+        RequestTimeout,
+        ServingEngine,
+    )
+
+    mesh = make_mesh()
+    gov = MemoryGovernor.initialize()
+    budget = BudgetedResource(gov, 1 << 30)
+    engine = ServingEngine(
+        mesh=mesh, gov=gov, budget=budget, workers=args.workers,
+        queue_size=args.queue_size, default_deadline_s=args.deadline_s,
+        builtin_handlers=True)
+
+    per_client = max(1, args.requests // args.clients)
+    total = per_client * args.clients
+    lock = threading.Lock()
+    tally = {"succeeded": 0, "rejected": 0, "timed_out": 0, "errors": 0,
+             "client_retries": 0, "wrong_answers": 0}
+
+    def client(ci: int) -> None:
+        rng = np.random.RandomState(args.seed * 1000 + ci)
+        # tenant spread: a third high-priority, a third byte-capped
+        sess = engine.open_session(
+            f"client{ci}",
+            priority=1 if ci % 3 == 0 else 0,
+            byte_budget=(64 << 20) if ci % 3 == 1 else None)
+        for _ in range(per_client):
+            use_hash = rng.random_sample() < args.hash_frac
+            if use_hash:
+                payload = rng.randint(0, 1 << 40, 256)
+                want = None
+            else:
+                n = args.q97_rows
+                payload = (
+                    (rng.randint(1, 200, n).astype(np.int32),
+                     rng.randint(1, 50, n).astype(np.int32)),
+                    (rng.randint(1, 200, n).astype(np.int32),
+                     rng.randint(1, 50, n).astype(np.int32)))
+                want = q97_host_oracle(*payload)
+            outcome = "rejected"
+            for _ in range(args.max_retries):
+                try:
+                    resp = engine.submit(
+                        sess, "hash32" if use_hash else "q97", payload)
+                except Backpressure as bp:
+                    with lock:
+                        tally["client_retries"] += 1
+                    time.sleep(min(bp.retry_after_s, 0.25))
+                    continue
+                try:
+                    out = resp.result(timeout=args.deadline_s + 30)
+                except RequestTimeout:
+                    outcome = "timed_out"
+                except Exception:  # noqa: BLE001 - counted, not raised
+                    outcome = "errors"
+                else:
+                    outcome = "succeeded"
+                    if want is not None:
+                        got = (int(out.store_only), int(out.catalog_only),
+                               int(out.both))
+                        if got != want:
+                            with lock:
+                                tally["wrong_answers"] += 1
+                break
+            with lock:
+                tally[outcome] += 1
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(ci,))
+               for ci in range(args.clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    engine.shutdown()
+    MemoryGovernor.shutdown()
+
+    snap = engine.metrics.snapshot()
+    accounted = (tally["succeeded"] + tally["rejected"] + tally["timed_out"]
+                 + tally["errors"])
+    rec = {
+        "name": "BENCH_serve",
+        "clients": args.clients,
+        "requests": total,
+        "workers": args.workers,
+        "queue_size": args.queue_size,
+        "wall_s": round(wall, 3),
+        "req_per_s": round(total / wall, 2),
+        "outcomes": tally,
+        "zero_lost": accounted == total and tally["errors"] == 0
+        and tally["wrong_answers"] == 0,
+        "queue_wait_ms": snap["queue_wait"],
+        "run_latency_ms": snap["run_latency"],
+        "counters": snap["counters"],
+    }
+    print(json.dumps(rec))
+    return 0 if rec["zero_lost"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
