@@ -1,0 +1,1650 @@
+"""Protocol-aware static analysis for the memory-governance contracts.
+
+The governor's hardest bugs are runtime-invisible until they wedge: a lock
+cycle the watchdog only breaks after the hang, a broad ``except`` that eats
+a RetryOOM, a kernel that allocates device memory without reserving budget.
+This gate rejects those *before* merge — the compile-time complement of the
+arbiter's runtime deadlock detector (native/task_arbiter.cpp), in the
+spirit of Flare's compile-time checking of Spark-native runtime contracts.
+
+Five passes (see docs/STATIC_ANALYSIS.md for the invariants):
+
+- ``lock-order``           cycles in the static lock-acquisition graph
+- ``unguarded-shared-state`` unlocked attribute writes in lock-owning classes
+- ``retry-protocol``       broad excepts that can swallow retry signals
+- ``governed-allocation``  raw device allocation outside a governor bracket
+- ``seam-discipline``      obs seam crossings not paired / unregistered
+
+Workflow:
+
+- ``python ci/analyze.py``                 gate: exit 1 on un-baselined findings
+- ``python ci/analyze.py --json``          machine-readable findings
+- ``python ci/analyze.py --changed-only REF``  only report findings in files
+  changed since the git ref (full-project analysis still runs — the lock
+  graph is whole-program — but the report is filtered)
+- ``python ci/analyze.py --update-baseline``   grandfather current findings
+- ``# analyze: ignore[rule-id]``           per-line suppression (on the
+  statement's first line); ``# analyze: ignore`` suppresses every rule;
+  ``# analyze: ignore-file[rule-id]`` anywhere in a file suppresses the
+  rule for the whole file.
+
+Suppressions are for findings that are *by design* (with a comment saying
+why); the baseline (ci/analyze_baseline.json) is for grandfathered debt
+that new code must not add to.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional, Set, Tuple
+
+# --------------------------------------------------------------------------
+# findings, suppressions, baseline
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation.  ``message`` is line-stable (no line numbers in
+    it) so the baseline survives unrelated edits above the finding."""
+
+    rule: str
+    path: str  # repo-root-relative posix path
+    line: int
+    message: str
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.message)
+
+    def human(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+
+def emit_json(findings: List[Finding], *, tool: str, files: int,
+              extra: Optional[dict] = None) -> None:
+    """The shared JSON report shape (ci/lint.py --json uses it too)."""
+    payload = {
+        "tool": tool,
+        "files": files,
+        "findings": [f.to_json() for f in findings],
+    }
+    if extra:
+        payload.update(extra)
+    json.dump(payload, sys.stdout, indent=1, sort_keys=True)
+    sys.stdout.write("\n")
+
+
+_SUPPR_RE = re.compile(r"#\s*analyze:\s*ignore(?:\[([A-Za-z0-9_,\- ]+)\])?")
+_SUPPR_FILE_RE = re.compile(r"#\s*analyze:\s*ignore-file\[([A-Za-z0-9_,\- ]+)\]")
+
+
+def _parse_suppressions(lines: List[str]):
+    """Same-line suppressions, plus comment-only lines whose suppression
+    carries to the next code line (so a block comment above an ``except``
+    can both suppress and explain why)."""
+    per_line: Dict[int, Set[str]] = {}
+    whole_file: Set[str] = set()
+    pending: Set[str] = set()
+    for i, line in enumerate(lines, 1):
+        stripped = line.strip()
+        m = _SUPPR_FILE_RE.search(line)
+        if m:
+            whole_file.update(r.strip() for r in m.group(1).split(","))
+            continue
+        m = _SUPPR_RE.search(line)
+        rules: Set[str] = set()
+        if m:
+            rules = (set(r.strip() for r in m.group(1).split(","))
+                     if m.group(1) else {"*"})
+            per_line.setdefault(i, set()).update(rules)
+        if stripped.startswith("#"):
+            pending |= rules
+            continue
+        if not stripped:
+            pending = set()  # blank line ends a carrying comment block
+            continue
+        if pending:
+            per_line.setdefault(i, set()).update(pending)
+            pending = set()
+    return per_line, whole_file
+
+
+class Baseline:
+    """Committed grandfather list keyed on (rule, path, message) counts."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.counts: Dict[Tuple[str, str, str], int] = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                data = json.load(f)
+            for e in data.get("entries", []):
+                key = (e["rule"], e["path"], e["message"])
+                self.counts[key] = self.counts.get(key, 0) + e.get("count", 1)
+
+    def split(self, findings: List[Finding]):
+        """-> (new_findings, n_baselined, n_stale_entries)."""
+        remaining = dict(self.counts)
+        new: List[Finding] = []
+        baselined = 0
+        for f in findings:
+            if remaining.get(f.key(), 0) > 0:
+                remaining[f.key()] -= 1
+                baselined += 1
+            else:
+                new.append(f)
+        stale = sum(1 for v in remaining.values() if v > 0)
+        return new, baselined, stale
+
+    @staticmethod
+    def write(path: str, findings: List[Finding]) -> None:
+        counts: Dict[Tuple[str, str, str], int] = defaultdict(int)
+        for f in findings:
+            counts[f.key()] += 1
+        entries = [
+            {"rule": r, "path": p, "message": m, "count": n}
+            for (r, p, m), n in sorted(counts.items())
+        ]
+        with open(path, "w") as f:
+            json.dump({"version": 1, "entries": entries}, f, indent=1,
+                      sort_keys=True)
+            f.write("\n")
+
+
+# --------------------------------------------------------------------------
+# configuration
+# --------------------------------------------------------------------------
+
+CONTROL_EXCEPTIONS = frozenset({
+    "RetryOOM", "SplitAndRetryOOM", "GpuRetryOOM", "GpuSplitAndRetryOOM",
+    "CpuRetryOOM", "CpuSplitAndRetryOOM", "ShuffleCapacityExceeded",
+})
+# the roots a broad handler's TRY must cover explicitly to be exempt
+CONTROL_ROOTS = frozenset({"RetryOOM", "SplitAndRetryOOM",
+                           "ShuffleCapacityExceeded"})
+# a name (e.g. a module-level tuple constant) treated as covering all roots
+CONTROL_ALIASES = frozenset({"CONTROL_FLOW_EXCEPTIONS"})
+BROAD_NAMES = frozenset({"Exception", "BaseException", "MemoryError"})
+
+ALLOC_ATTRS = frozenset({"zeros", "ones", "empty", "full", "zeros_like",
+                         "ones_like", "empty_like", "full_like"})
+LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "cond"}
+
+
+@dataclasses.dataclass
+class Config:
+    lock_scope: Tuple[str, ...] = ("mem.", "mem", "serve.", "serve")
+    state_scope: Tuple[str, ...] = ("mem.", "mem", "serve.", "serve")
+    governed_scope: Tuple[str, ...] = ("ops.", "ops", "models.", "models",
+                                       "serve.", "serve")
+    seam_exclude: Tuple[str, ...] = ("obs.seam",)
+    governed_drivers: Tuple[str, ...] = ("attempt_once",
+                                         "run_with_split_retry", "_attempt")
+    handler_classes: Tuple[str, ...] = ("QueryHandler",)
+    reservation_funcs: Tuple[str, ...] = ("reservation",)
+    categories: Optional[Set[str]] = None  # None -> parse obs/seam.py
+    rules: Optional[Set[str]] = None  # None -> all registered
+
+
+def _in_scope(modid: str, prefixes: Tuple[str, ...]) -> bool:
+    return any(modid == p or modid.startswith(p) for p in prefixes)
+
+
+# --------------------------------------------------------------------------
+# project model
+# --------------------------------------------------------------------------
+
+
+class ModuleInfo:
+    def __init__(self, pkg: str, modid: str, path: str, relpath: str):
+        self.pkg = pkg  # package name, e.g. "spark_rapids_jni_tpu"
+        self.modid = modid  # package-relative dotted id, e.g. "mem.governor"
+        self.path = path
+        self.relpath = relpath  # repo-root-relative posix path
+        with open(path, "rb") as f:
+            src = f.read().decode("utf-8")
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src, filename=path)
+        self.line_suppr, self.file_suppr = _parse_suppressions(self.lines)
+        # localname -> ("mod", modid) | ("obj", modid, name)
+        self.imports: Dict[str, tuple] = {}
+        # top-level defs
+        self.classes: Dict[str, "ClassInfo"] = {}
+        self.functions: Dict[str, ast.AST] = {}  # qualname -> node
+        self.module_locks: Dict[str, str] = {}  # var -> kind
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_suppr or "*" in self.file_suppr:
+            return True
+        rules = self.line_suppr.get(line, ())
+        return rule in rules or "*" in rules
+
+
+class ClassInfo:
+    def __init__(self, module: ModuleInfo, node: ast.ClassDef):
+        self.module = module
+        self.node = node
+        self.name = node.name
+        self.key = f"{module.modid}.{node.name}"
+        self.methods: Dict[str, ast.AST] = {}
+        self.lock_attrs: Dict[str, str] = {}  # attr -> kind
+        self.attr_types: Dict[str, str] = {}  # attr -> class key
+        # funckeys passed as arguments to this class's ctor/methods anywhere
+        self.callback_targets: Set[str] = set()
+
+
+class Project:
+    """Parsed package(s) + cross-module name resolution."""
+
+    def __init__(self, root: str, config: Config):
+        self.root = root
+        self.config = config
+        self.modules: Dict[str, ModuleInfo] = {}  # modid -> info
+        self.classes: Dict[str, ClassInfo] = {}  # "mod.Class" -> info
+        # "mod.qualname" -> (module, node); includes methods and nested defs
+        self.functions: Dict[str, Tuple[ModuleInfo, ast.AST]] = {}
+        self.packages: List[str] = []
+        self.errors: List[Finding] = []
+        self._discover()
+        self._index()
+
+    # -- discovery ---------------------------------------------------------
+    def _discover(self) -> None:
+        for entry in sorted(os.listdir(self.root)):
+            pkg_dir = os.path.join(self.root, entry)
+            if not os.path.isfile(os.path.join(pkg_dir, "__init__.py")):
+                continue
+            self.packages.append(entry)
+            for dirpath, dirnames, filenames in os.walk(pkg_dir):
+                dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+                for fname in sorted(filenames):
+                    if not fname.endswith(".py"):
+                        continue
+                    path = os.path.join(dirpath, fname)
+                    rel = os.path.relpath(path, pkg_dir)
+                    modid = rel[:-3].replace(os.sep, ".")
+                    if modid.endswith(".__init__"):
+                        modid = modid[: -len(".__init__")] or "__init__"
+                    elif modid == "__init__":
+                        pass
+                    relpath = os.path.relpath(path, self.root).replace(
+                        os.sep, "/")
+                    try:
+                        self.modules[modid] = ModuleInfo(
+                            entry, modid, path, relpath)
+                    except SyntaxError as e:
+                        self.errors.append(Finding(
+                            "parse", relpath, e.lineno or 1,
+                            f"syntax error: {e.msg}"))
+
+    # -- indexing ----------------------------------------------------------
+    def _index(self) -> None:
+        for mod in self.modules.values():
+            self._index_imports(mod)
+        for mod in self.modules.values():
+            self._index_defs(mod)
+        for mod in self.modules.values():
+            self._index_attr_types(mod)
+        self._index_callbacks()
+
+    def _mod_from_dotted(self, mod: ModuleInfo, dotted: str) -> Optional[str]:
+        for pkg in self.packages:
+            if dotted == pkg:
+                return "__init__"
+            if dotted.startswith(pkg + "."):
+                return dotted[len(pkg) + 1:]
+        return None
+
+    def _index_imports(self, mod: ModuleInfo) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    target = self._mod_from_dotted(mod, a.name)
+                    if target is not None:
+                        mod.imports[a.asname or a.name.split(".")[0]] = (
+                            "mod", target)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                dotted = node.module
+                if node.level:  # relative import: resolve against modid
+                    base = mod.modid.split(".")[: -(node.level)]
+                    dotted = ".".join(base + ([dotted] if dotted else []))
+                    target = dotted or "__init__"
+                else:
+                    target = self._mod_from_dotted(mod, dotted)
+                if target is None:
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    # `from pkg.obs import seam` imports a MODULE
+                    sub = f"{target}.{a.name}" if target != "__init__" else a.name
+                    if sub in self.modules:
+                        mod.imports[a.asname or a.name] = ("mod", sub)
+                    else:
+                        mod.imports[a.asname or a.name] = (
+                            "obj", target, a.name)
+
+    def _index_defs(self, mod: ModuleInfo) -> None:
+        def add_func(qual: str, node) -> None:
+            self.functions[f"{mod.modid}.{qual}"] = (mod, node)
+            mod.functions[qual] = node
+
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                add_func(node.name, node)
+            elif isinstance(node, ast.ClassDef):
+                ci = ClassInfo(mod, node)
+                self.classes[ci.key] = ci
+                mod.classes[node.name] = ci
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        ci.methods[item.name] = item
+                        self.functions[f"{ci.key}.{item.name}"] = (mod, item)
+                    elif isinstance(item, ast.Assign):
+                        kind = _lock_ctor_kind(item.value)
+                        for t in item.targets:
+                            if isinstance(t, ast.Name):
+                                if kind:
+                                    ci.lock_attrs[t.id] = kind
+                    elif isinstance(item, ast.AnnAssign) and isinstance(
+                            item.target, ast.Name):
+                        # dataclass-style field annotation -> attr type
+                        tkey = self._ann_to_class(mod, item.annotation)
+                        if tkey:
+                            ci.attr_types[item.target.id] = tkey
+                # method aliases (`shuffle_x = pool_x` at class level) are
+                # rare; resolve Assign from Name of an existing method
+                for item in node.body:
+                    if (isinstance(item, ast.Assign)
+                            and isinstance(item.value, ast.Name)
+                            and item.value.id in ci.methods):
+                        for t in item.targets:
+                            if isinstance(t, ast.Name):
+                                ci.methods[t.id] = ci.methods[item.value.id]
+            elif isinstance(node, ast.Assign):
+                kind = _lock_ctor_kind(node.value)
+                if kind:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            mod.module_locks[t.id] = kind
+
+    def _ann_to_class(self, mod: ModuleInfo, ann) -> Optional[str]:
+        """Annotation expression -> class key (handles Optional[X], "X")."""
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            try:
+                ann = ast.parse(ann.value, mode="eval").body
+            except SyntaxError:
+                return None
+        if isinstance(ann, ast.Subscript):  # Optional[X] / list[X]: use X
+            return self._ann_to_class(mod, ann.slice)
+        if isinstance(ann, (ast.Name, ast.Attribute)):
+            r = self.resolve(mod, ann)
+            if r and r[0] == "class":
+                return r[1]
+        return None
+
+    def _index_attr_types(self, mod: ModuleInfo) -> None:
+        for ci in mod.classes.values():
+            for mname, meth in ci.methods.items():
+                env = self._param_env(mod, ci, meth)
+                for node in ast.walk(meth):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    for t in node.targets:
+                        if not (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == _self_name(meth)):
+                            continue
+                        kind = _lock_ctor_kind(node.value)
+                        if kind:
+                            ci.lock_attrs[t.attr] = kind
+                            continue
+                        tkey = self._infer_expr_class(mod, env, node.value)
+                        if tkey and t.attr not in ci.lock_attrs:
+                            ci.attr_types.setdefault(t.attr, tkey)
+
+    def _param_env(self, mod: ModuleInfo, ci: Optional[ClassInfo],
+                   func) -> Dict[str, str]:
+        """name -> class key for self/cls + annotated params."""
+        env: Dict[str, str] = {}
+        args = getattr(func, "args", None)
+        if args is None:
+            return env
+        params = list(args.posonlyargs) + list(args.args) + list(
+            args.kwonlyargs)
+        for i, a in enumerate(params):
+            if i == 0 and ci is not None and a.arg in ("self", "cls"):
+                env[a.arg] = ci.key
+                continue
+            tkey = self._ann_to_class(mod, a.annotation)
+            if tkey:
+                env[a.arg] = tkey
+        return env
+
+    def _infer_expr_class(self, mod: ModuleInfo, env: Dict[str, str],
+                          expr) -> Optional[str]:
+        """Best-effort type of an expression: constructor calls,
+        ``Class.classmethod()`` calls, calls to functions with a class
+        return annotation, annotated names, and if/or fallbacks."""
+        found: Set[str] = set()
+
+        def visit(e):
+            if isinstance(e, ast.Call):
+                r = self.resolve(mod, e.func)
+                if r:
+                    if r[0] == "class":
+                        found.add(r[1])
+                        return
+                    if r[0] == "func":
+                        entry = self.functions.get(r[1])
+                        if entry is not None:
+                            fmod, fnode = entry
+                            tkey = self._ann_to_class(
+                                fmod, getattr(fnode, "returns", None))
+                            if tkey:
+                                found.add(tkey)
+                                return
+                # Class.method(...) -> Class (e.g. Governor.instance())
+                if isinstance(e.func, ast.Attribute):
+                    r2 = self.resolve(mod, e.func.value)
+                    if r2 and r2[0] == "class":
+                        found.add(r2[1])
+                        return
+            elif isinstance(e, ast.Name) and e.id in env:
+                found.add(env[e.id])
+                return
+            elif isinstance(e, ast.IfExp):
+                visit(e.body)
+                visit(e.orelse)
+                return
+            elif isinstance(e, ast.BoolOp):
+                for v in e.values:
+                    visit(v)
+                return
+
+        visit(expr)
+        return found.pop() if len(found) == 1 else None
+
+    def _index_callbacks(self) -> None:
+        """Functions passed as arguments to ``SomeClass(...)`` or
+        ``<obj of SomeClass>.method(...)`` become that class's possible
+        callback targets (the lock pass uses them to resolve stored-
+        callable calls like ``self._on_timeout(req)``)."""
+        for mod in self.modules.values():
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                target_class = None
+                r = self.resolve(mod, node.func)
+                if r and r[0] == "class":
+                    target_class = r[1]
+                elif isinstance(node.func, ast.Attribute):
+                    # obj.method(...): resolve obj type where obj is
+                    # `self.attr` or a resolvable name
+                    owner = self._rough_owner_class(mod, node.func.value)
+                    if owner:
+                        target_class = owner
+                if target_class not in self.classes:
+                    continue
+                ci = self.classes[target_class]
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    fk = self._callable_key(mod, arg)
+                    if fk:
+                        ci.callback_targets.add(fk)
+
+    def _rough_owner_class(self, mod: ModuleInfo, expr) -> Optional[str]:
+        """Type of `self.attr` / `name` receivers, scanning every class in
+        the module for a matching attr type (imprecise but only used to
+        attach callback targets)."""
+        if isinstance(expr, ast.Name):
+            r = self.resolve(mod, expr)
+            if r and r[0] == "class":
+                return r[1]
+            return None
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value,
+                                                          ast.Name):
+            if expr.value.id in ("self", "cls"):
+                for ci in mod.classes.values():
+                    if expr.attr in ci.attr_types:
+                        return ci.attr_types[expr.attr]
+        return None
+
+    def _callable_key(self, mod: ModuleInfo, expr) -> Optional[str]:
+        """`self.meth` / `name` argument -> funckey if it is a function."""
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name) and expr.value.id in ("self", "cls"):
+            for ci in mod.classes.values():
+                if expr.attr in ci.methods:
+                    return f"{ci.key}.{expr.attr}"
+            return None
+        if isinstance(expr, ast.Name):
+            r = self.resolve(mod, expr)
+            if r and r[0] == "func":
+                return r[1]
+        return None
+
+    # -- resolution --------------------------------------------------------
+    def resolve(self, mod: ModuleInfo, expr) -> Optional[tuple]:
+        """Name/Attribute -> ("class", key) | ("func", key) | ("mod", modid).
+        Follows imports; understands `alias.attr` for module aliases."""
+        if isinstance(expr, ast.Name):
+            name = expr.id
+            if name in mod.classes:
+                return ("class", mod.classes[name].key)
+            if name in mod.functions:
+                return ("func", f"{mod.modid}.{name}")
+            imp = mod.imports.get(name)
+            if imp is None:
+                return None
+            if imp[0] == "mod":
+                return ("mod", imp[1])
+            _, src_modid, src_name = imp
+            return self._resolve_in_module(src_modid, src_name)
+        if isinstance(expr, ast.Attribute):
+            base = self.resolve(mod, expr.value)
+            if base and base[0] == "mod":
+                return self._resolve_in_module(base[1], expr.attr)
+            return None
+        return None
+
+    def _resolve_in_module(self, modid: str, name: str) -> Optional[tuple]:
+        seen = set()
+        while True:
+            target = self.modules.get(modid)
+            if target is None:
+                return None
+            if name in target.classes:
+                return ("class", target.classes[name].key)
+            if name in target.functions:
+                return ("func", f"{modid}.{name}")
+            sub = f"{modid}.{name}" if modid != "__init__" else name
+            if sub in self.modules:
+                return ("mod", sub)
+            # re-export: follow the module's own import of the name
+            imp = target.imports.get(name)
+            if imp is None or (modid, name) in seen:
+                return None
+            seen.add((modid, name))
+            if imp[0] == "mod":
+                return ("mod", imp[1])
+            _, modid, name = imp
+
+
+def _self_name(func) -> Optional[str]:
+    args = getattr(func, "args", None)
+    if args and (args.posonlyargs or args.args):
+        first = (args.posonlyargs or args.args)[0]
+        if first.arg in ("self", "cls"):
+            return first.arg
+    return None
+
+
+def _lock_ctor_kind(expr) -> Optional[str]:
+    """`threading.Lock()` / `Lock()` / `Condition(...)` -> kind."""
+    if not isinstance(expr, ast.Call):
+        return None
+    f = expr.func
+    name = None
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id == "threading":
+        name = f.attr
+    elif isinstance(f, ast.Name):
+        name = f.id
+    return LOCK_CTORS.get(name) if name else None
+
+
+def _func_defs(node):
+    """Nested FunctionDef/Lambda nodes directly inside ``node`` (not
+    crossing into further nesting levels handled by recursion)."""
+    for child in ast.walk(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)) and child is not node:
+            yield child
+
+
+# --------------------------------------------------------------------------
+# rule registry
+# --------------------------------------------------------------------------
+
+RULES: Dict[str, tuple] = {}  # id -> (fn, short description)
+
+
+def rule(rule_id: str, doc: str):
+    def deco(fn):
+        RULES[rule_id] = (fn, doc)
+        return fn
+
+    return deco
+
+
+# --------------------------------------------------------------------------
+# pass 1: lock-order
+# --------------------------------------------------------------------------
+
+
+class _LockWalker(ast.NodeVisitor):
+    """Walk one function body tracking lexically-held locks; record lock
+    acquisitions, condition waits, and calls with their held-lock set."""
+
+    def __init__(self, analysis: "_LockAnalysis", mod: ModuleInfo,
+                 ci: Optional[ClassInfo], funckey: str, env: Dict[str, str]):
+        self.a = analysis
+        self.mod = mod
+        self.ci = ci
+        self.funckey = funckey
+        self.env = env
+        self.held: List[Tuple[str, str]] = []  # (lockkey, kind)
+
+    # lock resolution ------------------------------------------------------
+    def _lock_of(self, expr) -> Optional[Tuple[str, str]]:
+        """with-expr -> (lockkey, kind): self.X / obj.X / MODULE_LOCK /
+        alias chains like self.gov.arbiter (no lock there, but chains of
+        attr types are followed)."""
+        if isinstance(expr, ast.Name):
+            kind = self.mod.module_locks.get(expr.id)
+            if kind:
+                return (f"{self.mod.modid}.{expr.id}", kind)
+            imp = self.mod.imports.get(expr.id)
+            if imp and imp[0] == "obj":
+                src = self.a.project.modules.get(imp[1])
+                if src and imp[2] in src.module_locks:
+                    return (f"{imp[1]}.{imp[2]}", src.module_locks[imp[2]])
+            return None
+        if isinstance(expr, ast.Attribute):
+            owner = self._class_of(expr.value)
+            if owner is None:
+                return None
+            ci = self.a.project.classes.get(owner)
+            if ci and expr.attr in ci.lock_attrs:
+                return (f"{owner}.{expr.attr}", ci.lock_attrs[expr.attr])
+        return None
+
+    def _class_of(self, expr) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            if expr.id in self.env:
+                return self.env[expr.id]
+            r = self.a.project.resolve(self.mod, expr)
+            if r and r[0] == "class":
+                return r[1]
+            return None
+        if isinstance(expr, ast.Attribute):
+            owner = self._class_of(expr.value)
+            if owner:
+                ci = self.a.project.classes.get(owner)
+                if ci and expr.attr in ci.attr_types:
+                    return ci.attr_types[expr.attr]
+        return None
+
+    def _callee_keys(self, call: ast.Call) -> List[str]:
+        p = self.a.project
+        f = call.func
+        # self.m() / obj.m() / chain.m()
+        if isinstance(f, ast.Attribute):
+            owner = self._class_of(f.value)
+            if owner:
+                ci = p.classes.get(owner)
+                if ci:
+                    if f.attr in ci.methods:
+                        return [f"{owner}.{f.attr}"]
+                    # stored-callable call (self._cb(...)): all callbacks
+                    if f.attr not in ci.lock_attrs and \
+                            f.attr not in ci.attr_types:
+                        return sorted(ci.callback_targets)
+                return []
+            r = p.resolve(self.mod, f)
+            if r and r[0] == "func":
+                return [r[1]]
+            return []
+        if isinstance(f, ast.Name):
+            if f.id in self.a.local_funcs.get(self.funckey, {}):
+                return [self.a.local_funcs[self.funckey][f.id]]
+            r = p.resolve(self.mod, f)
+            if r and r[0] == "func":
+                return [r[1]]
+            if r and r[0] == "class":
+                # constructor: treat as call to __init__
+                ci = p.classes.get(r[1])
+                if ci and "__init__" in ci.methods:
+                    return [f"{r[1]}.__init__"]
+        return []
+
+    # visiting -------------------------------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            expr = item.context_expr
+            lk = self._lock_of(expr)
+            if lk is None and isinstance(expr, ast.Call):
+                # `with self._lock:` vs `with foo():` -- a Call can still be
+                # a lock via e.g. `with self._lock` only; calls are calls
+                self._record_call(expr)
+                self.generic_visit(expr)
+                continue
+            if lk is not None:
+                # items enter left-to-right: `with a, b:` acquires b while
+                # holding a, so earlier items of THIS statement are held too
+                self.a.record_acquire(self.funckey,
+                                      list(self.held) + acquired, lk,
+                                      self.mod, expr.lineno
+                                      if hasattr(expr, "lineno")
+                                      else node.lineno)
+                acquired.append(lk)
+            else:
+                self.visit(expr)
+        self.held.extend(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.held.pop()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self._record_call(node)
+        self.generic_visit(node)
+
+    def _record_call(self, node: ast.Call) -> None:
+        # condition wait while holding other locks = hold-and-wait
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in ("wait", "wait_for"):
+            lk = self._lock_of(f.value)
+            if lk is not None:
+                for h in self.held:
+                    if h[0] != lk[0]:
+                        self.a.record_wait_edge(h, lk, self.mod, node.lineno)
+        for key in self._callee_keys(node):
+            self.a.record_call(self.funckey, list(self.held), key,
+                               self.mod, node.lineno)
+
+    def visit_FunctionDef(self, node) -> None:
+        pass  # nested defs run later, not under these locks
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node) -> None:
+        pass
+
+    def visit_ClassDef(self, node) -> None:
+        pass
+
+
+class _LockAnalysis:
+    def __init__(self, project: Project):
+        self.project = project
+        # funckey -> set(lockkeys) acquired directly
+        self.direct: Dict[str, Set[str]] = defaultdict(set)
+        self.lock_kinds: Dict[str, str] = {}
+        # call graph funckey -> set(funckey)
+        self.calls: Dict[str, Set[str]] = defaultdict(set)
+        # (site) lists for edge building
+        self.acquire_sites: List[tuple] = []  # (func, held, lock, mod, line)
+        self.call_sites: List[tuple] = []  # (func, held, callee, mod, line)
+        self.wait_edges: List[tuple] = []  # (held_lock, lock, mod, line)
+        self.local_funcs: Dict[str, Dict[str, str]] = {}
+
+    def record_acquire(self, funckey, held, lk, mod, line):
+        self.direct[funckey].add(lk[0])
+        self.lock_kinds[lk[0]] = lk[1]
+        self.acquire_sites.append((funckey, held, lk, mod, line))
+
+    def record_call(self, funckey, held, callee, mod, line):
+        self.calls[funckey].add(callee)
+        if held:
+            self.call_sites.append((funckey, held, callee, mod, line))
+
+    def record_wait_edge(self, held_lock, lk, mod, line):
+        self.lock_kinds[lk[0]] = lk[1]
+        self.wait_edges.append((held_lock, lk, mod, line))
+
+
+@rule("lock-order",
+      "cycles in the static lock-acquisition graph (potential deadlock)")
+def check_lock_order(project: Project, config: Config) -> List[Finding]:
+    a = _LockAnalysis(project)
+    # walk every function/method of in-scope modules
+    for modid, mod in project.modules.items():
+        if not _in_scope(modid, config.lock_scope):
+            continue
+        items: List[tuple] = []
+        for qual, fnode in mod.functions.items():
+            items.append((None, f"{modid}.{qual}", fnode))
+        for ci in mod.classes.values():
+            seen = set()
+            for mname, meth in ci.methods.items():
+                if id(meth) in seen:
+                    continue
+                seen.add(id(meth))
+                items.append((ci, f"{ci.key}.{mname}", meth))
+        for ci, funckey, fnode in items:
+            env = project._param_env(mod, ci, fnode)
+            # local nested defs are callable by name from this function
+            locals_map = {}
+            for child in ast.iter_child_nodes(fnode):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    key = f"{funckey}.<{child.name}>"
+                    project.functions[key] = (mod, child)
+                    locals_map[child.name] = key
+                    items.append((ci, key, child))
+            a.local_funcs[funckey] = locals_map
+            walker = _LockWalker(a, mod, ci, funckey, env)
+            for stmt in fnode.body if hasattr(fnode, "body") else []:
+                walker.visit(stmt)
+
+    # transitive acquires fixed point
+    trans: Dict[str, Set[str]] = {k: set(v) for k, v in a.direct.items()}
+    changed = True
+    while changed:
+        changed = False
+        for caller, callees in a.calls.items():
+            cur = trans.setdefault(caller, set())
+            before = len(cur)
+            for c in callees:
+                cur |= trans.get(c, set())
+            if len(cur) != before:
+                changed = True
+
+    # edges with witnesses
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    def add_edge(src, dst, mod, line):
+        edges.setdefault((src, dst), (mod.relpath, line))
+
+    self_findings: List[Finding] = []
+    for funckey, held, lk, mod, line in a.acquire_sites:
+        for h in held:
+            if h[0] == lk[0]:
+                if a.lock_kinds.get(lk[0]) == "lock" and not mod.suppressed(
+                        "lock-order", line):
+                    self_findings.append(Finding(
+                        "lock-order", mod.relpath, line,
+                        f"non-reentrant lock {lk[0]} re-acquired while "
+                        f"already held (self-deadlock)"))
+            else:
+                add_edge(h[0], lk[0], mod, line)
+    self_reported: Set[Tuple[str, int]] = set()
+    for funckey, held, callee, mod, line in a.call_sites:
+        for l2 in trans.get(callee, ()):
+            for h in held:
+                if h[0] != l2:
+                    add_edge(h[0], l2, mod, line)
+                elif (a.lock_kinds.get(l2) == "lock"
+                      and (mod.relpath, line) not in self_reported
+                      and not mod.suppressed("lock-order", line)):
+                    self_reported.add((mod.relpath, line))
+                    self_findings.append(Finding(
+                        "lock-order", mod.relpath, line,
+                        f"non-reentrant lock {l2} re-acquired while "
+                        f"already held (self-deadlock via {callee})"))
+    for h, lk, mod, line in a.wait_edges:
+        add_edge(h[0], lk[0], mod, line)
+
+    # cycle detection (iterative Tarjan SCC)
+    graph: Dict[str, Set[str]] = defaultdict(set)
+    for (s, d) in edges:
+        graph[s].add(d)
+    sccs = _tarjan(graph)
+    findings = list(self_findings)
+    for scc in sccs:
+        if len(scc) < 2:
+            continue
+        cyc = sorted(scc)
+        # one witness edge inside the cycle for the report location
+        witness = None
+        for (s, d), w in sorted(edges.items()):
+            if s in scc and d in scc:
+                witness = w
+                break
+        path, line = witness if witness else ("", 0)
+        mod = next((m for m in project.modules.values()
+                    if m.relpath == path), None)
+        if mod is not None and mod.suppressed("lock-order", line):
+            continue
+        findings.append(Finding(
+            "lock-order", path, line,
+            "lock-acquisition cycle: " + " -> ".join(cyc + [cyc[0]])))
+    return findings
+
+
+def _tarjan(graph: Dict[str, Set[str]]) -> List[Set[str]]:
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[Set[str]] = []
+    counter = [0]
+
+    nodes = set(graph)
+    for vs in graph.values():
+        nodes |= vs
+
+    def strongconnect(v0):
+        work = [(v0, iter(sorted(graph.get(v0, ()))))]
+        index[v0] = low[v0] = counter[0]
+        counter[0] += 1
+        stack.append(v0)
+        on_stack.add(v0)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if low[v] == index[v]:
+                scc = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.add(w)
+                    if w == v:
+                        break
+                sccs.append(scc)
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+
+    for v in sorted(nodes):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+# --------------------------------------------------------------------------
+# pass 2: unguarded-shared-state
+# --------------------------------------------------------------------------
+
+
+@rule("unguarded-shared-state",
+      "attribute writes reachable from public methods outside the owning "
+      "class's lock")
+def check_unguarded_state(project: Project, config: Config) -> List[Finding]:
+    findings: List[Finding] = []
+    # names referenced as bare attributes (thread targets, callbacks like
+    # `Thread(target=self._worker_loop)`): such methods can be entered from
+    # outside without the lock, so they count as public entry points.  An
+    # Attribute load that is the func of a Call is a method CALL, not a
+    # bare reference.
+    referenced_attrs: Set[str] = set()
+    for mod in project.modules.values():
+        call_funcs = {id(n.func) for n in ast.walk(mod.tree)
+                      if isinstance(n, ast.Call)}
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.ctx, ast.Load)
+                    and id(node) not in call_funcs):
+                referenced_attrs.add(node.attr)
+
+    for modid, mod in project.modules.items():
+        if not _in_scope(modid, config.state_scope):
+            continue
+        for ci in mod.classes.values():
+            if not ci.lock_attrs:
+                continue
+            findings.extend(_check_class_state(project, mod, ci,
+                                               referenced_attrs))
+    return findings
+
+
+def _check_class_state(project: Project, mod: ModuleInfo, ci: ClassInfo,
+                       referenced_attrs: Set[str]) -> List[Finding]:
+    lock_names = set(ci.lock_attrs)
+
+    # per-method: (writes_outside_lock, intra-class calls with lock state)
+    class MethodScan(ast.NodeVisitor):
+        def __init__(self, selfname):
+            self.selfname = selfname
+            self.under = 0
+            self.writes: List[tuple] = []  # (attr, line, locked)
+            self.calls: List[tuple] = []  # (method_name, locked)
+
+        def _is_own_lock(self, expr) -> bool:
+            return (isinstance(expr, ast.Attribute)
+                    and isinstance(expr.value, ast.Name)
+                    and expr.value.id == self.selfname
+                    and expr.attr in lock_names)
+
+        def visit_With(self, node):
+            n = sum(1 for item in node.items
+                    if self._is_own_lock(item.context_expr))
+            for item in node.items:
+                if not self._is_own_lock(item.context_expr):
+                    self.visit(item.context_expr)
+            self.under += n
+            for stmt in node.body:
+                self.visit(stmt)
+            self.under -= n
+
+        def _self_targets(self, t):
+            """attr names written by a target: self.attr, self.attr[...],
+            and tuple/list unpacks (self.x, self.y = ...)."""
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for elt in t.elts:
+                    yield from self._self_targets(elt)
+                return
+            if isinstance(t, ast.Starred):
+                yield from self._self_targets(t.value)
+                return
+            if isinstance(t, ast.Subscript):
+                t = t.value
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == self.selfname):
+                yield t.attr
+
+        def _self_target(self, t):
+            return next(self._self_targets(t), None)
+
+        def visit_Assign(self, node):
+            for t in node.targets:
+                for attr in self._self_targets(t):
+                    self.writes.append((attr, node.lineno, self.under > 0))
+            self.visit(node.value)
+
+        def visit_AugAssign(self, node):
+            attr = self._self_target(node.target)
+            if attr:
+                self.writes.append((attr, node.lineno, self.under > 0))
+            self.visit(node.value)
+
+        def visit_AnnAssign(self, node):
+            attr = self._self_target(node.target)
+            if attr and node.value is not None:
+                self.writes.append((attr, node.lineno, self.under > 0))
+            if node.value is not None:
+                self.visit(node.value)
+
+        def visit_Call(self, node):
+            f = node.func
+            if (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == self.selfname
+                    and f.attr in ci.methods):
+                self.calls.append((f.attr, self.under > 0))
+            self.generic_visit(node)
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass
+
+    scans: Dict[str, MethodScan] = {}
+    seen_nodes: Dict[int, str] = {}
+    for mname, meth in ci.methods.items():
+        if id(meth) in seen_nodes:  # class-level alias of the same def
+            scans[mname] = scans[seen_nodes[id(meth)]]
+            continue
+        seen_nodes[id(meth)] = mname
+        sc = MethodScan(_self_name(meth) or "self")
+        for stmt in meth.body:
+            sc.visit(stmt)
+        scans[mname] = sc
+
+    # reachable-without-lock: public entries + externally referenced names;
+    # propagate through intra-class calls made outside the lock
+    unlocked: Set[str] = set()
+    work: List[str] = []
+    for mname in ci.methods:
+        if mname == "__init__":
+            continue
+        public = not mname.startswith("_") or (
+            mname.startswith("__") and mname.endswith("__"))
+        if public or mname in referenced_attrs:
+            unlocked.add(mname)
+            work.append(mname)
+    while work:
+        m = work.pop()
+        for callee, locked in scans[m].calls:
+            if not locked and callee not in unlocked and callee != "__init__":
+                unlocked.add(callee)
+                work.append(callee)
+
+    findings: List[Finding] = []
+    reported: Set[tuple] = set()
+    for mname in sorted(unlocked):
+        for attr, line, locked in scans[mname].writes:
+            if locked or (attr, line) in reported:
+                continue
+            if mod.suppressed("unguarded-shared-state", line):
+                continue
+            reported.add((attr, line))
+            locks = ", ".join(f"self.{n}" for n in sorted(lock_names))
+            findings.append(Finding(
+                "unguarded-shared-state", mod.relpath, line,
+                f"{ci.name}.{mname} writes self.{attr} outside {locks} "
+                f"but is reachable from public callers"))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# pass 3: retry-protocol
+# --------------------------------------------------------------------------
+
+
+def _except_names(type_node) -> Set[str]:
+    if type_node is None:
+        return {"<bare>"}
+    names: Set[str] = set()
+    for n in ([type_node.elts] if isinstance(type_node, ast.Tuple)
+              else [[type_node]])[0]:
+        if isinstance(n, ast.Name):
+            names.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            names.add(n.attr)
+        else:
+            names.add("<expr>")
+    return names
+
+
+@rule("retry-protocol",
+      "broad except that can swallow RetryOOM/SplitAndRetryOOM/"
+      "ShuffleCapacityExceeded without re-raising")
+def check_retry_protocol(project: Project, config: Config) -> List[Finding]:
+    findings: List[Finding] = []
+    for modid, mod in project.modules.items():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            covered: Set[str] = set()
+            for handler in node.handlers:
+                names = _except_names(handler.type)
+                explicit = names & (CONTROL_EXCEPTIONS | CONTROL_ALIASES)
+                if explicit:
+                    covered |= names & CONTROL_ROOTS
+                    if names & CONTROL_ALIASES:
+                        covered |= CONTROL_ROOTS
+                    continue  # protocol-aware by naming the signals
+                broad = "<bare>" in names or names & BROAD_NAMES
+                if not broad:
+                    continue
+                if CONTROL_ROOTS <= covered:
+                    continue  # earlier clauses intercept the signals
+                if _reraises(handler):
+                    continue  # re-raises the signal (maybe conditionally)
+                if mod.suppressed("retry-protocol", handler.lineno):
+                    continue
+                broad_name = sorted(names & (BROAD_NAMES | {"<bare>"}))[0]
+                missing = ", ".join(sorted(CONTROL_ROOTS - covered))
+                findings.append(Finding(
+                    "retry-protocol", mod.relpath, handler.lineno,
+                    f"except {broad_name} can swallow {missing} without "
+                    f"re-raising, re-attempting, or an explicit earlier "
+                    f"handler"))
+    return findings
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    """True only for a genuine re-raise of the caught exception: a bare
+    ``raise`` or ``raise e`` of the bound name.  ``raise Other(...) from e``
+    does NOT count — that converts a control signal into a generic failure,
+    which is exactly the defect this pass rejects."""
+    for n in _handler_body_walk(handler):
+        if not isinstance(n, ast.Raise):
+            continue
+        if n.exc is None:
+            return True
+        if (handler.name and isinstance(n.exc, ast.Name)
+                and n.exc.id == handler.name):
+            return True
+    return False
+
+
+def _handler_body_walk(handler: ast.ExceptHandler):
+    """Walk the handler body without descending into nested functions."""
+    stack = list(handler.body)
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                          ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+# --------------------------------------------------------------------------
+# pass 4: governed-allocation
+# --------------------------------------------------------------------------
+
+
+def _alloc_call_name(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        if f.value.id == "jnp" and f.attr in ALLOC_ATTRS:
+            return f"jnp.{f.attr}"
+        if f.value.id == "jax" and f.attr == "device_put":
+            return "jax.device_put"
+    if isinstance(f, ast.Name) and f.id == "device_put":
+        return "device_put"
+    return None
+
+
+@rule("governed-allocation",
+      "raw device allocation in ops/models/serve outside a governor bracket")
+def check_governed_allocation(project: Project,
+                              config: Config) -> List[Finding]:
+    # 1. index every function (incl. nested + lambdas) with parent links
+    #    funcid -> (mod, node, qualname); plus, per module, a map from any
+    #    node to its innermost enclosing function (real parent chain — a
+    #    line-span heuristic mis-scopes same-line lambdas)
+    funcs: Dict[int, tuple] = {}
+    enclosing: Dict[int, Optional[int]] = {}
+    name_to_ids: Dict[str, Set[int]] = defaultdict(set)
+    node_scope: Dict[int, Dict[int, Optional[int]]] = {}  # id(mod)->map
+
+    def walk_funcs(mod, node, parent_id, qual_prefix):
+        scope_map = node_scope[id(mod)]
+        for child in ast.iter_child_nodes(node):
+            scope_map[id(child)] = parent_id
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fid = id(child)
+                qual = f"{qual_prefix}{child.name}"
+                funcs[fid] = (mod, child, qual)
+                enclosing[fid] = parent_id
+                name_to_ids[f"{mod.modid}.{qual}"].add(fid)
+                walk_funcs(mod, child, fid, qual + ".")
+            elif isinstance(child, ast.Lambda):
+                fid = id(child)
+                funcs[fid] = (mod, child, f"{qual_prefix}<lambda>")
+                enclosing[fid] = parent_id
+                walk_funcs(mod, child, fid, qual_prefix)
+            elif isinstance(child, ast.ClassDef):
+                walk_funcs(mod, child, parent_id,
+                           f"{qual_prefix}{child.name}.")
+            else:
+                walk_funcs(mod, child, parent_id, qual_prefix)
+
+    for mod in project.modules.values():
+        node_scope[id(mod)] = {}
+        walk_funcs(mod, mod.tree, None, "")
+
+    def scope_of(mod, node) -> Optional[int]:
+        return node_scope[id(mod)].get(id(node))
+
+    # helper: resolve a callback expression to function node ids
+    def expr_func_ids(mod, expr, local_defs) -> Set[int]:
+        ids: Set[int] = set()
+        if isinstance(expr, ast.Lambda):
+            ids.add(id(expr))
+        elif isinstance(expr, ast.Call):
+            # functools.partial(f, ...) and similar single-level wrappers
+            for arg in expr.args:
+                ids |= expr_func_ids(mod, arg, local_defs)
+        elif isinstance(expr, ast.Name):
+            if expr.id in local_defs:
+                ids.add(local_defs[expr.id])
+            else:
+                r = project.resolve(mod, expr)
+                if r and r[0] == "func":
+                    ids |= name_to_ids.get(r[1], set())
+        elif isinstance(expr, ast.Attribute):
+            r = project.resolve(mod, expr)
+            if r and r[0] == "func":
+                ids |= name_to_ids.get(r[1], set())
+        return ids
+
+    # 2. governed roots: run= callbacks of the protocol drivers, fn= of
+    #    handler registrations (unless self_governed=True), and statements
+    #    under `with reservation(...)`
+    governed: Set[int] = set()
+    reservation_stmts: List[tuple] = []  # (mod, With node)
+
+    for mod in project.modules.values():
+        # local name -> nested funcdef id, per enclosing function
+        local_defs_by_scope: Dict[Optional[int], Dict[str, int]] = \
+            defaultdict(dict)
+        for fid, (m, node, qual) in funcs.items():
+            if m is not mod or isinstance(node, ast.Lambda):
+                continue
+            local_defs_by_scope[enclosing[fid]][node.name] = fid
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    ce = item.context_expr
+                    if not isinstance(ce, ast.Call):
+                        continue
+                    r = project.resolve(mod, ce.func)
+                    name = (r[1].rsplit(".", 1)[-1] if r and
+                            r[0] == "func" else
+                            getattr(ce.func, "id",
+                                    getattr(ce.func, "attr", None)))
+                    if name in config.reservation_funcs:
+                        reservation_stmts.append((mod, node))
+                    # `with seam(COMPILE, ...)` marks a step build: the
+                    # functions defined/referenced in it are traced device
+                    # code whose allocations materialize at the (governed)
+                    # launch, not at trace time
+                    if (name == "seam" and ce.args
+                            and isinstance(ce.args[0],
+                                           (ast.Name, ast.Attribute))):
+                        term = (ce.args[0].id
+                                if isinstance(ce.args[0], ast.Name)
+                                else ce.args[0].attr)
+                        if term == "COMPILE":
+                            for stmt in node.body:
+                                for ref in ast.walk(stmt):
+                                    rid = id(ref)
+                                    if rid in funcs:
+                                        governed.add(rid)
+                                    elif isinstance(ref, (ast.Name,
+                                                          ast.Attribute)):
+                                        rr = project.resolve(mod, ref)
+                                        if rr and rr[0] == "func":
+                                            governed |= name_to_ids.get(
+                                                rr[1], set())
+            if not isinstance(node, ast.Call):
+                continue
+            # traced device code: shard_map(f, ...) / jax.jit(f) bodies
+            # allocate at launch time, inside the caller's bracket
+            jit_name = None
+            if isinstance(node.func, ast.Name):
+                jit_name = node.func.id
+            elif isinstance(node.func, ast.Attribute):
+                jit_name = node.func.attr
+            if jit_name in ("jit", "shard_map", "pjit"):
+                scope0 = scope_of(mod, node)
+                for arg in node.args:
+                    governed |= expr_func_ids(
+                        mod, arg,
+                        local_defs_by_scope.get(scope0, {}))
+            r = project.resolve(mod, node.func)
+            callee = None
+            if r and r[0] == "func":
+                callee = r[1].rsplit(".", 1)[-1]
+            elif isinstance(node.func, ast.Name):
+                callee = node.func.id
+            elif isinstance(node.func, ast.Attribute):
+                callee = node.func.attr
+            scope = scope_of(mod, node)
+            local_defs = local_defs_by_scope.get(scope, {})
+            if callee in config.governed_drivers:
+                run_expr = None
+                for kw in node.keywords:
+                    if kw.arg == "run":
+                        run_expr = kw.value
+                if run_expr is None and callee in ("attempt_once", "_attempt") \
+                        and len(node.args) >= 5:
+                    run_expr = node.args[4]
+                if run_expr is not None:
+                    governed |= expr_func_ids(mod, run_expr, local_defs)
+            cls_r = project.resolve(mod, node.func)
+            if (cls_r and cls_r[0] == "class"
+                    and cls_r[1].rsplit(".", 1)[-1] in
+                    config.handler_classes):
+                self_gov = any(
+                    kw.arg == "self_governed"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value
+                    for kw in node.keywords)
+                if not self_gov:
+                    for kw in node.keywords:
+                        if kw.arg == "fn":
+                            governed |= expr_func_ids(mod, kw.value,
+                                                      local_defs)
+                    if len(node.args) >= 2:
+                        governed |= expr_func_ids(mod, node.args[1],
+                                                  local_defs)
+
+    # 3. propagate: a function referenced by name from a governed function
+    #    is governed (jit wrappers, partials, helpers, cross-module calls)
+    changed = True
+    while changed:
+        changed = False
+        for fid in list(governed):
+            mod, node, qual = funcs[fid]
+            body = node.body if isinstance(node.body, list) else [node.body]
+            # nested defs of a governed function are governed
+            for child in ast.walk(node):
+                cid = id(child)
+                if cid in funcs and cid != fid and cid not in governed:
+                    governed.add(cid)
+                    changed = True
+            for sub in body:
+                for ref in ast.walk(sub):
+                    tgt = None
+                    if isinstance(ref, (ast.Name, ast.Attribute)):
+                        r = project.resolve(mod, ref)
+                        if r and r[0] == "func":
+                            tgt = r[1]
+                    if tgt:
+                        for tid in name_to_ids.get(tgt, ()):
+                            if tid not in governed:
+                                governed.add(tid)
+                                changed = True
+
+    # 4. flag raw allocations in scope outside governed functions and
+    #    outside `with reservation(...)` bodies
+    reservation_spans: Dict[int, List[tuple]] = defaultdict(list)
+    for mod, wnode in reservation_stmts:
+        end = getattr(wnode, "end_lineno", wnode.lineno)
+        reservation_spans[id(mod)].append((wnode.lineno, end))
+
+    findings: List[Finding] = []
+    for modid, mod in project.modules.items():
+        if not _in_scope(modid, config.governed_scope):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = _alloc_call_name(node)
+            if cname is None:
+                continue
+            fid = scope_of(mod, node)
+            if fid is not None and fid in governed:
+                continue
+            if any(s <= node.lineno <= e
+                   for s, e in reservation_spans.get(id(mod), ())):
+                continue
+            if mod.suppressed("governed-allocation", node.lineno):
+                continue
+            qual = funcs[fid][2] if fid is not None else "<module>"
+            findings.append(Finding(
+                "governed-allocation", mod.relpath, node.lineno,
+                f"{cname} in {qual} has no governed path (not reserved "
+                f"through attempt_once/run_with_split_retry/reservation)"))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# pass 5: seam-discipline
+# --------------------------------------------------------------------------
+
+
+def _load_categories(project: Project, config: Config) -> Set[str]:
+    if config.categories is not None:
+        return config.categories
+    cats: Set[str] = set()
+    seam_mod = project.modules.get("obs.seam")
+    if seam_mod is not None:
+        for node in seam_mod.tree.body:
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Constant) and isinstance(
+                    node.value.value, str):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id.isupper():
+                        cats.add(t.id)
+    return cats
+
+
+@rule("seam-discipline",
+      "obs seam crossings must be context-managed with a registered "
+      "category constant")
+def check_seam_discipline(project: Project, config: Config) -> List[Finding]:
+    cats = _load_categories(project, config)
+    findings: List[Finding] = []
+    for modid, mod in project.modules.items():
+        if modid in config.seam_exclude:
+            continue
+        with_exprs: Set[int] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    with_exprs.add(id(item.context_expr))
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            r = project.resolve(mod, node.func)
+            if not (r and r[0] == "func"
+                    and r[1].split(".")[0:2] == ["obs", "seam"]):
+                continue
+            fname = r[1].rsplit(".", 1)[-1]
+            if fname not in ("seam", "instrument", "serialize_category"):
+                continue
+            line = node.lineno
+            if mod.suppressed("seam-discipline", line):
+                continue
+            if fname == "seam" and id(node) not in with_exprs:
+                findings.append(Finding(
+                    "seam-discipline", mod.relpath, line,
+                    "seam() used outside a with-statement: enter/exit are "
+                    "not exception-paired"))
+                continue
+            if not node.args:
+                continue
+            cat = node.args[0]
+            if isinstance(cat, ast.Constant):
+                findings.append(Finding(
+                    "seam-discipline", mod.relpath, line,
+                    f"{fname}() called with a literal category "
+                    f"{cat.value!r}: use a registered constant from "
+                    f"obs.seam"))
+            elif isinstance(cat, (ast.Name, ast.Attribute)):
+                term = cat.id if isinstance(cat, ast.Name) else cat.attr
+                if cats and term not in cats:
+                    findings.append(Finding(
+                        "seam-discipline", mod.relpath, line,
+                        f"{fname}() category {term!r} is not a registered "
+                        f"obs.seam category"))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# driver
+# --------------------------------------------------------------------------
+
+
+def run_rules(project: Project, config: Config) -> List[Finding]:
+    findings = list(project.errors)
+    for rule_id, (fn, _doc) in sorted(RULES.items()):
+        if config.rules is not None and rule_id not in config.rules:
+            continue
+        findings.extend(fn(project, config))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings
+
+
+def analyze(root: str, config: Optional[Config] = None) -> List[Finding]:
+    config = config or Config()
+    return run_rules(Project(root, config), config)
+
+
+def _changed_files(root: str, ref: str) -> Set[str]:
+    out = subprocess.run(
+        ["git", "diff", "--name-only", ref, "--"], cwd=root,
+        capture_output=True, text=True, check=True).stdout
+    untracked = subprocess.run(
+        ["git", "ls-files", "-o", "--exclude-standard"], cwd=root,
+        capture_output=True, text=True, check=True).stdout
+    return {line.strip() for line in (out + untracked).splitlines()
+            if line.strip()}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=None, help="repo root (default: "
+                    "parent of this script's directory)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--changed-only", metavar="REF",
+                    help="report only findings in files changed vs git REF")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline path (default ci/analyze_baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write current findings as the new baseline")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, (_fn, doc) in sorted(RULES.items()):
+            print(f"{rid}: {doc}")
+        return 0
+
+    root = args.root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    baseline_path = args.baseline or os.path.join(
+        root, "ci", "analyze_baseline.json")
+    config = Config()
+    if args.rules:
+        config.rules = set(args.rules.split(","))
+
+    t0 = time.monotonic()
+    project = Project(root, config)
+    findings = run_rules(project, config)
+    n_files = len(project.modules)
+
+    if args.update_baseline:
+        Baseline.write(baseline_path, findings)
+        print(f"analyze: baseline updated with {len(findings)} findings "
+              f"-> {os.path.relpath(baseline_path, root)}")
+        return 0
+
+    if args.no_baseline:
+        new, n_base, n_stale = findings, 0, 0
+    else:
+        new, n_base, n_stale = Baseline(baseline_path).split(findings)
+
+    if args.changed_only:
+        changed = _changed_files(root, args.changed_only)
+        new = [f for f in new if f.path in changed]
+
+    dt = time.monotonic() - t0
+    if args.as_json:
+        emit_json(new, tool="analyze", files=n_files,
+                  extra={"baselined": n_base, "stale_baseline": n_stale,
+                         "seconds": round(dt, 2)})
+    else:
+        for f in new:
+            print(f.human())
+        per_rule = defaultdict(int)
+        for f in new:
+            per_rule[f.rule] += 1
+        detail = ", ".join(f"{r}={n}" for r, n in sorted(per_rule.items()))
+        print(f"analyze: {n_files} files, {len(new)} findings"
+              + (f" ({detail})" if detail else "")
+              + f", {n_base} baselined, {n_stale} stale baseline entries, "
+              f"{dt:.1f}s")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,18 +4,33 @@ Checks, in the spirit of the reference's clang-format CI gate
 (.github/workflows/clang-format.yml): every file must parse, imports must be
 used, no tabs / trailing whitespace / overlong lines.
 
-Run: ``python ci/lint.py`` (exit 1 on findings).
+Run: ``python ci/lint.py`` (exit 1 on findings); ``--json`` emits the same
+machine-readable report shape as ``ci/analyze.py --json``.
 """
 
 from __future__ import annotations
 
+import argparse
 import ast
 import os
+import re
 import sys
+from typing import List
+
+from analyze import Finding, emit_json
 
 MAX_LINE = 100
 ROOTS = ["spark_rapids_jni_tpu", "tests", "bench.py", "__graft_entry__.py",
          "boot_cpu_mesh.py", "ci", "tools"]
+
+_URL_RE = re.compile(r"https?://\S+")
+
+
+def _overlong_without_urls(line: str) -> bool:
+    """True if the line is overlong even with its URLs removed: only an
+    actual URL earns the long-line exemption, not any line that happens
+    to mention http."""
+    return len(_URL_RE.sub("", line)) > MAX_LINE
 
 
 def iter_py_files(repo_root: str):
@@ -88,28 +103,33 @@ class _ImportChecker(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def check_file(path: str):
-    findings = []
+def check_file(path: str, repo_root: str) -> List[Finding]:
+    rel = os.path.relpath(path, repo_root).replace(os.sep, "/")
+    findings: List[Finding] = []
     with open(path, "rb") as f:
         raw = f.read()
     try:
         text = raw.decode("utf-8")
     except UnicodeDecodeError as e:
-        return [f"{path}: not valid UTF-8 at byte {e.start}"]
+        return [Finding("encoding", rel, 1,
+                        f"not valid UTF-8 at byte {e.start}")]
     try:
         tree = ast.parse(text, filename=path)
     except SyntaxError as e:
-        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+        return [Finding("syntax-error", rel, e.lineno or 1,
+                        f"syntax error: {e.msg}")]
 
     for i, line in enumerate(text.splitlines(), 1):
         if "noqa" in line:
             continue
         if "\t" in line:
-            findings.append(f"{path}:{i}: tab character")
+            findings.append(Finding("tab", rel, i, "tab character"))
         if line != line.rstrip():
-            findings.append(f"{path}:{i}: trailing whitespace")
-        if len(line) > MAX_LINE and "http" not in line:
-            findings.append(f"{path}:{i}: line too long ({len(line)})")
+            findings.append(Finding("trailing-whitespace", rel, i,
+                                    "trailing whitespace"))
+        if len(line) > MAX_LINE and _overlong_without_urls(line):
+            findings.append(Finding("long-line", rel, i,
+                                    f"line too long ({len(line)})"))
 
     chk = _ImportChecker()
     chk.visit(tree)
@@ -117,20 +137,27 @@ def check_file(path: str):
     if not path.endswith("__init__.py"):
         for name, lineno in chk.imported.items():
             if name not in chk.used:
-                findings.append(f"{path}:{lineno}: unused import {name!r}")
+                findings.append(Finding("unused-import", rel, lineno,
+                                        f"unused import {name!r}"))
     return findings
 
 
-def main() -> int:
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    findings = []
+    findings: List[Finding] = []
     n = 0
     for path in iter_py_files(repo_root):
         n += 1
-        findings.extend(check_file(path))
-    for f in findings:
-        print(f)
-    print(f"lint: {n} files, {len(findings)} findings")
+        findings.extend(check_file(path, repo_root))
+    if args.as_json:
+        emit_json(findings, tool="lint", files=n)
+    else:
+        for f in findings:
+            print(f"{f.path}:{f.line}: {f.message}")
+        print(f"lint: {n} files, {len(findings)} findings")
     return 1 if findings else 0
 
 

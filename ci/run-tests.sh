@@ -7,6 +7,10 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 python ci/lint.py
+# protocol-aware static analysis: fails on any un-baselined finding
+# (lock-order, unguarded-shared-state, retry-protocol, governed-allocation,
+# seam-discipline — see docs/STATIC_ANALYSIS.md)
+python ci/analyze.py
 
 if [[ "${QUICK:-0}" == "1" ]]; then
     exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu SRT_REEXECED=1 \

@@ -139,6 +139,8 @@ def _probed_hbm_bytes() -> Optional[int]:
         stats = jax.local_devices()[0].memory_stats()
         limit = (stats or {}).get("bytes_limit")
         return int(limit) if limit else None
+    # analyze: ignore[retry-protocol] - backend capability probe at budget
+    # construction, before any task registers: no retry bracket exists yet
     except Exception:  # backend without memory_stats (CPU), or no device
         return None
 

@@ -82,6 +82,9 @@ class MemoryGovernor:
         while not self._shutdown.wait(period_s):
             try:
                 self.arbiter.check_and_break_deadlocks()
+            # analyze: ignore[retry-protocol] - the watchdog daemon runs in
+            # no task's retry bracket (a control signal here targets nobody)
+            # and must survive everything, like the reference's daemon
             except Exception:  # pragma: no cover - defensive, mirrors daemon
                 pass
 

@@ -28,6 +28,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from spark_rapids_jni_tpu.mem.governor import BudgetedResource
 from spark_rapids_jni_tpu.obs import seam as _seam
 
 __all__ = ["SpillableBuffer", "SpillPool"]
@@ -87,7 +88,9 @@ class SpillPool:
     to the arbiter's BLOCKED/BUFN path.
     """
 
-    def __init__(self, budget) -> None:
+    def __init__(self, budget: BudgetedResource) -> None:
+        # the annotation also feeds the lock-order pass: pool -> budget
+        # lock edges resolve through it (docs/STATIC_ANALYSIS.md)
         self._budget = budget
         self._lock = threading.RLock()
         self._buffers: List[SpillableBuffer] = []

@@ -132,6 +132,8 @@ del _name, _fn
 # not make the library unimportable
 try:
     _faultinj.install_from_env()
+# analyze: ignore[retry-protocol] - import-time config parsing: no governor,
+# no task, no bracket exists yet; breadth keeps the library importable
 except Exception as _e:  # noqa: BLE001
     import warnings as _warnings
 

@@ -130,6 +130,11 @@ class _SplitJoin:
         if self.error is None:
             try:
                 self._finish(self.parent, OK, value=self.combine(self.slots))
+            except (RetryOOM, SplitAndRetryOOM, ShuffleCapacityExceeded) as e:
+                # combine runs outside any retry bracket and the halves are
+                # already consumed: a control signal here cannot be retried
+                # or re-split — terminal failure, never silently swallowed
+                self._finish(self.parent, ERROR, error=e)
             except Exception as e:  # noqa: BLE001 - combine failure
                 self._finish(self.parent, ERROR, error=e)
         else:
@@ -179,6 +184,7 @@ class ServingEngine:
         )
         self._seq = itertools.count()
         self._handlers: dict = {}
+        self._reg_lock = threading.Lock()  # guards handler registration
         self._ewma_lock = threading.Lock()
         self._ewma_service_s = 0.05
         if builtin_handlers:
@@ -193,13 +199,18 @@ class ServingEngine:
 
     # -- registration / sessions -------------------------------------------
     def register(self, handler: QueryHandler) -> None:
-        if handler.name in self._handlers:
-            raise ValueError(f"handler {handler.name!r} already registered")
         if (handler.batch is None) != (handler.unbatch is None):
             raise ValueError("batch and unbatch must be provided together")
         if handler.split is not None and handler.combine is None:
             raise ValueError("split requires combine")
-        self._handlers[handler.name] = handler
+        # exists-check + insert under one lock: two concurrent registers of
+        # the same name must not both pass the check (workers read the dict
+        # concurrently; the GIL makes the reads safe, not this write race)
+        with self._reg_lock:
+            if handler.name in self._handlers:
+                raise ValueError(
+                    f"handler {handler.name!r} already registered")
+            self._handlers[handler.name] = handler
 
     def open_session(self, name: Optional[str] = None, *, priority: int = 0,
                      byte_budget: Optional[int] = None) -> Session:
@@ -326,6 +337,13 @@ class ServingEngine:
             # unexpected escape only the primary is outstanding here
             try:
                 self._serve(req)
+            except (RetryOOM, SplitAndRetryOOM, ShuffleCapacityExceeded) as e:
+                # a governor control-flow signal leaked past every bracket:
+                # a protocol bug, not a handler failure.  Fail the request
+                # loudly (counted separately) and keep the worker alive —
+                # re-raising here would silently kill the pool thread.
+                self.metrics.count("protocol_leaked", req.session_id)
+                self._finish(req, ERROR, error=e)
             except Exception as e:  # noqa: BLE001 - never kill the worker
                 self._finish(req, ERROR, error=e)
             finally:
@@ -371,6 +389,15 @@ class ServingEngine:
             self.metrics.count("batched", n=len(group))
             try:
                 payload = h.batch([r.payload for r in group])
+            except (RetryOOM, SplitAndRetryOOM, ShuffleCapacityExceeded):
+                # pressure inside the batch hook (it may allocate): the
+                # protocol answer is to disband — each member re-queues
+                # alone (no_batch), gets its own bracket, and cannot
+                # re-enter this path
+                self.metrics.count("split_requeued", n=len(group))
+                for r in group:
+                    self._requeue(r, no_batch=True)
+                return group
             except Exception as e:  # noqa: BLE001 - mates were popped too:
                 # every member must reach a terminal state, not just req
                 for r in group:
@@ -418,6 +445,10 @@ class ServingEngine:
                 try:
                     fits = (int(h.nbytes_of(state["payload"]))
                             <= self.budget.limit)
+                # analyze: ignore[retry-protocol] - size probe of a user
+                # estimator while already handling an OOM: any failure
+                # (control signals included) means "broken estimator", and
+                # the enclosing handler fails the request terminally below
                 except Exception:  # noqa: BLE001 - broken estimator: fail,
                     fits = True    # don't split on garbage
                 if fits:
@@ -435,6 +466,13 @@ class ServingEngine:
             for r in group:
                 self._finish(r, ERROR, error=e)
             return group
+        except ShuffleCapacityExceeded as e:
+            # exchange overflow with no grow hook (or grows exhausted in
+            # _governed_attempt): the piece cannot fit its static exchange
+            # capacity — terminal, explicitly not swallowed as generic
+            for r in group:
+                self._finish(r, ERROR, error=e)
+            return group
         except Exception as e:  # noqa: BLE001 - handler failure
             for r in group:
                 self._finish(r, ERROR, error=e)
@@ -444,7 +482,27 @@ class ServingEngine:
         if len(group) > 1:
             try:
                 parts = h.unbatch(result, [r.payload for r in group])
+            except (RetryOOM, SplitAndRetryOOM, ShuffleCapacityExceeded):
+                # pressure inside the unbatch hook: disband and re-run each
+                # member alone (handlers are pure queries, so re-running is
+                # safe; failing them would turn recoverable pressure into
+                # lost work)
+                self.metrics.count("split_requeued", n=len(group))
+                for r in group:
+                    self._requeue(r, no_batch=True)
+                return group
             except Exception as e:  # noqa: BLE001
+                for r in group:
+                    self._finish(r, ERROR, error=e)
+                return group
+            parts = list(parts)
+            if len(parts) != len(group):
+                # a short result would leave trailing members PENDING
+                # forever (zip truncates; popped requests have no queue-side
+                # expiry) — every member must reach a terminal state
+                e = RuntimeError(
+                    f"unbatch returned {len(parts)} results for "
+                    f"{len(group)} requests (handler={h.name})")
                 for r in group:
                     self._finish(r, ERROR, error=e)
                 return group
@@ -477,6 +535,10 @@ class ServingEngine:
         req.no_batch = req.no_batch or no_batch
         try:
             self.queue.submit(req, force=True)
+        # analyze: ignore[retry-protocol] - queue.submit crosses no seam
+        # and launches no device work, so no control signal can originate
+        # here; the breadth is for shutdown races, where the request must
+        # reach a terminal state rather than be lost
         except BaseException as e:  # closed mid-shutdown: terminal, not lost
             self._finish(req, ERROR, error=e)
 
@@ -525,10 +587,7 @@ class ServingEngine:
                 split_depth=req.split_depth + 1,
                 no_batch=True, join=join, join_slot=slot,
             )
-            try:
-                self.queue.submit(child, force=True)
-            except BaseException as e:  # closed mid-shutdown
-                self._finish(child, ERROR, error=e)
+            self._requeue(child)  # force-admitted; terminal on shutdown race
 
 
 # --------------------------------------------------------------- builtins --

@@ -82,6 +82,7 @@ COUNTERS = (
     "split_requeued",   # SplitAndRetryOOM -> halves re-queued
     "batched",          # requests that rode a micro-batch launch
     "cancelled",        # queue shut down with the request still waiting
+    "protocol_leaked",  # control-flow exception escaped every bracket (bug)
 )
 
 

@@ -93,7 +93,7 @@ def _find_tzfile(key: str) -> Optional[str]:
         ref = res.files(pkg.rstrip(".")) / name
         if ref.is_file():
             return str(ref)
-    except Exception:
+    except (ImportError, OSError):  # no tzdata wheel / unreadable resource
         pass
     return None
 

@@ -72,7 +72,8 @@ def test_baidu_unescape_backslash():  # getJsonObjectTest_Baidu_unescape_backsla
         '"useJublRc":6,"URdeoRd":821284086},"tRtle":"ssssssssssmMsssssssssssssssssss",'
         '"url":"s{storehrl}","usersTortraRt":'
         r'"VttTs:\/\/feed-RxaGe.baRdu.cox\/0\/TRc\/-6971178959s-664926866s-6096674871.zTG",'
-        r'"URdeosurl":"http:\/\/nadURdeo2.baRdu.cox\/5fa3893aed7fc0f8231dab7be23efc75s820s6240.xT3",'
+        r'"URdeosurl":"http:\/\/nadURdeo2.baRdu.cox\/'
+        r'5fa3893aed7fc0f8231dab7be23efc75s820s6240.xT3",'
         '"URdeoRd":821284086}'
     )
     expected = "http://nadURdeo2.baRdu.cox/5fa3893aed7fc0f8231dab7be23efc75s820s6240.xT3"

@@ -395,3 +395,31 @@ def test_builtin_hash32_batches(gov):
             np.testing.assert_array_equal(r.result(timeout=60), want)
     finally:
         eng.shutdown()
+
+
+def test_unbatch_wrong_length_fails_terminally(gov):
+    """A handler whose unbatch returns the wrong number of parts must fail
+    every batch member terminally — a short result must not leave trailing
+    members PENDING forever (zip would silently truncate)."""
+    eng = _engine(gov, workers=1)
+    try:
+        eng.register(QueryHandler(
+            name="plug", fn=lambda p, ctx: time.sleep(p)))
+        eng.register(QueryHandler(
+            name="badbatch",
+            fn=lambda p, ctx: [x for x in p],
+            nbytes_of=lambda p: 64,
+            batch=lambda ps: [x for p in ps for x in p],
+            unbatch=lambda result, payloads: [result],  # wrong length
+        ))
+        s = eng.open_session()
+        plug = eng.submit(s, "plug", 0.3)  # occupies the lone worker so
+        # the badbatch submits below queue up and batch together
+        rs = [eng.submit(s, "badbatch", [i]) for i in range(3)]
+        for r in rs:
+            with pytest.raises(RuntimeError, match="unbatch returned"):
+                r.result(timeout=30)
+        plug.result(timeout=30)
+        assert eng.budget.used == 0
+    finally:
+        eng.shutdown()
