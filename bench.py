@@ -501,33 +501,78 @@ def main():
     _stage(detail, "q97_join_count", _q97,
            nbytes=min(n, 1 << 22) * 4 * 4 * 4)
 
-    def _json():
-        from spark_rapids_jni_tpu.columnar.column import strings_from_bytes
-        from spark_rapids_jni_tpu.ops import get_json_object
+    _json_cache = {}
 
-        nj = min(n, 1 << 18)
-        rows = [
-            b'{"store": {"fruit": [{"weight": %d, "type": "apple"}, '
-            b'{"weight": %d}], "book": "b%d"}, "k%d": %d.5}'
-            % (i % 9, i % 7, i % 100, i % 3, i)
-            for i in range(nj)
-        ]
-        jcol = strings_from_bytes(rows)
+    def _json_col():
+        from spark_rapids_jni_tpu.columnar.column import strings_from_bytes
+
+        if "col" not in _json_cache:
+            nj = min(n, 1 << 18)
+            rows = [
+                b'{"store": {"fruit": [{"weight": %d, "type": "apple"}, '
+                b'{"weight": %d}], "book": "b%d"}, "k%d": %d.5}'
+                % (i % 9, i % 7, i % 100, i % 3, i)
+                for i in range(nj)
+            ]
+            _json_cache["col"] = strings_from_bytes(rows)
+            _json_cache["nj"] = nj
+        return _json_cache["col"], _json_cache["nj"]
+
+    def _json():
+        from spark_rapids_jni_tpu.ops import get_json_object
+        from spark_rapids_jni_tpu.ops.get_json_object import (
+            phase_times,
+            reset_phase_times,
+        )
+
+        jcol, nj = _json_col()
         total_bytes = int(jcol.offsets[-1])
 
         def run_path():
             return get_json_object(jcol, "$.store.fruit[*].weight").chars
 
         dt = _time(run_path, max(iters // 8, 2))
+        # one extra instrumented call so regressions are attributable to a
+        # pipeline stage (tokenize / evaluate / render), not just the total
+        reset_phase_times()
+        run_path()
+        phases = {k: round(v, 3) for k, v in phase_times().items()}
         # rows_per_s too: this stage runs at krows/s on the axon backend
         # (docs/PERF.md round-5), where 2-decimal Mrows/s reads as 0.0
         return {"Mrows_per_s": round(nj / dt / 1e6, 4),
                 "rows_per_s": round(nj / dt, 1),
                 "GBps": round(total_bytes / dt / 1e9, 3),
-                "roofline_frac": _frac(total_bytes / dt)}
+                "roofline_frac": _frac(total_bytes / dt),
+                "phases_s": phases}
 
     _stage(detail, "get_json_object", _json,
            nbytes=min(n, 1 << 18) * 110 * 30)
+
+    def _json_multi():
+        from spark_rapids_jni_tpu.ops.get_json_object import (
+            get_json_object_multiple_paths,
+        )
+
+        jcol, nj = _json_col()
+        paths = ["$.store.fruit[*].weight", "$.store.book", "$.k0",
+                 "$.store.fruit[0].type"]
+
+        def run_multi():
+            return tuple(
+                c.chars for c in get_json_object_multiple_paths(jcol, paths))
+
+        dt = _time(run_multi, max(iters // 8, 2))
+        # rows_per_s counts source rows per call: compare against the
+        # single-path stage to read the multi-path amortization (4 paths
+        # should cost well under 4x one path)
+        return {"Mrows_per_s": round(nj / dt / 1e6, 4),
+                "rows_per_s": round(nj / dt, 1),
+                "n_paths": len(paths),
+                "s_per_call": round(dt, 3)}
+
+    _stage(detail, "get_json_object_multi", _json_multi,
+           nbytes=min(n, 1 << 18) * 110 * 30 * 2)
+    _json_cache.clear()
 
     def _q5():
         from spark_rapids_jni_tpu.models import generate_q5_data, q5_local

@@ -39,6 +39,7 @@ __all__ = [
     "padded_buckets",
     "map_buckets",
     "strings_from_buckets",
+    "count_subbuckets",
 ]
 
 # Narrowest bucket: one VPU lane register row.  Strings shorter than this
@@ -106,6 +107,51 @@ def length_buckets(
                 [rows_np, np.full(n_rows - n_valid, rows_np[-1], np.int32)]
             )
         out.append((int(w), rows_np, n_valid))
+    return out
+
+
+def count_subbuckets(
+    counts: np.ndarray,
+    cap: int,
+    min_rows: int = 512,
+) -> List[Tuple[np.ndarray, int]]:
+    """Split one padded bucket's rows into power-of-two *count* classes.
+
+    Second-axis companion to :func:`length_buckets`: a byte-width bucket
+    already bounds each row's padded width, but a per-row derived count
+    (token count for the JSON machine) can still vary by orders of
+    magnitude inside it, and lockstep consumers pay the bucket-wide
+    maximum for every row.  Grouping rows by ``next_pow2(counts)`` lets
+    each class run with its own capacity, so short rows never pay for the
+    longest row's count.
+
+    ``counts``: [n] per-row counts (``0 <= counts[i] <= cap``);
+    ``cap``: the bucket-wide capacity (class capacities never exceed it);
+    ``min_rows``: classes smaller than this merge into the next class up
+    (machine-per-class has fixed overhead, so tiny classes cost more than
+    their padding saves).  ``min_rows >= n`` degenerates to one class at
+    ``cap`` — the "sub-bucketing off" configuration.
+
+    Returns ``[(rows, class_cap), ...]`` with ascending ``class_cap``;
+    every input row appears in exactly one class.  Empty input -> [].
+    """
+    counts = np.asarray(counts)
+    n = len(counts)
+    if n == 0:
+        return []
+    cap = max(int(cap), 1)
+    widths = np.minimum(_next_pow2_arr(np.maximum(counts, 1)), cap)
+    out: List[Tuple[np.ndarray, int]] = []
+    pend: List[np.ndarray] = []
+    pend_n = 0
+    classes = sorted(set(widths.tolist()))
+    for i, w in enumerate(classes):
+        rows = np.nonzero(widths == w)[0].astype(np.int64)
+        pend.append(rows)
+        pend_n += len(rows)
+        if pend_n >= min_rows or i == len(classes) - 1:
+            out.append((np.sort(np.concatenate(pend)), int(w)))
+            pend, pend_n = [], 0
     return out
 
 

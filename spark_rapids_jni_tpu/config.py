@@ -146,11 +146,42 @@ register("fault_injector_config_path", "",
 # third evaluator shadowed by json_device_render) was removed in round 4;
 # its lax.scan machine lives on as ops/json_scan.py, the core of the
 # device-render product path below.
-register("json_device_render", True,
+def _parse_device_render(s: str):
+    return "auto" if s.strip().lower() == "auto" else _parse_bool(s)
+
+
+register("json_device_render", "auto",
          "Fully device-resident get_json_object: device machine + device "
          "segment rendering (ops/json_render_device.py); bytes cross to "
-         "host only at final column materialization.  Off = host numpy "
-         "pipeline (the debug oracle).", env="SRT_JSON_DEVICE_RENDER")
+         "host only at final column materialization.  False = host numpy "
+         "pipeline.  'auto' (default) picks by backend: device rendering "
+         "on an accelerator, the host pipeline on XLA:CPU — where the "
+         "compacted numpy machine beats lockstep-compiled scans (the "
+         "compiled scan cannot early-exit or compact, so it always pays "
+         "all 2T+40 steps).", env="SRT_JSON_DEVICE_RENDER",
+         parser=_parse_device_render)
+register("json_compact", True,
+         "Active-row compaction in the host get_json_object machine: when "
+         "at least half a (sub-)bucket's rows have finished, machine state "
+         "gathers down to the survivors (segments scatter back by original "
+         "row id).  Off = dense lockstep over every row for every step "
+         "(the pre-compaction shape, kept as an equivalence oracle).",
+         env="SRT_JSON_COMPACT")
+register("json_subbucket_min_rows", 512,
+         "Minimum rows per token-count sub-bucket in the host "
+         "get_json_object machine (columnar/buckets.count_subbuckets): "
+         "classes smaller than this merge upward.  >= bucket rows "
+         "disables sub-bucketing (one machine at the bucket-wide token "
+         "capacity); 1 splits maximally.",
+         env="SRT_JSON_SUBBUCKET_MIN_ROWS")
+register("json_step_margin", 40,
+         "Additive step-cap margin for the host get_json_object machine "
+         "(cap = 2T + margin, T = token capacity).  Rows that exhaust the "
+         "cap are nulled AND counted through the obs seam "
+         "(json:step_cap_truncated) — lowering this below the default "
+         "makes truncation reachable for tests; raising it buys "
+         "pathological nestings more steps.",
+         env="SRT_JSON_STEP_MARGIN")
 register("json_overlap_bytes", 64 << 20,
          "Padded-input byte budget per overlap group in device "
          "get_json_object: all buckets in a group issue their programs "

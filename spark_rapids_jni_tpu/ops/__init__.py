@@ -62,11 +62,16 @@ from spark_rapids_jni_tpu.ops.parse_uri import (
 )
 from spark_rapids_jni_tpu.ops.zorder import hilbert_index, interleave_bits
 from spark_rapids_jni_tpu.ops.from_json import JsonParsingException, from_json
-from spark_rapids_jni_tpu.ops.get_json_object import get_json_object, parse_path
+from spark_rapids_jni_tpu.ops.get_json_object import (
+    get_json_object,
+    get_json_object_multiple_paths,
+    parse_path,
+)
 
 __all__ = [
     "from_json",
     "get_json_object",
+    "get_json_object_multiple_paths",
     "parse_path",
     "JsonParsingException",
     "literal_range_pattern",

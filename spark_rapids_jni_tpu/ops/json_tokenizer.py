@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -94,10 +95,33 @@ class TokenStream:
     n_tokens: jnp.ndarray  # int32 [n]
     ok: jnp.ndarray  # bool [n]
     trailing: jnp.ndarray  # bool [n]: tokens existed after the root value
+    # reusable byte-analysis product: string-automaton state AFTER each byte
+    # ([n, L] int32).  The escape/unescape byte tables (host _byte_info and
+    # the device DByteInfo) need exactly this matrix, so exposing it here
+    # lets every downstream consumer — including multi-path extraction,
+    # which fans one token stream out to P machines — skip a second
+    # automaton pass over the bytes.
+    str_state: Optional[jnp.ndarray] = None
 
 
 def _pow2_at_least(x: int) -> int:
     return 1 << max(int(x) - 1, 0).bit_length() if x > 1 else 1
+
+
+_ONEHOT_GATHERS = None  # resolved lazily: backend known only after jax init
+
+
+def _use_onehot_gathers() -> bool:
+    """One-hot compare-and-reduce beats dynamic gathers on TPU lanes
+    (round-5 profile: 1.85 s vs 54 ms at n=2^18), but the inverse holds on
+    XLA:CPU, where the one-hot materializes an [n, K, W] intermediate that
+    a real gather never builds (measured 10 s vs 0.8 s for _scan_bytes at
+    n=2^14, L=128 on the virtual CPU mesh).  Resolved once per process —
+    the backend cannot change under a running session."""
+    global _ONEHOT_GATHERS
+    if _ONEHOT_GATHERS is None:
+        _ONEHOT_GATHERS = jax.default_backend() != "cpu"
+    return _ONEHOT_GATHERS
 
 
 def _compose_scan(maps: jnp.ndarray) -> jnp.ndarray:
@@ -109,12 +133,17 @@ def _compose_scan(maps: jnp.ndarray) -> jnp.ndarray:
 
     S = maps.shape[-1]
 
-    def comb(a, b):  # apply a, then b: result[..., s] = b[..., a[..., s]]
-        # select-sum over the tiny state axis instead of a per-element
-        # gather — dynamic gathers scalarize on TPU (round-5 profile:
-        # this combiner dominated the byte-analysis pass)
-        sel = a[..., :, None] == jnp.arange(S, dtype=_I8)
-        return jnp.where(sel, b[..., None, :], _I8(0)).sum(-1).astype(_I8)
+    if _use_onehot_gathers():
+        def comb(a, b):  # apply a, then b: result[..., s] = b[..., a[..., s]]
+            # select-sum over the tiny state axis instead of a per-element
+            # gather — dynamic gathers scalarize on TPU (round-5 profile:
+            # this combiner dominated the byte-analysis pass)
+            sel = a[..., :, None] == jnp.arange(S, dtype=_I8)
+            return jnp.where(sel, b[..., None, :], _I8(0)).sum(-1).astype(_I8)
+    else:
+        def comb(a, b):
+            return jnp.take_along_axis(
+                b, a.astype(jnp.int32), axis=-1).astype(_I8)
 
     pref = jax.lax.associative_scan(comb, maps, axis=1)
     return pref[..., 0].astype(_I32)
@@ -123,11 +152,14 @@ def _compose_scan(maps: jnp.ndarray) -> jnp.ndarray:
 def _take_rows(arr, idx):
     """``arr[i, idx[i, w]]`` for arr [n, K], idx [n, W] (pre-clipped).
 
-    One-hot compare-and-reduce instead of a 2-D advanced-index gather:
-    per-row dynamic gathers scalarize on TPU (measured 1.85 s vs 54 ms at
-    n=2^18, K=126, W=250 on the v5e); XLA fuses the select-reduce.
-    Shared with json_render_device.
+    On TPU: one-hot compare-and-reduce instead of a 2-D advanced-index
+    gather — per-row dynamic gathers scalarize there (measured 1.85 s vs
+    54 ms at n=2^18, K=126, W=250 on the v5e); XLA fuses the select-reduce.
+    On CPU the one-hot's [n, K, W] intermediate dominates instead, so the
+    real gather is used.  Shared with json_render_device and json_scan.
     """
+    if not _use_onehot_gathers():
+        return jnp.take_along_axis(arr, idx.astype(jnp.int32), axis=1)
     K = arr.shape[1]
     ks = jnp.arange(K, dtype=jnp.int32)
     sel = idx[:, None, :] == ks[None, :, None]
@@ -233,10 +265,20 @@ def tokenize(bytes_mat: jnp.ndarray, lens: jnp.ndarray) -> TokenStream:
     compiled-variant set stays bounded) — compaction + the grammar scan.
     """
     n, L = bytes_mat.shape
-    token_start, kind_b, end_b, counts = _scan_bytes(bytes_mat, lens)
+    token_start, kind_b, end_b, counts, st_after = _scan_bytes(bytes_mat, lens)
     T = _pow2_at_least(int(jnp.max(counts)) if n else 0)
-    res = _compact_and_grammar(token_start, kind_b, end_b, counts, T)
-    return TokenStream(*res)
+    if _use_onehot_gathers():
+        res = _compact_and_grammar(token_start, kind_b, end_b, counts, T)
+    else:
+        # XLA:CPU: the T-step lax.scan is dispatch-bound (~60 tiny kernels
+        # per step) and the dense compaction scatter pays for every byte;
+        # the numpy twins run the identical grammar with microsecond
+        # dispatch, scatter only the actual tokens, and really exit at the
+        # last live token instead of stepping all T
+        tok = _compact_tokens_np(np.asarray(token_start), np.asarray(kind_b),
+                                 np.asarray(end_b), T)
+        res = _grammar_scan_np(*tok, np.asarray(counts))
+    return TokenStream(*res, str_state=st_after)
 
 
 @jax.jit
@@ -413,12 +455,13 @@ def _scan_bytes(bytes_mat: jnp.ndarray, lens: jnp.ndarray):
     )
 
     counts = jnp.sum(token_start.astype(_I32), axis=1)
-    return token_start, kind_b.astype(_I32), end_b.astype(_I32), counts
+    return (token_start, kind_b.astype(_I32), end_b.astype(_I32), counts,
+            st_after.astype(_I32))
 
 
 @functools.partial(jax.jit, static_argnums=(4,))
-def _compact_and_grammar(token_start, kind_b, end_b, counts, T: int):
-    """Phase 5 compaction + phase 6 grammar scan (static token capacity)."""
+def _compact_tokens(token_start, kind_b, end_b, counts, T: int):
+    """Phase 5: scatter token-start bytes into dense [n, T] token arrays."""
     n, L = token_start.shape
     pos = jnp.arange(L, dtype=_I32)[None, :]
     rank = jnp.cumsum(token_start.astype(_I32), axis=1) - 1
@@ -437,8 +480,31 @@ def _compact_and_grammar(token_start, kind_b, end_b, counts, T: int):
     tok_kind = compact(kind_b.astype(_U8), _U8(PAD))
     tok_start = compact(pos + jnp.zeros_like(rank), _I32(0))
     tok_end = compact(end_b.astype(_I32), _I32(0))
+    return tok_kind, tok_start, tok_end
 
-    # ---- phase 6: grammar scan ------------------------------------------
+
+def _compact_tokens_np(token_start, kind_b, end_b, T: int):
+    """Numpy twin of :func:`_compact_tokens`: scatters only the ~nnz token
+    starts instead of every byte (CPU backend; outputs are identical)."""
+    n, L = token_start.shape
+    ri, li = np.nonzero(token_start)
+    rank = np.cumsum(token_start, axis=1) - 1
+    ci = np.minimum(rank[ri, li], T - 1)
+    tok_kind = np.full((n, T), PAD, np.uint8)
+    tok_start = np.zeros((n, T), np.int32)
+    tok_end = np.zeros((n, T), np.int32)
+    tok_kind[ri, ci] = kind_b[ri, li]
+    tok_start[ri, ci] = li
+    tok_end[ri, ci] = end_b[ri, li]
+    return tok_kind, tok_start, tok_end
+
+
+@functools.partial(jax.jit, static_argnums=(4,))
+def _compact_and_grammar(token_start, kind_b, end_b, counts, T: int):
+    """Phase 5 compaction + phase 6 grammar scan (static token capacity),
+    fused in one jit for accelerator backends."""
+    tok_kind, tok_start, tok_end = _compact_tokens(
+        token_start, kind_b, end_b, counts, T)
     return _grammar_scan(tok_kind, tok_start, tok_end, counts)
 
 
@@ -616,3 +682,164 @@ def _grammar_scan(kind, start, end, counts):
 
     trailing = jnp.any(done_before & (tok_idx < counts[:, None]), axis=1)
     return kind2, start2, end2, match2, n_tokens, ok, trailing
+
+
+def _grammar_scan_np(kind, start, end, counts):
+    """Numpy twin of :func:`_grammar_scan` for the CPU backend.
+
+    Identical grammar, identical outputs (the whole JSON test tier runs on
+    the CPU mesh, so any divergence fails corpus/fuzz/parity tests); the
+    wins over the lax.scan form are microsecond op dispatch and a real
+    early exit at the last live token instead of T fixed steps.
+    """
+    n, T = kind.shape
+    rows = np.arange(n, dtype=np.int32)
+    depth = np.zeros((n,), np.int32)
+    ctx = np.zeros((n, MAX_DEPTH), bool)
+    open_stack = np.zeros((n, MAX_DEPTH), np.int32)
+    expect = np.full((n,), _E_VALUE, np.int32)
+    err = np.zeros((n,), bool)
+    done = np.zeros((n,), bool)
+    is_field = np.zeros((n, T), bool)
+    close_rec = np.full((n, T), -1, np.int32)
+    done_before = np.zeros((n, T), bool)
+
+    for t in range(T):
+        done_before[:, t] = done
+        active = ~done & ~err & (t < counts)
+        if not active.any():
+            done_before[:, t:] = done[:, None]
+            break
+        k = kind[:, t].astype(np.int32)
+
+        is_scalar = (
+            (k == VALUE_STRING) | (k == VALUE_NUMBER_INT)
+            | (k == VALUE_NUMBER_FLOAT) | (k == VALUE_TRUE)
+            | (k == VALUE_FALSE) | (k == VALUE_NULL)
+        )
+        is_open_obj = k == START_OBJECT
+        is_open_arr = k == START_ARRAY
+        is_close_obj = k == END_OBJECT
+        is_close_arr = k == END_ARRAY
+        is_comma = k == COMMA
+        is_colon = k == COLON
+
+        exp_value = (expect == _E_VALUE) | (expect == _E_VALUE_OR_CLOSE)
+
+        take_scalar = exp_value & is_scalar
+        take_open = exp_value & (is_open_obj | is_open_arr)
+        take_field = (
+            ((expect == _E_FIELD_OR_CLOSE) | (expect == _E_FIELD))
+            & (k == VALUE_STRING)
+        )
+        take_colon = (expect == _E_COLON) & is_colon
+        take_comma_obj = (expect == _E_COMMA_OR_CLOSE_OBJ) & is_comma
+        take_comma_arr = (expect == _E_COMMA_OR_CLOSE_ARR) & is_comma
+        take_close_obj = (
+            ((expect == _E_FIELD_OR_CLOSE) | (expect == _E_COMMA_OR_CLOSE_OBJ))
+            & is_close_obj
+        )
+        take_close_arr = (
+            ((expect == _E_VALUE_OR_CLOSE) | (expect == _E_COMMA_OR_CLOSE_ARR))
+            & is_close_arr
+        )
+        take_close = take_close_obj | take_close_arr
+        legal = (
+            take_scalar | take_open | take_field | take_colon
+            | take_comma_obj | take_comma_arr | take_close
+        )
+        overflow = take_open & (depth >= MAX_DEPTH)
+        err = err | (active & (~legal | overflow))
+        do = active & legal & ~overflow
+
+        push = do & take_open
+        pop = do & take_close
+        depth2 = depth + push.astype(np.int32) - pop.astype(np.int32)
+        sel = np.clip(depth, 0, MAX_DEPTH - 1)
+        pr = np.nonzero(push)[0]
+        # matching open for a close: top of stack (read BEFORE this push)
+        sel_pop = np.clip(depth2, 0, MAX_DEPTH - 1)
+        popped_open = open_stack[rows, sel_pop]
+        popped_is_obj = ctx[rows, sel_pop]
+        ctx[pr, sel[pr]] = is_open_obj[pr]
+        open_stack[pr, sel[pr]] = t
+        # recorded PRE-mismatch-filter, exactly like the lax.scan form (the
+        # row errs anyway; keeping the record keeps ts.match bit-identical)
+        close_rec[:, t] = np.where(pop, popped_open, -1)
+        mismatch = pop & (popped_is_obj != is_close_obj)
+        err = err | mismatch
+        do = do & ~mismatch
+        pop = pop & ~mismatch
+        depth2 = np.where(mismatch, depth, depth2)
+
+        completed = do & (take_scalar | pop)
+        at_root = completed & (depth2 == 0)
+        done = done | at_root
+        parent_sel = np.clip(depth2 - 1, 0, MAX_DEPTH - 1)
+        parent_obj = ctx[rows, parent_sel]
+        after_value = np.where(
+            parent_obj, _E_COMMA_OR_CLOSE_OBJ, _E_COMMA_OR_CLOSE_ARR
+        )
+
+        expect = np.where(
+            completed & ~at_root, after_value,
+            np.where(
+                do & take_open & is_open_obj, _E_FIELD_OR_CLOSE,
+                np.where(
+                    do & take_open & is_open_arr, _E_VALUE_OR_CLOSE,
+                    np.where(
+                        do & take_field, _E_COLON,
+                        np.where(
+                            do & take_colon, _E_VALUE,
+                            np.where(
+                                do & take_comma_obj, _E_FIELD,
+                                np.where(
+                                    do & take_comma_arr, _E_VALUE, expect
+                                ),
+                            ),
+                        ),
+                    ),
+                ),
+            ),
+        ).astype(np.int32)
+        is_field[:, t] = do & take_field
+        depth = depth2
+
+    ok = done & ~err  # err can only be set while not done
+
+    kind = np.where(is_field, np.uint8(FIELD_NAME), kind)
+    tok_idx = np.broadcast_to(np.arange(T, dtype=np.int32)[None, :], (n, T))
+    match = tok_idx.copy()
+    ri, ti = np.nonzero(close_rec >= 0)
+    match[ri, close_rec[ri, ti]] = ti
+    has_close = close_rec >= 0
+    match = np.where(has_close, close_rec, match)
+
+    keep = (
+        ~done_before
+        & (kind != np.uint8(COMMA))
+        & (kind != np.uint8(COLON))
+        & (kind != np.uint8(PAD))
+        & (tok_idx < counts[:, None])
+    )
+    new_idx = np.cumsum(keep.astype(np.int32), axis=1) - 1
+    n_tokens = np.sum(keep.astype(np.int32), axis=1)
+
+    ri, ti = np.nonzero(keep)
+    ci = new_idx[ri, ti]
+
+    def compact(vals, fill, dtype):
+        out = np.full((n, T), fill, dtype=dtype)
+        out[ri, ci] = vals[ri, ti]
+        return out
+
+    kind2 = compact(kind, PAD, np.uint8)
+    start2 = compact(np.asarray(start), 0, np.int32)
+    end2 = compact(np.asarray(end), 0, np.int32)
+    match_new = new_idx[rows[:, None], np.clip(match, 0, T - 1)]
+    match2 = compact(match_new, 0, np.int32)
+
+    trailing = np.any(done_before & (tok_idx < counts[:, None]), axis=1)
+    return (jnp.asarray(kind2), jnp.asarray(start2), jnp.asarray(end2),
+            jnp.asarray(match2), jnp.asarray(n_tokens), jnp.asarray(ok),
+            jnp.asarray(trailing))

@@ -604,6 +604,11 @@ def register_builtin_handlers(engine: ServingEngine) -> None:
       ``Q5Data`` / ``Q3Data``).
     - ``hash32``: a batchable pure op (murmur3 over an int64 array) — the
       micro-batching demonstration payload (payload: 1-D numpy int64).
+    - ``get_json_object``: multi-path JSON extraction (payload:
+      ``(rows, paths)`` — a sequence of JSON strings/None and a sequence
+      of ``$.a[0].*`` path strings); returns one list of extracted
+      values per path.  Executor-governed: the engine reserves the
+      token-table working set before the launch.
     """
     import numpy as np
 
@@ -679,4 +684,28 @@ def register_builtin_handlers(engine: ServingEngine) -> None:
             [np.asarray(p, np.int64) for p in ps]),
         unbatch=unbatch_hash,
         max_batch=16,
+    ))
+
+    def run_json(p, ctx):
+        from spark_rapids_jni_tpu.columnar.column import strings_column
+        from spark_rapids_jni_tpu.ops.get_json_object import (
+            get_json_object_multiple_paths,
+        )
+
+        rows, paths = p
+        col = strings_column(list(rows))
+        outs = get_json_object_multiple_paths(col, list(paths))
+        return [c.to_list() for c in outs]
+
+    def json_nbytes(p) -> int:
+        rows, paths = p
+        src = sum(len(r) for r in rows if r is not None)
+        # token tables + byte tables run ~10-30x the source bytes; the
+        # per-path fan-out adds machines + rendered output per path
+        return 32 * src + 8 * src * max(len(paths), 1) + (1 << 16)
+
+    engine.register(QueryHandler(
+        name="get_json_object",
+        fn=run_json,
+        nbytes_of=json_nbytes,
     ))

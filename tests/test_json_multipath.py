@@ -1,0 +1,190 @@
+"""Multi-path extraction + adaptive-machine tiers for get_json_object.
+
+Fast tier (tier-1): multi-path vs per-path and vs the sequential oracle on
+a quirk-heavy corpus, compaction/sub-bucketing equivalence (the adaptive
+machine must be *bit*-invisible), step-cap truncation observability, the
+parse_path error grammar, and the count_subbuckets helper.
+
+Slow tier: multi-path parity over the full fuzz corpus on both pipelines.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import config
+from spark_rapids_jni_tpu.columnar.buckets import count_subbuckets
+from spark_rapids_jni_tpu.columnar.column import strings_column
+from spark_rapids_jni_tpu.ops.get_json_object import (
+    get_json_object,
+    get_json_object_multiple_paths,
+    parse_path,
+    truncation_count,
+)
+
+import json_oracle as jo
+
+# quirk coverage in one corpus: \uXXXX names (never match), -0 -> 0,
+# out-of-range index draining, escapes, floats, malformed rows, nulls
+_CORPUS = [
+    '{"a": {"b": 7}, "c": [1, 2, 3]}',
+    '{"a": 1, "k": 2}',
+    '{"\\u0061": 4}',                    # \u name never matches $.a
+    '{"a": [0, -0, 1.5, 2e3]}',          # -0 and float re-rendering
+    '[[1, 2], [3, [4, 5]], 6]',
+    '{"a": {"b": null}}',                # null value -> whole row null
+    "{'a': 'A\\tq'}",                    # single quotes + \t escape
+    '[{"b": 1}, {"b": 2}]',
+    '{"c": [10]}',                       # out-of-range $.c[1]
+    "junk", None, "", "[1,2",
+    '{"a": "x"} trailing',               # root trailing garbage ignored
+    '123', "'s'", "true",
+]
+
+_PATHS = ["$.a.b", "$.a", "$.c[1]", "$[1]", "$[*]", "$.a[*]"]
+
+
+def _paths_parsed():
+    return [parse_path(p) for p in _PATHS]
+
+
+def test_multipath_matches_oracle_and_single_calls():
+    col = strings_column(_CORPUS)
+    with config.override(json_device_render=False):
+        multi = [c.to_list()
+                 for c in get_json_object_multiple_paths(col, _PATHS)]
+        singles = [get_json_object(col, p).to_list() for p in _PATHS]
+    for path, parsed, got, single in zip(
+            _PATHS, _paths_parsed(), multi, singles):
+        want = [jo.get_json_object(row, parsed) for row in _CORPUS]
+        assert got == want, path
+        assert got == single, path
+
+
+def test_multipath_empty_and_zero_rows():
+    col = strings_column(_CORPUS)
+    assert get_json_object_multiple_paths(col, []) == []
+    empty = strings_column([])
+    outs = get_json_object_multiple_paths(empty, ["$.a", "$[0]"])
+    assert [c.to_list() for c in outs] == [[], []]
+
+
+def test_compaction_and_subbucketing_equivalence():
+    """The adaptive machine (compaction on/off x sub-bucket thresholds at
+    both degenerate extremes) must be byte-identical: these are execution
+    schedules, not semantics."""
+    # enough rows that compaction actually triggers (>= 64 live rows) and
+    # token counts spread across several pow2 classes
+    rng = random.Random(3)
+    rows = list(_CORPUS)
+    for i in range(300):
+        depth = rng.randint(0, 4)
+        inner = str(i) if i % 3 else '{"b": %d}' % i
+        for _ in range(depth):
+            inner = '[%s, %d]' % (inner, i)
+        rows.append('{"a": %s, "pad": "%s"}' % (inner, "x" * (i % 40)))
+    col = strings_column(rows)
+    configs = [
+        dict(json_compact=True, json_subbucket_min_rows=512),    # default
+        dict(json_compact=False, json_subbucket_min_rows=512),
+        dict(json_compact=True, json_subbucket_min_rows=1 << 30),  # one class
+        dict(json_compact=False, json_subbucket_min_rows=1 << 30),
+        dict(json_compact=True, json_subbucket_min_rows=1),      # max split
+    ]
+    baseline = None
+    for cfg in configs:
+        with config.override(json_device_render=False, **cfg):
+            got = [c.to_list()
+                   for c in get_json_object_multiple_paths(col, _PATHS)]
+        if baseline is None:
+            baseline = got
+        else:
+            assert got == baseline, cfg
+
+
+def test_step_cap_truncation_is_observable():
+    """Rows that exhaust the step cap must null AND count through the obs
+    seam — distinguishable from a genuine null result."""
+    from spark_rapids_jni_tpu.obs import seam as obs_seam
+
+    rows = ['{"a": [1, 2, 3, 4, 5, 6]}'] * 8
+    col = strings_column(rows)
+    crossings = []
+
+    def injector(category, name):
+        if name.startswith("json:step_cap_truncated"):
+            crossings.append((category, name))
+
+    before = truncation_count()
+    obs_seam._set_injector(injector)
+    try:
+        with config.override(json_device_render=False,
+                             json_step_margin=-10000):
+            out = get_json_object(col, "$.a[*]").to_list()
+    finally:
+        obs_seam._set_injector(None)
+    assert out == [None] * 8          # nulled ...
+    assert truncation_count() - before == 8   # ... but counted
+    assert crossings == [("op", "json:step_cap_truncated:8")]
+
+    # default margin: same rows extract fine and the counter stays put
+    with config.override(json_device_render=False):
+        ok = get_json_object(col, "$.a[*]").to_list()
+    assert ok == ["[1,2,3,4,5,6]"] * 8
+    assert truncation_count() - before == 8
+
+
+def test_parse_path_rejects_malformed_shapes():
+    for bad in ["$[]", "$[abc]", "$[+1]", "$[ 2]", "$[1_0]", "$[1.5]",
+                "$[", "$['a", "$x", "$$", "$.", "$..a", "no_dollar", ""]:
+        with pytest.raises(ValueError):
+            parse_path(bad)
+    # the accepted grammar still parses
+    assert parse_path("$") == []
+    assert parse_path("$['a]b'][3].*") == [(2, b"a]b"), (1, 3), (0,)]
+    assert parse_path("$.a[0].*") == [(2, b"a"), (1, 0), (0,)]
+
+
+def test_count_subbuckets_partitions_and_merges():
+    counts = np.array([1, 2, 3, 60, 5, 9, 17, 33, 2, 64])
+    # min_rows=1: pure pow2 classes
+    got = count_subbuckets(counts, 64, min_rows=1)
+    caps = [c for _, c in got]
+    assert caps == sorted(caps)
+    all_rows = np.sort(np.concatenate([r for r, _ in got]))
+    np.testing.assert_array_equal(all_rows, np.arange(len(counts)))
+    for rows, cap in got:
+        assert (counts[rows] <= cap).all()
+    # degenerate: min_rows >= n -> one class at the full capacity
+    got1 = count_subbuckets(counts, 64, min_rows=100)
+    assert len(got1) == 1 and got1[0][1] == 64
+    np.testing.assert_array_equal(got1[0][0], np.arange(len(counts)))
+    # cap clips classes (counts above cap land in the cap class)
+    got2 = count_subbuckets(counts, 16, min_rows=1)
+    assert max(c for _, c in got2) == 16
+    assert count_subbuckets(np.array([]), 8) == []
+
+
+@pytest.mark.slow
+def test_multipath_fuzz_parity_both_pipelines():
+    """Multi-path over the fuzz corpus: every path's column must equal the
+    oracle, on the host pipeline and the device pipeline."""
+    from test_get_json_object_fuzz import _FUZZ_PATHS, _rand_json
+
+    rng = random.Random(42)
+    n = config.get("json_fuzz_rows")
+    rows = [_rand_json(rng) for _ in range(n)]
+    for i in range(0, n, 17):
+        rows[i] = rows[i][:-1] if rows[i] else "{"
+    col = strings_column(rows)
+    paths = _FUZZ_PATHS
+    want = [[jo.get_json_object(s, p) for s in rows] for p in paths]
+    for flag in (False, True):
+        with config.override(json_device_render=flag):
+            got = [c.to_list()
+                   for c in get_json_object_multiple_paths(col, paths)]
+        for p, g, w in zip(paths, got, want):
+            bad = [(i, rows[i], g[i], w[i])
+                   for i in range(n) if g[i] != w[i]]
+            assert not bad, (flag, p, bad[:5])

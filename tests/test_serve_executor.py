@@ -397,6 +397,30 @@ def test_builtin_hash32_batches(gov):
         eng.shutdown()
 
 
+def test_builtin_get_json_object_multipath(gov):
+    mesh = make_mesh((len(jax.devices()), 1))
+    eng = _engine(gov, mesh=mesh, workers=1, builtin_handlers=True)
+    try:
+        import json_oracle as jo
+        from spark_rapids_jni_tpu.ops.get_json_object import parse_path
+
+        rows = ['{"a": {"b": %d}, "c": [%d, %d]}' % (i, i, i + 1)
+                for i in range(20)] + [None, "junk", '{"a": 1.5}']
+        paths = ["$.a.b", "$.c[1]", "$.a"]
+        s = eng.open_session()
+        r = eng.submit(s, "get_json_object", (rows, paths))
+        got = r.result(timeout=120)
+        assert len(got) == len(paths)
+        for path, col in zip(paths, got):
+            want = [jo.get_json_object(row, parse_path(path))
+                    for row in rows]
+            assert col == want, path
+        assert eng.budget.used == 0
+        assert eng.budget.peak > 0  # working set reserved before launch
+    finally:
+        eng.shutdown()
+
+
 def test_unbatch_wrong_length_fails_terminally(gov):
     """A handler whose unbatch returns the wrong number of parts must fail
     every batch member terminally — a short result must not leave trailing
