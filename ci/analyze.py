@@ -7,13 +7,15 @@ This gate rejects those *before* merge — the compile-time complement of the
 arbiter's runtime deadlock detector (native/task_arbiter.cpp), in the
 spirit of Flare's compile-time checking of Spark-native runtime contracts.
 
-Five passes (see docs/STATIC_ANALYSIS.md for the invariants):
+Six passes (see docs/STATIC_ANALYSIS.md for the invariants):
 
 - ``lock-order``           cycles in the static lock-acquisition graph
 - ``unguarded-shared-state`` unlocked attribute writes in lock-owning classes
 - ``retry-protocol``       broad excepts that can swallow retry signals
 - ``governed-allocation``  raw device allocation outside a governor bracket
 - ``seam-discipline``      obs seam crossings not paired / unregistered
+- ``flight-discipline``    flight-recorder events not using registered
+  EV_* kind constants (obs/flight.py)
 
 Workflow:
 
@@ -196,6 +198,8 @@ class Config:
     handler_classes: Tuple[str, ...] = ("QueryHandler",)
     reservation_funcs: Tuple[str, ...] = ("reservation",)
     categories: Optional[Set[str]] = None  # None -> parse obs/seam.py
+    flight_exclude: Tuple[str, ...] = ("obs.flight",)
+    event_kinds: Optional[Set[str]] = None  # None -> parse obs/flight.py
     rules: Optional[Set[str]] = None  # None -> all registered
 
 
@@ -1542,6 +1546,74 @@ def check_seam_discipline(project: Project, config: Config) -> List[Finding]:
                         "seam-discipline", mod.relpath, line,
                         f"{fname}() category {term!r} is not a registered "
                         f"obs.seam category"))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# pass 6: flight-discipline
+# --------------------------------------------------------------------------
+
+
+def _load_event_kinds(project: Project, config: Config) -> Set[str]:
+    """The EV_* constant *names* defined at obs/flight.py module level —
+    the registered event-kind vocabulary emission sites must use."""
+    if config.event_kinds is not None:
+        return config.event_kinds
+    kinds: Set[str] = set()
+    mod = project.modules.get("obs.flight")
+    if mod is not None:
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Constant) and isinstance(
+                    node.value.value, str):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id.startswith("EV_"):
+                        kinds.add(t.id)
+    return kinds
+
+
+@rule("flight-discipline",
+      "flight-recorder events must be emitted with registered EV_* "
+      "event-kind constants")
+def check_flight_discipline(project: Project, config: Config) -> List[Finding]:
+    """A dump consumer (tools/flightdump.py, the converter's governance
+    tracks, the chaos tests' completeness checks) keys on the event-kind
+    vocabulary; a free-form string at an emission site silently falls out
+    of every reconstruction.  Mirrors seam-discipline: the first argument
+    of ``obs.flight.record(...)`` must be an EV_* constant."""
+    kinds = _load_event_kinds(project, config)
+    findings: List[Finding] = []
+    for modid, mod in project.modules.items():
+        if modid in config.flight_exclude:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            r = project.resolve(mod, node.func)
+            # anomaly() reasons are intentionally free-form (they name the
+            # incident, not an event kind) — only record() is vocabulary-
+            # checked here
+            if not (r and r[0] == "func" and r[1] == "obs.flight.record"):
+                continue
+            if not node.args:
+                continue
+            line = node.lineno
+            if mod.suppressed("flight-discipline", line):
+                continue
+            kind = node.args[0]
+            if isinstance(kind, ast.Constant):
+                findings.append(Finding(
+                    "flight-discipline", mod.relpath, line,
+                    f"record() called with a literal event kind "
+                    f"{kind.value!r}: use a registered EV_* constant from "
+                    f"obs.flight"))
+            elif isinstance(kind, (ast.Name, ast.Attribute)):
+                term = kind.id if isinstance(kind, ast.Name) else kind.attr
+                if kinds and term not in kinds:
+                    findings.append(Finding(
+                        "flight-discipline", mod.relpath, line,
+                        f"record() event kind {term!r} is not a registered "
+                        f"obs.flight EV_* constant"))
     return findings
 
 

@@ -219,3 +219,17 @@ register("serve_workers", 4,
 register("serve_queue_size", 64,
          "Admission-queue bound: submits past this depth are rejected "
          "with backpressure (serve/queue.py).", env="SRT_SERVE_QUEUE_SIZE")
+register("flight_ring_size", 4096,
+         "Bounded event capacity of the always-on governance flight "
+         "recorder (obs/flight.py): the newest N state-transition events "
+         "survive for anomaly dumps.", env="SRT_FLIGHT_RING_SIZE")
+register("flight_dump_dir", "",
+         "Directory for flight-recorder anomaly dump artifacts (JSON, "
+         "pretty-printed by tools/flightdump.py).  Empty (default) keeps "
+         "dumps in memory only (FlightRecorder.dumps).",
+         env="SRT_FLIGHT_DUMP_DIR")
+register("flight_saturation_rejects", 8,
+         "Consecutive backpressure rejections (no successful submit in "
+         "between) that count as queue saturation and trigger a flight-"
+         "recorder anomaly dump (serve/executor.py).",
+         env="SRT_FLIGHT_SATURATION_REJECTS")

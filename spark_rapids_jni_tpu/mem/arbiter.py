@@ -13,8 +13,10 @@ import ctypes
 import os
 import subprocess
 import threading
+import time
 
 from spark_rapids_jni_tpu.mem import exceptions as exc
+from spark_rapids_jni_tpu.obs import flight as _flight
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
 _SRC = os.path.join(_NATIVE_DIR, "task_arbiter.cpp")
@@ -46,6 +48,10 @@ STATE_BUFN_WAIT = 5
 STATE_BUFN = 6
 STATE_SPLIT_THROW = 7
 STATE_REMOVE_THROW = 8
+
+# throw codes that, returned from a *parked* native call, mean the deadlock
+# detector escalated the waiting thread (the break verdict; see _parked)
+_BREAK_CODES = frozenset({-1, -2, -3, -4})
 
 # oom filter bits (OomInjectionType): CPU=1, GPU=2, ALL=3
 OOM_CPU = 1
@@ -126,11 +132,27 @@ class Arbiter:
         )
         if not self._h:
             raise RuntimeError("failed to create native arbiter")
+        # thread -> task association mirror, so flight-recorder events can
+        # carry task ids (the native map is not introspectable per thread)
+        self._task_map_lock = threading.Lock()
+        self._task_of: dict[int, int] = {}
+        # thread -> monotonic_ns at which post_alloc_failed parked it
+        # (state BLOCKED): the park is *served* inside the thread's next
+        # pre_alloc, which closes the window.  Keys are touched only by
+        # the owning thread (GIL-atomic dict ops, no lock needed).
+        self._blocked_at: dict[int, int] = {}
 
     def close(self):
-        if self._h:
-            self._lib.arbiter_destroy(self.handle)
-            self._h = None
+        # null the handle *before* destroying it: gauge samplers on other
+        # threads (governor.budget_gauges -> total_blocked_or_bufn) guard
+        # on the handle property, and must fail that guard rather than
+        # race a native call against the free
+        # analyze: ignore[unguarded-shared-state] - single-owner lifecycle
+        # teardown, pre-dating the task-map lock (which guards only the
+        # thread->task mirror, not the handle)
+        h, self._h = self._h, None
+        if h:
+            self._lib.arbiter_destroy(h)
 
     def __enter__(self):
         return self
@@ -151,27 +173,59 @@ class Arbiter:
         if code >= 0:
             return code
         err = self._lib.arbiter_last_error().decode()
-        raise _CODE_TO_EXC.get(code, RuntimeError)(err)
+        e_cls = _CODE_TO_EXC.get(code, RuntimeError)
+        # surface retry/split signal deliveries to the flight recorder:
+        # the native machine returns throw codes to the calling thread, so
+        # the current thread is the signal's target
+        if e_cls in (exc.GpuRetryOOM, exc.CpuRetryOOM):
+            _flight.record(_flight.EV_RETRY,
+                           self.task_of(current_thread_id()),
+                           detail=e_cls.__name__)
+        elif e_cls in (exc.GpuSplitAndRetryOOM, exc.CpuSplitAndRetryOOM):
+            _flight.record(_flight.EV_SPLIT_RETRY,
+                           self.task_of(current_thread_id()),
+                           detail=e_cls.__name__)
+        raise e_cls(err)
+
+    def task_of(self, thread_id) -> int:
+        """Primary task associated with ``thread_id`` (-1 when none)."""
+        with self._task_map_lock:
+            return self._task_of.get(thread_id, -1)
 
     # registration ----------------------------------------------------------
     def start_dedicated_task_thread(self, thread_id, task_id):
         self._check(self._lib.arbiter_start_dedicated_task_thread(self.handle, thread_id, task_id))
+        with self._task_map_lock:
+            self._task_of[thread_id] = task_id
 
     def pool_thread_working_on_task(self, thread_id, task_id, is_shuffle=False):
         self._check(
             self._lib.arbiter_pool_thread_working_on_task(
                 self.handle, thread_id, task_id, is_shuffle)
         )
+        with self._task_map_lock:
+            self._task_of[thread_id] = task_id
 
     def pool_thread_finished_for_task(self, thread_id, task_id):
         self._check(self._lib.arbiter_pool_thread_finished_for_task(
             self.handle, thread_id, task_id))
+        with self._task_map_lock:
+            if self._task_of.get(thread_id) == task_id:
+                del self._task_of[thread_id]
 
     def remove_thread_association(self, thread_id, task_id=-1):
         self._check(self._lib.arbiter_remove_thread_association(self.handle, thread_id, task_id))
+        with self._task_map_lock:
+            if task_id == -1 or self._task_of.get(thread_id) == task_id:
+                self._task_of.pop(thread_id, None)
+        self._blocked_at.pop(thread_id, None)  # no pre_alloc will close it
 
     def task_done(self, task_id):
         self._check(self._lib.arbiter_task_done(self.handle, task_id))
+        with self._task_map_lock:
+            for tid in [t for t, task in self._task_of.items()
+                        if task == task_id]:
+                del self._task_of[tid]
 
     def set_pool_blocked(self, thread_id, blocked):
         self._check(self._lib.arbiter_set_pool_blocked(self.handle, thread_id, blocked))
@@ -205,7 +259,32 @@ class Arbiter:
     # alloc protocol --------------------------------------------------------
     def pre_alloc(self, thread_id, is_cpu=False, blocking=True) -> bool:
         """True if this is a recursive (spill) allocation."""
-        return self._check(self._lib.arbiter_pre_alloc(self.handle, thread_id, is_cpu, blocking)) == RECURSIVE  # noqa
+        code = self._lib.arbiter_pre_alloc(self.handle, thread_id, is_cpu,
+                                           blocking)
+        t0 = self._blocked_at.pop(thread_id, None)
+        if t0 is not None:
+            # this pre_alloc served the park the previous post_alloc_failed
+            # opened (block_thread_until_ready_core runs inside it); close
+            # the blocked window, and surface a deadlock-break verdict if
+            # the wait ended in a retry/split throw — the detector's BUFN
+            # escalation is the only source of those on a parked thread
+            # (forced injections fire before the park and count as normal
+            # retries via _check)
+            wait_ns = time.monotonic_ns() - t0
+            task = self.task_of(thread_id)
+            broke = code in _BREAK_CODES
+            if broke:
+                _flight.record(_flight.EV_DEADLOCK_VERDICT, task,
+                               detail=_CODE_TO_EXC[code].__name__)
+            _flight.record(
+                _flight.EV_TASK_WOKEN, task,
+                detail=f"alloc:{'threw' if code < 0 else 'ready'}",
+                value=wait_ns)
+            if broke:
+                _flight.anomaly("deadlock_broken",
+                                detail=f"task={task} thread={thread_id} "
+                                       f"{_CODE_TO_EXC[code].__name__}")
+        return self._check(code) == RECURSIVE
 
     def post_alloc_success(self, thread_id, is_cpu=False, was_recursive=False):
         self._check(
@@ -215,22 +294,56 @@ class Arbiter:
     def post_alloc_failed(self, thread_id, is_cpu=False, is_oom=True, blocking=True,
                           was_recursive=False) -> bool:
         """True if the allocation should be retried."""
-        return (
-            self._check(
-                self._lib.arbiter_post_alloc_failed(
-                    self.handle, thread_id, is_cpu, is_oom, blocking, was_recursive
-                )
-            )
-            == 1
-        )
+        ret = self._check(self._lib.arbiter_post_alloc_failed(
+            self.handle, thread_id, is_cpu, is_oom, blocking, was_recursive
+        )) == 1
+        if ret and blocking and is_oom:
+            # the thread is now in state BLOCKED; the park itself is
+            # served by the thread's next pre_alloc, which closes this
+            # window with a WOKEN event (and possibly a break verdict).
+            # analyze: ignore[unguarded-shared-state] - each key is
+            # written/popped only by its owning thread (GIL-atomic dict
+            # ops); the flight hot path must stay lock-free
+            self._blocked_at[thread_id] = time.monotonic_ns()
+            _flight.record(_flight.EV_TASK_BLOCKED,
+                           self.task_of(thread_id),
+                           detail=f"alloc:{'cpu' if is_cpu else 'dev'}")
+        return ret
 
     def dealloc(self, thread_id, is_cpu=False):
         self._check(self._lib.arbiter_dealloc(self.handle, thread_id, is_cpu))
 
     def block_thread_until_ready(self, thread_id):
-        self._check(self._lib.arbiter_block_thread_until_ready(self.handle, thread_id))
+        """Park until the arbiter readies this thread, bracketed by
+        BLOCKED / WOKEN flight events; a retry/split throw delivered into
+        the park is the deadlock detector's break verdict, surfaced
+        race-free on the victim's own thread (anomaly-dumped with the
+        history already in the ring)."""
+        task = self.task_of(thread_id)
+        _flight.record(_flight.EV_TASK_BLOCKED, task, detail="until_ready")
+        t0 = time.monotonic_ns()
+        code = self._lib.arbiter_block_thread_until_ready(
+            self.handle, thread_id)
+        wait_ns = time.monotonic_ns() - t0
+        broke = code in _BREAK_CODES
+        if broke:
+            _flight.record(_flight.EV_DEADLOCK_VERDICT, task,
+                           detail=_CODE_TO_EXC[code].__name__)
+        _flight.record(
+            _flight.EV_TASK_WOKEN, task,
+            detail=f"until_ready:{'threw' if code < 0 else 'ready'}",
+            value=wait_ns)
+        if broke:
+            _flight.anomaly("deadlock_broken",
+                            detail=f"task={task} thread={thread_id} "
+                                   f"{_CODE_TO_EXC[code].__name__}")
+        self._check(code)
 
     def check_and_break_deadlocks(self):
+        """Run the deadlock detector.  Break *verdicts* are surfaced by
+        the victims themselves (see :meth:`_parked`): a woken thread knows
+        it was escalated, while a post-hoc state sweep here would race the
+        victims consuming their signals."""
         self._check(self._lib.arbiter_check_and_break_deadlocks(self.handle))
 
     # introspection ---------------------------------------------------------

@@ -43,6 +43,7 @@ from spark_rapids_jni_tpu.mem.governor import (
     MemoryGovernor,
     OutOfBudget,
 )
+from spark_rapids_jni_tpu.obs import flight as _flight
 
 __all__ = [
     "task_context",
@@ -69,12 +70,16 @@ class ShuffleCapacityExceeded(Exception):
 @contextlib.contextmanager
 def task_context(gov: MemoryGovernor, task_id: int):
     """Register the current thread as the dedicated thread of ``task_id``
-    for the duration (startDedicatedTaskThread / taskDone pairing)."""
+    for the duration (startDedicatedTaskThread / taskDone pairing).
+    Admission and completion land in the governance flight recorder, so a
+    task's lifetime brackets its blocked/retry history in the ring."""
     gov.current_thread_is_dedicated_to_task(task_id)
+    _flight.record(_flight.EV_TASK_ADMITTED, task_id, detail="dedicated")
     try:
         yield gov
     finally:
         gov.task_done(task_id)
+        _flight.record(_flight.EV_TASK_DONE, task_id)
 
 
 @contextlib.contextmanager

@@ -20,6 +20,7 @@ Two layers, mirroring the reference split:
 from __future__ import annotations
 
 import threading
+import weakref
 from typing import Optional
 
 from spark_rapids_jni_tpu.mem.arbiter import (
@@ -29,6 +30,7 @@ from spark_rapids_jni_tpu.mem.arbiter import (
     OOM_GPU,
     current_thread_id,
 )
+from spark_rapids_jni_tpu.obs import flight as _flight
 
 
 class MemoryGovernor:
@@ -44,6 +46,7 @@ class MemoryGovernor:
 
             watchdog_period_s = config.get("watchdog_period_s")
         self.arbiter = Arbiter(log_path)
+        _GOVERNORS.add(self)
         self._shutdown = threading.Event()
         self._watchdog = threading.Thread(
             target=self._watch, args=(watchdog_period_s,), daemon=True,
@@ -183,6 +186,36 @@ class OutOfBudget(MemoryError):
     """Raised by a budget when a reservation cannot be satisfied."""
 
 
+# live budgets/governors, for memory-pressure gauges (serve metrics +
+# flight dumps); weak so a dropped per-test instance never pins or
+# double-counts
+_BUDGETS: "weakref.WeakSet" = weakref.WeakSet()
+_GOVERNORS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def budget_gauges() -> dict:
+    """Process-wide memory-pressure gauges: bytes in use / limits summed
+    over live budgets (device vs host), plus the arbiters' parked-thread
+    counts.  Non-destructive — safe for anomaly dumps and per-request
+    metrics publishing."""
+    out = {"device_bytes_in_use": 0, "device_bytes_limit": 0,
+           "host_bytes_in_use": 0, "host_bytes_limit": 0,
+           "blocked_or_bufn": 0}
+    for b in list(_BUDGETS):
+        side = "host" if b.is_cpu else "device"
+        out[f"{side}_bytes_in_use"] += b.used
+        out[f"{side}_bytes_limit"] += b.limit
+    for gov in list(_GOVERNORS):
+        try:
+            out["blocked_or_bufn"] += gov.arbiter.total_blocked_or_bufn()
+        except RuntimeError:  # racing close(): this governor contributes 0
+            pass
+    return out
+
+
+_flight.register_telemetry_source("governor", budget_gauges)
+
+
 class BudgetedResource:
     """An HBM/host-memory budget driven through the arbiter's retry protocol.
 
@@ -202,6 +235,7 @@ class BudgetedResource:
         self.is_cpu = is_cpu
         self._lock = threading.Lock()
         self._spill_handlers = []
+        _BUDGETS.add(self)
 
     def register_spill_handler(self, handler) -> None:
         """``handler(shortfall_bytes) -> freed_bytes``: consulted between a

@@ -24,14 +24,35 @@ borrowed device array is still live — accounting drift).
 from __future__ import annotations
 
 import threading
+import time
+import weakref
 from typing import List, Optional
 
 import numpy as np
 
 from spark_rapids_jni_tpu.mem.governor import BudgetedResource
+from spark_rapids_jni_tpu.obs import flight as _flight
 from spark_rapids_jni_tpu.obs import seam as _seam
 
-__all__ = ["SpillableBuffer", "SpillPool"]
+__all__ = ["SpillableBuffer", "SpillPool", "pool_gauges"]
+
+# live pools, for spill-pressure gauges (serve metrics + flight dumps)
+_POOLS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def pool_gauges() -> dict:
+    """Aggregate spill gauges over live pools (non-destructive)."""
+    out = {"pools": 0, "device_bytes": 0, "spill_count": 0,
+           "spilled_bytes": 0}
+    for p in list(_POOLS):
+        out["pools"] += 1
+        out["device_bytes"] += p.device_bytes()
+        out["spill_count"] += p.spill_count
+        out["spilled_bytes"] += p.spilled_bytes
+    return out
+
+
+_flight.register_telemetry_source("spill", pool_gauges)
 
 
 class SpillableBuffer:
@@ -98,6 +119,7 @@ class SpillPool:
         self.spill_count = 0
         self.spilled_bytes = 0
         budget.register_spill_handler(self.spill_until)
+        _POOLS.add(self)
 
     # ---- user API --------------------------------------------------------
 
@@ -160,8 +182,21 @@ class SpillPool:
         with self._lock:
             if buf.spilled or buf._pins > 0:
                 return 0
-            with _seam.seam(_seam.SPILL, f"spill:{buf.nbytes}B"):
-                buf._host = np.asarray(buf._dev)
+            task = self._budget.gov.arbiter.task_of(
+                threading.get_ident())
+            _flight.record(_flight.EV_SPILL_BEGIN, task, value=buf.nbytes)
+            t0 = time.monotonic_ns()
+            try:
+                with _seam.seam(_seam.SPILL, f"spill:{buf.nbytes}B"):
+                    buf._host = np.asarray(buf._dev)
+            except BaseException:
+                # an injected/real spill failure still closes the window
+                _flight.record(_flight.EV_SPILL_END, task, detail="error",
+                               value=time.monotonic_ns() - t0)
+                raise
+            _flight.record(_flight.EV_SPILL_END, task,
+                           detail=f"{buf.nbytes}B",
+                           value=time.monotonic_ns() - t0)
             buf._dev = None
             self.spill_count += 1
             self.spilled_bytes += buf.nbytes

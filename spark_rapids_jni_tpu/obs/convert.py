@@ -33,24 +33,57 @@ import struct
 import sys
 from typing import Iterator, List, Optional
 
+from spark_rapids_jni_tpu.obs.flight import EVENT_KINDS
 from spark_rapids_jni_tpu.obs.profiler import CLOCK_ANCHOR, MAGIC, VERSION
 
 _CATEGORY_NAMES = ["op", "transfer", "collective", "alloc", "marker",
                    "spill", "compile", "serve"]
 
+SUPPORTED_VERSIONS = (1, 2)
 
-def parse_capture(data: bytes) -> Iterator[dict]:
-    """Yield event dicts from a raw capture byte string."""
-    if data[:4] != MAGIC:
-        raise ValueError("not an SRTP capture (bad magic)")
-    version = struct.unpack_from("<I", data, 4)[0]
-    if version != VERSION:
+# per-version record sizes that differ: v1 COUNTER carried no tid
+_COUNTER_FMT = {1: "<IQq", 2: "<IQqI"}
+
+
+def parse_capture(data: bytes, *, midstream: bool = False,
+                  version: Optional[int] = None,
+                  strict: bool = False) -> Iterator[dict]:
+    """Yield event dicts from a raw capture byte string.
+
+    Reads format v1 and v2 (v2 adds STATE records and a tid on COUNTER).
+    ``midstream=True`` starts at a *block boundary* with no file header —
+    every block is self-contained (the string table restarts per block),
+    so a consumer attaching to a live stream can begin at any size prefix;
+    ``version`` then selects the record layout (default: current).
+
+    A truncated final block (a writer killed mid-flush) ends iteration
+    cleanly instead of raising, unless ``strict=True``.  Corruption
+    *inside* a complete block (unknown record kind) still raises.
+    """
+    if midstream:
+        pos = 0
+        version = VERSION if version is None else version
+    else:
+        if data[:4] != MAGIC:
+            raise ValueError("not an SRTP capture (bad magic)")
+        version = struct.unpack_from("<I", data, 4)[0]
+        pos = 8
+    if version not in SUPPORTED_VERSIONS:
         raise ValueError(f"unsupported SRTP version {version}")
-    pos = 8
+    cfmt = _COUNTER_FMT[version]
+    clen = struct.calcsize(cfmt)
     while pos < len(data):
+        if pos + 4 > len(data):
+            if strict:
+                raise ValueError("truncated capture: partial block length")
+            return
         (blen,) = struct.unpack_from("<I", data, pos)
         pos += 4
         end = pos + blen
+        if end > len(data):
+            if strict:
+                raise ValueError("truncated capture: partial final block")
+            return
         names = {}
         while pos < end:
             kind = data[pos]
@@ -72,18 +105,69 @@ def parse_capture(data: bytes) -> Iterator[dict]:
                 yield {"type": "instant", "name": names.get(nid, f"#{nid}"),
                        "category": _CATEGORY_NAMES[cat], "t_ns": t, "tid": tid}
             elif kind == 3:  # COUNTER
-                nid, t, value = struct.unpack_from("<IQq", data, pos)
-                pos += 20
+                vals = struct.unpack_from(cfmt, data, pos)
+                pos += clen
+                nid, t, value = vals[0], vals[1], vals[2]
                 yield {"type": "counter", "name": names.get(nid, f"#{nid}"),
-                       "t_ns": t, "value": value}
+                       "t_ns": t, "value": value,
+                       "tid": vals[3] if version >= 2 else None}
+            elif kind == 4 and version >= 2:  # STATE
+                ek, task_id, t, tid, did, value = struct.unpack_from(
+                    "<BqQIIq", data, pos)
+                pos += 33
+                yield {"type": "state",
+                       "kind": (EVENT_KINDS[ek] if ek < len(EVENT_KINDS)
+                                else f"#{ek}"),
+                       "task_id": task_id, "t_ns": t, "tid": tid,
+                       "detail": names.get(did, f"#{did}"), "value": value}
             else:
                 raise ValueError(f"corrupt capture: record kind {kind}")
         pos = end
 
 
+# pid for the reconstructed per-task governance tracks (host seam events
+# are pid 0, merged device tracks sit at >= 1000)
+_GOV_PID = 2000
+
+# state kinds whose `value` carries a duration (ns) ending at t_ns: they
+# render as complete ('X') slices so blocked windows are visible spans
+_STATE_DUR_KINDS = {"woken": "blocked", "spill_end": "spill"}
+
+
+def _state_to_chrome(e: dict, out: list, named_tracks: set) -> None:
+    """One governance STATE event -> chrome events on a per-task track."""
+    track = e["task_id"] if e["task_id"] >= 0 else e["tid"]
+    if track not in named_tracks:
+        named_tracks.add(track)
+        if not named_tracks - {track}:  # first track names the process
+            out.append({"ph": "M", "pid": _GOV_PID, "name": "process_name",
+                        "args": {"name": "governance"}})
+        label = (f"task {track}" if e["task_id"] >= 0
+                 else f"thread {e['tid']} (untasked)")
+        out.append({"ph": "M", "pid": _GOV_PID, "tid": track,
+                    "name": "thread_name", "args": {"name": label}})
+    span = _STATE_DUR_KINDS.get(e["kind"])
+    if span is not None and e["value"] > 0:
+        out.append({"name": span, "cat": "governance", "ph": "X",
+                    "ts": (e["t_ns"] - e["value"]) / 1e3,
+                    "dur": e["value"] / 1e3, "pid": _GOV_PID, "tid": track,
+                    "args": {"detail": e["detail"]}})
+    else:
+        out.append({"name": e["kind"], "cat": "governance", "ph": "i",
+                    "ts": e["t_ns"] / 1e3, "pid": _GOV_PID, "tid": track,
+                    "s": "t", "args": {"detail": e["detail"]}})
+
+
 def to_chrome(events) -> dict:
-    """Chrome trace-event JSON (ts/dur in microseconds)."""
+    """Chrome trace-event JSON (ts/dur in microseconds).
+
+    Governance STATE events land on per-task tracks under a dedicated
+    ``governance`` pid, on the same monotonic timeline as the op/serve
+    ranges — blocked windows (and spills) render as spans, the other
+    transitions as instants.
+    """
     out = []
+    named_tracks: set = set()
     for e in events:
         if e["type"] == "range":
             out.append({"name": e["name"], "cat": e["category"], "ph": "X",
@@ -94,6 +178,8 @@ def to_chrome(events) -> dict:
             out.append({"name": e["name"], "cat": e["category"], "ph": "i",
                         "ts": e["t_ns"] / 1e3, "pid": 0, "tid": e["tid"],
                         "s": "t"})
+        elif e["type"] == "state":
+            _state_to_chrome(e, out, named_tracks)
         else:
             out.append({"name": e["name"], "ph": "C", "ts": e["t_ns"] / 1e3,
                         "pid": 0, "args": {"value": e["value"]}})

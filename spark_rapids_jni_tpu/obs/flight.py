@@ -1,0 +1,294 @@
+"""Governance flight recorder: always-on ring of state-transition events.
+
+The reference's only window into its SparkResourceAdaptor state machine is
+a CSV transition log the operator must arm *before* the incident
+(task_arbiter.cpp log_transition; ``TaskArbiter(log_path=...)`` here) —
+after a soak deadlock or a retry storm, "which task was blocked on what,
+and what woke it" is unanswerable.  This module is the always-on analog a
+production query engine keeps: a bounded, lock-cheap ring buffer of
+structured state-transition events fed from every governance layer —
+
+- ``mem/arbiter.py``   blocked/woken around parking calls, retry and
+  split-and-retry signal deliveries, deadlock-break verdicts (state_of
+  sweeps across ``check_and_break_deadlocks``);
+- ``mem/governed.py``  task admission / completion (``task_context``);
+- ``mem/spill.py``     spill begin/end with byte counts;
+- ``serve/executor.py`` queue rejections/timeouts, split-requeues,
+  OOM-killed requests, queue-saturation detection.
+
+Events are tuples appended to a ``collections.deque(maxlen=N)`` — in
+CPython a bounded deque append is a single atomic operation under the GIL,
+so the hot recording path takes **no lock** (the only lock guards the
+small per-task stats table, touched for four event kinds only).  When the
+SRTP profiler is active each event is additionally streamed into the
+capture as a STATE record (format v2, obs/profiler.py), which
+``obs/convert.py`` renders as per-task governance tracks aligned with the
+op/serve ranges.
+
+On anomaly — deadlock broken, queue saturation, task OOM-killed, watchdog
+fire — :func:`anomaly` dumps the full ring plus a unified telemetry
+snapshot (every registered source: serve metrics, governor budget gauges,
+spill-pool gauges) to a JSON artifact under the ``flight_dump_dir`` config
+flag (kept in memory when unset).  ``tools/flightdump.py`` pretty-prints
+the reconstructed per-task timeline from such a dump.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from spark_rapids_jni_tpu.obs import seam as _seam
+
+__all__ = [
+    "EV_TASK_ADMITTED", "EV_TASK_BLOCKED", "EV_TASK_WOKEN", "EV_RETRY",
+    "EV_SPLIT_RETRY", "EV_SPILL_BEGIN", "EV_SPILL_END",
+    "EV_DEADLOCK_VERDICT", "EV_QUEUE_REJECT", "EV_QUEUE_TIMEOUT",
+    "EV_TASK_DONE", "EV_TASK_KILLED", "EV_ANOMALY",
+    "EVENT_KINDS", "KIND_IDS", "DUMP_SCHEMA",
+    "FlightRecorder", "record", "anomaly", "snapshot", "task_stats",
+    "register_telemetry_source", "unregister_telemetry_source",
+    "unified_snapshot", "recorder",
+]
+
+# --------------------------------------------------------------------------
+# event-kind vocabulary (wire ids = tuple index; ci/analyze.py's
+# flight-discipline pass enforces that emission sites use these constants)
+# --------------------------------------------------------------------------
+
+EV_TASK_ADMITTED = "admitted"          # task registered a dedicated thread
+EV_TASK_BLOCKED = "blocked"            # thread parked waiting for budget
+EV_TASK_WOKEN = "woken"                # parked wait returned (value=wait_ns)
+EV_RETRY = "retry"                     # RetryOOM delivered to the thread
+EV_SPLIT_RETRY = "split_retry"         # SplitAndRetryOOM / split-requeue
+EV_SPILL_BEGIN = "spill_begin"         # D2H staging starts (value=nbytes)
+EV_SPILL_END = "spill_end"             # D2H staging done (value=dur_ns)
+EV_DEADLOCK_VERDICT = "deadlock_verdict"  # watchdog escalated a thread
+EV_QUEUE_REJECT = "queue_reject"       # admission backpressure rejection
+EV_QUEUE_TIMEOUT = "queue_timeout"     # deadline expired while queued
+EV_TASK_DONE = "task_done"             # task deregistered cleanly
+EV_TASK_KILLED = "task_killed"         # task failed terminally on OOM
+EV_ANOMALY = "anomaly"                 # a dump was triggered (detail=reason)
+
+EVENT_KINDS = (
+    EV_TASK_ADMITTED, EV_TASK_BLOCKED, EV_TASK_WOKEN, EV_RETRY,
+    EV_SPLIT_RETRY, EV_SPILL_BEGIN, EV_SPILL_END, EV_DEADLOCK_VERDICT,
+    EV_QUEUE_REJECT, EV_QUEUE_TIMEOUT, EV_TASK_DONE, EV_TASK_KILLED,
+    EV_ANOMALY,
+)
+KIND_IDS = {k: i for i, k in enumerate(EVENT_KINDS)}
+
+DUMP_SCHEMA = "srt-flight-dump-v1"
+
+# per-task accumulators kept for at most this many distinct tasks (oldest
+# evicted); sized above any realistic live-task count, below leak territory
+_MAX_TASKS = 1024
+# one dump per (reason) per this many seconds: a retry storm must produce
+# one artifact, not thousands
+_DUMP_MIN_INTERVAL_S = 1.0
+
+
+class FlightRecorder:
+    """Bounded ring of governance events + per-task accumulators."""
+
+    def __init__(self, ring_size: Optional[int] = None):
+        if ring_size is None:
+            from spark_rapids_jni_tpu import config
+
+            ring_size = int(config.get("flight_ring_size"))
+        self._ring: "collections.deque" = collections.deque(maxlen=ring_size)
+        self._stats_lock = threading.Lock()
+        self._tasks: "collections.OrderedDict" = collections.OrderedDict()
+        self._sources: Dict[str, Callable[[], dict]] = {}
+        self._sources_lock = threading.Lock()
+        self._dump_lock = threading.Lock()
+        self._last_dump_t: Dict[str, float] = {}
+        self._dump_seq = 0
+        self.dumps: List[dict] = []          # last few dumps, newest last
+        self.dump_count = 0
+        self.dumps_suppressed = 0
+
+    # -- recording (the hot path) ------------------------------------------
+    def record(self, kind: str, task_id: int = -1, detail: str = "",
+               value: int = 0) -> None:
+        t_ns = time.monotonic_ns()
+        tid = threading.get_ident() & 0xFFFFFFFF
+        # atomic bounded append: no lock on the hot path
+        self._ring.append((t_ns, kind, task_id, tid, detail, value))
+        if task_id >= 0 and kind in _STAT_KINDS:
+            with self._stats_lock:
+                st = self._tasks.get(task_id)
+                if st is None:
+                    if len(self._tasks) >= _MAX_TASKS:
+                        self._tasks.popitem(last=False)
+                    st = self._tasks[task_id] = {
+                        "retries": 0, "split_retries": 0,
+                        "blocked_ns": 0, "wakes": 0, "killed": 0,
+                    }
+                if kind == EV_RETRY:
+                    st["retries"] += 1
+                elif kind == EV_SPLIT_RETRY:
+                    st["split_retries"] += 1
+                elif kind == EV_TASK_WOKEN:
+                    st["wakes"] += 1
+                    st["blocked_ns"] += max(int(value), 0)
+                elif kind == EV_TASK_KILLED:
+                    st["killed"] += 1
+        if _seam._profiler_range is not None:
+            from spark_rapids_jni_tpu.obs.profiler import Profiler
+
+            Profiler.state(KIND_IDS[kind], task_id, detail, value,
+                           t_ns=t_ns, tid=tid)
+
+    # -- reading -----------------------------------------------------------
+    def snapshot(self) -> List[dict]:
+        """The ring as event dicts, oldest first (a point-in-time copy)."""
+        return [
+            {"t_ns": t, "kind": k, "task_id": task, "tid": tid,
+             "detail": d, "value": v}
+            for t, k, task, tid, d, v in list(self._ring)
+        ]
+
+    def task_stats(self) -> Dict[int, dict]:
+        """Per-task accumulators (non-destructive, unlike the arbiter's
+        get-and-reset metrics — safe to sample from any dump/publish)."""
+        with self._stats_lock:
+            return {task: dict(st) for task, st in self._tasks.items()}
+
+    # -- telemetry sources -------------------------------------------------
+    def register_telemetry_source(self, name: str,
+                                  fn: Callable[[], dict]) -> None:
+        with self._sources_lock:
+            self._sources[name] = fn
+
+    def unregister_telemetry_source(self, name: str) -> None:
+        with self._sources_lock:
+            self._sources.pop(name, None)
+
+    def unified_snapshot(self) -> dict:
+        """Every registered telemetry source, sampled now.  A failing
+        source becomes an ``{"error": ...}`` entry — a dump taken mid-crash
+        must never itself crash."""
+        with self._sources_lock:
+            sources = dict(self._sources)
+        out = {}
+        for name, fn in sources.items():
+            try:
+                out[name] = fn()
+            # analyze: ignore[retry-protocol] - dump-time sampling of user
+            # gauge callables: any failure (a closed engine, a shut-down
+            # governor) is reported in-band, never propagated out of the
+            # anomaly path
+            except Exception as e:  # noqa: BLE001
+                out[name] = {"error": repr(e)[:200]}
+        return out
+
+    # -- anomaly dumps -----------------------------------------------------
+    def anomaly(self, reason: str, detail: str = "") -> Optional[dict]:
+        """Record an ANOMALY event and dump ring + telemetry.
+
+        Returns the dump dict, or None when rate-limited (one dump per
+        reason per second — a storm produces one artifact, counted).
+        """
+        self.record(EV_ANOMALY, -1, f"{reason}:{detail}" if detail
+                    else reason)
+        now = time.monotonic()
+        with self._dump_lock:
+            last = self._last_dump_t.get(reason, -1e9)
+            if now - last < _DUMP_MIN_INTERVAL_S:
+                self.dumps_suppressed += 1
+                return None
+            self._last_dump_t[reason] = now
+            self._dump_seq += 1
+            seq = self._dump_seq
+        dump = {
+            "schema": DUMP_SCHEMA,
+            "reason": reason,
+            "detail": detail,
+            "wall_time_s": time.time(),
+            "t_ns": time.monotonic_ns(),
+            "events": self.snapshot(),
+            "tasks": {str(k): v for k, v in self.task_stats().items()},
+            "telemetry": self.unified_snapshot(),
+        }
+        self.dumps.append(dump)
+        del self.dumps[:-4]  # keep the newest few in memory
+        self.dump_count += 1
+        path = self._write_dump(dump, reason, seq)
+        if path:
+            dump["artifact"] = path
+        return dump
+
+    def _write_dump(self, dump: dict, reason: str, seq: int) -> str:
+        from spark_rapids_jni_tpu import config
+
+        d = str(config.get("flight_dump_dir") or "")
+        if not d:
+            return ""
+        try:
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(
+                d, f"flight_{reason}_{os.getpid()}_{seq}.json")
+            with open(path, "w") as f:
+                json.dump(dump, f, indent=1, sort_keys=True)
+                f.write("\n")
+            return path
+        except OSError:
+            return ""  # an unwritable dump dir must not break governance
+
+    def reset_for_tests(self) -> None:
+        self._ring.clear()
+        with self._stats_lock:
+            self._tasks.clear()
+        with self._dump_lock:
+            self._last_dump_t.clear()
+        self.dumps = []
+        self.dump_count = 0
+        self.dumps_suppressed = 0
+
+
+_STAT_KINDS = frozenset({EV_RETRY, EV_SPLIT_RETRY, EV_TASK_WOKEN,
+                         EV_TASK_KILLED})
+
+# --------------------------------------------------------------------------
+# module-level singleton facade (the always-on recorder every layer feeds)
+# --------------------------------------------------------------------------
+
+_RECORDER = FlightRecorder()
+
+
+def recorder() -> FlightRecorder:
+    return _RECORDER
+
+
+def record(kind: str, task_id: int = -1, detail: str = "",
+           value: int = 0) -> None:
+    _RECORDER.record(kind, task_id, detail, value)
+
+
+def anomaly(reason: str, detail: str = "") -> Optional[dict]:
+    return _RECORDER.anomaly(reason, detail)
+
+
+def snapshot() -> List[dict]:
+    return _RECORDER.snapshot()
+
+
+def task_stats() -> Dict[int, dict]:
+    return _RECORDER.task_stats()
+
+
+def register_telemetry_source(name: str, fn: Callable[[], dict]) -> None:
+    _RECORDER.register_telemetry_source(name, fn)
+
+
+def unregister_telemetry_source(name: str) -> None:
+    _RECORDER.unregister_telemetry_source(name)
+
+
+def unified_snapshot() -> dict:
+    return _RECORDER.unified_snapshot()

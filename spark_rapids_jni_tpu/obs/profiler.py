@@ -11,7 +11,7 @@ jax.profiler XPlane capture for on-chip kernel timelines.
 
 Capture format (little-endian):
 
-- file header: ``b"SRTP"`` + u32 version (1)
+- file header: ``b"SRTP"`` + u32 version (2; the converter still reads 1)
 - blocks: u32 payload_len + payload (the size-prefix mirrors the
   reference's size-prefixed flatbuffers so a stream can be split without
   parsing records)
@@ -19,7 +19,11 @@ Capture format (little-endian):
   - 0 STRING_DEF: u32 id, u16 len, utf-8 bytes (interned names)
   - 1 RANGE: u32 name_id, u8 category, u64 start_ns, u64 end_ns, u32 tid
   - 2 INSTANT: u32 name_id, u8 category, u64 t_ns, u32 tid
-  - 3 COUNTER: u32 name_id, u64 t_ns, i64 value
+  - 3 COUNTER: u32 name_id, u64 t_ns, i64 value [, u32 tid — v2 only:
+    v1 counters carried no thread id, unlike RANGE/INSTANT]
+  - 4 STATE (v2 only): u8 event_kind (obs/flight.py EVENT_KINDS index),
+    i64 task_id, u64 t_ns, u32 tid, u32 detail_name_id, i64 value —
+    one governance state-transition event from the flight recorder
 
 Offline conversion to JSON / chrome-trace: ``python -m
 spark_rapids_jni_tpu.obs.convert`` (the spark_rapids_profile_converter
@@ -40,7 +44,7 @@ from spark_rapids_jni_tpu.obs import seam as _seam
 __all__ = ["Profiler", "MAGIC", "VERSION", "CLOCK_ANCHOR"]
 
 MAGIC = b"SRTP"
-VERSION = 1
+VERSION = 2
 
 # counter emitted at start(): wall-clock ns minus monotonic ns, letting the
 # converter place wall-stamped device events on the monotonic host timeline
@@ -50,7 +54,7 @@ _CATEGORIES = {_seam.OP: 0, _seam.TRANSFER: 1, _seam.COLLECTIVE: 2,
                _seam.ALLOC: 3, "marker": 4, _seam.SPILL: 5,
                _seam.COMPILE: 6, _seam.SERVE: 7}
 
-_R_STRING, _R_RANGE, _R_INSTANT, _R_COUNTER = 0, 1, 2, 3
+_R_STRING, _R_RANGE, _R_INSTANT, _R_COUNTER, _R_STATE = 0, 1, 2, 3, 4
 
 
 class _State:
@@ -222,4 +226,20 @@ class Profiler:
             if _st.active:
                 nid = _intern(name)
                 _append_locked(struct.pack(
-                    "<BIQq", _R_COUNTER, nid, time.monotonic_ns(), value))
+                    "<BIQqI", _R_COUNTER, nid, time.monotonic_ns(), value,
+                    threading.get_ident() & 0xFFFFFFFF))
+
+    @staticmethod
+    def state(event_kind: int, task_id: int, detail: str = "",
+              value: int = 0, *, t_ns: int = 0, tid: int = 0) -> None:
+        """Governance state-transition record (obs/flight.py feed).  The
+        caller passes its own timestamp/thread so the capture record is
+        bit-identical to the ring-buffer event it mirrors."""
+        with _st.lock:
+            if _st.active:
+                did = _intern(detail)
+                _append_locked(struct.pack(
+                    "<BBqQIIq", _R_STATE, event_kind & 0xFF, task_id,
+                    t_ns or time.monotonic_ns(),
+                    (tid or threading.get_ident()) & 0xFFFFFFFF,
+                    did, value))

@@ -29,6 +29,7 @@ import dataclasses
 import itertools
 import threading
 import time
+import weakref
 from typing import Any, Callable, List, Optional, Sequence
 
 from spark_rapids_jni_tpu.mem.exceptions import RetryOOM, SplitAndRetryOOM
@@ -39,6 +40,7 @@ from spark_rapids_jni_tpu.mem.governed import (
     task_context,
 )
 from spark_rapids_jni_tpu.mem.governor import MemoryGovernor, OutOfBudget
+from spark_rapids_jni_tpu.obs import flight as _flight
 from spark_rapids_jni_tpu.obs.seam import SERVE, seam
 from spark_rapids_jni_tpu.serve.metrics import ServeMetrics
 from spark_rapids_jni_tpu.serve.queue import (
@@ -187,6 +189,29 @@ class ServingEngine:
         self._reg_lock = threading.Lock()  # guards handler registration
         self._ewma_lock = threading.Lock()
         self._ewma_service_s = 0.05
+        # queue-saturation detector: N consecutive backpressure rejections
+        # with no successful admit in between trigger a flight-recorder
+        # anomaly dump (obs/flight.py)
+        self._sat_lock = threading.Lock()
+        self._sat_rejects = 0
+        self._sat_threshold = int(config.get("flight_saturation_rejects"))
+        self.metrics.set_gauge_source(self._gauges)
+        self._telemetry_name = f"serve:{id(self):x}"
+        # weakly referenced, like the governor/spill gauge registries: an
+        # engine that is never shut down (crash path, abandoned test
+        # instance) must not be pinned forever by the process-global
+        # recorder, and its source self-unregisters once collected
+        wm = weakref.WeakMethod(self.metrics.snapshot)
+        name = self._telemetry_name
+
+        def _sample(wm=wm, name=name):
+            fn = wm()
+            if fn is None:
+                _flight.unregister_telemetry_source(name)
+                return {"error": "engine collected"}
+            return fn()
+
+        _flight.register_telemetry_source(name, _sample)
         if builtin_handlers:
             register_builtin_handlers(self)
         self._workers = [
@@ -255,13 +280,39 @@ class ServingEngine:
         except Backpressure:
             session.credit(nbytes)
             self.metrics.count("rejected_full", session.session_id)
+            _flight.record(_flight.EV_QUEUE_REJECT, req.task_id,
+                           detail=f"handler:{handler}")
+            with self._sat_lock:
+                self._sat_rejects += 1
+                saturated = self._sat_rejects >= self._sat_threshold
+                if saturated:
+                    self._sat_rejects = 0
+            if saturated:
+                _flight.anomaly("queue_saturation",
+                                detail=f"depth={self.queue.depth()} "
+                                       f"rejects={self._sat_threshold}")
             raise
         except BaseException:  # closed queue (shutdown): no charge leaks
             session.credit(nbytes)
             raise
+        with self._sat_lock:
+            self._sat_rejects = 0
         self.metrics.count("submitted", session.session_id)
         self.metrics.set_depth(self.queue.depth())
         return req.response
+
+    def _gauges(self) -> dict:
+        """Memory-pressure gauges for metrics snapshots: governor budget
+        bytes, spill-pool bytes, and the arbiter's parked-thread count."""
+        from spark_rapids_jni_tpu.mem.governor import budget_gauges
+        from spark_rapids_jni_tpu.mem.spill import pool_gauges
+
+        g = {"gov_" + k: v for k, v in budget_gauges().items()}
+        sp = pool_gauges()
+        g["spill_pool_bytes"] = sp["device_bytes"]
+        g["spill_spilled_bytes"] = sp["spilled_bytes"]
+        g["spill_count"] = sp["spill_count"]
+        return g
 
     # -- lifecycle ----------------------------------------------------------
     def shutdown(self, drain: bool = True, timeout: float = 60.0) -> None:
@@ -283,6 +334,7 @@ class ServingEngine:
         for t in self._workers:
             t.join(timeout=max(0.1, deadline - time.monotonic()))
         self.metrics.set_depth(0)
+        _flight.unregister_telemetry_source(self._telemetry_name)
 
     def __enter__(self):
         return self
@@ -306,6 +358,8 @@ class ServingEngine:
         """Queue-side expiry (response already completed by the queue)."""
         self._credit(req)
         self.metrics.count("timed_out", req.session_id)
+        _flight.record(_flight.EV_QUEUE_TIMEOUT, req.task_id,
+                       detail=f"handler:{req.handler}")
         if req.join is not None:  # an expired split half still joins: the
             # parent must reach a terminal state, not hang on the slot
             req.join.deliver(req.join_slot, TIMED_OUT, None,
@@ -322,6 +376,16 @@ class ServingEngine:
         counter = {OK: "completed", TIMED_OUT: "timed_out",
                    CANCELLED: "cancelled"}.get(status, "failed")
         self.metrics.count(counter, req.session_id)
+        if status == ERROR and isinstance(error, MemoryError):
+            # the serving analog of an OOM-killed task: the governor's
+            # protocol gave up on this request (terminal OutOfBudget /
+            # split-depth cap / device OOM) — anomaly-dump the ring while
+            # the transition history leading here is still in it
+            _flight.record(_flight.EV_TASK_KILLED, req.task_id,
+                           detail=type(error).__name__)
+            _flight.anomaly("task_oom_killed",
+                            detail=f"task={req.task_id} "
+                                   f"handler={req.handler}")
         if req.join is not None:
             req.join.deliver(req.join_slot, status, value, error)
 
@@ -587,6 +651,11 @@ class ServingEngine:
                 split_depth=req.split_depth + 1,
                 no_batch=True, join=join, join_slot=slot,
             )
+            # the serve-level half: a fresh task carrying its parent's
+            # lineage into the flight ring (the arbiter already recorded
+            # the parent's split signal delivery)
+            _flight.record(_flight.EV_SPLIT_RETRY, child.task_id,
+                           detail=f"requeued_from:{req.task_id}")
             self._requeue(child)  # force-admitted; terminal on shutdown race
 
 

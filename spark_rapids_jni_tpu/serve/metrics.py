@@ -15,8 +15,11 @@ same capture stream as op ranges and budget counters.
 from __future__ import annotations
 
 import threading
+import time
 from collections import defaultdict
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
+
+from spark_rapids_jni_tpu.obs import flight as _flight
 
 __all__ = ["LatencyHistogram", "ServeMetrics"]
 
@@ -96,6 +99,41 @@ class ServeMetrics:
         self.queue_wait = LatencyHistogram()
         self.run_latency = LatencyHistogram()
         self._depth = 0
+        self._gauge_source: Optional[Callable[[], dict]] = None
+        self._gauge_cache: Dict[str, int] = {}
+        self._gauge_cache_t = -1e9
+
+    def set_gauge_source(self, fn: Optional[Callable[[], dict]]) -> None:
+        """Attach a memory-pressure gauge sampler (the engine passes
+        governor budget + spill-pool gauges); sampled per snapshot/publish
+        so serving telemetry reflects pressure, not just request counts."""
+        with self._lock:
+            self._gauge_source = fn
+            self._gauge_cache_t = -1e9
+
+    def gauges(self, max_age_s: float = 0.0) -> Dict[str, int]:
+        """Sample the gauge source.  ``max_age_s`` lets per-request
+        publishing reuse a recent sample: the walk behind the sampler
+        (pool buffer lists, a native arbiter call per governor) is too
+        heavy to repeat for every served request under capture."""
+        with self._lock:
+            fn = self._gauge_source
+            if max_age_s > 0.0 and (
+                    time.monotonic() - self._gauge_cache_t) < max_age_s:
+                return dict(self._gauge_cache)
+        if fn is None:
+            return {}
+        try:
+            g = dict(fn())
+        # analyze: ignore[retry-protocol] - gauge sampling during metrics
+        # publishing: a failing sampler (governor shut down mid-snapshot)
+        # must degrade to "no gauges", never fail the serving hot path
+        except Exception:  # noqa: BLE001
+            return {}
+        with self._lock:
+            self._gauge_cache = dict(g)
+            self._gauge_cache_t = time.monotonic()
+        return g
 
     # -- recording ----------------------------------------------------------
     def count(self, name: str, session_id: Optional[str] = None,
@@ -127,8 +165,12 @@ class ServeMetrics:
             return self._global.get(name, 0)
 
     def snapshot(self) -> dict:
-        """One JSON-able dict: global counters, latency summaries, and the
-        per-session counter tables (the serve_bench emission payload)."""
+        """One JSON-able dict: global counters, latency summaries, the
+        per-session counter tables (the serve_bench emission payload),
+        memory-pressure gauges, and the flight recorder's per-task
+        arbiter accumulators (retries / blocked-ns, non-destructive)."""
+        gauges = self.gauges()
+        tasks = {str(t): st for t, st in _flight.task_stats().items()}
         with self._lock:
             return {
                 "counters": {k: self._global.get(k, 0) for k in COUNTERS},
@@ -138,6 +180,8 @@ class ServeMetrics:
                 "sessions": {
                     sid: dict(c) for sid, c in self._per_session.items()
                 },
+                "gauges": gauges,
+                "tasks": tasks,
             }
 
     def publish(self) -> None:
@@ -154,5 +198,11 @@ class ServeMetrics:
         with self._lock:
             items = [("serve_" + k, v) for k, v in self._global.items()]
             items.append(("serve_queue_depth", self._depth))
+        # memory-pressure gauges ride the same capture stream, so the
+        # converter's counter tracks show pressure next to request counts
+        # (a 0.25s-aged sample is fine for a trace-viewer counter track)
+        items.extend(("serve_" + k, int(v))
+                     for k, v in self.gauges(max_age_s=0.25).items()
+                     if isinstance(v, (int, float)))
         for name, value in items:
             Profiler.counter(name, value)

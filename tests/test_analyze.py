@@ -635,6 +635,84 @@ def test_seam_proper_use_clean(tmp_path):
     assert run(root, rules=["seam-discipline"]) == []
 
 
+# ------------------------------------------------------- flight-discipline
+
+
+FLIGHT_PKG = {
+    "obs/flight.py": """
+        EV_RETRY = "retry"
+        EV_TASK_BLOCKED = "blocked"
+
+
+        def record(kind, task_id=-1, detail="", value=0):
+            pass
+
+
+        def anomaly(reason, detail=""):
+            pass
+    """,
+}
+
+
+def test_flight_literal_kind_flagged(tmp_path):
+    files = dict(FLIGHT_PKG)
+    files["mem/bad.py"] = """
+        from pkg.obs import flight
+
+
+        def f():
+            flight.record("retry", 1)
+    """
+    root = write_pkg(tmp_path, files)
+    fs = run(root, rules=["flight-discipline"])
+    assert len(fs) == 1 and "literal" in fs[0].message
+
+
+def test_flight_unregistered_kind_flagged(tmp_path):
+    files = dict(FLIGHT_PKG)
+    files["mem/bad.py"] = """
+        from pkg.obs.flight import record
+
+        MY_KIND = "mine"
+
+
+        def f():
+            record(MY_KIND, 1)
+    """
+    root = write_pkg(tmp_path, files)
+    fs = run(root, rules=["flight-discipline"])
+    assert len(fs) == 1 and "not a registered" in fs[0].message
+
+
+def test_flight_registered_constant_clean(tmp_path):
+    files = dict(FLIGHT_PKG)
+    files["mem/good.py"] = """
+        from pkg.obs import flight
+        from pkg.obs.flight import EV_RETRY, record
+
+
+        def f():
+            record(EV_RETRY, 1, detail="x")
+            flight.record(flight.EV_TASK_BLOCKED, 2)
+            flight.anomaly("deadlock_broken")  # reasons are free-form
+    """
+    root = write_pkg(tmp_path, files)
+    assert run(root, rules=["flight-discipline"]) == []
+
+
+def test_flight_suppression_honored(tmp_path):
+    files = dict(FLIGHT_PKG)
+    files["mem/sup.py"] = """
+        from pkg.obs.flight import record
+
+
+        def f():
+            record("raw", 1)  # analyze: ignore[flight-discipline]
+    """
+    root = write_pkg(tmp_path, files)
+    assert run(root, rules=["flight-discipline"]) == []
+
+
 # ------------------------------------------------- suppressions + baseline
 
 

@@ -1,0 +1,214 @@
+"""Flight-recorder chaos tier: the ISSUE's acceptance criteria.
+
+A forced deadlock-break and a forced queue saturation must each produce an
+anomaly dump whose reconstructed per-task timeline contains the complete
+blocked->woken/killed transition history for every involved task — the
+post-incident question ("which task was blocked on what, and what woke
+it") answered from the always-on ring, with no pre-armed log.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from spark_rapids_jni_tpu import config
+from spark_rapids_jni_tpu.mem import (
+    BudgetedResource,
+    GpuRetryOOM,
+    GpuSplitAndRetryOOM,
+    MemoryGovernor,
+    task_context,
+)
+from spark_rapids_jni_tpu.obs import flight
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import flightdump  # noqa: E402
+
+OOMS = (GpuRetryOOM, GpuSplitAndRetryOOM)
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    flight.recorder().reset_for_tests()
+    yield
+    flight.recorder().reset_for_tests()
+
+
+@pytest.fixture
+def gov():
+    g = MemoryGovernor(watchdog_period_s=0.02)
+    yield g
+    g.close()
+
+
+def test_deadlock_break_produces_complete_anomaly_dump(gov, tmp_path):
+    """Acceptance: a watchdog-broken deadlock auto-dumps, and the dump's
+    reconstructed timeline for the victim task holds its full
+    blocked->woken history up to and including the break verdict."""
+    budget = BudgetedResource(gov, limit_bytes=10)
+
+    with config.override(flight_dump_dir=str(tmp_path)):
+
+        def task():
+            with task_context(gov, 7):
+                with pytest.raises(OOMS):
+                    budget.acquire(50)  # can never fit: watchdog breaks it
+
+        t = threading.Thread(target=task)
+        t.start()
+        t.join(timeout=15)
+        assert not t.is_alive()
+
+    rec = flight.recorder()
+    assert rec.dump_count >= 1
+    dump = next(d for d in rec.dumps if d["reason"] == "deadlock_broken")
+    # the artifact landed on disk and carries the telemetry snapshot
+    assert os.path.exists(dump["artifact"])
+    assert "governor" in dump["telemetry"]
+    assert dump["telemetry"]["governor"]["device_bytes_limit"] >= 10
+
+    tasks = flightdump.reconstruct(dump)
+    tl = tasks[7]
+    kinds = [e["kind"] for e in tl]
+    # complete transition history: admitted, every blocked window closed,
+    # and the break verdict present — dumped from the victim's own thread
+    assert kinds[0] == "admitted"
+    assert "blocked" in kinds and "woken" in kinds
+    assert "deadlock_verdict" in kinds
+    assert kinds.index("blocked") < kinds.index("deadlock_verdict")
+    assert flightdump.timeline_complete(tl)
+    woken = [e for e in tl if e["kind"] == "woken"]
+    assert any(e["value"] > 0 for e in woken)  # a measured wait
+    assert dump["tasks"]["7"]["blocked_ns"] > 0
+
+
+def test_two_task_deadlock_history_is_complete_for_every_task(gov):
+    """Two tasks hold-and-wait on one budget until the arbiter escalates;
+    afterwards the ring holds a complete blocked->woken history for BOTH
+    involved tasks (every park closed by a woken or a verdict)."""
+    budget = BudgetedResource(gov, limit_bytes=100)
+    barrier = threading.Barrier(2)
+
+    def run_task(task_id):
+        with task_context(gov, task_id):
+            budget.acquire(40)
+            barrier.wait()
+            try:
+                try:
+                    budget.acquire(50)  # 20 left: both park -> deadlock
+                    budget.release(50)
+                except GpuRetryOOM:
+                    with pytest.raises(OOMS):
+                        gov.block_thread_until_ready()
+                        budget.acquire(50)  # retry once after rollback
+                        budget.release(50)
+            except GpuSplitAndRetryOOM:
+                pass
+            finally:
+                budget.release(40)
+
+    threads = [threading.Thread(target=run_task, args=(i,)) for i in (1, 2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive(), "deadlock was never broken"
+
+    # at least one break verdict fired and was dumped
+    assert flight.recorder().dump_count >= 1
+    evs = flight.snapshot()
+    assert any(e["kind"] == "deadlock_verdict" for e in evs)
+    for task_id in (1, 2):
+        tl = [e for e in evs if e["task_id"] == task_id]
+        assert any(e["kind"] == "blocked" for e in tl), task_id
+        assert flightdump.timeline_complete(tl), (task_id, tl)
+
+
+def test_queue_saturation_produces_anomaly_dump(gov, tmp_path):
+    """Acceptance: sustained backpressure rejections trigger a
+    queue_saturation dump whose timeline is complete for every involved
+    task (rejected requests never opened a blocked window; admitted ones
+    closed theirs)."""
+    from spark_rapids_jni_tpu.serve import (
+        Backpressure,
+        QueryHandler,
+        ServingEngine,
+    )
+
+    budget = BudgetedResource(gov, limit_bytes=1 << 20)
+    release = threading.Event()
+    with config.override(flight_dump_dir=str(tmp_path),
+                         flight_saturation_rejects=3):
+        eng = ServingEngine(gov=gov, budget=budget, workers=1, queue_size=2,
+                            default_deadline_s=60.0)
+        try:
+            eng.register(QueryHandler(
+                name="slow", fn=lambda p, ctx: release.wait(30) and p,
+                nbytes_of=lambda p: 64))
+            s = eng.open_session()
+            held = []  # fill the worker + the queue; rejects count toward
+            rejects = 0  # the saturation threshold from the first one
+            deadline = time.monotonic() + 30
+            while (flight.recorder().dump_count == 0
+                   and time.monotonic() < deadline):
+                try:
+                    held.append(eng.submit(s, "slow", len(held)))
+                except Backpressure:
+                    rejects += 1
+            assert rejects >= 3, "queue never saturated"
+            release.set()
+            for r in held:
+                r.result(timeout=60)
+        finally:
+            release.set()
+            eng.shutdown()
+
+    rec = flight.recorder()
+    dump = next(d for d in rec.dumps if d["reason"] == "queue_saturation")
+    assert os.path.exists(dump["artifact"])
+    kinds = [e["kind"] for e in dump["events"]]
+    assert kinds.count("queue_reject") >= 3
+    tasks = flightdump.reconstruct(dump)
+    for task_id, tl in tasks.items():
+        assert flightdump.timeline_complete(tl), (task_id, tl)
+    # the unified snapshot carries the engine's serving metrics
+    serve_keys = [k for k in dump["telemetry"] if k.startswith("serve:")]
+    assert serve_keys
+    snap = dump["telemetry"][serve_keys[0]]
+    assert snap["counters"]["rejected_full"] >= 3
+    assert "gauges" in snap
+
+
+def test_oom_killed_request_dumps_and_marks_task(gov, tmp_path):
+    """A request whose working set can never fit dies as OOM-killed: the
+    task gets an EV_TASK_KILLED event and a task_oom_killed dump."""
+    from spark_rapids_jni_tpu.serve import QueryHandler, ServingEngine
+
+    budget = BudgetedResource(gov, limit_bytes=1000)
+    with config.override(flight_dump_dir=str(tmp_path)):
+        eng = ServingEngine(gov=gov, budget=budget, workers=1, queue_size=4,
+                            default_deadline_s=60.0)
+        try:
+            eng.register(QueryHandler(name="fat", fn=lambda p, ctx: p,
+                                      nbytes_of=lambda p: 1 << 20))
+            s = eng.open_session()
+            r = eng.submit(s, "fat", 1)
+            # unsplittable over-budget request: the protocol's terminal
+            # answer is an OOM-flavored MemoryError (arbiter escalation)
+            with pytest.raises(MemoryError):
+                r.result(timeout=60)
+        finally:
+            eng.shutdown()
+
+    dump = next(d for d in flight.recorder().dumps
+                if d["reason"] == "task_oom_killed")
+    killed = [e for e in dump["events"] if e["kind"] == "task_killed"]
+    assert killed and killed[0]["detail"] in (
+        "OutOfBudget", "GpuRetryOOM", "GpuSplitAndRetryOOM", "MemoryError")
+    tl = flightdump.reconstruct(dump)[killed[0]["task_id"]]
+    assert flightdump.timeline_complete(tl)

@@ -1,0 +1,136 @@
+"""Pretty-print a governance flight-recorder anomaly dump.
+
+Reads the JSON artifact the flight recorder writes on anomaly
+(obs/flight.py, ``flight_dump_dir`` config flag) and reconstructs the
+per-task timeline: for every task involved in the incident, the ordered
+admitted / blocked / woken / retry / split / spilled / killed history with
+relative timestamps, plus the unified telemetry snapshot — the post-mortem
+view the reference only gets by pre-arming the adaptor's CSV log.
+
+Usage::
+
+    python tools/flightdump.py flight_deadlock_broken_1234_1.json
+    python tools/flightdump.py dump.json --task 7
+    python tools/flightdump.py dump.json --json   # reconstructed, machine-readable
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+# event kinds that terminate a blocked window for completeness checking
+_CLOSERS = ("woken", "task_killed", "deadlock_verdict")
+
+
+def reconstruct(dump: dict) -> Dict[int, List[dict]]:
+    """Group the dump's events into per-task ordered timelines.
+
+    Events with no task (task_id < 0, e.g. anomaly markers) group under
+    task -1.  Within a task, events keep capture order (the ring is
+    append-ordered; ties on t_ns preserve emission order).
+    """
+    tasks: Dict[int, List[dict]] = {}
+    for e in dump.get("events", []):
+        tasks.setdefault(int(e.get("task_id", -1)), []).append(e)
+    for evs in tasks.values():
+        evs.sort(key=lambda e: e.get("t_ns", 0))
+    return tasks
+
+
+def timeline_complete(events: List[dict]) -> bool:
+    """True when every blocked event is closed by a later woken / killed /
+    verdict event — the "complete blocked->woken/killed transition
+    history" property anomaly dumps must satisfy for involved tasks."""
+    open_blocks = 0
+    for e in events:
+        k = e.get("kind")
+        if k == "blocked":
+            open_blocks += 1
+        elif k in _CLOSERS and open_blocks > 0:
+            open_blocks -= 1
+    return open_blocks == 0
+
+
+def _fmt_value(e: dict) -> str:
+    k, v = e.get("kind"), int(e.get("value", 0))
+    if v <= 0:
+        return ""
+    if k in ("woken", "spill_end"):
+        return f" [{v / 1e6:.3f} ms]"
+    if k == "spill_begin":
+        return f" [{v} B]"
+    return f" [{v}]"
+
+
+def format_dump(dump: dict, task: int | None = None) -> str:
+    """Human-readable reconstruction of one dump."""
+    out = [
+        f"flight dump: reason={dump.get('reason')!r} "
+        f"detail={dump.get('detail')!r}",
+        f"  events={len(dump.get('events', []))} "
+        f"schema={dump.get('schema')}",
+    ]
+    tasks = reconstruct(dump)
+    t0 = min((e.get("t_ns", 0) for evs in tasks.values() for e in evs),
+             default=0)
+    for task_id in sorted(tasks):
+        if task is not None and task_id != task:
+            continue
+        evs = tasks[task_id]
+        label = f"task {task_id}" if task_id >= 0 else "(untasked)"
+        stats = dump.get("tasks", {}).get(str(task_id))
+        suffix = ""
+        if stats:
+            suffix = (f"  [retries={stats.get('retries', 0)} "
+                      f"splits={stats.get('split_retries', 0)} "
+                      f"blocked={stats.get('blocked_ns', 0) / 1e6:.3f} ms]")
+        complete = timeline_complete(evs)
+        out.append(f"\n{label}{suffix}"
+                   f"{'' if complete else '  [OPEN BLOCKED WINDOW]'}")
+        for e in evs:
+            dt_ms = (e.get("t_ns", 0) - t0) / 1e6
+            detail = e.get("detail", "")
+            out.append(f"  +{dt_ms:10.3f} ms  {e.get('kind'):<17}"
+                       f"{detail}{_fmt_value(e)}")
+    tele = dump.get("telemetry", {})
+    if tele and task is None:
+        out.append("\ntelemetry snapshot:")
+        for name in sorted(tele):
+            out.append(f"  {name}: {json.dumps(tele[name], sort_keys=True)}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Reconstruct per-task timelines from a flight-recorder "
+                    "anomaly dump")
+    ap.add_argument("dump", help="JSON artifact written on anomaly "
+                                 "(flight_dump_dir config flag)")
+    ap.add_argument("--task", type=int, default=None,
+                    help="show only this task's timeline")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the reconstructed per-task timelines as JSON")
+    args = ap.parse_args(argv)
+
+    with open(args.dump) as f:
+        dump = json.load(f)
+    if dump.get("schema") != "srt-flight-dump-v1":
+        print(f"warning: unknown dump schema {dump.get('schema')!r}",
+              file=sys.stderr)
+    if args.json:
+        tasks = reconstruct(dump)
+        json.dump({str(t): {"events": evs,
+                            "complete": timeline_complete(evs)}
+                   for t, evs in tasks.items()},
+                  sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        print(format_dump(dump, task=args.task))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
