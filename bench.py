@@ -646,6 +646,28 @@ def main(argv=None):
            nbytes=min(n, 1 << 18) * 110 * 30 * 2)
     _json_cache.clear()
 
+    def _plan_cache_span():
+        """Delta snapshot of the plan cache around a timed region: the
+        per-stage ``phases_s`` (trace/compile/execute split) and
+        ``plan_cache`` (hit/miss) sections — the compile-amortization
+        story the trajectory point watches."""
+        from spark_rapids_jni_tpu.plans import plan_cache
+
+        before = plan_cache.stats()
+
+        def close():
+            after = plan_cache.stats()
+            phases = {
+                "trace": round(after["trace_s"] - before["trace_s"], 3),
+                "compile": round(after["compile_s"] - before["compile_s"], 3),
+                "execute": round(after["execute_s"] - before["execute_s"], 3),
+            }
+            cache = {"hits": int(after["hits"] - before["hits"]),
+                     "misses": int(after["misses"] - before["misses"])}
+            return phases, cache
+
+        return close
+
     def _q5():
         from spark_rapids_jni_tpu.models import generate_q5_data, q5_local
 
@@ -654,9 +676,12 @@ def main(argv=None):
         rows_total = sum(
             len(data.channels[c].sales_sk) + len(data.channels[c].ret_sk)
             for c in data.channels)
+        span = _plan_cache_span()
         dt = _time(lambda: tuple(q5_local(data)), max(iters // 8, 2))
+        phases, cache = span()
         return {"Mrows_per_s": round(rows_total / dt / 1e6, 2),
-                "fact_rows": rows_total}
+                "fact_rows": rows_total,
+                "phases_s": phases, "plan_cache": cache}
 
     _stage(detail, "q5_rollup", _q5, nbytes=int(min(n, 1 << 22) * 8))
 
@@ -666,11 +691,20 @@ def main(argv=None):
         sf = min(1.0, max(0.05, n / (1 << 24)))
         data = generate_q3_data(sf=sf, seed=42)
         rows_total = len(data.ss_item_sk)
+        span = _plan_cache_span()
         dt = _time(lambda: tuple(q3_local(data)), max(iters // 8, 2))
+        phases, cache = span()
         return {"Mrows_per_s": round(rows_total / dt / 1e6, 2),
-                "fact_rows": rows_total}
+                "fact_rows": rows_total,
+                "phases_s": phases, "plan_cache": cache}
 
     _stage(detail, "q3_star_join", _q3, nbytes=int(min(n, 1 << 22) * 8))
+
+    # cumulative plan-cache gauges across every plan-compiled stage: a
+    # second same-shape execution must be a hit (hits > 0, misses stable)
+    from spark_rapids_jni_tpu.plans import plan_cache as _plan_cache
+
+    detail["plan_cache"] = _plan_cache.stats()
 
     gov.task_done(0)
     MemoryGovernor.shutdown()

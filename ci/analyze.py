@@ -191,12 +191,13 @@ class Config:
     lock_scope: Tuple[str, ...] = ("mem.", "mem", "serve.", "serve")
     state_scope: Tuple[str, ...] = ("mem.", "mem", "serve.", "serve")
     governed_scope: Tuple[str, ...] = ("ops.", "ops", "models.", "models",
-                                       "serve.", "serve")
+                                       "serve.", "serve", "plans.", "plans")
     seam_exclude: Tuple[str, ...] = ("obs.seam",)
     governed_drivers: Tuple[str, ...] = ("attempt_once",
                                          "run_with_split_retry", "_attempt")
     handler_classes: Tuple[str, ...] = ("QueryHandler",)
     reservation_funcs: Tuple[str, ...] = ("reservation",)
+    emitter_decorators: Tuple[str, ...] = ("emitter",)
     categories: Optional[Set[str]] = None  # None -> parse obs/seam.py
     flight_exclude: Tuple[str, ...] = ("obs.flight",)
     event_kinds: Optional[Set[str]] = None  # None -> parse obs/flight.py
@@ -1318,6 +1319,28 @@ def check_governed_allocation(project: Project,
     #    under `with reservation(...)`
     governed: Set[int] = set()
     reservation_stmts: List[tuple] = []  # (mod, With node)
+
+    # plan-compiled roots: @emitter(Node)-decorated functions
+    # (plans/compiler.py) are the fused program's traced device code —
+    # their allocations materialize at the governed plan launch, not at
+    # trace time: the same seeding rule as `with seam(COMPILE)` bodies
+    # and jit/shard_map callback arguments.  Seeds, not baseline entries:
+    # new emitters are covered automatically, with no grandfathering.
+    for fid, (mod, node, _qual) in funcs.items():
+        for dec in getattr(node, "decorator_list", ()):
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            dec_name = None
+            if isinstance(target, (ast.Name, ast.Attribute)):
+                r = project.resolve(mod, target)
+                if r and r[0] == "func":
+                    dec_name = r[1].rsplit(".", 1)[-1]
+            if dec_name is None:
+                if isinstance(target, ast.Name):
+                    dec_name = target.id
+                elif isinstance(target, ast.Attribute):
+                    dec_name = target.attr
+            if dec_name in config.emitter_decorators:
+                governed.add(fid)
 
     for mod in project.modules.values():
         # local name -> nested funcdef id, per enclosing function

@@ -228,6 +228,12 @@ register("flight_dump_dir", "",
          "pretty-printed by tools/flightdump.py).  Empty (default) keeps "
          "dumps in memory only (FlightRecorder.dumps).",
          env="SRT_FLIGHT_DUMP_DIR")
+register("plan_cache_size", 64,
+         "Resident compiled-plan variants in the process-global plan "
+         "cache (plans/cache.py), LRU-evicted past this.  Variants are "
+         "keyed on (plan structure, dtype signature, pow2 batch bucket), "
+         "so a long-lived executor holds O(log rows) entries per query "
+         "geometry.", env="SRT_PLAN_CACHE_SIZE")
 register("flight_saturation_rejects", 8,
          "Consecutive backpressure rejections (no successful submit in "
          "between) that count as queue saturation and trigger a flight-"

@@ -18,6 +18,17 @@ the data axis — no row exchange, same as q5's partials.
 
 Money stays unscaled int64 cents (decimal scale 2) end to end; brand
 STRINGS materialize only in the host-formatted result rows.
+
+Since round 6 the int64 path is ONE compiled plan (:func:`q3_plan`,
+plans/ir.py): both gathers, the filter and the grouped segment-sum trace
+into a single jitted program cached on (plan structure, dtype signature,
+pow2 batch bucket), and the governed runner admits the whole plan as one
+working set (SplitAndRetryOOM re-executes the fused program on fact
+halves — exact, sums/counts are additive).  The pre-plan eager per-op
+path survives as :func:`q3_local_unfused`, the bit-parity oracle
+tests/test_plans.py pins the fused program against.  The decimal-columns
+variant keeps its own fused step (Column pytrees are outside the scalar
+plan IR).
 """
 
 from __future__ import annotations
@@ -34,8 +45,11 @@ from jax.sharding import PartitionSpec as P
 
 from spark_rapids_jni_tpu.models.tpcds import Q3Data
 from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS, shard_map
+from spark_rapids_jni_tpu.plans import ir
+from spark_rapids_jni_tpu.plans.ir import Bin, Cast, band_all, col, lit
 
-__all__ = ["Q3Row", "q3_local", "make_distributed_q3", "run_distributed_q3",
+__all__ = ["Q3Row", "q3_local", "q3_local_unfused", "q3_plan",
+           "make_distributed_q3", "run_distributed_q3",
            "run_distributed_q3_columns", "q3_columns_host_oracle",
            "q3_working_set_bytes"]
 
@@ -68,8 +82,13 @@ def _partials(ss_item, ss_item_v, ss_date, ss_date_v, price,
     year_off = (date_year[d_idx] - year0).astype(jnp.int32)
     group = jnp.clip(year_off, 0, n_years - 1) * n_brands + (brand - 1)
     ngroups = n_years * n_brands
+    # analyze: ignore[governed-allocation] - per-op ORACLE path: since the
+    # plan port this body runs only eagerly under q3_local_unfused, the
+    # bit-parity reference the fused (governed) program is checked against
+    # in tests; group-grid partials are tiny and test-scoped by design
     sums = jnp.zeros((ngroups,), jnp.int64).at[group].add(
         jnp.where(ok, price, 0), mode="drop")
+    # analyze: ignore[governed-allocation] - same oracle-path rationale
     counts = jnp.zeros((ngroups,), jnp.int32).at[group].add(
         jnp.where(ok, 1, 0), mode="drop")
     return _Partials(sums, counts)
@@ -120,6 +139,58 @@ def _facts(data: Q3Data) -> dict:
     )
 
 
+# ------------------------------------------------------------------ the plan
+
+
+@functools.lru_cache(maxsize=64)
+def q3_plan(*, n_brands: int, year0: int, n_years: int, date_sk0: int,
+            manufact_id: int, moy: int) -> ir.Plan:
+    """The whole q3 device pipeline as ONE plan: scan -> item gather ->
+    date gather -> manufact/moy filter -> grouped segment-sum into the
+    dense [n_years * n_brands] grid.  Geometry scalars normalize through
+    ``plans.ir.lit`` so equal geometry always builds an EQUAL plan (one
+    cache entry on the process-global plan cache).  Memoized per
+    geometry: the per-request hot path must not rebuild (and re-hash)
+    the plan tree every call."""
+    item = ir.Dim("item", ("brand", "manufact"))
+    date = ir.Dim("date_dim", ("year", "moy"))
+    node: ir.Node = ir.Scan(
+        "store_sales", ("ss_item", "ss_item_v", "ss_date", "ss_date_v",
+                        "price"))
+    node = ir.GatherJoin(node, item, key=col("ss_item"), base=lit(1),
+                         fields=(("brand", "brand"),
+                                 ("manufact", "manufact")))
+    node = ir.GatherJoin(node, date, key=col("ss_date"), base=lit(date_sk0),
+                         fields=(("year", "year"), ("moy", "moy")))
+    node = ir.Filter(node, band_all(
+        col("ss_item_v"), col("ss_date_v"),
+        Bin("eq", col("manufact"), lit(manufact_id)),
+        Bin("eq", col("moy"), lit(moy)),
+    ))
+    # group = clip(year - year0, 0, n_years-1) * n_brands + (brand - 1),
+    # exactly the per-op body's grid arithmetic (brand is 1-based)
+    year_off = Cast(Bin("sub", col("year"), lit(year0)), "int32")
+    clipped = Bin("min", Bin("max", year_off, lit(0)), lit(n_years - 1))
+    group = Bin("add", Bin("mul", clipped, lit(n_brands)),
+                Bin("sub", Cast(col("brand"), "int32"), lit(1)))
+    node = ir.Project(node, (("group", group),))
+    sink = ir.SegmentAgg(
+        node, key=col("group"), num_segments=n_years * n_brands,
+        aggs=(("sums", col("price"), "int64"),
+              ("counts", lit(1), "int32")))
+    return ir.Plan("q3", (sink,))
+
+
+def _q3_tables(facts: dict, dims: dict) -> dict:
+    """The plan's input tables from the fact/dim array dicts."""
+    return {
+        "store_sales": dict(facts),
+        "item": {"brand": dims["item_brand"],
+                 "manufact": dims["item_manufact"]},
+        "date_dim": {"year": dims["date_year"], "moy": dims["date_moy"]},
+    }
+
+
 def _dims(data: Q3Data) -> dict:
     # raw numpy: q3_local's jnp ops take them directly, and
     # run_distributed_q3 device_puts them with a replicated sharding
@@ -132,8 +203,9 @@ def _dims(data: Q3Data) -> dict:
     )
 
 
-def q3_local(data: Q3Data) -> List[Q3Row]:
-    """Single-chip q3."""
+def q3_local_unfused(data: Q3Data) -> List[Q3Row]:
+    """Per-op eager q3 (the pre-plan shape): one device dispatch per op.
+    The plan path's bit-parity oracle."""
     geo = _geometry(data)
     parts = _partials(
         *(jnp.asarray(v) for v in _facts(data).values()),
@@ -141,35 +213,33 @@ def q3_local(data: Q3Data) -> List[Q3Row]:
     return _format(parts, data, geo["year0"])
 
 
+def q3_local(data: Q3Data) -> List[Q3Row]:
+    """Single-chip q3 through the compiled plan: gathers, filter and
+    grouped sum are ONE jitted program (cached across calls on the pow2
+    bucket lattice), then host formatting."""
+    from spark_rapids_jni_tpu.plans.runtime import execute_plan
+
+    geo = _geometry(data)
+    plan = q3_plan(**geo)
+    outputs = execute_plan(None, plan, _q3_tables(_facts(data), _dims(data)))
+    return _format(_Partials(outputs["sums"], outputs["counts"]),
+                   data, geo["year0"])
+
+
 def make_distributed_q3(mesh, data: Q3Data):
-    """jit-compiled distributed q3 partials: facts sharded over DATA_AXIS,
-    dims replicated, group grid psum'd (the q5 partials pattern).
+    """Compiled distributed q3 plan over ``mesh``'s data axis.
 
-    LRU-cached on (mesh, geometry) like q97/q5: one traced program per
-    geometry, not a fresh jit wrapper per call (soak-tool finding)."""
-    return _q3_step_cached(mesh, tuple(sorted(_geometry(data).items())))
+    Returns the :class:`plans.cache.CompiledPlan` for ``data``'s geometry
+    and batch bucket — facts sharded over DATA_AXIS, dims replicated,
+    the group grid psum'd.  Same-geometry data returns the IDENTICAL
+    cached object (plan-cache identity, replacing the per-module lru
+    step cache) with O(1) host work on a hit — the key derives from
+    lengths and dtypes, never a padded dataset copy."""
+    from spark_rapids_jni_tpu.plans.runtime import compiled_plan_for
 
-
-@functools.lru_cache(maxsize=32)
-def _q3_step_cached(mesh, geo_items: tuple):
-    from spark_rapids_jni_tpu.obs.seam import COMPILE, seam
-
-    geo = dict(geo_items)
-    with seam(COMPILE, "q3_step"):
-        def body(ss_item, ss_item_v, ss_date, ss_date_v, price,
-                 item_brand, item_manufact, date_year, date_moy):
-            p = _partials(ss_item, ss_item_v, ss_date, ss_date_v, price,
-                          item_brand, item_manufact, date_year, date_moy,
-                          **geo)
-            return _Partials(*(jax.lax.psum(x, (DATA_AXIS,)) for x in p))
-
-        step = shard_map(
-            body, mesh=mesh,
-            in_specs=(P(DATA_AXIS),) * 5 + (P(),) * 4,
-            out_specs=_Partials(P(), P()),
-            check_vma=False,
-        )
-        return jax.jit(step)
+    plan = q3_plan(**_geometry(data))
+    return compiled_plan_for(plan, mesh, _q3_tables(_facts(data),
+                                                    _dims(data)))
 
 
 def _pad_facts(facts: dict, dp: int) -> dict:
@@ -190,10 +260,15 @@ def _pad_facts(facts: dict, dp: int) -> dict:
 
 def q3_working_set_bytes(facts_or_data, dp: int = 1) -> int:
     """Reserved bytes for one governed q3 attempt over the given facts
-    (inputs + masks/buckets + partials headroom) — the single source of
-    truth for run_distributed_q3's admission and for tests sizing
-    budgets.  With ``dp``, row counts are the quantized (padded) lengths
-    run() actually uploads."""
+    (inputs + masks/buckets + partials headroom): the admission size for
+    the decimal-columns runner, and what tests size budgets from.  The
+    plan-compiled runner admits via ``plans.runtime
+    .plan_working_set_bytes``, which applies the SAME quantized-bytes x3
+    margin to the plan's scan tables — numerically equal here, pinned by
+    test_plans.test_q3_admission_formulas_agree so budget-sizing tests
+    can't silently desynchronize from the runner's real admission.  With
+    ``dp``, row counts are the quantized (padded) lengths run() actually
+    uploads."""
     from spark_rapids_jni_tpu.parallel.shuffle import quantized_rows
 
     facts = (facts_or_data if isinstance(facts_or_data, dict)
@@ -210,58 +285,21 @@ def _split_facts(facts: dict):
 
 def run_distributed_q3(mesh, data: Q3Data, *, budget=None, task_id: int = 0,
                        manage_task: bool = True) -> List[Q3Row]:
-    """Governed distributed q3: launches admitted through the memory
-    arbiter; SplitAndRetryOOM halves fact rows (exact: sums/counts are
-    additive) and partials combine by addition."""
-    import contextlib
-
-    from spark_rapids_jni_tpu.mem.governed import (
-        default_device_budget,
-        run_with_split_retry,
-        task_context,
-    )
-
-    from jax.sharding import NamedSharding
+    """Governed distributed q3 through the compiled plan: ONE admission
+    for the fused working set, RetryOOM re-runs the fused program,
+    SplitAndRetryOOM halves fact rows and re-executes the fused program
+    per half (exact: sums/counts are additive), one flight-recorder task
+    spans the plan."""
+    from spark_rapids_jni_tpu.plans.runtime import run_governed_plan
 
     geo = _geometry(data)
-    dp = mesh.shape[DATA_AXIS]
-    step = make_distributed_q3(mesh, data)  # LRU-cached; COMPILE seam inside
-    sharding = NamedSharding(mesh, P(DATA_AXIS))
-    rep = NamedSharding(mesh, P())
-    # analyze: ignore[governed-allocation] - small replicated dimension
-    # tables uploaded ONCE and shared by every piece; uploading them inside
-    # the bracket would re-pay the transfer per split retry.  Their bytes
-    # are covered by nbytes_of's working-set margin.
-    dims = {k: jax.device_put(v, rep) for k, v in _dims(data).items()}
-
-    def nbytes_of(f):
-        return q3_working_set_bytes(f, dp)
-
-    def run(facts):
-        from spark_rapids_jni_tpu.obs.seam import COLLECTIVE, TRANSFER, seam
-
-        padded = _pad_facts(facts, dp)
-        with seam(TRANSFER, "q3_batch_upload"):
-            dev = [jax.device_put(np.ascontiguousarray(v), sharding)
-                   for v in padded.values()]
-        with seam(COLLECTIVE, "launch:q3_step"):
-            out = step(*dev, *dims.values())
-            jax.block_until_ready(out)  # async dispatch: keep the
-            # execution inside the launch range, as q5/q97 do
-        return _Partials(*(np.asarray(x) for x in out))
-
-    def combine(results):
-        return _Partials(*(sum(r[i] for r in results)
-                           for i in range(len(results[0]))))
-
-    budget = budget if budget is not None else default_device_budget()
-    ctx = (task_context(budget.gov, task_id) if manage_task
-           else contextlib.nullcontext())
-    with ctx:
-        parts = run_with_split_retry(
-            budget, _facts(data), nbytes_of=nbytes_of, run=run,
-            split=_split_facts, combine=combine)
-    return _format(parts, data, geo["year0"])
+    plan = q3_plan(**geo)
+    outputs = run_governed_plan(
+        mesh, plan, _q3_tables(_facts(data), _dims(data)),
+        budget=budget, task_id=task_id, manage_task=manage_task,
+    )
+    return _format(_Partials(outputs["sums"], outputs["counts"]),
+                   data, geo["year0"])
 
 
 # ----------------------------------------------------------- columns variant

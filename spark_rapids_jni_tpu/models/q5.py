@@ -20,9 +20,19 @@ ROLLUP(channel, id).  The TPU-native plan per channel:
 5. **rollup** (host, tiny): (channel, id) rows -> channel totals -> grand
    total, with the string business ids attached from the dim table.
 
-The governed runner admits every launch through the memory arbiter and
-splits fact rows on SplitAndRetryOOM — row splits are exact here because
-every aggregate is additive.
+Since round 6 the whole device side is ONE compiled plan
+(:func:`q5_plan`, plans/ir.py): all six fact streams (3 channels x
+sales/returns), their window semi-joins and segment aggregations trace
+into a single jitted program, cached on (plan structure, dtype
+signature, pow2 batch bucket) in the process-global plan cache — the
+per-query ``_q5_step_cached`` lru (and its geometry-keying foot-gun: a
+fresh jit wrapper leaked per call when a key component didn't normalize,
+~3 MB RSS each, tools/soak.py) is gone.  The governed runner admits the
+whole plan as one working set and SplitAndRetryOOM re-executes the fused
+program on split halves — exact, because every aggregate is additive.
+
+The pre-plan eager per-op path survives as :func:`q5_local_unfused`, the
+bit-parity oracle tests/test_plans.py pins the fused program against.
 """
 
 from __future__ import annotations
@@ -33,14 +43,16 @@ from typing import Dict, List, NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from spark_rapids_jni_tpu.models.tpcds import CHANNELS, Q5Data
-from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS, shard_map
+from spark_rapids_jni_tpu.plans import ir
+from spark_rapids_jni_tpu.plans.ir import Bin, Cast, band_all, col, lit
 
 __all__ = [
     "Q5Row",
     "q5_local",
+    "q5_local_unfused",
+    "q5_plan",
     "make_distributed_q5",
     "run_distributed_q5",
     "run_q5_partials",
@@ -84,6 +96,96 @@ def add_partials(
             for name in a}
 
 
+# ------------------------------------------------------------------ the plan
+
+
+@functools.lru_cache(maxsize=64)
+def q5_plan(n_dims: Tuple[int, ...], lo: int, hi: int) -> ir.Plan:
+    """The whole q5 device pipeline as ONE plan: per channel, the sales
+    and returns streams each scan -> bounds/null filter -> date-window
+    semi-join -> masked segment aggregation; profit and count derive in
+    post over the psum'd partial vectors.
+
+    Geometry scalars are normalized to python ints here (via
+    ``plans.ir.lit``), so equal geometry always builds an EQUAL plan —
+    one cache entry, never a leaked fresh program per call (the
+    ``_q5_step_cached`` geometry-keying fix, pinned by
+    test_plans.test_compiled_step_identity_same_geometry and
+    test_lit_normalizes_numpy_scalars).
+    """
+    n_dims = tuple(int(n) for n in n_dims)
+    dim = ir.Dim("date_dim", ("sk", "days"))
+    sinks: list = []
+    post: list = []
+    outputs: list = []
+
+    for name, n_dim in zip(CHANNELS, n_dims):
+        for suffix, value_fields, aggs in (
+            ("sales", ("price", "profit"),
+             ((f"{name}_sales", col("price"), "int64"),
+              (f"{name}_profit_s", col("profit"), "int64"),
+              (f"{name}_count_s", lit(1), "int32"))),
+            ("ret", ("amt", "loss"),
+             ((f"{name}_returns", col("amt"), "int64"),
+              (f"{name}_loss", col("loss"), "int64"),
+              (f"{name}_count_r", lit(1), "int32"))),
+        ):
+            node: ir.Node = ir.Scan(
+                f"{name}_{suffix}",
+                ("sk", "sk_valid", "date", "date_valid") + value_fields)
+            node = ir.Filter(node, band_all(
+                col("sk_valid"),
+                Bin("ge", col("sk"), lit(1)),
+                Bin("le", col("sk"), lit(n_dim)),
+            ))
+            node = ir.SemiJoinWindow(
+                node, dim, key=col("date"), key_valid=col("date_valid"),
+                sk_field="sk", days_field="days", lo=lit(lo), hi=lit(hi))
+            sinks.append(ir.SegmentAgg(
+                node, key=Bin("sub", Cast(col("sk"), "int32"), lit(1)),
+                num_segments=n_dim, aggs=aggs))
+        post.append((f"{name}_profit",
+                     Bin("sub", col(f"{name}_profit_s"),
+                         col(f"{name}_loss"))))
+        post.append((f"{name}_count",
+                     Bin("add", col(f"{name}_count_s"),
+                         col(f"{name}_count_r"))))
+        outputs.extend([f"{name}_sales", f"{name}_returns",
+                        f"{name}_profit", f"{name}_count"])
+    return ir.Plan("q5", tuple(sinks), tuple(post), tuple(outputs))
+
+
+def _q5_tables(batch: Dict[str, Dict[str, np.ndarray]],
+               date_sk: np.ndarray, date_days: np.ndarray):
+    """The plan's input tables from a per-channel fact-array batch (the
+    ``_facts_of`` field names)."""
+    tables = {"date_dim": {"sk": np.asarray(date_sk),
+                           "days": np.asarray(date_days)}}
+    for name, facts in batch.items():
+        tables[f"{name}_sales"] = {
+            "sk": facts["sales_sk"], "sk_valid": facts["sales_sk_valid"],
+            "date": facts["sales_date"],
+            "date_valid": facts["sales_date_valid"],
+            "price": facts["sales_price"], "profit": facts["sales_profit"],
+        }
+        tables[f"{name}_ret"] = {
+            "sk": facts["ret_sk"], "sk_valid": facts["ret_sk_valid"],
+            "date": facts["ret_date"], "date_valid": facts["ret_date_valid"],
+            "amt": facts["ret_amt"], "loss": facts["ret_loss"],
+        }
+    return tables
+
+
+def _partials_of(outputs: Dict[str, np.ndarray]) -> Dict[str, _ChannelPartials]:
+    return {name: _ChannelPartials(
+        outputs[f"{name}_sales"], outputs[f"{name}_returns"],
+        outputs[f"{name}_profit"], outputs[f"{name}_count"])
+        for name in CHANNELS}
+
+
+# ------------------------------------------------------- unfused oracle path
+
+
 def _window_member(date, date_valid, dim_sk, dim_days, lo, hi):
     """Inner-join membership of fact date_sk in the filtered date dim."""
     idx = jnp.clip(jnp.searchsorted(dim_sk, date), 0, dim_sk.shape[0] - 1)
@@ -101,10 +203,11 @@ def _masked_segment(values, sk, ok, n_dim, dtype=jnp.int64):
 
 
 def _channel_partials(ch, n_dim, dim_sk, dim_days, lo, hi) -> _ChannelPartials:
-    """One shard's partial aggregates for one channel.
+    """One shard's partial aggregates for one channel, per-op eager form.
 
     ``ch`` is a dict of this channel's fact arrays (see models/tpcds.py
-    ChannelTables field names).
+    ChannelTables field names).  This is the pre-plan path, kept as the
+    fused program's bit-parity oracle.
     """
     s_ok = ch["sales_sk_valid"] & (ch["sales_sk"] >= 1) & (
         ch["sales_sk"] <= n_dim
@@ -120,8 +223,13 @@ def _channel_partials(ch, n_dim, dim_sk, dim_days, lo, hi) -> _ChannelPartials:
     returns_ = _masked_segment(ch["ret_amt"], ch["ret_sk"], r_ok, n_dim)
     loss = _masked_segment(ch["ret_loss"], ch["ret_sk"], r_ok, n_dim)
     count = (
+        # analyze: ignore[governed-allocation] - per-op ORACLE path: since
+        # the plan port this body runs only eagerly under q5_local_unfused,
+        # the bit-parity reference the fused (governed) program is checked
+        # against in tests; the ones masks are fact-row-sized, test-scoped
         _masked_segment(jnp.ones_like(ch["sales_sk"]), ch["sales_sk"],
                         s_ok, n_dim, jnp.int32)
+        # analyze: ignore[governed-allocation] - same oracle-path rationale
         + _masked_segment(jnp.ones_like(ch["ret_sk"]), ch["ret_sk"],
                           r_ok, n_dim, jnp.int32)
     )
@@ -145,8 +253,9 @@ def _facts_of(ch_tables) -> Dict[str, np.ndarray]:
     }
 
 
-def q5_local(data: Q5Data) -> List[Q5Row]:
-    """Single-chip q5: per-channel partials + host rollup."""
+def q5_local_unfused(data: Q5Data) -> List[Q5Row]:
+    """Per-op eager q5 (the pre-plan shape): one device dispatch per op,
+    partials per channel, host rollup.  The plan path's oracle."""
     dim_sk = jnp.asarray(data.date_sk)
     dim_days = jnp.asarray(data.date_days)
     per_channel = {}
@@ -159,6 +268,21 @@ def q5_local(data: Q5Data) -> List[Q5Row]:
         )
         per_channel[name] = jax.tree.map(np.asarray, parts)
     return q5_rollup(per_channel,
+                     {n: data.channels[n].dim_id for n in CHANNELS})
+
+
+def q5_local(data: Q5Data) -> List[Q5Row]:
+    """Single-chip q5 through the compiled plan: the whole six-stream
+    pipeline is ONE jitted program (cached across calls on the pow2
+    bucket lattice), then the host rollup."""
+    from spark_rapids_jni_tpu.plans.runtime import execute_plan
+
+    n_dims = tuple(len(data.channels[n].dim_sk) for n in CHANNELS)
+    plan = q5_plan(n_dims, data.sales_date_lo, data.sales_date_hi)
+    tables = _q5_tables({n: _facts_of(data.channels[n]) for n in CHANNELS},
+                        data.date_sk, data.date_days)
+    outputs = execute_plan(None, plan, tables)
+    return q5_rollup(_partials_of(outputs),
                      {n: data.channels[n].dim_id for n in CHANNELS})
 
 
@@ -194,92 +318,24 @@ def q5_rollup(per_channel: Dict[str, _ChannelPartials],
 # ------------------------------------------------------------- distributed --
 
 
-def _sharded_q5(channel_facts, dim_sk, dim_days, n_dims: Tuple[int, ...],
-                lo: int, hi: int):
-    """Per-device body: partials for all three channels, psum'd."""
-    out = []
-    for name, n_dim in zip(CHANNELS, n_dims):
-        p = _channel_partials(channel_facts[name], n_dim, dim_sk, dim_days,
-                              lo, hi)
-        out.append(_ChannelPartials(*(
-            jax.lax.psum(x, (DATA_AXIS,)) for x in p
-        )))
-    return tuple(out)
-
-
 def make_distributed_q5(mesh, data: Q5Data):
-    """jit-compiled distributed q5 partials over ``mesh``'s data axis.
+    """Compiled distributed q5 plan over ``mesh``'s data axis.
 
-    Facts are sharded over DATA_AXIS; the date dim is replicated.  Returns
-    a function of the sharded channel-fact pytree producing replicated
-    per-channel partial vectors (feed to :func:`q5_rollup`).
-
-    The step depends on ``data`` only through small scalars, so it is
-    LRU-cached like q97's: an executor looping over many batches of one
-    geometry must reuse ONE traced program, not leak a fresh jit wrapper
-    (and its compiled-executable cache entry) per call — the soak tool
-    caught exactly that as ~3 MB RSS per iteration (tools/soak.py).
+    Returns the :class:`plans.cache.CompiledPlan` for ``data``'s geometry
+    and batch bucket — facts sharded over DATA_AXIS, the date dim
+    replicated, partial vectors psum'd.  Same-geometry data returns the
+    IDENTICAL cached object (plan-cache identity; the leak-proof
+    replacement for the old per-module lru step cache) with O(1) host
+    work on a hit: the cache key derives from lengths and dtypes alone,
+    never a padded copy of the dataset.
     """
+    from spark_rapids_jni_tpu.plans.runtime import compiled_plan_for
+
     n_dims = tuple(len(data.channels[n].dim_sk) for n in CHANNELS)
-    return _q5_step_cached(mesh, n_dims, data.sales_date_lo,
-                           data.sales_date_hi)
-
-
-@functools.lru_cache(maxsize=32)
-def _q5_step_cached(mesh, n_dims: tuple, lo: int, hi: int):
-    from spark_rapids_jni_tpu.obs.seam import COMPILE, seam
-
-    with seam(COMPILE, "q5_step"):
-        body = functools.partial(_sharded_q5, n_dims=n_dims, lo=lo, hi=hi)
-        step = shard_map(
-            body,
-            mesh=mesh,
-            in_specs=(P(DATA_AXIS), P(), P()),
-            out_specs=tuple(_ChannelPartials(P(), P(), P(), P())
-                            for _ in CHANNELS),
-            check_vma=False,
-        )
-        return jax.jit(step)
-
-
-def _pad_channel(facts: Dict[str, np.ndarray], dp: int) -> Dict[str, np.ndarray]:
-    """Pad fact arrays to the dp-aligned pow2-quantized length (bounded
-    compile variants, parallel.shuffle.quantized_rows); pad rows get
-    invalid keys, so they drop out of the joins like any null-keyed row."""
-    from spark_rapids_jni_tpu.parallel.shuffle import quantized_rows
-
-    out = {}
-    n_s = len(facts["sales_sk"])
-    n_r = len(facts["ret_sk"])
-    pad_s = quantized_rows(n_s, dp) - n_s
-    pad_r = quantized_rows(n_r, dp) - n_r
-    for k, v in facts.items():
-        pad = pad_s if k.startswith("sales") else pad_r
-        if pad == 0:
-            out[k] = v
-            continue
-        fill = np.zeros(pad, dtype=v.dtype)
-        out[k] = np.concatenate([v, fill])
-    if pad_s:
-        out["sales_sk_valid"][-pad_s:] = False
-    if pad_r:
-        out["ret_sk_valid"][-pad_r:] = False
-    return out
-
-
-def _split_channel(facts: Dict[str, np.ndarray]):
-    """Halve fact rows (exact: all q5 aggregates are additive over rows)."""
-    halves = []
-    n_s = len(facts["sales_sk"])
-    n_r = len(facts["ret_sk"])
-    for side in (0, 1):
-        sel = {}
-        s_sl = slice(0, n_s // 2) if side == 0 else slice(n_s // 2, n_s)
-        r_sl = slice(0, n_r // 2) if side == 0 else slice(n_r // 2, n_r)
-        for k, v in facts.items():
-            sel[k] = v[s_sl] if k.startswith("sales") else v[r_sl]
-        halves.append(sel)
-    return halves
+    plan = q5_plan(n_dims, data.sales_date_lo, data.sales_date_hi)
+    tables = _q5_tables({n: _facts_of(data.channels[n]) for n in CHANNELS},
+                        data.date_sk, data.date_days)
+    return compiled_plan_for(plan, mesh, tables)
 
 
 def run_q5_partials(
@@ -297,75 +353,29 @@ def run_q5_partials(
 ) -> Dict[str, _ChannelPartials]:
     """Governed distributed q5 PARTIALS over a host fact batch.
 
-    ``batch`` maps channel -> fact-array dict (the _facts_of field names);
-    the step is LRU-cached on (mesh, n_dims, lo, hi), so every caller with
-    one dim geometry — in-memory q5, every bucket of streamed q5 — reuses
-    ONE compiled program.  Every launch is admitted through the memory
-    arbiter; SplitAndRetryOOM halves fact rows (exact — all aggregates are
-    additive) and partials combine by addition.
+    ``batch`` maps channel -> fact-array dict (the _facts_of field names).
+    The whole pipeline is ONE compiled plan under ONE governed bracket:
+    one admission for the fused working set, RetryOOM re-runs the fused
+    program, SplitAndRetryOOM halves every fact stream and re-executes
+    the fused program per half (exact — all aggregates are additive),
+    and one flight-recorder task spans the plan.  Every caller with one
+    dim geometry and batch bucket — in-memory q5, every bucket of
+    streamed q5 — reuses ONE cached program.
     """
-    import contextlib
+    from spark_rapids_jni_tpu.plans.runtime import run_governed_plan
 
-    from spark_rapids_jni_tpu.mem.governed import (
-        default_device_budget,
-        run_with_split_retry,
-        task_context,
+    plan = q5_plan(tuple(n_dims), lo, hi)
+    tables = _q5_tables(batch, date_sk, date_days)
+    outputs = run_governed_plan(
+        mesh, plan, tables,
+        budget=budget, task_id=task_id, manage_task=manage_task,
     )
-
-    if budget is None:
-        budget = default_device_budget()
-    dp = int(np.prod([mesh.shape[a] for a in (DATA_AXIS,)]))
-    sharding = NamedSharding(mesh, P(DATA_AXIS))
-    rep = NamedSharding(mesh, P())
-    step = _q5_step_cached(mesh, tuple(n_dims), lo, hi)
-    dim_sk = jax.device_put(date_sk, rep)
-    dim_days = jax.device_put(date_days, rep)
-
-    def nbytes_of(b):
-        # quantized (padded) lengths: what run() actually uploads
-        from spark_rapids_jni_tpu.parallel.shuffle import quantized_rows
-
-        total = sum(quantized_rows(len(v), dp) * v.itemsize
-                    for ch in b.values() for v in ch.values())
-        return total * 3  # inputs + masks/buckets + partials
-
-    def run(b):
-        from spark_rapids_jni_tpu.obs.seam import COLLECTIVE, TRANSFER, seam
-
-        with seam(TRANSFER, "q5_batch_upload"):
-            dev = {
-                n: {k: jax.device_put(np.ascontiguousarray(v), sharding)
-                    for k, v in _pad_channel(ch, dp).items()}
-                for n, ch in b.items()
-            }
-        with seam(COLLECTIVE, "launch:q5_step"):
-            out = step(dev, dim_sk, dim_days)
-            jax.block_until_ready(out)
-        return {n: jax.tree.map(np.asarray, p)
-                for n, p in zip(CHANNELS, out)}
-
-    def split(b):
-        parts = {n: _split_channel(ch) for n, ch in b.items()}
-        return [{n: parts[n][0] for n in b}, {n: parts[n][1] for n in b}]
-
-    def combine(results):
-        acc = results[0]
-        for r in results[1:]:
-            acc = add_partials(acc, r)
-        return acc
-
-    ctx = (task_context(budget.gov, task_id) if manage_task
-           else contextlib.nullcontext())
-    with ctx:
-        return run_with_split_retry(
-            budget, batch,
-            nbytes_of=nbytes_of, run=run, split=split, combine=combine,
-        )
+    return _partials_of(outputs)
 
 
 def run_distributed_q5(mesh, data: Q5Data, *, budget=None, task_id: int = 0,
                        manage_task: bool = True) -> List[Q5Row]:
-    """Governed distributed q5 over host data: partials via
+    """Governed distributed q5 over host data: fused partials via
     :func:`run_q5_partials`, then the host rollup."""
     per_channel = run_q5_partials(
         mesh,

@@ -36,10 +36,11 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS, axis_size, shard_map
 from spark_rapids_jni_tpu.parallel.shuffle import all_to_all_shuffle, partition_of
+from spark_rapids_jni_tpu.plans import ir as ir_mod
 
 
 class Q97Out(NamedTuple):
@@ -151,6 +152,29 @@ def _sharded_q97(s_cust, s_item, c_cust, c_item, capacity: int,
         jax.lax.psum(b, axes),
         jax.lax.psum(ex.dropped, axes),
     )
+
+
+@functools.lru_cache(maxsize=64)
+def q97_plan(capacity: int) -> ir_mod.Plan:
+    """The whole distributed q97 pipeline as ONE plan: two fact scans
+    project the packed composite key, union with a source tag, exchange
+    by key hash (static ``capacity`` is plan structure — one compiled
+    variant per pow2 capacity, as the lru step cache kept before), then
+    sort-merge presence counting.  Mesh-only (contains an Exchange)."""
+    from spark_rapids_jni_tpu.plans.ir import Bin, Cast, col, lit
+
+    key = Bin("bor",
+              Bin("shl", Cast(col("cust"), "int64"), lit(32)),
+              Bin("band", Cast(col("item"), "int64"), lit(0xFFFFFFFF)))
+    store = ir_mod.Project(ir_mod.Scan("store", ("cust", "item")),
+                           (("key", key),))
+    catalog = ir_mod.Project(ir_mod.Scan("catalog", ("cust", "item")),
+                             (("key", key),))
+    node = ir_mod.Union((store, catalog), tag="tag", tag_values=(1, 0))
+    node = ir_mod.Exchange(node, key=col("key"), capacity=int(capacity),
+                           fields=("key", "tag"))
+    return ir_mod.Plan("q97", (ir_mod.PresenceCount(node, key="key",
+                                                    tag="tag"),))
 
 
 def make_distributed_q97(mesh, capacity: int, with_validity: bool = False):
@@ -380,17 +404,6 @@ def q97_working_set_bytes(batch: Q97Batch, dp: int) -> int:
     return n * (8 + per_row) + 2 * slots * per_row + 2 * slots * 10
 
 
-@functools.lru_cache(maxsize=32)
-def _q97_step_cached(mesh, capacity: int):
-    from spark_rapids_jni_tpu.obs.seam import COMPILE, seam
-
-    # cache miss == a step build (and, on first launch, an XLA compile):
-    # a chaos rule on the 'compile' category fails it like the reference's
-    # CUDA-API injector fails a module load
-    with seam(COMPILE, f"q97_step:cap{capacity}"):
-        return make_distributed_q97(mesh, capacity, with_validity=True)
-
-
 def _pad_to_multiple(arr: np.ndarray, mult: int, fill=0):
     """Pad to the dp-aligned POW2-QUANTIZED batch length (bounded compile
     variants — see parallel.shuffle.quantized_rows); pad rows are
@@ -418,39 +431,28 @@ def default_q97_capacity(total_rows: int, dp: int) -> int:
 
 
 def run_q97_piece(mesh, piece: Q97Batch, *, sharding=None) -> Q97Out:
-    """One device launch of one q97 (sub-)batch — pad, upload, exchange.
+    """One FUSED launch of one q97 (sub-)batch through the compiled plan.
 
     The single-attempt core shared by :func:`run_distributed_q97` (which
     splits inline via run_with_split_retry) and the serving engine's q97
-    handler (serve/executor.py, which splits by re-queueing halves).
-    Raises :class:`ShuffleCapacityExceeded` when rows overflowed the
-    piece's static exchange capacity (the caller grows and re-runs).
+    handler (serve/executor.py, which splits by re-queueing halves) —
+    both re-execute the whole fused program per piece, never a per-op
+    disband.  Pad/upload/launch live in plans/runtime.execute_plan;
+    compiled variants are cached on (plan structure, dtype signature,
+    pow2 batch bucket).  Raises :class:`ShuffleCapacityExceeded` when
+    rows overflowed the piece's static exchange capacity (the caller
+    grows and re-runs).  ``sharding`` is accepted for API compatibility;
+    the plan runtime derives placements from the plan itself.
     """
-    from spark_rapids_jni_tpu.mem.governed import ShuffleCapacityExceeded
-    from spark_rapids_jni_tpu.obs.seam import COLLECTIVE, TRANSFER, seam
+    from spark_rapids_jni_tpu.plans.runtime import execute_plan
 
-    dp = mesh.shape[DATA_AXIS]
-    if sharding is None:
-        sharding = NamedSharding(mesh, P(DATA_AXIS))
-    # _pad_to_multiple quantizes to >= dp rows, so empty inputs come
-    # back as dp all-invalid rows — no empty-array special case
-    sc, sv = _pad_to_multiple(piece.s_cust, dp)
-    si, _ = _pad_to_multiple(piece.s_item, dp)
-    cc, cv = _pad_to_multiple(piece.c_cust, dp)
-    ci, _ = _pad_to_multiple(piece.c_item, dp)
-    step = _q97_step_cached(mesh, piece.capacity)
-    with seam(TRANSFER, "q97_batch_upload"):
-        args = [jax.device_put(a, sharding)
-                for a in (sc, si, cc, ci, sv, cv)]
-    # the step IS the collective exchange (tagged all_to_all): a chaos
-    # rule on 'collective' fails the launch like a wedged collective
-    with seam(COLLECTIVE, "launch:q97_step"):
-        out = step(*args)
-        jax.block_until_ready(out)
-    if int(out.dropped) > 0:
-        raise ShuffleCapacityExceeded(
-            f"{int(out.dropped)} rows overflowed capacity {piece.capacity}")
-    return out
+    del sharding
+    out = execute_plan(mesh, q97_plan(piece.capacity), {
+        "store": {"cust": piece.s_cust, "item": piece.s_item},
+        "catalog": {"cust": piece.c_cust, "item": piece.c_item},
+    })
+    return Q97Out(out["store_only"], out["catalog_only"], out["both"],
+                  out["dropped"])
 
 
 def combine_q97_outs(outs) -> Q97Out:
@@ -504,10 +506,8 @@ def run_distributed_q97(
     cap0 = capacity if capacity is not None else default_q97_capacity(total, dp)
     batch = Q97Batch(s_cust, s_item, c_cust, c_item, capacity=cap0)
 
-    sharding = NamedSharding(mesh, P(DATA_AXIS))
-
     def run(piece: Q97Batch) -> Q97Out:
-        return run_q97_piece(mesh, piece, sharding=sharding)
+        return run_q97_piece(mesh, piece)
 
     import contextlib
 

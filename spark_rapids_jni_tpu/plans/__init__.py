@@ -1,0 +1,46 @@
+"""Plan-level compilation: whole query pipelines as single jitted programs.
+
+- :mod:`plans.ir` — the hashable plan vocabulary (scan/filter/project/
+  join/aggregate/exchange nodes over the existing ops/columnar
+  primitives);
+- :mod:`plans.compiler` — traces a plan into ONE jitted (shard_map'd)
+  program, bit-identical to the per-op path;
+- :mod:`plans.cache` — compiled variants keyed on (plan structure,
+  dtype signature, pow2 batch bucket), gauges through serve/metrics and
+  obs/flight;
+- :mod:`plans.runtime` — the governed bracket at plan granularity (one
+  admission, one retry/split boundary, one flight task per plan).
+"""
+
+from spark_rapids_jni_tpu.plans import ir
+from spark_rapids_jni_tpu.plans.cache import CompiledPlan, plan_cache
+from spark_rapids_jni_tpu.plans.compiler import (
+    cached_compile,
+    compile_plan,
+    input_signature,
+    output_names,
+)
+from spark_rapids_jni_tpu.plans.runtime import (
+    combine_outputs,
+    execute_plan,
+    pad_tables,
+    plan_working_set_bytes,
+    run_governed_plan,
+    split_scan_tables,
+)
+
+__all__ = [
+    "ir",
+    "CompiledPlan",
+    "plan_cache",
+    "cached_compile",
+    "compile_plan",
+    "input_signature",
+    "output_names",
+    "combine_outputs",
+    "execute_plan",
+    "pad_tables",
+    "plan_working_set_bytes",
+    "run_governed_plan",
+    "split_scan_tables",
+]

@@ -1,0 +1,420 @@
+"""Plan compiler: trace a whole query plan into ONE jitted program.
+
+Every IR node maps onto the existing device primitives (the same jnp
+calls the per-op model bodies used, kept bit-identical so fused results
+equal the per-op path exactly); the compiler walks the plan, builds one
+python callable over the flat input arrays, wraps it in ``shard_map``
+when a mesh is given (facts ride the data axis, dims are replicated,
+sink outputs psum), and jits the whole thing — one launch per plan
+execution instead of one per op.
+
+Compilation crosses the COMPILE seam (a chaos rule can fail it like the
+reference's module-load injector) and is cached in plans/cache.py; the
+trace/compile split is measured with the AOT API (``jit(...).lower()``
+then ``.compile()``) when the backend supports it, falling back to a
+plain jit whose first call pays both.
+
+Emitters are registered with the :func:`emitter` decorator —
+``ci/analyze.py``'s governed-allocation pass treats emitter-decorated
+functions as traced device code (allocations materialize at the
+governed plan launch, not at trace time), the same seeding rule as
+``with seam(COMPILE)`` blocks and jit/shard_map arguments.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu.plans import ir
+from spark_rapids_jni_tpu.plans.cache import CompiledPlan, plan_cache
+
+__all__ = ["compile_plan", "cached_compile", "input_signature",
+           "output_names", "emitter", "DTYPES"]
+
+DTYPES = {
+    "bool": jnp.bool_,
+    "int8": jnp.int8,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "uint64": jnp.uint64,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+}
+
+#: the implicit per-scan row-validity input the executor appends
+VALID_FIELD = "__valid__"
+
+
+# ---------------------------------------------------------------- expressions
+
+
+def _eval(expr, env: Dict[str, object]):
+    """Evaluate an IR expression against an environment of traced arrays
+    (or, for Plan.post, of aggregate output vectors)."""
+    if isinstance(expr, ir.Col):
+        return env[expr.name]
+    if isinstance(expr, ir.Lit):
+        return expr.value
+    if isinstance(expr, ir.Cast):
+        x = _eval(expr.x, env)
+        return jnp.asarray(x).astype(DTYPES[expr.dtype])
+    if isinstance(expr, ir.Unary):
+        x = _eval(expr.x, env)
+        return (~x) if expr.op == "not" else (-x)
+    if isinstance(expr, ir.Bin):
+        a = _eval(expr.lhs, env)
+        b = _eval(expr.rhs, env)
+        op = expr.op
+        if op == "add":
+            return a + b
+        if op == "sub":
+            return a - b
+        if op == "mul":
+            return a * b
+        if op == "and":
+            return a & b
+        if op == "or":
+            return a | b
+        if op == "eq":
+            return a == b
+        if op == "ne":
+            return a != b
+        if op == "ge":
+            return a >= b
+        if op == "gt":
+            return a > b
+        if op == "le":
+            return a <= b
+        if op == "lt":
+            return a < b
+        if op == "min":
+            return jnp.minimum(a, b)
+        if op == "max":
+            return jnp.maximum(a, b)
+        if op == "shl":
+            return a << b
+        if op == "band":
+            return a & b
+        if op == "bor":
+            return a | b
+    raise TypeError(f"not an IR expression: {expr!r}")
+
+
+# ------------------------------------------------------------------- emitters
+
+
+class _Ctx:
+    """One trace: bound input arrays + exchange-drop accumulation."""
+
+    def __init__(self, inputs, rowvalid, mesh):
+        self.inputs = inputs      # table -> field -> traced array
+        self.rowvalid = rowvalid  # scan table -> traced bool array
+        self.mesh = mesh
+        self.dropped: List[object] = []
+
+
+class _Rows:
+    """A row-level pipeline state: named columns + the AND'd mask."""
+
+    def __init__(self, cols: Dict[str, object], mask):
+        self.cols = cols
+        self.mask = mask
+
+
+_EMITTERS: Dict[type, Callable] = {}
+
+
+def emitter(node_cls):
+    """Register the emit function of one IR node type.  Emitter bodies
+    are traced device code: ci/analyze.py seeds them as governed roots
+    (their allocations happen at the governed plan launch)."""
+
+    def deco(fn):
+        _EMITTERS[node_cls] = fn
+        return fn
+
+    return deco
+
+
+def _emit(node, ctx: _Ctx):
+    return _EMITTERS[type(node)](node, ctx)
+
+
+@emitter(ir.Scan)
+def _emit_scan(node: ir.Scan, ctx: _Ctx) -> _Rows:
+    cols = {f: ctx.inputs[node.table][f] for f in node.fields}
+    return _Rows(cols, ctx.rowvalid[node.table])
+
+
+@emitter(ir.Filter)
+def _emit_filter(node: ir.Filter, ctx: _Ctx) -> _Rows:
+    rows = _emit(node.child, ctx)
+    return _Rows(rows.cols, rows.mask & _eval(node.pred, rows.cols))
+
+
+@emitter(ir.Project)
+def _emit_project(node: ir.Project, ctx: _Ctx) -> _Rows:
+    rows = _emit(node.child, ctx)
+    cols = dict(rows.cols)
+    for name, expr in node.cols:
+        cols[name] = _eval(expr, cols)
+    return _Rows(cols, rows.mask)
+
+
+@emitter(ir.GatherJoin)
+def _emit_gather_join(node: ir.GatherJoin, ctx: _Ctx) -> _Rows:
+    rows = _emit(node.child, ctx)
+    dim = ctx.inputs[node.dim.table]
+    key = _eval(node.key, rows.cols)
+    base = _eval(node.base, rows.cols)
+    n_dim = dim[node.fields[0][0]].shape[0]
+    idx = jnp.clip(key - base, 0, n_dim - 1)
+    cols = dict(rows.cols)
+    for dfield, out in node.fields:
+        cols[out] = dim[dfield][idx]
+    return _Rows(cols, rows.mask)
+
+
+@emitter(ir.SemiJoinWindow)
+def _emit_semi_join_window(node: ir.SemiJoinWindow, ctx: _Ctx) -> _Rows:
+    rows = _emit(node.child, ctx)
+    dim_sk = ctx.inputs[node.dim.table][node.sk_field]
+    dim_days = ctx.inputs[node.dim.table][node.days_field]
+    date = _eval(node.key, rows.cols)
+    valid = _eval(node.key_valid, rows.cols)
+    lo = _eval(node.lo, rows.cols)
+    hi = _eval(node.hi, rows.cols)
+    idx = jnp.clip(jnp.searchsorted(dim_sk, date), 0, dim_sk.shape[0] - 1)
+    hit = dim_sk[idx] == date
+    in_win = (dim_days[idx] >= lo) & (dim_days[idx] < hi)
+    return _Rows(rows.cols, rows.mask & valid & hit & in_win)
+
+
+@emitter(ir.SegmentAgg)
+def _emit_segment_agg(node: ir.SegmentAgg, ctx: _Ctx) -> Dict[str, object]:
+    rows = _emit(node.child, ctx)
+    key = _eval(node.key, rows.cols)
+    n = node.num_segments
+    # masked rows scatter into the drop bucket — the _masked_segment
+    # shape, bit-identical for integer sums
+    bucket = jnp.where(rows.mask, key, n)
+    out = {}
+    for name, value_expr, dtype in node.aggs:
+        vals = jnp.where(rows.mask, _eval(value_expr, rows.cols), 0).astype(
+            DTYPES[dtype])
+        out[name] = jax.ops.segment_sum(vals, bucket, num_segments=n + 1)[:-1]
+    return out
+
+
+@emitter(ir.Union)
+def _emit_union(node: ir.Union, ctx: _Ctx) -> _Rows:
+    parts = [_emit(c, ctx) for c in node.children]
+    fields = [f for f in parts[0].cols if all(f in p.cols for p in parts)]
+    cols = {f: jnp.concatenate([p.cols[f] for p in parts]) for f in fields}
+    cols[node.tag] = jnp.concatenate([
+        jnp.full(p.mask.shape, tv, jnp.int8)
+        for p, tv in zip(parts, node.tag_values)
+    ])
+    return _Rows(cols, jnp.concatenate([p.mask for p in parts]))
+
+
+@emitter(ir.Exchange)
+def _emit_exchange(node: ir.Exchange, ctx: _Ctx) -> _Rows:
+    from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS, axis_size
+    from spark_rapids_jni_tpu.parallel.shuffle import (
+        all_to_all_shuffle,
+        partition_of,
+    )
+
+    rows = _emit(node.child, ctx)
+    dp = axis_size(DATA_AXIS)
+    part = partition_of(_eval(node.key, rows.cols), dp)
+    ex = all_to_all_shuffle(
+        {f: rows.cols[f] for f in node.fields}, part, node.capacity,
+        axis=DATA_AXIS, row_valid=rows.mask,
+    )
+    ctx.dropped.append(ex.dropped)
+    return _Rows(dict(ex.columns), ex.valid)
+
+
+@emitter(ir.PresenceCount)
+def _emit_presence_count(node: ir.PresenceCount,
+                         ctx: _Ctx) -> Dict[str, object]:
+    # lazy: models.q97 imports plans at module level; by trace time the
+    # module exists, and _count_runs stays single-owner over there
+    from spark_rapids_jni_tpu.models.q97 import _count_runs
+
+    rows = _emit(node.child, ctx)
+    so, co, b = _count_runs(rows.cols[node.key],
+                            rows.cols[node.tag] == 1, rows.mask)
+    return dict(zip(node.names, (so, co, b)))
+
+
+# ------------------------------------------------------------------ compiling
+
+
+def output_names(plan: ir.Plan) -> Tuple[str, ...]:
+    """Static output order of a compiled plan: sink outputs in sink/agg
+    order, then the implicit ``dropped`` (plans with an Exchange), then
+    post outputs — filtered/ordered by ``plan.outputs`` when set."""
+    names: List[str] = []
+    for sink in plan.sinks:
+        if isinstance(sink, ir.SegmentAgg):
+            names.extend(name for name, _e, _d in sink.aggs)
+        elif isinstance(sink, ir.PresenceCount):
+            names.extend(sink.names)
+        else:
+            raise TypeError(f"not a sink node: {sink!r}")
+    if ir.has_exchange(plan):
+        names.append("dropped")
+    names.extend(name for name, _e in plan.post)
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate output names in plan {plan.name!r}")
+    if plan.outputs:
+        missing = set(plan.outputs) - set(names)
+        if missing:
+            raise ValueError(f"unknown plan outputs {sorted(missing)}")
+        if ir.has_exchange(plan) and "dropped" not in plan.outputs:
+            # the runtime's overflow guard reads 'dropped' from the
+            # compiled outputs; filtering it away would silently disable
+            # ShuffleCapacityExceeded and return wrong counts on overflow
+            raise ValueError(
+                f"plan {plan.name!r} contains an Exchange: its 'outputs' "
+                f"must include 'dropped' (the overflow retry signal)")
+        return tuple(plan.outputs)
+    return tuple(names)
+
+
+def _arg_layout(plan: ir.Plan):
+    """Flat argument order: scans (table-sorted; fields then the implicit
+    row-valid), then dims (table-sorted)."""
+    layout = []
+    for scan in ir.scan_tables(plan):
+        for f in scan.fields:
+            layout.append(("scan", scan.table, f))
+        layout.append(("scan", scan.table, VALID_FIELD))
+    for dim in ir.dim_tables(plan):
+        for f in dim.fields:
+            layout.append(("dim", dim.table, f))
+    return layout
+
+
+def input_signature(plan: ir.Plan, tables) -> Tuple:
+    """The dtype+bucket signature of already-padded input ``tables``
+    (table -> field -> array, row-valid included) in flat arg order —
+    the variable half of the plan-cache key."""
+    sig = []
+    for kind, table, field in _arg_layout(plan):
+        a = tables[table][field]
+        sig.append((kind, table, field, str(a.dtype), int(a.shape[0])))
+    return tuple(sig)
+
+
+def compile_plan(plan: ir.Plan, mesh, signature: Tuple) -> CompiledPlan:
+    """Trace + compile ``plan`` for one input signature.  Uncached —
+    go through :func:`cached_compile`."""
+    from spark_rapids_jni_tpu.obs.seam import COMPILE, seam
+
+    layout = _arg_layout(plan)
+    if len(signature) != len(layout):
+        raise ValueError("signature does not match the plan's arg layout")
+    out_names = output_names(plan)
+    local = mesh is None
+    if local and ir.has_exchange(plan):
+        raise ValueError(
+            f"plan {plan.name!r} contains an Exchange: mesh required")
+
+    def body(*flat):
+        inputs: Dict[str, Dict[str, object]] = {}
+        rowvalid: Dict[str, object] = {}
+        for (kind, table, field), arr in zip(layout, flat):
+            if field == VALID_FIELD:
+                rowvalid[table] = arr
+            else:
+                inputs.setdefault(table, {})[field] = arr
+        ctx = _Ctx(inputs, rowvalid, mesh)
+        outputs: Dict[str, object] = {}
+        for sink in plan.sinks:
+            outputs.update(_emit(sink, ctx))
+        if ctx.dropped:
+            outputs["dropped"] = sum(ctx.dropped[1:], ctx.dropped[0])
+        if not local:
+            from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS
+
+            outputs = {k: jax.lax.psum(v, (DATA_AXIS,))
+                       for k, v in outputs.items()}
+        for name, expr in plan.post:
+            outputs[name] = _eval(expr, outputs)
+        return tuple(outputs[n] for n in out_names)
+
+    with seam(COMPILE, f"plan:{ir.plan_signature(plan)}"):
+        if local:
+            step = jax.jit(body)
+        else:
+            from jax.sharding import PartitionSpec as P
+
+            from spark_rapids_jni_tpu.parallel.mesh import (
+                DATA_AXIS,
+                shard_map,
+            )
+
+            in_specs = tuple(
+                P(DATA_AXIS) if kind == "scan" else P()
+                for kind, _t, _f in layout)
+            step = jax.jit(shard_map(
+                body, mesh=mesh, in_specs=in_specs,
+                out_specs=tuple(P() for _ in out_names),
+                check_vma=False,
+            ))
+        fn, aot, trace_s, compile_s, aot_err = _try_aot(
+            step, mesh, layout, signature)
+    return CompiledPlan(fn, plan, mesh, signature, out_names,
+                        tuple(f"{t}.{f}" for _k, t, f in layout),
+                        aot, trace_s, compile_s, aot_err)
+
+
+def _try_aot(step, mesh, layout, signature):
+    """AOT lower+compile so trace and compile are separately timed (the
+    bench's compile-amortization story); fall back to the plain jit —
+    whose first call pays both — if the backend refuses the abstract
+    shardings."""
+    try:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        avals = []
+        for (kind, _t, _f), (_k2, _t2, _f2, dtype, n) in zip(layout,
+                                                             signature):
+            sharding = None
+            if mesh is not None:
+                from spark_rapids_jni_tpu.parallel.mesh import DATA_AXIS
+
+                sharding = NamedSharding(
+                    mesh, P(DATA_AXIS) if kind == "scan" else P())
+            avals.append(jax.ShapeDtypeStruct((n,), DTYPES.get(dtype, dtype),
+                                              sharding=sharding))
+        t0 = time.perf_counter()
+        lowered = step.lower(*avals)
+        t1 = time.perf_counter()
+        compiled = lowered.compile()
+        t2 = time.perf_counter()
+        return compiled, True, t1 - t0, t2 - t1, ""
+    # analyze: ignore[retry-protocol] - AOT probe at compile time, before
+    # any device work launches: no retry bracket is open, and the plain
+    # jit fallback is the correct degradation for any lowering failure.
+    # NOT silent: the reason rides CompiledPlan.aot_error and the cache
+    # counts aot_fallbacks in its stats gauge, so a genuine trace bug
+    # deferred to first launch is still visible at the compile layer.
+    except Exception as e:  # noqa: BLE001
+        return step, False, 0.0, 0.0, f"{type(e).__name__}: {e}"[:200]
+
+
+def cached_compile(plan: ir.Plan, mesh, tables) -> CompiledPlan:
+    """The front door: compiled program for (plan, mesh, padded inputs),
+    via the process-global plan cache."""
+    sig = input_signature(plan, tables)
+    return plan_cache.get_or_compile(
+        (plan, mesh, sig), lambda: compile_plan(plan, mesh, sig))

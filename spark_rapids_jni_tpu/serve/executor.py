@@ -303,15 +303,21 @@ class ServingEngine:
 
     def _gauges(self) -> dict:
         """Memory-pressure gauges for metrics snapshots: governor budget
-        bytes, spill-pool bytes, and the arbiter's parked-thread count."""
+        bytes, spill-pool bytes, and the compiled-plan cache (hit/miss/
+        entries — compile-variant churn shows up beside memory pressure
+        in the same snapshot)."""
         from spark_rapids_jni_tpu.mem.governor import budget_gauges
         from spark_rapids_jni_tpu.mem.spill import pool_gauges
+        from spark_rapids_jni_tpu.plans.cache import plan_cache
 
         g = {"gov_" + k: v for k, v in budget_gauges().items()}
         sp = pool_gauges()
         g["spill_pool_bytes"] = sp["device_bytes"]
         g["spill_spilled_bytes"] = sp["spilled_bytes"]
         g["spill_count"] = sp["spill_count"]
+        pc = plan_cache.stats()
+        for k in ("hits", "misses", "entries", "evictions"):
+            g[f"plan_cache_{k}"] = int(pc[k])
         return g
 
     # -- lifecycle ----------------------------------------------------------

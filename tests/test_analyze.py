@@ -544,6 +544,85 @@ def test_reservation_block_clean(tmp_path):
     assert run(root, rules=["governed-allocation"]) == []
 
 
+EMITTER_COMPILER = """
+    _EMITTERS = {}
+
+
+    def emitter(node_cls):
+        def deco(fn):
+            _EMITTERS[node_cls] = fn
+            return fn
+
+        return deco
+"""
+
+
+def test_emitter_decorated_clean_but_sibling_flagged(tmp_path):
+    # @emitter(Node)-decorated functions are plan-compiled roots: traced
+    # device code whose allocations materialize at the governed plan
+    # launch (the round-6 seeding rule); an undecorated sibling in the
+    # same module stays flagged — no blanket module exemption
+    root = write_pkg(tmp_path, {
+        "plans/compiler.py": EMITTER_COMPILER + """
+
+        import jax.numpy as jnp
+
+        class ScanNode:
+            pass
+
+
+        @emitter(ScanNode)
+        def emit_scan(node, ctx):
+            return jnp.zeros((4,), jnp.int32)
+
+
+        def naked(n):
+            return jnp.zeros((n,), jnp.int32)
+    """})
+    fs = run(root, rules=["governed-allocation"])
+    assert len(fs) == 1 and "naked" in fs[0].message
+
+
+def test_emitter_seed_propagates_to_helpers(tmp_path):
+    # a helper (even cross-module) referenced from an emitter body is
+    # governed by the same propagation jit/COMPILE-seam seeds get
+    root = write_pkg(tmp_path, {
+        "plans/compiler.py": EMITTER_COMPILER + """
+
+        from pkg.ops.kernels import helper_kernel
+
+        class AggNode:
+            pass
+
+
+        @emitter(AggNode)
+        def emit_agg(node, ctx):
+            return helper_kernel(8)
+    """,
+        "ops/kernels.py": """
+        import jax.numpy as jnp
+
+
+        def helper_kernel(n):
+            return jnp.ones((n,), jnp.int32)
+    """})
+    assert run(root, rules=["governed-allocation"]) == []
+
+
+def test_plans_scope_ungoverned_alloc_flagged(tmp_path):
+    # plans/ is governed scope: a raw allocation outside any emitter or
+    # bracket is a finding, same as ops/models/serve
+    root = write_pkg(tmp_path, {"plans/runtime.py": """
+        import jax.numpy as jnp
+
+
+        def upload(n):
+            return jnp.zeros((n,), jnp.int32)
+    """})
+    fs = run(root, rules=["governed-allocation"])
+    assert len(fs) == 1 and "upload" in fs[0].message
+
+
 # --------------------------------------------------------- seam-discipline
 
 

@@ -48,7 +48,7 @@ def test_transfer_fault_mid_query_retries_to_completion(gov):
     store, catalog = _tables()
     budget = BudgetedResource(gov, 1 << 30)
     FaultInjector.install({
-        "transfer": {"q97_batch_upload": {"injectionType": "retry_oom",
+        "transfer": {"plan_upload:q97": {"injectionType": "retry_oom",
                                           "interceptionCount": 1}},
     })
     try:
@@ -69,7 +69,7 @@ def test_transfer_hard_fault_aborts_cleanly(gov):
     store, catalog = _tables(seed=6)
     budget = BudgetedResource(gov, 1 << 30)
     FaultInjector.install({
-        "transfer": {"q97_batch_upload": {"injectionType": "exception",
+        "transfer": {"plan_upload:q97": {"injectionType": "exception",
                                           "interceptionCount": 1}},
     })
     try:
@@ -93,7 +93,7 @@ def test_collective_launch_fault_aborts_cleanly(gov):
     budget = BudgetedResource(gov, 1 << 30)
     gov.current_thread_is_dedicated_to_task(3)
     FaultInjector.install({
-        "collective": {"launch:q97_step": {"injectionType": "exception",
+        "collective": {"launch:plan:q97:*": {"injectionType": "exception",
                                            "interceptionCount": 1}},
     })
     try:
@@ -117,7 +117,7 @@ def test_compile_fault_aborts_cleanly(gov):
     store, catalog = _tables(seed=8, n=170)
     budget = BudgetedResource(gov, 1 << 30)
     FaultInjector.install({
-        "compile": {"q97_step:*": {"injectionType": "exception",
+        "compile": {"plan:q97:*": {"injectionType": "exception",
                                    "interceptionCount": 1}},
     })
     try:

@@ -392,7 +392,9 @@ def test_serve_metrics_snapshot_and_publish_carry_pressure_gauges(gov):
         # governor device/host bytes-in-use + spill-pool bytes are present
         for key in ("gov_device_bytes_in_use", "gov_device_bytes_limit",
                     "gov_host_bytes_in_use", "gov_blocked_or_bufn",
-                    "spill_pool_bytes", "spill_spilled_bytes"):
+                    "spill_pool_bytes", "spill_spilled_bytes",
+                    "plan_cache_hits", "plan_cache_misses",
+                    "plan_cache_entries"):
             assert key in g, key
         assert g["gov_device_bytes_limit"] >= 1 << 20
         # per-task arbiter accumulators ride the snapshot

@@ -62,7 +62,7 @@ def test_capture_bank_replay_end_to_end(tmp_path, monkeypatch, capsys):
 
     monkeypatch.setattr(ge, "probe_ambient",
                         lambda n, timeout=0: (False, "forced dead (test)"))
-    bench_mod.main()
+    bench_mod.main([])  # [] not None: None parses pytest's sys.argv
     replayed = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert replayed["replayed"] is True
     assert replayed["value"] == bench_rec["value"]

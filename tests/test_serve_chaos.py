@@ -263,7 +263,7 @@ def test_q97_chaos_transfer_fault_through_engine(gov):
         catalog = (rng.randint(1, 40, 120).astype(np.int32),
                    rng.randint(1, 12, 120).astype(np.int32))
         FaultInjector.install({
-            "transfer": {"q97_batch_upload": {"injectionType": "retry_oom",
+            "transfer": {"plan_upload:q97": {"injectionType": "retry_oom",
                                               "interceptionCount": 1}},
         })
         s = eng.open_session()
