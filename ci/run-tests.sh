@@ -34,6 +34,14 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     --budget-mib 8 --task-max-mib 6 --allocs 40 --skewed --inject-pct 10 \
     --spill-buffers 6 --seed "${FUZZ_SEED:-0}"
 
+# seeded pressure-storm chaos tier (round 9): 3 paired rounds under an
+# identical injected-fault schedule and undersized budget — adaptive
+# admission (serve/controller.py) must beat static config on median p99
+# AND rejected-request count, with zero lost requests in every round
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu SRT_REEXECED=1 \
+    python tools/serve_bench.py --chaos-storm --clients 4 --requests 160 \
+    --workers 2 --queue-size 8 --seed "${STORM_SEED:-7}"
+
 python -c "
 from __graft_entry__ import dryrun_multichip
 dryrun_multichip(8)

@@ -239,3 +239,22 @@ register("flight_saturation_rejects", 8,
          "between) that count as queue saturation and trigger a flight-"
          "recorder anomaly dump (serve/executor.py).",
          env="SRT_FLIGHT_SATURATION_REJECTS")
+register("serve_adaptive", False,
+         "Telemetry-steered adaptive admission (serve/controller.py): the "
+         "serving engine runs a feedback controller that tunes queue "
+         "depth, session byte-budget scale, priority aging, and "
+         "pre-emptive split depth from live flight-recorder gauges.  Off "
+         "(default) = the static-config behavior of rounds 1-8.",
+         env="SRT_SERVE_ADAPTIVE")
+register("serve_controller_period_s", 0.05,
+         "Tick period of the adaptive-admission controller thread "
+         "(serve/controller.py).  Each tick samples pressure gauges, "
+         "updates the EWMA, and applies at most one banded adjustment "
+         "per knob.", env="SRT_SERVE_CONTROLLER_PERIOD_S")
+register("serve_controller_freeze", False,
+         "Kill switch for adaptive admission: when set, the controller "
+         "immediately resets every knob to its static config value and "
+         "stops adjusting — behavior becomes bit-identical to "
+         "serve_adaptive=False while the controller thread keeps "
+         "heartbeating (so un-freezing resumes without a restart).",
+         env="SRT_SERVE_CONTROLLER_FREEZE")

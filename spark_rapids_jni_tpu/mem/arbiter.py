@@ -9,6 +9,7 @@ thread-id convention (python ``threading.get_ident()``).
 
 from __future__ import annotations
 
+import collections
 import ctypes
 import os
 import subprocess
@@ -141,6 +142,16 @@ class Arbiter:
         # pre_alloc, which closes the window.  Keys are touched only by
         # the owning thread (GIL-atomic dict ops, no lock needed).
         self._blocked_at: dict[int, int] = {}
+        # thread -> park start for block_thread_until_ready (closed in the
+        # same call); same owning-thread-only discipline as _blocked_at
+        self._until_ready_at: dict[int, int] = {}
+        # rolling log of CLOSED blocked windows: (close_t_ns, task_id,
+        # wait_ns).  Bounded deque, GIL-atomic appends — feeds the
+        # rolling_blocked() trend gauge the admission controller steers
+        # from (cumulative per-task totals live in the flight recorder;
+        # a controller needs the trailing-window rate, not lifetime sums).
+        self._recent_blocked: "collections.deque" = collections.deque(
+            maxlen=1024)
 
     def close(self):
         # null the handle *before* destroying it: gauge samplers on other
@@ -219,6 +230,7 @@ class Arbiter:
             if task_id == -1 or self._task_of.get(thread_id) == task_id:
                 self._task_of.pop(thread_id, None)
         self._blocked_at.pop(thread_id, None)  # no pre_alloc will close it
+        self._until_ready_at.pop(thread_id, None)
 
     def task_done(self, task_id):
         self._check(self._lib.arbiter_task_done(self.handle, task_id))
@@ -270,8 +282,10 @@ class Arbiter:
             # escalation is the only source of those on a parked thread
             # (forced injections fire before the park and count as normal
             # retries via _check)
-            wait_ns = time.monotonic_ns() - t0
+            now = time.monotonic_ns()
+            wait_ns = now - t0
             task = self.task_of(thread_id)
+            self._recent_blocked.append((now, task, wait_ns))
             broke = code in _BREAK_CODES
             if broke:
                 _flight.record(_flight.EV_DEADLOCK_VERDICT, task,
@@ -322,9 +336,17 @@ class Arbiter:
         task = self.task_of(thread_id)
         _flight.record(_flight.EV_TASK_BLOCKED, task, detail="until_ready")
         t0 = time.monotonic_ns()
-        code = self._lib.arbiter_block_thread_until_ready(
-            self.handle, thread_id)
-        wait_ns = time.monotonic_ns() - t0
+        # analyze: ignore[unguarded-shared-state] - owning-thread-only key,
+        # same GIL-atomic discipline as _blocked_at (lock-free park path)
+        self._until_ready_at[thread_id] = t0
+        try:
+            code = self._lib.arbiter_block_thread_until_ready(
+                self.handle, thread_id)
+        finally:
+            self._until_ready_at.pop(thread_id, None)
+        now = time.monotonic_ns()
+        wait_ns = now - t0
+        self._recent_blocked.append((now, task, wait_ns))
         broke = code in _BREAK_CODES
         if broke:
             _flight.record(_flight.EV_DEADLOCK_VERDICT, task,
@@ -347,6 +369,29 @@ class Arbiter:
         self._check(self._lib.arbiter_check_and_break_deadlocks(self.handle))
 
     # introspection ---------------------------------------------------------
+    def rolling_blocked(self, window_s: float = 1.0) -> dict:
+        """Per-task blocked-ns observed within the trailing window — the
+        pressure TREND the admission controller steers from, as opposed to
+        the flight recorder's cumulative lifetime accumulators.
+
+        Closed windows contribute up to the portion inside the window
+        (clamped by close time); parks still in progress (post_alloc_failed
+        or block_thread_until_ready) contribute their elapsed time, so a
+        hard stall reads as rising pressure instead of zero.  Pure python
+        state — safe to sample from any thread, even mid-close."""
+        now = time.monotonic_ns()
+        cutoff = now - int(window_s * 1e9)
+        out: dict = {}
+        for t_close, task, ns in list(self._recent_blocked):
+            if t_close >= cutoff:
+                part = min(int(ns), t_close - cutoff)
+                out[task] = out.get(task, 0) + part
+        for open_map in (self._blocked_at, self._until_ready_at):
+            for tid, t0 in list(open_map.items()):
+                task = self.task_of(tid)
+                out[task] = out.get(task, 0) + (now - max(t0, cutoff))
+        return out
+
     def state_of(self, thread_id) -> int:
         return self._lib.arbiter_get_state_of(self.handle, thread_id)
 

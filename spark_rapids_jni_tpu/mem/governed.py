@@ -192,6 +192,8 @@ def run_with_split_retry(
     grow: Optional[Callable[[Any], Any]] = None,
     max_split_depth: int = 8,
     max_grows: int = 8,
+    initial_split_depth: int = 0,
+    on_retry: Optional[Callable[[int], None]] = None,
 ) -> Any:
     """Process ``batch`` under the arbiter's retry protocol.
 
@@ -207,16 +209,35 @@ def run_with_split_retry(
     a fixed-capacity exchange overflow; the piece is re-attempted as
     ``grow(piece)`` (typically doubling the shuffle capacity), with the
     reservation recomputed for the bigger buffers.
+
+    ``initial_split_depth`` pre-splits the batch BEFORE the first attempt
+    (the adaptive controller's pre-emptive split sizing: a class whose
+    history shows SplitAndRetryOOM skips the doomed full-size attempt and
+    its blocked/retry churn).  Pieces start at that depth, so the
+    ``max_split_depth`` cap covers pre-splits + reactive splits together.
+    ``on_retry(count)`` is forwarded to every piece's retry bracket.
     """
     gov = budget.gov
     results: List[Any] = []
     # depth-first work list of (piece, depth, grows) keeps combine() order ==
     # input order
     work: List[tuple] = [(batch, 0, 0)]
+    for _ in range(max(0, min(initial_split_depth, max_split_depth))):
+        nxt: List[tuple] = []
+        for piece, depth, grows in work:
+            parts = list(split(piece))
+            if len(parts) <= 1:  # not splittable further: keep as-is
+                nxt.append((piece, depth, grows))
+            else:
+                nxt.extend((p, depth + 1, grows) for p in parts)
+        if len(nxt) == len(work):
+            break  # nothing split this round; deeper rounds won't either
+        work = nxt
     while work:
         piece, depth, grows = work.pop(0)
         try:
-            results.append(_attempt(gov, budget, piece, nbytes_of, run))
+            results.append(_attempt(gov, budget, piece, nbytes_of, run,
+                                    on_retry=on_retry))
             continue
         except ShuffleCapacityExceeded:
             if grow is None or grows >= max_grows:

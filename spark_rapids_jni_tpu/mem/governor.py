@@ -200,7 +200,7 @@ def budget_gauges() -> dict:
     metrics publishing."""
     out = {"device_bytes_in_use": 0, "device_bytes_limit": 0,
            "host_bytes_in_use": 0, "host_bytes_limit": 0,
-           "blocked_or_bufn": 0}
+           "blocked_or_bufn": 0, "blocked_ns_rolling": 0}
     for b in list(_BUDGETS):
         side = "host" if b.is_cpu else "device"
         out[f"{side}_bytes_in_use"] += b.used
@@ -210,10 +210,26 @@ def budget_gauges() -> dict:
             out["blocked_or_bufn"] += gov.arbiter.total_blocked_or_bufn()
         except RuntimeError:  # racing close(): this governor contributes 0
             pass
+        out["blocked_ns_rolling"] += sum(
+            gov.arbiter.rolling_blocked().values())
     return out
 
 
+def rolling_blocked_gauges(window_s: float = 1.0) -> dict:
+    """Per-task blocked-ns inside the trailing window, merged over live
+    governors (the weak registry) — the trend gauge the admission
+    controller subscribes to, also snapshotted into anomaly dumps."""
+    per_task: dict = {}
+    for gov in list(_GOVERNORS):
+        for task, ns in gov.arbiter.rolling_blocked(window_s).items():
+            per_task[task] = per_task.get(task, 0) + ns
+    return {"window_s": window_s,
+            "blocked_ns": sum(per_task.values()),
+            "per_task": {str(t): n for t, n in per_task.items()}}
+
+
 _flight.register_telemetry_source("governor", budget_gauges)
+_flight.register_telemetry_source("blocked_rolling", rolling_blocked_gauges)
 
 
 class BudgetedResource:

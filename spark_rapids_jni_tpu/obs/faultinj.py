@@ -57,7 +57,8 @@ from spark_rapids_jni_tpu.mem.exceptions import (
 )
 from spark_rapids_jni_tpu.obs import seam as _seam
 
-__all__ = ["FaultInjector", "install_from_env", "ENV_CONFIG_PATH"]
+__all__ = ["FaultInjector", "install_from_env", "pressure_storm_config",
+           "ENV_CONFIG_PATH"]
 
 ENV_CONFIG_PATH = "SRT_FAULT_INJECTOR_CONFIG_PATH"
 
@@ -181,6 +182,32 @@ class FaultInjector:
             fault = rule.fire(self._rng, name)
         if fault is not None:
             raise fault
+
+
+def pressure_storm_config(seed: int = 0, *, retry_pct: float = 25.0,
+                          split_pct: float = 8.0) -> dict:
+    """The seeded memory-pressure-storm chaos profile (round 9).
+
+    One canonical scenario shared by the serve_bench ``--chaos-storm``
+    tier, the CI chaos gate, and the controller acceptance tests, so
+    "adaptive beats static under chaos" is always measured against the
+    SAME storm: injected RetryOOMs on a fraction of budget reservations
+    (extra arbiter churn inside every retry bracket) plus occasional
+    SplitAndRetryOOMs at the serve seam (handler-level split storms).
+    Real *sustained* pressure comes from the caller's undersized budget;
+    this profile adds the transient-fault weather on top.
+
+    Deterministic: the injector's config-level RNG is seeded, so the same
+    seed yields the same injected-fault schedule (the property
+    test_observability pins for the injector in general).
+    """
+    return {
+        "seed": int(seed),
+        "alloc": {"reserve:*": {"percent": float(retry_pct),
+                                "injectionType": "retry_oom"}},
+        "serve": {"handle:*": {"percent": float(split_pct),
+                               "injectionType": "split_oom"}},
+    }
 
 
 def install_from_env() -> Optional[FaultInjector]:

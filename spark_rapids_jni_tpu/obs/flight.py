@@ -49,6 +49,7 @@ __all__ = [
     "EV_SPLIT_RETRY", "EV_SPILL_BEGIN", "EV_SPILL_END",
     "EV_DEADLOCK_VERDICT", "EV_QUEUE_REJECT", "EV_QUEUE_TIMEOUT",
     "EV_TASK_DONE", "EV_TASK_KILLED", "EV_ANOMALY",
+    "EV_CONTROL_ADJUST", "EV_CONTROL_FREEZE", "EV_CONTROL_PRESPLIT",
     "EVENT_KINDS", "KIND_IDS", "DUMP_SCHEMA",
     "FlightRecorder", "record", "anomaly", "snapshot", "task_stats",
     "register_telemetry_source", "unregister_telemetry_source",
@@ -73,12 +74,23 @@ EV_QUEUE_TIMEOUT = "queue_timeout"     # deadline expired while queued
 EV_TASK_DONE = "task_done"             # task deregistered cleanly
 EV_TASK_KILLED = "task_killed"         # task failed terminally on OOM
 EV_ANOMALY = "anomaly"                 # a dump was triggered (detail=reason)
+# admission-controller decision ledger (serve/controller.py): every knob
+# adjustment, freeze transition, and pre-emptive split lands in the ring so
+# tools/flightdump.py can reconstruct WHY the admission posture changed
+EV_CONTROL_ADJUST = "control_adjust"   # knob changed (detail=knob:old->new
+#                                        :reason, value=new scaled)
+EV_CONTROL_FREEZE = "control_freeze"   # kill-switch froze (value=1) /
+#                                        resumed (value=0) the controller
+EV_CONTROL_PRESPLIT = "control_presplit"  # request split BEFORE dispatch
+#                                        (detail=handler:pieces)
 
 EVENT_KINDS = (
     EV_TASK_ADMITTED, EV_TASK_BLOCKED, EV_TASK_WOKEN, EV_RETRY,
     EV_SPLIT_RETRY, EV_SPILL_BEGIN, EV_SPILL_END, EV_DEADLOCK_VERDICT,
     EV_QUEUE_REJECT, EV_QUEUE_TIMEOUT, EV_TASK_DONE, EV_TASK_KILLED,
     EV_ANOMALY,
+    # round 9: appended (never reordered) so v2 STATE wire ids stay stable
+    EV_CONTROL_ADJUST, EV_CONTROL_FREEZE, EV_CONTROL_PRESPLIT,
 )
 KIND_IDS = {k: i for i, k in enumerate(EVENT_KINDS)}
 

@@ -22,11 +22,13 @@ O(log rows) compiled variants per plan, not one per distinct length.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from spark_rapids_jni_tpu.obs import flight as _flight
 from spark_rapids_jni_tpu.plans import ir
 from spark_rapids_jni_tpu.plans.cache import plan_cache
 from spark_rapids_jni_tpu.plans.compiler import (
@@ -36,9 +38,108 @@ from spark_rapids_jni_tpu.plans.compiler import (
 
 __all__ = ["pad_tables", "plan_working_set_bytes", "execute_plan",
            "run_governed_plan", "split_scan_tables", "combine_outputs",
-           "input_signature_raw", "compiled_plan_for"]
+           "input_signature_raw", "compiled_plan_for",
+           "plan_retry_stats", "suggested_presplit_depth",
+           "reset_plan_retry_stats"]
 
 Tables = Dict[str, Dict[str, np.ndarray]]
+
+
+# --------------------------------------------------------------------------
+# per-plan retry statistics (adaptive admission, round 9)
+#
+# Every governed plan execution records its retry/split history per PLAN
+# NAME — the request-class granularity the admission controller steers on.
+# ``suggested_presplit_depth`` turns that history into a pre-emptive split
+# depth: a plan whose recent runs SplitAndRetried starts its next run
+# already split, skipping the doomed full-size attempt (and its blocked
+# windows).  The hint DECAYS — one depth level per ``_PRESPLIT_DECAY_S``
+# without a new split — so a transient pressure episode doesn't pin small
+# pieces forever.  Gated on the serve_adaptive flag (and the controller
+# kill switch), so static configurations are bit-identical to round 8.
+# --------------------------------------------------------------------------
+
+_PRESPLIT_DECAY_S = 30.0
+_STATS_LOCK = threading.Lock()
+_PLAN_STATS: Dict[str, dict] = {}
+
+
+def _stats_entry(name: str) -> dict:
+    st = _PLAN_STATS.get(name)
+    if st is None:
+        st = _PLAN_STATS[name] = {
+            "runs": 0, "retries": 0, "split_retries": 0,
+            "presplit_depth": 0, "last_split_t": 0.0,
+        }
+    return st
+
+
+def _record_plan_retry(name: str) -> None:
+    with _STATS_LOCK:
+        _stats_entry(name)["retries"] += 1
+
+
+def _note_plan_run(name: str, presplit: int, reactive_splits: int,
+                   max_depth: int) -> None:
+    """Record one completed run: the observed total depth (pre-splits plus
+    the depth implied by REACTIVE split events — pre-split invocations of
+    the split callback are excluded, or the hint could never decay)
+    becomes the new hint when it exceeds the decayed current one."""
+    observed = presplit
+    if reactive_splits > 0:
+        observed += max(1, (reactive_splits + 1).bit_length() - 1)
+    now = time.monotonic()
+    with _STATS_LOCK:
+        st = _stats_entry(name)
+        st["runs"] += 1
+        if reactive_splits > 0:
+            st["split_retries"] += reactive_splits
+            st["last_split_t"] = now
+        # collapse the stored hint to its decayed value first, so a long-
+        # faded episode doesn't resurrect at full depth on the next split
+        st["presplit_depth"] = min(
+            max(observed, _decayed_depth(st, now)), max_depth)
+
+
+def _decayed_depth(st: dict, now: float) -> int:
+    if st["presplit_depth"] <= 0 or st["last_split_t"] <= 0.0:
+        return 0
+    faded = int((now - st["last_split_t"]) / _PRESPLIT_DECAY_S)
+    return max(0, st["presplit_depth"] - faded)
+
+
+def plan_retry_stats() -> Dict[str, dict]:
+    """Per-plan retry/split history (non-destructive copy), with the
+    decayed ``suggested_depth`` the next run would start at."""
+    now = time.monotonic()
+    with _STATS_LOCK:
+        return {name: dict(st, suggested_depth=_decayed_depth(st, now))
+                for name, st in _PLAN_STATS.items()}
+
+
+def suggested_presplit_depth(name: str, max_depth: int = 8) -> int:
+    """Pre-emptive split depth for the next run of plan ``name`` (0 =
+    attempt full size).  Returns 0 unless adaptive admission is enabled
+    AND the kill switch is clear — the static path must stay untouched."""
+    from spark_rapids_jni_tpu import config
+
+    if not config.get("serve_adaptive") or config.get(
+            "serve_controller_freeze"):
+        return 0
+    now = time.monotonic()
+    with _STATS_LOCK:
+        st = _PLAN_STATS.get(name)
+        if st is None:
+            return 0
+        return min(_decayed_depth(st, now), max_depth)
+
+
+def reset_plan_retry_stats() -> None:
+    with _STATS_LOCK:
+        _PLAN_STATS.clear()
+
+
+_flight.register_telemetry_source("plan_retry", plan_retry_stats)
 
 
 def _quantized(n: int, dp: int) -> int:
@@ -293,18 +394,42 @@ def run_governed_plan(
     scans = ir.scan_tables(plan)
     tables = _upload_dims(plan, tables, mesh)
 
+    # plan-granularity adaptive presplit: this request class's recent
+    # retry history decides whether to skip the full-size attempt (0 under
+    # static config / kill switch — bit-identical to the round-8 path)
+    presplit = suggested_presplit_depth(plan.name, max_split_depth)
+    inline_splits = [0]
+    attempted = [False]  # flips at the first run attempt: split() calls
+    # before it are the pre-split phase (NOT reactive pressure — counting
+    # them would pin the hint against decay; exact regardless of how many
+    # parts a custom split returns)
+    base_split = split or (lambda t: split_scan_tables(t, scans))
+
+    def split_counted(t):
+        if attempted[0]:
+            inline_splits[0] += 1
+        return base_split(t)
+
     def run(piece: Tables):
+        attempted[0] = True
         return execute_plan(mesh, plan, piece)
+
+    def on_retry(_count: int) -> None:
+        _record_plan_retry(plan.name)
 
     ctx = (task_context(budget.gov, task_id) if manage_task
            else contextlib.nullcontext())
     with ctx:
-        return run_with_split_retry(
+        out = run_with_split_retry(
             budget, tables,
             nbytes_of=nbytes_of or (
                 lambda t: plan_working_set_bytes(plan, t, dp)),
             run=run,
-            split=split or (lambda t: split_scan_tables(t, scans)),
+            split=split_counted,
             combine=combine or combine_outputs,
             max_split_depth=max_split_depth,
+            initial_split_depth=presplit,
+            on_retry=on_retry,
         )
+    _note_plan_run(plan.name, presplit, inline_splits[0], max_split_depth)
+    return out

@@ -20,6 +20,7 @@ governed execution, split re-queueing, micro-batching), serve.metrics
 (counters + latency histograms, exported through the obs seam).
 """
 
+from spark_rapids_jni_tpu.serve.controller import AdmissionController, Knob
 from spark_rapids_jni_tpu.serve.executor import (
     HandlerContext,
     QueryHandler,
@@ -41,8 +42,10 @@ from spark_rapids_jni_tpu.serve.session import (
 )
 
 __all__ = [
+    "AdmissionController",
     "AdmissionQueue",
     "Backpressure",
+    "Knob",
     "HandlerContext",
     "LatencyHistogram",
     "QueryHandler",

@@ -151,13 +151,16 @@ class ServingEngine:
                  queue_size: Optional[int] = None,
                  default_deadline_s: Optional[float] = 30.0,
                  micro_batch_max: int = 8, max_split_depth: int = 8,
-                 builtin_handlers: bool = False):
+                 builtin_handlers: bool = False,
+                 adaptive: Optional[bool] = None):
         from spark_rapids_jni_tpu import config
 
         if workers is None:
             workers = int(config.get("serve_workers"))
         if queue_size is None:
             queue_size = int(config.get("serve_queue_size"))
+        if adaptive is None:
+            adaptive = bool(config.get("serve_adaptive"))
         if mesh is None and builtin_handlers:
             from spark_rapids_jni_tpu.parallel import make_mesh
 
@@ -187,6 +190,14 @@ class ServingEngine:
         self._seq = itertools.count()
         self._handlers: dict = {}
         self._reg_lock = threading.Lock()  # guards handler registration
+        # adaptive-admission state (serve/controller.py): the static knob
+        # values the kill switch restores, per-handler pre-emptive split
+        # depths the controller sets, and per-handler split history it
+        # reads.  One leaf lock, never held across calls into other layers.
+        self.static_queue_size = queue_size
+        self._ctl_lock = threading.Lock()
+        self._presplit: dict = {}       # handler -> pre-dispatch split depth
+        self._class_splits: dict = {}   # handler -> cumulative splits seen
         self._ewma_lock = threading.Lock()
         self._ewma_service_s = 0.05
         # queue-saturation detector: N consecutive backpressure rejections
@@ -221,6 +232,15 @@ class ServingEngine:
         ]
         for t in self._workers:
             t.start()
+        self.adaptive = adaptive
+        self.controller = None
+        if adaptive:
+            from spark_rapids_jni_tpu.serve.controller import (
+                AdmissionController,
+            )
+
+            self.controller = AdmissionController(self)
+            self.controller.start()
 
     # -- registration / sessions -------------------------------------------
     def register(self, handler: QueryHandler) -> None:
@@ -239,8 +259,13 @@ class ServingEngine:
 
     def open_session(self, name: Optional[str] = None, *, priority: int = 0,
                      byte_budget: Optional[int] = None) -> Session:
-        return self.sessions.open(name, priority=priority,
+        sess = self.sessions.open(name, priority=priority,
                                   byte_budget=byte_budget)
+        if self.controller is not None:  # join at the CURRENT posture,
+            # not the static one (a tenant arriving mid-overload must not
+            # enforce its full static budget until the next adjustment)
+            self.controller.apply_to_new_session(sess)
+        return sess
 
     def close_session(self, session: Session) -> None:
         self.sessions.close(session)
@@ -268,7 +293,11 @@ class ServingEngine:
         req = Request(
             handler=handler, payload=payload,
             session_id=session.session_id,
-            priority=priority if priority is not None else session.priority,
+            # session.age_boost is the controller's anti-starvation knob
+            # (0 under static config): an explicit per-request priority
+            # still wins outright
+            priority=(priority if priority is not None
+                      else session.priority + session.age_boost),
             deadline=(time.monotonic() + dl) if dl is not None else None,
             seq=next(self._seq),
             task_id=self.sessions.next_task_id(),
@@ -326,6 +355,8 @@ class ServingEngine:
         first; anything still queued after the wait (or with drain=False)
         completes as cancelled — never silently lost."""
         deadline = time.monotonic() + timeout
+        if self.controller is not None:
+            self.controller.stop()
         if drain:
             # queued + popped-but-unfinished under ONE lock: no window
             # where an in-flight request is invisible to the drain
@@ -347,6 +378,41 @@ class ServingEngine:
 
     def __exit__(self, *exc):
         self.shutdown()
+
+    # -- adaptive-admission surface (serve/controller.py) -------------------
+    def set_presplit(self, handler: str, depth: int) -> None:
+        """Controller knob: split ``handler`` requests ``depth`` times
+        BEFORE dispatch (0 clears).  Only top-level splittable requests
+        pre-split; halves and self-governed handlers are untouched."""
+        with self._ctl_lock:
+            if depth <= 0:
+                self._presplit.pop(handler, None)
+            else:
+                self._presplit[handler] = min(int(depth),
+                                              self.max_split_depth)
+
+    def presplit_depth(self, handler: str) -> int:
+        with self._ctl_lock:
+            return self._presplit.get(handler, 0)
+
+    def presplit_map(self) -> dict:
+        with self._ctl_lock:
+            return dict(self._presplit)
+
+    def class_split_counts(self) -> dict:
+        """Cumulative reactive TOP-LEVEL splits per handler class — the
+        history the controller turns into pre-emptive split depths.  Only
+        depth-0 splits count: a pre-split (or half) that splits again is
+        either deeper real pressure the NEXT top-level split will re-report
+        or injected chaos weather — escalating on it would ratchet the
+        knob toward max depth under any sustained fault storm."""
+        with self._ctl_lock:
+            return dict(self._class_splits)
+
+    def _note_class_split(self, handler: str, n: int = 1) -> None:
+        with self._ctl_lock:
+            self._class_splits[handler] = (
+                self._class_splits.get(handler, 0) + n)
 
     # -- internals ----------------------------------------------------------
     def _retry_after(self, depth: int) -> float:
@@ -446,6 +512,13 @@ class ServingEngine:
 
     def _serve_group(self, req: Request) -> List[Request]:
         h = self._handlers[req.handler]
+        if (req.split_depth == 0 and req.join is None
+                and h.split is not None and not h.self_governed):
+            depth = self.presplit_depth(req.handler)
+            if depth > 0:
+                parts, d = self._presplit_parts(req.payload, h, depth)
+                if len(parts) > 1:
+                    return self._presplit_dispatch(req, h, parts, d)
         now_ns = time.monotonic_ns()
         group = self._gather_batch(req, h)
         for r in group:
@@ -601,6 +674,64 @@ class ServingEngine:
                 grows += 1
                 state["payload"] = h.grow(state["payload"])
 
+    def _presplit_parts(self, payload: Any, h: QueryHandler,
+                        depth: int) -> tuple:
+        """Split ``payload`` up to ``depth`` times (``split`` returns
+        halves; applied per level).  Returns (parts, achieved_depth) —
+        callers fall back to normal dispatch when nothing split."""
+        parts = [payload]
+        d = 0
+        while d < min(depth, self.max_split_depth):
+            nxt: List[Any] = []
+            for p in parts:
+                sub = list(h.split(p))
+                nxt.extend(sub if len(sub) > 1 else [p])
+            if len(nxt) == len(parts):
+                break  # not splittable further
+            parts = nxt
+            d += 1
+        return parts, d
+
+    def _presplit_dispatch(self, req: Request, h: QueryHandler,
+                           parts: List[Any], depth: int) -> List[Request]:
+        """Pre-emptive split sizing: the controller marked this request
+        class as one whose history shows SplitAndRetryOOM, so skip the
+        doomed full-size attempt (and its blocked/retry churn) and
+        dispatch the pieces directly through the same join machinery a
+        reactive split uses."""
+        now_ns = time.monotonic_ns()
+        if req.response.admitted_ns == 0:
+            req.response.admitted_ns = now_ns
+            self.metrics.count("admitted", req.session_id)
+            self.metrics.record_wait(now_ns - req.response.submitted_ns)
+        self.metrics.count("presplit", req.session_id)
+        _flight.record(_flight.EV_CONTROL_PRESPLIT, req.task_id,
+                       detail=f"handler:{h.name}:pieces:{len(parts)}",
+                       value=len(parts))
+        join = _SplitJoin(req, h.combine, len(parts), self._finish)
+        children = [
+            Request(
+                handler=req.handler, payload=part,
+                session_id=req.session_id, priority=req.priority,
+                deadline=req.deadline, seq=next(self._seq),
+                task_id=self.sessions.next_task_id(),
+                split_depth=depth,
+                no_batch=True, join=join, join_slot=slot,
+            )
+            for slot, part in enumerate(parts)
+        ]
+        for child in children[1:]:
+            self._requeue(child)  # force-admitted, as for reactive halves
+        # the first piece runs INLINE on this worker: the request already
+        # owns a pop slot, so one piece fewer rides the queue (lower
+        # occupancy under exactly the pressure that triggered presplit)
+        # and the join's critical path loses one queue round trip.  The
+        # child was never handed out by the queue, so it must NOT flow
+        # through _serve/task_done — _serve_group alone keeps every
+        # terminal/requeue path it needs.
+        self._serve_group(children[0])
+        return [req]
+
     def _requeue(self, req: Request, *, no_batch: bool = False) -> None:
         req.no_batch = req.no_batch or no_batch
         try:
@@ -646,6 +777,8 @@ class ServingEngine:
             self._finish(req, ERROR,
                          error=MemoryError("request is not splittable"))
             return
+        if req.split_depth == 0:  # see class_split_counts: only top-level
+            self._note_class_split(req.handler)
         join = _SplitJoin(req, h.combine, len(parts), self._finish)
         self.metrics.count("split_requeued", req.session_id, n=len(parts))
         for slot, part in enumerate(parts):

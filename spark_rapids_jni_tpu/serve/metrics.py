@@ -83,6 +83,7 @@ COUNTERS = (
     "timed_out",        # deadline expired (in queue or between retries)
     "retried",          # RetryOOM re-attempts inside the bracket
     "split_requeued",   # SplitAndRetryOOM -> halves re-queued
+    "presplit",         # requests split BEFORE dispatch (controller knob)
     "batched",          # requests that rode a micro-batch launch
     "cancelled",        # queue shut down with the request still waiting
     "protocol_leaked",  # control-flow exception escaped every bracket (bug)

@@ -111,6 +111,8 @@ class Request:
     seq: int                 # global submit order; also the tiebreaker
     task_id: int             # governor task id (arbiter priority follows it)
     response: Response = dataclasses.field(default_factory=Response)
+    boost: int = 0           # priority-aging bonus (controller-set; the
+    #                          effective pop priority is priority + boost)
     split_depth: int = 0     # how many split-requeues produced this piece
     no_batch: bool = False   # excluded from micro-batching (post-split)
     join: Any = None         # _SplitJoin linking a half to its parent
@@ -162,7 +164,8 @@ class AdmissionQueue:
             if req.response.submitted_ns == 0:  # re-submits (split halves,
                 # disbanded mates) keep the original wait clock
                 req.response.submitted_ns = time.monotonic_ns()
-            heapq.heappush(self._heap, (-req.priority, req.seq, req))
+            heapq.heappush(
+                self._heap, (-(req.priority + req.boost), req.seq, req))
             self._cond.notify()
         return req.response
 
@@ -226,6 +229,88 @@ class AdmissionQueue:
         with self._cond:
             self._handed_out -= n
             self._cond.notify_all()
+
+    # -- controller knobs ---------------------------------------------------
+    def set_maxsize(self, n: int) -> int:
+        """Retune the occupancy bound (the admission controller's queue-
+        depth knob).  Shrinking proactively completes deadline-expired
+        queued requests as timed-out — they would otherwise occupy the
+        now-scarcer slots until popped, shielding live work from the
+        tighter bound the controller just asked for.  Live requests are
+        NEVER purged (they were admitted; the bound governs new submits
+        only).  Returns the number of purged entries."""
+        n = max(1, int(n))
+        with self._cond:
+            shrinking = n < self.maxsize
+            self.maxsize = n
+            if not shrinking:
+                return 0
+            now = time.monotonic()
+            keep, purged = [], 0
+            for entry in self._heap:
+                req = entry[2]
+                if req.expired(now):
+                    self._timeout_locked(req)
+                    purged += 1
+                else:
+                    keep.append(entry)
+            if purged:
+                self._heap = keep
+                heapq.heapify(self._heap)
+            return purged
+
+    def age_sessions(self, boosts: dict) -> int:
+        """Apply priority-aging boosts (``{session_id: boost}``) to queued
+        requests and re-order the heap.  Boosts are absolute levels, not
+        increments — re-applying the same mapping is idempotent, and a
+        session's boost only ever ratchets a queued request upward (a
+        lowered boost applies to future submits via the session, never
+        demotes work already in line).  Returns how many requests moved."""
+        if not boosts:
+            return 0
+        changed = 0
+        with self._cond:
+            for entry in self._heap:
+                req = entry[2]
+                b = int(boosts.get(req.session_id, 0))
+                if b > req.boost:
+                    req.boost = b
+                    changed += 1
+            if changed:
+                self._heap = [(-(r.priority + r.boost), r.seq, r)
+                              for _, _, r in self._heap]
+                heapq.heapify(self._heap)
+        return changed
+
+    def clear_boosts(self) -> int:
+        """Reset every queued request's aging boost to 0 and re-order —
+        the freeze path: after the kill switch, pop order must be exactly
+        the static (priority, seq) order, including for entries boosted
+        before the freeze.  Returns how many requests changed."""
+        with self._cond:
+            changed = 0
+            for _, _, req in self._heap:
+                if req.boost:
+                    req.boost = 0
+                    changed += 1
+            if changed:
+                self._heap = [(-r.priority, r.seq, r)
+                              for _, _, r in self._heap]
+                heapq.heapify(self._heap)
+            return changed
+
+    def session_waits(self) -> dict:
+        """Oldest queued wait (seconds) per session — the starvation
+        signal priority aging feeds on.  Sampled at controller tick rate,
+        so the O(depth) scan is off every hot path."""
+        now_ns = time.monotonic_ns()
+        out: dict = {}
+        with self._cond:
+            for _, _, req in self._heap:
+                wait_s = (now_ns - req.response.submitted_ns) / 1e9
+                if wait_s > out.get(req.session_id, 0.0):
+                    out[req.session_id] = wait_s
+        return out
 
     # -- introspection / lifecycle ------------------------------------------
     def depth(self) -> int:

@@ -36,11 +36,43 @@ class Session:
                  byte_budget: Optional[int]):
         self.session_id = session_id
         self.priority = priority
-        self.byte_budget = byte_budget  # None = uncapped
+        self.byte_budget = byte_budget  # None = uncapped (static config)
         self.closed = False
         self._lock = threading.Lock()
         self.inflight_bytes = 0
         self.inflight_requests = 0
+        # adaptive-admission knobs (serve/controller.py).  budget_scale
+        # multiplies the STATIC byte_budget into the effective cap charge()
+        # enforces — under pressure the controller shrinks every tenant's
+        # concurrent working set without touching the configured budget,
+        # and 1.0 restores static behavior exactly.  age_boost is added to
+        # this session's queue priority at submit (and ratcheted onto
+        # already-queued requests via AdmissionQueue.age_sessions), so a
+        # starved low-priority tenant climbs instead of aging out.
+        self.budget_scale = 1.0
+        self.age_boost = 0
+
+    def set_budget_scale(self, scale: float) -> None:
+        with self._lock:
+            self.budget_scale = min(1.0, max(0.05, float(scale)))
+
+    def set_age_boost(self, boost: int) -> None:
+        with self._lock:
+            self.age_boost = max(0, int(boost))
+
+    def _effective_cap(self) -> Optional[int]:
+        """The byte cap charge() enforces right now (None = uncapped):
+        the static budget scaled by the controller's knob, floored at one
+        byte so a capped session can never become accidentally uncapped
+        (or cap-zero) through scaling.  Lock-free; callers hold _lock or
+        accept a racy read (effective_budget)."""
+        if self.byte_budget is None:
+            return None
+        return max(1, int(self.byte_budget * self.budget_scale))
+
+    def effective_budget(self) -> Optional[int]:
+        with self._lock:
+            return self._effective_cap()
 
     def charge(self, nbytes: int) -> None:
         """Reserve ``nbytes`` of the session budget for one request, or
@@ -50,14 +82,16 @@ class Session:
             if self.closed:
                 raise RuntimeError(f"session {self.session_id} is closed")
             if self.byte_budget is not None:
-                if nbytes > self.byte_budget:
+                cap = self._effective_cap()
+                if nbytes > cap:
                     raise SessionBudgetExceeded(
                         f"request working set {nbytes} exceeds session "
-                        f"budget {self.byte_budget}")
-                if self.inflight_bytes + nbytes > self.byte_budget:
+                        f"budget {cap} (static {self.byte_budget} x "
+                        f"scale {self.budget_scale:g})")
+                if self.inflight_bytes + nbytes > cap:
                     raise SessionBudgetExceeded(
                         f"session budget exhausted: {self.inflight_bytes} "
-                        f"in flight + {nbytes} > {self.byte_budget}")
+                        f"in flight + {nbytes} > {cap}")
             self.inflight_bytes += nbytes
             self.inflight_requests += 1
 
@@ -72,6 +106,8 @@ class Session:
                 "session_id": self.session_id,
                 "priority": self.priority,
                 "byte_budget": self.byte_budget,
+                "budget_scale": self.budget_scale,
+                "age_boost": self.age_boost,
                 "inflight_bytes": self.inflight_bytes,
                 "inflight_requests": self.inflight_requests,
                 "closed": self.closed,
@@ -113,6 +149,11 @@ class SessionRegistry:
     def get(self, session_id: str) -> Session:
         with self._lock:
             return self._sessions[session_id]
+
+    def all_open(self) -> list:
+        """Live sessions (the controller's knob-application sweep)."""
+        with self._lock:
+            return [s for s in self._sessions.values() if not s.closed]
 
     def next_task_id(self) -> int:
         return next(self._task_seq)

@@ -779,6 +779,46 @@ def test_flight_registered_constant_clean(tmp_path):
     assert run(root, rules=["flight-discipline"]) == []
 
 
+def test_flight_control_vocabulary_clean(tmp_path):
+    """The round-9 controller vocabulary (EV_CONTROL_*) is parsed from
+    obs/flight.py like every other kind: registered constants pass at
+    record() sites in serve/controller.py."""
+    files = dict(FLIGHT_PKG)
+    files["obs/flight.py"] = FLIGHT_PKG["obs/flight.py"] + """
+        EV_CONTROL_ADJUST = "control_adjust"
+        EV_CONTROL_FREEZE = "control_freeze"
+    """
+    files["serve/controller.py"] = """
+        from pkg.obs import flight
+
+
+        def adjust(knob, old, new):
+            flight.record(flight.EV_CONTROL_ADJUST, -1,
+                          detail=f"{knob}:{old}->{new}")
+            flight.record(flight.EV_CONTROL_FREEZE, -1, value=1)
+    """
+    root = write_pkg(tmp_path, files)
+    assert run(root, rules=["flight-discipline"]) == []
+
+
+def test_flight_control_unregistered_kind_flagged(tmp_path):
+    """A controller emitting a decision event that is NOT in the EV_*
+    vocabulary falls out of every ledger reconstruction — flagged."""
+    files = dict(FLIGHT_PKG)
+    files["serve/controller.py"] = """
+        from pkg.obs.flight import record
+
+        EV_CONTROL_ROGUE = "control_rogue"
+
+
+        def adjust():
+            record(EV_CONTROL_ROGUE, -1)
+    """
+    root = write_pkg(tmp_path, files)
+    fs = run(root, rules=["flight-discipline"])
+    assert len(fs) == 1 and "not a registered" in fs[0].message
+
+
 def test_flight_suppression_honored(tmp_path):
     files = dict(FLIGHT_PKG)
     files["mem/sup.py"] = """

@@ -1,0 +1,51 @@
+"""Acceptance chaos tier (slow): the seeded pressure storm.
+
+Runs the exact tier CI runs (tools/serve_bench.py --chaos-storm): paired
+(static, adaptive) rounds under an identical seeded fault schedule —
+injected RetryOOM weather on reservations, SplitAndRetryOOM weather at the
+serve seam — over a deliberately undersized device budget, so every
+full-size request draws the split protocol.  The ISSUE-7 acceptance
+criterion: adaptive admission beats static config on p99 latency AND
+rejected-request count, with ZERO lost requests in every round.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from conftest import scrubbed_cpu_env
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_pressure_storm_adaptive_beats_static():
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "serve_bench.py"),
+         "--chaos-storm", "--clients", "4", "--requests", "160",
+         "--workers", "2", "--queue-size", "8", "--seed", "7"],
+        cwd=ROOT, env=scrubbed_cpu_env(), capture_output=True, text=True,
+        timeout=600)
+    assert out.returncode == 0, f"storm gate failed:\n{out.stdout}\n{out.stderr}"
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["mode"] == "chaos_storm"
+    c = rec["comparison"]
+    # zero lost, every round, both tiers
+    assert rec["zero_lost"], rec
+    for rnd in rec["rounds"]:
+        for tier in ("static", "adaptive"):
+            assert rnd[tier]["lost"] == 0
+            assert rnd[tier]["outcomes"]["errors"] == 0
+            assert rnd[tier]["outcomes"]["wrong_answers"] == 0
+    # the headline win: median p99 strictly better, rejects no worse
+    assert c["adaptive_wins_p99"], c
+    assert c["adaptive_wins_rejects"], c
+    # the adaptive tiers actually adapted (presplit landed and was used)
+    assert any(r["adaptive"]["counters"]["presplit"] > 0
+               for r in rec["rounds"]), rec
+    # and the decision ledger recorded why
+    assert any(r["adaptive"]["controller"]["ledger_tail"]
+               for r in rec["rounds"])

@@ -129,9 +129,15 @@ def test_anomaly_dump_schema_artifact_and_rate_limit(tmp_path):
 
 
 def test_event_kind_vocabulary_is_stable():
-    # wire ids are tuple positions: appending is safe, reordering is not
+    # wire ids are tuple positions: appending is safe, reordering is not —
+    # the round-7 vocabulary keeps its ids (v2 captures stay readable),
+    # and the round-9 controller kinds are strictly appended after it
     assert flight.EVENT_KINDS.index("admitted") == 0
-    assert flight.KIND_IDS[flight.EV_ANOMALY] == len(flight.EVENT_KINDS) - 1
+    assert flight.KIND_IDS[flight.EV_ANOMALY] == 12
+    assert (flight.KIND_IDS[flight.EV_CONTROL_ADJUST]
+            > flight.KIND_IDS[flight.EV_ANOMALY])
+    assert flight.EVENT_KINDS[-3:] == ("control_adjust", "control_freeze",
+                                       "control_presplit")
     assert len(set(flight.EVENT_KINDS)) == len(flight.EVENT_KINDS)
 
 

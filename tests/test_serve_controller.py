@@ -1,0 +1,431 @@
+"""Adaptive admission controller: convergence, clamps, freeze, knobs.
+
+What round 9's acceptance pins (ISSUE 7):
+
+- hysteresis prevents oscillation under a square-wave pressure signal
+  (the EWMA + band + dwell combination holds, it does not flap);
+- min/max clamps hold at both extremes under sustained pressure/calm;
+- the kill-switch freeze is immediate and restores every knob to its
+  static value (bit-identical admission decisions to serve_adaptive=off);
+- pre-emptive split sizing: a class with SplitAndRetry history splits
+  BEFORE dispatch, exactly once per level, with correct joined results;
+- queue shrink purges deadline-expired entries with queue_timeout flight
+  events; priority aging ratchets starved sessions upward;
+- the arbiter's rolling blocked-ns gauge reports trends, not lifetimes;
+- every decision lands in the ledger + flight ring (EV_CONTROL_*), and
+  tools/flightdump.py reconstructs it.
+"""
+
+import threading
+import time
+
+import pytest
+
+from spark_rapids_jni_tpu import config
+from spark_rapids_jni_tpu.mem import BudgetedResource, MemoryGovernor
+from spark_rapids_jni_tpu.mem.governed import task_context
+from spark_rapids_jni_tpu.mem.governor import budget_gauges
+from spark_rapids_jni_tpu.obs import flight as _flight
+from spark_rapids_jni_tpu.serve import (
+    AdmissionController,
+    AdmissionQueue,
+    QueryHandler,
+    Request,
+    ServingEngine,
+)
+
+
+@pytest.fixture
+def gov():
+    g = MemoryGovernor(watchdog_period_s=0.02)
+    yield g
+    g.close()
+
+
+def _engine(gov, **kw):
+    kw.setdefault("workers", 2)
+    kw.setdefault("queue_size", 16)
+    kw.setdefault("default_deadline_s", 60.0)
+    kw.setdefault("adaptive", False)  # tests drive tick() by hand
+    budget = BudgetedResource(gov, kw.pop("budget_bytes", 1 << 20))
+    return ServingEngine(gov=gov, budget=budget, **kw)
+
+
+def _sig(p=0.0, **kw):
+    base = {"mem_frac": p, "blocked_frac": 0.0, "counters": {},
+            "class_splits": {}, "session_waits": {}}
+    base.update(kw)
+    return base
+
+
+# ------------------------------------------------------------- hysteresis
+
+
+def test_square_wave_pressure_does_not_oscillate(gov):
+    """A square wave flapping between full and zero pressure every tick
+    must NOT flap the knobs: the EWMA settles into the hysteresis band
+    and, after the initial transient, no further adjustments happen."""
+    eng = _engine(gov)
+    try:
+        ctl = AdmissionController(eng, band_lo=0.4, dwell_ticks=1)
+        for i in range(100):
+            ctl.tick(_sig(1.0 if i % 2 == 0 else 0.0))
+        ledger = list(ctl.ledger)
+        assert ledger, "the first full-pressure tick should adjust"
+        # after the transient (EWMA limit cycle ~[0.41, 0.59], inside the
+        # [0.4, 0.85] band) the controller HOLDS: no flapping
+        assert all(d["tick"] <= 4 for d in ledger), ledger
+        assert len(ledger) <= 4
+        ctl.stop()
+    finally:
+        eng.shutdown()
+
+
+def test_clamps_hold_at_both_extremes(gov):
+    eng = _engine(gov, queue_size=16)
+    try:
+        sess = eng.open_session("t", byte_budget=1000)
+        ctl = AdmissionController(eng, dwell_ticks=1)
+        for _ in range(50):
+            ctl.tick(_sig(1.0))
+        snap = ctl.snapshot()
+        assert snap["knobs"]["queue_depth"]["value"] == 4  # 16 // 4
+        assert snap["knobs"]["session_scale"]["value"] == 0.25
+        assert eng.queue.maxsize == 4
+        assert sess.budget_scale == 0.25
+        assert sess.effective_budget() == 250
+        # a tenant joining MID-overload starts at the current posture,
+        # not the static one (the knob only pushes on value changes)
+        eng.controller = ctl  # what adaptive=True wires up
+        late = eng.open_session("late", byte_budget=1000)
+        eng.controller = None
+        assert late.budget_scale == 0.25
+        for _ in range(50):
+            ctl.tick(_sig(0.0))
+        snap = ctl.snapshot()
+        assert snap["knobs"]["queue_depth"]["value"] == 16
+        assert snap["knobs"]["session_scale"]["value"] == 1.0
+        assert eng.queue.maxsize == 16
+        assert sess.budget_scale == 1.0
+        ctl.stop()
+    finally:
+        eng.shutdown()
+
+
+def test_session_scale_rejects_then_recovers(gov):
+    """The scaled-down cap actually bites at submit, and scaling back
+    restores the static cap exactly."""
+    eng = _engine(gov)
+    try:
+        from spark_rapids_jni_tpu.serve import SessionBudgetExceeded
+
+        eng.register(QueryHandler(name="w", fn=lambda p, ctx: p,
+                                  nbytes_of=lambda p: int(p)))
+        sess = eng.open_session("t", byte_budget=1000)
+        ctl = AdmissionController(eng, dwell_ticks=1)
+        for _ in range(50):
+            ctl.tick(_sig(1.0))
+        with pytest.raises(SessionBudgetExceeded):
+            eng.submit(sess, "w", 600)  # fits static 1000, not 0.25x
+        for _ in range(50):
+            ctl.tick(_sig(0.0))
+        assert eng.submit(sess, "w", 600).result(timeout=30) == 600
+        ctl.stop()
+    finally:
+        eng.shutdown()
+
+
+# ------------------------------------------------------------ kill switch
+
+
+def test_kill_switch_freeze_is_immediate_and_static(gov):
+    eng = _engine(gov, queue_size=16)
+    try:
+        sess = eng.open_session("t", byte_budget=1000)
+        ctl = AdmissionController(eng, dwell_ticks=1)
+        for _ in range(50):
+            ctl.tick(_sig(1.0, class_splits={"w": 3}))
+        assert eng.queue.maxsize == 4
+        assert eng.presplit_depth("w") >= 1
+        ring_before = len([e for e in _flight.snapshot()
+                           if e["kind"] == "control_freeze"])
+        with config.override(serve_controller_freeze=True):
+            ctl.tick(_sig(1.0))  # first frozen tick resets everything
+            snap = ctl.snapshot()
+            assert snap["frozen"]
+            for name, k in snap["knobs"].items():
+                assert k["value"] == k["static"], name
+            assert eng.queue.maxsize == 16
+            assert sess.budget_scale == 1.0
+            assert sess.age_boost == 0
+            assert eng.presplit_map() == {}
+            n_ledger = len(ctl.ledger)
+            for _ in range(20):  # frozen: pressure changes nothing
+                ctl.tick(_sig(1.0))
+            assert len(ctl.ledger) == n_ledger
+        freezes = [e for e in _flight.snapshot()
+                   if e["kind"] == "control_freeze"]
+        assert len(freezes) == ring_before + 1
+        assert freezes[-1]["value"] == 1
+        # unfreeze: the controller resumes adjusting
+        for _ in range(10):
+            ctl.tick(_sig(1.0))
+        assert eng.queue.maxsize < 16
+        ctl.stop()
+    finally:
+        eng.shutdown()
+
+
+# --------------------------------------------------------------- presplit
+
+
+def test_presplit_escalates_decays_and_dispatches(gov):
+    eng = _engine(gov)
+    try:
+        calls = []
+
+        def fn(p, ctx):
+            calls.append(len(p))
+            return sum(p)
+
+        eng.register(QueryHandler(
+            name="sum", fn=fn, nbytes_of=lambda p: 8 * len(p),
+            split=lambda p: [p[:len(p) // 2], p[len(p) // 2:]],
+            combine=sum))
+        sess = eng.open_session("t")
+        ctl = AdmissionController(eng, dwell_ticks=1,
+                                  presplit_decay_ticks=3)
+        # escalation: one top-level split observed -> depth 1; sustained
+        # evidence (delta >= 2) -> depth 2
+        ctl.tick(_sig(class_splits={"sum": 1}))
+        assert eng.presplit_depth("sum") == 1
+        ctl.tick(_sig(class_splits={"sum": 2}))  # delta 1 < 2: holds at 1
+        assert eng.presplit_depth("sum") == 1
+        ctl.tick(_sig(class_splits={"sum": 5}))  # delta 3: deepen
+        assert eng.presplit_depth("sum") == 2
+        # dispatch: the request splits BEFORE running — 4 pieces, no
+        # full-size attempt, exact joined result
+        assert eng.submit(sess, "sum", list(range(16))).result(timeout=30) \
+            == sum(range(16))
+        assert eng.metrics.get("presplit") == 1
+        assert calls and all(n == 4 for n in calls)
+        assert any(e["kind"] == "control_presplit"
+                   for e in _flight.snapshot())
+        # decay: quiet ticks at LOW pressure step the knob back down
+        for _ in range(10):
+            ctl.tick(_sig(0.0, class_splits={"sum": 5}))
+        assert eng.presplit_depth("sum") < 2
+        ctl.stop()
+    finally:
+        eng.shutdown()
+
+
+def test_presplit_decay_held_back_while_pressure_high(gov):
+    """Mid-storm the decay probe must NOT hand a request the doomed
+    full-size attempt: quiet ticks only decay once pressure subsides."""
+    eng = _engine(gov)
+    try:
+        ctl = AdmissionController(eng, dwell_ticks=1,
+                                  presplit_decay_ticks=2)
+        ctl.tick(_sig(1.0, class_splits={"w": 1}))
+        assert eng.presplit_depth("w") == 1
+        for _ in range(20):  # quiet but still under pressure: hold
+            ctl.tick(_sig(1.0, class_splits={"w": 1}))
+        assert eng.presplit_depth("w") == 1
+        for _ in range(30):  # pressure gone: probe back toward full size
+            ctl.tick(_sig(0.0, class_splits={"w": 1}))
+        assert eng.presplit_depth("w") == 0
+        ctl.stop()
+    finally:
+        eng.shutdown()
+
+
+# ------------------------------------------------- queue purge + aging
+
+
+def test_queue_shrink_purges_expired_with_flight_events(gov):
+    eng = _engine(gov, workers=1, queue_size=8)
+    try:
+        release = threading.Event()
+        eng.register(QueryHandler(name="block",
+                                  fn=lambda p, ctx: release.wait(30) and p,
+                                  nbytes_of=lambda p: 8))
+        eng.register(QueryHandler(name="w", fn=lambda p, ctx: p,
+                                  nbytes_of=lambda p: 8))
+        sess = eng.open_session("t")
+        blocker = eng.submit(sess, "block", 1)
+        time.sleep(0.05)  # the single worker is now parked in "block"
+        stale = [eng.submit(sess, "w", i, deadline_s=0.01)
+                 for i in range(3)]
+        live = eng.submit(sess, "w", 99, deadline_s=30.0)
+        time.sleep(0.05)  # the short deadlines expire IN the queue
+        before = len([e for e in _flight.snapshot()
+                      if e["kind"] == "queue_timeout"])
+        purged = eng.queue.set_maxsize(2)
+        assert purged == 3
+        after = [e for e in _flight.snapshot()
+                 if e["kind"] == "queue_timeout"]
+        assert len(after) == before + 3
+        for r in stale:
+            assert r.status == "timed_out"
+        assert live.status == "pending"  # live entries are never purged
+        release.set()
+        assert blocker.result(timeout=30) == 1
+        assert live.result(timeout=30) == 99
+    finally:
+        release.set()
+        eng.shutdown()
+
+
+def test_priority_aging_ratchets_starved_session(gov):
+    # queue-level ordering: aging lifts an old low-priority request over
+    # a fresher high-priority one, idempotently
+    q = AdmissionQueue(8)
+    old = Request(handler="w", payload=1, session_id="starved", priority=0,
+                  deadline=None, seq=0, task_id=1)
+    fresh = Request(handler="w", payload=2, session_id="vip", priority=1,
+                    deadline=None, seq=1, task_id=2)
+    q.submit(old)
+    q.submit(fresh)
+    assert q.age_sessions({"starved": 2}) == 1
+    assert q.age_sessions({"starved": 2}) == 0  # idempotent: no re-bump
+    # the freeze path restores STATIC order for already-boosted entries
+    assert q.clear_boosts() == 1
+    assert q.pop(timeout=1).session_id == "vip"
+    assert q.age_sessions({"starved": 2}) == 1  # re-boost the remaining
+    assert q.pop(timeout=1).session_id == "starved"
+    q.close()
+
+
+def test_controller_aging_sets_and_clears_boosts(gov):
+    eng = _engine(gov)
+    try:
+        sess = eng.open_session("slow")
+        ctl = AdmissionController(eng, age_after_s=1.0, max_age_boost=3)
+        ctl.tick(_sig(session_waits={"slow": 2.5}))
+        assert sess.age_boost == 2
+        assert ctl.snapshot()["age_boosts"] == {"slow": 2}
+        ctl.tick(_sig(session_waits={}))  # served: boost decays to 0
+        assert sess.age_boost == 0
+        assert ctl.snapshot()["age_boosts"] == {}
+        ctl.stop()
+    finally:
+        eng.shutdown()
+
+
+# ------------------------------------------------ rolling blocked gauge
+
+
+def test_rolling_blocked_gauge_reports_trend(gov):
+    budget = BudgetedResource(gov, 100)
+    woke = threading.Event()
+
+    def contender():
+        with task_context(gov, 2):
+            budget.acquire(80)  # parks: task 1 holds the budget
+            budget.release(80)
+        woke.set()
+
+    with task_context(gov, 1):
+        budget.acquire(80)
+        t = threading.Thread(target=contender)
+        t.start()
+        time.sleep(0.08)  # let task 2 park (an OPEN window counts too)
+        open_rolled = gov.arbiter.rolling_blocked(window_s=10.0)
+        budget.release(80)
+    assert woke.wait(10) and not t.join(10)
+    assert open_rolled.get(2, 0) > 0, "open park must read as pressure"
+    rolled = gov.arbiter.rolling_blocked(window_s=10.0)
+    assert rolled.get(2, 0) >= int(0.05e9)  # the ~80ms park, closed
+    # the weak-registry aggregate carries it too
+    assert budget_gauges()["blocked_ns_rolling"] > 0
+    # trend, not lifetime: a tiny trailing window sees (almost) nothing
+    assert sum(gov.arbiter.rolling_blocked(window_s=1e-9).values()) \
+        < sum(rolled.values())
+
+
+# ------------------------------------------------------ ledger + dumps
+
+
+def test_decision_ledger_in_flight_ring_and_flightdump(gov):
+    import tools.flightdump as fd
+
+    eng = _engine(gov, queue_size=16)
+    try:
+        ctl = AdmissionController(eng, dwell_ticks=1)
+        for _ in range(10):
+            ctl.tick(_sig(1.0))
+        adj = [e for e in _flight.snapshot()
+               if e["kind"] == "control_adjust"]
+        assert any("queue_depth:16->8:pressure_high" in e["detail"]
+                   for e in adj)
+        dump = {"events": _flight.snapshot()}
+        ledger = fd.control_ledger(dump)
+        assert ledger and all(e["kind"].startswith("control_")
+                              for e in ledger)
+        text = fd.format_control_ledger(dump)
+        assert "queue_depth:16->8:pressure_high" in text
+        # the ledger mirrors what the ring carries, with why + old -> new
+        assert any(d["knob"] == "queue_depth" and d["old"] == 16
+                   and d["new"] == 8 for d in ctl.ledger)
+        ctl.stop()
+    finally:
+        eng.shutdown()
+
+
+def test_controller_registers_telemetry_source(gov):
+    eng = _engine(gov)
+    try:
+        ctl = AdmissionController(eng)
+        name = ctl._telemetry_name
+        snap = _flight.unified_snapshot()
+        assert name in snap
+        assert "knobs" in snap[name] and "frozen" in snap[name]
+        ctl.stop()
+        assert name not in _flight.unified_snapshot()
+    finally:
+        eng.shutdown()
+
+
+def test_adaptive_engine_serves_end_to_end(gov):
+    """The wired-in path: adaptive=True starts the controller thread;
+    requests serve normally and shutdown stops the thread cleanly."""
+    budget = BudgetedResource(gov, 1 << 20)
+    with config.override(serve_controller_period_s=0.01):
+        eng = ServingEngine(gov=gov, budget=budget, workers=2,
+                            queue_size=8, adaptive=True)
+        try:
+            assert eng.controller is not None
+            eng.register(QueryHandler(name="w", fn=lambda p, ctx: p * 2,
+                                      nbytes_of=lambda p: 64))
+            s = eng.open_session()
+            assert eng.submit(s, "w", 21).result(timeout=30) == 42
+            time.sleep(0.05)  # a few live ticks
+            assert eng.controller.snapshot()["tick"] >= 1
+            assert eng.controller.errors == 0
+        finally:
+            eng.shutdown()
+    assert not any(t.name == "serve-admission-control" and t.is_alive()
+                   for t in threading.enumerate())
+
+
+# ------------------------------------------------ plan-level retry stats
+
+
+def test_plan_retry_stats_gate_and_decay():
+    from spark_rapids_jni_tpu.plans import runtime as rt
+
+    rt.reset_plan_retry_stats()
+    try:
+        rt._note_plan_run("q_test", presplit=0, reactive_splits=3,
+                          max_depth=8)
+        st = rt.plan_retry_stats()["q_test"]
+        assert st["split_retries"] == 3 and st["presplit_depth"] >= 1
+        # gated: static config never presplits
+        assert rt.suggested_presplit_depth("q_test") == 0
+        with config.override(serve_adaptive=True):
+            assert rt.suggested_presplit_depth("q_test") >= 1
+            with config.override(serve_controller_freeze=True):
+                assert rt.suggested_presplit_depth("q_test") == 0
+    finally:
+        rt.reset_plan_retry_stats()

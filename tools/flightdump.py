@@ -65,6 +65,27 @@ def _fmt_value(e: dict) -> str:
     return f" [{v}]"
 
 
+def control_ledger(dump: dict) -> List[dict]:
+    """The admission controller's decision ledger: every ``control_*``
+    event in capture order — the WHY behind each knob adjustment,
+    freeze transition, and pre-emptive split (serve/controller.py)."""
+    return [e for e in dump.get("events", [])
+            if str(e.get("kind", "")).startswith("control_")]
+
+
+def format_control_ledger(dump: dict) -> str:
+    events = control_ledger(dump)
+    if not events:
+        return "no control events in this dump"
+    t0 = min(e.get("t_ns", 0) for e in events)
+    out = ["admission-control decision ledger:"]
+    for e in events:
+        dt_ms = (e.get("t_ns", 0) - t0) / 1e6
+        out.append(f"  +{dt_ms:10.3f} ms  {e.get('kind'):<17}"
+                   f"{e.get('detail', '')}{_fmt_value(e)}")
+    return "\n".join(out)
+
+
 def format_dump(dump: dict, task: int | None = None) -> str:
     """Human-readable reconstruction of one dump."""
     out = [
@@ -111,6 +132,10 @@ def main(argv=None) -> int:
                                  "(flight_dump_dir config flag)")
     ap.add_argument("--task", type=int, default=None,
                     help="show only this task's timeline")
+    ap.add_argument("--control", action="store_true",
+                    help="show only the admission-control decision ledger "
+                         "(control_* events: knob adjustments with "
+                         "old->new:reason, freezes, pre-splits)")
     ap.add_argument("--json", action="store_true",
                     help="emit the reconstructed per-task timelines as JSON")
     args = ap.parse_args(argv)
@@ -120,6 +145,14 @@ def main(argv=None) -> int:
     if dump.get("schema") != "srt-flight-dump-v1":
         print(f"warning: unknown dump schema {dump.get('schema')!r}",
               file=sys.stderr)
+    if args.control:
+        if args.json:
+            json.dump(control_ledger(dump), sys.stdout, indent=1,
+                      sort_keys=True)
+            sys.stdout.write("\n")
+        else:
+            print(format_control_ledger(dump))
+        return 0
     if args.json:
         tasks = reconstruct(dump)
         json.dump({str(t): {"events": evs,
