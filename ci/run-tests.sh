@@ -42,6 +42,16 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu SRT_REEXECED=1 \
     python tools/serve_bench.py --chaos-storm --clients 4 --requests 160 \
     --workers 2 --queue-size 8 --seed "${STORM_SEED:-7}"
 
+# crash-only serving chaos tier (round 10): 4 supervised executor
+# processes, seeded in-worker proc_kill faults SIGKILL executors
+# mid-request — gates on zero lost requests, exactly-once lease
+# completion, >= 2 kills with respawns, the degradation ladder stepping
+# down AND recovering, bounded p99 inflation, and the per-process flight
+# dumps merging into one cross-process timeline (flightdump --cluster)
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu SRT_REEXECED=1 \
+    python tools/serve_bench.py --cluster 4 --chaos-kill --clients 8 \
+    --requests 120 --workers 2 --queue-size 16 --seed "${KILL_SEED:-3}"
+
 python -c "
 from __graft_entry__ import dryrun_multichip
 dryrun_multichip(8)

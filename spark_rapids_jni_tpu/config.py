@@ -251,6 +251,40 @@ register("serve_controller_period_s", 0.05,
          "(serve/controller.py).  Each tick samples pressure gauges, "
          "updates the EWMA, and applies at most one banded adjustment "
          "per knob.", env="SRT_SERVE_CONTROLLER_PERIOD_S")
+register("serve_retry_jitter_seed", 0,
+         "Seed for the serving engine's backpressure retry-after jitter "
+         "(serve/executor.py): hints spread over [0.5x, 1.5x) of the "
+         "EWMA-derived backoff so synchronized rejectees de-phase.  Fixed "
+         "seed = replayable hint sequence (chaos determinism).",
+         env="SRT_SERVE_RETRY_JITTER_SEED")
+register("serve_hang_factor", 20.0,
+         "Hung-task watchdog threshold: a handler still running after "
+         "this multiple of its per-class EWMA service time (floored at "
+         "serve_hang_min_s) is flagged EV_TASK_HUNG with a rate-limited "
+         "anomaly dump (serve/executor.py).  <= 0 disables the watchdog.",
+         env="SRT_SERVE_HANG_FACTOR")
+register("serve_hang_min_s", 1.0,
+         "Absolute floor for the hung-task watchdog bound: cold classes "
+         "(no EWMA yet) and microsecond handlers are never flagged before "
+         "this many seconds.", env="SRT_SERVE_HANG_MIN_S")
+register("serve_heartbeat_s", 0.05,
+         "Executor-worker heartbeat period in cluster serving "
+         "(serve/rpc.py -> serve/supervisor.py): each worker process "
+         "reports liveness + pressure gauges this often.",
+         env="SRT_SERVE_HEARTBEAT_S")
+register("serve_heartbeat_misses", 6,
+         "Consecutive missed heartbeat periods after which the supervisor "
+         "declares an executor dead and re-dispatches its leases "
+         "(serve/supervisor.py).", env="SRT_SERVE_HEARTBEAT_MISSES")
+register("serve_lease_hang_s", 5.0,
+         "Supervisor-side hung-lease bound: a lease outstanding on one "
+         "executor longer than this marks the executor wedged — it is "
+         "killed, respawned, and the lease re-queued to survivors "
+         "(crash-only recovery).  MUST exceed the slowest legitimate "
+         "handler service time, or healthy-but-slow executors get "
+         "recycled; a request that hangs lease_max_dispatches separate "
+         "executors fails terminally instead of destroying the pool.",
+         env="SRT_SERVE_LEASE_HANG_S")
 register("serve_controller_freeze", False,
          "Kill switch for adaptive admission: when set, the controller "
          "immediately resets every knob to its static config value and "

@@ -30,6 +30,19 @@ Config shape::
   non-retryable device failure)
 - ``host_oom``     -> OffHeapOOM (a hard host/off-heap allocation failure)
 
+Behavioral kinds (round 10, crash-only serving): instead of raising, the
+crossing misbehaves the way a sick executor process does —
+
+- ``slow``      -> the crossing stalls ``durationMs`` (default 50) before
+  proceeding: a degraded-but-correct executor;
+- ``hang``      -> the crossing stalls ``durationMs`` (default one hour):
+  a wedged handler thread that will never return on its own — only the
+  supervisor's hung-lease recycling (serve/supervisor.py) or the engine's
+  hung-task watchdog notices;
+- ``proc_kill`` -> ``SIGKILL`` to the CURRENT process: the crash-only
+  failure domain drill.  No cleanup runs, no exception propagates — the
+  supervisor must detect the dead executor and re-dispatch its leases.
+
 ``interceptionCount`` limits how many times the rule fires (faultinj.cu
 ``injectionCount`` countdown); ``percent`` gates each crossing.
 
@@ -45,7 +58,9 @@ import fnmatch
 import json
 import os
 import random
+import signal
 import threading
+import time
 from typing import Optional
 
 from spark_rapids_jni_tpu.mem.exceptions import (
@@ -58,7 +73,7 @@ from spark_rapids_jni_tpu.mem.exceptions import (
 from spark_rapids_jni_tpu.obs import seam as _seam
 
 __all__ = ["FaultInjector", "install_from_env", "pressure_storm_config",
-           "ENV_CONFIG_PATH"]
+           "chaos_kill_config", "ENV_CONFIG_PATH"]
 
 ENV_CONFIG_PATH = "SRT_FAULT_INJECTOR_CONFIG_PATH"
 
@@ -71,25 +86,38 @@ _FAULTS = {
     "host_oom": lambda name: OffHeapOOM(f"injected host OOM in {name}"),
 }
 
+# behavioral kinds misbehave instead of raising (executed OUTSIDE the
+# injector lock: a hang must wedge the crossing thread, not the injector)
+_BEHAVIOR_KINDS = frozenset({"slow", "hang", "proc_kill"})
+_BEHAVIOR_DEFAULT_MS = {"slow": 50.0, "hang": 3_600_000.0}
+
 
 class _Rule:
     def __init__(self, spec: dict):
         self.percent = float(spec.get("percent", 100))
         self.kind = spec.get("injectionType", "exception")
-        if self.kind not in _FAULTS:
+        if self.kind not in _FAULTS and self.kind not in _BEHAVIOR_KINDS:
             raise ValueError(f"unknown injectionType {self.kind!r}")
+        self.duration_s = float(
+            spec.get("durationMs", _BEHAVIOR_DEFAULT_MS.get(self.kind, 0.0))
+        ) / 1e3
         # None = unlimited, mirroring a missing injectionCount in faultinj
         c = spec.get("interceptionCount")
         self.remaining = None if c is None else int(c)
 
     def fire(self, rng: random.Random, name: str):
+        """Roll the dice; returns ``(kind, payload)`` — payload is the
+        exception to raise for fault kinds, the stall duration for
+        slow/hang, None for proc_kill — or None when the rule holds."""
         if self.remaining is not None and self.remaining <= 0:
             return None
         if self.percent < 100 and rng.uniform(0, 100) >= self.percent:
             return None
         if self.remaining is not None:
             self.remaining -= 1
-        return _FAULTS[self.kind](name)
+        if self.kind in _BEHAVIOR_KINDS:
+            return (self.kind, self.duration_s)
+        return ("raise", _FAULTS[self.kind](name))
 
 
 class FaultInjector:
@@ -179,9 +207,19 @@ class FaultInjector:
                     None) or cat_rules.get("*")
             if rule is None:
                 return
-            fault = rule.fire(self._rng, name)
-        if fault is not None:
-            raise fault
+            fired = rule.fire(self._rng, name)
+        if fired is None:
+            return
+        kind, payload = fired
+        if kind == "raise":
+            raise payload
+        if kind == "proc_kill":
+            # the crash-only drill: no cleanup, no exception — the process
+            # vanishes mid-crossing exactly like a segfaulted executor
+            os.kill(os.getpid(), signal.SIGKILL)
+        # slow / hang: stall the crossing thread (outside the lock — a
+        # hang wedges THIS thread only, other crossings keep injecting)
+        time.sleep(payload)
 
 
 def pressure_storm_config(seed: int = 0, *, retry_pct: float = 25.0,
@@ -208,6 +246,39 @@ def pressure_storm_config(seed: int = 0, *, retry_pct: float = 25.0,
         "serve": {"handle:*": {"percent": float(split_pct),
                                "injectionType": "split_oom"}},
     }
+
+
+def chaos_kill_config(seed: int = 0, *, kill: bool = True,
+                      kill_pct: float = 8.0, slow_pct: float = 5.0,
+                      slow_ms: float = 25.0) -> dict:
+    """The seeded executor-chaos profile for cluster serving (round 10).
+
+    Armed INSIDE each executor worker process by the supervisor's chaos
+    mode (``serve_bench --cluster N --chaos-kill``): a fraction of served
+    requests stall briefly (``slow``), and — when ``kill`` is set for this
+    incarnation — one seeded crossing SIGKILLs the whole executor mid-
+    request (``interceptionCount: 1``: each armed incarnation dies at most
+    once, so the kill count across a run is bounded by the incarnations
+    the caller chooses to arm).  Deterministic per seed, like
+    :func:`pressure_storm_config`.
+    """
+    cfg = {
+        "seed": int(seed),
+        "serve": {"handle:*": {"percent": float(slow_pct),
+                               "injectionType": "slow",
+                               "durationMs": float(slow_ms)}},
+    }
+    if kill:
+        # the kill arms a DIFFERENT seam (the budget reservation every
+        # executor-governed handler crosses per attempt) so it rolls
+        # independently of the serve-seam slow weather — one rule per
+        # crossing name means stacking both on handle:* would shadow
+        # (review r10); dying while holding an admission slot is also
+        # the nastier drill
+        cfg["alloc"] = {"reserve:*": {"percent": float(kill_pct),
+                                      "injectionType": "proc_kill",
+                                      "interceptionCount": 1}}
+    return cfg
 
 
 def install_from_env() -> Optional[FaultInjector]:

@@ -50,6 +50,9 @@ __all__ = [
     "EV_DEADLOCK_VERDICT", "EV_QUEUE_REJECT", "EV_QUEUE_TIMEOUT",
     "EV_TASK_DONE", "EV_TASK_KILLED", "EV_ANOMALY",
     "EV_CONTROL_ADJUST", "EV_CONTROL_FREEZE", "EV_CONTROL_PRESPLIT",
+    "EV_TASK_HUNG", "EV_DEGRADE_ENTER", "EV_DEGRADE_EXIT",
+    "EV_LEASE_GRANT", "EV_LEASE_REDISPATCH", "EV_LEASE_DONE",
+    "EV_WORKER_SPAWN", "EV_WORKER_DEAD",
     "EVENT_KINDS", "KIND_IDS", "DUMP_SCHEMA",
     "FlightRecorder", "record", "anomaly", "snapshot", "task_stats",
     "register_telemetry_source", "unregister_telemetry_source",
@@ -83,6 +86,26 @@ EV_CONTROL_FREEZE = "control_freeze"   # kill-switch froze (value=1) /
 #                                        resumed (value=0) the controller
 EV_CONTROL_PRESPLIT = "control_presplit"  # request split BEFORE dispatch
 #                                        (detail=handler:pieces)
+# crash-only serving (serve/supervisor.py, round 10): the supervisor's
+# lease table, executor-process lifecycle, and degradation ladder all
+# narrate into the ring so a cross-process incident is reconstructable
+# from the per-process dumps (tools/flightdump.py --cluster)
+EV_TASK_HUNG = "task_hung"             # handler exceeded its EWMA hang
+#                                        bound (value=elapsed_ns)
+EV_DEGRADE_ENTER = "degrade_enter"     # ladder stepped DOWN a level
+#                                        (detail=level name, value=level)
+EV_DEGRADE_EXIT = "degrade_exit"       # ladder recovered UP a level
+#                                        (detail=level name, value=level)
+EV_LEASE_GRANT = "lease_grant"         # request leased to an executor
+#                                        (detail=rid:<id>:worker:<wid>...)
+EV_LEASE_REDISPATCH = "lease_redispatch"  # dead/hung executor's lease
+#                                        re-queued to survivors
+EV_LEASE_DONE = "lease_done"           # lease reached a terminal state
+#                                        (detail=rid:<id>:...:status)
+EV_WORKER_SPAWN = "worker_spawn"       # executor process (re)started
+#                                        (detail=worker:<wid>:inc:<n>:pid)
+EV_WORKER_DEAD = "worker_dead"         # executor declared dead (crashed,
+#                                        heartbeat-lost, or hung-recycled)
 
 EVENT_KINDS = (
     EV_TASK_ADMITTED, EV_TASK_BLOCKED, EV_TASK_WOKEN, EV_RETRY,
@@ -91,6 +114,10 @@ EVENT_KINDS = (
     EV_ANOMALY,
     # round 9: appended (never reordered) so v2 STATE wire ids stay stable
     EV_CONTROL_ADJUST, EV_CONTROL_FREEZE, EV_CONTROL_PRESPLIT,
+    # round 10: appended for the same reason
+    EV_TASK_HUNG, EV_DEGRADE_ENTER, EV_DEGRADE_EXIT,
+    EV_LEASE_GRANT, EV_LEASE_REDISPATCH, EV_LEASE_DONE,
+    EV_WORKER_SPAWN, EV_WORKER_DEAD,
 )
 KIND_IDS = {k: i for i, k in enumerate(EVENT_KINDS)}
 
@@ -221,6 +248,9 @@ class FlightRecorder:
             "schema": DUMP_SCHEMA,
             "reason": reason,
             "detail": detail,
+            # pid + paired (wall, monotonic) stamps let the --cluster merge
+            # align per-process monotonic event times on one wall clock
+            "pid": os.getpid(),
             "wall_time_s": time.time(),
             "t_ns": time.monotonic_ns(),
             "events": self.snapshot(),

@@ -18,6 +18,13 @@ Layers: serve.session (tenants -> governor task ids), serve.queue (bounded
 priority queue + deadlines + backpressure), serve.executor (worker pool,
 governed execution, split re-queueing, micro-batching), serve.metrics
 (counters + latency histograms, exported through the obs seam).
+
+Round 10 adds the crash-only tier above the engine: serve.supervisor (a
+router/supervisor owning sessions + admission over N executor worker
+processes, with a per-request lease table, idempotent re-dispatch, and a
+reversible degradation ladder) and serve.rpc (the worker process entry
+point + pipe protocol).  One engine is one failure domain; the supervisor
+is what makes losing one survivable.
 """
 
 from spark_rapids_jni_tpu.serve.controller import AdmissionController, Knob
@@ -40,15 +47,26 @@ from spark_rapids_jni_tpu.serve.session import (
     SessionBudgetExceeded,
     SessionRegistry,
 )
+from spark_rapids_jni_tpu.serve.supervisor import (
+    DEGRADE_LEVELS,
+    Degraded,
+    HandlerSpec,
+    RemoteExecutorError,
+    Supervisor,
+)
 
 __all__ = [
     "AdmissionController",
     "AdmissionQueue",
     "Backpressure",
+    "DEGRADE_LEVELS",
+    "Degraded",
+    "HandlerSpec",
     "Knob",
     "HandlerContext",
     "LatencyHistogram",
     "QueryHandler",
+    "RemoteExecutorError",
     "Request",
     "RequestTimeout",
     "Response",
@@ -57,5 +75,6 @@ __all__ = [
     "Session",
     "SessionBudgetExceeded",
     "SessionRegistry",
+    "Supervisor",
     "register_builtin_handlers",
 ]

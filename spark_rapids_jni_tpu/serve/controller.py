@@ -48,8 +48,20 @@ from collections import deque
 from typing import Callable, Dict, Optional
 
 from spark_rapids_jni_tpu.obs import flight as _flight
+from spark_rapids_jni_tpu.serve.metrics import (
+    percentile_of_counts as _percentile,
+)
 
 __all__ = ["AdmissionController", "Knob"]
+
+
+def _counts_delta(now, start):
+    """Windowed latency-bucket counts between two cumulative samples."""
+    if not now:
+        return []
+    if not start:
+        return list(now)
+    return [a - b for a, b in zip(now, start)]
 
 
 class Knob:
@@ -90,6 +102,11 @@ class AdmissionController:
                  presplit_max: int = 3, presplit_decay_ticks: int = 40,
                  presplit_probe_lo: float = 0.1,
                  blocked_window_s: float = 1.0,
+                 latency_probe: bool = True,
+                 probe_after_ticks: int = 12,
+                 probe_window_ticks: int = 10,
+                 probe_min_samples: int = 8,
+                 probe_keep_ratio: float = 0.9,
                  signal_source: Optional[Callable[[], dict]] = None):
         if period_s is None:
             from spark_rapids_jni_tpu import config
@@ -107,6 +124,11 @@ class AdmissionController:
         self.presplit_decay_ticks = presplit_decay_ticks
         self.presplit_probe_lo = presplit_probe_lo
         self.blocked_window_s = blocked_window_s
+        self.latency_probe = latency_probe
+        self.probe_after_ticks = probe_after_ticks
+        self.probe_window_ticks = probe_window_ticks
+        self.probe_min_samples = probe_min_samples
+        self.probe_keep_ratio = probe_keep_ratio
         self._signal_source = signal_source
         qs = engine.static_queue_size
         self.knobs: Dict[str, Knob] = {
@@ -121,6 +143,12 @@ class AdmissionController:
         self._last_counters: Dict[str, int] = {}
         self._last_class_splits: Dict[str, int] = {}
         self._class_quiet: Dict[str, int] = {}  # ticks since last class split
+        # latency-aware presplit probing (ROADMAP item 4 follow-on): per
+        # handler, the in-flight probe record and the converged-regime
+        # "already decided" marker (cleared when splits recur or decay
+        # fires, so a new regime re-earns its probe)
+        self._probe: Dict[str, dict] = {}
+        self._probe_done: Dict[str, bool] = {}
         self._boosts: Dict[str, int] = {}
         self._frozen = False
         self.errors = 0
@@ -251,6 +279,8 @@ class AdmissionController:
         self._steer_queue_depth(overloaded, calm)
         self._steer_session_scale(overloaded, calm)
         self._steer_presplit(dict(sig.get("class_splits", {})))
+        if self.latency_probe:
+            self._steer_latency_probe()
         self._steer_aging(dict(sig.get("session_waits", {})))
 
     def _dwell_ok(self, knob: str) -> bool:
@@ -310,10 +340,21 @@ class AdmissionController:
             with self._lock:
                 delta = total - self._last_class_splits.get(handler, 0)
                 self._last_class_splits[handler] = total
-            cur = self.engine.presplit_depth(handler)
             if delta > 0:
                 with self._lock:
                     self._class_quiet[handler] = 0
+                    # splits mean the regime moved: abort any in-flight
+                    # latency probe (escalation owns the knob again) and
+                    # let the next convergence re-earn its probe
+                    aborted = self._probe.pop(handler, None)
+                    self._probe_done.pop(handler, None)
+                if aborted is not None and aborted["phase"] == "probe":
+                    self.engine.set_presplit(handler, aborted["depth"])
+                    self._adjust(f"presplit:{handler}",
+                                 aborted["depth"] + 1, aborted["depth"],
+                                 "probe_split_abort")
+            cur = self.engine.presplit_depth(handler)
+            if delta > 0:
                 # dwell between escalations: top-level splits observed in
                 # this window may predate the knob's last change (requests
                 # already past the presplit gate) — stepping every tick
@@ -332,23 +373,106 @@ class AdmissionController:
                     self.engine.set_presplit(handler, new)
                     self._adjust(f"presplit:{handler}", cur, new,
                                  f"split_retries+{delta}")
-            elif cur > 0:
+            else:
                 with self._lock:
                     quiet = self._class_quiet.get(handler, 0) + 1
                     self._class_quiet[handler] = quiet
                     ewma = self._ewma
+                    probing = handler in self._probe
                 # decay is a PROBE (the next full-size attempt re-tests the
                 # budget) — only probe when overall pressure has actually
                 # subsided, or mid-storm probes hand a tail-latency spike
-                # to whichever request draws the full-size attempt
-                if (quiet >= self.presplit_decay_ticks
+                # to whichever request draws the full-size attempt; a
+                # live latency probe owns the knob until it decides
+                if (cur > 0 and not probing
+                        and quiet >= self.presplit_decay_ticks
                         and (ewma is None
                              or ewma <= self.presplit_probe_lo)):
                     with self._lock:
                         self._class_quiet[handler] = 0
+                        # shallower regime: the deeper-probe decision (if
+                        # any) no longer applies — let it re-run
+                        self._probe_done.pop(handler, None)
                     self.engine.set_presplit(handler, cur - 1)
                     self._adjust(f"presplit:{handler}", cur, cur - 1,
                                  "quiet_decay")
+
+    def _steer_latency_probe(self) -> None:
+        """Latency-aware presplit depth (ROADMAP item 4 follow-on).
+
+        Reactive escalation converges to the depth that merely STOPS
+        SplitAndRetry signals — but the throughput-optimal depth can be
+        one deeper, where smaller pieces unlock budget-level parallelism.
+        Once a class has been quiet for ``probe_after_ticks``, measure a
+        baseline window of its p99 at the converged depth, then set the
+        knob one deeper for an equal window, and KEEP the deeper depth
+        only if the windowed p99 actually improved (``probe_keep_ratio``).
+        Windows with fewer than ``probe_min_samples`` completions decide
+        nothing (revert); recurring splits abort mid-probe
+        (_steer_presplit owns that path).
+        """
+        counts = self.engine.metrics.handler_latency_counts()
+        with self._lock:
+            candidates = list(self._last_class_splits)
+        for handler in candidates:
+            with self._lock:
+                st = self._probe.get(handler)
+                quiet = self._class_quiet.get(handler, 0)
+                done = self._probe_done.get(handler, False)
+                ewma = self._ewma
+            cur = self.engine.presplit_depth(handler)
+            if st is None:
+                if (done or quiet < self.probe_after_ticks
+                        or cur + 1 > self.presplit_max
+                        or (ewma is not None
+                            and ewma > self.presplit_probe_lo)):
+                    continue
+                with self._lock:
+                    self._probe[handler] = {
+                        "phase": "baseline", "depth": cur, "ticks": 0,
+                        "start": list(counts.get(handler, [])),
+                        "baseline_p99": 0,
+                    }
+                continue
+            st["ticks"] += 1
+            if st["ticks"] < self.probe_window_ticks:
+                continue
+            window = _counts_delta(counts.get(handler, []), st["start"])
+            samples = sum(window)
+            if st["phase"] == "baseline":
+                if samples < self.probe_min_samples:
+                    with self._lock:  # nothing measurable yet: stand down
+                        self._probe.pop(handler, None)
+                    continue
+                st["baseline_p99"] = _percentile(window, 99)
+                st["phase"] = "probe"
+                st["ticks"] = 0
+                st["start"] = list(counts.get(handler, []))
+                self._mark_adj(f"presplit:{handler}")
+                self.engine.set_presplit(handler, st["depth"] + 1)
+                self._adjust(f"presplit:{handler}", st["depth"],
+                             st["depth"] + 1, "latency_probe")
+                continue
+            # probe window complete: decide
+            keep = (samples >= self.probe_min_samples
+                    and _percentile(window, 99)
+                    <= self.probe_keep_ratio * st["baseline_p99"])
+            with self._lock:
+                self._probe.pop(handler, None)
+                self._probe_done[handler] = True
+                self._class_quiet[handler] = 0
+            if keep:
+                self._adjust(f"presplit:{handler}", st["depth"] + 1,
+                             st["depth"] + 1,
+                             "probe_keep:p99_improved")
+            else:
+                self._mark_adj(f"presplit:{handler}")
+                self.engine.set_presplit(handler, st["depth"])
+                self._adjust(f"presplit:{handler}", st["depth"] + 1,
+                             st["depth"],
+                             "probe_revert:insufficient"
+                             if samples < self.probe_min_samples
+                             else "probe_revert:p99_worse")
 
     def _steer_aging(self, session_waits: Dict[str, float]) -> None:
         """Starvation control: a session whose oldest queued request has
@@ -401,6 +525,8 @@ class AdmissionController:
             self._class_quiet = {}
             self._last_adj = {}
             self._ewma = None
+            self._probe = {}
+            self._probe_done = {}
 
     # -- introspection ------------------------------------------------------
     def snapshot(self) -> dict:

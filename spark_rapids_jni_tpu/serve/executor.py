@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import random
 import threading
 import time
 import weakref
@@ -61,7 +62,31 @@ from spark_rapids_jni_tpu.serve.session import (
 )
 
 __all__ = ["HandlerContext", "QueryHandler", "ServingEngine",
-           "register_builtin_handlers"]
+           "register_builtin_handlers", "split_till"]
+
+
+def split_till(payload: Any, split: Callable[[Any], Sequence[Any]], *,
+               want_parts: Optional[int] = None,
+               max_levels: Optional[int] = None) -> tuple:
+    """Repeatedly apply ``split`` (halves per level) until ``want_parts``
+    pieces or ``max_levels`` levels are reached, or splitting stalls
+    (``split`` stops producing more than one piece).  Returns
+    ``(parts, levels)`` — the one split-expansion loop shared by the
+    engine's pre-dispatch split and the supervisor's cross-executor
+    fan-out."""
+    parts = [payload]
+    levels = 0
+    while ((want_parts is None or len(parts) < want_parts)
+           and (max_levels is None or levels < max_levels)):
+        nxt: List[Any] = []
+        for p in parts:
+            sub = list(split(p))
+            nxt.extend(sub if len(sub) > 1 else [p])
+        if len(nxt) == len(parts):
+            break  # not splittable further
+        parts = nxt
+        levels += 1
+    return parts, levels
 
 
 @dataclasses.dataclass(frozen=True)
@@ -206,6 +231,21 @@ class ServingEngine:
         self._sat_lock = threading.Lock()
         self._sat_rejects = 0
         self._sat_threshold = int(config.get("flight_saturation_rejects"))
+        # seeded retry-after jitter: split children of one batch land back
+        # in their clients' retry loops at the SAME instant, and an
+        # unjittered hint marches them all back through the front door in
+        # lockstep (a thundering herd the governor then re-splits).  The
+        # RNG is seeded from config so chaos runs stay replayable.
+        self._jitter = random.Random(int(config.get(
+            "serve_retry_jitter_seed")))
+        # hung-task watchdog: per-popped-request start stamps the watchdog
+        # thread sweeps (leaf lock, nothing else acquired while held)
+        self._inflight_lock = threading.Lock()
+        self._inflight: dict = {}      # worker name -> [req, t0_ns, flagged]
+        self._ewma_by_handler: dict = {}  # handler -> EWMA service seconds
+        self._hang_factor = float(config.get("serve_hang_factor"))
+        self._hang_min_s = float(config.get("serve_hang_min_s"))
+        self._hang_stop = threading.Event()
         self.metrics.set_gauge_source(self._gauges)
         self._telemetry_name = f"serve:{id(self):x}"
         # weakly referenced, like the governor/spill gauge registries: an
@@ -232,6 +272,12 @@ class ServingEngine:
         ]
         for t in self._workers:
             t.start()
+        self._hang_watchdog = None
+        if self._workers and self._hang_factor > 0:
+            self._hang_watchdog = threading.Thread(
+                target=self._hang_watchdog_loop, daemon=True,
+                name="serve-hang-watchdog")
+            self._hang_watchdog.start()
         self.adaptive = adaptive
         self.controller = None
         if adaptive:
@@ -355,6 +401,7 @@ class ServingEngine:
         first; anything still queued after the wait (or with drain=False)
         completes as cancelled — never silently lost."""
         deadline = time.monotonic() + timeout
+        self._hang_stop.set()
         if self.controller is not None:
             self.controller.stop()
         if drain:
@@ -416,9 +463,16 @@ class ServingEngine:
 
     # -- internals ----------------------------------------------------------
     def _retry_after(self, depth: int) -> float:
+        """Backpressure retry hint: EWMA-of-service x occupancy, spread by
+        seeded jitter over [0.5x, 1.5x) so synchronized rejectees (split
+        children, batch disbands) de-phase instead of thundering back in
+        lockstep.  Deterministic under a fixed serve_retry_jitter_seed
+        (pinned by test_serve_executor)."""
         with self._ewma_lock:
             per_req = self._ewma_service_s
-        return min(5.0, max(0.005, per_req * depth / max(len(self._workers), 1)))
+            u = self._jitter.random()
+        base = per_req * depth / max(len(self._workers), 1)
+        return min(5.0, max(0.005, base * (0.5 + u)))
 
     def _credit(self, req: Request) -> None:
         sess = getattr(req, "session", None)
@@ -462,12 +516,15 @@ class ServingEngine:
             req.join.deliver(req.join_slot, status, value, error)
 
     def _worker_loop(self) -> None:
+        me = threading.current_thread().name
         while True:
             req = self.queue.pop()
             if req is None:
                 return  # queue closed and drained
             self.metrics.set_depth(self.queue.depth())
             t0 = time.monotonic()
+            with self._inflight_lock:
+                self._inflight[me] = [req, time.monotonic_ns(), False]
             # _serve returns every popped member to the queue's
             # outstanding count itself (incl. batch mates); on an
             # unexpected escape only the primary is outstanding here
@@ -484,10 +541,51 @@ class ServingEngine:
                 self._finish(req, ERROR, error=e)
             finally:
                 dt = time.monotonic() - t0
+                with self._inflight_lock:
+                    self._inflight.pop(me, None)
                 with self._ewma_lock:
                     self._ewma_service_s = (0.8 * self._ewma_service_s
                                             + 0.2 * dt)
+                    prev = self._ewma_by_handler.get(req.handler, dt)
+                    self._ewma_by_handler[req.handler] = (0.8 * prev
+                                                          + 0.2 * dt)
                 self.metrics.publish()
+
+    def _hang_watchdog_loop(self) -> None:
+        """Sweep in-flight requests for handlers running far past their
+        class EWMA (``serve_hang_factor x``, floored at serve_hang_min_s).
+        A hung handler silently eats a pool worker forever — the watchdog
+        cannot unwedge the thread (crash-only recovery is the supervisor
+        tier's job), but it makes the wedge LOUD: one EV_TASK_HUNG + one
+        rate-limited anomaly dump per stuck request, while the transition
+        history that led there is still in the ring."""
+        period = max(0.02, min(1.0, self._hang_min_s / 4.0))
+        while not self._hang_stop.wait(period):
+            now_ns = time.monotonic_ns()
+            hung = []
+            with self._ewma_lock:
+                ewmas = dict(self._ewma_by_handler)
+            with self._inflight_lock:
+                for entry in self._inflight.values():
+                    req, t0_ns, flagged = entry
+                    if flagged:
+                        continue
+                    bound_s = max(self._hang_min_s, self._hang_factor
+                                  * ewmas.get(req.handler, 0.0))
+                    elapsed_ns = now_ns - t0_ns
+                    if elapsed_ns > bound_s * 1e9:
+                        entry[2] = True
+                        hung.append((req, elapsed_ns, bound_s))
+            for req, elapsed_ns, bound_s in hung:
+                self.metrics.count("hung", req.session_id)
+                _flight.record(_flight.EV_TASK_HUNG, req.task_id,
+                               detail=f"handler:{req.handler}:"
+                                      f"bound_ms:{bound_s * 1e3:.0f}",
+                               value=elapsed_ns)
+                _flight.anomaly("task_hung",
+                                detail=f"task={req.task_id} "
+                                       f"handler={req.handler} "
+                                       f"elapsed_ms={elapsed_ns / 1e6:.0f}")
 
     def _gather_batch(self, req: Request, h: QueryHandler) -> List[Request]:
         """Pull compatible queued requests to ride this launch."""
@@ -650,10 +748,10 @@ class ServingEngine:
                     self._finish(r, ERROR, error=e)
                 return group
             for r, value in zip(group, parts):
-                self.metrics.record_run(run_ns)
+                self.metrics.record_run(run_ns, handler=h.name)
                 self._finish(r, OK, value=value)
         else:
-            self.metrics.record_run(run_ns)
+            self.metrics.record_run(run_ns, handler=h.name)
             self._finish(req, OK, value=result)
         return group
 
@@ -676,21 +774,11 @@ class ServingEngine:
 
     def _presplit_parts(self, payload: Any, h: QueryHandler,
                         depth: int) -> tuple:
-        """Split ``payload`` up to ``depth`` times (``split`` returns
-        halves; applied per level).  Returns (parts, achieved_depth) —
-        callers fall back to normal dispatch when nothing split."""
-        parts = [payload]
-        d = 0
-        while d < min(depth, self.max_split_depth):
-            nxt: List[Any] = []
-            for p in parts:
-                sub = list(h.split(p))
-                nxt.extend(sub if len(sub) > 1 else [p])
-            if len(nxt) == len(parts):
-                break  # not splittable further
-            parts = nxt
-            d += 1
-        return parts, d
+        """Split ``payload`` up to ``depth`` times.  Returns
+        (parts, achieved_depth) — callers fall back to normal dispatch
+        when nothing split."""
+        return split_till(payload, h.split,
+                          max_levels=min(depth, self.max_split_depth))
 
     def _presplit_dispatch(self, req: Request, h: QueryHandler,
                            parts: List[Any], depth: int) -> List[Request]:

@@ -21,7 +21,24 @@ from typing import Callable, Dict, Optional
 
 from spark_rapids_jni_tpu.obs import flight as _flight
 
-__all__ = ["LatencyHistogram", "ServeMetrics"]
+__all__ = ["LatencyHistogram", "ServeMetrics", "percentile_of_counts"]
+
+
+def percentile_of_counts(counts, p: float) -> int:
+    """Upper-edge percentile over raw log2 bucket counts — the windowed
+    twin of :meth:`LatencyHistogram.percentile_ns` for callers that diff
+    two cumulative samples (controller probe windows).  Returns 0 for an
+    empty window."""
+    total = sum(counts)
+    if total == 0:
+        return 0
+    rank = max(1, int(round(total * p / 100.0)))
+    seen = 0
+    for i, c in enumerate(counts):
+        seen += c
+        if seen >= rank:
+            return 1 << (i + 1)
+    return 1 << len(counts)  # pragma: no cover - unreachable
 
 
 class LatencyHistogram:
@@ -48,16 +65,10 @@ class LatencyHistogram:
         self.sum_ns += ns
 
     def percentile_ns(self, p: float) -> int:
-        """Upper-edge estimate of the ``p``-th percentile (0 < p <= 100)."""
-        if self.total == 0:
-            return 0
-        rank = max(1, int(round(self.total * p / 100.0)))
-        seen = 0
-        for i, c in enumerate(self.counts):
-            seen += c
-            if seen >= rank:
-                return 1 << (i + 1)
-        return 1 << self.NBUCKETS  # pragma: no cover - unreachable
+        """Upper-edge estimate of the ``p``-th percentile (0 < p <= 100).
+        Delegates to :func:`percentile_of_counts` so cumulative and
+        windowed (controller probe) percentiles can never diverge."""
+        return percentile_of_counts(self.counts, p)
 
     def mean_ns(self) -> float:
         return self.sum_ns / self.total if self.total else 0.0
@@ -87,6 +98,21 @@ COUNTERS = (
     "batched",          # requests that rode a micro-batch launch
     "cancelled",        # queue shut down with the request still waiting
     "protocol_leaked",  # control-flow exception escaped every bracket (bug)
+    "hung",             # watchdog flagged a handler past its EWMA bound
+)
+
+# supervisor-tier counter vocabulary (serve/supervisor.py): lease and
+# executor-process lifecycle plus degradation-ladder admission decisions.
+# Kept separate so engine dashboards stay engine-shaped; ServeMetrics
+# snapshots merge in whichever of these the owner actually incremented.
+SUPERVISOR_COUNTERS = (
+    "leases_granted",     # requests dispatched to an executor process
+    "leases_redispatched",  # dead/hung executor's leases re-queued
+    "leases_completed",   # leases that reached a terminal state
+    "duplicate_results",  # late results for an already-completed lease
+    "workers_spawned",    # executor processes started (incl. respawns)
+    "workers_dead",       # executors declared dead (crash/heartbeat/hung)
+    "rejected_degraded",  # submits shed by the degradation ladder
 )
 
 
@@ -99,6 +125,10 @@ class ServeMetrics:
         self._per_session: Dict[str, Dict[str, int]] = {}
         self.queue_wait = LatencyHistogram()
         self.run_latency = LatencyHistogram()
+        # per-handler run latency: the admission controller's latency-aware
+        # presplit probe compares a class's p99 across probe windows, which
+        # the single global histogram cannot answer
+        self._run_by_handler: Dict[str, LatencyHistogram] = {}
         self._depth = 0
         self._gauge_source: Optional[Callable[[], dict]] = None
         self._gauge_cache: Dict[str, int] = {}
@@ -150,9 +180,22 @@ class ServeMetrics:
         with self._lock:
             self.queue_wait.record(ns)
 
-    def record_run(self, ns: int) -> None:
+    def record_run(self, ns: int, handler: Optional[str] = None) -> None:
         with self._lock:
             self.run_latency.record(ns)
+            if handler is not None:
+                h = self._run_by_handler.get(handler)
+                if h is None:
+                    h = self._run_by_handler[handler] = LatencyHistogram()
+                h.record(ns)
+
+    def handler_latency_counts(self) -> Dict[str, list]:
+        """Cumulative per-handler latency bucket counts.  Callers diff two
+        samples to get a WINDOWED distribution (the controller's probe
+        windows) — the histograms themselves never reset."""
+        with self._lock:
+            return {h: list(hist.counts)
+                    for h, hist in self._run_by_handler.items()}
 
     def set_depth(self, depth: int) -> None:
         with self._lock:
@@ -173,8 +216,14 @@ class ServeMetrics:
         gauges = self.gauges()
         tasks = {str(t): st for t, st in _flight.task_stats().items()}
         with self._lock:
+            counters = {k: self._global.get(k, 0) for k in COUNTERS}
+            # supervisor-tier counters appear only when this metrics
+            # object belongs to a supervisor (engine snapshots stay
+            # engine-shaped, dashboards don't grow dead columns)
+            counters.update({k: self._global[k] for k in SUPERVISOR_COUNTERS
+                             if k in self._global})
             return {
-                "counters": {k: self._global.get(k, 0) for k in COUNTERS},
+                "counters": counters,
                 "queue_depth": self._depth,
                 "queue_wait": self.queue_wait.snapshot(),
                 "run_latency": self.run_latency.snapshot(),

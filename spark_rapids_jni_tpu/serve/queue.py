@@ -68,6 +68,11 @@ class Response:
         self.submitted_ns = 0
         self.admitted_ns = 0
         self.finished_ns = 0
+        # the owning request's governor task id (stamped by Request):
+        # cross-process callers (serve/rpc.py executor workers) correlate
+        # this engine-local id with the supervisor's lease id in the
+        # flight ring, keying the --cluster timeline merge
+        self.task_id = 0
 
     def _complete(self, status: str, value: Any = None,
                   error: Optional[BaseException] = None) -> bool:
@@ -119,6 +124,9 @@ class Request:
     join_slot: int = 0
     session: Any = None      # set for client-facing requests (not halves):
     charge_bytes: int = 0    # session byte-budget charge to credit back
+
+    def __post_init__(self):
+        self.response.task_id = self.task_id
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (self.deadline is not None

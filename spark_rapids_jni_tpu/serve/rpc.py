@@ -1,0 +1,249 @@
+"""Cross-process RPC for crash-only serving: the executor-worker side.
+
+Spark's real resilience layer sits ABOVE the resource adaptor this repo
+reproduces: executors die and the driver re-dispatches their tasks.  This
+module is the executor half of that layer for the serve tier — a worker
+process entry point (:func:`executor_worker_main`) that runs today's
+:class:`~spark_rapids_jni_tpu.serve.executor.ServingEngine` over its OWN
+memory governor, plus the small message protocol it speaks with the
+supervisor (serve/supervisor.py) over a ``multiprocessing`` pipe.
+
+Protocol (plain tuples, first element the tag — pickled by the pipe):
+
+- ``(HELLO, worker_id, incarnation, pid)``        worker ready to serve
+- ``(BEAT, worker_id, incarnation, wall_t, gauges)``  liveness + pressure
+- ``(DISPATCH, rid, handler, payload, deadline_rel_s, priority)``
+- ``(RESULT, rid, status, value, (err_type, err_msg) | None)``
+- ``(SHUTDOWN, dump_epilogue)``                   drain and exit
+
+Crash-only discipline: the worker never tries to hand off state on the way
+down.  A SIGKILL (injected ``proc_kill`` fault, OOM killer, operator) just
+drops the pipe; the supervisor's receiver sees EOF, declares the worker
+dead, and re-dispatches its leases — the same path a missed-heartbeat or
+hung-lease recycle takes.  Symmetrically, a worker whose pipe to the
+supervisor breaks exits: an orphaned executor must not keep burning the
+machine.
+
+The ``rid`` (supervisor lease id) is deliberately woven into the worker's
+flight ring (``EV_LEASE_GRANT`` with ``rid:<id>`` detail next to the
+engine-local task id) so ``tools/flightdump.py --cluster`` can stitch
+per-process dumps into one cross-process request timeline.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+__all__ = [
+    "MSG_HELLO", "MSG_BEAT", "MSG_DISPATCH", "MSG_RESULT", "MSG_SHUTDOWN",
+    "SafeConn", "resolve_factory", "executor_worker_main",
+]
+
+MSG_HELLO = "hello"
+MSG_BEAT = "beat"
+MSG_DISPATCH = "dispatch"
+MSG_RESULT = "result"
+MSG_SHUTDOWN = "shutdown"
+
+# RESULT statuses mirror serve.queue terminal states, plus the one
+# non-terminal flow-control verdict a worker may return:
+STATUS_BUSY = "busy"        # worker queue full — supervisor re-queues
+
+
+class SafeConn:
+    """A ``multiprocessing`` connection that survives its peer dying.
+
+    ``send`` serializes concurrent senders (heartbeat thread + result
+    waiters share one pipe) and returns False instead of raising once the
+    peer is gone — by then the supervisor/worker death path owns cleanup,
+    and a crashing send inside a waiter thread would just add noise.
+    ``recv`` returns None on EOF for the same reason.
+    """
+
+    def __init__(self, conn):
+        self._conn = conn
+        self._send_lock = threading.Lock()
+
+    def send(self, msg: tuple) -> bool:
+        try:
+            with self._send_lock:
+                self._conn.send(msg)
+            return True
+        # analyze: ignore[retry-protocol] - pipe serialization crosses no
+        # seam and launches no governed work: nothing here can originate a
+        # control signal.  Any failure (broken pipe mid-crash, an
+        # unpicklable result value) means "peer unreachable / message
+        # undeliverable", which the caller maps to the dead-worker path.
+        except Exception:  # noqa: BLE001
+            return False
+
+    def recv(self) -> Optional[tuple]:
+        try:
+            return self._conn.recv()
+        except (EOFError, OSError):
+            return None
+
+    def close(self) -> None:
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+
+
+def resolve_factory(factory) -> Callable:
+    """Resolve a handler factory: a callable passes through; a
+    ``"module:attr"`` string imports in THIS process.  String specs are
+    what cross the spawn boundary robustly — the child resolves them
+    against its own interpreter instead of unpickling a closure."""
+    if callable(factory):
+        return factory
+    mod_name, _, attr = str(factory).partition(":")
+    if not attr:
+        raise ValueError(
+            f"factory spec {factory!r} must be 'module:function'")
+    return getattr(importlib.import_module(mod_name), attr)
+
+
+def executor_worker_main(worker_id: int, incarnation: int, conn,
+                         factory, factory_kwargs: Optional[dict] = None,
+                         worker_cfg: Optional[dict] = None,
+                         chaos: Optional[dict] = None,
+                         flags: Optional[dict] = None) -> None:
+    """Entry point of one executor worker process (spawned by the
+    supervisor).  Builds its own governor + budget + ServingEngine (one
+    failure domain, nothing shared with any sibling), registers handlers
+    via ``factory(engine, **factory_kwargs)``, optionally arms the fault
+    injector from ``chaos``, then serves DISPATCH messages until the pipe
+    closes or a SHUTDOWN arrives."""
+    from spark_rapids_jni_tpu import config
+
+    for k, v in (flags or {}).items():
+        config.set(k, v)
+
+    from spark_rapids_jni_tpu.mem.governed import default_device_budget
+    from spark_rapids_jni_tpu.mem.governor import (
+        BudgetedResource,
+        MemoryGovernor,
+    )
+    from spark_rapids_jni_tpu.obs import flight as _flight
+    from spark_rapids_jni_tpu.serve.executor import ServingEngine
+    from spark_rapids_jni_tpu.serve.queue import OK
+
+    cfg = dict(worker_cfg or {})
+    gov = MemoryGovernor(
+        watchdog_period_s=float(cfg.pop("watchdog_period_s", 0.05)))
+    budget_bytes = cfg.pop("budget_bytes", None)
+    budget = (BudgetedResource(gov, int(budget_bytes))
+              if budget_bytes is not None else default_device_budget(gov))
+    engine = ServingEngine(
+        gov=gov, budget=budget,
+        workers=int(cfg.pop("workers", 2)),
+        queue_size=int(cfg.pop("queue_size", 64)),
+        default_deadline_s=cfg.pop("default_deadline_s", 30.0),
+        adaptive=bool(cfg.pop("adaptive", False)))
+    resolve_factory(factory)(engine, **(factory_kwargs or {}))
+    if chaos:
+        from spark_rapids_jni_tpu.obs.faultinj import FaultInjector
+
+        FaultInjector.install(chaos)
+
+    # one uncapped internal session: tenant admission (budgets, ladder,
+    # priorities) already happened in the supervisor; the worker engine's
+    # job is governed execution, not a second front door
+    sess = engine.open_session(f"lease:w{worker_id}")
+    sconn = SafeConn(conn)
+    stop = threading.Event()
+    dump_epilogue = [False]
+
+    def heartbeat() -> None:
+        period = float(config.get("serve_heartbeat_s"))
+        nworkers = max(1, len(engine._workers))
+        while not stop.wait(period):
+            # blocked_frac mirrors the admission controller's pressure
+            # signal (rolling arbiter park time over the window, per
+            # worker thread) — the supervisor's ladder reads both
+            try:
+                rolled = engine.gov.arbiter.rolling_blocked(1.0)
+                blocked = min(1.0, sum(rolled.values()) / (1e9 * nworkers))
+            except RuntimeError:  # governor closing: no trend signal
+                blocked = 0.0
+            gauges = {
+                "mem_frac": engine.budget.used / max(1, engine.budget.limit),
+                "blocked_frac": blocked,
+                "queue_depth": engine.queue.depth(),
+                "outstanding": engine.queue.outstanding(),
+            }
+            if not sconn.send((MSG_BEAT, worker_id, incarnation,
+                               time.time(), gauges)):
+                return  # supervisor gone; main loop will see EOF too
+
+    def waiter(rid: int, resp) -> None:
+        resp.wait()  # the engine guarantees a terminal state
+        if resp.status == OK:
+            err = None
+            value = resp.value
+        else:
+            err = (type(resp.error).__name__ if resp.error is not None
+                   else resp.status,
+                   str(resp.error) if resp.error is not None else "")
+            value = None
+        if not sconn.send((MSG_RESULT, rid, resp.status, value, err)):
+            # the value may be unpicklable even though the pipe is fine:
+            # degrade to an in-band error so the lease still terminates
+            sconn.send((MSG_RESULT, rid, "error", None,
+                        ("UnserializableResult",
+                         f"result of rid {rid} could not cross the pipe")))
+        _flight.record(_flight.EV_LEASE_DONE, resp.task_id,
+                       detail=f"rid:{rid}:worker:{worker_id}:{resp.status}")
+
+    beat_thread = threading.Thread(target=heartbeat, daemon=True,
+                                   name=f"serve-worker-{worker_id}-beat")
+    beat_thread.start()
+    sconn.send((MSG_HELLO, worker_id, incarnation, os.getpid()))
+
+    try:
+        while True:
+            msg = sconn.recv()
+            if msg is None:
+                break  # supervisor died: crash-only both directions
+            tag = msg[0]
+            if tag == MSG_SHUTDOWN:
+                dump_epilogue[0] = bool(msg[1])
+                break
+            if tag != MSG_DISPATCH:
+                continue
+            _, rid, handler, payload, deadline_rel_s, priority = msg
+            try:
+                resp = engine.submit(sess, handler, payload,
+                                     priority=priority,
+                                     deadline_s=deadline_rel_s)
+            # analyze: ignore[retry-protocol] - submit crosses no seam
+            # (admission only); failures here are flow control
+            # (Backpressure -> BUSY re-queue upstream) or setup bugs
+            # (unknown handler), both reported in-band to the supervisor
+            except Exception as e:  # noqa: BLE001
+                from spark_rapids_jni_tpu.serve.queue import Backpressure
+
+                status = (STATUS_BUSY if isinstance(e, Backpressure)
+                          else "error")
+                sconn.send((MSG_RESULT, rid, status, None,
+                            (type(e).__name__, str(e))))
+                continue
+            _flight.record(_flight.EV_LEASE_GRANT, resp.task_id,
+                           detail=f"rid:{rid}:worker:{worker_id}:local")
+            threading.Thread(target=waiter, args=(rid, resp), daemon=True,
+                             name=f"serve-worker-{worker_id}-rid{rid}").start()
+    finally:
+        stop.set()
+        if dump_epilogue[0]:
+            # end-of-run ring dump so the --cluster merge has this
+            # process's timeline even when nothing anomalous happened here
+            _flight.anomaly("cluster_epilogue",
+                            detail=f"worker:{worker_id}:inc:{incarnation}")
+        engine.shutdown(drain=False, timeout=5.0)
+        gov.close()
+        sconn.close()

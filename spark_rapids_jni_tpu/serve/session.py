@@ -51,6 +51,15 @@ class Session:
         # starved low-priority tenant climbs instead of aging out.
         self.budget_scale = 1.0
         self.age_boost = 0
+        # degradation-ladder shed count (serve/supervisor.py): which
+        # tenants the brownout actually hit, surfaced per session so an
+        # operator can tell "we shed the batch tier" from "we shed
+        # everyone" in one snapshot
+        self.degrade_rejects = 0
+
+    def note_degraded(self) -> None:
+        with self._lock:
+            self.degrade_rejects += 1
 
     def set_budget_scale(self, scale: float) -> None:
         with self._lock:
@@ -108,6 +117,7 @@ class Session:
                 "byte_budget": self.byte_budget,
                 "budget_scale": self.budget_scale,
                 "age_boost": self.age_boost,
+                "degrade_rejects": self.degrade_rejects,
                 "inflight_bytes": self.inflight_bytes,
                 "inflight_requests": self.inflight_requests,
                 "closed": self.closed,

@@ -1,0 +1,918 @@
+"""Crash-only serving: supervised multi-process failure domains.
+
+The reference's ``SparkResourceAdaptor`` arbitrates memory *within* one
+executor; Spark's actual resilience lives one layer up, where the driver
+watches executors and re-dispatches the tasks of any that die.  This
+module is that layer for the serve tier: a **router/supervisor** that owns
+sessions and the admission queue, over **N executor worker processes**
+(serve/rpc.py) each running its own :class:`ServingEngine` on its own
+memory governor — separate failure domains, nothing shared but pipes.
+
+Three mechanisms make it crash-only (processes are only ever killed and
+respawned, never coaxed back to health):
+
+- **Heartbeat/health protocol** — every worker beats pressure gauges at
+  ``serve_heartbeat_s``; a worker that stops beating, whose process exits,
+  or whose pipe EOFs is declared dead, SIGKILLed for certainty, and
+  respawned with a bumped incarnation.
+- **Per-request lease table with idempotent re-dispatch** — every
+  dispatched request holds a lease recording (worker, incarnation).  A
+  dead or hung executor's leased requests re-queue to survivors exactly
+  once (death detection is idempotent per incarnation), and late results
+  from a recycled worker are dropped as duplicates — each lease completes
+  effectively once.  Fan-out splits keep parent lineage in the lease
+  table, so a re-dispatched child still lands in its ``_SplitJoin`` slot
+  and the parent's join completes (*Thallus*-shaped owner-to-owner seam:
+  the columnar exchange of ROADMAP open item 1 plugs in here later).
+- **Degradation ladder** — healthy -> shed-low-priority ->
+  serve-only-cached-plans -> reject-with-retry-after, steered by the same
+  pressure signals the round-9 admission controller samples (worker
+  mem/blocked gauges via heartbeats, queue occupancy) plus the alive
+  fraction.  Degrade before you drop (*Sparkle*'s tiered capacity): each
+  transition is a ledger entry and an ``EV_DEGRADE_*`` flight event, and
+  every step is reversible when pressure clears.
+"""
+
+from __future__ import annotations
+
+import itertools
+import multiprocessing
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from spark_rapids_jni_tpu.obs import flight as _flight
+from spark_rapids_jni_tpu.serve.executor import _SplitJoin, split_till
+from spark_rapids_jni_tpu.serve.metrics import ServeMetrics
+from spark_rapids_jni_tpu.serve.queue import (
+    CANCELLED,
+    ERROR,
+    OK,
+    TIMED_OUT,
+    AdmissionQueue,
+    Backpressure,
+    Request,
+    RequestTimeout,
+)
+from spark_rapids_jni_tpu.serve import rpc
+from spark_rapids_jni_tpu.serve.session import (
+    Session,
+    SessionBudgetExceeded,
+    SessionRegistry,
+)
+
+__all__ = [
+    "Degraded", "HandlerSpec", "RemoteExecutorError", "Supervisor",
+    "DEGRADE_LEVELS", "LEVEL_HEALTHY", "LEVEL_SHED_LOW",
+    "LEVEL_CACHED_ONLY", "LEVEL_REJECT",
+]
+
+# the degradation ladder, shallow to deep
+DEGRADE_LEVELS = ("healthy", "shed_low", "cached_only", "reject")
+LEVEL_HEALTHY = 0
+LEVEL_SHED_LOW = 1       # shed below-threshold-priority submits
+LEVEL_CACHED_ONLY = 2    # admit only warm/cacheable handler classes
+LEVEL_REJECT = 3         # reject everything with retry-after
+
+# lease states
+_QUEUED = "queued"       # in the admission queue (initial or re-dispatch)
+_LEASED = "leased"       # dispatched to one executor incarnation
+_DONE = "done"           # effectively completed (exactly once)
+
+
+class Degraded(Backpressure):
+    """Submit shed by the degradation ladder (a typed Backpressure: the
+    client's reject/retry loop needs no new branch, but can see WHY)."""
+
+    def __init__(self, msg: str, retry_after_s: float, level: int):
+        super().__init__(msg, retry_after_s)
+        self.level = level
+
+
+class RemoteExecutorError(RuntimeError):
+    """A handler failure inside an executor process, re-raised here with
+    the remote type name preserved in the message."""
+
+
+class HandlerSpec:
+    """The supervisor's view of a query class: enough to admit (byte
+    estimate), optionally fan a request out across executors
+    (``split``/``combine``, up to ``fanout`` pieces), and classify it for
+    the cached-only degradation level (``cacheable`` marks classes whose
+    compiled plans are expected resident; otherwise a class becomes
+    "warm" after its first completed request)."""
+
+    __slots__ = ("name", "nbytes_of", "split", "combine", "cacheable",
+                 "fanout")
+
+    def __init__(self, name: str,
+                 nbytes_of: Callable[[Any], int] = lambda p: 0,
+                 split: Optional[Callable[[Any], Sequence[Any]]] = None,
+                 combine: Optional[Callable[[List[Any]], Any]] = None,
+                 cacheable: bool = False, fanout: int = 1):
+        if (split is None) != (combine is None):
+            raise ValueError("split and combine must be provided together")
+        if fanout > 1 and split is None:
+            raise ValueError("fanout > 1 requires split/combine")
+        self.name = name
+        self.nbytes_of = nbytes_of
+        self.split = split
+        self.combine = combine
+        self.cacheable = cacheable
+        self.fanout = int(fanout)
+
+
+class _Lease:
+    """One dispatched request's supervision record (lease-table entry)."""
+
+    __slots__ = ("rid", "req", "state", "worker_id", "incarnation",
+                 "dispatches", "redispatches", "granted_ns", "completed")
+
+    def __init__(self, rid: int, req: Request):
+        self.rid = rid
+        self.req = req
+        self.state = _QUEUED
+        self.worker_id = -1
+        self.incarnation = -1
+        self.dispatches = 0
+        self.redispatches = 0
+        self.granted_ns = 0
+        self.completed = False
+
+
+class _ExecutorHandle:
+    """Supervisor-side record of one executor process incarnation."""
+
+    __slots__ = ("worker_id", "incarnation", "proc", "conn", "state",
+                 "pid", "last_beat", "gauges", "inflight", "recv_thread")
+
+    def __init__(self, worker_id: int, incarnation: int, proc, conn):
+        self.worker_id = worker_id
+        self.incarnation = incarnation
+        self.proc = proc
+        self.conn = conn
+        self.state = "starting"    # starting -> alive -> dead
+        self.pid = 0
+        self.last_beat = time.monotonic()
+        self.gauges: dict = {}
+        self.inflight: set = set()  # rids leased to this incarnation
+        self.recv_thread = None
+
+
+class Supervisor:
+    """Router/supervisor process: sessions + admission + lease table over
+    N executor worker processes.
+
+    ``stress_source`` (tests) injects the ladder's pressure sample;
+    ``start=False`` builds the supervisor without spawning processes or
+    threads so unit tests can drive :meth:`_ladder_tick` and the lease
+    table deterministically.
+    """
+
+    def __init__(self, *, workers: int = 2, factory=None,
+                 factory_kwargs: Optional[dict] = None,
+                 worker_cfg: Optional[dict] = None,
+                 worker_flags: Optional[dict] = None,
+                 chaos: Optional[Callable[[int, int], Optional[dict]]] = None,
+                 queue_size: Optional[int] = None,
+                 default_deadline_s: Optional[float] = 30.0,
+                 heartbeat_s: Optional[float] = None,
+                 heartbeat_misses: Optional[int] = None,
+                 lease_hang_s: Optional[float] = None,
+                 lease_max_dispatches: int = 3,
+                 spawn_grace_s: float = 60.0,
+                 max_inflight_per_worker: int = 8,
+                 degrade_up: Sequence[float] = (0.2, 0.55, 0.85),
+                 degrade_margin: float = 0.1,
+                 degrade_dwell_ticks: int = 2,
+                 degrade_alpha: float = 0.5,
+                 shed_priority_min: int = 1,
+                 dump_on_exit: bool = False,
+                 stress_source: Optional[Callable[[], float]] = None,
+                 start: bool = True):
+        from spark_rapids_jni_tpu import config
+
+        if queue_size is None:
+            queue_size = int(config.get("serve_queue_size"))
+        if heartbeat_s is None:
+            heartbeat_s = float(config.get("serve_heartbeat_s"))
+        if heartbeat_misses is None:
+            heartbeat_misses = int(config.get("serve_heartbeat_misses"))
+        if lease_hang_s is None:
+            lease_hang_s = float(config.get("serve_lease_hang_s"))
+        self.nworkers = int(workers)
+        self.factory = factory
+        self.factory_kwargs = dict(factory_kwargs or {})
+        self.worker_cfg = dict(worker_cfg or {})
+        self.worker_flags = dict(worker_flags or {})
+        self.chaos = chaos
+        self.default_deadline_s = default_deadline_s
+        self.heartbeat_s = heartbeat_s
+        self.heartbeat_misses = int(heartbeat_misses)
+        self.lease_hang_s = float(lease_hang_s)
+        self.lease_max_dispatches = int(lease_max_dispatches)
+        self.spawn_grace_s = float(spawn_grace_s)
+        self.max_inflight_per_worker = int(max_inflight_per_worker)
+        self.degrade_up = tuple(degrade_up)
+        self.degrade_margin = float(degrade_margin)
+        self.degrade_dwell_ticks = int(degrade_dwell_ticks)
+        self.degrade_alpha = float(degrade_alpha)
+        self.shed_priority_min = int(shed_priority_min)
+        self.dump_on_exit = bool(dump_on_exit)
+        self._stress_source = stress_source
+        self._ctx = multiprocessing.get_context("spawn")
+        self.metrics = ServeMetrics()
+        self.sessions = SessionRegistry()
+        self.queue = AdmissionQueue(queue_size,
+                                    retry_after_hint=self._retry_after,
+                                    on_timeout=self._on_queue_timeout)
+        self._seq = itertools.count()
+        # ONE lock guards the supervisor's shared state: handles, the
+        # lease table, handler specs, the warm set, and ladder fields.
+        # Leaf discipline: never held across pipe sends, queue calls,
+        # process spawns, or session/response completion.
+        self._lock = threading.Lock()
+        self._handles: Dict[int, _ExecutorHandle] = {}
+        # live leases only: completed entries retire into the aggregate
+        # counters below (holding every served request's payload+result
+        # forever would be an unbounded leak, and the monitor's sweeps
+        # scan this table every heartbeat tick)
+        self._leases: Dict[int, _Lease] = {}
+        self._leases_total = 0
+        self._leases_completed = 0
+        self._leases_redispatched = 0
+        self._lease_max_dispatches_seen = 0
+        self._specs: Dict[str, HandlerSpec] = {}
+        self._warm: set = set()
+        self._level = LEVEL_HEALTHY
+        self._level_max_seen = LEVEL_HEALTHY
+        self._stress_ewma: Optional[float] = None
+        self._ladder_tickno = 0
+        self._ladder_last_change = -10**9
+        self.ledger: List[dict] = []
+        self._stop = threading.Event()
+        self._dispatcher: Optional[threading.Thread] = None
+        self._monitor: Optional[threading.Thread] = None
+        self._telemetry_name = f"supervisor:{id(self):x}"
+        _flight.register_telemetry_source(self._telemetry_name,
+                                          self.snapshot)
+        if start:
+            for wid in range(self.nworkers):
+                self._spawn_worker(wid, 0)
+            self._dispatcher = threading.Thread(
+                target=self._dispatch_loop, daemon=True,
+                name="serve-supervisor-dispatch")
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, daemon=True,
+                name="serve-supervisor-monitor")
+            self._dispatcher.start()
+            self._monitor.start()
+
+    # -- registration / sessions --------------------------------------------
+    def register(self, spec: HandlerSpec) -> None:
+        with self._lock:
+            if spec.name in self._specs:
+                raise ValueError(f"handler {spec.name!r} already registered")
+            self._specs[spec.name] = spec
+
+    def open_session(self, name: Optional[str] = None, *, priority: int = 0,
+                     byte_budget: Optional[int] = None) -> Session:
+        return self.sessions.open(name, priority=priority,
+                                  byte_budget=byte_budget)
+
+    def close_session(self, session: Session) -> None:
+        self.sessions.close(session)
+
+    # -- the producer surface -----------------------------------------------
+    def submit(self, session: Session, handler: str, payload: Any, *,
+               priority: Optional[int] = None,
+               deadline_s: Optional[float] = None):
+        with self._lock:
+            spec = self._specs.get(handler)
+        if spec is None:
+            raise KeyError(f"no handler {handler!r} registered")
+        prio = priority if priority is not None else session.priority
+        self._gate(session, spec, prio)
+        nbytes = int(spec.nbytes_of(payload))
+        try:
+            session.charge(nbytes)
+        except SessionBudgetExceeded:
+            self.metrics.count("rejected_session", session.session_id)
+            raise
+        dl = deadline_s if deadline_s is not None else self.default_deadline_s
+        req = Request(
+            handler=handler, payload=payload,
+            session_id=session.session_id, priority=prio,
+            deadline=(time.monotonic() + dl) if dl is not None else None,
+            seq=next(self._seq), task_id=self.sessions.next_task_id(),
+        )
+        req.charge_bytes = nbytes
+        req.session = session
+        try:
+            self.queue.submit(req)
+        except Backpressure:
+            session.credit(nbytes)
+            self.metrics.count("rejected_full", session.session_id)
+            _flight.record(_flight.EV_QUEUE_REJECT, req.task_id,
+                           detail=f"handler:{handler}")
+            raise
+        except BaseException:  # closed queue (shutdown): no charge leaks
+            session.credit(nbytes)
+            raise
+        self.metrics.count("submitted", session.session_id)
+        return req.response
+
+    def _gate(self, session: Session, spec: HandlerSpec,
+              priority: int) -> None:
+        """The degradation ladder's admission decision for one submit."""
+        with self._lock:
+            level = self._level
+            warm = spec.name in self._warm
+        if level == LEVEL_HEALTHY:
+            return
+        reason = None
+        if level >= LEVEL_REJECT:
+            reason = "rejecting all submits"
+        elif level >= LEVEL_CACHED_ONLY and not (spec.cacheable or warm):
+            reason = f"only warm/cacheable classes served ({spec.name} cold)"
+        elif level >= LEVEL_SHED_LOW and priority < self.shed_priority_min:
+            reason = (f"shedding priority < {self.shed_priority_min} "
+                      f"(got {priority})")
+        if reason is None:
+            return
+        retry = self._retry_after(self.queue.depth()) * (1 + level)
+        self.metrics.count("rejected_degraded", session.session_id)
+        session.note_degraded()
+        _flight.record(_flight.EV_QUEUE_REJECT, -1,
+                       detail=f"degraded:{DEGRADE_LEVELS[level]}:"
+                              f"handler:{spec.name}")
+        raise Degraded(
+            f"degraded ({DEGRADE_LEVELS[level]}): {reason}", retry, level)
+
+    def _retry_after(self, depth: int) -> float:
+        return min(5.0, 0.01 * max(depth, 1))
+
+    # -- queue callbacks -----------------------------------------------------
+    def _credit(self, req: Request) -> None:
+        sess = getattr(req, "session", None)
+        if sess is not None:
+            sess.credit(getattr(req, "charge_bytes", 0))
+            req.session = None
+
+    def _lease_done_locked(self, lease: _Lease) -> None:
+        """Retire a lease (caller holds ``self._lock``): fold it into the
+        aggregate counters and drop the table entry — the lease table
+        holds LIVE supervision state only."""
+        if lease.completed:
+            return
+        lease.completed = True
+        lease.state = _DONE
+        self._leases_completed += 1
+        self._lease_max_dispatches_seen = max(
+            self._lease_max_dispatches_seen, lease.dispatches)
+        self._leases.pop(lease.rid, None)
+
+    def _on_queue_timeout(self, req: Request) -> None:
+        self._credit(req)
+        self.metrics.count("timed_out", req.session_id)
+        _flight.record(_flight.EV_QUEUE_TIMEOUT, req.task_id,
+                       detail=f"handler:{req.handler}")
+        with self._lock:
+            lease = self._leases.get(req.task_id)
+            if lease is not None:
+                self._lease_done_locked(lease)
+        if req.join is not None:
+            req.join.deliver(req.join_slot, TIMED_OUT, None,
+                             req.response.error)
+
+    def _finish(self, req: Request, status: str, value: Any = None,
+                error: Optional[BaseException] = None) -> None:
+        first = req.response._complete(status, value=value, error=error)
+        if not first:
+            return
+        self._credit(req)
+        counter = {OK: "completed", TIMED_OUT: "timed_out",
+                   CANCELLED: "cancelled"}.get(status, "failed")
+        self.metrics.count(counter, req.session_id)
+        if req.join is not None:
+            req.join.deliver(req.join_slot, status, value, error)
+
+    # -- worker lifecycle ----------------------------------------------------
+    def _spawn_worker(self, worker_id: int, incarnation: int) -> None:
+        parent_conn, child_conn = self._ctx.Pipe(duplex=True)
+        chaos_cfg = (self.chaos(worker_id, incarnation)
+                     if self.chaos is not None else None)
+        proc = self._ctx.Process(
+            target=rpc.executor_worker_main,
+            args=(worker_id, incarnation, child_conn, self.factory),
+            kwargs={"factory_kwargs": self.factory_kwargs,
+                    "worker_cfg": self.worker_cfg,
+                    "chaos": chaos_cfg,
+                    "flags": self.worker_flags},
+            daemon=True, name=f"serve-executor-{worker_id}")
+        proc.start()
+        child_conn.close()  # the child's end lives in the child now
+        handle = _ExecutorHandle(worker_id, incarnation, proc,
+                                 rpc.SafeConn(parent_conn))
+        handle.recv_thread = threading.Thread(
+            target=self._recv_loop, args=(handle,), daemon=True,
+            name=f"serve-supervisor-recv-{worker_id}.{incarnation}")
+        with self._lock:
+            self._handles[worker_id] = handle
+        handle.recv_thread.start()
+        self.metrics.count("workers_spawned")
+        _flight.record(_flight.EV_WORKER_SPAWN, -1,
+                       detail=f"worker:{worker_id}:inc:{incarnation}:"
+                              f"pid:{proc.pid}")
+
+    def _recv_loop(self, handle: _ExecutorHandle) -> None:
+        while True:
+            msg = handle.conn.recv()
+            if msg is None:
+                # EOF during shutdown is the worker draining on request,
+                # not a death — only a LIVE supervisor treats it as one
+                if not self._stop.is_set():
+                    self._worker_dead(handle, "pipe_eof")
+                return
+            tag = msg[0]
+            if tag == rpc.MSG_HELLO:
+                with self._lock:
+                    if handle.state == "starting":
+                        handle.state = "alive"
+                    handle.pid = msg[3]
+                    handle.last_beat = time.monotonic()
+            elif tag == rpc.MSG_BEAT:
+                with self._lock:
+                    handle.last_beat = time.monotonic()
+                    handle.gauges = dict(msg[4])
+            elif tag == rpc.MSG_RESULT:
+                self._on_result(handle, msg[1], msg[2], msg[3], msg[4])
+
+    def _worker_dead(self, handle: _ExecutorHandle, reason: str) -> None:
+        """Idempotent per incarnation: declare dead, SIGKILL for
+        certainty, re-queue its leases to survivors (each exactly once),
+        respawn."""
+        with self._lock:
+            if handle.state == "dead":
+                return
+            handle.state = "dead"
+            current = self._handles.get(handle.worker_id) is handle
+            orphans = []
+            for rid in handle.inflight:
+                lease = self._leases.get(rid)
+                if (lease is not None and not lease.completed
+                        and lease.state == _LEASED
+                        and lease.worker_id == handle.worker_id
+                        and lease.incarnation == handle.incarnation):
+                    lease.state = _QUEUED
+                    if lease.redispatches == 0:
+                        self._leases_redispatched += 1
+                    lease.redispatches += 1
+                    orphans.append(lease)
+            handle.inflight.clear()
+        self.metrics.count("workers_dead")
+        _flight.record(_flight.EV_WORKER_DEAD, -1,
+                       detail=f"worker:{handle.worker_id}:"
+                              f"inc:{handle.incarnation}:{reason}")
+        try:
+            handle.proc.kill()
+        except (OSError, ValueError, AttributeError):
+            pass
+        handle.conn.close()
+        for lease in orphans:
+            self.metrics.count("leases_redispatched")
+            _flight.record(_flight.EV_LEASE_REDISPATCH, lease.rid,
+                           detail=f"rid:{lease.rid}:"
+                                  f"from:{handle.worker_id}."
+                                  f"{handle.incarnation}:{reason}")
+            self._requeue(lease.req)
+        if current and not self._stop.is_set():
+            self._spawn_worker(handle.worker_id, handle.incarnation + 1)
+
+    def _requeue(self, req: Request) -> None:
+        try:
+            self.queue.submit(req, force=True)
+        # analyze: ignore[retry-protocol] - queue.submit crosses no seam;
+        # the breadth is for shutdown races, where the request must reach
+        # a terminal state rather than be lost (engine._requeue twin)
+        except BaseException as e:  # noqa: BLE001
+            self._finish(req, ERROR, error=e)
+
+    # -- dispatch ------------------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        while True:
+            req = self.queue.pop(timeout=0.1)
+            if req is None:
+                if self._stop.is_set():
+                    return
+                continue
+            # the pop slot is returned only AFTER routing: between pop and
+            # lease grant (or re-queue) the request is tracked by neither
+            # the heap nor the lease table, and wait_drained must not see
+            # idle through that window (review r10)
+            try:
+                self._route(req)
+            # analyze: ignore[retry-protocol] - routing crosses no seam
+            # and runs no governed work; any unexpected failure must
+            # terminate THIS request loudly, never the dispatcher thread
+            except Exception as e:  # noqa: BLE001
+                self._finish(req, ERROR, error=e)
+            finally:
+                self.queue.task_done()
+
+    def _route(self, req: Request) -> None:
+        with self._lock:
+            spec = self._specs.get(req.handler)
+            alive = sum(1 for h in self._handles.values()
+                        if h.state == "alive")
+            # a request that already holds a lease is a re-dispatch (dead
+            # worker, BUSY): it must re-grant as itself — fanning out now
+            # would complete the response through child leases while the
+            # original lease sat un-completed forever (review r10)
+            has_lease = req.task_id in self._leases
+        if spec is None:
+            self._finish(req, ERROR,
+                         error=KeyError(f"no handler {req.handler!r}"))
+            return
+        if (spec.fanout > 1 and spec.split is not None and req.join is None
+                and req.split_depth == 0 and not has_lease and alive > 1):
+            parts = self._fanout_parts(spec, req.payload,
+                                       min(spec.fanout, alive))
+            if len(parts) > 1:
+                self._fanout_dispatch(req, spec, parts)
+                return
+        self._grant(req)
+
+    def _fanout_parts(self, spec: HandlerSpec, payload: Any,
+                      want: int) -> List[Any]:
+        # halving per level yields powers of two: bound by the DEEPEST
+        # level that stays <= want, so the piece count never exceeds the
+        # spec's documented fanout contract (2^floor(log2(want)))
+        return split_till(payload, spec.split,
+                          max_levels=max(1, want.bit_length() - 1))[0]
+
+    def _fanout_dispatch(self, req: Request, spec: HandlerSpec,
+                         parts: List[Any]) -> None:
+        """Split one request across executors; children carry the parent's
+        lineage through the lease table so a re-dispatched child still
+        joins (the _SplitJoin machinery is the executor's own)."""
+        join = _SplitJoin(req, spec.combine, len(parts), self._finish)
+        self.metrics.count("split_requeued", req.session_id, n=len(parts))
+        for slot, part in enumerate(parts):
+            child = Request(
+                handler=req.handler, payload=part,
+                session_id=req.session_id, priority=req.priority,
+                deadline=req.deadline, seq=next(self._seq),
+                task_id=self.sessions.next_task_id(),
+                split_depth=1, no_batch=True, join=join, join_slot=slot,
+            )
+            _flight.record(_flight.EV_SPLIT_RETRY, child.task_id,
+                           detail=f"rid:{child.task_id}:"
+                                  f"fanout_from:{req.task_id}")
+            self._requeue(child)
+
+    def _grant(self, req: Request) -> None:
+        rid = req.task_id
+        now_ns = time.monotonic_ns()
+        # target choice and lease recording are ONE critical section: a
+        # worker declared dead between a separate pick and record would
+        # leave the lease pointing at an incarnation whose orphan scan
+        # already ran — lost forever (review r10, pass 2)
+        with self._lock:
+            candidates = [h for h in self._handles.values()
+                          if h.state == "alive"
+                          and len(h.inflight) < self.max_inflight_per_worker]
+            target = (min(candidates, key=lambda h: len(h.inflight))
+                      if candidates else None)
+            if target is not None:
+                lease = self._leases.get(rid)
+                if lease is None:
+                    lease = self._leases[rid] = _Lease(rid, req)
+                    self._leases_total += 1
+                if lease.completed:
+                    return  # completed while queued (timeout race)
+                lease.state = _LEASED
+                lease.worker_id = target.worker_id
+                lease.incarnation = target.incarnation
+                lease.dispatches += 1
+                lease.granted_ns = now_ns
+                target.inflight.add(rid)
+        if target is None:
+            # no live capacity right now (all dead/saturated/starting):
+            # breathe, then line back up — deadline expiry in the queue
+            # still bounds how long a request can wait for a survivor
+            time.sleep(min(0.05, self.heartbeat_s))
+            self._requeue(req)
+            return
+        if req.response.admitted_ns == 0:
+            req.response.admitted_ns = now_ns
+            self.metrics.count("admitted", req.session_id)
+            self.metrics.record_wait(now_ns - req.response.submitted_ns)
+        self.metrics.count("leases_granted", req.session_id)
+        _flight.record(_flight.EV_LEASE_GRANT, rid,
+                       detail=f"rid:{rid}:worker:{target.worker_id}:"
+                              f"inc:{target.incarnation}:"
+                              f"handler:{req.handler}")
+        deadline_rel = (None if req.deadline is None
+                        else max(0.05, req.deadline - time.monotonic()))
+        ok = target.conn.send((rpc.MSG_DISPATCH, rid, req.handler,
+                               req.payload, deadline_rel, req.priority))
+        if not ok:
+            # reclaim THIS lease explicitly: if the EOF path already ran
+            # for this incarnation, _worker_dead below is a no-op and
+            # would never re-scan — without this the lease is orphaned
+            with self._lock:
+                lease = self._leases.get(rid)
+                reclaim = (lease is not None and not lease.completed
+                           and lease.state == _LEASED
+                           and lease.worker_id == target.worker_id
+                           and lease.incarnation == target.incarnation)
+                if reclaim:
+                    lease.state = _QUEUED
+                    if lease.redispatches == 0:
+                        self._leases_redispatched += 1
+                    lease.redispatches += 1
+                    target.inflight.discard(rid)
+            if reclaim:
+                self.metrics.count("leases_redispatched")
+                _flight.record(_flight.EV_LEASE_REDISPATCH, rid,
+                               detail=f"rid:{rid}:"
+                                      f"from:{target.worker_id}."
+                                      f"{target.incarnation}:send_failed")
+                self._requeue(req)
+            self._worker_dead(target, "send_failed")
+
+    def _on_result(self, handle: _ExecutorHandle, rid: int, status: str,
+                   value: Any, err) -> None:
+        requeue = False
+        with self._lock:
+            lease = self._leases.get(rid)
+            stale = (lease is None or lease.completed
+                     or lease.state != _LEASED
+                     or lease.worker_id != handle.worker_id
+                     or lease.incarnation != handle.incarnation)
+            if not stale:
+                handle.inflight.discard(rid)
+                if status == rpc.STATUS_BUSY:
+                    lease.state = _QUEUED
+                    if lease.redispatches == 0:
+                        self._leases_redispatched += 1
+                    lease.redispatches += 1
+                    requeue = True
+                else:
+                    self._lease_done_locked(lease)
+        if stale:
+            # a recycled worker's late answer for a re-dispatched lease:
+            # the active dispatch owns completion — count and drop
+            self.metrics.count("duplicate_results")
+            return
+        req = lease.req
+        if requeue:
+            self.metrics.count("leases_redispatched")
+            _flight.record(_flight.EV_LEASE_REDISPATCH, rid,
+                           detail=f"rid:{rid}:from:{handle.worker_id}."
+                                  f"{handle.incarnation}:busy")
+            self._requeue(req)
+            return
+        self.metrics.count("leases_completed", req.session_id)
+        _flight.record(_flight.EV_LEASE_DONE, rid,
+                       detail=f"rid:{rid}:worker:{handle.worker_id}:"
+                              f"{status}")
+        if status == OK:
+            with self._lock:
+                self._warm.add(req.handler)
+            self._finish(req, OK, value=value)
+        elif status == TIMED_OUT:
+            self._finish(req, TIMED_OUT, error=RequestTimeout(
+                err[1] if err else "deadline expired in executor"))
+        elif status == CANCELLED:
+            self._finish(req, CANCELLED, error=RuntimeError(
+                "executor cancelled the request"))
+        else:
+            tname, msg = err if err else ("unknown", "")
+            self._finish(req, ERROR,
+                         error=RemoteExecutorError(f"{tname}: {msg}"))
+
+    # -- the monitor: health, hung leases, the ladder ------------------------
+    def _monitor_loop(self) -> None:
+        period = max(0.01, self.heartbeat_s)
+        while not self._stop.wait(period):
+            self._health_sweep()
+            self._ladder_tick()
+
+    def _health_sweep(self) -> None:
+        now = time.monotonic()
+        now_ns = time.monotonic_ns()
+        with self._lock:
+            handles = list(self._handles.values())
+            hang_ns = int(self.lease_hang_s * 1e9)
+            hung = [lease for lease in self._leases.values()
+                    if lease.state == _LEASED and not lease.completed
+                    and now_ns - lease.granted_ns > hang_ns]
+            # blast-radius cap: a request that has hung repeatedly must
+            # not serially destroy the whole pool — after
+            # lease_max_dispatches it fails terminally instead of
+            # re-dispatching again (the worker it wedged still recycles)
+            doomed = []
+            for lease in hung:
+                if lease.dispatches >= self.lease_max_dispatches:
+                    doomed.append(lease.req)
+                    self._lease_done_locked(lease)
+            hung_keys = {(lease.worker_id, lease.incarnation)
+                         for lease in hung}
+        for req in doomed:
+            _flight.record(_flight.EV_LEASE_DONE, req.task_id,
+                           detail=f"rid:{req.task_id}:gave_up:"
+                                  f"hung_x{self.lease_max_dispatches}")
+            self._finish(req, ERROR, error=RuntimeError(
+                f"request hung on {self.lease_max_dispatches} separate "
+                f"executors (lease_hang_s={self.lease_hang_s:g} each)"))
+        for h in handles:
+            if h.state == "dead":
+                continue
+            if not h.proc.is_alive():
+                self._worker_dead(h, "proc_exit")
+            elif (h.state == "alive" and now - h.last_beat
+                    > self.heartbeat_s * self.heartbeat_misses):
+                self._worker_dead(h, "heartbeat_lost")
+            elif (h.state == "starting"
+                    and now - h.last_beat > self.spawn_grace_s):
+                self._worker_dead(h, "spawn_timeout")
+            elif (h.worker_id, h.incarnation) in hung_keys:
+                # crash-only hung-lease recovery: recycle the WHOLE
+                # process (its wedged thread is unrecoverable anyway) and
+                # let the shared dead-worker path re-dispatch
+                _flight.record(_flight.EV_TASK_HUNG, -1,
+                               detail=f"worker:{h.worker_id}:"
+                                      f"inc:{h.incarnation}:hung_lease")
+                self._worker_dead(h, "hung_lease")
+
+    def _sample_stress(self) -> float:
+        with self._lock:
+            handles = list(self._handles.values())
+        alive = [h for h in handles if h.state == "alive"]
+        # missing capacity: dead workers plus RESPAWNING incarnations
+        # (their capacity is genuinely absent until the new process says
+        # hello).  Cold-start incarnation-0 spawns don't count — a pool
+        # that has never been up is booting, not degraded.
+        missing = sum(1 for h in handles
+                      if h.state == "dead"
+                      or (h.state == "starting" and h.incarnation > 0))
+        dead_frac = missing / max(1, self.nworkers)
+        queue_frac = self.queue.depth() / max(1, self.queue.maxsize)
+        worker_press = max(
+            (max(float(h.gauges.get("mem_frac", 0.0)),
+                 float(h.gauges.get("blocked_frac", 0.0)))
+             for h in alive), default=0.0)
+        return max(dead_frac, queue_frac, min(1.0, worker_press))
+
+    def _ladder_tick(self, stress: Optional[float] = None) -> None:
+        """One degradation-ladder step: EWMA the stress signal, move at
+        most one level per dwell window, record every transition."""
+        if stress is None:
+            stress = (self._stress_source() if self._stress_source
+                      else self._sample_stress())
+        transition = None
+        with self._lock:
+            self._ladder_tickno += 1
+            tick = self._ladder_tickno
+            ewma = (stress if self._stress_ewma is None
+                    else self.degrade_alpha * stress
+                    + (1.0 - self.degrade_alpha) * self._stress_ewma)
+            self._stress_ewma = ewma
+            level = self._level
+            desired = sum(1 for t in self.degrade_up if ewma >= t)
+            if tick - self._ladder_last_change < self.degrade_dwell_ticks:
+                return
+            if desired > level:
+                new = level + 1
+            elif (level > 0
+                  and ewma <= self.degrade_up[level - 1]
+                  - self.degrade_margin):
+                new = level - 1
+            else:
+                return
+            self._level = new
+            self._level_max_seen = max(self._level_max_seen, new)
+            self._ladder_last_change = tick
+            transition = {
+                "tick": tick, "t_ns": time.monotonic_ns(),
+                "from": DEGRADE_LEVELS[level], "to": DEGRADE_LEVELS[new],
+                "level": new, "stress_ewma": round(ewma, 4),
+            }
+            self.ledger.append(transition)
+            del self.ledger[:-256]
+        if transition["level"] > level:
+            _flight.record(_flight.EV_DEGRADE_ENTER, -1,
+                           detail=f"{transition['to']}:"
+                                  f"ewma:{transition['stress_ewma']}",
+                           value=transition["level"])
+        else:
+            _flight.record(_flight.EV_DEGRADE_EXIT, -1,
+                           detail=f"{transition['to']}:"
+                                  f"ewma:{transition['stress_ewma']}",
+                           value=transition["level"])
+
+    # -- introspection / lifecycle ------------------------------------------
+    def level(self) -> int:
+        with self._lock:
+            return self._level
+
+    def lease_stats(self) -> dict:
+        """The exactly-once ledger the chaos bench gates on.  Completed
+        leases live only in the aggregates; the table holds live ones."""
+        with self._lock:
+            live = list(self._leases.values())
+            total = self._leases_total
+            completed = self._leases_completed
+            redispatched = self._leases_redispatched
+            maxd = max([self._lease_max_dispatches_seen]
+                       + [le.dispatches for le in live])
+        return {
+            "leases": total,
+            "completed": completed,
+            "outstanding": len(live),
+            "redispatched": redispatched,
+            "max_dispatches": maxd,
+        }
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            workers = {
+                str(h.worker_id): {
+                    "state": h.state, "incarnation": h.incarnation,
+                    "pid": h.pid, "inflight": len(h.inflight),
+                    "gauges": dict(h.gauges),
+                }
+                for h in self._handles.values()
+            }
+            ladder = {
+                "level": self._level,
+                "level_name": DEGRADE_LEVELS[self._level],
+                "max_level_seen": self._level_max_seen,
+                "stress_ewma": (round(self._stress_ewma, 4)
+                                if self._stress_ewma is not None else None),
+                "ledger_tail": list(self.ledger)[-16:],
+                "transitions": len(self.ledger),
+            }
+        return {
+            "workers": workers,
+            "ladder": ladder,
+            "leases": self.lease_stats(),
+            "queue_depth": self.queue.depth(),
+            "counters": self.metrics.snapshot()["counters"],
+        }
+
+    def wait_drained(self, timeout: float = 60.0) -> bool:
+        """Block until every lease completed and the queue is empty."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                pending = bool(self._leases)  # live leases only
+            if not pending and self.queue.outstanding() == 0:
+                return True
+            time.sleep(0.02)
+        return False
+
+    def shutdown(self, drain: bool = True, timeout: float = 60.0) -> None:
+        if drain:
+            self.wait_drained(timeout)
+        self._stop.set()
+        dropped = self.queue.close()
+        for req in dropped:
+            self._credit(req)
+            self.metrics.count("cancelled", req.session_id)
+            if req.join is not None:
+                req.join.deliver(req.join_slot, CANCELLED, None,
+                                 req.response.error)
+        with self._lock:
+            handles = list(self._handles.values())
+            live = list(self._leases.values())
+            orphans = [le.req for le in live]
+            for le in live:
+                self._lease_done_locked(le)
+        for h in handles:
+            if h.conn is not None:
+                h.conn.send((rpc.MSG_SHUTDOWN, self.dump_on_exit))
+        for req in orphans:
+            self._finish(req, CANCELLED,
+                         error=RuntimeError("supervisor shut down"))
+        for h in handles:
+            if h.proc is not None:
+                h.proc.join(timeout=5.0)
+                if h.proc.is_alive():
+                    h.proc.kill()
+                    h.proc.join(timeout=2.0)
+            if h.conn is not None:
+                h.conn.close()
+        for t in (self._dispatcher, self._monitor):
+            if t is not None:
+                t.join(timeout=5.0)
+        _flight.unregister_telemetry_source(self._telemetry_name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+

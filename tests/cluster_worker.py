@@ -1,0 +1,69 @@
+"""Handler factories for the cluster-serving tests (not a test module).
+
+Spawned executor worker processes (serve/rpc.py) resolve their handler
+factory as a ``"module:function"`` string against their own interpreter —
+these live here, at module level in an importable file, mirroring
+``multihost_worker.py``.  Keep them dependency-light: a worker that only
+serves these never imports jax, so spawn stays cheap for tier-1 tests.
+"""
+
+import os
+import time
+
+from spark_rapids_jni_tpu.serve import QueryHandler
+
+
+def register_toy(engine, service_s: float = 0.0) -> None:
+    """Toy handlers the supervisor tests drive.
+
+    - ``sum``: splittable list-of-ints sum (the executor-test staple);
+    - ``echo_pid``: returns this worker process's pid (placement probe);
+    - ``sleep_n``: sleeps ``payload`` seconds then returns it;
+    - ``hang_once``: wedges for 60s the FIRST time a given marker path is
+      seen (cross-process "only hang once" latch: the re-dispatched
+      attempt on a survivor sees the marker and returns fast);
+    - ``boom``: always raises ValueError (remote-error propagation).
+    """
+
+    def run_sum(p, ctx):
+        if service_s:
+            time.sleep(service_s)
+        return sum(p)
+
+    engine.register(QueryHandler(
+        name="sum", fn=run_sum,
+        nbytes_of=lambda p: 64 * len(p),
+        split=lambda p: [p[:len(p) // 2], p[len(p) // 2:]],
+        combine=sum))
+
+    # same body, separate name: the supervisor fans this one out across
+    # executors (children arrive here as plain per-piece requests)
+    engine.register(QueryHandler(
+        name="sum_fan", fn=run_sum,
+        nbytes_of=lambda p: 64 * len(p),
+        split=lambda p: [p[:len(p) // 2], p[len(p) // 2:]],
+        combine=sum))
+
+    engine.register(QueryHandler(
+        name="echo_pid", fn=lambda p, ctx: os.getpid()))
+
+    def run_sleep(p, ctx):
+        time.sleep(float(p))
+        return float(p)
+
+    engine.register(QueryHandler(name="sleep_n", fn=run_sleep))
+
+    def run_hang_once(p, ctx):
+        marker = str(p)
+        if not os.path.exists(marker):
+            with open(marker, "w") as f:
+                f.write(str(os.getpid()))
+            time.sleep(60.0)  # wedged: only a supervisor recycle ends this
+        return "recovered"
+
+    engine.register(QueryHandler(name="hang_once", fn=run_hang_once))
+
+    def run_boom(p, ctx):
+        raise ValueError(f"boom: {p}")
+
+    engine.register(QueryHandler(name="boom", fn=run_boom))

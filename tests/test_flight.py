@@ -16,6 +16,7 @@ import struct
 import subprocess
 import sys
 import threading
+import time
 
 import pytest
 
@@ -131,13 +132,18 @@ def test_anomaly_dump_schema_artifact_and_rate_limit(tmp_path):
 def test_event_kind_vocabulary_is_stable():
     # wire ids are tuple positions: appending is safe, reordering is not —
     # the round-7 vocabulary keeps its ids (v2 captures stay readable),
-    # and the round-9 controller kinds are strictly appended after it
+    # the round-9 controller kinds sit right after it, and the round-10
+    # supervision kinds are strictly appended after those
     assert flight.EVENT_KINDS.index("admitted") == 0
     assert flight.KIND_IDS[flight.EV_ANOMALY] == 12
-    assert (flight.KIND_IDS[flight.EV_CONTROL_ADJUST]
-            > flight.KIND_IDS[flight.EV_ANOMALY])
-    assert flight.EVENT_KINDS[-3:] == ("control_adjust", "control_freeze",
-                                       "control_presplit")
+    assert flight.EVENT_KINDS[13:16] == ("control_adjust", "control_freeze",
+                                         "control_presplit")
+    assert (flight.KIND_IDS[flight.EV_TASK_HUNG]
+            > flight.KIND_IDS[flight.EV_CONTROL_PRESPLIT])
+    assert flight.EVENT_KINDS[-8:] == (
+        "task_hung", "degrade_enter", "degrade_exit",
+        "lease_grant", "lease_redispatch", "lease_done",
+        "worker_spawn", "worker_dead")
     assert len(set(flight.EVENT_KINDS)) == len(flight.EVENT_KINDS)
 
 
@@ -390,6 +396,14 @@ def test_serve_metrics_snapshot_and_publish_carry_pressure_gauges(gov):
         Profiler.init(sink)
         Profiler.start()
         assert eng.submit(s, "w", 1).result(timeout=60) == 2
+        # publish() runs on the worker thread AFTER the result is
+        # delivered: wait for it to land before stopping the capture
+        deadline = time.monotonic() + 5.0
+        while (eng.metrics.get("completed") < 1
+               or eng.queue.outstanding() > 0) \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.1)
         Profiler.stop()
         Profiler.shutdown()
 

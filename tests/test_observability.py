@@ -212,6 +212,74 @@ def test_fault_injection_seeded_schedule_is_deterministic():
     assert a != c  # 2^-64 false-failure odds: different seed, new schedule
 
 
+def test_fault_injection_slow_behavior_stalls_the_crossing():
+    """The round-10 ``slow`` kind: the crossing stalls durationMs then
+    proceeds normally — a degraded-but-correct executor, not a failure."""
+    col = column([1], INT32)
+    FaultInjector.install({
+        "op": {"murmur_hash32": {"injectionType": "slow",
+                                 "durationMs": 80.0}},
+    })
+    try:
+        t0 = time.monotonic()
+        ops.murmur_hash32([col], seed=0)  # completes, just late
+        assert time.monotonic() - t0 >= 0.07
+    finally:
+        FaultInjector.uninstall()
+
+
+def test_fault_injection_slow_seeded_schedule_is_deterministic():
+    """Behavioral kinds roll the same config-level RNG as fault kinds:
+    a seeded slow schedule replays exactly (chaos-kill runs depend on
+    this — the proc_kill crossing is picked the same way)."""
+    col = column([1], INT32)
+
+    def schedule(seed):
+        FaultInjector.install({
+            "seed": seed,
+            "op": {"murmur_hash32": {"injectionType": "slow",
+                                     "percent": 50,
+                                     "durationMs": 15.0}},
+        })
+        try:
+            outcomes = []
+            for _ in range(32):
+                t0 = time.monotonic()
+                ops.murmur_hash32([col], seed=0)
+                outcomes.append(1 if time.monotonic() - t0 >= 0.012 else 0)
+            return outcomes
+        finally:
+            FaultInjector.uninstall()
+
+    a, b = schedule(77), schedule(77)
+    assert a == b, "same seed must replay the exact slow schedule"
+    assert 0 < sum(a) < 32
+
+
+def test_fault_injection_proc_kill_sigkills_the_process():
+    """``proc_kill`` is the crash-only drill: the armed process vanishes
+    mid-crossing with SIGKILL — no cleanup, no exception (run in a child
+    so the suite survives its own chaos)."""
+    import subprocess
+    import sys
+
+    code = (
+        "from spark_rapids_jni_tpu.obs.faultinj import FaultInjector\n"
+        "from spark_rapids_jni_tpu.obs.seam import seam, OP\n"
+        "FaultInjector.install({'op': {'die': "
+        "{'injectionType': 'proc_kill'}}})\n"
+        "with seam(OP, 'die'):\n"
+        "    pass\n"
+        "print('survived')\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=60,
+                          env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == -9, (proc.returncode, proc.stdout,
+                                   proc.stderr)
+    assert "survived" not in proc.stdout
+
+
 def test_fault_injection_hot_reload(tmp_path):
     cfg = tmp_path / "faults.json"
     cfg.write_text(json.dumps({"dynamic": True, "op": {}}))

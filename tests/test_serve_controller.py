@@ -429,3 +429,126 @@ def test_plan_retry_stats_gate_and_decay():
                 assert rt.suggested_presplit_depth("q_test") == 0
     finally:
         rt.reset_plan_retry_stats()
+
+
+# ------------------------------------------- latency-aware presplit probe
+
+
+def _probe_ctl(eng, **kw):
+    kw.setdefault("dwell_ticks", 1)
+    kw.setdefault("presplit_decay_ticks", 1000)  # decay out of the way
+    kw.setdefault("probe_after_ticks", 2)
+    kw.setdefault("probe_window_ticks", 2)
+    kw.setdefault("probe_min_samples", 4)
+    kw.setdefault("probe_keep_ratio", 0.95)
+    return AdmissionController(eng, **kw)
+
+
+def _probe_run(ctl, eng, baseline_ms, probe_ms):
+    """Drive the probe state machine: history -> depth 1, earn the probe,
+    feed a baseline window at ``baseline_ms`` and a probe window at
+    ``probe_ms``; returns the tick at which the probe set depth 2."""
+    ctl.tick(_sig(class_splits={"h": 1}))       # reactive history: depth 1
+    assert eng.presplit_depth("h") == 1
+    for _ in range(2):                          # quiet: earn the probe
+        ctl.tick(_sig(class_splits={"h": 1}))
+    # baseline window (still at depth 1)
+    for _ in range(2):
+        for _ in range(3):
+            eng.metrics.record_run(int(baseline_ms * 1e6), handler="h")
+        ctl.tick(_sig(class_splits={"h": 1}))
+    assert eng.presplit_depth("h") == 2, "probe should be in flight"
+    # probe window (at depth 2)
+    for _ in range(2):
+        for _ in range(3):
+            eng.metrics.record_run(int(probe_ms * 1e6), handler="h")
+        ctl.tick(_sig(class_splits={"h": 1}))
+
+
+def test_latency_probe_keeps_deeper_depth_when_p99_improves(gov):
+    """ROADMAP item 4 follow-on: after converging to the depth that stops
+    splits, probe ONE deeper and keep it only because p99 improved."""
+    eng = _engine(gov)
+    try:
+        eng.register(QueryHandler(
+            name="h", fn=lambda p, ctx: p, nbytes_of=lambda p: 8,
+            split=lambda p: [p, p], combine=lambda rs: rs[0]))
+        ctl = _probe_ctl(eng)
+        _probe_run(ctl, eng, baseline_ms=100.0, probe_ms=1.0)
+        assert eng.presplit_depth("h") == 2, "improved p99 keeps the depth"
+        reasons = [d["reason"] for d in ctl.ledger
+                   if d["knob"] == "presplit:h"]
+        assert "latency_probe" in reasons
+        assert "probe_keep:p99_improved" in reasons
+        ctl.stop()
+    finally:
+        eng.shutdown()
+
+
+def test_latency_probe_reverts_when_p99_worsens(gov):
+    eng = _engine(gov)
+    try:
+        eng.register(QueryHandler(
+            name="h", fn=lambda p, ctx: p, nbytes_of=lambda p: 8,
+            split=lambda p: [p, p], combine=lambda rs: rs[0]))
+        ctl = _probe_ctl(eng)
+        _probe_run(ctl, eng, baseline_ms=10.0, probe_ms=100.0)
+        assert eng.presplit_depth("h") == 1, "worse p99 reverts the probe"
+        reasons = [d["reason"] for d in ctl.ledger
+                   if d["knob"] == "presplit:h"]
+        assert "probe_revert:p99_worse" in reasons
+        # decided: the same regime is not re-probed
+        for _ in range(8):
+            ctl.tick(_sig(class_splits={"h": 1}))
+        assert eng.presplit_depth("h") == 1
+        ctl.stop()
+    finally:
+        eng.shutdown()
+
+
+def test_latency_probe_stands_down_without_samples(gov):
+    """No measurable traffic in the baseline window = no decision and no
+    knob movement (the probe never escalates on thin evidence) — and the
+    existing decay/escalation behavior is untouched."""
+    eng = _engine(gov)
+    try:
+        eng.register(QueryHandler(
+            name="h", fn=lambda p, ctx: p, nbytes_of=lambda p: 8,
+            split=lambda p: [p, p], combine=lambda rs: rs[0]))
+        ctl = _probe_ctl(eng)
+        ctl.tick(_sig(class_splits={"h": 1}))
+        assert eng.presplit_depth("h") == 1
+        for _ in range(12):  # quiet forever, zero recorded latency
+            ctl.tick(_sig(class_splits={"h": 1}))
+        assert eng.presplit_depth("h") == 1  # never probed deeper
+        assert not any("probe" in d["reason"] for d in ctl.ledger
+                       if d["knob"] == "presplit:h")
+        ctl.stop()
+    finally:
+        eng.shutdown()
+
+
+def test_latency_probe_aborts_when_splits_recur_mid_probe(gov):
+    """Splits during the probe window mean the deeper depth is drawing
+    real pressure: the probe aborts back to the converged depth and
+    reactive escalation owns the knob again."""
+    eng = _engine(gov)
+    try:
+        eng.register(QueryHandler(
+            name="h", fn=lambda p, ctx: p, nbytes_of=lambda p: 8,
+            split=lambda p: [p, p], combine=lambda rs: rs[0]))
+        ctl = _probe_ctl(eng)
+        ctl.tick(_sig(class_splits={"h": 1}))
+        for _ in range(2):
+            ctl.tick(_sig(class_splits={"h": 1}))
+        for _ in range(2):
+            for _ in range(3):
+                eng.metrics.record_run(int(10e6), handler="h")
+            ctl.tick(_sig(class_splits={"h": 1}))
+        assert eng.presplit_depth("h") == 2  # probing
+        ctl.tick(_sig(class_splits={"h": 2}))  # a split lands mid-probe
+        assert eng.presplit_depth("h") == 1  # aborted back
+        assert any(d["reason"] == "probe_split_abort" for d in ctl.ledger)
+        ctl.stop()
+    finally:
+        eng.shutdown()

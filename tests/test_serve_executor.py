@@ -447,3 +447,170 @@ def test_unbatch_wrong_length_fails_terminally(gov):
         assert eng.budget.used == 0
     finally:
         eng.shutdown()
+
+
+# ------------------------------------------------- round 10 satellites
+
+
+def test_retry_after_jitter_is_seeded_and_deterministic(gov):
+    """The backpressure retry-after hint carries seeded jitter: identical
+    seeds replay the identical hint sequence (chaos runs stay
+    replayable), different seeds de-phase — and every hint stays inside
+    the [0.5x, 1.5x) spread of the unjittered backoff."""
+    from spark_rapids_jni_tpu import config
+
+    with config.override(serve_retry_jitter_seed=1234):
+        a = _engine(gov, workers=2)
+        b = _engine(gov, workers=2)
+    with config.override(serve_retry_jitter_seed=99):
+        c = _engine(gov, workers=2)
+    try:
+        seq_a = [a._retry_after(8) for _ in range(32)]
+        seq_b = [b._retry_after(8) for _ in range(32)]
+        seq_c = [c._retry_after(8) for _ in range(32)]
+        assert seq_a == seq_b, "same seed must replay the hint sequence"
+        assert seq_a != seq_c, "different seed must de-phase"
+        assert len(set(seq_a)) > 1, "jitter actually varies"
+        base = a._ewma_service_s * 8 / 2  # depth=8 over 2 workers
+        for v in seq_a:
+            assert 0.5 * base - 1e-9 <= v <= 1.5 * base + 1e-9 or v == 0.005
+    finally:
+        a.shutdown()
+        b.shutdown()
+        c.shutdown()
+
+
+def test_hung_handler_emits_task_hung_and_anomaly_dump(gov):
+    """A handler running far past its class EWMA trips the watchdog: one
+    EV_TASK_HUNG with the task id + a rate-limited anomaly dump, while
+    the worker is still wedged (detection is observability, recovery is
+    the supervisor tier's kill path)."""
+    from spark_rapids_jni_tpu import config
+    from spark_rapids_jni_tpu.obs import flight as _flight
+
+    with config.override(serve_hang_min_s=0.15, serve_hang_factor=1.0):
+        eng = _engine(gov, workers=2)
+    try:
+        # establish a fast EWMA for the class, then wedge one request
+        eng.register(QueryHandler(name="nap",
+                                  fn=lambda p, ctx: time.sleep(p)))
+        s = eng.open_session()
+        eng.submit(s, "nap", 0.0).result(timeout=30)
+        rec = _flight.recorder()
+        dumps_before = rec.dump_count + rec.dumps_suppressed
+        mark = len(_flight.snapshot())
+        r = eng.submit(s, "nap", 0.8)  # >> max(0.15, 1.0 x EWMA)
+        deadline = time.monotonic() + 10
+        hung = []
+        while not hung and time.monotonic() < deadline:
+            hung = [e for e in _flight.snapshot()[mark:]
+                    if e["kind"] == "task_hung"
+                    and "handler:nap" in e["detail"]]
+            time.sleep(0.02)
+        assert hung, "watchdog never flagged the wedged handler"
+        assert hung[0]["task_id"] == r.task_id
+        assert hung[0]["value"] >= 0.15e9  # elapsed_ns rides the event
+        assert eng.metrics.get("hung") >= 1
+        assert rec.dump_count + rec.dumps_suppressed > dumps_before
+        assert len(hung) == 1 or hung[0]["task_id"] != hung[-1]["task_id"], \
+            "one flag per stuck request, not one per sweep"
+        r.result(timeout=30)  # the request itself still completes
+    finally:
+        eng.shutdown()
+
+
+def test_presplit_children_inherit_parent_deadline(gov):
+    """_presplit_dispatch copies req.deadline onto every child: pieces of
+    a deadlined request must not outlive it."""
+    eng = _engine(gov, workers=1)
+    try:
+        eng.register(_sum_handler())
+        eng.set_presplit("sum", 1)
+        captured = []
+        orig = eng._requeue
+
+        def spy(req, **kw):
+            captured.append(req)
+            return orig(req, **kw)
+
+        eng._requeue = spy
+        s = eng.open_session()
+        r = eng.submit(s, "sum", list(range(8)), deadline_s=5.0)
+        assert r.result(timeout=30) == sum(range(8))
+        assert captured, "presplit never queued a child"
+        parent_deadline = captured[0].deadline
+        assert parent_deadline is not None
+        assert all(c.deadline == parent_deadline for c in captured)
+        assert all(c.split_depth == 1 for c in captured)
+    finally:
+        eng.shutdown()
+
+
+def test_split_requeue_children_inherit_parent_deadline(gov):
+    """Reactive SplitAndRetry halves carry the parent's absolute
+    deadline through _split_requeue."""
+    from spark_rapids_jni_tpu.mem.exceptions import SplitAndRetryOOM
+
+    eng = _engine(gov, workers=1)
+    try:
+        calls = []
+
+        def fussy(p, ctx):
+            if len(p) > 4:
+                raise SplitAndRetryOOM("too big")
+            calls.append(len(p))
+            return sum(p)
+
+        eng.register(QueryHandler(
+            name="fussy", fn=fussy, nbytes_of=lambda p: 8 * len(p),
+            split=lambda p: [p[:len(p) // 2], p[len(p) // 2:]],
+            combine=sum))
+        captured = []
+        orig = eng._requeue
+
+        def spy(req, **kw):
+            captured.append(req)
+            return orig(req, **kw)
+
+        eng._requeue = spy
+        s = eng.open_session()
+        r = eng.submit(s, "fussy", list(range(8)), deadline_s=10.0)
+        assert r.result(timeout=30) == sum(range(8))
+        halves = [c for c in captured if c.split_depth == 1]
+        assert len(halves) == 2
+        assert all(h.deadline is not None for h in halves)
+        assert len({h.deadline for h in halves}) == 1  # the parent's
+    finally:
+        eng.shutdown()
+
+
+def test_expired_parent_cancels_undispatched_presplit_children(gov):
+    """Children queued by a presplit share the parent's deadline, so an
+    expired parent's un-dispatched pieces time out in the queue instead
+    of running — and the parent's join still reaches a terminal state."""
+    from spark_rapids_jni_tpu.serve import RequestTimeout
+
+    eng = _engine(gov, workers=1)
+    try:
+        def slow_sum(p, ctx):
+            time.sleep(0.6)  # the inline piece outlives the deadline
+            return sum(p)
+
+        eng.register(QueryHandler(
+            name="slowsum", fn=slow_sum, nbytes_of=lambda p: 8 * len(p),
+            split=lambda p: [p[:len(p) // 2], p[len(p) // 2:]],
+            combine=sum))
+        eng.set_presplit("slowsum", 1)
+        s = eng.open_session()
+        r = eng.submit(s, "slowsum", list(range(8)), deadline_s=0.3)
+        with pytest.raises(RequestTimeout):
+            r.result(timeout=30)
+        # terminal, accounted, nothing leaks
+        assert r.status == "timed_out"
+        deadline = time.monotonic() + 10
+        while eng.queue.outstanding() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert eng.queue.outstanding() == 0
+        assert eng.budget.used == 0
+    finally:
+        eng.shutdown()

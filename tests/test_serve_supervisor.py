@@ -1,0 +1,441 @@
+"""Crash-only serving: supervision, leases, re-dispatch, degradation.
+
+What round 10's acceptance pins (ISSUE 9):
+
+- requests route through REAL executor worker processes (own governors,
+  own failure domains) and come back correct;
+- a SIGKILLed executor's leased requests re-queue to survivors exactly
+  once and still complete (the zero-lost invariant under process death);
+- a hung executor (wedged handler thread) is recycled crash-only — kill,
+  respawn, re-dispatch — instead of holding its lease forever;
+- fan-out splits keep parent lineage through the lease table, so the
+  join completes even across executors;
+- duplicate results from a recycled worker are dropped: every lease
+  completes effectively once;
+- the degradation ladder steps down under stress and back up when it
+  clears, one level per dwell, every transition in the ledger + flight
+  ring; the submit gate sheds what each level says it sheds.
+
+Process tests share one module-scoped 2-executor cluster (spawn costs
+seconds); the pool self-heals after kill tests by design, so order does
+not matter — each test waits for live capacity first.
+"""
+
+import os
+import signal
+import time
+
+import pytest
+
+from spark_rapids_jni_tpu.obs import flight as _flight
+from spark_rapids_jni_tpu.serve import (
+    DEGRADE_LEVELS,
+    Degraded,
+    HandlerSpec,
+    RemoteExecutorError,
+    Supervisor,
+)
+from spark_rapids_jni_tpu.serve.supervisor import (
+    LEVEL_CACHED_ONLY,
+    LEVEL_HEALTHY,
+    LEVEL_REJECT,
+    LEVEL_SHED_LOW,
+    _ExecutorHandle,
+    _Lease,
+)
+from spark_rapids_jni_tpu.serve.queue import OK, Request
+
+
+def _specs(sup):
+    sup.register(HandlerSpec("sum", nbytes_of=lambda p: 64 * len(p),
+                             split=lambda p: [p[:len(p) // 2],
+                                              p[len(p) // 2:]],
+                             combine=sum))
+    sup.register(HandlerSpec("echo_pid"))
+    sup.register(HandlerSpec("sleep_n"))
+    sup.register(HandlerSpec("hang_once"))
+    sup.register(HandlerSpec("boom"))
+    sup.register(HandlerSpec(
+        "sum_fan", nbytes_of=lambda p: 64 * len(p),
+        split=lambda p: [p[:len(p) // 2], p[len(p) // 2:]],
+        combine=sum, fanout=2))
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    sup = Supervisor(workers=2, factory="cluster_worker:register_toy",
+                     worker_cfg={"workers": 2, "queue_size": 32},
+                     queue_size=32, default_deadline_s=30.0,
+                     lease_hang_s=2.0)
+    _specs(sup)
+    yield sup
+    sup.shutdown(drain=False, timeout=10)
+
+
+def _wait_alive(sup, n=1, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        snap = sup.snapshot()["workers"]
+        if sum(1 for w in snap.values() if w["state"] == "alive") >= n:
+            return snap
+        time.sleep(0.05)
+    raise AssertionError(f"cluster never reached {n} alive workers")
+
+
+# ------------------------------------------------------- process tests
+
+
+def test_cross_process_dispatch_and_result(cluster):
+    _wait_alive(cluster, 2)
+    s = cluster.open_session(priority=1)
+    assert cluster.submit(s, "sum", list(range(100))).result(
+        timeout=60) == 4950
+    # the work genuinely ran OUTSIDE this process
+    pid = cluster.submit(s, "echo_pid", None).result(timeout=60)
+    assert pid != os.getpid()
+    assert pid in {w["pid"] for w in cluster.snapshot()["workers"].values()}
+    cluster.close_session(s)
+
+
+def test_remote_handler_error_propagates_with_type_name(cluster):
+    _wait_alive(cluster, 1)
+    s = cluster.open_session(priority=1)
+    r = cluster.submit(s, "boom", "payload7")
+    with pytest.raises(RemoteExecutorError, match="ValueError.*payload7"):
+        r.result(timeout=60)
+    cluster.close_session(s)
+
+
+def test_killed_executor_lease_redispatches_exactly_once(cluster):
+    """SIGKILL the executor holding a lease mid-request: the supervisor
+    sees the pipe drop, re-queues the lease to the survivor, and the
+    client's response completes — once."""
+    _wait_alive(cluster, 2)
+    s = cluster.open_session(priority=1)
+    before = cluster.metrics.get("leases_redispatched")
+    r = cluster.submit(s, "sleep_n", 1.0)
+    # find which executor took the lease, then kill that process
+    victim = None
+    deadline = time.monotonic() + 10
+    while victim is None and time.monotonic() < deadline:
+        snap = cluster.snapshot()["workers"]
+        victim = next((w for w in snap.values() if w["inflight"] > 0), None)
+        time.sleep(0.02)
+    assert victim is not None, "lease never granted"
+    os.kill(victim["pid"], signal.SIGKILL)
+    assert r.result(timeout=60) == 1.0
+    assert cluster.metrics.get("leases_redispatched") >= before + 1
+    rid = r.task_id
+    kinds = [e["kind"] for e in _flight.snapshot()
+             if f"rid:{rid}" in e.get("detail", "")]
+    assert "lease_redispatch" in kinds
+    assert kinds.count("lease_done") == 1  # effectively-once completion
+    # the pool heals: the killed slot respawns
+    _wait_alive(cluster, 2, timeout=90)
+    cluster.close_session(s)
+
+
+def test_hung_executor_is_recycled_and_lease_redispatched(cluster, tmp_path):
+    """A wedged handler thread never returns on its own: the hung-lease
+    bound recycles the WHOLE executor (crash-only) and the re-dispatched
+    attempt on a survivor completes (the marker file latches the hang to
+    the first attempt only)."""
+    _wait_alive(cluster, 2)
+    s = cluster.open_session(priority=1)
+    before_dead = cluster.metrics.get("workers_dead")
+    marker = str(tmp_path / "hang_marker")
+    t0 = time.monotonic()
+    r = cluster.submit(s, "hang_once", marker)
+    assert r.result(timeout=60) == "recovered"
+    # took at least the hang bound (the first attempt wedged), and the
+    # wedged executor was declared dead
+    assert time.monotonic() - t0 >= 1.5
+    assert cluster.metrics.get("workers_dead") >= before_dead + 1
+    assert os.path.exists(marker)
+    _wait_alive(cluster, 2, timeout=90)
+    cluster.close_session(s)
+
+
+def test_fanout_split_joins_across_executors(cluster):
+    """fanout=2 splits one request into per-executor child leases whose
+    results join back into the parent's response."""
+    _wait_alive(cluster, 2)
+    s = cluster.open_session(priority=1)
+    before = cluster.metrics.get("split_requeued")
+    r = cluster.submit(s, "sum_fan", list(range(200)))
+    assert r.result(timeout=60) == sum(range(200))
+    assert cluster.metrics.get("split_requeued") >= before + 2
+    cluster.close_session(s)
+
+
+def test_session_budget_enforced_at_supervisor(cluster):
+    from spark_rapids_jni_tpu.serve import SessionBudgetExceeded
+
+    _wait_alive(cluster, 1)
+    s = cluster.open_session(priority=1, byte_budget=64 * 10)
+    with pytest.raises(SessionBudgetExceeded):
+        cluster.submit(s, "sum", list(range(100)))
+    assert cluster.metrics.get("rejected_session", s.session_id) == 1
+    cluster.close_session(s)
+
+
+# ------------------------------------------------ supervision unit tests
+
+
+@pytest.fixture
+def sup_unit():
+    sup = Supervisor(workers=2, factory=None, start=False)
+    _specs(sup)
+    yield sup
+    sup.shutdown(drain=False, timeout=5)
+
+
+def _mk_lease(sup, rid=101, handler="sum"):
+    req = Request(handler=handler, payload=[1, 2], session_id="u",
+                  priority=0, deadline=None, seq=0, task_id=rid)
+    with sup._lock:
+        lease = sup._leases[rid] = _Lease(rid, req)
+    return lease, req
+
+
+def test_duplicate_result_from_recycled_worker_is_dropped(sup_unit):
+    """Exactly-once: only the incarnation currently holding the lease may
+    complete it; a late answer from the recycled one is counted and
+    dropped."""
+    sup = sup_unit
+    old = _ExecutorHandle(0, 0, proc=None, conn=None)
+    new = _ExecutorHandle(0, 1, proc=None, conn=None)
+    lease, req = _mk_lease(sup)
+    lease.state = "leased"
+    lease.worker_id, lease.incarnation = 0, 1  # re-dispatched to inc 1
+    sup._on_result(old, lease.rid, OK, 99, None)   # stale incarnation
+    assert req.response.status == "pending"
+    assert sup.metrics.get("duplicate_results") == 1
+    sup._on_result(new, lease.rid, OK, 3, None)    # the active one
+    assert req.response.status == OK and req.response.value == 3
+    assert lease.completed
+    sup._on_result(new, lease.rid, OK, 3, None)    # and only once
+    assert sup.metrics.get("duplicate_results") == 2
+    assert sup.metrics.get("leases_completed") == 1
+
+
+def test_worker_dead_is_idempotent_per_incarnation(sup_unit):
+    """Two detectors declaring the same incarnation dead (monitor +
+    receiver race) must re-queue its leases once, not twice."""
+    sup = sup_unit
+
+    class _FakeProc:
+        pid = 0
+
+        def kill(self):
+            pass
+
+    h = _ExecutorHandle(0, 0, proc=_FakeProc(), conn=None)
+
+    class _FakeConn:
+        def close(self):
+            pass
+
+    h.conn = _FakeConn()
+    lease, req = _mk_lease(sup)
+    lease.state = "leased"
+    lease.worker_id, lease.incarnation = 0, 0
+    h.inflight.add(lease.rid)
+    sup._worker_dead(h, "heartbeat_lost")
+    sup._worker_dead(h, "proc_exit")  # the racing second detection
+    assert sup.metrics.get("leases_redispatched") == 1
+    assert sup.metrics.get("workers_dead") == 1
+    assert lease.redispatches == 1
+    assert sup.queue.depth() == 1  # re-queued exactly once
+
+
+# ---------------------------------------------------- degradation ladder
+
+
+def _tick_until(sup, stress, level, max_ticks=64):
+    for _ in range(max_ticks):
+        sup._ladder_tick(stress)
+        if sup.level() == level:
+            return
+    raise AssertionError(
+        f"never reached level {level} (at {sup.level()})")
+
+
+def test_ladder_steps_down_and_recovers_with_ledger_and_events(sup_unit):
+    """Sustained stress walks the ladder down one level per dwell; calm
+    walks it back up — every transition a ledger entry AND an
+    EV_DEGRADE_* flight event with matching direction."""
+    sup = sup_unit
+    mark = len(_flight.snapshot())
+    _tick_until(sup, 1.0, LEVEL_REJECT)
+    assert [e["to"] for e in sup.ledger] == ["shed_low", "cached_only",
+                                             "reject"]
+    _tick_until(sup, 0.0, LEVEL_HEALTHY)
+    names = [e["to"] for e in sup.ledger]
+    assert names == ["shed_low", "cached_only", "reject",
+                     "cached_only", "shed_low", "healthy"]
+    evs = [e for e in _flight.snapshot()[mark:]
+           if e["kind"] in ("degrade_enter", "degrade_exit")]
+    assert [e["kind"] for e in evs] == ["degrade_enter"] * 3 + \
+        ["degrade_exit"] * 3
+    assert [e["value"] for e in evs] == [1, 2, 3, 2, 1, 0]
+    snap = sup.snapshot()["ladder"]
+    assert snap["max_level_seen"] == LEVEL_REJECT
+    assert snap["level_name"] == "healthy"
+
+
+def test_ladder_hysteresis_holds_between_bands(sup_unit):
+    """Stress inside the band (above the exit margin, below the next
+    entry threshold) holds the level — no flapping."""
+    sup = sup_unit
+    _tick_until(sup, 0.4, LEVEL_SHED_LOW)
+    n = len(sup.ledger)
+    for _ in range(32):  # 0.4 < 0.55 entry, > 0.2 - 0.1 exit
+        sup._ladder_tick(0.4)
+    assert sup.level() == LEVEL_SHED_LOW
+    assert len(sup.ledger) == n
+
+
+def test_gate_shed_low_rejects_only_low_priority(sup_unit):
+    sup = sup_unit
+    with sup._lock:
+        sup._level = LEVEL_SHED_LOW
+    lo = sup.open_session("lo", priority=0)
+    hi = sup.open_session("hi", priority=1)
+    with pytest.raises(Degraded) as ei:
+        sup.submit(lo, "sum", [1])
+    assert ei.value.level == LEVEL_SHED_LOW
+    assert ei.value.retry_after_s > 0
+    assert sup.submit(hi, "sum", [1]) is not None  # queued, not shed
+    assert lo.degrade_rejects == 1 and hi.degrade_rejects == 0
+    assert sup.metrics.get("rejected_degraded", "lo") == 1
+
+
+def test_gate_cached_only_admits_warm_and_cacheable(sup_unit):
+    sup = sup_unit
+    sup.register(HandlerSpec("warmed"))
+    sup.register(HandlerSpec("plan_q", cacheable=True))
+    with sup._lock:
+        sup._level = LEVEL_CACHED_ONLY
+        sup._warm.add("warmed")
+    s = sup.open_session("t", priority=5)
+    sup.submit(s, "warmed", [1])       # warm: served once before
+    sup.submit(s, "plan_q", [1])       # declared cacheable
+    with pytest.raises(Degraded):
+        sup.submit(s, "sum", [1])      # cold class sheds
+
+
+def test_gate_reject_rejects_everything_with_retry_after(sup_unit):
+    sup = sup_unit
+    with sup._lock:
+        sup._level = LEVEL_REJECT
+        sup._warm.add("sum")
+    s = sup.open_session("t", priority=99)
+    with pytest.raises(Degraded) as ei:
+        sup.submit(s, "sum", [1])
+    assert ei.value.level == LEVEL_REJECT
+    assert ei.value.retry_after_s > 0
+    assert DEGRADE_LEVELS[LEVEL_REJECT] in str(ei.value)
+
+
+def test_respawning_incarnation_counts_as_missing_capacity(sup_unit):
+    """Stress sampling: a cold-start incarnation-0 spawn is booting, not
+    degraded; a RESPAWNING incarnation is genuinely missing capacity."""
+    sup = sup_unit
+    h0 = _ExecutorHandle(0, 0, proc=None, conn=None)   # cold start
+    h1 = _ExecutorHandle(1, 0, proc=None, conn=None)
+    h1.state = "alive"
+    with sup._lock:
+        sup._handles[0] = h0
+        sup._handles[1] = h1
+    assert sup._sample_stress() == 0.0
+    h0.incarnation = 2  # now it is a respawn in flight
+    assert sup._sample_stress() == pytest.approx(0.5)
+    h0.state = "alive"
+    assert sup._sample_stress() == 0.0
+
+
+def test_redispatched_fanout_request_regrants_itself_not_fanout(sup_unit):
+    """A request that already holds a lease (it was granted whole while
+    only one executor was alive, then that executor died) must re-grant
+    AS ITSELF on re-dispatch: fanning out would complete the response
+    through child leases while the original lease never completes —
+    wait_drained would hang and exactly-once accounting would break."""
+    sup = sup_unit
+
+    class _RecConn:
+        def __init__(self):
+            self.sent = []
+
+        def send(self, msg):
+            self.sent.append(msg)
+            return True
+
+        def close(self):
+            pass
+
+    a = _ExecutorHandle(0, 0, proc=None, conn=_RecConn())
+    b = _ExecutorHandle(1, 0, proc=None, conn=_RecConn())
+    a.state = b.state = "alive"
+    with sup._lock:
+        sup._handles[0] = a
+        sup._handles[1] = b
+
+    # fresh fanout-capable request: fans out into child leases
+    fresh = Request(handler="sum_fan", payload=list(range(8)),
+                    session_id="u", priority=0, deadline=None, seq=1,
+                    task_id=201)
+    sup._route(fresh)
+    assert sup.queue.depth() == 2  # two children queued
+    assert 201 not in sup._leases  # parent holds no lease
+
+    # re-dispatch: same shape, but a lease already exists for it
+    redisp = Request(handler="sum_fan", payload=list(range(8)),
+                     session_id="u", priority=0, deadline=None, seq=2,
+                     task_id=202)
+    with sup._lock:
+        lease = sup._leases[202] = _Lease(202, redisp)
+        lease.redispatches = 1
+    depth_before = sup.queue.depth()
+    sup._route(redisp)
+    assert sup.queue.depth() == depth_before  # no new children
+    assert lease.state == "leased"            # re-granted as itself
+    sent = a.conn.sent + b.conn.sent
+    assert any(m[0] == "dispatch" and m[1] == 202 for m in sent)
+
+
+def test_completed_leases_retire_from_the_table(sup_unit):
+    """The lease table holds LIVE supervision state only: completion
+    folds a lease into the aggregates and drops the entry (payloads and
+    results must not accumulate for the life of the supervisor)."""
+    sup = sup_unit
+    h = _ExecutorHandle(0, 0, proc=None, conn=None)
+    lease, req = _mk_lease(sup, rid=301)
+    with sup._lock:
+        sup._leases_total += 1
+    lease.state = "leased"
+    lease.worker_id, lease.incarnation = 0, 0
+    sup._on_result(h, 301, OK, 3, None)
+    assert req.response.value == 3
+    assert 301 not in sup._leases            # retired, not retained
+    st = sup.lease_stats()
+    assert st["completed"] == 1 and st["outstanding"] == 0
+    # a late duplicate for the retired rid still drops cleanly
+    sup._on_result(h, 301, OK, 3, None)
+    assert sup.metrics.get("duplicate_results") == 1
+
+
+def test_repeatedly_hung_lease_fails_instead_of_destroying_the_pool(sup_unit):
+    """Blast-radius cap: a request that already hung lease_max_dispatches
+    executors fails terminally at the next sweep rather than re-dispatching
+    onto (and eventually wedging) yet another worker."""
+    sup = sup_unit
+    lease, req = _mk_lease(sup, rid=401)
+    lease.state = "leased"
+    lease.worker_id, lease.incarnation = 0, 0
+    lease.dispatches = sup.lease_max_dispatches
+    lease.granted_ns = time.monotonic_ns() - int(60e9)  # long past hung
+    sup._health_sweep()
+    assert req.response.status == "error"
+    assert "hung on" in str(req.response.error)
+    assert 401 not in sup._leases

@@ -12,14 +12,27 @@ Usage::
     python tools/flightdump.py flight_deadlock_broken_1234_1.json
     python tools/flightdump.py dump.json --task 7
     python tools/flightdump.py dump.json --json   # reconstructed, machine-readable
+    python tools/flightdump.py dump_dir/ --cluster   # cross-process merge
+
+``--cluster`` reads EVERY dump in a directory (one per process: the
+supervisor's plus each executor worker's, round 10) and merges them into
+one cross-process timeline keyed on the supervisor's request id — lease
+events carry ``rid:<id>`` in their detail on both sides of the pipe, and
+each dump's paired (wall_time_s, t_ns) stamps align per-process monotonic
+clocks onto one wall clock.
 """
 
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
+import re
 import sys
 from typing import Dict, List
+
+_RID_RE = re.compile(r"(?:^|:)rid:(\d+)")
 
 # event kinds that terminate a blocked window for completeness checking
 _CLOSERS = ("woken", "task_killed", "deadlock_verdict")
@@ -124,14 +137,99 @@ def format_dump(dump: dict, task: int | None = None) -> str:
     return "\n".join(out)
 
 
+def merge_cluster(dump_dir: str) -> dict:
+    """Merge every ``flight_*.json`` dump under ``dump_dir`` into one
+    cross-process view.
+
+    Events gain ``pid`` and an aligned ``wall_s`` (the owning dump's
+    wall/monotonic stamp pair re-bases each process's monotonic event
+    times); duplicates from overlapping ring snapshots of one process
+    dedupe on (pid, t_ns, kind, task, detail).  ``rids`` groups the
+    merged stream by supervisor request id — the supervisor's
+    grant/re-dispatch/done events and each executor's local grant/done
+    events for the same request land in ONE ordered chain.
+    """
+    paths = sorted(glob.glob(os.path.join(dump_dir, "flight_*.json")))
+    events: List[dict] = []
+    seen = set()
+    pids = set()
+    for path in paths:
+        try:
+            with open(path) as f:
+                dump = json.load(f)
+        except (OSError, ValueError):
+            continue  # a dump truncated by a mid-write kill is expected
+        pid = dump.get("pid")
+        if pid is None:  # pre-round-10 dump: fall back to the filename
+            m = re.search(r"_(\d+)_\d+\.json$", os.path.basename(path))
+            pid = int(m.group(1)) if m else -1
+        pids.add(pid)
+        wall0 = float(dump.get("wall_time_s", 0.0))
+        t0 = int(dump.get("t_ns", 0))
+        for e in dump.get("events", []):
+            key = (pid, e.get("t_ns"), e.get("kind"), e.get("task_id"),
+                   e.get("detail"))
+            if key in seen:
+                continue
+            seen.add(key)
+            ev = dict(e)
+            ev["pid"] = pid
+            ev["wall_s"] = wall0 - (t0 - int(e.get("t_ns", 0))) / 1e9
+            events.append(ev)
+    events.sort(key=lambda e: e["wall_s"])
+    rids: Dict[str, List[dict]] = {}
+    for e in events:
+        m = _RID_RE.search(str(e.get("detail", "")))
+        if m:
+            rids.setdefault(m.group(1), []).append(e)
+    return {"dumps": len(paths), "pids": sorted(pids), "events": events,
+            "rids": rids}
+
+
+def format_cluster(merged: dict, rid: str | None = None) -> str:
+    """Human-readable cross-process timeline: ladder + worker lifecycle
+    first (the incident spine), then one chain per request id."""
+    events = merged["events"]
+    out = [f"cluster merge: dumps={merged['dumps']} "
+           f"pids={merged['pids']} events={len(events)} "
+           f"rids={len(merged['rids'])}"]
+    t0 = events[0]["wall_s"] if events else 0.0
+    spine = [e for e in events
+             if e["kind"] in ("degrade_enter", "degrade_exit",
+                              "worker_spawn", "worker_dead", "anomaly")]
+    if spine and rid is None:
+        out.append("\nsupervision spine:")
+        for e in spine:
+            out.append(f"  +{e['wall_s'] - t0:9.3f} s  pid {e['pid']:<8}"
+                       f"{e['kind']:<16}{e.get('detail', '')}")
+    for r in sorted(merged["rids"], key=int):
+        if rid is not None and r != rid:
+            continue
+        chain = merged["rids"][r]
+        procs = sorted({e["pid"] for e in chain})
+        out.append(f"\nrid {r}  (processes: {procs})")
+        for e in chain:
+            out.append(f"  +{e['wall_s'] - t0:9.3f} s  pid {e['pid']:<8}"
+                       f"{e['kind']:<18}{e.get('detail', '')}")
+    return "\n".join(out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Reconstruct per-task timelines from a flight-recorder "
                     "anomaly dump")
     ap.add_argument("dump", help="JSON artifact written on anomaly "
-                                 "(flight_dump_dir config flag)")
+                                 "(flight_dump_dir config flag), or a "
+                                 "directory of them with --cluster")
     ap.add_argument("--task", type=int, default=None,
                     help="show only this task's timeline")
+    ap.add_argument("--cluster", action="store_true",
+                    help="treat the positional as a DIRECTORY of "
+                         "per-process dumps and merge them into one "
+                         "cross-process timeline keyed on request id")
+    ap.add_argument("--rid", default=None,
+                    help="with --cluster: show only this request id's "
+                         "cross-process chain")
     ap.add_argument("--control", action="store_true",
                     help="show only the admission-control decision ledger "
                          "(control_* events: knob adjustments with "
@@ -139,6 +237,18 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit the reconstructed per-task timelines as JSON")
     args = ap.parse_args(argv)
+
+    if args.cluster:
+        merged = merge_cluster(args.dump)
+        if args.json:
+            json.dump({"dumps": merged["dumps"], "pids": merged["pids"],
+                       "events": merged["events"],
+                       "rids": merged["rids"]},
+                      sys.stdout, indent=1, sort_keys=True)
+            sys.stdout.write("\n")
+        else:
+            print(format_cluster(merged, rid=args.rid))
+        return 0
 
     with open(args.dump) as f:
         dump = json.load(f)

@@ -34,6 +34,264 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def cluster_worker_factory(engine, bytes_per_row: int = 1024,
+                           service_ms: float = 2.0) -> None:
+    """Executor-side handler registration for ``--cluster`` mode —
+    resolved by name inside each spawned worker process (serve/rpc.py)."""
+    import numpy as np
+
+    from spark_rapids_jni_tpu.serve import QueryHandler
+
+    def storm_fn(p, ctx):
+        time.sleep(service_ms / 1e3)  # a stable service-time floor
+        return int(np.sum(p))
+
+    engine.register(QueryHandler(
+        name="storm", fn=storm_fn,
+        nbytes_of=lambda p: bytes_per_row * len(p),
+        split=lambda p: [p[:len(p) // 2], p[len(p) // 2:]],
+        combine=lambda rs: int(sum(rs))))
+
+
+def _cluster_round(args, *, chaos: bool, dump_dir: str = "") -> dict:
+    """One supervised-cluster run: N executor processes under the
+    router/supervisor, closed-loop clients, optional seeded executor
+    chaos (in-worker proc_kill + slow faults).  Returns client outcomes,
+    latency percentiles, and the supervisor's lease/ladder evidence."""
+    import numpy as np
+
+    from spark_rapids_jni_tpu.obs import flight as _flight
+    from spark_rapids_jni_tpu.obs.faultinj import chaos_kill_config
+    from spark_rapids_jni_tpu.serve import (
+        Backpressure,
+        HandlerSpec,
+        RequestTimeout,
+        Supervisor,
+    )
+
+    from spark_rapids_jni_tpu import config
+
+    if dump_dir:
+        config.set("flight_dump_dir", dump_dir)
+        # fresh incident window: this round's dump must not interleave a
+        # previous round's rids (task ids restart per supervisor)
+        _flight.recorder().reset_for_tests()
+
+    def chaos_fn(wid: int, inc: int):
+        if not chaos:
+            return None
+        # incarnation 0 executors are armed to die (at most once each, at
+        # a seeded crossing); respawned incarnations only get the slow
+        # weather — the kill count is bounded by the original pool size
+        return chaos_kill_config(
+            seed=args.seed * 1000 + wid * 17 + inc,
+            kill=(inc == 0), kill_pct=args.kill_pct)
+
+    worker_flags = {}
+    if dump_dir:
+        worker_flags["flight_dump_dir"] = dump_dir
+    sup = Supervisor(
+        workers=args.cluster,
+        factory="serve_bench:cluster_worker_factory",
+        factory_kwargs={"bytes_per_row": args.storm_bytes_per_row,
+                        "service_ms": args.cluster_service_ms},
+        worker_cfg={"workers": args.workers,
+                    "queue_size": max(32, args.queue_size)},
+        worker_flags=worker_flags,
+        chaos=chaos_fn,
+        queue_size=args.queue_size,
+        default_deadline_s=args.deadline_s,
+        lease_hang_s=args.lease_hang_s,
+        dump_on_exit=bool(dump_dir))
+    sup.register(HandlerSpec(
+        "storm",
+        nbytes_of=lambda p: args.storm_bytes_per_row * len(p),
+        split=lambda p: [p[:len(p) // 2], p[len(p) // 2:]],
+        combine=lambda rs: int(sum(rs))))
+
+    per_client = max(1, args.requests // args.clients)
+    total = per_client * args.clients
+    lock = threading.Lock()
+    tally = {"succeeded": 0, "rejected": 0, "timed_out": 0, "errors": 0,
+             "client_retries": 0, "degraded_retries": 0, "wrong_answers": 0}
+    latencies = []
+
+    def client(ci: int) -> None:
+        from spark_rapids_jni_tpu.serve import Degraded
+
+        rng = np.random.RandomState(args.seed * 1000 + ci)
+        sess = sup.open_session(
+            f"cluster{ci}", priority=1 if ci % 3 == 0 else 0)
+        for ri in range(per_client):
+            payload = rng.randint(0, 1000, args.storm_rows).astype(np.int64)
+            want = int(payload.sum())
+            t0 = time.perf_counter()
+            outcome = "rejected"
+            for _ in range(args.max_retries):
+                try:
+                    resp = sup.submit(sess, "storm", payload)
+                except Degraded as bp:
+                    with lock:
+                        tally["degraded_retries"] += 1
+                    time.sleep(min(bp.retry_after_s, 0.1))
+                    continue
+                except Backpressure as bp:
+                    with lock:
+                        tally["client_retries"] += 1
+                    time.sleep(min(bp.retry_after_s, 0.05))
+                    continue
+                try:
+                    out = resp.result(timeout=args.deadline_s + 30)
+                except RequestTimeout:
+                    outcome = "timed_out"
+                except Exception:  # noqa: BLE001 - counted, not raised
+                    outcome = "errors"
+                else:
+                    outcome = "succeeded"
+                    if out != want:
+                        with lock:
+                            tally["wrong_answers"] += 1
+                break
+            dt = time.perf_counter() - t0
+            with lock:
+                tally[outcome] += 1
+                if outcome == "succeeded" and ri >= args.storm_warmup:
+                    latencies.append(dt)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(ci,))
+               for ci in range(args.clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    sup.wait_drained(timeout=60)
+    # give the ladder time to walk back to healthy (the recovery half of
+    # the acceptance: transitions down AND back up)
+    recover_deadline = time.perf_counter() + 20
+    while (sup.level() != 0 and time.perf_counter() < recover_deadline):
+        time.sleep(0.1)
+    wall = time.perf_counter() - t0
+    snap = sup.snapshot()
+    if dump_dir:
+        _flight.anomaly("cluster_epilogue", detail="supervisor")
+    sup.shutdown()
+    accounted = (tally["succeeded"] + tally["rejected"] + tally["timed_out"]
+                 + tally["errors"])
+    lat_ms = sorted(1e3 * x for x in latencies)
+    pct = (lambda p: round(
+        lat_ms[min(len(lat_ms) - 1, int(len(lat_ms) * p / 100))], 3)
+        if lat_ms else 0.0)
+    counters = snap["counters"]
+    return {
+        "chaos": chaos,
+        "requests": total,
+        "wall_s": round(wall, 3),
+        "outcomes": tally,
+        "lost": total - accounted,
+        "zero_lost": (accounted == total and tally["errors"] == 0
+                      and tally["wrong_answers"] == 0),
+        "p50_ms": pct(50),
+        "p99_ms": pct(99),
+        "workers_dead": counters.get("workers_dead", 0),
+        "respawns": counters.get("workers_spawned", 0) - args.cluster,
+        "leases": snap["leases"],
+        "duplicate_results": counters.get("duplicate_results", 0),
+        "ladder": snap["ladder"],
+        "final_level": snap["ladder"]["level"],
+        "counters": counters,
+    }
+
+
+def _run_cluster(args) -> int:
+    """``--cluster N [--chaos-kill]``: the crash-only serving acceptance.
+
+    A calm round establishes the latency baseline, then (with
+    ``--chaos-kill``) an identically-configured round runs while seeded
+    in-worker faults SIGKILL executors mid-request.  Gates: zero lost
+    requests, every lease completed exactly once, >= 2 executor kills
+    with respawns, the degradation ladder stepping down AND back to
+    healthy, p99 inflation bounded, and the per-process flight dumps
+    merging into one cross-process timeline (flightdump --cluster)."""
+    import tempfile
+
+    calm = _cluster_round(args, chaos=False)
+    rec = {
+        "name": "BENCH_serve",
+        "mode": "cluster_chaos" if args.chaos_kill else "cluster",
+        "seed": args.seed,
+        "cluster": args.cluster,
+        "clients": args.clients,
+        "workers_per_executor": args.workers,
+        "queue_size": args.queue_size,
+        "calm": calm,
+    }
+    if not args.chaos_kill:
+        rec["zero_lost"] = calm["zero_lost"]
+        print(json.dumps(rec))
+        return 0 if calm["zero_lost"] else 1
+
+    dump_dir = args.dump_dir or tempfile.mkdtemp(prefix="srt_cluster_")
+    chaos = _cluster_round(args, chaos=True, dump_dir=dump_dir)
+    merged = _verify_cluster_dumps(dump_dir)
+    p99_bound = max(float(args.chaos_p99_bound_ms),
+                    args.p99_inflation_factor * max(calm["p99_ms"], 1.0))
+    gates = {
+        "zero_lost": calm["zero_lost"] and chaos["zero_lost"],
+        "kills_with_respawns": (chaos["workers_dead"] >= 2
+                                and chaos["respawns"] >= 2),
+        "leases_exactly_once": (
+            chaos["leases"]["outstanding"] == 0
+            and chaos["leases"]["completed"] == chaos["leases"]["leases"]),
+        "ladder_down_and_up": (
+            chaos["ladder"]["max_level_seen"] >= 1
+            and chaos["final_level"] == 0),
+        "p99_bounded": chaos["p99_ms"] <= p99_bound,
+        "dumps_reconstruct": (merged["degrade_enter"] >= 1
+                              and merged["degrade_exit"] >= 1
+                              and merged["rids_done"] >= 1),
+    }
+    rec.update({
+        "chaos": chaos,
+        "p99_bound_ms": round(p99_bound, 3),
+        "p99_inflation": round(
+            chaos["p99_ms"] / max(calm["p99_ms"], 1e-3), 2),
+        "dump_dir": dump_dir,
+        "cluster_dumps": merged,
+        "gates": gates,
+        "zero_lost": gates["zero_lost"],
+    })
+    print(json.dumps(rec))
+    return 0 if all(gates.values()) else 1
+
+
+def _verify_cluster_dumps(dump_dir: str) -> dict:
+    """Merge the per-process flight dumps and summarize what the
+    --cluster reconstruction can prove about the run."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import flightdump
+
+    merged = flightdump.merge_cluster(dump_dir)
+    kinds = {}
+    for e in merged["events"]:
+        kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+    rids_done = sum(1 for r in merged["rids"].values()
+                    if any(e["kind"] == "lease_done"
+                           and e["detail"].endswith(":ok")
+                           for e in r))
+    return {
+        "dumps": merged["dumps"],
+        "pids": len(merged["pids"]),
+        "events": len(merged["events"]),
+        "rids": len(merged["rids"]),
+        "rids_done": rids_done,
+        "degrade_enter": kinds.get("degrade_enter", 0),
+        "degrade_exit": kinds.get("degrade_exit", 0),
+        "worker_dead": kinds.get("worker_dead", 0),
+        "redispatches": kinds.get("lease_redispatch", 0),
+    }
+
+
 def _chaos_tier(args, adaptive: bool) -> dict:
     """One pressure-storm run (fresh governor/engine/injector): a
     deliberately undersized device budget makes EVERY full-size request
@@ -270,8 +528,39 @@ def main(argv=None) -> int:
                     help="paired (static, adaptive) rounds; the verdict "
                          "compares MEDIAN p99 across rounds (seed+i per "
                          "round, identical schedule within a pair)")
+    ap.add_argument("--cluster", type=int, default=0,
+                    help="run the supervised multi-process tier: N "
+                         "executor worker processes under the "
+                         "router/supervisor (serve/supervisor.py), each "
+                         "with its own governor")
+    ap.add_argument("--chaos-kill", action="store_true",
+                    help="with --cluster: arm seeded in-worker faults "
+                         "(proc_kill SIGKILLs executors mid-request, slow "
+                         "stalls) and gate on zero lost requests, "
+                         "exactly-once lease completion, >= 2 kills with "
+                         "respawns, the degradation ladder stepping down "
+                         "AND recovering, bounded p99 inflation, and "
+                         "cross-process dump reconstruction")
+    ap.add_argument("--kill-pct", type=float, default=12.0,
+                    help="per-crossing probability of the armed "
+                         "executors' one-shot proc_kill fault")
+    ap.add_argument("--cluster-service-ms", type=float, default=2.0,
+                    help="service-time floor of the cluster storm handler")
+    ap.add_argument("--lease-hang-s", type=float, default=5.0,
+                    help="supervisor hung-lease bound (must exceed the "
+                         "worst-case legitimate service time)")
+    ap.add_argument("--chaos-p99-bound-ms", type=float, default=8000.0,
+                    help="absolute ceiling on chaos-round p99 (the "
+                         "'bounded inflation' gate also allows "
+                         "--p99-inflation-factor x the calm round's p99)")
+    ap.add_argument("--p99-inflation-factor", type=float, default=50.0)
+    ap.add_argument("--dump-dir", default="",
+                    help="flight-dump directory for the cluster tier "
+                         "(default: a fresh temp dir)")
     args = ap.parse_args(argv)
 
+    if args.cluster > 0:
+        return _run_cluster(args)
     if args.chaos_storm:
         return _run_chaos_storm(args)
 
