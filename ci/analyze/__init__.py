@@ -1,0 +1,70 @@
+"""Protocol-aware static analysis for the memory-governance contracts.
+
+The governor's hardest bugs are runtime-invisible until they wedge: a lock
+cycle the watchdog only breaks after the hang, a broad ``except`` that eats
+a RetryOOM, a kernel that allocates device memory without reserving budget,
+a wire message one side of the pipe stopped sending.  This gate rejects
+those *before* merge — the compile-time complement of the arbiter's
+runtime deadlock detector (native/task_arbiter.cpp), in the spirit of
+Flare's compile-time checking of Spark-native runtime contracts.
+
+Nine passes (see docs/STATIC_ANALYSIS.md for the invariants):
+
+- ``lock-order``           cycles in the static lock-acquisition graph
+- ``unguarded-shared-state`` unlocked attribute writes in lock-owning classes
+- ``retry-protocol``       broad excepts that can swallow retry signals
+- ``governed-allocation``  raw device allocation outside a governor bracket
+- ``seam-discipline``      obs seam crossings not paired / unregistered
+- ``flight-discipline``    flight-recorder events not using registered
+  EV_* kind constants (obs/flight.py)
+- ``guarded-by``           ``# guarded-by: <lock>`` attributes accessed
+  outside their declared lock
+- ``wire-protocol``        RPC tuple messages vs. the declared
+  MESSAGE_FIELDS schema; flight wire ids frozen append-only
+  (ci/flight_wire_ids.json)
+- ``state-machine``        transition sites vs. declared transition
+  tables; paired flight events balanced
+
+Workflow:
+
+- ``python ci/analyze``                    gate: exit 1 on un-baselined findings
+- ``python ci/analyze --json``             machine-readable findings
+- ``python ci/analyze --format github``    workflow-annotation lines
+- ``python ci/analyze --changed-only REF`` only report findings in files
+  changed since the git ref (full-project analysis still runs — the lock
+  graph is whole-program — but the report is filtered, and the
+  content-hash cache makes the unchanged-tree case sub-second)
+- ``python ci/analyze --update-baseline``  grandfather current findings
+- ``python ci/analyze --update-wire-ids``  append new flight event kinds
+  to the frozen wire-id registry (append-only; refuses mutations)
+- ``# analyze: ignore[rule-id]``           per-line suppression (on the
+  statement's first line); ``# analyze: ignore`` suppresses every rule;
+  ``# analyze: ignore-file[rule-id]`` anywhere in a file suppresses the
+  rule for the whole file.
+
+Suppressions are for findings that are *by design* (with a comment saying
+why); the baseline (ci/analyze_baseline.json) is for grandfathered debt
+that new code must not add to.
+
+This package is importable as ``analyze`` with ``ci/`` on sys.path (how
+tests/test_analyze.py and ci/lint.py consume it); the public surface
+below is the original single-module API, preserved.
+"""
+
+from .cache import AnalysisCache  # noqa: F401
+from .core import Baseline, Finding, emit_github, emit_json  # noqa: F401
+from .project import (  # noqa: F401
+    ClassInfo,
+    Config,
+    ModuleInfo,
+    Project,
+    module_constants,
+)
+from .registry import RULES, rule, run_rules  # noqa: F401
+from .cli import analyze, discover_files, main  # noqa: F401
+
+__all__ = [
+    "AnalysisCache", "Baseline", "Finding", "emit_github", "emit_json",
+    "ClassInfo", "Config", "ModuleInfo", "Project", "module_constants",
+    "RULES", "rule", "run_rules", "analyze", "discover_files", "main",
+]

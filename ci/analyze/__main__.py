@@ -1,0 +1,15 @@
+"""`python ci/analyze` entry: bootstrap the package onto sys.path.
+
+Running a directory puts the directory ITSELF on sys.path[0]; the parent
+(``ci/``) must be there for the ``analyze`` package imports to resolve.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from analyze.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

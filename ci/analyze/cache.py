@@ -1,0 +1,165 @@
+"""Content-hash analysis cache: per-file ASTs + whole-run findings.
+
+Two layers, both keyed on SHA-256 of file *content* (never mtimes):
+
+- **AST cache** — parsing is the hottest part of building a
+  :class:`~analyze.project.Project`; a parsed ``ast`` tree pickles
+  cleanly, so each file's tree is reused until its bytes change.
+- **Findings cache** — the passes are whole-program (the lock graph, the
+  governed-allocation fixed point), so per-file findings cannot be reused
+  incrementally.  But when NOTHING in the analysis input set changed —
+  package sources, the wire-protocol extra files, the flight wire-id
+  registry, and the analyzer's own sources — the previous run's findings
+  are returned without building the project at all.  That is what keeps
+  a ``--changed-only`` pre-commit run sub-second: the common case is an
+  edit-test loop where the tree at commit time matches the last gate run.
+
+The cache file lives at ``ci/.analyze_cache.pkl`` (gitignored).  A cache
+that fails to load for any reason is treated as cold — correctness never
+depends on it, only speed.  ``--no-cache`` bypasses it entirely.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+import pickle
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from .core import Finding
+
+__all__ = ["AnalysisCache"]
+
+_CACHE_VERSION = 1
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _analyzer_fingerprint() -> str:
+    """Hash of the analyzer's own sources + interpreter version: an edit
+    to any pass or to the project model invalidates everything."""
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    h.update(f"{_CACHE_VERSION}:{sys.version_info[:2]}".encode())
+    for dirpath, dirnames, filenames in os.walk(pkg_dir):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fname in sorted(filenames):
+            if fname.endswith(".py"):
+                with open(os.path.join(dirpath, fname), "rb") as f:
+                    h.update(fname.encode())
+                    h.update(f.read())
+    return h.hexdigest()
+
+
+class AnalysisCache:
+    """One persisted dict: ``{"fingerprint", "asts", "findings"}``.
+
+    ``asts``: relpath -> (content_sha, pickled-tree-ready object)
+    ``findings``: run_key -> [Finding dicts]
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self.fingerprint = _analyzer_fingerprint()
+        self.ast_hits = 0
+        self.ast_misses = 0
+        self.findings_reused = False
+        self._dirty = False
+        self._asts: Dict[str, Tuple[str, object]] = {}
+        self._findings: Dict[str, list] = {}
+        try:
+            with open(path, "rb") as f:
+                data = pickle.load(f)
+            if (isinstance(data, dict)
+                    and data.get("fingerprint") == self.fingerprint):
+                self._asts = data.get("asts", {})
+                self._findings = data.get("findings", {})
+        # a corrupt/stale/foreign cache is a cold cache, never an error
+        except Exception:  # noqa: BLE001  # analyze: ignore[retry-protocol]
+            pass
+
+    # -- per-file AST layer -------------------------------------------------
+    def load(self, path: str, relpath: str) -> Tuple[str, ast.AST]:
+        """(source, tree) for ``path``, reusing the cached parse when the
+        content hash matches.  Raises SyntaxError like ast.parse."""
+        with open(path, "rb") as f:
+            raw = f.read()
+        src = raw.decode("utf-8")
+        sha = _sha256(raw)
+        hit = self._asts.get(relpath)
+        if hit is not None and hit[0] == sha:
+            self.ast_hits += 1
+            return src, hit[1]
+        tree = ast.parse(src, filename=path)
+        self.ast_misses += 1
+        self._asts[relpath] = (sha, tree)
+        self._dirty = True
+        return src, tree
+
+    # -- whole-run findings layer ------------------------------------------
+    def hash_tree(self, root: str, rules_key: str, package_files: List[str],
+                  extra_paths: List[str]) -> Optional[str]:
+        """Run key WITHOUT parsing: hash all inputs by content directly.
+        Returns None when any file is unreadable (fall back to a build)."""
+        h = hashlib.sha256()
+        h.update(rules_key.encode())
+        shas = {}
+        try:
+            for rel in sorted(package_files):
+                with open(os.path.join(root, rel), "rb") as f:
+                    shas[rel] = _sha256(f.read())
+        except OSError:
+            return None
+        for rel in sorted(shas):
+            h.update(f"{rel}:{shas[rel]}".encode())
+        for rel in sorted(extra_paths):
+            p = os.path.join(root, rel)
+            h.update(rel.encode())
+            if os.path.exists(p):
+                with open(p, "rb") as f:
+                    h.update(_sha256(f.read()).encode())
+            else:
+                h.update(b"<missing>")
+        return h.hexdigest()
+
+    def get_findings(self, run_key: str) -> Optional[List[Finding]]:
+        hit = self._findings.get(run_key)
+        if hit is None:
+            return None
+        self.findings_reused = True
+        return [Finding(**d) for d in hit]
+
+    def put_findings(self, run_key: str, findings: List[Finding]) -> None:
+        # one run key kept: the cache answers "did anything change since
+        # the last gate run", not a history query
+        self._findings = {run_key: [f.to_json() for f in findings]}
+        self._dirty = True
+
+    # -- persistence --------------------------------------------------------
+    def save(self) -> None:
+        if not self._dirty:
+            return
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump({"fingerprint": self.fingerprint,
+                             "asts": self._asts,
+                             "findings": self._findings}, f,
+                            protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, self.path)
+        # symmetric with the load path: an unwritable dir OR an
+        # unpicklable payload (RecursionError on a pathologically deep
+        # AST, PicklingError) must never fail a clean gate run
+        except Exception:  # noqa: BLE001  # analyze: ignore[retry-protocol]
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def stats(self) -> dict:
+        return {"ast_hits": self.ast_hits, "ast_misses": self.ast_misses,
+                "findings_reused": self.findings_reused}

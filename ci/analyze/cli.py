@@ -1,0 +1,160 @@
+"""CLI driver: the merge gate, baselines, caching, output formats."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import time
+from collections import defaultdict
+from typing import List, Optional, Set
+
+from .cache import AnalysisCache
+from .core import Baseline, emit_github, emit_json
+from .project import Config, Project, package_files
+from .registry import RULES, run_rules
+from . import passes
+
+assert passes  # imported for effect: registers every rule
+
+__all__ = ["analyze", "main"]
+
+
+def analyze(root: str, config: Optional[Config] = None):
+    """Library entry point (tests): build + run every configured rule."""
+    config = config or Config()
+    return run_rules(Project(root, config), config)
+
+
+def discover_files(root: str) -> List[str]:
+    """Repo-root-relative paths of every package .py the Project would
+    load — the findings-cache key input, computed without parsing and
+    guaranteed to match the analysis input set (same walker)."""
+    return [relpath for _pkg, _modid, _path, relpath
+            in package_files(root)]
+
+
+def _changed_files(root: str, ref: str) -> Set[str]:
+    out = subprocess.run(
+        ["git", "diff", "--name-only", ref, "--"], cwd=root,
+        capture_output=True, text=True, check=True).stdout
+    untracked = subprocess.run(
+        ["git", "ls-files", "-o", "--exclude-standard"], cwd=root,
+        capture_output=True, text=True, check=True).stdout
+    return {line.strip() for line in (out + untracked).splitlines()
+            if line.strip()}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Protocol-aware static analysis for the "
+                    "memory-governance contracts.")
+    ap.add_argument("--root", default=None, help="repo root (default: "
+                    "parent of this script's directory)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--format", choices=("text", "json", "github"),
+                    default=None,
+                    help="report format (--json is shorthand for json)")
+    ap.add_argument("--changed-only", metavar="REF",
+                    help="report only findings in files changed vs git REF")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline path (default ci/analyze_baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write current findings as the new baseline")
+    ap.add_argument("--update-wire-ids", action="store_true",
+                    help="append newly registered flight event kinds to "
+                    "ci/flight_wire_ids.json (refuses to change an "
+                    "existing id: the registry is append-only)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="bypass the content-hash AST/findings cache")
+    ap.add_argument("--cache-file", default=None,
+                    help="cache path (default ci/.analyze_cache.pkl)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, (_fn, doc) in sorted(RULES.items()):
+            print(f"{rid}: {doc}")
+        return 0
+
+    fmt = args.format or ("json" if args.as_json else "text")
+    root = args.root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    baseline_path = args.baseline or os.path.join(
+        root, "ci", "analyze_baseline.json")
+    config = Config()
+    if args.rules:
+        config.rules = set(args.rules.split(","))
+
+    if args.update_wire_ids:
+        from .passes.wire import update_wire_ids
+
+        return update_wire_ids(root, config)
+
+    t0 = time.monotonic()
+    cache = None
+    findings = None
+    n_files = 0
+    run_key = None
+    if not args.no_cache:
+        cache_path = args.cache_file or os.path.join(
+            root, "ci", ".analyze_cache.pkl")
+        cache = AnalysisCache(cache_path)
+        rules_key = ",".join(sorted(config.rules)) if config.rules else "all"
+        pkg_files = discover_files(root)
+        extra = list(config.wire_extra_files) + [config.flight_wire_ids_path]
+        run_key = cache.hash_tree(root, rules_key, pkg_files, extra)
+        if run_key is not None:
+            hit = cache.get_findings(run_key)
+            if hit is not None:
+                findings = hit
+                n_files = len(pkg_files)
+    if findings is None:
+        project = Project(root, config, ast_cache=cache)
+        findings = run_rules(project, config)
+        n_files = len(project.modules)
+        if cache is not None and run_key is not None:
+            cache.put_findings(run_key, findings)
+    if cache is not None:
+        cache.save()
+
+    if args.update_baseline:
+        Baseline.write(baseline_path, findings)
+        print(f"analyze: baseline updated with {len(findings)} findings "
+              f"-> {os.path.relpath(baseline_path, root)}")
+        return 0
+
+    if args.no_baseline:
+        new, n_base, n_stale = findings, 0, 0
+    else:
+        new, n_base, n_stale = Baseline(baseline_path).split(findings)
+
+    if args.changed_only:
+        changed = _changed_files(root, args.changed_only)
+        new = [f for f in new if f.path in changed]
+
+    dt = time.monotonic() - t0
+    if fmt == "json":
+        extra = {"baselined": n_base, "stale_baseline": n_stale,
+                 "seconds": round(dt, 2)}
+        if cache is not None:
+            extra["cache"] = cache.stats()
+        emit_json(new, tool="analyze", files=n_files, extra=extra)
+    elif fmt == "github":
+        emit_github(new, tool="analyze")
+    else:
+        for f in new:
+            print(f.human())
+        per_rule = defaultdict(int)
+        for f in new:
+            per_rule[f.rule] += 1
+        detail = ", ".join(f"{r}={n}" for r, n in sorted(per_rule.items()))
+        print(f"analyze: {n_files} files, {len(new)} findings"
+              + (f" ({detail})" if detail else "")
+              + f", {n_base} baselined, {n_stale} stale baseline entries, "
+              f"{dt:.1f}s")
+    return 1 if new else 0

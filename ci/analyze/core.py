@@ -1,0 +1,186 @@
+"""Findings, suppressions, baseline, and report emitters.
+
+The pieces every pass and every front end (``ci/analyze`` CLI,
+``ci/lint.py``) share: the line-stable :class:`Finding` record, the
+``# analyze: ignore[...]`` suppression grammar, the committed-baseline
+grandfather list, and the ``--json`` / ``--format github`` emitters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+from collections import defaultdict
+from typing import Dict, List, Optional, Set, Tuple
+
+import re
+
+__all__ = [
+    "Finding", "Baseline", "emit_json", "emit_github",
+    "_parse_suppressions", "carrying_matches",
+]
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation.  ``message`` is line-stable (no line numbers in
+    it) so the baseline survives unrelated edits above the finding."""
+
+    rule: str
+    path: str  # repo-root-relative posix path
+    line: int
+    message: str
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.message)
+
+    def human(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+
+def emit_json(findings: List[Finding], *, tool: str, files: int,
+              extra: Optional[dict] = None) -> None:
+    """The shared JSON report shape (ci/lint.py --json uses it too)."""
+    payload = {
+        "tool": tool,
+        "files": files,
+        "findings": [f.to_json() for f in findings],
+    }
+    if extra:
+        payload.update(extra)
+    json.dump(payload, sys.stdout, indent=1, sort_keys=True)
+    sys.stdout.write("\n")
+
+
+def emit_github(findings: List[Finding], *, tool: str) -> None:
+    """GitHub Actions workflow-annotation lines (``--format github``):
+    one ``::error`` command per finding, so a workflow step running the
+    gate annotates the PR diff inline.  Newlines/``::`` in messages are
+    escaped per the workflow-command grammar."""
+    for f in findings:
+        msg = (f.message.replace("%", "%25").replace("\r", "%0D")
+               .replace("\n", "%0A"))
+        print(f"::error file={f.path},line={f.line},"
+              f"title={tool}:{f.rule}::{msg}")
+
+
+def carrying_matches(lines: List[str], regex: "re.Pattern") -> Dict[int, "re.Match"]:
+    """line -> match for a comment annotation grammar with carrying: a
+    match on a comment-only line carries to the next code line (a block
+    comment can hold both the annotation and its rationale); a blank
+    line ends a carrying block.  Each annotation appears exactly ONCE in
+    the result — at the code line it binds to, or at its own comment
+    line when the carry dies (blank line / EOF / a code line carrying
+    its own match), so consumers can flag dangling annotations.  The
+    carry rules mirror the suppression grammar below and are shared by
+    `# guarded-by:` and `# transition:` (passes/), so they can never
+    diverge."""
+    out: Dict[int, "re.Match"] = {}
+    pending: Optional[tuple] = None  # (comment line, match)
+    for i, line in enumerate(lines, 1):
+        stripped = line.strip()
+        m = regex.search(line)
+        if stripped.startswith("#"):
+            if m is not None:
+                if pending is not None:
+                    out[pending[0]] = pending[1]  # superseded: dangling
+                pending = (i, m)
+            continue
+        if not stripped:
+            if pending is not None:  # blank line ends a carrying block
+                out[pending[0]] = pending[1]
+                pending = None
+            continue
+        if m is not None:
+            out[i] = m
+            if pending is not None:
+                out[pending[0]] = pending[1]  # code line had its own
+        elif pending is not None:
+            out[i] = pending[1]
+        pending = None
+    if pending is not None:
+        out[pending[0]] = pending[1]
+    return out
+
+
+_SUPPR_RE = re.compile(r"#\s*analyze:\s*ignore(?:\[([A-Za-z0-9_,\- ]+)\])?")
+_SUPPR_FILE_RE = re.compile(r"#\s*analyze:\s*ignore-file\[([A-Za-z0-9_,\- ]+)\]")
+
+
+def _parse_suppressions(lines: List[str]):
+    """Same-line suppressions, plus comment-only lines whose suppression
+    carries to the next code line (so a block comment above an ``except``
+    can both suppress and explain why)."""
+    per_line: Dict[int, Set[str]] = {}
+    whole_file: Set[str] = set()
+    pending: Set[str] = set()
+    for i, line in enumerate(lines, 1):
+        stripped = line.strip()
+        m = _SUPPR_FILE_RE.search(line)
+        if m:
+            whole_file.update(r.strip() for r in m.group(1).split(","))
+            continue
+        m = _SUPPR_RE.search(line)
+        rules: Set[str] = set()
+        if m:
+            rules = (set(r.strip() for r in m.group(1).split(","))
+                     if m.group(1) else {"*"})
+            per_line.setdefault(i, set()).update(rules)
+        if stripped.startswith("#"):
+            pending |= rules
+            continue
+        if not stripped:
+            pending = set()  # blank line ends a carrying comment block
+            continue
+        if pending:
+            per_line.setdefault(i, set()).update(pending)
+            pending = set()
+    return per_line, whole_file
+
+
+class Baseline:
+    """Committed grandfather list keyed on (rule, path, message) counts."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.counts: Dict[Tuple[str, str, str], int] = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                data = json.load(f)
+            for e in data.get("entries", []):
+                key = (e["rule"], e["path"], e["message"])
+                self.counts[key] = self.counts.get(key, 0) + e.get("count", 1)
+
+    def split(self, findings: List[Finding]):
+        """-> (new_findings, n_baselined, n_stale_entries)."""
+        remaining = dict(self.counts)
+        new: List[Finding] = []
+        baselined = 0
+        for f in findings:
+            if remaining.get(f.key(), 0) > 0:
+                remaining[f.key()] -= 1
+                baselined += 1
+            else:
+                new.append(f)
+        stale = sum(1 for v in remaining.values() if v > 0)
+        return new, baselined, stale
+
+    @staticmethod
+    def write(path: str, findings: List[Finding]) -> None:
+        counts: Dict[Tuple[str, str, str], int] = defaultdict(int)
+        for f in findings:
+            counts[f.key()] += 1
+        entries = [
+            {"rule": r, "path": p, "message": m, "count": n}
+            for (r, p, m), n in sorted(counts.items())
+        ]
+        with open(path, "w") as f:
+            json.dump({"version": 1, "entries": entries}, f, indent=1,
+                      sort_keys=True)
+            f.write("\n")

@@ -1,0 +1,272 @@
+"""Pass 4: governed-allocation — raw device allocation reachability."""
+
+from __future__ import annotations
+
+import ast
+from collections import defaultdict
+from typing import Dict, List, Optional, Set
+
+from ..core import Finding
+from ..project import ALLOC_ATTRS, Config, Project, _in_scope
+from ..registry import rule
+
+
+def _alloc_call_name(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        if f.value.id == "jnp" and f.attr in ALLOC_ATTRS:
+            return f"jnp.{f.attr}"
+        if f.value.id == "jax" and f.attr == "device_put":
+            return "jax.device_put"
+    if isinstance(f, ast.Name) and f.id == "device_put":
+        return "device_put"
+    return None
+
+
+@rule("governed-allocation",
+      "raw device allocation in ops/models/serve outside a governor bracket")
+def check_governed_allocation(project: Project,
+                              config: Config) -> List[Finding]:
+    # 1. index every function (incl. nested + lambdas) with parent links
+    #    funcid -> (mod, node, qualname); plus, per module, a map from any
+    #    node to its innermost enclosing function (real parent chain — a
+    #    line-span heuristic mis-scopes same-line lambdas)
+    funcs: Dict[int, tuple] = {}
+    enclosing: Dict[int, Optional[int]] = {}
+    name_to_ids: Dict[str, Set[int]] = defaultdict(set)
+    node_scope: Dict[int, Dict[int, Optional[int]]] = {}  # id(mod)->map
+
+    def walk_funcs(mod, node, parent_id, qual_prefix):
+        scope_map = node_scope[id(mod)]
+        for child in ast.iter_child_nodes(node):
+            scope_map[id(child)] = parent_id
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fid = id(child)
+                qual = f"{qual_prefix}{child.name}"
+                funcs[fid] = (mod, child, qual)
+                enclosing[fid] = parent_id
+                name_to_ids[f"{mod.modid}.{qual}"].add(fid)
+                walk_funcs(mod, child, fid, qual + ".")
+            elif isinstance(child, ast.Lambda):
+                fid = id(child)
+                funcs[fid] = (mod, child, f"{qual_prefix}<lambda>")
+                enclosing[fid] = parent_id
+                walk_funcs(mod, child, fid, qual_prefix)
+            elif isinstance(child, ast.ClassDef):
+                walk_funcs(mod, child, parent_id,
+                           f"{qual_prefix}{child.name}.")
+            else:
+                walk_funcs(mod, child, parent_id, qual_prefix)
+
+    for mod in project.modules.values():
+        node_scope[id(mod)] = {}
+        walk_funcs(mod, mod.tree, None, "")
+
+    def scope_of(mod, node) -> Optional[int]:
+        return node_scope[id(mod)].get(id(node))
+
+    # helper: resolve a callback expression to function node ids
+    def expr_func_ids(mod, expr, local_defs) -> Set[int]:
+        ids: Set[int] = set()
+        if isinstance(expr, ast.Lambda):
+            ids.add(id(expr))
+        elif isinstance(expr, ast.Call):
+            # functools.partial(f, ...) and similar single-level wrappers
+            for arg in expr.args:
+                ids |= expr_func_ids(mod, arg, local_defs)
+        elif isinstance(expr, ast.Name):
+            if expr.id in local_defs:
+                ids.add(local_defs[expr.id])
+            else:
+                r = project.resolve(mod, expr)
+                if r and r[0] == "func":
+                    ids |= name_to_ids.get(r[1], set())
+        elif isinstance(expr, ast.Attribute):
+            r = project.resolve(mod, expr)
+            if r and r[0] == "func":
+                ids |= name_to_ids.get(r[1], set())
+        return ids
+
+    # 2. governed roots: run= callbacks of the protocol drivers, fn= of
+    #    handler registrations (unless self_governed=True), and statements
+    #    under `with reservation(...)`
+    governed: Set[int] = set()
+    reservation_stmts: List[tuple] = []  # (mod, With node)
+
+    # plan-compiled roots: @emitter(Node)-decorated functions
+    # (plans/compiler.py) are the fused program's traced device code —
+    # their allocations materialize at the governed plan launch, not at
+    # trace time: the same seeding rule as `with seam(COMPILE)` bodies
+    # and jit/shard_map callback arguments.  Seeds, not baseline entries:
+    # new emitters are covered automatically, with no grandfathering.
+    for fid, (mod, node, _qual) in funcs.items():
+        for dec in getattr(node, "decorator_list", ()):
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            dec_name = None
+            if isinstance(target, (ast.Name, ast.Attribute)):
+                r = project.resolve(mod, target)
+                if r and r[0] == "func":
+                    dec_name = r[1].rsplit(".", 1)[-1]
+            if dec_name is None:
+                if isinstance(target, ast.Name):
+                    dec_name = target.id
+                elif isinstance(target, ast.Attribute):
+                    dec_name = target.attr
+            if dec_name in config.emitter_decorators:
+                governed.add(fid)
+
+    for mod in project.modules.values():
+        # local name -> nested funcdef id, per enclosing function
+        local_defs_by_scope: Dict[Optional[int], Dict[str, int]] = \
+            defaultdict(dict)
+        for fid, (m, node, qual) in funcs.items():
+            if m is not mod or isinstance(node, ast.Lambda):
+                continue
+            local_defs_by_scope[enclosing[fid]][node.name] = fid
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    ce = item.context_expr
+                    if not isinstance(ce, ast.Call):
+                        continue
+                    r = project.resolve(mod, ce.func)
+                    name = (r[1].rsplit(".", 1)[-1] if r and
+                            r[0] == "func" else
+                            getattr(ce.func, "id",
+                                    getattr(ce.func, "attr", None)))
+                    if name in config.reservation_funcs:
+                        reservation_stmts.append((mod, node))
+                    # `with seam(COMPILE, ...)` marks a step build: the
+                    # functions defined/referenced in it are traced device
+                    # code whose allocations materialize at the (governed)
+                    # launch, not at trace time
+                    if (name == "seam" and ce.args
+                            and isinstance(ce.args[0],
+                                           (ast.Name, ast.Attribute))):
+                        term = (ce.args[0].id
+                                if isinstance(ce.args[0], ast.Name)
+                                else ce.args[0].attr)
+                        if term == "COMPILE":
+                            for stmt in node.body:
+                                for ref in ast.walk(stmt):
+                                    rid = id(ref)
+                                    if rid in funcs:
+                                        governed.add(rid)
+                                    elif isinstance(ref, (ast.Name,
+                                                          ast.Attribute)):
+                                        rr = project.resolve(mod, ref)
+                                        if rr and rr[0] == "func":
+                                            governed |= name_to_ids.get(
+                                                rr[1], set())
+            if not isinstance(node, ast.Call):
+                continue
+            # traced device code: shard_map(f, ...) / jax.jit(f) bodies
+            # allocate at launch time, inside the caller's bracket
+            jit_name = None
+            if isinstance(node.func, ast.Name):
+                jit_name = node.func.id
+            elif isinstance(node.func, ast.Attribute):
+                jit_name = node.func.attr
+            if jit_name in ("jit", "shard_map", "pjit"):
+                scope0 = scope_of(mod, node)
+                for arg in node.args:
+                    governed |= expr_func_ids(
+                        mod, arg,
+                        local_defs_by_scope.get(scope0, {}))
+            r = project.resolve(mod, node.func)
+            callee = None
+            if r and r[0] == "func":
+                callee = r[1].rsplit(".", 1)[-1]
+            elif isinstance(node.func, ast.Name):
+                callee = node.func.id
+            elif isinstance(node.func, ast.Attribute):
+                callee = node.func.attr
+            scope = scope_of(mod, node)
+            local_defs = local_defs_by_scope.get(scope, {})
+            if callee in config.governed_drivers:
+                run_expr = None
+                for kw in node.keywords:
+                    if kw.arg == "run":
+                        run_expr = kw.value
+                if run_expr is None and callee in ("attempt_once", "_attempt") \
+                        and len(node.args) >= 5:
+                    run_expr = node.args[4]
+                if run_expr is not None:
+                    governed |= expr_func_ids(mod, run_expr, local_defs)
+            cls_r = project.resolve(mod, node.func)
+            if (cls_r and cls_r[0] == "class"
+                    and cls_r[1].rsplit(".", 1)[-1] in
+                    config.handler_classes):
+                self_gov = any(
+                    kw.arg == "self_governed"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value
+                    for kw in node.keywords)
+                if not self_gov:
+                    for kw in node.keywords:
+                        if kw.arg == "fn":
+                            governed |= expr_func_ids(mod, kw.value,
+                                                      local_defs)
+                    if len(node.args) >= 2:
+                        governed |= expr_func_ids(mod, node.args[1],
+                                                  local_defs)
+
+    # 3. propagate: a function referenced by name from a governed function
+    #    is governed (jit wrappers, partials, helpers, cross-module calls)
+    changed = True
+    while changed:
+        changed = False
+        for fid in list(governed):
+            mod, node, qual = funcs[fid]
+            body = node.body if isinstance(node.body, list) else [node.body]
+            # nested defs of a governed function are governed
+            for child in ast.walk(node):
+                cid = id(child)
+                if cid in funcs and cid != fid and cid not in governed:
+                    governed.add(cid)
+                    changed = True
+            for sub in body:
+                for ref in ast.walk(sub):
+                    tgt = None
+                    if isinstance(ref, (ast.Name, ast.Attribute)):
+                        r = project.resolve(mod, ref)
+                        if r and r[0] == "func":
+                            tgt = r[1]
+                    if tgt:
+                        for tid in name_to_ids.get(tgt, ()):
+                            if tid not in governed:
+                                governed.add(tid)
+                                changed = True
+
+    # 4. flag raw allocations in scope outside governed functions and
+    #    outside `with reservation(...)` bodies
+    reservation_spans: Dict[int, List[tuple]] = defaultdict(list)
+    for mod, wnode in reservation_stmts:
+        end = getattr(wnode, "end_lineno", wnode.lineno)
+        reservation_spans[id(mod)].append((wnode.lineno, end))
+
+    findings: List[Finding] = []
+    for modid, mod in project.modules.items():
+        if not _in_scope(modid, config.governed_scope):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = _alloc_call_name(node)
+            if cname is None:
+                continue
+            fid = scope_of(mod, node)
+            if fid is not None and fid in governed:
+                continue
+            if any(s <= node.lineno <= e
+                   for s, e in reservation_spans.get(id(mod), ())):
+                continue
+            if mod.suppressed("governed-allocation", node.lineno):
+                continue
+            qual = funcs[fid][2] if fid is not None else "<module>"
+            findings.append(Finding(
+                "governed-allocation", mod.relpath, node.lineno,
+                f"{cname} in {qual} has no governed path (not reserved "
+                f"through attempt_once/run_with_split_retry/reservation)"))
+    return findings

@@ -1,0 +1,277 @@
+"""Pass 7: guarded-by — annotated attributes accessed under their lock.
+
+Pass 2 (unguarded-shared-state) infers which writes *look* shared from
+lock ownership alone; it checks writes only, and cannot know which
+attribute belongs to which lock.  This pass is the declared complement:
+an attribute annotated at its initialization site with
+
+    self._leases = {}          # guarded-by: _lock
+
+must be read AND written under ``with self._lock:`` at every site outside
+``__init__``, with lock-held context propagated through self-method calls
+— a private helper only ever called from under the lock is compliant; the
+same helper reachable from a public method without the lock is not.  The
+defect class this pins at merge time is the round-10 review's
+pick-vs-record shape: supervision state touched in a window where the
+declared lock is not held.
+
+Granularity notes (documented limits, not surprises):
+
+- only ``self.<attr>`` accesses inside the owning class are checked;
+  external ``obj.attr`` pokes are a design smell pass 2 partially covers;
+- accesses inside nested functions/lambdas are skipped (they run later,
+  under whatever lock state their caller establishes) — same rule as
+  pass 2;
+- ``__init__`` is exempt: the object is not shared yet.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding, carrying_matches
+from ..project import ClassInfo, Config, ModuleInfo, Project, _in_scope, \
+    _self_name
+from ..registry import rule
+from .locks import referenced_attr_names
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*(?:self\.)?([A-Za-z_]\w*)")
+
+
+def annotation_map(mod: ModuleInfo) -> Dict[int, "re.Match"]:
+    """Per-module ``# guarded-by:`` annotations with the shared carrying
+    grammar: same-line (any line of a multi-line statement), or on a
+    comment line carrying to the next code line (cached on the module)."""
+    cached = getattr(mod, "_guarded_ann", None)
+    if cached is None:
+        cached = mod._guarded_ann = carrying_matches(mod.lines, _GUARDED_RE)
+    return cached
+
+
+def collect_guarded(mod: ModuleInfo, ci: ClassInfo,
+                    consumed: Optional[Set[int]] = None) -> List[Finding]:
+    """Populate ``ci.guarded_attrs`` from annotations on class-body and
+    ``__init__`` attribute initializations; returns findings for
+    annotations naming a lock the class does not own.  Lines whose
+    annotation bound something are added to ``consumed`` so the caller
+    can flag annotations that silently bind NOTHING."""
+    findings: List[Finding] = []
+    anns = annotation_map(mod)
+
+    def bind(attrs: List[str], node) -> None:
+        lineno = node.lineno
+        span = range(lineno, getattr(node, "end_lineno", lineno) + 1)
+        hit = next((i for i in span if i in anns), None)
+        if hit is None:
+            return
+        if consumed is not None:
+            consumed.add(hit)
+        lock = anns[hit].group(1)
+        if lock not in ci.lock_attrs:
+            findings.append(Finding(
+                "guarded-by", mod.relpath, lineno,
+                f"{ci.name} guarded-by annotation names {lock!r}, which "
+                f"is not a Lock/RLock/Condition attribute of the class"))
+            return
+        for attr in attrs:
+            ci.guarded_attrs[attr] = lock
+
+    for item in ci.node.body:
+        if isinstance(item, ast.Assign):
+            names = [t.id for t in item.targets if isinstance(t, ast.Name)]
+            if names:
+                bind(names, item)
+        elif isinstance(item, ast.AnnAssign) and isinstance(
+                item.target, ast.Name):
+            bind([item.target.id], item)
+
+    init = ci.methods.get("__init__")
+    if init is not None:
+        selfname = _self_name(init) or "self"
+        for node in ast.walk(init):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            attrs = []
+            for t in targets:
+                for a in _self_attr_targets(t, selfname):
+                    attrs.append(a)
+            if attrs:
+                bind(attrs, node)
+    return findings
+
+
+def _self_attr_targets(t, selfname: str):
+    """Plain ``self.attr`` assignment targets (no subscripts: a subscript
+    store initializes a container's content, not the attribute)."""
+    if isinstance(t, (ast.Tuple, ast.List)):
+        for elt in t.elts:
+            yield from _self_attr_targets(elt, selfname)
+        return
+    if isinstance(t, ast.Starred):
+        yield from _self_attr_targets(t.value, selfname)
+        return
+    if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+            and t.value.id == selfname):
+        yield t.attr
+
+
+class _GuardedScan(ast.NodeVisitor):
+    """Per-method accesses of guarded attrs with lexical lock state, plus
+    self-calls with the held-lock set (for entered-unlocked propagation)."""
+
+    def __init__(self, ci: ClassInfo, selfname: str):
+        self.ci = ci
+        self.selfname = selfname
+        self.held: List[str] = []  # own-lock attr names, lexically held
+        # (attr, line, kind, frozenset(held))
+        self.accesses: List[Tuple[str, int, str, frozenset]] = []
+        self.calls: List[Tuple[str, frozenset]] = []
+
+    def _is_own_lock(self, expr) -> bool:
+        return (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == self.selfname
+                and expr.attr in self.ci.lock_attrs)
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            if self._is_own_lock(item.context_expr):
+                acquired.append(item.context_expr.attr)
+            else:
+                self.visit(item.context_expr)
+        self.held.extend(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.held.pop()
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (isinstance(node.value, ast.Name)
+                and node.value.id == self.selfname
+                and node.attr in self.ci.guarded_attrs):
+            kind = ("write" if isinstance(node.ctx, (ast.Store, ast.Del))
+                    else "read")
+            self.accesses.append((node.attr, node.lineno, kind,
+                                  frozenset(self.held)))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if (isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id == self.selfname
+                and f.attr in self.ci.methods):
+            self.calls.append((f.attr, frozenset(self.held)))
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node) -> None:
+        pass  # nested defs run later, not under these locks (pass-2 rule)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node) -> None:
+        pass
+
+    def visit_ClassDef(self, node) -> None:
+        pass
+
+
+@rule("guarded-by",
+      "attributes annotated `# guarded-by: <lock>` must be read/written "
+      "under that lock outside __init__")
+def check_guarded_by(project: Project, config: Config) -> List[Finding]:
+    findings: List[Finding] = []
+    referenced = referenced_attr_names(project)
+    for modid, mod in project.modules.items():
+        if not _in_scope(modid, config.guarded_scope):
+            continue
+        consumed: Set[int] = set()
+        for ci in mod.classes.values():
+            findings.extend(collect_guarded(mod, ci, consumed))
+            if ci.guarded_attrs:
+                findings.extend(_check_class(mod, ci, referenced))
+        # an annotation that bound NOTHING is a silent no-op — the exact
+        # drift class this pass exists to kill, so it is itself a finding
+        # (e.g. the annotation landed on a line no attribute assignment
+        # spans, or inside a method body instead of __init__)
+        for line in sorted(set(annotation_map(mod)) - consumed):
+            findings.append(Finding(
+                "guarded-by", mod.relpath, line,
+                "guarded-by annotation binds no attribute: it must sit on "
+                "(or carry to) a class-body or __init__ attribute "
+                "initialization"))
+    return [f for f in findings
+            if not _suppressed(project, f)]
+
+
+def _suppressed(project: Project, f: Finding) -> bool:
+    mod = next((m for m in project.modules.values()
+                if m.relpath == f.path), None)
+    return mod is not None and mod.suppressed(f.rule, f.line)
+
+
+def _check_class(mod: ModuleInfo, ci: ClassInfo,
+                 referenced: Set[str]) -> List[Finding]:
+    scans: Dict[str, _GuardedScan] = {}
+    seen_nodes: Dict[int, str] = {}
+    for mname, meth in ci.methods.items():
+        if id(meth) in seen_nodes:  # class-level alias of the same def
+            scans[mname] = scans[seen_nodes[id(meth)]]
+            continue
+        seen_nodes[id(meth)] = mname
+        sc = _GuardedScan(ci, _self_name(meth) or "self")
+        for stmt in meth.body:
+            sc.visit(stmt)
+        scans[mname] = sc
+
+    # per lock: which methods can be ENTERED without it held.  Public and
+    # externally-referenced methods start unlocked; an unlocked method
+    # calling self.helper() without the lock makes the helper unlocked too
+    # (the lock-held-context propagation through self-method calls).
+    locks = set(ci.guarded_attrs.values())
+    entered_unlocked: Dict[str, Set[str]] = {}
+    for lock in locks:
+        unlocked: Set[str] = set()
+        work: List[str] = []
+        for mname in ci.methods:
+            if mname == "__init__":
+                continue
+            public = not mname.startswith("_") or (
+                mname.startswith("__") and mname.endswith("__"))
+            if public or mname in referenced:
+                unlocked.add(mname)
+                work.append(mname)
+        while work:
+            m = work.pop()
+            for callee, held in scans[m].calls:
+                if (lock not in held and callee not in unlocked
+                        and callee != "__init__"):
+                    unlocked.add(callee)
+                    work.append(callee)
+        entered_unlocked[lock] = unlocked
+
+    findings: List[Finding] = []
+    reported: Set[tuple] = set()
+    for mname in sorted(ci.methods):
+        if mname == "__init__":
+            continue
+        for attr, line, kind, held in scans[mname].accesses:
+            lock = ci.guarded_attrs[attr]
+            if lock in held:
+                continue
+            if mname not in entered_unlocked[lock]:
+                continue  # only ever called with the lock already held
+            if (attr, line, kind) in reported:
+                continue
+            reported.add((attr, line, kind))
+            findings.append(Finding(
+                "guarded-by", mod.relpath, line,
+                f"{ci.name}.{mname} {kind}s self.{attr} outside "
+                f"self.{lock} (declared guarded-by), reachable without "
+                f"the lock"))
+    return findings

@@ -1,0 +1,536 @@
+"""Passes 1-2: lock-order cycles and unguarded shared state."""
+
+from __future__ import annotations
+
+import ast
+from collections import defaultdict
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding
+from ..project import (
+    ClassInfo,
+    Config,
+    ModuleInfo,
+    Project,
+    _in_scope,
+    _self_name,
+)
+from ..registry import rule
+
+# --------------------------------------------------------------------------
+# pass 1: lock-order
+# --------------------------------------------------------------------------
+
+
+class _LockWalker(ast.NodeVisitor):
+    """Walk one function body tracking lexically-held locks; record lock
+    acquisitions, condition waits, and calls with their held-lock set."""
+
+    def __init__(self, analysis: "_LockAnalysis", mod: ModuleInfo,
+                 ci: Optional[ClassInfo], funckey: str, env: Dict[str, str]):
+        self.a = analysis
+        self.mod = mod
+        self.ci = ci
+        self.funckey = funckey
+        self.env = env
+        self.held: List[Tuple[str, str]] = []  # (lockkey, kind)
+
+    # lock resolution ------------------------------------------------------
+    def _lock_of(self, expr) -> Optional[Tuple[str, str]]:
+        """with-expr -> (lockkey, kind): self.X / obj.X / MODULE_LOCK /
+        alias chains like self.gov.arbiter (no lock there, but chains of
+        attr types are followed)."""
+        if isinstance(expr, ast.Name):
+            kind = self.mod.module_locks.get(expr.id)
+            if kind:
+                return (f"{self.mod.modid}.{expr.id}", kind)
+            imp = self.mod.imports.get(expr.id)
+            if imp and imp[0] == "obj":
+                src = self.a.project.modules.get(imp[1])
+                if src and imp[2] in src.module_locks:
+                    return (f"{imp[1]}.{imp[2]}", src.module_locks[imp[2]])
+            return None
+        if isinstance(expr, ast.Attribute):
+            owner = self._class_of(expr.value)
+            if owner is None:
+                return None
+            ci = self.a.project.classes.get(owner)
+            if ci and expr.attr in ci.lock_attrs:
+                return (f"{owner}.{expr.attr}", ci.lock_attrs[expr.attr])
+        return None
+
+    def _class_of(self, expr) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            if expr.id in self.env:
+                return self.env[expr.id]
+            r = self.a.project.resolve(self.mod, expr)
+            if r and r[0] == "class":
+                return r[1]
+            return None
+        if isinstance(expr, ast.Attribute):
+            owner = self._class_of(expr.value)
+            if owner:
+                ci = self.a.project.classes.get(owner)
+                if ci and expr.attr in ci.attr_types:
+                    return ci.attr_types[expr.attr]
+        return None
+
+    def _callee_keys(self, call: ast.Call) -> List[str]:
+        p = self.a.project
+        f = call.func
+        # self.m() / obj.m() / chain.m()
+        if isinstance(f, ast.Attribute):
+            owner = self._class_of(f.value)
+            if owner:
+                ci = p.classes.get(owner)
+                if ci:
+                    if f.attr in ci.methods:
+                        return [f"{owner}.{f.attr}"]
+                    # stored-callable call (self._cb(...)): all callbacks
+                    if f.attr not in ci.lock_attrs and \
+                            f.attr not in ci.attr_types:
+                        return sorted(ci.callback_targets)
+                return []
+            r = p.resolve(self.mod, f)
+            if r and r[0] == "func":
+                return [r[1]]
+            return []
+        if isinstance(f, ast.Name):
+            if f.id in self.a.local_funcs.get(self.funckey, {}):
+                return [self.a.local_funcs[self.funckey][f.id]]
+            r = p.resolve(self.mod, f)
+            if r and r[0] == "func":
+                return [r[1]]
+            if r and r[0] == "class":
+                # constructor: treat as call to __init__
+                ci = p.classes.get(r[1])
+                if ci and "__init__" in ci.methods:
+                    return [f"{r[1]}.__init__"]
+        return []
+
+    # visiting -------------------------------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            expr = item.context_expr
+            lk = self._lock_of(expr)
+            if lk is None and isinstance(expr, ast.Call):
+                # `with self._lock:` vs `with foo():` -- a Call can still be
+                # a lock via e.g. `with self._lock` only; calls are calls
+                self._record_call(expr)
+                self.generic_visit(expr)
+                continue
+            if lk is not None:
+                # items enter left-to-right: `with a, b:` acquires b while
+                # holding a, so earlier items of THIS statement are held too
+                self.a.record_acquire(self.funckey,
+                                      list(self.held) + acquired, lk,
+                                      self.mod, expr.lineno
+                                      if hasattr(expr, "lineno")
+                                      else node.lineno)
+                acquired.append(lk)
+            else:
+                self.visit(expr)
+        self.held.extend(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.held.pop()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self._record_call(node)
+        self.generic_visit(node)
+
+    def _record_call(self, node: ast.Call) -> None:
+        # condition wait while holding other locks = hold-and-wait
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in ("wait", "wait_for"):
+            lk = self._lock_of(f.value)
+            if lk is not None:
+                for h in self.held:
+                    if h[0] != lk[0]:
+                        self.a.record_wait_edge(h, lk, self.mod, node.lineno)
+        for key in self._callee_keys(node):
+            self.a.record_call(self.funckey, list(self.held), key,
+                               self.mod, node.lineno)
+
+    def visit_FunctionDef(self, node) -> None:
+        pass  # nested defs run later, not under these locks
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node) -> None:
+        pass
+
+    def visit_ClassDef(self, node) -> None:
+        pass
+
+
+class _LockAnalysis:
+    def __init__(self, project: Project):
+        self.project = project
+        # funckey -> set(lockkeys) acquired directly
+        self.direct: Dict[str, Set[str]] = defaultdict(set)
+        self.lock_kinds: Dict[str, str] = {}
+        # call graph funckey -> set(funckey)
+        self.calls: Dict[str, Set[str]] = defaultdict(set)
+        # (site) lists for edge building
+        self.acquire_sites: List[tuple] = []  # (func, held, lock, mod, line)
+        self.call_sites: List[tuple] = []  # (func, held, callee, mod, line)
+        self.wait_edges: List[tuple] = []  # (held_lock, lock, mod, line)
+        self.local_funcs: Dict[str, Dict[str, str]] = {}
+
+    def record_acquire(self, funckey, held, lk, mod, line):
+        self.direct[funckey].add(lk[0])
+        self.lock_kinds[lk[0]] = lk[1]
+        self.acquire_sites.append((funckey, held, lk, mod, line))
+
+    def record_call(self, funckey, held, callee, mod, line):
+        self.calls[funckey].add(callee)
+        if held:
+            self.call_sites.append((funckey, held, callee, mod, line))
+
+    def record_wait_edge(self, held_lock, lk, mod, line):
+        self.lock_kinds[lk[0]] = lk[1]
+        self.wait_edges.append((held_lock, lk, mod, line))
+
+
+@rule("lock-order",
+      "cycles in the static lock-acquisition graph (potential deadlock)")
+def check_lock_order(project: Project, config: Config) -> List[Finding]:
+    a = _LockAnalysis(project)
+    # walk every function/method of in-scope modules
+    for modid, mod in project.modules.items():
+        if not _in_scope(modid, config.lock_scope):
+            continue
+        items: List[tuple] = []
+        for qual, fnode in mod.functions.items():
+            items.append((None, f"{modid}.{qual}", fnode))
+        for ci in mod.classes.values():
+            seen = set()
+            for mname, meth in ci.methods.items():
+                if id(meth) in seen:
+                    continue
+                seen.add(id(meth))
+                items.append((ci, f"{ci.key}.{mname}", meth))
+        for ci, funckey, fnode in items:
+            env = project._param_env(mod, ci, fnode)
+            # local nested defs are callable by name from this function
+            locals_map = {}
+            for child in ast.iter_child_nodes(fnode):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    key = f"{funckey}.<{child.name}>"
+                    project.functions[key] = (mod, child)
+                    locals_map[child.name] = key
+                    items.append((ci, key, child))
+            a.local_funcs[funckey] = locals_map
+            walker = _LockWalker(a, mod, ci, funckey, env)
+            for stmt in fnode.body if hasattr(fnode, "body") else []:
+                walker.visit(stmt)
+
+    # transitive acquires fixed point
+    trans: Dict[str, Set[str]] = {k: set(v) for k, v in a.direct.items()}
+    changed = True
+    while changed:
+        changed = False
+        for caller, callees in a.calls.items():
+            cur = trans.setdefault(caller, set())
+            before = len(cur)
+            for c in callees:
+                cur |= trans.get(c, set())
+            if len(cur) != before:
+                changed = True
+
+    # edges with witnesses
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    def add_edge(src, dst, mod, line):
+        edges.setdefault((src, dst), (mod.relpath, line))
+
+    self_findings: List[Finding] = []
+    for funckey, held, lk, mod, line in a.acquire_sites:
+        for h in held:
+            if h[0] == lk[0]:
+                if a.lock_kinds.get(lk[0]) == "lock" and not mod.suppressed(
+                        "lock-order", line):
+                    self_findings.append(Finding(
+                        "lock-order", mod.relpath, line,
+                        f"non-reentrant lock {lk[0]} re-acquired while "
+                        f"already held (self-deadlock)"))
+            else:
+                add_edge(h[0], lk[0], mod, line)
+    self_reported: Set[Tuple[str, int]] = set()
+    for funckey, held, callee, mod, line in a.call_sites:
+        for l2 in trans.get(callee, ()):
+            for h in held:
+                if h[0] != l2:
+                    add_edge(h[0], l2, mod, line)
+                elif (a.lock_kinds.get(l2) == "lock"
+                      and (mod.relpath, line) not in self_reported
+                      and not mod.suppressed("lock-order", line)):
+                    self_reported.add((mod.relpath, line))
+                    self_findings.append(Finding(
+                        "lock-order", mod.relpath, line,
+                        f"non-reentrant lock {l2} re-acquired while "
+                        f"already held (self-deadlock via {callee})"))
+    for h, lk, mod, line in a.wait_edges:
+        add_edge(h[0], lk[0], mod, line)
+
+    # cycle detection (iterative Tarjan SCC)
+    graph: Dict[str, Set[str]] = defaultdict(set)
+    for (s, d) in edges:
+        graph[s].add(d)
+    sccs = _tarjan(graph)
+    findings = list(self_findings)
+    for scc in sccs:
+        if len(scc) < 2:
+            continue
+        cyc = sorted(scc)
+        # one witness edge inside the cycle for the report location
+        witness = None
+        for (s, d), w in sorted(edges.items()):
+            if s in scc and d in scc:
+                witness = w
+                break
+        path, line = witness if witness else ("", 0)
+        mod = next((m for m in project.modules.values()
+                    if m.relpath == path), None)
+        if mod is not None and mod.suppressed("lock-order", line):
+            continue
+        findings.append(Finding(
+            "lock-order", path, line,
+            "lock-acquisition cycle: " + " -> ".join(cyc + [cyc[0]])))
+    return findings
+
+
+def _tarjan(graph: Dict[str, Set[str]]) -> List[Set[str]]:
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[Set[str]] = []
+    counter = [0]
+
+    nodes = set(graph)
+    for vs in graph.values():
+        nodes |= vs
+
+    def strongconnect(v0):
+        work = [(v0, iter(sorted(graph.get(v0, ()))))]
+        index[v0] = low[v0] = counter[0]
+        counter[0] += 1
+        stack.append(v0)
+        on_stack.add(v0)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if low[v] == index[v]:
+                scc = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.add(w)
+                    if w == v:
+                        break
+                sccs.append(scc)
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+
+    for v in sorted(nodes):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+# --------------------------------------------------------------------------
+# pass 2: unguarded-shared-state
+# --------------------------------------------------------------------------
+
+
+@rule("unguarded-shared-state",
+      "attribute writes reachable from public methods outside the owning "
+      "class's lock")
+def check_unguarded_state(project: Project, config: Config) -> List[Finding]:
+    findings: List[Finding] = []
+    referenced_attrs = referenced_attr_names(project)
+    for modid, mod in project.modules.items():
+        if not _in_scope(modid, config.state_scope):
+            continue
+        for ci in mod.classes.values():
+            if not ci.lock_attrs:
+                continue
+            findings.extend(_check_class_state(project, mod, ci,
+                                               referenced_attrs))
+    return findings
+
+
+def referenced_attr_names(project: Project) -> Set[str]:
+    """Names referenced as bare attributes (thread targets, callbacks like
+    ``Thread(target=self._worker_loop)``): such methods can be entered from
+    outside without the lock, so they count as public entry points.  An
+    Attribute load that is the func of a Call is a method CALL, not a
+    bare reference.  Shared with pass 7 (guarded-by); the two full-tree
+    walks run once per gate invocation (cached on the Project)."""
+    cached = getattr(project, "_referenced_attrs", None)
+    if cached is not None:
+        return cached
+    referenced: Set[str] = set()
+    for mod in project.modules.values():
+        call_funcs = {id(n.func) for n in ast.walk(mod.tree)
+                      if isinstance(n, ast.Call)}
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.ctx, ast.Load)
+                    and id(node) not in call_funcs):
+                referenced.add(node.attr)
+    project._referenced_attrs = referenced
+    return referenced
+
+
+def _check_class_state(project: Project, mod: ModuleInfo, ci: ClassInfo,
+                       referenced_attrs: Set[str]) -> List[Finding]:
+    lock_names = set(ci.lock_attrs)
+
+    # per-method: (writes_outside_lock, intra-class calls with lock state)
+    class MethodScan(ast.NodeVisitor):
+        def __init__(self, selfname):
+            self.selfname = selfname
+            self.under = 0
+            self.writes: List[tuple] = []  # (attr, line, locked)
+            self.calls: List[tuple] = []  # (method_name, locked)
+
+        def _is_own_lock(self, expr) -> bool:
+            return (isinstance(expr, ast.Attribute)
+                    and isinstance(expr.value, ast.Name)
+                    and expr.value.id == self.selfname
+                    and expr.attr in lock_names)
+
+        def visit_With(self, node):
+            n = sum(1 for item in node.items
+                    if self._is_own_lock(item.context_expr))
+            for item in node.items:
+                if not self._is_own_lock(item.context_expr):
+                    self.visit(item.context_expr)
+            self.under += n
+            for stmt in node.body:
+                self.visit(stmt)
+            self.under -= n
+
+        def _self_targets(self, t):
+            """attr names written by a target: self.attr, self.attr[...],
+            and tuple/list unpacks (self.x, self.y = ...)."""
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for elt in t.elts:
+                    yield from self._self_targets(elt)
+                return
+            if isinstance(t, ast.Starred):
+                yield from self._self_targets(t.value)
+                return
+            if isinstance(t, ast.Subscript):
+                t = t.value
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == self.selfname):
+                yield t.attr
+
+        def _self_target(self, t):
+            return next(self._self_targets(t), None)
+
+        def visit_Assign(self, node):
+            for t in node.targets:
+                for attr in self._self_targets(t):
+                    self.writes.append((attr, node.lineno, self.under > 0))
+            self.visit(node.value)
+
+        def visit_AugAssign(self, node):
+            attr = self._self_target(node.target)
+            if attr:
+                self.writes.append((attr, node.lineno, self.under > 0))
+            self.visit(node.value)
+
+        def visit_AnnAssign(self, node):
+            attr = self._self_target(node.target)
+            if attr and node.value is not None:
+                self.writes.append((attr, node.lineno, self.under > 0))
+            if node.value is not None:
+                self.visit(node.value)
+
+        def visit_Call(self, node):
+            f = node.func
+            if (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == self.selfname
+                    and f.attr in ci.methods):
+                self.calls.append((f.attr, self.under > 0))
+            self.generic_visit(node)
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass
+
+    scans: Dict[str, MethodScan] = {}
+    seen_nodes: Dict[int, str] = {}
+    for mname, meth in ci.methods.items():
+        if id(meth) in seen_nodes:  # class-level alias of the same def
+            scans[mname] = scans[seen_nodes[id(meth)]]
+            continue
+        seen_nodes[id(meth)] = mname
+        sc = MethodScan(_self_name(meth) or "self")
+        for stmt in meth.body:
+            sc.visit(stmt)
+        scans[mname] = sc
+
+    # reachable-without-lock: public entries + externally referenced names;
+    # propagate through intra-class calls made outside the lock
+    unlocked: Set[str] = set()
+    work: List[str] = []
+    for mname in ci.methods:
+        if mname == "__init__":
+            continue
+        public = not mname.startswith("_") or (
+            mname.startswith("__") and mname.endswith("__"))
+        if public or mname in referenced_attrs:
+            unlocked.add(mname)
+            work.append(mname)
+    while work:
+        m = work.pop()
+        for callee, locked in scans[m].calls:
+            if not locked and callee not in unlocked and callee != "__init__":
+                unlocked.add(callee)
+                work.append(callee)
+
+    findings: List[Finding] = []
+    reported: Set[tuple] = set()
+    for mname in sorted(unlocked):
+        for attr, line, locked in scans[mname].writes:
+            if locked or (attr, line) in reported:
+                continue
+            if mod.suppressed("unguarded-shared-state", line):
+                continue
+            reported.add((attr, line))
+            locks = ", ".join(f"self.{n}" for n in sorted(lock_names))
+            findings.append(Finding(
+                "unguarded-shared-state", mod.relpath, line,
+                f"{ci.name}.{mname} writes self.{attr} outside {locks} "
+                f"but is reachable from public callers"))
+    return findings

@@ -1,0 +1,97 @@
+"""Pass 3: retry-protocol — broad excepts that can swallow signals."""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ..core import Finding
+from ..project import (
+    BROAD_NAMES,
+    CONTROL_ALIASES,
+    CONTROL_EXCEPTIONS,
+    CONTROL_ROOTS,
+    Config,
+    Project,
+)
+from ..registry import rule
+
+
+def _except_names(type_node) -> Set[str]:
+    if type_node is None:
+        return {"<bare>"}
+    names: Set[str] = set()
+    for n in ([type_node.elts] if isinstance(type_node, ast.Tuple)
+              else [[type_node]])[0]:
+        if isinstance(n, ast.Name):
+            names.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            names.add(n.attr)
+        else:
+            names.add("<expr>")
+    return names
+
+
+@rule("retry-protocol",
+      "broad except that can swallow RetryOOM/SplitAndRetryOOM/"
+      "ShuffleCapacityExceeded without re-raising")
+def check_retry_protocol(project: Project, config: Config) -> List[Finding]:
+    findings: List[Finding] = []
+    for modid, mod in project.modules.items():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            covered: Set[str] = set()
+            for handler in node.handlers:
+                names = _except_names(handler.type)
+                explicit = names & (CONTROL_EXCEPTIONS | CONTROL_ALIASES)
+                if explicit:
+                    covered |= names & CONTROL_ROOTS
+                    if names & CONTROL_ALIASES:
+                        covered |= CONTROL_ROOTS
+                    continue  # protocol-aware by naming the signals
+                broad = "<bare>" in names or names & BROAD_NAMES
+                if not broad:
+                    continue
+                if CONTROL_ROOTS <= covered:
+                    continue  # earlier clauses intercept the signals
+                if _reraises(handler):
+                    continue  # re-raises the signal (maybe conditionally)
+                if mod.suppressed("retry-protocol", handler.lineno):
+                    continue
+                broad_name = sorted(names & (BROAD_NAMES | {"<bare>"}))[0]
+                missing = ", ".join(sorted(CONTROL_ROOTS - covered))
+                findings.append(Finding(
+                    "retry-protocol", mod.relpath, handler.lineno,
+                    f"except {broad_name} can swallow {missing} without "
+                    f"re-raising, re-attempting, or an explicit earlier "
+                    f"handler"))
+    return findings
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    """True only for a genuine re-raise of the caught exception: a bare
+    ``raise`` or ``raise e`` of the bound name.  ``raise Other(...) from e``
+    does NOT count — that converts a control signal into a generic failure,
+    which is exactly the defect this pass rejects."""
+    for n in _handler_body_walk(handler):
+        if not isinstance(n, ast.Raise):
+            continue
+        if n.exc is None:
+            return True
+        if (handler.name and isinstance(n.exc, ast.Name)
+                and n.exc.id == handler.name):
+            return True
+    return False
+
+
+def _handler_body_walk(handler: ast.ExceptHandler):
+    """Walk the handler body without descending into nested functions."""
+    stack = list(handler.body)
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                          ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))

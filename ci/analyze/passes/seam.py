@@ -1,0 +1,150 @@
+"""Passes 5-6: seam-discipline and flight-discipline."""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ..core import Finding
+from ..project import Config, Project
+from ..registry import rule
+
+# --------------------------------------------------------------------------
+# pass 5: seam-discipline
+# --------------------------------------------------------------------------
+
+
+def _load_categories(project: Project, config: Config) -> Set[str]:
+    if config.categories is not None:
+        return config.categories
+    cats: Set[str] = set()
+    seam_mod = project.modules.get("obs.seam")
+    if seam_mod is not None:
+        for node in seam_mod.tree.body:
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Constant) and isinstance(
+                    node.value.value, str):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id.isupper():
+                        cats.add(t.id)
+    return cats
+
+
+@rule("seam-discipline",
+      "obs seam crossings must be context-managed with a registered "
+      "category constant")
+def check_seam_discipline(project: Project, config: Config) -> List[Finding]:
+    cats = _load_categories(project, config)
+    findings: List[Finding] = []
+    for modid, mod in project.modules.items():
+        if modid in config.seam_exclude:
+            continue
+        with_exprs: Set[int] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    with_exprs.add(id(item.context_expr))
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            r = project.resolve(mod, node.func)
+            if not (r and r[0] == "func"
+                    and r[1].split(".")[0:2] == ["obs", "seam"]):
+                continue
+            fname = r[1].rsplit(".", 1)[-1]
+            if fname not in ("seam", "instrument", "serialize_category"):
+                continue
+            line = node.lineno
+            if mod.suppressed("seam-discipline", line):
+                continue
+            if fname == "seam" and id(node) not in with_exprs:
+                findings.append(Finding(
+                    "seam-discipline", mod.relpath, line,
+                    "seam() used outside a with-statement: enter/exit are "
+                    "not exception-paired"))
+                continue
+            if not node.args:
+                continue
+            cat = node.args[0]
+            if isinstance(cat, ast.Constant):
+                findings.append(Finding(
+                    "seam-discipline", mod.relpath, line,
+                    f"{fname}() called with a literal category "
+                    f"{cat.value!r}: use a registered constant from "
+                    f"obs.seam"))
+            elif isinstance(cat, (ast.Name, ast.Attribute)):
+                term = cat.id if isinstance(cat, ast.Name) else cat.attr
+                if cats and term not in cats:
+                    findings.append(Finding(
+                        "seam-discipline", mod.relpath, line,
+                        f"{fname}() category {term!r} is not a registered "
+                        f"obs.seam category"))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# pass 6: flight-discipline
+# --------------------------------------------------------------------------
+
+
+def _load_event_kinds(project: Project, config: Config) -> Set[str]:
+    """The EV_* constant *names* defined at obs/flight.py module level —
+    the registered event-kind vocabulary emission sites must use."""
+    if config.event_kinds is not None:
+        return config.event_kinds
+    kinds: Set[str] = set()
+    mod = project.modules.get(config.flight_module)
+    if mod is not None:
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Constant) and isinstance(
+                    node.value.value, str):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id.startswith("EV_"):
+                        kinds.add(t.id)
+    return kinds
+
+
+@rule("flight-discipline",
+      "flight-recorder events must be emitted with registered EV_* "
+      "event-kind constants")
+def check_flight_discipline(project: Project, config: Config) -> List[Finding]:
+    """A dump consumer (tools/flightdump.py, the converter's governance
+    tracks, the chaos tests' completeness checks) keys on the event-kind
+    vocabulary; a free-form string at an emission site silently falls out
+    of every reconstruction.  Mirrors seam-discipline: the first argument
+    of ``obs.flight.record(...)`` must be an EV_* constant."""
+    kinds = _load_event_kinds(project, config)
+    findings: List[Finding] = []
+    for modid, mod in project.modules.items():
+        if modid in config.flight_exclude:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            r = project.resolve(mod, node.func)
+            # anomaly() reasons are intentionally free-form (they name the
+            # incident, not an event kind) — only record() is vocabulary-
+            # checked here
+            if not (r and r[0] == "func" and r[1] == "obs.flight.record"):
+                continue
+            if not node.args:
+                continue
+            line = node.lineno
+            if mod.suppressed("flight-discipline", line):
+                continue
+            kind = node.args[0]
+            if isinstance(kind, ast.Constant):
+                findings.append(Finding(
+                    "flight-discipline", mod.relpath, line,
+                    f"record() called with a literal event kind "
+                    f"{kind.value!r}: use a registered EV_* constant from "
+                    f"obs.flight"))
+            elif isinstance(kind, (ast.Name, ast.Attribute)):
+                term = kind.id if isinstance(kind, ast.Name) else kind.attr
+                if kinds and term not in kinds:
+                    findings.append(Finding(
+                        "flight-discipline", mod.relpath, line,
+                        f"record() event kind {term!r} is not a registered "
+                        f"obs.flight EV_* constant"))
+    return findings

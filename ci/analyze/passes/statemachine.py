@@ -1,0 +1,423 @@
+"""Pass 9: state-machine — declared transition tables + paired events.
+
+The cluster's correctness now rests on a handful of small state machines:
+the lease lifecycle (queued/leased/done, exactly-once completion), the
+executor-worker health ladder (starting/alive/dead), the degradation
+ladder, and the request terminal states.  Each is declared next to its
+states as a transition table bound to the field it governs::
+
+    # state-machine: lease field=state
+    _LEASE_TRANSITIONS = {
+        _QUEUED: (_LEASED, _DONE),
+        _LEASED: (_QUEUED, _DONE),
+        _DONE: (),
+    }
+
+and every assignment to that field in the declaring module must then be
+one of:
+
+- an ``__init__`` write of a declared state (the initial state);
+- a write whose target state is declared AND whose from-state is
+  established by an enclosing ``if <x>.field == STATE:`` guard — the
+  (from, to) pair must be a declared edge;
+- a write carrying a ``# transition: <machine> <from>-><to>`` annotation
+  (``|`` joins alternatives, ``*`` means every other declared state);
+  every (from, to) pair in the annotation's cross product must be a
+  declared edge — an annotation is the author *asserting* the runtime
+  from-state, and the table saying the move is legal;
+- a suppression with a rationale (the escape hatch for genuinely dynamic
+  sites, e.g. the ladder's ``level +- 1`` arithmetic).
+
+Anything else — an undeclared target state, an undeclared edge, a bare
+unguarded write — is a finding.  Exhaustiveness: every state reachable in
+the table must have its own row (terminals declare an empty tuple), so
+adding a state without deciding its outgoing edges fails the gate.
+
+The same pass balances PAIRED flight events: ``EVENT_PAIRS`` in
+``obs/flight.py`` declares enter/exit kinds (spill begin/end,
+blocked/woken, degrade enter/exit, lease grant/done); a module that emits
+one side of a pair and never the other has drifted exactly the way the
+round-10 ``blocked_frac`` heartbeat did — flagged at the emitting line.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding, carrying_matches
+from ..project import Config, ModuleInfo, Project
+from ..registry import rule
+
+_DECL_RE = re.compile(
+    r"#\s*state-machine:\s*([\w-]+)\s+field=([A-Za-z_]\w*)")
+_TRANS_RE = re.compile(
+    r"#\s*transition:\s*([\w-]+)\s+(\S+)\s*->\s*(\S+)")
+
+
+class _Machine:
+    __slots__ = ("name", "field", "mod", "line", "states", "edges")
+
+    def __init__(self, name: str, field: str, mod: ModuleInfo, line: int):
+        self.name = name
+        self.field = field
+        self.mod = mod
+        self.line = line
+        self.states: Set[object] = set()
+        self.edges: Set[Tuple[object, object]] = set()
+
+
+def _fmt(state) -> str:
+    return repr(state)
+
+
+def load_machines(project: Project, config: Config
+                  ) -> Tuple[List[_Machine], List[Finding]]:
+    machines: List[_Machine] = []
+    findings: List[Finding] = []
+    for modid, mod in project.modules.items():
+        for node in mod.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            line = node.lineno
+            text = mod.lines[line - 1] if line <= len(mod.lines) else ""
+            m = _DECL_RE.search(text)
+            if m is None and line >= 2:  # marker on the line above
+                m = _DECL_RE.search(mod.lines[line - 2])
+            if m is None:
+                continue
+            name, field = m.group(1), m.group(2)
+            if not isinstance(node.value, ast.Dict):
+                findings.append(Finding(
+                    "state-machine", mod.relpath, line,
+                    f"state-machine {name!r} declaration must be a dict "
+                    f"literal {{state: (targets...)}}"))
+                continue
+            mach = _Machine(name, field, mod, line)
+            ok = True
+            rows: List[Tuple[object, List[object]]] = []
+            for kexpr, vexpr in zip(node.value.keys, node.value.values):
+                kc = (project.constant_of(mod, kexpr)
+                      if kexpr is not None else None)
+                if kc is None:
+                    findings.append(Finding(
+                        "state-machine", mod.relpath, line,
+                        f"state-machine {name!r}: a table key does not "
+                        f"resolve to a str/int state constant"))
+                    ok = False
+                    continue
+                if not isinstance(vexpr, (ast.Tuple, ast.List)):
+                    findings.append(Finding(
+                        "state-machine", mod.relpath, line,
+                        f"state-machine {name!r}: row for {_fmt(kc[1])} "
+                        f"must be a tuple of target states (empty for a "
+                        f"terminal state)"))
+                    ok = False
+                    continue
+                targets = []
+                for e in vexpr.elts:
+                    ec = project.constant_of(mod, e)
+                    if ec is None:
+                        findings.append(Finding(
+                            "state-machine", mod.relpath, line,
+                            f"state-machine {name!r}: a target in the "
+                            f"{_fmt(kc[1])} row does not resolve to a "
+                            f"state constant"))
+                        ok = False
+                        continue
+                    targets.append(ec[1])
+                rows.append((kc[1], targets))
+            for state, targets in rows:
+                mach.states.add(state)
+                for t in targets:
+                    mach.edges.add((state, t))
+            # exhaustiveness: every state reachable as a target must have
+            # its own declared row (terminals: an explicit empty tuple)
+            declared = {s for s, _t in rows}
+            for state, targets in rows:
+                for t in targets:
+                    if t not in declared:
+                        findings.append(Finding(
+                            "state-machine", mod.relpath, line,
+                            f"state-machine {name!r}: target state "
+                            f"{_fmt(t)} has no row of its own — declare "
+                            f"its outgoing edges (or an empty tuple for "
+                            f"a terminal)"))
+                        ok = False
+            if ok:
+                machines.append(mach)
+    return machines, findings
+
+
+def _parse_spec(spec: str, mach: _Machine) -> Optional[Set[object]]:
+    """'a|b' / '*' -> set of state values (matching by str(value))."""
+    if spec == "*":
+        return set(mach.states)
+    out: Set[object] = set()
+    by_str = {str(s): s for s in mach.states}
+    for part in spec.split("|"):
+        if part not in by_str:
+            return None
+        out.add(by_str[part])
+    return out
+
+
+class _SiteChecker:
+    """Walk one module's statements, tracking ``if x.field == STATE``
+    guards, and check every write to a machine-bound field."""
+
+    def __init__(self, project: Project, mod: ModuleInfo,
+                 machines: Dict[str, _Machine]):
+        self.project = project
+        self.mod = mod
+        self.machines = machines  # field -> machine
+        self.findings: List[Finding] = []
+        # `# transition:` annotations use the shared carrying-comment
+        # grammar (core.carrying_matches): a comment-only annotation line
+        # carries to the next code line, so a multi-line rationale works
+        self._annotations = carrying_matches(mod.lines, _TRANS_RE)
+
+    def run(self) -> None:
+        self._walk(self.mod.tree.body, {}, in_init=False)
+
+    # -- statement walking --------------------------------------------------
+    def _walk(self, stmts, ctx: Dict[tuple, object],
+              in_init: bool) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk(stmt.body, {}, stmt.name == "__init__")
+            elif isinstance(stmt, ast.ClassDef):
+                self._walk(stmt.body, {}, False)
+            elif isinstance(stmt, ast.If):
+                inferred = self._guards_of(stmt.test)
+                body_ctx = dict(ctx)
+                body_ctx.update(inferred)
+                self._walk(stmt.body, body_ctx, in_init)
+                self._walk(stmt.orelse, ctx, in_init)
+            elif isinstance(stmt, (ast.While, ast.For)):
+                self._walk(stmt.body, dict(ctx), in_init)
+                self._walk(stmt.orelse, dict(ctx), in_init)
+            elif isinstance(stmt, ast.Try):
+                self._walk(stmt.body, dict(ctx), in_init)
+                for h in stmt.handlers:
+                    self._walk(h.body, dict(ctx), in_init)
+                self._walk(stmt.orelse, dict(ctx), in_init)
+                self._walk(stmt.finalbody, dict(ctx), in_init)
+            elif isinstance(stmt, ast.With):
+                self._walk(stmt.body, ctx, in_init)
+            elif isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    self._check_target(t, stmt.value, stmt, ctx, in_init)
+            elif isinstance(stmt, ast.AugAssign):
+                self._check_target(stmt.target, None, stmt, ctx, in_init)
+
+    def _guards_of(self, test) -> Dict[tuple, object]:
+        """(receiver, field) -> state from ``x.field == STATE``
+        (and-joined) guards.  Keyed by the RECEIVER expression too: a
+        guard on one object must not license a write on another."""
+        out: Dict[tuple, object] = {}
+        tests = test.values if isinstance(test, ast.BoolOp) and isinstance(
+            test.op, ast.And) else [test]
+        for t in tests:
+            if not (isinstance(t, ast.Compare) and len(t.ops) == 1
+                    and isinstance(t.ops[0], ast.Eq)
+                    and isinstance(t.left, ast.Attribute)
+                    and t.left.attr in self.machines):
+                continue
+            c = self.project.constant_of(self.mod, t.comparators[0])
+            if c is not None:
+                out[(ast.unparse(t.left.value), t.left.attr)] = c[1]
+        return out
+
+    # -- one write site -----------------------------------------------------
+    def _check_target(self, target, value, stmt,
+                      ctx: Dict[tuple, object], in_init: bool) -> None:
+        if not (isinstance(target, ast.Attribute)
+                and target.attr in self.machines):
+            return
+        line = stmt.lineno
+        mach = self.machines[target.attr]
+        if self.mod.suppressed("state-machine", line):
+            return
+        to_state = None
+        if value is not None:
+            c = self.project.constant_of(self.mod, value)
+            if c is not None:
+                to_state = c[1]
+                if to_state not in mach.states:
+                    self.findings.append(Finding(
+                        "state-machine", self.mod.relpath, line,
+                        f"{mach.name}.{mach.field} assigned undeclared "
+                        f"state {_fmt(to_state)} (declared: "
+                        f"{', '.join(sorted(map(_fmt, mach.states)))})"))
+                    return
+        key = (ast.unparse(target.value), mach.field)
+        ann = self._annotation(line, getattr(stmt, "end_lineno", line),
+                               mach)
+        if ann == "bad":
+            return  # already reported
+        if ann is not None:
+            if to_state is not None:
+                ctx[key] = to_state  # the write consumes any prior guard
+            froms, tos = ann
+            if to_state is not None and to_state not in tos:
+                self.findings.append(Finding(
+                    "state-machine", self.mod.relpath, line,
+                    f"{mach.name}: site assigns {_fmt(to_state)} but its "
+                    f"transition annotation allows only "
+                    f"{', '.join(sorted(map(_fmt, tos)))}"))
+                return
+            targets = {to_state} if to_state is not None else tos
+            for f_ in sorted(froms, key=str):
+                for t_ in sorted(targets, key=str):
+                    if f_ == t_:
+                        continue
+                    if (f_, t_) not in mach.edges:
+                        self.findings.append(Finding(
+                            "state-machine", self.mod.relpath, line,
+                            f"{mach.name}: transition {_fmt(f_)} -> "
+                            f"{_fmt(t_)} is not a declared edge"))
+            return
+        if in_init:
+            if to_state is None:
+                self.findings.append(Finding(
+                    "state-machine", self.mod.relpath, line,
+                    f"{mach.name}.{mach.field} initialized to a value "
+                    f"that does not resolve to a declared state"))
+            return
+        from_state = ctx.get(key)
+        # this write consumes the guard for THIS receiver: a second
+        # write in the same block starts from the new state, not the
+        # originally guarded one
+        if to_state is not None:
+            ctx[key] = to_state
+        else:
+            ctx.pop(key, None)
+        if from_state is None or to_state is None:
+            self.findings.append(Finding(
+                "state-machine", self.mod.relpath, line,
+                f"{mach.name}.{mach.field} write cannot establish its "
+                f"transition: guard on `.{mach.field} == <state>` or "
+                f"annotate `# transition: {mach.name} <from>-><to>`"))
+            return
+        if from_state != to_state and (from_state, to_state) \
+                not in mach.edges:
+            self.findings.append(Finding(
+                "state-machine", self.mod.relpath, line,
+                f"{mach.name}: transition {_fmt(from_state)} -> "
+                f"{_fmt(to_state)} is not a declared edge"))
+
+    def _annotation(self, line: int, end_line: int, mach: _Machine):
+        """The annotation anywhere in the statement's line span — a
+        wrapped transition site may carry it on a continuation line."""
+        m = next((self._annotations[i]
+                  for i in range(line, end_line + 1)
+                  if i in self._annotations), None)
+        if m is None:
+            return None
+        if m.group(1) != mach.name:
+            self.findings.append(Finding(
+                "state-machine", self.mod.relpath, line,
+                f"transition annotation names machine {m.group(1)!r} but "
+                f"this field belongs to {mach.name!r}"))
+            return "bad"
+        froms = _parse_spec(m.group(2), mach)
+        tos = _parse_spec(m.group(3), mach)
+        if froms is None or tos is None:
+            self.findings.append(Finding(
+                "state-machine", self.mod.relpath, line,
+                f"transition annotation on {mach.name!r} names an "
+                f"undeclared state (declared: "
+                f"{', '.join(sorted(map(_fmt, mach.states)))})"))
+            return "bad"
+        return froms, tos
+
+
+# --------------------------------------------------------------------------
+# paired events
+# --------------------------------------------------------------------------
+
+
+def load_event_pairs(project: Project, config: Config
+                     ) -> List[Tuple[str, str]]:
+    """``EVENT_PAIRS`` constant-name pairs from obs/flight.py."""
+    mod = project.modules.get(config.flight_module)
+    if mod is None:
+        return []
+    pairs: List[Tuple[str, str]] = []
+    for node in mod.tree.body:
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "EVENT_PAIRS"
+                        for t in node.targets)
+                and isinstance(node.value, (ast.Tuple, ast.List))):
+            for e in node.value.elts:
+                if (isinstance(e, (ast.Tuple, ast.List))
+                        and len(e.elts) == 2
+                        and all(isinstance(x, ast.Name) for x in e.elts)):
+                    pairs.append((e.elts[0].id, e.elts[1].id))
+    return pairs
+
+
+def check_event_pairs(project: Project, config: Config) -> List[Finding]:
+    pairs = load_event_pairs(project, config)
+    if not pairs:
+        return []
+    findings: List[Finding] = []
+    for modid, mod in project.modules.items():
+        if modid in config.flight_exclude:
+            continue
+        emitted: Dict[str, int] = {}  # EV name -> first emission line
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            r = project.resolve(mod, node.func)
+            if not (r and r[0] == "func" and r[1] == "obs.flight.record"):
+                continue
+            kind = node.args[0]
+            term = (kind.id if isinstance(kind, ast.Name)
+                    else kind.attr if isinstance(kind, ast.Attribute)
+                    else None)
+            if term is not None and term not in emitted:
+                emitted[term] = node.lineno
+        for a, b in pairs:
+            for present, missing in ((a, b), (b, a)):
+                if present in emitted and missing not in emitted:
+                    line = emitted[present]
+                    if mod.suppressed("state-machine", line):
+                        continue
+                    findings.append(Finding(
+                        "state-machine", mod.relpath, line,
+                        f"module emits {present} but never its paired "
+                        f"{missing} (EVENT_PAIRS): one side of the "
+                        f"protocol has drifted"))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# the rule
+# --------------------------------------------------------------------------
+
+
+@rule("state-machine",
+      "transition sites must match the declared state-machine tables; "
+      "paired flight events must be emitted on balanced paths")
+def check_state_machines(project: Project, config: Config) -> List[Finding]:
+    machines, findings = load_machines(project, config)
+    by_module: Dict[str, Dict[str, _Machine]] = {}
+    for mach in machines:
+        slot = by_module.setdefault(mach.mod.modid, {})
+        if mach.field in slot:
+            findings.append(Finding(
+                "state-machine", mach.mod.relpath, mach.line,
+                f"machines {slot[mach.field].name!r} and {mach.name!r} "
+                f"both bind field {mach.field!r} in this module: sites "
+                f"would be ambiguous — rename one field"))
+            continue
+        slot[mach.field] = mach
+    for modid, machs in by_module.items():
+        checker = _SiteChecker(project, project.modules[modid], machs)
+        checker.run()
+        findings.extend(checker.findings)
+    findings.extend(check_event_pairs(project, config))
+    return findings

@@ -1,0 +1,408 @@
+"""Pass 8: wire-protocol — message schema + frozen flight wire ids.
+
+The round-10 review found a one-sided protocol drift (workers never sent
+the ``blocked_frac`` gauge the supervisor's ladder read): the tuple
+protocol between ``serve/supervisor.py`` and ``serve/rpc.py`` had no
+declared schema, so each side could drift alone.  This pass checks every
+construct and destructure site on BOTH sides of the pipe against one
+declared registry in ``serve/rpc.py``::
+
+    MESSAGE_FIELDS = {
+        MSG_DISPATCH: ("rid", "handler", "payload", "deadline_rel_s",
+                       "priority"),
+        ...
+    }
+
+- a tuple literal whose first element is a registered tag constant must
+  carry exactly ``1 + len(fields)`` elements;
+- inside an ``if tag == MSG_X:`` branch (``tag`` bound from ``msg[0]``),
+  a tuple-unpack of the message must match the declared arity AND the
+  declared field names positionally (``_``-prefixed names mean
+  "deliberately ignored");
+- indexed reads ``msg[i]`` in such a branch must stay within the declared
+  arity.
+
+Checked modules: ``Config.wire_scope`` inside the package plus
+``Config.wire_extra_files`` (loose files like tests/cluster_worker.py
+that speak the protocol from outside the package).
+
+The same pass freezes the flight-recorder EVENT WIRE IDS: v2 SRTP STATE
+records and every committed capture identify event kinds by their index
+in ``obs/flight.py``'s ``EVENT_KINDS`` tuple.  Those indexes are written
+once into ``ci/flight_wire_ids.json`` and enforced append-only here —
+reordering, mutating, or deleting an id is a finding, so the stability
+that one vocabulary-pin test used to carry is machine-checked against a
+committed artifact (``--update-wire-ids`` appends new kinds and refuses
+anything else).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from ..core import Finding
+from ..project import Config, ModuleInfo, Project, module_constants
+from ..registry import rule
+
+WIRE_IDS_SCHEMA = "flight-wire-ids-v1"
+
+
+# --------------------------------------------------------------------------
+# the declared message registry
+# --------------------------------------------------------------------------
+
+
+def load_message_registry(project: Project, config: Config
+                          ) -> Tuple[Dict[str, tuple], List[Finding]]:
+    """``MESSAGE_FIELDS`` from the registry module ->
+    {tag_value: (tag_name, (field, ...))}; malformed entries are
+    findings."""
+    registry: Dict[str, tuple] = {}
+    findings: List[Finding] = []
+    mod = project.modules.get(config.wire_registry_module)
+    if mod is None:
+        return registry, findings
+    for node in mod.tree.body:
+        if not (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "MESSAGE_FIELDS"
+                        for t in node.targets)
+                and isinstance(node.value, ast.Dict)):
+            continue
+        for kexpr, vexpr in zip(node.value.keys, node.value.values):
+            kc = project.constant_of(mod, kexpr) if kexpr is not None else None
+            if kc is None or not isinstance(kc[1], str):
+                findings.append(Finding(
+                    "wire-protocol", mod.relpath, node.lineno,
+                    "MESSAGE_FIELDS key does not resolve to a string tag "
+                    "constant"))
+                continue
+            if not isinstance(vexpr, (ast.Tuple, ast.List)) or not all(
+                    isinstance(e, ast.Constant) and isinstance(e.value, str)
+                    for e in vexpr.elts):
+                findings.append(Finding(
+                    "wire-protocol", mod.relpath, node.lineno,
+                    f"MESSAGE_FIELDS entry for {kc[0] or kc[1]!r} must be "
+                    f"a tuple of field-name strings"))
+                continue
+            registry[kc[1]] = (kc[0] or repr(kc[1]),
+                               tuple(e.value for e in vexpr.elts))
+    return registry, findings
+
+
+# --------------------------------------------------------------------------
+# site checking
+# --------------------------------------------------------------------------
+
+
+class _WireChecker:
+    def __init__(self, project: Project, registry: Dict[str, tuple]):
+        self.project = project
+        self.registry = registry
+        self.findings: List[Finding] = []
+
+    def _tag_of(self, mod: ModuleInfo, expr) -> Optional[str]:
+        c = self.project.constant_of(mod, expr)
+        if c is not None and c[1] in self.registry:
+            return c[1]
+        return None
+
+    def check_module(self, mod: ModuleInfo) -> None:
+        # construct sites: any tuple literal led by a registered tag
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Tuple) or not node.elts:
+                continue
+            tag = self._tag_of(mod, node.elts[0])
+            if tag is None:
+                continue
+            if mod.suppressed("wire-protocol", node.lineno):
+                continue
+            tag_name, fields = self.registry[tag]
+            got = len(node.elts) - 1
+            if got != len(fields):
+                self.findings.append(Finding(
+                    "wire-protocol", mod.relpath, node.lineno,
+                    f"{tag_name} message constructed with {got} fields; "
+                    f"registry declares {len(fields)} "
+                    f"({', '.join(fields)})"))
+        # destructure sites: walk each function body tracking tag guards
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk_stmts(mod, node.body, {}, None)
+
+    def _walk_stmts(self, mod: ModuleInfo, stmts, tagvars: Dict[str, str],
+                    active: Optional[tuple]) -> None:
+        """``tagvars``: name -> message-variable it was ``msg[0]``-bound
+        from; ``active``: (tag_value, msgvar) inside an ``if tag ==`` arm
+        or after an early-exit ``if tag != MSG_X: continue`` guard."""
+        for stmt in stmts:
+            if isinstance(stmt, ast.Assign):
+                v = stmt.value
+                if (isinstance(v, ast.Subscript)
+                        and isinstance(v.value, ast.Name)
+                        and isinstance(v.slice, ast.Constant)
+                        and v.slice.value == 0):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            tagvars[t.id] = v.value.id
+                if active is not None:
+                    self._check_unpack(mod, stmt, active)
+                self._check_subscripts(mod, stmt, active)
+            elif isinstance(stmt, ast.If):
+                # the test itself runs under the OUTER context (an
+                # out-of-arity msg[i] in a condition is still a read)
+                self._check_subscripts(mod, stmt.test, active)
+                arm = self._tag_test(mod, stmt.test, tagvars)
+                self._walk_stmts(mod, stmt.body, tagvars,
+                                 arm if arm is not None else active)
+                self._walk_stmts(mod, stmt.orelse, tagvars, active)
+                # `if tag != MSG_X: continue` (or return/break/raise):
+                # the rest of THIS statement list runs only for MSG_X
+                arm = self._tag_test(mod, stmt.test, tagvars, neq=True)
+                if (arm is not None and stmt.body and not stmt.orelse
+                        and isinstance(stmt.body[-1],
+                                       (ast.Continue, ast.Return,
+                                        ast.Break, ast.Raise))):
+                    active = arm
+            elif isinstance(stmt, (ast.While, ast.For)):
+                self._check_subscripts(
+                    mod, stmt.test if isinstance(stmt, ast.While)
+                    else stmt.iter, active)
+                self._walk_stmts(mod, stmt.body, tagvars, active)
+                self._walk_stmts(mod, stmt.orelse, tagvars, active)
+            elif isinstance(stmt, ast.Try):
+                self._walk_stmts(mod, stmt.body, tagvars, active)
+                for h in stmt.handlers:
+                    self._walk_stmts(mod, h.body, tagvars, active)
+                self._walk_stmts(mod, stmt.orelse, tagvars, active)
+                self._walk_stmts(mod, stmt.finalbody, tagvars, active)
+            elif isinstance(stmt, ast.With):
+                for item in stmt.items:
+                    self._check_subscripts(mod, item.context_expr, active)
+                self._walk_stmts(mod, stmt.body, tagvars, active)
+            else:
+                self._check_subscripts(mod, stmt, active)
+
+    def _tag_test(self, mod: ModuleInfo, test,
+                  tagvars: Dict[str, str], neq: bool = False
+                  ) -> Optional[tuple]:
+        op = ast.NotEq if neq else ast.Eq
+        if (isinstance(test, ast.Compare) and len(test.ops) == 1
+                and isinstance(test.ops[0], op)
+                and isinstance(test.left, ast.Name)
+                and test.left.id in tagvars):
+            tag = self._tag_of(mod, test.comparators[0])
+            if tag is not None:
+                return (tag, tagvars[test.left.id])
+        return None
+
+    def _check_unpack(self, mod: ModuleInfo, stmt: ast.Assign,
+                      active: tuple) -> None:
+        tag, msgvar = active
+        tag_name, fields = self.registry[tag]
+        for t in stmt.targets:
+            if not (isinstance(t, (ast.Tuple, ast.List))
+                    and isinstance(stmt.value, ast.Name)
+                    and stmt.value.id == msgvar):
+                continue
+            if mod.suppressed("wire-protocol", stmt.lineno):
+                continue
+            if len(t.elts) != 1 + len(fields):
+                self.findings.append(Finding(
+                    "wire-protocol", mod.relpath, stmt.lineno,
+                    f"{tag_name} message unpacked into "
+                    f"{len(t.elts) - 1} fields; registry declares "
+                    f"{len(fields)} ({', '.join(fields)})"))
+                continue
+            for i, elt in enumerate(t.elts[1:]):
+                if not isinstance(elt, ast.Name):
+                    continue
+                name = elt.id
+                if name == "_" or name.startswith("_"):
+                    continue  # deliberately ignored field
+                if name != fields[i]:
+                    self.findings.append(Finding(
+                        "wire-protocol", mod.relpath, stmt.lineno,
+                        f"{tag_name} field {i} unpacked as {name!r}; "
+                        f"registry declares {fields[i]!r} (rename or fix "
+                        f"the registry on both sides)"))
+
+    def _check_subscripts(self, mod: ModuleInfo, stmt,
+                          active: Optional[tuple]) -> None:
+        if active is None:
+            return
+        tag, msgvar = active
+        tag_name, fields = self.registry[tag]
+        for node in ast.walk(stmt):
+            if (isinstance(node, ast.Subscript)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == msgvar
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, int)):
+                idx = node.slice.value
+                if idx > len(fields) and not mod.suppressed(
+                        "wire-protocol", node.lineno):
+                    self.findings.append(Finding(
+                        "wire-protocol", mod.relpath, node.lineno,
+                        f"{tag_name} message indexed at [{idx}] but the "
+                        f"registry declares only {len(fields)} fields "
+                        f"after the tag"))
+
+
+def _extra_file_module(project: Project, relpath: str
+                       ) -> Optional[ModuleInfo]:
+    """Parse a loose (non-package) file into a ModuleInfo shim wired into
+    the project's import resolution — NOT registered in project.modules,
+    so no other pass sees it."""
+    path = os.path.join(project.root, relpath)
+    if not os.path.exists(path):
+        return None
+    try:
+        mod = ModuleInfo("", f"<extra:{relpath}>", path, relpath)
+    except SyntaxError:
+        return None  # pass 0 (parse) covers package files; skip loose ones
+    project._index_imports(mod)
+    return mod
+
+
+# --------------------------------------------------------------------------
+# frozen flight wire ids
+# --------------------------------------------------------------------------
+
+
+def load_event_kind_order(project: Project, config: Config
+                          ) -> Tuple[Optional[ModuleInfo], List[str],
+                                     Dict[str, int]]:
+    """(flight module, EVENT_KINDS values in order, EV_* consts line map)."""
+    mod = project.modules.get(config.flight_module)
+    if mod is None:
+        return None, [], {}
+    consts = module_constants(mod)
+    ev_lines: Dict[str, int] = {}
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id.startswith("EV_"):
+                    ev_lines[t.id] = node.lineno
+    order: List[str] = []
+    for node in mod.tree.body:
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "EVENT_KINDS"
+                        for t in node.targets)
+                and isinstance(node.value, (ast.Tuple, ast.List))):
+            for e in node.value.elts:
+                if isinstance(e, ast.Name) and e.id in consts:
+                    order.append(str(consts[e.id]))
+                elif isinstance(e, ast.Constant):
+                    order.append(str(e.value))
+    return mod, order, ev_lines
+
+
+def check_wire_ids(project: Project, config: Config) -> List[Finding]:
+    mod, order, ev_lines = load_event_kind_order(project, config)
+    if mod is None or not order:
+        return []  # no flight vocabulary in this tree (fixture packages)
+    findings: List[Finding] = []
+    consts = module_constants(mod)
+    for name, line in ev_lines.items():
+        val = consts.get(name)
+        if isinstance(val, str) and val not in order:
+            findings.append(Finding(
+                "wire-protocol", mod.relpath, line,
+                f"event kind constant {name} is not in EVENT_KINDS: it "
+                f"has no wire id and would fail KIND_IDS at record time"))
+    reg_rel = config.flight_wire_ids_path
+    reg_path = os.path.join(project.root, reg_rel)
+    if not os.path.exists(reg_path):
+        findings.append(Finding(
+            "wire-protocol", reg_rel, 1,
+            "flight wire-id registry missing: run `python ci/analyze "
+            "--update-wire-ids` and commit it"))
+        return findings
+    try:
+        with open(reg_path) as f:
+            reg = json.load(f)
+        ids = dict(reg.get("ids", {}))
+    except (OSError, ValueError):
+        findings.append(Finding(
+            "wire-protocol", reg_rel, 1,
+            "flight wire-id registry is unreadable or not JSON"))
+        return findings
+    for i, kind in enumerate(order):
+        frozen = ids.pop(kind, None)
+        if frozen is None:
+            findings.append(Finding(
+                "wire-protocol", reg_rel, 1,
+                f"event kind {kind!r} (wire id {i}) is not frozen in the "
+                f"registry: run `python ci/analyze --update-wire-ids`"))
+        elif frozen != i:
+            findings.append(Finding(
+                "wire-protocol", reg_rel, 1,
+                f"event kind {kind!r} has wire id {i} in EVENT_KINDS but "
+                f"{frozen} in the committed registry: EVENT_KINDS is "
+                f"append-only (never reorder, never insert mid-tuple)"))
+    for kind, frozen in sorted(ids.items()):
+        findings.append(Finding(
+            "wire-protocol", reg_rel, 1,
+            f"registry freezes {kind!r} as wire id {frozen} but "
+            f"EVENT_KINDS no longer contains it: kinds must never be "
+            f"removed (old captures reference the id)"))
+    return findings
+
+
+def update_wire_ids(root: str, config: Config) -> int:
+    """``--update-wire-ids``: append new kinds; refuse any other change."""
+    project = Project(root, config)
+    _mod, order, _lines = load_event_kind_order(project, config)
+    if not order:
+        print("analyze: no EVENT_KINDS found; nothing to freeze")
+        return 1
+    reg_path = os.path.join(root, config.flight_wire_ids_path)
+    old: Dict[str, int] = {}
+    if os.path.exists(reg_path):
+        with open(reg_path) as f:
+            old = dict(json.load(f).get("ids", {}))
+    new = {kind: i for i, kind in enumerate(order)}
+    for kind, frozen in old.items():
+        if new.get(kind) != frozen:
+            print(f"analyze: REFUSING to update wire ids: {kind!r} is "
+                  f"frozen as {frozen} but EVENT_KINDS says "
+                  f"{new.get(kind)} — the registry is append-only")
+            return 1
+    with open(reg_path, "w") as f:
+        json.dump({"schema": WIRE_IDS_SCHEMA, "ids": new}, f, indent=1,
+                  sort_keys=True)
+        f.write("\n")
+    added = sorted(set(new) - set(old), key=new.get)
+    print(f"analyze: wire-id registry updated "
+          f"({len(new)} kinds, {len(added)} appended: "
+          f"{', '.join(added) if added else 'none'}) -> "
+          f"{os.path.relpath(reg_path, root)}")
+    return 0
+
+
+# --------------------------------------------------------------------------
+# the rule
+# --------------------------------------------------------------------------
+
+
+@rule("wire-protocol",
+      "RPC tuple messages must match the declared MESSAGE_FIELDS schema "
+      "on both sides; flight event wire ids are frozen append-only")
+def check_wire_protocol(project: Project, config: Config) -> List[Finding]:
+    registry, findings = load_message_registry(project, config)
+    if registry:
+        checker = _WireChecker(project, registry)
+        for modid in config.wire_scope:
+            mod = project.modules.get(modid)
+            if mod is not None:
+                checker.check_module(mod)
+        for rel in config.wire_extra_files:
+            mod = _extra_file_module(project, rel)
+            if mod is not None:
+                checker.check_module(mod)
+        findings.extend(checker.findings)
+    findings.extend(check_wire_ids(project, config))
+    return findings

@@ -1,0 +1,568 @@
+"""Project model: parsed packages + cross-module name resolution.
+
+One :class:`Project` is built per run (or per fixture root in tests):
+package discovery, per-module ASTs with suppression tables, class/method
+indexes, attribute-type inference, import following, and the registered-
+callback map the lock passes resolve stored-callable calls through.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, _parse_suppressions
+
+__all__ = [
+    "Config", "ModuleInfo", "ClassInfo", "Project",
+    "CONTROL_EXCEPTIONS", "CONTROL_ROOTS", "CONTROL_ALIASES", "BROAD_NAMES",
+    "ALLOC_ATTRS", "LOCK_CTORS",
+    "_in_scope", "_self_name", "_lock_ctor_kind", "_func_defs",
+    "module_constants", "package_files",
+]
+
+# --------------------------------------------------------------------------
+# configuration
+# --------------------------------------------------------------------------
+
+CONTROL_EXCEPTIONS = frozenset({
+    "RetryOOM", "SplitAndRetryOOM", "GpuRetryOOM", "GpuSplitAndRetryOOM",
+    "CpuRetryOOM", "CpuSplitAndRetryOOM", "ShuffleCapacityExceeded",
+})
+# the roots a broad handler's TRY must cover explicitly to be exempt
+CONTROL_ROOTS = frozenset({"RetryOOM", "SplitAndRetryOOM",
+                           "ShuffleCapacityExceeded"})
+# a name (e.g. a module-level tuple constant) treated as covering all roots
+CONTROL_ALIASES = frozenset({"CONTROL_FLOW_EXCEPTIONS"})
+BROAD_NAMES = frozenset({"Exception", "BaseException", "MemoryError"})
+
+ALLOC_ATTRS = frozenset({"zeros", "ones", "empty", "full", "zeros_like",
+                         "ones_like", "empty_like", "full_like"})
+LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "cond"}
+
+
+@dataclasses.dataclass
+class Config:
+    lock_scope: Tuple[str, ...] = ("mem.", "mem", "serve.", "serve")
+    state_scope: Tuple[str, ...] = ("mem.", "mem", "serve.", "serve")
+    governed_scope: Tuple[str, ...] = ("ops.", "ops", "models.", "models",
+                                       "serve.", "serve", "plans.", "plans")
+    seam_exclude: Tuple[str, ...] = ("obs.seam",)
+    governed_drivers: Tuple[str, ...] = ("attempt_once",
+                                         "run_with_split_retry", "_attempt")
+    handler_classes: Tuple[str, ...] = ("QueryHandler",)
+    reservation_funcs: Tuple[str, ...] = ("reservation",)
+    emitter_decorators: Tuple[str, ...] = ("emitter",)
+    categories: Optional[Set[str]] = None  # None -> parse obs/seam.py
+    flight_exclude: Tuple[str, ...] = ("obs.flight",)
+    event_kinds: Optional[Set[str]] = None  # None -> parse obs/flight.py
+    # pass 7 (guarded-by): modules whose classes may carry
+    # `# guarded-by: <lock>` attribute annotations
+    guarded_scope: Tuple[str, ...] = ("mem.", "mem", "serve.", "serve",
+                                      "plans.", "plans", "obs.", "obs")
+    # pass 8 (wire-protocol): the module declaring MESSAGE_FIELDS, the
+    # package modules whose construct/destructure sites are checked, and
+    # loose (non-package) files checked the same way
+    wire_registry_module: str = "serve.rpc"
+    wire_scope: Tuple[str, ...] = ("serve.rpc", "serve.supervisor")
+    wire_extra_files: Tuple[str, ...] = ("tests/cluster_worker.py",)
+    # pass 8 (wire ids): the committed flight-event wire-id registry,
+    # repo-root-relative; the module whose EVENT_KINDS order defines ids
+    flight_wire_ids_path: str = "ci/flight_wire_ids.json"
+    flight_module: str = "obs.flight"
+    rules: Optional[Set[str]] = None  # None -> all registered
+
+
+def _in_scope(modid: str, prefixes: Tuple[str, ...]) -> bool:
+    return any(modid == p or modid.startswith(p) for p in prefixes)
+
+
+def package_files(root: str) -> List[Tuple[str, str, str, str]]:
+    """(pkg, modid, path, relpath) for every package .py under ``root``
+    — the ONE walker shared by :meth:`Project._discover` and the
+    findings-cache key (cli.discover_files), so the cache's input set
+    can never diverge from what the analysis actually reads."""
+    out: List[Tuple[str, str, str, str]] = []
+    for entry in sorted(os.listdir(root)):
+        pkg_dir = os.path.join(root, entry)
+        if not os.path.isfile(os.path.join(pkg_dir, "__init__.py")):
+            continue
+        for dirpath, dirnames, filenames in os.walk(pkg_dir):
+            dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+            for fname in sorted(filenames):
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                rel = os.path.relpath(path, pkg_dir)
+                modid = rel[:-3].replace(os.sep, ".")
+                if modid.endswith(".__init__"):
+                    modid = modid[: -len(".__init__")] or "__init__"
+                relpath = os.path.relpath(path, root).replace(os.sep, "/")
+                out.append((entry, modid, path, relpath))
+    return out
+
+
+# --------------------------------------------------------------------------
+# project model
+# --------------------------------------------------------------------------
+
+
+class ModuleInfo:
+    def __init__(self, pkg: str, modid: str, path: str, relpath: str,
+                 tree: Optional[ast.AST] = None,
+                 src: Optional[str] = None):
+        self.pkg = pkg  # package name, e.g. "spark_rapids_jni_tpu"
+        self.modid = modid  # package-relative dotted id, e.g. "mem.governor"
+        self.path = path
+        self.relpath = relpath  # repo-root-relative posix path
+        if src is None:
+            with open(path, "rb") as f:
+                src = f.read().decode("utf-8")
+        self.lines = src.splitlines()
+        # a pre-parsed tree (the content-hash AST cache) skips the parse,
+        # by far the hottest part of building a Project
+        self.tree = tree if tree is not None else ast.parse(
+            src, filename=path)
+        self.line_suppr, self.file_suppr = _parse_suppressions(self.lines)
+        # localname -> ("mod", modid) | ("obj", modid, name)
+        self.imports: Dict[str, tuple] = {}
+        # top-level defs
+        self.classes: Dict[str, "ClassInfo"] = {}
+        self.functions: Dict[str, ast.AST] = {}  # qualname -> node
+        self.module_locks: Dict[str, str] = {}  # var -> kind
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_suppr or "*" in self.file_suppr:
+            return True
+        rules = self.line_suppr.get(line, ())
+        return rule in rules or "*" in rules
+
+
+class ClassInfo:
+    def __init__(self, module: ModuleInfo, node: ast.ClassDef):
+        self.module = module
+        self.node = node
+        self.name = node.name
+        self.key = f"{module.modid}.{node.name}"
+        self.methods: Dict[str, ast.AST] = {}
+        self.lock_attrs: Dict[str, str] = {}  # attr -> kind
+        self.attr_types: Dict[str, str] = {}  # attr -> class key
+        # attr -> lock attr name (pass 7 `# guarded-by: <lock>` annotations)
+        self.guarded_attrs: Dict[str, str] = {}
+        # funckeys passed as arguments to this class's ctor/methods anywhere
+        self.callback_targets: Set[str] = set()
+
+
+class Project:
+    """Parsed package(s) + cross-module name resolution."""
+
+    def __init__(self, root: str, config: Config, ast_cache=None):
+        self.root = root
+        self.config = config
+        self.ast_cache = ast_cache  # optional cache.AstCache
+        self.modules: Dict[str, ModuleInfo] = {}  # modid -> info
+        self.classes: Dict[str, ClassInfo] = {}  # "mod.Class" -> info
+        # "mod.qualname" -> (module, node); includes methods and nested defs
+        self.functions: Dict[str, Tuple[ModuleInfo, ast.AST]] = {}
+        self.packages: List[str] = []
+        self.errors: List[Finding] = []
+        self._discover()
+        self._index()
+
+    # -- discovery ---------------------------------------------------------
+    def _load_module(self, pkg: str, modid: str, path: str,
+                     relpath: str) -> None:
+        try:
+            if self.ast_cache is not None:
+                src, tree = self.ast_cache.load(path, relpath)
+                self.modules[modid] = ModuleInfo(pkg, modid, path, relpath,
+                                                tree=tree, src=src)
+            else:
+                self.modules[modid] = ModuleInfo(pkg, modid, path, relpath)
+        except SyntaxError as e:
+            self.errors.append(Finding(
+                "parse", relpath, e.lineno or 1,
+                f"syntax error: {e.msg}"))
+
+    def _discover(self) -> None:
+        for pkg, modid, path, relpath in package_files(self.root):
+            if pkg not in self.packages:
+                self.packages.append(pkg)
+            self._load_module(pkg, modid, path, relpath)
+
+    # -- indexing ----------------------------------------------------------
+    def _index(self) -> None:
+        for mod in self.modules.values():
+            self._index_imports(mod)
+        for mod in self.modules.values():
+            self._index_defs(mod)
+        for mod in self.modules.values():
+            self._index_attr_types(mod)
+        self._index_callbacks()
+
+    def _mod_from_dotted(self, mod: ModuleInfo, dotted: str) -> Optional[str]:
+        for pkg in self.packages:
+            if dotted == pkg:
+                return "__init__"
+            if dotted.startswith(pkg + "."):
+                return dotted[len(pkg) + 1:]
+        return None
+
+    def _index_imports(self, mod: ModuleInfo) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    target = self._mod_from_dotted(mod, a.name)
+                    if target is not None:
+                        mod.imports[a.asname or a.name.split(".")[0]] = (
+                            "mod", target)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                dotted = node.module
+                if node.level:  # relative import: resolve against modid
+                    base = mod.modid.split(".")[: -(node.level)]
+                    dotted = ".".join(base + ([dotted] if dotted else []))
+                    target = dotted or "__init__"
+                else:
+                    target = self._mod_from_dotted(mod, dotted)
+                if target is None:
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    # `from pkg.obs import seam` imports a MODULE
+                    sub = f"{target}.{a.name}" if target != "__init__" else a.name
+                    if sub in self.modules:
+                        mod.imports[a.asname or a.name] = ("mod", sub)
+                    else:
+                        mod.imports[a.asname or a.name] = (
+                            "obj", target, a.name)
+
+    def _index_defs(self, mod: ModuleInfo) -> None:
+        def add_func(qual: str, node) -> None:
+            self.functions[f"{mod.modid}.{qual}"] = (mod, node)
+            mod.functions[qual] = node
+
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                add_func(node.name, node)
+            elif isinstance(node, ast.ClassDef):
+                ci = ClassInfo(mod, node)
+                self.classes[ci.key] = ci
+                mod.classes[node.name] = ci
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        ci.methods[item.name] = item
+                        self.functions[f"{ci.key}.{item.name}"] = (mod, item)
+                    elif isinstance(item, ast.Assign):
+                        kind = _lock_ctor_kind(item.value)
+                        for t in item.targets:
+                            if isinstance(t, ast.Name):
+                                if kind:
+                                    ci.lock_attrs[t.id] = kind
+                    elif isinstance(item, ast.AnnAssign) and isinstance(
+                            item.target, ast.Name):
+                        # dataclass-style field annotation -> attr type
+                        tkey = self._ann_to_class(mod, item.annotation)
+                        if tkey:
+                            ci.attr_types[item.target.id] = tkey
+                # method aliases (`shuffle_x = pool_x` at class level) are
+                # rare; resolve Assign from Name of an existing method
+                for item in node.body:
+                    if (isinstance(item, ast.Assign)
+                            and isinstance(item.value, ast.Name)
+                            and item.value.id in ci.methods):
+                        for t in item.targets:
+                            if isinstance(t, ast.Name):
+                                ci.methods[t.id] = ci.methods[item.value.id]
+            elif isinstance(node, ast.Assign):
+                kind = _lock_ctor_kind(node.value)
+                if kind:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            mod.module_locks[t.id] = kind
+
+    def _ann_to_class(self, mod: ModuleInfo, ann) -> Optional[str]:
+        """Annotation expression -> class key (handles Optional[X], "X")."""
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            try:
+                ann = ast.parse(ann.value, mode="eval").body
+            except SyntaxError:
+                return None
+        if isinstance(ann, ast.Subscript):  # Optional[X] / list[X]: use X
+            return self._ann_to_class(mod, ann.slice)
+        if isinstance(ann, (ast.Name, ast.Attribute)):
+            r = self.resolve(mod, ann)
+            if r and r[0] == "class":
+                return r[1]
+        return None
+
+    def _index_attr_types(self, mod: ModuleInfo) -> None:
+        for ci in mod.classes.values():
+            for mname, meth in ci.methods.items():
+                env = self._param_env(mod, ci, meth)
+                for node in ast.walk(meth):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    for t in node.targets:
+                        if not (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == _self_name(meth)):
+                            continue
+                        kind = _lock_ctor_kind(node.value)
+                        if kind:
+                            ci.lock_attrs[t.attr] = kind
+                            continue
+                        tkey = self._infer_expr_class(mod, env, node.value)
+                        if tkey and t.attr not in ci.lock_attrs:
+                            ci.attr_types.setdefault(t.attr, tkey)
+
+    def _param_env(self, mod: ModuleInfo, ci: Optional[ClassInfo],
+                   func) -> Dict[str, str]:
+        """name -> class key for self/cls + annotated params."""
+        env: Dict[str, str] = {}
+        args = getattr(func, "args", None)
+        if args is None:
+            return env
+        params = list(args.posonlyargs) + list(args.args) + list(
+            args.kwonlyargs)
+        for i, a in enumerate(params):
+            if i == 0 and ci is not None and a.arg in ("self", "cls"):
+                env[a.arg] = ci.key
+                continue
+            tkey = self._ann_to_class(mod, a.annotation)
+            if tkey:
+                env[a.arg] = tkey
+        return env
+
+    def _infer_expr_class(self, mod: ModuleInfo, env: Dict[str, str],
+                          expr) -> Optional[str]:
+        """Best-effort type of an expression: constructor calls,
+        ``Class.classmethod()`` calls, calls to functions with a class
+        return annotation, annotated names, and if/or fallbacks."""
+        found: Set[str] = set()
+
+        def visit(e):
+            if isinstance(e, ast.Call):
+                r = self.resolve(mod, e.func)
+                if r:
+                    if r[0] == "class":
+                        found.add(r[1])
+                        return
+                    if r[0] == "func":
+                        entry = self.functions.get(r[1])
+                        if entry is not None:
+                            fmod, fnode = entry
+                            tkey = self._ann_to_class(
+                                fmod, getattr(fnode, "returns", None))
+                            if tkey:
+                                found.add(tkey)
+                                return
+                # Class.method(...) -> Class (e.g. Governor.instance())
+                if isinstance(e.func, ast.Attribute):
+                    r2 = self.resolve(mod, e.func.value)
+                    if r2 and r2[0] == "class":
+                        found.add(r2[1])
+                        return
+            elif isinstance(e, ast.Name) and e.id in env:
+                found.add(env[e.id])
+                return
+            elif isinstance(e, ast.IfExp):
+                visit(e.body)
+                visit(e.orelse)
+                return
+            elif isinstance(e, ast.BoolOp):
+                for v in e.values:
+                    visit(v)
+                return
+
+        visit(expr)
+        return found.pop() if len(found) == 1 else None
+
+    def _index_callbacks(self) -> None:
+        """Functions passed as arguments to ``SomeClass(...)`` or
+        ``<obj of SomeClass>.method(...)`` become that class's possible
+        callback targets (the lock pass uses them to resolve stored-
+        callable calls like ``self._on_timeout(req)``)."""
+        for mod in self.modules.values():
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                target_class = None
+                r = self.resolve(mod, node.func)
+                if r and r[0] == "class":
+                    target_class = r[1]
+                elif isinstance(node.func, ast.Attribute):
+                    # obj.method(...): resolve obj type where obj is
+                    # `self.attr` or a resolvable name
+                    owner = self._rough_owner_class(mod, node.func.value)
+                    if owner:
+                        target_class = owner
+                if target_class not in self.classes:
+                    continue
+                ci = self.classes[target_class]
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    fk = self._callable_key(mod, arg)
+                    if fk:
+                        ci.callback_targets.add(fk)
+
+    def _rough_owner_class(self, mod: ModuleInfo, expr) -> Optional[str]:
+        """Type of `self.attr` / `name` receivers, scanning every class in
+        the module for a matching attr type (imprecise but only used to
+        attach callback targets)."""
+        if isinstance(expr, ast.Name):
+            r = self.resolve(mod, expr)
+            if r and r[0] == "class":
+                return r[1]
+            return None
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value,
+                                                          ast.Name):
+            if expr.value.id in ("self", "cls"):
+                for ci in mod.classes.values():
+                    if expr.attr in ci.attr_types:
+                        return ci.attr_types[expr.attr]
+        return None
+
+    def _callable_key(self, mod: ModuleInfo, expr) -> Optional[str]:
+        """`self.meth` / `name` argument -> funckey if it is a function."""
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name) and expr.value.id in ("self", "cls"):
+            for ci in mod.classes.values():
+                if expr.attr in ci.methods:
+                    return f"{ci.key}.{expr.attr}"
+            return None
+        if isinstance(expr, ast.Name):
+            r = self.resolve(mod, expr)
+            if r and r[0] == "func":
+                return r[1]
+        return None
+
+    # -- resolution --------------------------------------------------------
+    def resolve(self, mod: ModuleInfo, expr) -> Optional[tuple]:
+        """Name/Attribute -> ("class", key) | ("func", key) | ("mod", modid).
+        Follows imports; understands `alias.attr` for module aliases."""
+        if isinstance(expr, ast.Name):
+            name = expr.id
+            if name in mod.classes:
+                return ("class", mod.classes[name].key)
+            if name in mod.functions:
+                return ("func", f"{mod.modid}.{name}")
+            imp = mod.imports.get(name)
+            if imp is None:
+                return None
+            if imp[0] == "mod":
+                return ("mod", imp[1])
+            _, src_modid, src_name = imp
+            return self._resolve_in_module(src_modid, src_name)
+        if isinstance(expr, ast.Attribute):
+            base = self.resolve(mod, expr.value)
+            if base and base[0] == "mod":
+                return self._resolve_in_module(base[1], expr.attr)
+            return None
+        return None
+
+    def _resolve_in_module(self, modid: str, name: str) -> Optional[tuple]:
+        seen = set()
+        while True:
+            target = self.modules.get(modid)
+            if target is None:
+                return None
+            if name in target.classes:
+                return ("class", target.classes[name].key)
+            if name in target.functions:
+                return ("func", f"{modid}.{name}")
+            sub = f"{modid}.{name}" if modid != "__init__" else name
+            if sub in self.modules:
+                return ("mod", sub)
+            # re-export: follow the module's own import of the name
+            imp = target.imports.get(name)
+            if imp is None or (modid, name) in seen:
+                return None
+            seen.add((modid, name))
+            if imp[0] == "mod":
+                return ("mod", imp[1])
+            _, modid, name = imp
+
+    # -- constants (passes 8/9) --------------------------------------------
+    def constant_of(self, mod: ModuleInfo, expr):
+        """Resolve a Name/Attribute/Constant expression to a module-level
+        str/int constant -> (defining_name, value), or None.  Follows
+        `from x import NAME` and `alias.NAME` one module deep."""
+        if isinstance(expr, ast.Constant) and isinstance(
+                expr.value, (str, int)) and not isinstance(expr.value, bool):
+            return (None, expr.value)
+        if isinstance(expr, ast.Name):
+            consts = module_constants(mod)
+            if expr.id in consts:
+                return (expr.id, consts[expr.id])
+            imp = mod.imports.get(expr.id)
+            if imp and imp[0] == "obj":
+                src = self.modules.get(imp[1])
+                if src is not None:
+                    consts = module_constants(src)
+                    if imp[2] in consts:
+                        return (imp[2], consts[imp[2]])
+            return None
+        if isinstance(expr, ast.Attribute):
+            base = self.resolve(mod, expr.value)
+            if base and base[0] == "mod":
+                src = self.modules.get(base[1])
+                if src is not None:
+                    consts = module_constants(src)
+                    if expr.attr in consts:
+                        return (expr.attr, consts[expr.attr])
+        return None
+
+
+def module_constants(mod: ModuleInfo) -> Dict[str, object]:
+    """Module-level ``NAME = <str|int literal>`` assignments (cached)."""
+    cached = getattr(mod, "_constants", None)
+    if cached is not None:
+        return cached
+    consts: Dict[str, object] = {}
+    for node in mod.tree.body:
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, (str, int))
+                and not isinstance(node.value.value, bool)):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    consts[t.id] = node.value.value
+    mod._constants = consts
+    return consts
+
+
+def _self_name(func) -> Optional[str]:
+    args = getattr(func, "args", None)
+    if args and (args.posonlyargs or args.args):
+        first = (args.posonlyargs or args.args)[0]
+        if first.arg in ("self", "cls"):
+            return first.arg
+    return None
+
+
+def _lock_ctor_kind(expr) -> Optional[str]:
+    """`threading.Lock()` / `Lock()` / `Condition(...)` -> kind."""
+    if not isinstance(expr, ast.Call):
+        return None
+    f = expr.func
+    name = None
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id == "threading":
+        name = f.attr
+    elif isinstance(f, ast.Name):
+        name = f.id
+    return LOCK_CTORS.get(name) if name else None
+
+
+def _func_defs(node):
+    """Nested FunctionDef/Lambda nodes directly inside ``node`` (not
+    crossing into further nesting levels handled by recursion)."""
+    for child in ast.walk(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)) and child is not node:
+            yield child

@@ -1,0 +1,29 @@
+"""Rule registry: passes self-register under their rule id."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from .core import Finding
+
+__all__ = ["RULES", "rule", "run_rules"]
+
+RULES: Dict[str, tuple] = {}  # id -> (fn, short description)
+
+
+def rule(rule_id: str, doc: str):
+    def deco(fn):
+        RULES[rule_id] = (fn, doc)
+        return fn
+
+    return deco
+
+
+def run_rules(project, config) -> List[Finding]:
+    findings = list(project.errors)
+    for rule_id, (fn, _doc) in sorted(RULES.items()):
+        if config.rules is not None and rule_id not in config.rules:
+            continue
+        findings.extend(fn(project, config))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings

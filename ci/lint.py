@@ -17,7 +17,7 @@ import re
 import sys
 from typing import List
 
-from analyze import Finding, emit_json
+from analyze import Finding, emit_github, emit_json
 
 MAX_LINE = 100
 ROOTS = ["spark_rapids_jni_tpu", "tests", "bench.py", "__graft_entry__.py",
@@ -145,15 +145,21 @@ def check_file(path: str, repo_root: str) -> List[Finding]:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--format", choices=("text", "json", "github"),
+                    default=None,
+                    help="report format (--json is shorthand for json)")
     args = ap.parse_args(argv)
+    fmt = args.format or ("json" if args.as_json else "text")
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     findings: List[Finding] = []
     n = 0
     for path in iter_py_files(repo_root):
         n += 1
         findings.extend(check_file(path, repo_root))
-    if args.as_json:
+    if fmt == "json":
         emit_json(findings, tool="lint", files=n)
+    elif fmt == "github":
+        emit_github(findings, tool="lint")
     else:
         for f in findings:
             print(f"{f.path}:{f.line}: {f.message}")

@@ -9,12 +9,23 @@ cd "$(dirname "$0")/.."
 python ci/lint.py
 # protocol-aware static analysis: fails on any un-baselined finding
 # (lock-order, unguarded-shared-state, retry-protocol, governed-allocation,
-# seam-discipline — see docs/STATIC_ANALYSIS.md)
-python ci/analyze.py
-
+# seam-discipline, flight-discipline, guarded-by, wire-protocol incl. the
+# frozen flight wire-id registry, state-machine — docs/STATIC_ANALYSIS.md)
 if [[ "${QUICK:-0}" == "1" ]]; then
+    # inner loop: the content-hash cache + changed-only report keep this
+    # sub-second when the tree matches the last full gate run
+    python ci/analyze --changed-only HEAD
     exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu SRT_REEXECED=1 \
         python -m pytest tests/ -q -m "not slow"
+fi
+# full gate, with an asserted runtime budget: the analyze run must stay
+# fast as the repo grows (cold, cache-less worst case included)
+t0=$(date +%s)
+python ci/analyze
+t1=$(date +%s)
+if (( t1 - t0 > 60 )); then
+    echo "analyze: full gate took $((t1 - t0))s, budget is 60s" >&2
+    exit 1
 fi
 
 # One fresh interpreter per test file: XLA:CPU's JIT segfaults sporadically

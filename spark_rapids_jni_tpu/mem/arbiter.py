@@ -136,7 +136,7 @@ class Arbiter:
         # thread -> task association mirror, so flight-recorder events can
         # carry task ids (the native map is not introspectable per thread)
         self._task_map_lock = threading.Lock()
-        self._task_of: dict[int, int] = {}
+        self._task_of: dict[int, int] = {}  # guarded-by: _task_map_lock
         # thread -> monotonic_ns at which post_alloc_failed parked it
         # (state BLOCKED): the park is *served* inside the thread's next
         # pre_alloc, which closes the window.  Keys are touched only by

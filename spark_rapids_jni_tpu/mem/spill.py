@@ -114,10 +114,10 @@ class SpillPool:
         # lock edges resolve through it (docs/STATIC_ANALYSIS.md)
         self._budget = budget
         self._lock = threading.RLock()
-        self._buffers: List[SpillableBuffer] = []
-        self._clock = 0
-        self.spill_count = 0
-        self.spilled_bytes = 0
+        self._buffers: List[SpillableBuffer] = []  # guarded-by: _lock
+        self._clock = 0  # guarded-by: _lock
+        self.spill_count = 0  # guarded-by: _lock
+        self.spilled_bytes = 0  # guarded-by: _lock
         budget.register_spill_handler(self.spill_until)
         _POOLS.add(self)
 

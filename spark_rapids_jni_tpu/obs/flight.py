@@ -53,7 +53,7 @@ __all__ = [
     "EV_TASK_HUNG", "EV_DEGRADE_ENTER", "EV_DEGRADE_EXIT",
     "EV_LEASE_GRANT", "EV_LEASE_REDISPATCH", "EV_LEASE_DONE",
     "EV_WORKER_SPAWN", "EV_WORKER_DEAD",
-    "EVENT_KINDS", "KIND_IDS", "DUMP_SCHEMA",
+    "EVENT_KINDS", "EVENT_PAIRS", "KIND_IDS", "DUMP_SCHEMA",
     "FlightRecorder", "record", "anomaly", "snapshot", "task_stats",
     "register_telemetry_source", "unregister_telemetry_source",
     "unified_snapshot", "recorder",
@@ -106,6 +106,18 @@ EV_WORKER_SPAWN = "worker_spawn"       # executor process (re)started
 #                                        (detail=worker:<wid>:inc:<n>:pid)
 EV_WORKER_DEAD = "worker_dead"         # executor declared dead (crashed,
 #                                        heartbeat-lost, or hung-recycled)
+
+# Paired kinds: a layer that emits the left side of a pair must also emit
+# the right side (module-granular balance, enforced by the analyze gate's
+# state-machine pass) — the drift class where one side of a bracket
+# protocol is dropped and every reconstruction silently loses its spans.
+EVENT_PAIRS = (
+    (EV_TASK_BLOCKED, EV_TASK_WOKEN),
+    (EV_TASK_ADMITTED, EV_TASK_DONE),
+    (EV_SPILL_BEGIN, EV_SPILL_END),
+    (EV_DEGRADE_ENTER, EV_DEGRADE_EXIT),
+    (EV_LEASE_GRANT, EV_LEASE_DONE),
+)
 
 EVENT_KINDS = (
     EV_TASK_ADMITTED, EV_TASK_BLOCKED, EV_TASK_WOKEN, EV_RETRY,

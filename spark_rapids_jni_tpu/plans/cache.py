@@ -67,14 +67,18 @@ class PlanCache:
     def __init__(self, maxsize: Optional[int] = None):
         self._maxsize = maxsize
         self._lock = threading.RLock()
-        self._entries: "collections.OrderedDict" = collections.OrderedDict()
-        self._building: Dict[Tuple, threading.Event] = {}
-        self._stats: Dict[str, float] = {
+        # the LRU table + its gauges: every access below goes through
+        # _lock (the guarded-by pass enforces it), so stats() readers on
+        # dump/telemetry threads can never see a half-updated eviction
+        self._entries: "collections.OrderedDict" = \
+            collections.OrderedDict()  # guarded-by: _lock
+        self._building: Dict[Tuple, threading.Event] = {}  # guarded-by: _lock
+        self._stats: Dict[str, float] = {  # guarded-by: _lock
             "hits": 0, "misses": 0, "evictions": 0, "aot_fallbacks": 0,
             "trace_s": 0.0, "compile_s": 0.0,
             "execute_calls": 0, "execute_s": 0.0,
         }
-        self._last_aot_error = ""
+        self._last_aot_error = ""  # guarded-by: _lock
 
     def _cap(self) -> int:
         if self._maxsize is not None:

@@ -136,24 +136,25 @@ class AdmissionController:
             "session_scale": Knob("session_scale", 1.0, 0.25, 1.0),
         }
         self._lock = threading.Lock()  # ledger + ewma + per-knob bookkeeping
-        self.ledger: "deque" = deque(maxlen=256)
-        self._ewma: Optional[float] = None
-        self._tick = 0
-        self._last_adj: Dict[str, int] = {}
-        self._last_counters: Dict[str, int] = {}
-        self._last_class_splits: Dict[str, int] = {}
-        self._class_quiet: Dict[str, int] = {}  # ticks since last class split
+        self.ledger: "deque" = deque(maxlen=256)  # guarded-by: _lock
+        self._ewma: Optional[float] = None  # guarded-by: _lock
+        self._tick = 0  # guarded-by: _lock
+        self._last_adj: Dict[str, int] = {}  # guarded-by: _lock
+        self._last_counters: Dict[str, int] = {}  # guarded-by: _lock
+        self._last_class_splits: Dict[str, int] = {}  # guarded-by: _lock
+        # ticks since last class split
+        self._class_quiet: Dict[str, int] = {}  # guarded-by: _lock
         # latency-aware presplit probing (ROADMAP item 4 follow-on): per
         # handler, the in-flight probe record and the converged-regime
         # "already decided" marker (cleared when splits recur or decay
         # fires, so a new regime re-earns its probe)
-        self._probe: Dict[str, dict] = {}
-        self._probe_done: Dict[str, bool] = {}
-        self._boosts: Dict[str, int] = {}
-        self._frozen = False
-        self.errors = 0
+        self._probe: Dict[str, dict] = {}  # guarded-by: _lock
+        self._probe_done: Dict[str, bool] = {}  # guarded-by: _lock
+        self._boosts: Dict[str, int] = {}  # guarded-by: _lock
+        self._frozen = False  # guarded-by: _lock
+        self.errors = 0  # guarded-by: _lock
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+        self._thread: Optional[threading.Thread] = None  # guarded-by: _lock
         # telemetry registration mirrors the engine's: weak, so an
         # abandoned controller never pins itself into the process-global
         # recorder, and the source self-unregisters once collected

@@ -213,7 +213,10 @@ class ServingEngine:
             on_timeout=self._on_queue_timeout,
         )
         self._seq = itertools.count()
-        self._handlers: dict = {}
+        # registration is exists-check + insert under _reg_lock; READS
+        # are deliberately lock-free (GIL-atomic dict gets on a dict that
+        # only grows at startup) and carry per-site suppressions below
+        self._handlers: dict = {}  # guarded-by: _reg_lock
         self._reg_lock = threading.Lock()  # guards handler registration
         # adaptive-admission state (serve/controller.py): the static knob
         # values the kill switch restores, per-handler pre-emptive split
@@ -221,15 +224,17 @@ class ServingEngine:
         # reads.  One leaf lock, never held across calls into other layers.
         self.static_queue_size = queue_size
         self._ctl_lock = threading.Lock()
-        self._presplit: dict = {}       # handler -> pre-dispatch split depth
-        self._class_splits: dict = {}   # handler -> cumulative splits seen
+        # handler -> pre-dispatch split depth  # guarded-by: _ctl_lock
+        self._presplit: dict = {}
+        # handler -> cumulative splits seen  # guarded-by: _ctl_lock
+        self._class_splits: dict = {}
         self._ewma_lock = threading.Lock()
-        self._ewma_service_s = 0.05
+        self._ewma_service_s = 0.05  # guarded-by: _ewma_lock
         # queue-saturation detector: N consecutive backpressure rejections
         # with no successful admit in between trigger a flight-recorder
         # anomaly dump (obs/flight.py)
         self._sat_lock = threading.Lock()
-        self._sat_rejects = 0
+        self._sat_rejects = 0  # guarded-by: _sat_lock
         self._sat_threshold = int(config.get("flight_saturation_rejects"))
         # seeded retry-after jitter: split children of one batch land back
         # in their clients' retry loops at the SAME instant, and an
@@ -241,8 +246,10 @@ class ServingEngine:
         # hung-task watchdog: per-popped-request start stamps the watchdog
         # thread sweeps (leaf lock, nothing else acquired while held)
         self._inflight_lock = threading.Lock()
-        self._inflight: dict = {}      # worker name -> [req, t0_ns, flagged]
-        self._ewma_by_handler: dict = {}  # handler -> EWMA service seconds
+        # worker name -> [req, t0_ns, flagged]  # guarded-by: _inflight_lock
+        self._inflight: dict = {}
+        # handler -> EWMA service seconds  # guarded-by: _ewma_lock
+        self._ewma_by_handler: dict = {}
         self._hang_factor = float(config.get("serve_hang_factor"))
         self._hang_min_s = float(config.get("serve_hang_min_s"))
         self._hang_stop = threading.Event()
@@ -326,6 +333,9 @@ class ServingEngine:
         :class:`SessionBudgetExceeded` (the session is over its byte
         budget) — both clean rejections; the request never queues.
         """
+        # analyze: ignore[guarded-by] - hot-path read of a registration
+        # dict that only grows at startup; a GIL-atomic get needs no lock
+        # (the _reg_lock guards the register-register write race only)
         h = self._handlers.get(handler)
         if h is None:
             raise KeyError(f"no handler {handler!r} registered")
@@ -609,6 +619,8 @@ class ServingEngine:
             self.queue.task_done(len(group))
 
     def _serve_group(self, req: Request) -> List[Request]:
+        # analyze: ignore[guarded-by] - same lock-free registration-dict
+        # read as submit(): GIL-atomic on a startup-only-growing dict
         h = self._handlers[req.handler]
         if (req.split_depth == 0 and req.join is None
                 and h.split is not None and not h.self_governed):

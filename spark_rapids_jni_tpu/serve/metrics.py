@@ -121,18 +121,18 @@ class ServeMetrics:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._global: Dict[str, int] = defaultdict(int)
-        self._per_session: Dict[str, Dict[str, int]] = {}
-        self.queue_wait = LatencyHistogram()
-        self.run_latency = LatencyHistogram()
+        self._global: Dict[str, int] = defaultdict(int)  # guarded-by: _lock
+        self._per_session: Dict[str, Dict[str, int]] = {}  # guarded-by: _lock
+        self.queue_wait = LatencyHistogram()  # guarded-by: _lock
+        self.run_latency = LatencyHistogram()  # guarded-by: _lock
         # per-handler run latency: the admission controller's latency-aware
         # presplit probe compares a class's p99 across probe windows, which
         # the single global histogram cannot answer
-        self._run_by_handler: Dict[str, LatencyHistogram] = {}
-        self._depth = 0
-        self._gauge_source: Optional[Callable[[], dict]] = None
-        self._gauge_cache: Dict[str, int] = {}
-        self._gauge_cache_t = -1e9
+        self._run_by_handler: Dict[str, LatencyHistogram] = {}  # guarded-by: _lock
+        self._depth = 0  # guarded-by: _lock
+        self._gauge_source: Optional[Callable[[], dict]] = None  # guarded-by: _lock
+        self._gauge_cache: Dict[str, int] = {}  # guarded-by: _lock
+        self._gauge_cache_t = -1e9  # guarded-by: _lock
 
     def set_gauge_source(self, fn: Optional[Callable[[], dict]]) -> None:
         """Attach a memory-pressure gauge sampler (the engine passes

@@ -54,6 +54,19 @@ ERROR = "error"
 TIMED_OUT = "timed_out"
 CANCELLED = "cancelled"
 
+# Request terminal-state machine, checked by the analyze gate: the ONLY
+# legal move is pending -> one terminal, exactly once (_complete's
+# first-completion-wins contract — the zero-lost invariant every chaos
+# bench asserts reduces to "every request leaves pending exactly once").
+# state-machine: response field=status
+_RESPONSE_TRANSITIONS = {
+    PENDING: (OK, ERROR, TIMED_OUT, CANCELLED),
+    OK: (),
+    ERROR: (),
+    TIMED_OUT: (),
+    CANCELLED: (),
+}
+
 
 class Response:
     """Completion handle for one submitted request (a minimal future)."""
@@ -80,6 +93,9 @@ class Response:
         with self._lock:
             if self.status != PENDING:
                 return False
+            # transition: response pending->* (the != PENDING early
+            # return above IS the from-state guard; status is whichever
+            # terminal the caller reached first)
             self.status = status
             self.value = value
             self.error = error
@@ -142,15 +158,16 @@ class AdmissionQueue:
                  on_timeout: Optional[Callable[[Request], None]] = None):
         if maxsize <= 0:
             raise ValueError("maxsize must be positive")
-        self.maxsize = maxsize
-        self._heap: List[tuple] = []  # (-priority, seq, Request)
+        self.maxsize = maxsize  # guarded-by: _cond
+        # (-priority, seq, Request) entries  # guarded-by: _cond
+        self._heap: List[tuple] = []
         self._cond = threading.Condition()
-        self._closed = False
+        self._closed = False  # guarded-by: _cond
         # requests handed to a consumer and not yet returned via
         # task_done(); outstanding() = queued + handed-out, the quantity
         # a drain must watch (a popped-but-unfinished request is neither
         # in the heap nor idle — the engine's shutdown race, review r1)
-        self._handed_out = 0
+        self._handed_out = 0  # guarded-by: _cond
         # default hint: linear in occupancy — a full queue of slow requests
         # asks for a longer backoff than a just-full one (the engine
         # replaces this with an EWMA-of-service-time estimate)

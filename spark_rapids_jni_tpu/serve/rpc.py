@@ -40,6 +40,7 @@ from typing import Callable, Optional
 
 __all__ = [
     "MSG_HELLO", "MSG_BEAT", "MSG_DISPATCH", "MSG_RESULT", "MSG_SHUTDOWN",
+    "MESSAGE_FIELDS",
     "SafeConn", "resolve_factory", "executor_worker_main",
 ]
 
@@ -48,6 +49,24 @@ MSG_BEAT = "beat"
 MSG_DISPATCH = "dispatch"
 MSG_RESULT = "result"
 MSG_SHUTDOWN = "shutdown"
+
+# The declared wire schema: tag -> field names after the tag.  BOTH sides
+# of the pipe are checked against this table at merge time (ci/analyze
+# wire-protocol pass): every tuple constructed with one of these tags must
+# carry exactly these fields, and every destructure site (tuple unpack or
+# msg[i] index under an `if tag == MSG_X` guard) must match arity and
+# names.  The round-10 blocked_frac drift — a gauge the supervisor read
+# but no worker sent — is the defect class this freezes out; changing a
+# message means changing this row, which forces every site on both sides
+# into the same review.
+MESSAGE_FIELDS = {
+    MSG_HELLO: ("worker_id", "incarnation", "pid"),
+    MSG_BEAT: ("worker_id", "incarnation", "wall_t", "gauges"),
+    MSG_DISPATCH: ("rid", "handler", "payload", "deadline_rel_s",
+                   "priority"),
+    MSG_RESULT: ("rid", "status", "value", "err"),
+    MSG_SHUTDOWN: ("dump_epilogue",),
+}
 
 # RESULT statuses mirror serve.queue terminal states, plus the one
 # non-terminal flow-control verdict a worker may return:

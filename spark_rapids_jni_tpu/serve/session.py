@@ -37,10 +37,10 @@ class Session:
         self.session_id = session_id
         self.priority = priority
         self.byte_budget = byte_budget  # None = uncapped (static config)
-        self.closed = False
+        self.closed = False  # guarded-by: _lock
         self._lock = threading.Lock()
-        self.inflight_bytes = 0
-        self.inflight_requests = 0
+        self.inflight_bytes = 0  # guarded-by: _lock
+        self.inflight_requests = 0  # guarded-by: _lock
         # adaptive-admission knobs (serve/controller.py).  budget_scale
         # multiplies the STATIC byte_budget into the effective cap charge()
         # enforces — under pressure the controller shrinks every tenant's
@@ -49,13 +49,13 @@ class Session:
         # this session's queue priority at submit (and ratcheted onto
         # already-queued requests via AdmissionQueue.age_sessions), so a
         # starved low-priority tenant climbs instead of aging out.
-        self.budget_scale = 1.0
-        self.age_boost = 0
+        self.budget_scale = 1.0  # guarded-by: _lock
+        self.age_boost = 0  # guarded-by: _lock
         # degradation-ladder shed count (serve/supervisor.py): which
         # tenants the brownout actually hit, surfaced per session so an
         # operator can tell "we shed the batch tier" from "we shed
         # everyone" in one snapshot
-        self.degrade_rejects = 0
+        self.degrade_rejects = 0  # guarded-by: _lock
 
     def note_degraded(self) -> None:
         with self._lock:
@@ -136,7 +136,7 @@ class SessionRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._sessions: Dict[str, Session] = {}
+        self._sessions: Dict[str, Session] = {}  # guarded-by: _lock
         self._session_seq = itertools.count(1)
         self._task_seq = itertools.count(1)
 

@@ -79,6 +79,37 @@ _QUEUED = "queued"       # in the admission queue (initial or re-dispatch)
 _LEASED = "leased"       # dispatched to one executor incarnation
 _DONE = "done"           # effectively completed (exactly once)
 
+# executor-process health states (_ExecutorHandle.health)
+_STARTING = "starting"   # spawned, hello not yet received
+_ALIVE = "alive"         # heartbeating and leasable
+_DEAD = "dead"           # declared dead (terminal: a respawn is a NEW
+#                          handle with a bumped incarnation)
+
+# The machines the analyze gate checks every transition site against
+# (docs/STATIC_ANALYSIS.md, state-machine pass).  A write to the bound
+# field must be an __init__ initialization, sit under an `== <state>`
+# guard matching a declared edge, or carry a `# transition:` annotation.
+# state-machine: lease field=state
+_LEASE_TRANSITIONS = {
+    _QUEUED: (_LEASED, _DONE),   # grant; queue-timeout/shutdown retire
+    _LEASED: (_QUEUED, _DONE),   # dead/hung/busy re-dispatch; completion
+    _DONE: (),                   # terminal: exactly-once, never revived
+}
+# state-machine: worker field=health
+_WORKER_TRANSITIONS = {
+    _STARTING: (_ALIVE, _DEAD),  # hello; spawn-timeout/proc-exit
+    _ALIVE: (_DEAD,),            # crash-only: never coaxed back
+    _DEAD: (),                   # terminal per incarnation
+}
+# state-machine: ladder field=_level  (the degradation ladder moves one
+# level at a time, both directions — adjacency IS the declared edge set)
+_LADDER_TRANSITIONS = {
+    LEVEL_HEALTHY: (LEVEL_SHED_LOW,),
+    LEVEL_SHED_LOW: (LEVEL_HEALTHY, LEVEL_CACHED_ONLY),
+    LEVEL_CACHED_ONLY: (LEVEL_SHED_LOW, LEVEL_REJECT),
+    LEVEL_REJECT: (LEVEL_CACHED_ONLY,),
+}
+
 
 class Degraded(Backpressure):
     """Submit shed by the degradation ladder (a typed Backpressure: the
@@ -143,7 +174,7 @@ class _Lease:
 class _ExecutorHandle:
     """Supervisor-side record of one executor process incarnation."""
 
-    __slots__ = ("worker_id", "incarnation", "proc", "conn", "state",
+    __slots__ = ("worker_id", "incarnation", "proc", "conn", "health",
                  "pid", "last_beat", "gauges", "inflight", "recv_thread")
 
     def __init__(self, worker_id: int, incarnation: int, proc, conn):
@@ -151,7 +182,7 @@ class _ExecutorHandle:
         self.incarnation = incarnation
         self.proc = proc
         self.conn = conn
-        self.state = "starting"    # starting -> alive -> dead
+        self.health = _STARTING    # starting -> alive -> dead
         self.pid = 0
         self.last_beat = time.monotonic()
         self.gauges: dict = {}
@@ -228,28 +259,30 @@ class Supervisor:
                                     on_timeout=self._on_queue_timeout)
         self._seq = itertools.count()
         # ONE lock guards the supervisor's shared state: handles, the
-        # lease table, handler specs, the warm set, and ladder fields.
+        # lease table, handler specs, the warm set, and ladder fields —
+        # every attribute below declares it, and the guarded-by pass
+        # (ci/analyze) rejects any access outside it at merge time.
         # Leaf discipline: never held across pipe sends, queue calls,
         # process spawns, or session/response completion.
         self._lock = threading.Lock()
-        self._handles: Dict[int, _ExecutorHandle] = {}
+        self._handles: Dict[int, _ExecutorHandle] = {}  # guarded-by: _lock
         # live leases only: completed entries retire into the aggregate
         # counters below (holding every served request's payload+result
         # forever would be an unbounded leak, and the monitor's sweeps
         # scan this table every heartbeat tick)
-        self._leases: Dict[int, _Lease] = {}
-        self._leases_total = 0
-        self._leases_completed = 0
-        self._leases_redispatched = 0
-        self._lease_max_dispatches_seen = 0
-        self._specs: Dict[str, HandlerSpec] = {}
-        self._warm: set = set()
-        self._level = LEVEL_HEALTHY
-        self._level_max_seen = LEVEL_HEALTHY
-        self._stress_ewma: Optional[float] = None
-        self._ladder_tickno = 0
-        self._ladder_last_change = -10**9
-        self.ledger: List[dict] = []
+        self._leases: Dict[int, _Lease] = {}  # guarded-by: _lock
+        self._leases_total = 0  # guarded-by: _lock
+        self._leases_completed = 0  # guarded-by: _lock
+        self._leases_redispatched = 0  # guarded-by: _lock
+        self._lease_max_dispatches_seen = 0  # guarded-by: _lock
+        self._specs: Dict[str, HandlerSpec] = {}  # guarded-by: _lock
+        self._warm: set = set()  # guarded-by: _lock
+        self._level = LEVEL_HEALTHY  # guarded-by: _lock
+        self._level_max_seen = LEVEL_HEALTHY  # guarded-by: _lock
+        self._stress_ewma: Optional[float] = None  # guarded-by: _lock
+        self._ladder_tickno = 0  # guarded-by: _lock
+        self._ladder_last_change = -10**9  # guarded-by: _lock
+        self.ledger: List[dict] = []  # guarded-by: _lock
         self._stop = threading.Event()
         self._dispatcher: Optional[threading.Thread] = None
         self._monitor: Optional[threading.Thread] = None
@@ -366,7 +399,7 @@ class Supervisor:
         if lease.completed:
             return
         lease.completed = True
-        lease.state = _DONE
+        lease.state = _DONE  # transition: lease *->done (retire from any)
         self._leases_completed += 1
         self._lease_max_dispatches_seen = max(
             self._lease_max_dispatches_seen, lease.dispatches)
@@ -437,8 +470,8 @@ class Supervisor:
             tag = msg[0]
             if tag == rpc.MSG_HELLO:
                 with self._lock:
-                    if handle.state == "starting":
-                        handle.state = "alive"
+                    if handle.health == _STARTING:
+                        handle.health = _ALIVE
                     handle.pid = msg[3]
                     handle.last_beat = time.monotonic()
             elif tag == rpc.MSG_BEAT:
@@ -453,9 +486,11 @@ class Supervisor:
         certainty, re-queue its leases to survivors (each exactly once),
         respawn."""
         with self._lock:
-            if handle.state == "dead":
+            if handle.health == _DEAD:
                 return
-            handle.state = "dead"
+            # transition: worker *->dead (idempotent guard above; both
+            # starting and alive executors die through this one path)
+            handle.health = _DEAD
             current = self._handles.get(handle.worker_id) is handle
             orphans = []
             for rid in handle.inflight:
@@ -464,7 +499,7 @@ class Supervisor:
                         and lease.state == _LEASED
                         and lease.worker_id == handle.worker_id
                         and lease.incarnation == handle.incarnation):
-                    lease.state = _QUEUED
+                    lease.state = _QUEUED  # transition: lease leased->queued
                     if lease.redispatches == 0:
                         self._leases_redispatched += 1
                     lease.redispatches += 1
@@ -524,7 +559,7 @@ class Supervisor:
         with self._lock:
             spec = self._specs.get(req.handler)
             alive = sum(1 for h in self._handles.values()
-                        if h.state == "alive")
+                        if h.health == _ALIVE)
             # a request that already holds a lease is a re-dispatch (dead
             # worker, BUSY): it must re-grant as itself — fanning out now
             # would complete the response through child leases while the
@@ -580,7 +615,7 @@ class Supervisor:
         # already ran — lost forever (review r10, pass 2)
         with self._lock:
             candidates = [h for h in self._handles.values()
-                          if h.state == "alive"
+                          if h.health == _ALIVE
                           and len(h.inflight) < self.max_inflight_per_worker]
             target = (min(candidates, key=lambda h: len(h.inflight))
                       if candidates else None)
@@ -591,6 +626,9 @@ class Supervisor:
                     self._leases_total += 1
                 if lease.completed:
                     return  # completed while queued (timeout race)
+                # transition: lease queued->leased (fresh or re-dispatch:
+                # both reach here in state QUEUED, pinned by the guard
+                # in _worker_dead / the BUSY path before re-queueing)
                 lease.state = _LEASED
                 lease.worker_id = target.worker_id
                 lease.incarnation = target.incarnation
@@ -628,7 +666,7 @@ class Supervisor:
                            and lease.worker_id == target.worker_id
                            and lease.incarnation == target.incarnation)
                 if reclaim:
-                    lease.state = _QUEUED
+                    lease.state = _QUEUED  # transition: lease leased->queued
                     if lease.redispatches == 0:
                         self._leases_redispatched += 1
                     lease.redispatches += 1
@@ -654,7 +692,7 @@ class Supervisor:
             if not stale:
                 handle.inflight.discard(rid)
                 if status == rpc.STATUS_BUSY:
-                    lease.state = _QUEUED
+                    lease.state = _QUEUED  # transition: lease leased->queued
                     if lease.redispatches == 0:
                         self._leases_redispatched += 1
                     lease.redispatches += 1
@@ -728,14 +766,14 @@ class Supervisor:
                 f"request hung on {self.lease_max_dispatches} separate "
                 f"executors (lease_hang_s={self.lease_hang_s:g} each)"))
         for h in handles:
-            if h.state == "dead":
+            if h.health == _DEAD:
                 continue
             if not h.proc.is_alive():
                 self._worker_dead(h, "proc_exit")
-            elif (h.state == "alive" and now - h.last_beat
+            elif (h.health == _ALIVE and now - h.last_beat
                     > self.heartbeat_s * self.heartbeat_misses):
                 self._worker_dead(h, "heartbeat_lost")
-            elif (h.state == "starting"
+            elif (h.health == _STARTING
                     and now - h.last_beat > self.spawn_grace_s):
                 self._worker_dead(h, "spawn_timeout")
             elif (h.worker_id, h.incarnation) in hung_keys:
@@ -750,14 +788,14 @@ class Supervisor:
     def _sample_stress(self) -> float:
         with self._lock:
             handles = list(self._handles.values())
-        alive = [h for h in handles if h.state == "alive"]
+        alive = [h for h in handles if h.health == _ALIVE]
         # missing capacity: dead workers plus RESPAWNING incarnations
         # (their capacity is genuinely absent until the new process says
         # hello).  Cold-start incarnation-0 spawns don't count — a pool
         # that has never been up is booting, not degraded.
         missing = sum(1 for h in handles
-                      if h.state == "dead"
-                      or (h.state == "starting" and h.incarnation > 0))
+                      if h.health == _DEAD
+                      or (h.health == _STARTING and h.incarnation > 0))
         dead_frac = missing / max(1, self.nworkers)
         queue_frac = self.queue.depth() / max(1, self.queue.maxsize)
         worker_press = max(
@@ -792,6 +830,10 @@ class Supervisor:
                 new = level - 1
             else:
                 return
+            # analyze: ignore[state-machine] - new is level +- 1 by the
+            # branch arithmetic above, exactly the _LADDER_TRANSITIONS
+            # adjacency; dynamic arithmetic is invisible to the static
+            # pass, and the down-AND-up ladder tests pin it at runtime
             self._level = new
             self._level_max_seen = max(self._level_max_seen, new)
             self._ladder_last_change = tick
@@ -840,7 +882,7 @@ class Supervisor:
         with self._lock:
             workers = {
                 str(h.worker_id): {
-                    "state": h.state, "incarnation": h.incarnation,
+                    "state": h.health, "incarnation": h.incarnation,
                     "pid": h.pid, "inflight": len(h.inflight),
                     "gauges": dict(h.gauges),
                 }

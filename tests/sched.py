@@ -1,0 +1,160 @@
+"""Deterministic two-thread interleaving harness (not a test module).
+
+The round-10 review class — "pick a target in one critical section,
+record the lease in another" — is invisible to ordinary tests because the
+window is a few microseconds wide; you only hit it when a worker dies in
+exactly that gap.  This harness makes such windows *schedulable*: threads
+announce checkpoints, and a declared schedule decides which thread
+proceeds at each one, so an adversarial ordering replays identically on
+every run (the executable twin of the analyze gate's static guarded-by
+pass: the gate proves the lock scope, this harness demonstrates the race
+the scope prevents).
+
+Two instrumentation styles:
+
+- :meth:`Interleaver.wrap_lock` wraps a real ``threading.Lock`` so every
+  acquire by a registered thread is a checkpoint — drive code UNDER TEST
+  through adversarial lock-acquisition orderings without modifying it
+  (swap ``obj._lock = sched.wrap_lock(obj._lock)``);
+- :meth:`Interleaver.point` is an explicit checkpoint for call-boundary
+  ordering in the test body itself.
+
+The schedule is a list of thread labels consumed left to right: a thread
+reaching a checkpoint blocks until the head names it (entries for
+finished threads are dropped, so a schedule may be an over-approximation;
+an exhausted schedule means free-run).  Mutual exclusion still comes from
+the REAL locks — the harness only sequences who *attempts* an acquire
+first, which is exactly the degree of freedom a kernel scheduler has.
+
+NOTE: with tests/ on sys.path (pytest prepend mode) this module shadows
+the little-used stdlib ``sched`` (event scheduler).  Nothing in this
+repo's dependency set imports it (pytest/jax/numpy verified), but if a
+future dependency needs ``sched.scheduler``, rename this file and its
+one importer (tests/test_sched.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+
+class ScheduleTimeout(AssertionError):
+    """A thread waited too long for its turn (schedule deadlock)."""
+
+
+class Interleaver:
+    def __init__(self, schedule: Sequence[str], timeout_s: float = 10.0):
+        self._schedule: List[str] = list(schedule)
+        self._cond = threading.Condition()
+        self._labels: Dict[int, str] = {}  # thread ident -> label
+        self._finished: set = set()
+        self.timeout_s = timeout_s
+        self.history: List[str] = []  # consumed checkpoints, in order
+
+    # -- checkpoints --------------------------------------------------------
+    def point(self, label: Optional[str] = None) -> None:
+        """Block until the schedule head names ``label`` (default: the
+        current thread's registered label), then consume it.  Unregistered
+        threads (and labels the schedule never mentions once it is
+        exhausted) pass straight through."""
+        if label is None:
+            label = self._labels.get(threading.get_ident())
+            if label is None:
+                return  # not a scheduled thread
+        deadline = time.monotonic() + self.timeout_s
+        with self._cond:
+            while True:
+                self._drop_dead_heads()
+                if not self._schedule:
+                    return  # exhausted: free-run
+                if self._schedule[0] == label:
+                    self._schedule.pop(0)
+                    self.history.append(label)
+                    self._cond.notify_all()
+                    return
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ScheduleTimeout(
+                        f"thread {label!r} timed out waiting for its turn "
+                        f"(head={self._schedule[0]!r}, "
+                        f"history={self.history})")
+                self._cond.wait(min(remaining, 0.2))
+
+    def _drop_dead_heads(self) -> None:
+        while self._schedule and self._schedule[0] in self._finished:
+            self._schedule.pop(0)
+            self._cond.notify_all()
+
+    def _finish(self, label: str) -> None:
+        with self._cond:
+            self._finished.add(label)
+            self._cond.notify_all()
+
+    # -- lock wrapping ------------------------------------------------------
+    def wrap_lock(self, lock) -> "SchedLock":
+        return SchedLock(self, lock)
+
+    # -- running ------------------------------------------------------------
+    def run(self, threads: Dict[str, Callable[[], None]],
+            join_timeout_s: float = 15.0) -> Dict[str, BaseException]:
+        """Run ``{label: fn}`` to completion under the schedule; returns
+        ``{label: exception}`` for threads that raised (empty = clean).
+        The registration happens inside the spawned thread, so wrapped
+        locks identify scheduled threads by ident."""
+        errors: Dict[str, BaseException] = {}
+
+        def runner(label: str, fn: Callable[[], None]) -> None:
+            self._labels[threading.get_ident()] = label
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001 - reported to caller
+                errors[label] = e
+            finally:
+                self._finish(label)
+
+        ts = [threading.Thread(target=runner, args=(label, fn),
+                               name=f"sched-{label}", daemon=True)
+              for label, fn in threads.items()]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=join_timeout_s)
+        hung = [t.name for t in ts if t.is_alive()]
+        if hung:
+            raise ScheduleTimeout(f"threads never finished: {hung} "
+                                  f"(history={self.history})")
+        return errors
+
+
+class SchedLock:
+    """A ``threading.Lock`` proxy whose every acquire AND release by a
+    scheduled thread is an :class:`Interleaver` checkpoint.  The release
+    checkpoint is what makes critical-SECTION ordering deterministic: a
+    schedule entry consumed at release time sequences the next thread's
+    acquire strictly after this section, not merely after this acquire
+    attempt (each locked region costs two schedule entries per thread)."""
+
+    def __init__(self, sched: Interleaver, lock):
+        self._sched = sched
+        self._lock = lock
+
+    def acquire(self, *a, **k):
+        self._sched.point()
+        return self._lock.acquire(*a, **k)
+
+    def release(self):
+        self._sched.point()
+        return self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._lock.locked()

@@ -1,0 +1,266 @@
+"""Adversarial interleavings through the supervisor's critical sections.
+
+The round-10 review fixed a race the test suite could not see: `_grant`
+picked a target in one critical section and recorded the lease in a
+second one, so a worker declared dead in the gap orphaned the lease
+forever (the dead-path orphan scan had already run).  These tests rebuild
+that bug as a *mutant* with the narrowed lock scope and drive it through
+the exact adversarial schedule with tests/sched.py — the mutant orphans
+the lease deterministically, while the real `_grant` (pick + record in
+ONE section) re-queues it under the same schedule.  The queue's
+shrink/purge critical section gets the same treatment: both orderings of
+a concurrent shrink and pop must account for every request.
+
+This is the runtime twin of the analyze gate's guarded-by pass: the pass
+proves the lock scope at merge time; these tests demonstrate the failure
+the scope prevents, so neither can regress silently.
+"""
+
+import threading
+import time
+
+import pytest
+
+from sched import Interleaver, ScheduleTimeout
+from spark_rapids_jni_tpu.serve import HandlerSpec, Supervisor
+from spark_rapids_jni_tpu.serve.queue import (
+    OK,
+    PENDING,
+    TIMED_OUT,
+    AdmissionQueue,
+    Request,
+)
+from spark_rapids_jni_tpu.serve.supervisor import (
+    _ALIVE,
+    _DEAD,
+    _LEASED,
+    _QUEUED,
+    _ExecutorHandle,
+    _Lease,
+)
+
+pytestmark = pytest.mark.filterwarnings("ignore")
+
+
+# ----------------------------------------------------------- harness itself
+
+
+def test_interleaver_is_deterministic():
+    """The schedule, not thread timing, decides the observed order."""
+    for _ in range(3):
+        sched = Interleaver(["b", "a", "b", "a"])
+        out = []
+
+        def mk(label):
+            def body():
+                for _i in range(2):
+                    sched.point(label)
+                    out.append(label)
+            return body
+
+        assert sched.run({"a": mk("a"), "b": mk("b")}) == {}
+        assert out == ["b", "a", "b", "a"]
+
+
+def test_interleaver_timeout_is_loud():
+    """A schedule naming a label no live thread owns fails fast with the
+    consumed history, instead of hanging the suite."""
+    sched = Interleaver(["ghost"], timeout_s=0.2)
+    with pytest.raises(ScheduleTimeout):
+        sched.point("real")
+
+
+def test_schedlock_checkpoints_acquire_and_release():
+    """Each locked region consumes one acquire and one release entry, so
+    a schedule can order whole critical SECTIONS across threads."""
+    sched = Interleaver(["a", "a", "b", "b"])
+    lock = sched.wrap_lock(threading.Lock())
+    order = []
+
+    def mk(label):
+        def body():
+            with lock:
+                order.append(label)
+        return body
+
+    assert sched.run({"a": mk("a"), "b": mk("b")}) == {}
+    assert order == ["a", "b"]
+    assert sched.history == ["a", "a", "b", "b"]
+
+
+# ------------------------------------------- the pick-vs-record race class
+
+
+class _FakeProc:
+    pid = 0
+
+    def kill(self):
+        pass
+
+    def join(self, timeout=None):
+        pass
+
+    def is_alive(self):
+        return False
+
+
+class _FakeConn:
+    """A pipe whose sends 'succeed' (buffered toward a process that may
+    already be dead — exactly how the real race loses the message)."""
+
+    def __init__(self):
+        self.sent = []
+
+    def send(self, msg):
+        self.sent.append(msg)
+        return True
+
+    def close(self):
+        pass
+
+
+def _race_rig(schedule):
+    """Supervisor(start=False) with one alive fake executor, its _lock
+    wrapped so every critical section is schedulable."""
+    sup = Supervisor(workers=1, factory=None, start=False)
+    sup.register(HandlerSpec("sum", nbytes_of=lambda p: 8 * len(p)))
+    sup._stop.set()  # unit rig: the dead-path must not respawn processes
+    handle = _ExecutorHandle(0, 0, proc=_FakeProc(), conn=_FakeConn())
+    handle.health = _ALIVE
+    with sup._lock:
+        sup._handles[0] = handle
+    sched = Interleaver(schedule)
+    sup._lock = sched.wrap_lock(sup._lock)
+    req = Request(handler="sum", payload=[1, 2], session_id="r", priority=0,
+                  deadline=None, seq=0, task_id=7)
+    return sup, handle, sched, req
+
+
+def _narrowed_grant(sup, req):
+    """The DELIBERATELY NARROWED lock scope — the pre-review-fix shape of
+    Supervisor._grant: target choice and lease recording in two separate
+    critical sections.  The guarded-by/state-machine passes never see
+    this code (it lives in a test), and the real `_grant` carries the
+    one-critical-section comment this mutant violates."""
+    rid = req.task_id
+    now_ns = time.monotonic_ns()
+    with sup._lock:  # section 1: pick
+        candidates = [h for h in sup._handles.values()
+                      if h.health == _ALIVE
+                      and len(h.inflight) < sup.max_inflight_per_worker]
+        target = (min(candidates, key=lambda h: len(h.inflight))
+                  if candidates else None)
+    if target is None:
+        return
+    # <-- the window: a worker declared dead HERE has already run its
+    #     orphan scan, so the lease recorded below is never re-scanned
+    with sup._lock:  # section 2: record
+        lease = sup._leases.get(rid)
+        if lease is None:
+            lease = sup._leases[rid] = _Lease(rid, req)
+            sup._leases_total += 1
+        lease.state = _LEASED
+        lease.worker_id = target.worker_id
+        lease.incarnation = target.incarnation
+        lease.dispatches += 1
+        lease.granted_ns = now_ns
+        target.inflight.add(rid)
+    target.conn.send(("dispatch", rid, req.handler, req.payload, None, 0))
+
+
+# grantor's first section, then the FULL dead-path section, then the rest
+_ADVERSARIAL = ["grantor", "grantor", "killer", "killer",
+                "grantor", "grantor"]
+
+
+def test_narrowed_lock_scope_orphans_the_lease():
+    """The PR 9 race class, reproduced deterministically: with the
+    narrowed scope, a worker dying between pick and record leaves the
+    lease LEASED against a dead incarnation, queued nowhere, re-scanned
+    never — a request lost forever."""
+    sup, handle, sched, req = _race_rig(_ADVERSARIAL)
+    try:
+        errs = sched.run({
+            "grantor": lambda: _narrowed_grant(sup, req),
+            "killer": lambda: sup._worker_dead(handle, "heartbeat_lost"),
+        })
+        assert errs == {}
+        lease = sup._leases[req.task_id]
+        # the orphan: leased against the incarnation whose orphan scan
+        # already ran, with nothing queued and nothing ever completing it
+        assert handle.health == _DEAD
+        assert lease.state == _LEASED
+        assert (lease.worker_id, lease.incarnation) == (0, 0)
+        assert lease.redispatches == 0
+        assert sup.queue.depth() == 0
+        assert req.response.status == PENDING  # lost: nobody owns it now
+    finally:
+        sup.shutdown(drain=False, timeout=5)
+
+
+def test_real_grant_survives_the_same_schedule():
+    """Main's `_grant` (pick + record in ONE critical section) under the
+    SAME adversarial schedule: the dead-path's orphan scan runs strictly
+    after the record, finds the lease, and re-queues it exactly once."""
+    sup, handle, sched, req = _race_rig(_ADVERSARIAL)
+    try:
+        errs = sched.run({
+            "grantor": lambda: sup._grant(req),
+            "killer": lambda: sup._worker_dead(handle, "heartbeat_lost"),
+        })
+        assert errs == {}
+        lease = sup._leases[req.task_id]
+        assert handle.health == _DEAD
+        assert lease.state == _QUEUED        # reclaimed by the dead path
+        assert lease.redispatches == 1
+        assert sup.queue.depth() == 1        # re-queued for a survivor
+        assert sup.metrics.get("leases_redispatched") == 1
+    finally:
+        sup.shutdown(drain=False, timeout=5)
+
+
+# ------------------------------------------------- queue shrink vs. pop
+
+
+def _mk_req(seq, task_id, deadline):
+    return Request(handler="h", payload=None, session_id="q", priority=0,
+                   deadline=deadline, seq=seq, task_id=task_id)
+
+
+@pytest.mark.parametrize("order", [
+    ["shrinker", "popper"],
+    ["popper", "shrinker"],
+])
+def test_queue_shrink_purge_vs_pop_is_loss_free(order):
+    """AdmissionQueue.set_maxsize's shrink-purge and a concurrent pop,
+    driven through BOTH orderings: every expired request reaches
+    TIMED_OUT exactly once (purged or expired-in-passing), the live
+    request is popped exactly once, and the outstanding count drains to
+    zero — no ordering loses a request or double-completes one."""
+    q = AdmissionQueue(8)
+    past = time.monotonic() - 1.0
+    expired = [_mk_req(i, 100 + i, past) for i in range(3)]
+    live = _mk_req(10, 50, time.monotonic() + 30.0)
+    for r in expired:
+        q.submit(r, force=True)
+    q.submit(live)
+    sched = Interleaver(order)
+    popped = []
+
+    def popper():
+        sched.point("popper")
+        r = q.pop(timeout=2.0)
+        popped.append(r)
+
+    def shrinker():
+        sched.point("shrinker")
+        q.set_maxsize(2)
+
+    errs = sched.run({"popper": popper, "shrinker": shrinker})
+    assert errs == {}
+    assert [r.response.status for r in expired] == [TIMED_OUT] * 3
+    assert popped == [live] and live.response.status == PENDING
+    live.response._complete(OK, value=1)
+    q.task_done()
+    assert q.outstanding() == 0
+    assert q.depth() == 0

@@ -344,14 +344,14 @@ def test_respawning_incarnation_counts_as_missing_capacity(sup_unit):
     sup = sup_unit
     h0 = _ExecutorHandle(0, 0, proc=None, conn=None)   # cold start
     h1 = _ExecutorHandle(1, 0, proc=None, conn=None)
-    h1.state = "alive"
+    h1.health = "alive"
     with sup._lock:
         sup._handles[0] = h0
         sup._handles[1] = h1
     assert sup._sample_stress() == 0.0
     h0.incarnation = 2  # now it is a respawn in flight
     assert sup._sample_stress() == pytest.approx(0.5)
-    h0.state = "alive"
+    h0.health = "alive"
     assert sup._sample_stress() == 0.0
 
 
@@ -376,7 +376,7 @@ def test_redispatched_fanout_request_regrants_itself_not_fanout(sup_unit):
 
     a = _ExecutorHandle(0, 0, proc=None, conn=_RecConn())
     b = _ExecutorHandle(1, 0, proc=None, conn=_RecConn())
-    a.state = b.state = "alive"
+    a.health = b.health = "alive"
     with sup._lock:
         sup._handles[0] = a
         sup._handles[1] = b
