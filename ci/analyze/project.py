@@ -48,7 +48,8 @@ class Config:
     lock_scope: Tuple[str, ...] = ("mem.", "mem", "serve.", "serve")
     state_scope: Tuple[str, ...] = ("mem.", "mem", "serve.", "serve")
     governed_scope: Tuple[str, ...] = ("ops.", "ops", "models.", "models",
-                                       "serve.", "serve", "plans.", "plans")
+                                       "serve.", "serve", "plans.", "plans",
+                                       "columnar.pages")
     seam_exclude: Tuple[str, ...] = ("obs.seam",)
     governed_drivers: Tuple[str, ...] = ("attempt_once",
                                          "run_with_split_retry", "_attempt")
@@ -61,7 +62,8 @@ class Config:
     # pass 7 (guarded-by): modules whose classes may carry
     # `# guarded-by: <lock>` attribute annotations
     guarded_scope: Tuple[str, ...] = ("mem.", "mem", "serve.", "serve",
-                                      "plans.", "plans", "obs.", "obs")
+                                      "plans.", "plans", "obs.", "obs",
+                                      "columnar.pages")
     # pass 8 (wire-protocol): the module declaring MESSAGE_FIELDS, the
     # package modules whose construct/destructure sites are checked, and
     # loose (non-package) files checked the same way

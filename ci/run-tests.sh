@@ -63,6 +63,16 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu SRT_REEXECED=1 \
     python tools/serve_bench.py --cluster 4 --chaos-kill --clients 8 \
     --requests 120 --workers 2 --queue-size 16 --seed "${KILL_SEED:-3}"
 
+# continuous ragged batching tier (round 12): paired (micro, ragged)
+# rounds under identical seeded heterogeneous-row-count schedules plus a
+# chaos pair (pressure storm) — gates on ragged winning median rows/s,
+# strictly fewer plan-cache compiles per pair, oracle-identical results,
+# and zero lost requests on both paths calm AND chaos
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu SRT_REEXECED=1 \
+    python tools/serve_bench.py --ragged-storm --clients 8 --requests 160 \
+    --workers 2 --queue-size 32 --ragged-rounds 2 \
+    --seed "${RAGGED_SEED:-5}"
+
 python -c "
 from __graft_entry__ import dryrun_multichip
 dryrun_multichip(8)

@@ -285,6 +285,31 @@ register("serve_lease_hang_s", 5.0,
          "recycled; a request that hangs lease_max_dispatches separate "
          "executors fails terminally instead of destroying the pool.",
          env="SRT_SERVE_LEASE_HANG_S")
+register("serve_ragged", False,
+         "Continuous ragged batching in the serving engine "
+         "(serve/ragged.py): arbitrary concurrent requests of one "
+         "handler class pack into the fixed-size page pool and ride ONE "
+         "fused launch per tick, results scattered back per session.  "
+         "Off (default) = the micro-batching behavior of rounds 1-11 "
+         "(the bit-identical parity oracle).", env="SRT_SERVE_RAGGED")
+register("serve_page_rows", 256,
+         "Rows per fixed-size page in the ragged batching page pool "
+         "(columnar/pages.py).  Page count quantizes pow2 above this, "
+         "so it sets the pack granularity, not a capacity.",
+         env="SRT_SERVE_PAGE_ROWS")
+register("serve_ragged_pool_pages", 64,
+         "Standing page count of the ragged dispatch pool: every fresh "
+         "tick packs into serve_page_rows x this many rows (padding "
+         "validity-masked), so steady-state traffic compiles ONE "
+         "program per (handler kernel, dtype) regardless of request "
+         "shapes.  Page counts only drop below this when "
+         "SplitAndRetryOOM halves a pack.",
+         env="SRT_SERVE_RAGGED_POOL_PAGES")
+register("serve_ragged_max_riders", 64,
+         "Most requests that share one fused ragged launch (the rider-id "
+         "capacity is its pow2; per-rider kernel outputs are sized by "
+         "it).  Candidates past the row or rider cap stay queued for "
+         "the next tick.", env="SRT_SERVE_RAGGED_MAX_RIDERS")
 register("serve_controller_freeze", False,
          "Kill switch for adaptive admission: when set, the controller "
          "immediately resets every knob to its static config value and "

@@ -53,6 +53,7 @@ __all__ = [
     "EV_TASK_HUNG", "EV_DEGRADE_ENTER", "EV_DEGRADE_EXIT",
     "EV_LEASE_GRANT", "EV_LEASE_REDISPATCH", "EV_LEASE_DONE",
     "EV_WORKER_SPAWN", "EV_WORKER_DEAD",
+    "EV_RAGGED_PACK", "EV_RAGGED_LAUNCH", "EV_RAGGED_SPLIT",
     "EVENT_KINDS", "EVENT_PAIRS", "KIND_IDS", "DUMP_SCHEMA",
     "FlightRecorder", "record", "anomaly", "snapshot", "task_stats",
     "register_telemetry_source", "unregister_telemetry_source",
@@ -106,6 +107,20 @@ EV_WORKER_SPAWN = "worker_spawn"       # executor process (re)started
 #                                        (detail=worker:<wid>:inc:<n>:pid)
 EV_WORKER_DEAD = "worker_dead"         # executor declared dead (crashed,
 #                                        heartbeat-lost, or hung-recycled)
+# continuous ragged batching (serve/ragged.py, round 12): every fused
+# page-pool tick narrates pack -> launch (-> split) into the ring, so a
+# pressure incident shows WHICH riders shared a launch and how the page
+# count walked down under SplitAndRetryOOM
+EV_RAGGED_PACK = "ragged_pack"         # riders packed into the page pool
+#                                        (detail=handler:<h>:riders:<n>
+#                                        :pages:<p>, value=rows packed)
+EV_RAGGED_LAUNCH = "ragged_launch"     # one fused page-pool launch
+#                                        (detail=handler:<h>:geom:<g>,
+#                                        value=rows packed)
+EV_RAGGED_SPLIT = "ragged_split"       # page-count halving on
+#                                        SplitAndRetryOOM (detail=
+#                                        handler:<h>:riders:<n>:pages:
+#                                        <from>-><to>, value=new depth)
 
 # Paired kinds: a layer that emits the left side of a pair must also emit
 # the right side (module-granular balance, enforced by the analyze gate's
@@ -130,6 +145,8 @@ EVENT_KINDS = (
     EV_TASK_HUNG, EV_DEGRADE_ENTER, EV_DEGRADE_EXIT,
     EV_LEASE_GRANT, EV_LEASE_REDISPATCH, EV_LEASE_DONE,
     EV_WORKER_SPAWN, EV_WORKER_DEAD,
+    # round 12: appended (wire ids frozen in ci/flight_wire_ids.json)
+    EV_RAGGED_PACK, EV_RAGGED_LAUNCH, EV_RAGGED_SPLIT,
 )
 KIND_IDS = {k: i for i, k in enumerate(EVENT_KINDS)}
 

@@ -15,8 +15,11 @@
 from spark_rapids_jni_tpu.plans import ir
 from spark_rapids_jni_tpu.plans.cache import CompiledPlan, plan_cache
 from spark_rapids_jni_tpu.plans.compiler import (
+    RaggedProgram,
     cached_compile,
+    cached_ragged_compile,
     compile_plan,
+    compile_ragged,
     input_signature,
     output_names,
 )
@@ -32,9 +35,12 @@ from spark_rapids_jni_tpu.plans.runtime import (
 __all__ = [
     "ir",
     "CompiledPlan",
+    "RaggedProgram",
     "plan_cache",
     "cached_compile",
+    "cached_ragged_compile",
     "compile_plan",
+    "compile_ragged",
     "input_signature",
     "output_names",
     "combine_outputs",

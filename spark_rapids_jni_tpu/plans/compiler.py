@@ -23,6 +23,7 @@ governed plan launch, not at trace time), the same seeding rule as
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from typing import Callable, Dict, List, Tuple
 
@@ -33,7 +34,8 @@ from spark_rapids_jni_tpu.plans import ir
 from spark_rapids_jni_tpu.plans.cache import CompiledPlan, plan_cache
 
 __all__ = ["compile_plan", "cached_compile", "input_signature",
-           "output_names", "emitter", "DTYPES"]
+           "output_names", "emitter", "DTYPES",
+           "RaggedProgram", "compile_ragged", "cached_ragged_compile"]
 
 DTYPES = {
     "bool": jnp.bool_,
@@ -418,3 +420,109 @@ def cached_compile(plan: ir.Plan, mesh, tables) -> CompiledPlan:
     sig = input_signature(plan, tables)
     return plan_cache.get_or_compile(
         (plan, mesh, sig), lambda: compile_plan(plan, mesh, sig))
+
+
+# ----------------------------------------------- ragged calling convention
+
+
+@dataclasses.dataclass(frozen=True)
+class RaggedProgram:
+    """The hashable identity of one page-pool-shaped fused program — the
+    plan-cache key the ragged serving path compiles under (the analog of
+    an :class:`ir.Plan` value for a handler kernel instead of a query
+    IR).  ``geometry`` is a :class:`columnar.pages.PageGeometry`; equal
+    (kernel, geometry, out) ticks share one compiled executable, so a
+    long-lived executor's cache holds one entry per PAGE GEOMETRY, not
+    one per request-shape bucket.
+
+    ``kernel_key`` names the kernel (module-qualified by default):
+    handler registration is per engine, but the plan cache is process
+    global, so the key must identify the FUNCTION, not the handler name
+    a second engine may rebind.
+    """
+
+    kernel_key: str
+    geometry: object  # columnar.pages.PageGeometry (frozen, hashable)
+    out: str          # "rows" (row-aligned) | "riders" (per-rider vector)
+
+    @property
+    def name(self) -> str:
+        return f"ragged:{self.kernel_key}:{self.geometry.describe()}"
+
+
+def _ragged_signature(prog: RaggedProgram) -> Tuple:
+    """The flat input signature of the page-pool calling convention:
+    ``(data[total_rows] dtype, valid[total_rows] bool,
+    rid[total_rows] int32)`` — entirely geometry-derived, the property
+    the cache-bounding acceptance test pins."""
+    g = prog.geometry
+    n = g.total_rows
+    return (("pages", "pool", "data", g.dtype, n),
+            ("pages", "pool", VALID_FIELD, "bool", n),
+            ("pages", "pool", "rid", "int32", n))
+
+
+def compile_ragged(prog: RaggedProgram, kernel: Callable) -> CompiledPlan:
+    """Trace + compile ``kernel`` under the page-pool calling convention.
+
+    ``kernel(data, valid, rid, riders_cap)`` is traced device code over
+    the flat pool buffers (``riders_cap`` is static, baked into the
+    trace); it returns ONE array, either row-aligned (``out="rows"`` —
+    the executor scatters slices back per rider) or per-rider
+    (``out="riders"``, indexed by the pack's rider ids; padding rows
+    carry ``rid == riders_cap`` so a segment scatter's drop bucket is
+    index ``riders_cap`` — kernels must size segment outputs
+    ``riders_cap + 1`` and drop the tail, like the masked-segment
+    aggregate emitter).  Uncached — go through
+    :func:`cached_ragged_compile`.
+    """
+    from spark_rapids_jni_tpu.obs.seam import COMPILE, seam
+
+    g = prog.geometry
+    riders_cap = g.riders_cap
+
+    def body(data, valid, rid):
+        return (kernel(data, valid, rid, riders_cap),)
+
+    with seam(COMPILE, prog.name):
+        step = jax.jit(body)
+        fn, aot, trace_s, compile_s, aot_err = _try_aot_flat(
+            step, _ragged_signature(prog))
+    return CompiledPlan(fn, prog, None, _ragged_signature(prog),
+                        ("out",), ("pool.data", "pool.__valid__",
+                                   "pool.rid"),
+                        aot, trace_s, compile_s, aot_err)
+
+
+def _try_aot_flat(step, signature):
+    """AOT lower+compile over a flat (unsharded) signature — the ragged
+    twin of :func:`_try_aot` (which builds per-table shardings a page
+    pool does not have)."""
+    try:
+        avals = [jax.ShapeDtypeStruct((n,), DTYPES.get(dtype, dtype))
+                 for _k, _t, _f, dtype, n in signature]
+        t0 = time.perf_counter()
+        lowered = step.lower(*avals)
+        t1 = time.perf_counter()
+        compiled = lowered.compile()
+        t2 = time.perf_counter()
+        return compiled, True, t1 - t0, t2 - t1, ""
+    # analyze: ignore[retry-protocol] - AOT probe at compile time, before
+    # any device work launches (same degradation contract as _try_aot):
+    # the plain-jit fallback is correct for any lowering refusal, and the
+    # reason rides CompiledPlan.aot_error + the cache's aot_fallbacks
+    # gauge rather than being swallowed.
+    except Exception as e:  # noqa: BLE001
+        return step, False, 0.0, 0.0, f"{type(e).__name__}: {e}"[:200]
+
+
+def cached_ragged_compile(prog: RaggedProgram,
+                          kernel: Callable) -> CompiledPlan:
+    """The ragged front door: one compiled executable per
+    (kernel, page geometry, out kind), via the SAME process-global plan
+    cache (ragged programs compete for residency with query plans and
+    show up in the same hit/miss gauges — the compile-pressure story is
+    one story)."""
+    return plan_cache.get_or_compile(
+        (prog, None, _ragged_signature(prog)),
+        lambda: compile_ragged(prog, kernel))

@@ -35,6 +35,7 @@ from spark_rapids_jni_tpu.serve.executor import (
     register_builtin_handlers,
 )
 from spark_rapids_jni_tpu.serve.metrics import LatencyHistogram, ServeMetrics
+from spark_rapids_jni_tpu.serve.ragged import RaggedDispatcher, RaggedSpec
 from spark_rapids_jni_tpu.serve.queue import (
     AdmissionQueue,
     Backpressure,
@@ -66,6 +67,8 @@ __all__ = [
     "HandlerContext",
     "LatencyHistogram",
     "QueryHandler",
+    "RaggedDispatcher",
+    "RaggedSpec",
     "RemoteExecutorError",
     "Request",
     "RequestTimeout",

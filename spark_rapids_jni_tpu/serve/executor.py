@@ -64,6 +64,24 @@ from spark_rapids_jni_tpu.serve.session import (
 __all__ = ["HandlerContext", "QueryHandler", "ServingEngine",
            "register_builtin_handlers", "split_till"]
 
+# one-time (per process) misconfiguration warning: micro_batch_max <= 1
+# disables micro-batching entirely, which used to be silent
+_BATCH_DISABLED_WARNED = []
+
+
+def _warn_batching_disabled(value: int) -> None:
+    if _BATCH_DISABLED_WARNED:
+        return
+    _BATCH_DISABLED_WARNED.append(value)
+    import warnings
+
+    warnings.warn(
+        f"micro_batch_max={value} disables micro-batching entirely "
+        f"(and serve_ragged is off): every request launches alone. "
+        f"Set micro_batch_max >= 2 or enable serve_ragged; snapshots "
+        f"carry gauges.micro_batch_disabled=1 while this persists.",
+        RuntimeWarning, stacklevel=3)
+
 
 def split_till(payload: Any, split: Callable[[Any], Sequence[Any]], *,
                want_parts: Optional[int] = None,
@@ -111,6 +129,11 @@ class QueryHandler:
       (the exchange-overflow retry);
     - ``batch``/``unbatch``: enable micro-batching (``batch(payloads)``
       merges, ``unbatch(result, payloads)`` redistributes);
+    - ``ragged``: a :class:`serve.ragged.RaggedSpec` opting the handler
+      into continuous ragged batching — arbitrary concurrent requests
+      pack into the fixed-size page pool and ride ONE fused launch per
+      tick (used only when the engine's ``serve_ragged`` flag is on; the
+      micro-batch hooks above stay the flag-off oracle);
     - ``self_governed``: fn drives its own admission (the models/ runners,
       which internally run run_with_split_retry) — the executor supplies
       only the task context and skips its own reservation bracket.
@@ -124,6 +147,7 @@ class QueryHandler:
     grow: Optional[Callable[[Any], Any]] = None
     batch: Optional[Callable[[List[Any]], Any]] = None
     unbatch: Optional[Callable[[Any, List[Any]], List[Any]]] = None
+    ragged: Any = None  # Optional[serve.ragged.RaggedSpec]
     self_governed: bool = False
     max_batch: int = 8
     max_grows: int = 8
@@ -177,7 +201,8 @@ class ServingEngine:
                  default_deadline_s: Optional[float] = 30.0,
                  micro_batch_max: int = 8, max_split_depth: int = 8,
                  builtin_handlers: bool = False,
-                 adaptive: Optional[bool] = None):
+                 adaptive: Optional[bool] = None,
+                 serve_ragged: Optional[bool] = None):
         from spark_rapids_jni_tpu import config
 
         if workers is None:
@@ -186,6 +211,8 @@ class ServingEngine:
             queue_size = int(config.get("serve_queue_size"))
         if adaptive is None:
             adaptive = bool(config.get("serve_adaptive"))
+        if serve_ragged is None:
+            serve_ragged = bool(config.get("serve_ragged"))
         if mesh is None and builtin_handlers:
             from spark_rapids_jni_tpu.parallel import make_mesh
 
@@ -197,6 +224,22 @@ class ServingEngine:
         self.default_deadline_s = default_deadline_s
         self.micro_batch_max = micro_batch_max
         self.max_split_depth = max_split_depth
+        # continuous ragged batching (serve/ragged.py): packs arbitrary
+        # same-handler requests into the fixed-size page pool and fuses
+        # one launch per tick.  Off (default) keeps the micro-batcher
+        # bit-identical to round 11 — the parity oracle.
+        self.serve_ragged = serve_ragged
+        self._ragged = None
+        if serve_ragged:
+            from spark_rapids_jni_tpu.serve.ragged import RaggedDispatcher
+
+            self._ragged = RaggedDispatcher(self)
+        if micro_batch_max <= 1 and not serve_ragged:
+            # a silent no-batching configuration is the misconfiguration
+            # the batch-miss observability exists to surface: warn once
+            # per process, and _gauges() exports micro_batch_disabled so
+            # every serve snapshot carries the signal
+            _warn_batching_disabled(micro_batch_max)
         # Multi-threaded serving over one process-local device group:
         # concurrent collective launches wedge the single-process CPU
         # rendezvous runtime, so collective crossings serialize at the
@@ -403,6 +446,24 @@ class ServingEngine:
         pc = plan_cache.stats()
         for k in ("hits", "misses", "entries", "evictions"):
             g[f"plan_cache_{k}"] = int(pc[k])
+        # misconfiguration visibility: every snapshot says whether this
+        # engine can batch at all (see _warn_batching_disabled)
+        g["micro_batch_disabled"] = int(
+            self.micro_batch_max <= 1 and not self.serve_ragged)
+        if self._ragged is not None:
+            from spark_rapids_jni_tpu.columnar.pages import page_pool
+
+            # the ragged win conditions as gauges: launches saved (riders
+            # that shared a fused launch), pool occupancy (packed rows /
+            # pool capacity), and the host page-pool recycling stats
+            m = self.metrics
+            launches = m.get("ragged_launches")
+            g["ragged_launches_saved"] = m.get("ragged_batched") - launches
+            cap = m.get("ragged_row_capacity")
+            g["ragged_occupancy_pct"] = int(
+                100 * m.get("ragged_rows") / cap) if cap else 0
+            for k, v in page_pool.gauges().items():
+                g[f"page_pool_{k}"] = int(v)
         return g
 
     # -- lifecycle ----------------------------------------------------------
@@ -598,13 +659,48 @@ class ServingEngine:
                                        f"elapsed_ms={elapsed_ns / 1e6:.0f}")
 
     def _gather_batch(self, req: Request, h: QueryHandler) -> List[Request]:
-        """Pull compatible queued requests to ride this launch."""
-        if (h.batch is None or h.self_governed or req.no_batch
-                or self.micro_batch_max <= 1):
+        """Pull compatible queued requests to ride this launch.
+
+        Every way a request FAILS to merge is counted in the metrics
+        batch-miss map (``no_batch`` = the handler cannot batch at all,
+        ``post_split`` = the primary or a candidate is a split product,
+        ``disabled`` = micro_batch_max <= 1, ``handler_mismatch`` per
+        scanned candidate, ``cap`` at most once per tick when the ride
+        filled with work still queued — a heuristic: the remainder may
+        serve other handlers).  The ragged gather counts the same
+        reasons the same way — the measurable half of the
+        ragged-vs-micro win condition."""
+        if h.batch is None or h.self_governed:
+            self.metrics.count_batch_miss("no_batch")
+            return [req]
+        if req.no_batch:
+            self.metrics.count_batch_miss("post_split")
+            return [req]
+        if self.micro_batch_max <= 1:
+            self.metrics.count_batch_miss("disabled")
             return [req]
         limit = min(h.max_batch, self.micro_batch_max) - 1
-        mates = self.queue.pop_compatible(
-            lambda r: r.handler == req.handler and not r.no_batch, limit)
+        miss = {"handler_mismatch": 0, "post_split": 0}
+
+        def pred(r: Request) -> bool:
+            if r.handler != req.handler:
+                miss["handler_mismatch"] += 1
+                return False
+            if r.no_batch:
+                miss["post_split"] += 1
+                return False
+            return True
+
+        mates = self.queue.pop_compatible(pred, limit)
+        # counted OUTSIDE pop_compatible: pred runs under the queue lock,
+        # and the metrics lock must stay a leaf
+        for reason, n in miss.items():
+            if n:
+                self.metrics.count_batch_miss(reason, n)
+        if len(mates) == limit and self.queue.depth() > 0:
+            # the ride filled to its cap with work still queued — the
+            # max_batch ceiling is the binding constraint this tick
+            self.metrics.count_batch_miss("cap")
         if mates:
             self.metrics.set_depth(self.queue.depth())
         return [req] + mates
@@ -629,6 +725,14 @@ class ServingEngine:
                 parts, d = self._presplit_parts(req.payload, h, depth)
                 if len(parts) > 1:
                     return self._presplit_dispatch(req, h, parts, d)
+        if (self._ragged is not None and h.ragged is not None
+                and not h.self_governed):
+            # continuous ragged batching: gather/pack/fused-launch/
+            # scatter with page-granularity retry/split semantics —
+            # split products (no_batch) still ride as single-rider packs
+            # so the compiled-geometry set stays the pool's, and every
+            # popped member is terminal or re-queued on return
+            return self._ragged.serve_group(req, h)
         now_ns = time.monotonic_ns()
         group = self._gather_batch(req, h)
         for r in group:
@@ -984,6 +1088,19 @@ def register_builtin_handlers(engine: ServingEngine) -> None:
         offs = np.cumsum([0] + sizes)
         return [result[offs[i]:offs[i + 1]] for i in range(len(sizes))]
 
+    def hash_kernel(data, valid, rid, riders_cap):
+        # the page-pool twin of run_hash: same murmur body over the flat
+        # pool buffer; padding rows hash harmlessly and are sliced away
+        # by the scatter, so results stay bit-identical to the per-
+        # request path (test_ragged pins it)
+        from spark_rapids_jni_tpu.columnar.column import Column
+        from spark_rapids_jni_tpu.columnar.dtypes import INT64
+        from spark_rapids_jni_tpu.ops.hashing import murmur_hash32
+
+        return murmur_hash32([Column(data, None, INT64)], seed=42).data
+
+    from spark_rapids_jni_tpu.serve.ragged import RaggedSpec
+
     engine.register(QueryHandler(
         name="hash32",
         fn=run_hash,
@@ -991,6 +1108,11 @@ def register_builtin_handlers(engine: ServingEngine) -> None:
         batch=lambda ps: np.concatenate(
             [np.asarray(p, np.int64) for p in ps]),
         unbatch=unbatch_hash,
+        ragged=RaggedSpec(
+            rows_of=lambda p: np.asarray(p, np.int64),
+            kernel=hash_kernel,
+            kernel_key="builtin.hash32",
+        ),
         max_batch=16,
     ))
 

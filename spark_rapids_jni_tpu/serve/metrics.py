@@ -21,7 +21,8 @@ from typing import Callable, Dict, Optional
 
 from spark_rapids_jni_tpu.obs import flight as _flight
 
-__all__ = ["LatencyHistogram", "ServeMetrics", "percentile_of_counts"]
+__all__ = ["LatencyHistogram", "ServeMetrics", "percentile_of_counts",
+           "BATCH_MISS_REASONS"]
 
 
 def percentile_of_counts(counts, p: float) -> int:
@@ -99,6 +100,29 @@ COUNTERS = (
     "cancelled",        # queue shut down with the request still waiting
     "protocol_leaked",  # control-flow exception escaped every bracket (bug)
     "hung",             # watchdog flagged a handler past its EWMA bound
+    # continuous ragged batching (serve/ragged.py, round 12): the fused
+    # page-pool launch path.  launches-saved and occupancy gauges derive
+    # from these in the engine's gauge source.
+    "ragged_batched",   # riders that rode a fused page-pool launch
+    "ragged_launches",  # fused page-pool launches issued
+    "ragged_pages",     # pages packed across all launches
+    "ragged_rows",      # real rows packed across all launches
+    "ragged_row_capacity",  # pool row capacity across all launches
+    "ragged_splits",    # SplitAndRetryOOM page-count halvings
+)
+
+# why a request did NOT merge into a batch (micro or ragged gather) —
+# a small counter map rather than COUNTERS entries so dashboards can
+# iterate reasons without a fixed schema; the ragged-vs-micro win
+# condition ("how much merge opportunity does micro-batching leave on
+# the table?") is read directly off this map in serve snapshots and the
+# engine's flight telemetry source.
+BATCH_MISS_REASONS = (
+    "no_batch",          # handler has no batch hooks / is self-governed
+    "post_split",        # request is a split product (no_batch flag)
+    "disabled",          # micro_batch_max <= 1 (see micro_batch_disabled)
+    "handler_mismatch",  # queued candidate serves a different handler
+    "cap",               # ride filled to max_batch / pool capacity
 )
 
 # supervisor-tier counter vocabulary (serve/supervisor.py): lease and
@@ -129,6 +153,8 @@ class ServeMetrics:
         # presplit probe compares a class's p99 across probe windows, which
         # the single global histogram cannot answer
         self._run_by_handler: Dict[str, LatencyHistogram] = {}  # guarded-by: _lock
+        # batch-miss reason -> count (see BATCH_MISS_REASONS)
+        self._batch_miss: Dict[str, int] = {}  # guarded-by: _lock
         self._depth = 0  # guarded-by: _lock
         self._gauge_source: Optional[Callable[[], dict]] = None  # guarded-by: _lock
         self._gauge_cache: Dict[str, int] = {}  # guarded-by: _lock
@@ -175,6 +201,16 @@ class ServeMetrics:
                 sess = self._per_session.setdefault(
                     session_id, defaultdict(int))
                 sess[name] += n
+
+    def count_batch_miss(self, reason: str, n: int = 1) -> None:
+        """One request (or scanned candidate) failed to merge into a
+        batch for ``reason`` — the merge-opportunity ledger."""
+        with self._lock:
+            self._batch_miss[reason] = self._batch_miss.get(reason, 0) + n
+
+    def batch_miss(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._batch_miss)
 
     def record_wait(self, ns: int) -> None:
         with self._lock:
@@ -224,6 +260,7 @@ class ServeMetrics:
                              if k in self._global})
             return {
                 "counters": counters,
+                "batch_miss": dict(self._batch_miss),
                 "queue_depth": self._depth,
                 "queue_wait": self.queue_wait.snapshot(),
                 "run_latency": self.run_latency.snapshot(),

@@ -140,10 +140,13 @@ def test_event_kind_vocabulary_is_stable():
                                          "control_presplit")
     assert (flight.KIND_IDS[flight.EV_TASK_HUNG]
             > flight.KIND_IDS[flight.EV_CONTROL_PRESPLIT])
-    assert flight.EVENT_KINDS[-8:] == (
+    assert flight.EVENT_KINDS[16:24] == (
         "task_hung", "degrade_enter", "degrade_exit",
         "lease_grant", "lease_redispatch", "lease_done",
         "worker_spawn", "worker_dead")
+    # round 12: the ragged batching kinds are strictly appended after
+    assert flight.EVENT_KINDS[-3:] == (
+        "ragged_pack", "ragged_launch", "ragged_split")
     assert len(set(flight.EVENT_KINDS)) == len(flight.EVENT_KINDS)
 
 

@@ -1,0 +1,536 @@
+"""Continuous ragged batching: pack/scatter parity, page-pool geometry
+bounds, the fused serving path vs the per-request oracle, and the
+page-granularity split protocol under injected pressure.
+
+The headline invariant is bit-identical scatter-back: for ANY mix of
+row counts (zero-row riders, one giant rider, a full pool of riders),
+the ragged path's per-session results equal the unbatched oracle's
+exactly, with zero requests lost — and the compiled-variant set is
+bounded by page geometries, not request shapes.
+"""
+
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import pages
+from spark_rapids_jni_tpu.mem import BudgetedResource, MemoryGovernor
+from spark_rapids_jni_tpu.serve import QueryHandler, RaggedSpec, ServingEngine
+
+
+@pytest.fixture
+def gov():
+    g = MemoryGovernor(watchdog_period_s=0.02)
+    yield g
+    g.close()
+
+
+def _engine(gov, budget_bytes=1 << 30, **kw):
+    budget = BudgetedResource(gov, budget_bytes)
+    kw.setdefault("workers", 2)
+    kw.setdefault("queue_size", 64)
+    kw.setdefault("default_deadline_s", 30.0)
+    return ServingEngine(gov=gov, budget=budget, **kw)
+
+
+# ------------------------------------------------------- pack / scatter
+
+
+ADVERSARIAL_MIXES = [
+    [0],                      # a single empty rider
+    [0, 0, 0],                # all-empty tick
+    [1],                      # minimal rider
+    [5000],                   # single giant rider (> several pages)
+    [0, 5, 1000, 3, 0, 257],  # mixed with zeros
+    list(range(64)),          # max-rider page, tiny ragged lengths
+    [4096] + [1] * 63,        # one giant + a swarm
+]
+
+
+@pytest.mark.parametrize("mix", ADVERSARIAL_MIXES)
+def test_pack_scatter_roundtrip(mix):
+    rng = np.random.RandomState(42)
+    rows = [rng.randint(-1000, 1000, n).astype(np.int64) for n in mix]
+    packed = pages.pack_ragged(rows, 256)
+    # offsets index riders in submit order
+    assert packed.n_riders == len(rows)
+    assert int(packed.offsets[-1]) == sum(mix) == packed.rows_packed
+    # valid marks exactly the packed rows; rid pads with riders_cap
+    assert int(packed.valid.sum()) == sum(mix)
+    assert (packed.rid[packed.rows_packed:]
+            == packed.geometry.riders_cap).all()
+    back = pages.scatter_ragged(packed.data, packed)
+    assert len(back) == len(rows)
+    for a, b in zip(rows, back):
+        assert np.array_equal(a, b)
+
+
+def test_pack_geometry_is_pow2_quantized():
+    geoms = set()
+    for total in range(0, 10_000, 37):
+        g = pages.geometry_for(total, 7, 256, "int64")
+        assert g.num_pages & (g.num_pages - 1) == 0  # pow2
+        assert g.riders_cap & (g.riders_cap - 1) == 0
+        assert g.total_rows >= total
+        geoms.add(g)
+    # O(log rows) distinct geometries over a 10k-row range
+    assert len(geoms) <= 8
+
+
+def test_pack_floors_at_standing_pool():
+    """min_pages floors the geometry: half-empty ticks share the full
+    pool's compiled shape (the one-program steady state)."""
+    small = pages.pack_ragged([np.arange(3, dtype=np.int64)], 256,
+                              min_pages=64, min_riders=64)
+    assert small.geometry.num_pages == 64
+    assert small.geometry.riders_cap == 64
+    # the giant rider grows past the floor, pow2
+    big = pages.pack_ragged([np.arange(64 * 256 + 1, dtype=np.int64)], 256,
+                            min_pages=64, min_riders=64)
+    assert big.geometry.num_pages == 128
+
+
+def test_pack_rejects_mixed_dtypes_and_2d():
+    with pytest.raises(ValueError, match="dtype"):
+        pages.pack_ragged([np.zeros(2, np.int64), np.zeros(2, np.int32)], 16)
+    with pytest.raises(ValueError, match="1-D"):
+        pages.pack_ragged([np.zeros((2, 2), np.int64)], 16)
+
+
+def test_split_point_is_the_one_cut_rule():
+    """The dispatcher's request-group split and split_riders both cut at
+    pages.split_point — one algorithm, one owner."""
+    assert pages.split_point([10, 10, 10, 10]) == 2
+    assert pages.split_point([100, 1, 1]) == 1   # giant first rider
+    assert pages.split_point([1, 1, 100]) == 2   # giant last rider
+    assert pages.split_point([5, 5]) == 1
+
+
+def test_pool_released_on_launch_fault():
+    """A failing launch must still recycle the pooled buffers — pool
+    reuse has to survive exactly the chaos the feature gates on."""
+    from spark_rapids_jni_tpu.serve.ragged import RaggedSpec, run_rows_compiled
+
+    def broken_kernel(data, valid, rid, riders_cap):
+        raise ValueError("kernel bug")
+
+    spec = RaggedSpec(rows_of=lambda p: np.asarray(p, np.int64),
+                      kernel=broken_kernel, kernel_key="test.broken")
+    before = pages.page_pool.gauges()["buffers_free"]
+    with pytest.raises(ValueError, match="kernel bug"):
+        run_rows_compiled(spec, np.arange(8, dtype=np.int64), 16)
+    assert pages.page_pool.gauges()["buffers_free"] >= before + 1
+
+
+def test_split_riders_halves_without_drops():
+    rows = [np.arange(n, dtype=np.int64) for n in (10, 10, 10, 10)]
+    halves = pages.split_riders(rows)
+    assert len(halves) == 2
+    assert [len(h) for h in halves] == [2, 2]
+    flat = [a for h in halves for a in h]
+    assert all(np.array_equal(a, b) for a, b in zip(rows, flat))
+    # a single rider cannot halve
+    assert len(pages.split_riders(rows[:1])) == 1
+
+
+def test_page_pool_recycles_buffers():
+    pool = pages.PagePool()
+    p1 = pages.pack_ragged([np.arange(10, dtype=np.int64)], 16, pool=pool)
+    pool.release(p1)
+    g0 = pool.gauges()
+    assert g0["buffers_free"] == 1
+    p2 = pages.pack_ragged([np.arange(4, dtype=np.int64)], 16, pool=pool)
+    g1 = pool.gauges()
+    assert g1["reuses"] == 1 and g1["buffers_free"] == 0
+    # the recycled buffer was re-zeroed: only the new rows are valid
+    assert int(p2.valid.sum()) == 4
+    assert np.array_equal(pages.scatter_ragged(p2.data, p2)[0],
+                          np.arange(4))
+    # the free list is bounded per geometry
+    packs = [pages.pack_ragged([np.arange(8, dtype=np.int64)], 16,
+                               pool=pool) for _ in range(10)]
+    for p in packs:
+        pool.release(p)
+    assert pool.gauges()["buffers_free"] <= pages.PagePool.MAX_FREE_PER_GEOMETRY
+
+
+# ------------------------------------------- the fused path vs the oracle
+
+
+def _hash_engines(gov):
+    """A ragged engine and its flag-off oracle twin over one governor."""
+    from spark_rapids_jni_tpu.parallel import make_mesh
+
+    mesh = make_mesh()
+    ragged = ServingEngine(mesh=mesh, gov=gov,
+                           budget=BudgetedResource(gov, 1 << 30),
+                           workers=2, queue_size=128,
+                           builtin_handlers=True, serve_ragged=True)
+    oracle = ServingEngine(mesh=mesh, gov=gov,
+                           budget=BudgetedResource(gov, 1 << 30),
+                           workers=2, queue_size=128,
+                           builtin_handlers=True, serve_ragged=False)
+    return ragged, oracle
+
+
+def test_fuzz_parity_ragged_vs_oracle(gov):
+    """The acceptance fuzz: adversarial row-count mixes through the
+    built-in hash32 handler on the ragged path vs the micro-batch oracle
+    — bit-identical per-request results, nothing lost."""
+    ragged, oracle = _hash_engines(gov)
+    try:
+        rng = np.random.RandomState(7)
+        mixes = list(ADVERSARIAL_MIXES)
+        for _ in range(3):  # fuzz rounds on top of the fixed corpus
+            mixes.append(list(rng.randint(0, 3000, rng.randint(1, 40))))
+        for mix in mixes:
+            payloads = [rng.randint(0, 1 << 40, n) for n in mix]
+            sr = ragged.open_session()
+            so = oracle.open_session()
+            r_resps = [ragged.submit(sr, "hash32", p) for p in payloads]
+            o_resps = [oracle.submit(so, "hash32", p) for p in payloads]
+            for rr, orr, p in zip(r_resps, o_resps, payloads):
+                a = np.asarray(rr.result(timeout=60))
+                b = np.asarray(orr.result(timeout=60))
+                assert a.shape[0] == len(p)
+                assert np.array_equal(a, b)
+        assert ragged.metrics.get("ragged_launches") >= 1
+        assert (ragged.metrics.get("ragged_batched")
+                >= ragged.metrics.get("ragged_launches"))
+        # the oracle never touched the ragged path
+        assert oracle.metrics.get("ragged_launches") == 0
+    finally:
+        ragged.shutdown()
+        oracle.shutdown()
+
+
+def test_riders_out_per_rider_reduction(gov):
+    """out='riders' kernels (per-rider segment reductions) scatter one
+    value per rider, zero for empty riders."""
+    import jax
+    import jax.numpy as jnp
+
+    def sum_kernel(data, valid, rid, riders_cap):
+        vals = jnp.where(valid, data, jnp.int64(0))
+        return jax.ops.segment_sum(vals, rid,
+                                   num_segments=riders_cap + 1)[:-1]
+
+    spec = RaggedSpec(rows_of=lambda p: np.asarray(p, np.int64),
+                      kernel=sum_kernel, out="riders",
+                      result_of=lambda out, p: int(out),
+                      kernel_key="test.ragged_sum")
+    eng = _engine(gov, serve_ragged=True, workers=1)
+    try:
+        eng.register(QueryHandler(
+            name="rsum", fn=lambda p, ctx: int(np.sum(p)),
+            nbytes_of=lambda p: 8 * max(len(p), 1), ragged=spec))
+        s = eng.open_session()
+        blocker = eng.submit(s, "rsum", list(range(50)))
+        payloads = [list(range(n)) for n in (0, 3, 100, 1)]
+        resps = [eng.submit(s, "rsum", p) for p in payloads]
+        assert blocker.result(timeout=30) == sum(range(50))
+        for resp, p in zip(resps, payloads):
+            assert resp.result(timeout=30) == sum(p)
+    finally:
+        eng.shutdown()
+
+
+def test_compiles_bounded_by_page_geometry(gov):
+    """Heterogeneous ticks through the standing pool compile ONE program
+    (the pool geometry), however many request shapes flow through — the
+    cache-pressure collapse the tentpole exists for."""
+    from spark_rapids_jni_tpu.plans.cache import plan_cache
+
+    ragged, oracle = _hash_engines(gov)
+    try:
+        rng = np.random.RandomState(3)
+        before = plan_cache.stats()
+        s = ragged.open_session()
+        for _ in range(5):
+            payloads = [rng.randint(0, 1 << 30, int(n)) for n in
+                        rng.randint(0, 2000, 12)]
+            resps = [ragged.submit(s, "hash32", p) for p in payloads]
+            for r in resps:
+                r.result(timeout=60)
+        after = plan_cache.stats()
+        # one pool geometry (pow2 floor) regardless of the 60 shapes
+        assert after["misses"] - before["misses"] <= 2
+        assert ragged.metrics.get("ragged_launches") >= 5
+    finally:
+        ragged.shutdown()
+        oracle.shutdown()
+
+
+# ------------------------------------------------- split / chaos protocol
+
+
+def _sum_spec():
+    import jax
+    import jax.numpy as jnp
+
+    def sum_kernel(data, valid, rid, riders_cap):
+        vals = jnp.where(valid, data, jnp.int64(0))
+        return jax.ops.segment_sum(vals, rid,
+                                   num_segments=riders_cap + 1)[:-1]
+
+    return RaggedSpec(rows_of=lambda p: np.asarray(p, np.int64),
+                      kernel=sum_kernel, out="riders",
+                      result_of=lambda out, p: int(out),
+                      kernel_key="test.ragged_sum")
+
+
+def test_injected_split_oom_halves_pages_multi_rider(gov):
+    """An injected SplitAndRetryOOM against a MULTI-rider fused launch
+    drives the page-halving protocol: riders partition into two packs at
+    half the page count, every result still lands, nothing is lost."""
+    from spark_rapids_jni_tpu.obs import flight as _flight
+    from spark_rapids_jni_tpu.obs.faultinj import FaultInjector
+
+    eng = _engine(gov, serve_ragged=True, workers=1)
+    try:
+        eng.register(QueryHandler(
+            name="rsum", fn=lambda p, ctx: int(np.sum(p)),
+            nbytes_of=lambda p: 8 * max(len(p), 1), ragged=_sum_spec(),
+            split=lambda p: [p[:len(p) // 2], p[len(p) // 2:]],
+            combine=lambda rs: int(sum(rs))))
+        # the blocker is a DIFFERENT handler (its own seam label), so the
+        # one-shot fault below can only hit the multi-rider rsum pack
+        eng.register(QueryHandler(
+            name="blk", fn=lambda p, ctx: time.sleep(0.1) or p))
+        s = eng.open_session()
+        # backs the queue up behind the single worker, so the next pop
+        # gathers a genuinely multi-rider pack
+        blocker = eng.submit(s, "blk", 1)
+        FaultInjector.install({
+            "serve": {"handle:rsum": {"injectionType": "split_oom",
+                                      "interceptionCount": 1}},
+        })
+        payloads = [list(range(n)) for n in (100, 7, 0, 300, 42)]
+        resps = [eng.submit(s, "rsum", p) for p in payloads]
+        assert blocker.result(timeout=30) == 1
+        for resp, p in zip(resps, payloads):
+            assert resp.result(timeout=30) == sum(p)
+        assert eng.metrics.get("ragged_splits") >= 1
+        kinds = [e["kind"] for e in _flight.snapshot()]
+        assert "ragged_split" in kinds
+        assert eng.budget.used == 0  # bracket unwound clean
+    finally:
+        FaultInjector.uninstall()
+        eng.shutdown()
+
+
+def test_single_rider_split_falls_back_to_handler_split(gov):
+    """A single-rider pack that draws a split signal falls back to the
+    classic per-request protocol: h.split halves re-queue and join — the
+    rider is never dropped."""
+    from spark_rapids_jni_tpu.obs.faultinj import FaultInjector
+
+    eng = _engine(gov, serve_ragged=True, workers=1)
+    try:
+        eng.register(QueryHandler(
+            name="rsum", fn=lambda p, ctx: int(np.sum(p)),
+            nbytes_of=lambda p: 8 * max(len(p), 1), ragged=_sum_spec(),
+            split=lambda p: [p[:len(p) // 2], p[len(p) // 2:]],
+            combine=lambda rs: int(sum(rs))))
+        FaultInjector.install({
+            "serve": {"handle:rsum": {"injectionType": "split_oom",
+                                      "interceptionCount": 1}},
+        })
+        s = eng.open_session()
+        resp = eng.submit(s, "rsum", list(range(64)))
+        assert resp.result(timeout=30) == sum(range(64))
+        assert eng.metrics.get("split_requeued") >= 2  # both halves rode
+    finally:
+        FaultInjector.uninstall()
+        eng.shutdown()
+
+
+def test_unsplittable_single_rider_fails_loud(gov):
+    """No h.split and a split signal on a lone rider: terminal error
+    surfaced to the client — never a hang, never a silent drop."""
+    from spark_rapids_jni_tpu.obs.faultinj import FaultInjector
+
+    eng = _engine(gov, serve_ragged=True, workers=1)
+    try:
+        eng.register(QueryHandler(
+            name="rsum", fn=lambda p, ctx: int(np.sum(p)),
+            nbytes_of=lambda p: 8 * max(len(p), 1), ragged=_sum_spec()))
+        FaultInjector.install({
+            "serve": {"handle:rsum": {"injectionType": "split_oom",
+                                      "interceptionCount": 1}},
+        })
+        s = eng.open_session()
+        resp = eng.submit(s, "rsum", list(range(8)))
+        with pytest.raises(Exception):
+            resp.result(timeout=30)
+        assert eng.metrics.get("failed") == 1
+        assert eng.budget.used == 0
+    finally:
+        FaultInjector.uninstall()
+        eng.shutdown()
+
+
+def test_injected_retry_oom_reruns_same_pack(gov):
+    """RetryOOM inside the fused bracket re-runs the SAME pack (a plan-
+    cache hit, zero retrace) — the rider set is stable across retries."""
+    from spark_rapids_jni_tpu.obs.faultinj import FaultInjector
+
+    eng = _engine(gov, serve_ragged=True, workers=1)
+    try:
+        eng.register(QueryHandler(
+            name="rsum", fn=lambda p, ctx: int(np.sum(p)),
+            nbytes_of=lambda p: 8 * max(len(p), 1), ragged=_sum_spec()))
+        FaultInjector.install({
+            "alloc": {"reserve:dev:*": {"injectionType": "retry_oom",
+                                        "interceptionCount": 1}},
+        })
+        s = eng.open_session()
+        resp = eng.submit(s, "rsum", list(range(20)))
+        assert resp.result(timeout=30) == sum(range(20))
+        assert eng.metrics.get("retried") >= 1
+        assert eng.budget.used == 0
+    finally:
+        FaultInjector.uninstall()
+        eng.shutdown()
+
+
+# ------------------------------------------------ batch-miss observability
+
+
+def test_batch_miss_reasons_counted(gov):
+    """Every way a request fails to merge lands in the ServeMetrics
+    batch-miss map (the ragged-vs-micro win-condition ledger), and the
+    map rides snapshots (hence the engine's flight telemetry source)."""
+    eng = _engine(gov, workers=1)
+    try:
+        eng.register(QueryHandler(name="plain", fn=lambda p, ctx: p))
+        s = eng.open_session()
+        assert eng.submit(s, "plain", 1).result(timeout=30) == 1
+        miss = eng.metrics.batch_miss()
+        assert miss.get("no_batch", 0) >= 1  # handler cannot batch
+        assert "batch_miss" in eng.metrics.snapshot()
+    finally:
+        eng.shutdown()
+
+
+def test_batch_miss_handler_mismatch(gov):
+    eng = _engine(gov, workers=1)
+    try:
+        slow_started = threading.Event()
+
+        def slow(p, ctx):
+            slow_started.set()
+            time.sleep(0.1)
+            return sum(p)
+
+        eng.register(QueryHandler(
+            name="a", fn=slow,
+            batch=lambda ps: [x for p in ps for x in p],
+            unbatch=lambda res, ps: [res] * len(ps)))
+        eng.register(QueryHandler(name="b", fn=lambda p, ctx: p))
+        s = eng.open_session()
+        first = eng.submit(s, "a", [1])        # occupies the worker
+        slow_started.wait(timeout=10)
+        # "b" queues at LOWER priority, so the next "a" pops first and
+        # its gather scans the queued "b" — a handler mismatch
+        other = eng.submit(s, "b", 2, priority=-1)
+        second = eng.submit(s, "a", [3])
+        assert first.result(timeout=30) == 1
+        assert other.result(timeout=30) == 2
+        second.result(timeout=30)
+        miss = eng.metrics.batch_miss()
+        assert miss.get("handler_mismatch", 0) >= 1
+    finally:
+        eng.shutdown()
+
+
+def test_micro_batch_disabled_warns_once_and_gauges(gov):
+    """micro_batch_max <= 1 used to silently disable batching; now it
+    warns once per process and every snapshot carries the gauge."""
+    from spark_rapids_jni_tpu.serve import executor as _ex
+
+    saved = list(_ex._BATCH_DISABLED_WARNED)
+    _ex._BATCH_DISABLED_WARNED.clear()
+    try:
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            eng = _engine(gov, micro_batch_max=1)
+        try:
+            assert any("micro_batch_max" in str(w.message) for w in caught)
+            snap = eng.metrics.snapshot()
+            assert snap["gauges"]["micro_batch_disabled"] == 1
+            # a request still flows, counted as a disabled-batch miss
+            eng.register(QueryHandler(
+                name="h", fn=lambda p, ctx: p,
+                batch=lambda ps: ps, unbatch=lambda res, ps: res))
+            s = eng.open_session()
+            assert eng.submit(s, "h", 5).result(timeout=30) == 5
+            assert eng.metrics.batch_miss().get("disabled", 0) >= 1
+        finally:
+            eng.shutdown()
+        # second engine: no second warning (one-time per process)
+        with warnings.catch_warnings(record=True) as caught2:
+            warnings.simplefilter("always")
+            eng2 = _engine(gov, micro_batch_max=1)
+        try:
+            assert not any("micro_batch_max" in str(w.message)
+                           for w in caught2)
+        finally:
+            eng2.shutdown()
+        # a healthy engine gauges 0
+        eng3 = _engine(gov)
+        try:
+            assert eng3.metrics.snapshot()["gauges"][
+                "micro_batch_disabled"] == 0
+        finally:
+            eng3.shutdown()
+    finally:
+        _ex._BATCH_DISABLED_WARNED.clear()
+        _ex._BATCH_DISABLED_WARNED.extend(saved)
+
+
+def test_flag_off_is_todays_behavior(gov):
+    """serve_ragged=False: the dispatcher is never built, no ragged
+    counters move, and a ragged-capable handler micro-batches exactly as
+    before — the bit-identical oracle contract."""
+    eng = _engine(gov, workers=1, serve_ragged=False)
+    try:
+        assert eng._ragged is None
+        eng.register(QueryHandler(
+            name="rsum", fn=lambda p, ctx: int(np.sum(p)),
+            nbytes_of=lambda p: 8 * max(len(p), 1), ragged=_sum_spec()))
+        s = eng.open_session()
+        resps = [eng.submit(s, "rsum", list(range(n))) for n in (3, 9, 0)]
+        assert [r.result(timeout=30) for r in resps] == [3, 36, 0]
+        for k in ("ragged_launches", "ragged_batched", "ragged_splits"):
+            assert eng.metrics.get(k) == 0
+    finally:
+        eng.shutdown()
+
+
+def test_ragged_flight_events_narrate_the_tick(gov):
+    """Every fused tick narrates pack -> launch into the flight ring with
+    the frozen EV_RAGGED_* kinds."""
+    from spark_rapids_jni_tpu.obs import flight as _flight
+
+    eng = _engine(gov, serve_ragged=True, workers=1)
+    try:
+        eng.register(QueryHandler(
+            name="rsum", fn=lambda p, ctx: int(np.sum(p)),
+            nbytes_of=lambda p: 8 * max(len(p), 1), ragged=_sum_spec()))
+        s = eng.open_session()
+        assert eng.submit(s, "rsum", [1, 2, 3]).result(timeout=30) == 6
+        # the ring is process-global: filter this handler's events
+        events = [e for e in _flight.snapshot()
+                  if e["kind"].startswith("ragged_")
+                  and "handler:rsum" in e["detail"]]
+        kinds = [e["kind"] for e in events]
+        assert "ragged_pack" in kinds and "ragged_launch" in kinds
+        # earlier tests share the ring: the NEWEST rsum pack is this tick
+        pack = [e for e in events if e["kind"] == "ragged_pack"][-1]
+        assert pack["value"] == 3
+    finally:
+        eng.shutdown()

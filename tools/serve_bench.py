@@ -292,6 +292,230 @@ def _verify_cluster_dumps(dump_dir: str) -> dict:
     }
 
 
+def _ragged_round(args, *, ragged: bool, chaos: bool) -> dict:
+    """One heterogeneous-row-count storm round (fresh governor/engine):
+    every client submits requests whose row counts are drawn log-uniform
+    (plus a slice of zero-row requests), each wanting its own per-request
+    sum.  ``ragged`` toggles the page-pool fused path on an otherwise
+    identical configuration; BOTH paths run the SAME kernel through the
+    SAME plan cache (the classic fn is serve/ragged.run_rows_compiled,
+    the per-request oracle), so the plan-cache miss delta is a
+    like-for-like compile count.  ``chaos`` arms the round-9 pressure
+    storm (injected RetryOOM on reservations + split_oom at the serve
+    seam both paths cross)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_rapids_jni_tpu.mem import BudgetedResource, MemoryGovernor
+    from spark_rapids_jni_tpu.obs.faultinj import (
+        FaultInjector,
+        pressure_storm_config,
+    )
+    from spark_rapids_jni_tpu.plans import plan_cache
+    from spark_rapids_jni_tpu.serve import (
+        Backpressure,
+        QueryHandler,
+        RaggedSpec,
+        RequestTimeout,
+        ServingEngine,
+    )
+    from spark_rapids_jni_tpu.serve.ragged import run_rows_compiled
+
+    from spark_rapids_jni_tpu import config
+
+    # paired rounds must not share compiled entries: each round pays (and
+    # counts) its own compiles
+    plan_cache.clear()
+    cache_before = plan_cache.stats()
+
+    gov = MemoryGovernor(watchdog_period_s=0.02)
+    budget = BudgetedResource(gov, args.ragged_budget)
+    engine = ServingEngine(
+        gov=gov, budget=budget, workers=args.workers,
+        queue_size=args.queue_size, default_deadline_s=args.deadline_s,
+        serve_ragged=ragged)
+    page_rows = int(config.get("serve_page_rows"))
+
+    def storm_kernel(data, valid, rid, riders_cap):
+        vals = jnp.where(valid, data, jnp.int64(0))
+        return jax.ops.segment_sum(vals, rid,
+                                   num_segments=riders_cap + 1)[:-1]
+
+    spec = RaggedSpec(
+        rows_of=lambda p: np.asarray(p, np.int64),
+        kernel=storm_kernel, out="riders",
+        result_of=lambda out, p: int(out),
+        kernel_key="bench.ragged_storm_sum")
+
+    def storm_fn(p, ctx):
+        # the per-request oracle: same kernel, same cache, one rider —
+        # compiled per request-shape bucket (exactly the variant
+        # explosion the ragged path collapses)
+        return int(run_rows_compiled(spec, np.asarray(p, np.int64),
+                                     page_rows))
+
+    engine.register(QueryHandler(
+        name="rstorm", fn=storm_fn,
+        nbytes_of=lambda p: 64 * max(len(p), 1),
+        split=lambda p: [p[:len(p) // 2], p[len(p) // 2:]],
+        combine=lambda rs: int(sum(rs)),
+        ragged=spec))
+    if chaos:
+        FaultInjector.install(pressure_storm_config(args.seed))
+
+    per_client = max(1, args.requests // args.clients)
+    total = per_client * args.clients
+    lock = threading.Lock()
+    tally = {"succeeded": 0, "rejected": 0, "timed_out": 0, "errors": 0,
+             "client_retries": 0, "wrong_answers": 0}
+    rows_done = [0]
+
+    def client(ci: int) -> None:
+        rng = np.random.RandomState(args.seed * 1000 + ci)
+        sess = engine.open_session(
+            f"ragged{ci}", priority=1 if ci % 3 == 0 else 0)
+        for _ri in range(per_client):
+            if rng.random_sample() < args.ragged_zero_pct / 100.0:
+                n = 0
+            else:  # log-uniform row counts: the heterogeneity the
+                # micro-batcher compiles per shape
+                n = int(2 ** rng.uniform(0, np.log2(args.ragged_max_rows)))
+            payload = rng.randint(0, 1000, n).astype(np.int64)
+            want = int(payload.sum())
+            outcome = "rejected"
+            for _ in range(args.max_retries):
+                try:
+                    resp = engine.submit(sess, "rstorm", payload)
+                except Backpressure as bp:
+                    with lock:
+                        tally["client_retries"] += 1
+                    time.sleep(min(bp.retry_after_s, 0.05))
+                    continue
+                try:
+                    out = resp.result(timeout=args.deadline_s + 30)
+                except RequestTimeout:
+                    outcome = "timed_out"
+                except Exception:  # noqa: BLE001 - counted, not raised
+                    outcome = "errors"
+                else:
+                    outcome = "succeeded"
+                    if out != want:
+                        with lock:
+                            tally["wrong_answers"] += 1
+                break
+            with lock:
+                tally[outcome] += 1
+                if outcome == "succeeded":
+                    rows_done[0] += n
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(ci,))
+               for ci in range(args.clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    snap = engine.metrics.snapshot()
+    engine.shutdown()
+    if chaos:
+        FaultInjector.uninstall()
+    gov.close()
+    cache_after = plan_cache.stats()
+    accounted = (tally["succeeded"] + tally["rejected"] + tally["timed_out"]
+                 + tally["errors"])
+    counters = snap["counters"]
+    return {
+        "ragged": ragged,
+        "chaos": chaos,
+        "requests": total,
+        "wall_s": round(wall, 3),
+        "rows": rows_done[0],
+        "rows_per_s": round(rows_done[0] / wall, 1),
+        "outcomes": tally,
+        "lost": total - accounted,
+        "zero_lost": (accounted == total and tally["errors"] == 0
+                      and tally["wrong_answers"] == 0),
+        "compiles": int(cache_after["misses"] - cache_before["misses"]),
+        "launches": (counters.get("ragged_launches", 0) if ragged
+                     else tally["succeeded"]),
+        "ragged_counters": {k: counters.get(k, 0) for k in
+                            ("ragged_batched", "ragged_launches",
+                             "ragged_pages", "ragged_rows",
+                             "ragged_splits")},
+        "batch_miss": snap.get("batch_miss", {}),
+        "gauges": {k: v for k, v in snap.get("gauges", {}).items()
+                   if k.startswith(("ragged_", "page_pool_"))},
+    }
+
+
+def _run_ragged_storm(args) -> int:
+    """``--ragged-storm``: the continuous-ragged-batching acceptance.
+
+    Paired (micro, ragged) rounds per seed under identical request
+    schedules — calm pairs judge throughput and compile counts, a final
+    chaos pair (seeded pressure storm) judges the protocol: zero lost,
+    zero wrong answers on BOTH paths.  Gates: ragged beats micro on
+    MEDIAN rows/s, issues STRICTLY fewer plan-cache compiles in every
+    calm pair, and both paths return bit-identical (oracle-checked)
+    per-session results with nothing lost."""
+    import statistics
+
+    base_seed = args.seed
+    pairs = []
+    for i in range(max(1, args.ragged_rounds)):
+        args.seed = base_seed + i
+        micro = _ragged_round(args, ragged=False, chaos=False)
+        ragged = _ragged_round(args, ragged=True, chaos=False)
+        pairs.append({"seed": args.seed, "micro": micro, "ragged": ragged})
+    args.seed = base_seed
+    chaos_pair = {
+        "micro": _ragged_round(args, ragged=False, chaos=True),
+        "ragged": _ragged_round(args, ragged=True, chaos=True),
+    }
+    rows_micro = statistics.median(p["micro"]["rows_per_s"] for p in pairs)
+    rows_ragged = statistics.median(p["ragged"]["rows_per_s"] for p in pairs)
+    comparison = {
+        "pairs": len(pairs),
+        "rows_per_s_micro": rows_micro,
+        "rows_per_s_ragged": rows_ragged,
+        "speedup": round(rows_ragged / max(rows_micro, 1e-9), 2),
+        "compiles_micro": sum(p["micro"]["compiles"] for p in pairs),
+        "compiles_ragged": sum(p["ragged"]["compiles"] for p in pairs),
+        "launches_micro": sum(p["micro"]["launches"] for p in pairs),
+        "launches_ragged": sum(p["ragged"]["launches"] for p in pairs),
+    }
+    gates = {
+        "ragged_wins_rows_per_s": rows_ragged > rows_micro,
+        "ragged_fewer_compiles": all(
+            p["ragged"]["compiles"] < p["micro"]["compiles"]
+            for p in pairs),
+        "identical_results": all(
+            p[k]["zero_lost"] for p in pairs for k in ("micro", "ragged")),
+        "chaos_zero_lost": (chaos_pair["micro"]["zero_lost"]
+                            and chaos_pair["ragged"]["zero_lost"]),
+    }
+    rec = {
+        "name": "BENCH_serve",
+        "mode": "ragged_storm",
+        "seed": base_seed,
+        "clients": args.clients,
+        "workers": args.workers,
+        "queue_size": args.queue_size,
+        "storm": {"max_rows": args.ragged_max_rows,
+                  "zero_pct": args.ragged_zero_pct,
+                  "budget": args.ragged_budget},
+        "rounds": pairs,
+        "chaos_pair": chaos_pair,
+        "comparison": comparison,
+        "gates": gates,
+        "zero_lost": gates["identical_results"] and gates["chaos_zero_lost"],
+    }
+    print(json.dumps(rec))
+    return 0 if all(gates.values()) else 1
+
+
 def _chaos_tier(args, adaptive: bool) -> dict:
     """One pressure-storm run (fresh governor/engine/injector): a
     deliberately undersized device budget makes EVERY full-size request
@@ -510,6 +734,27 @@ def main(argv=None) -> int:
                          "emits one BENCH_serve comparison block (p99, "
                          "rejects, lost) — the adaptive-admission win "
                          "pinned in the bench trajectory")
+    ap.add_argument("--ragged-storm", action="store_true",
+                    help="run the heterogeneous-row-count storm in paired "
+                         "(micro, ragged) rounds under identical seeded "
+                         "schedules, plus one chaos pair (pressure "
+                         "storm); gates: ragged wins median rows/s, "
+                         "strictly fewer plan-cache compiles per pair, "
+                         "oracle-identical results and zero lost on both "
+                         "paths")
+    ap.add_argument("--ragged-rounds", type=int, default=2,
+                    help="calm (micro, ragged) pairs for the ragged-storm "
+                         "verdict (seed+i per pair)")
+    ap.add_argument("--ragged-max-rows", type=int, default=8192,
+                    help="row counts draw log-uniform from [1, this] "
+                         "(plus --ragged-zero-pct zero-row requests)")
+    ap.add_argument("--ragged-zero-pct", type=float, default=5.0,
+                    help="percent of ragged-storm requests with ZERO rows "
+                         "(the adversarial empty rider)")
+    ap.add_argument("--ragged-budget", type=int, default=1 << 30,
+                    help="device budget for the ragged-storm rounds (the "
+                         "chaos pair's splits come from injected weather, "
+                         "not sustained starvation)")
     ap.add_argument("--storm-rows", type=int, default=256,
                     help="rows per storm request (chaos-storm mode)")
     ap.add_argument("--storm-bytes-per-row", type=int, default=1024,
@@ -563,6 +808,8 @@ def main(argv=None) -> int:
         return _run_cluster(args)
     if args.chaos_storm:
         return _run_chaos_storm(args)
+    if args.ragged_storm:
+        return _run_ragged_storm(args)
 
     import numpy as np
 
