@@ -57,14 +57,21 @@ WIRE_IDS_SCHEMA = "flight-wire-ids-v1"
 
 def load_message_registry(project: Project, config: Config
                           ) -> Tuple[Dict[str, tuple], List[Finding]]:
-    """``MESSAGE_FIELDS`` from the registry module ->
-    {tag_value: (tag_name, (field, ...))}; malformed entries are
-    findings."""
+    """``MESSAGE_FIELDS`` merged from every registry module ->
+    {tag_value: (tag_name, (field, ...))}; malformed entries (and a tag
+    two registries both claim) are findings."""
     registry: Dict[str, tuple] = {}
     findings: List[Finding] = []
-    mod = project.modules.get(config.wire_registry_module)
-    if mod is None:
-        return registry, findings
+    for modid in config.wire_registry_modules:
+        mod = project.modules.get(modid)
+        if mod is not None:
+            _load_one_registry(project, mod, registry, findings)
+    return registry, findings
+
+
+def _load_one_registry(project: Project, mod: ModuleInfo,
+                       registry: Dict[str, tuple],
+                       findings: List[Finding]) -> None:
     for node in mod.tree.body:
         if not (isinstance(node, ast.Assign)
                 and any(isinstance(t, ast.Name) and t.id == "MESSAGE_FIELDS"
@@ -87,9 +94,14 @@ def load_message_registry(project: Project, config: Config
                     f"MESSAGE_FIELDS entry for {kc[0] or kc[1]!r} must be "
                     f"a tuple of field-name strings"))
                 continue
+            if kc[1] in registry:
+                findings.append(Finding(
+                    "wire-protocol", mod.relpath, node.lineno,
+                    f"message tag {kc[1]!r} is declared by two wire "
+                    f"registries: every tag must have ONE schema"))
+                continue
             registry[kc[1]] = (kc[0] or repr(kc[1]),
                                tuple(e.value for e in vexpr.elts))
-    return registry, findings
 
 
 # --------------------------------------------------------------------------

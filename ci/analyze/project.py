@@ -64,11 +64,16 @@ class Config:
     guarded_scope: Tuple[str, ...] = ("mem.", "mem", "serve.", "serve",
                                       "plans.", "plans", "obs.", "obs",
                                       "columnar.pages")
-    # pass 8 (wire-protocol): the module declaring MESSAGE_FIELDS, the
-    # package modules whose construct/destructure sites are checked, and
-    # loose (non-package) files checked the same way
-    wire_registry_module: str = "serve.rpc"
-    wire_scope: Tuple[str, ...] = ("serve.rpc", "serve.supervisor")
+    # pass 8 (wire-protocol): the modules declaring MESSAGE_FIELDS
+    # registries (the supervisor pipe protocol in serve.rpc AND the
+    # peer-to-peer frame control protocol in columnar.frames — round
+    # 13's shuffle data plane), the package modules whose construct/
+    # destructure sites are checked, and loose (non-package) files
+    # checked the same way
+    wire_registry_modules: Tuple[str, ...] = ("serve.rpc",
+                                              "columnar.frames")
+    wire_scope: Tuple[str, ...] = ("serve.rpc", "serve.supervisor",
+                                   "serve.shuffle", "columnar.frames")
     wire_extra_files: Tuple[str, ...] = ("tests/cluster_worker.py",)
     # pass 8 (wire ids): the committed flight-event wire-id registry,
     # repo-root-relative; the module whose EVENT_KINDS order defines ids

@@ -63,6 +63,17 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu SRT_REEXECED=1 \
     python tools/serve_bench.py --cluster 4 --chaos-kill --clients 8 \
     --requests 120 --workers 2 --queue-size 16 --seed "${KILL_SEED:-3}"
 
+# crash-safe columnar shuffle tier (round 13): every request a q97
+# Exchange plan run as a REAL cross-process shuffle over the framed
+# peer-to-peer transport; the chaos round corrupts/truncates frames,
+# stalls peers, and SIGKILLs executors mid-exchange — gates on zero lost
+# + oracle-identical reduce outputs both rounds, >= 2 mid-shuffle kills
+# recovered with respawns, checksum-detected corruption re-fetched,
+# leases exactly-once, and bounded p99 inflation
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu SRT_REEXECED=1 \
+    python tools/serve_bench.py --cluster 3 --chaos-shuffle --clients 4 \
+    --requests 24 --seed "${SHUFFLE_SEED:-11}"
+
 # continuous ragged batching tier (round 12): paired (micro, ragged)
 # rounds under identical seeded heterogeneous-row-count schedules plus a
 # chaos pair (pressure storm) — gates on ragged winning median rows/s,

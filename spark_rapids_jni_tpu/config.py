@@ -310,6 +310,53 @@ register("serve_ragged_max_riders", 64,
          "capacity is its pow2; per-rider kernel outputs are sized by "
          "it).  Candidates past the row or rider cap stay queued for "
          "the next tick.", env="SRT_SERVE_RAGGED_MAX_RIDERS")
+register("serve_send_timeout_s", 10.0,
+         "Bounded-time guard on cross-process pipe sends (serve/rpc.py "
+         "SafeConn): a peer that stops draining its pipe for this long "
+         "surfaces as an EV_TASK_HUNG flight event and a failed send "
+         "(the caller's unreachable-peer path) instead of an indefinite "
+         "block holding the send lock.  <= 0 disables the guard.",
+         env="SRT_SERVE_SEND_TIMEOUT_S")
+register("serve_shuffle_fetch_timeout_s", 30.0,
+         "Total time a shuffle consumer will wait for one partition "
+         "(serve/shuffle.py) across map updates, reconnects, and "
+         "re-fetches before the piece fails with ShuffleFetchStalled "
+         "(which the supervisor re-dispatches, bounded by "
+         "lease_max_dispatches).  Must comfortably exceed the time a "
+         "dead producer takes to be detected, re-dispatched, and "
+         "re-produced on a survivor.",
+         env="SRT_SERVE_SHUFFLE_FETCH_TIMEOUT_S")
+register("serve_shuffle_io_timeout_s", 2.0,
+         "Per-attempt socket I/O timeout of one framed partition fetch: "
+         "a stalled peer (peer_stall chaos, wedged serving thread) trips "
+         "this, the consumer records EV_SHUFFLE_RETRY and backs off "
+         "with seeded jitter rather than hanging on the socket.",
+         env="SRT_SERVE_SHUFFLE_IO_TIMEOUT_S")
+register("serve_shuffle_backoff_ms", 10.0,
+         "Base backoff between shuffle fetch attempts; each attempt "
+         "sleeps base * attempt * jitter with jitter drawn from "
+         "[0.5, 1.5) of a per-(sid, task, part) seeded RNG, so "
+         "consumers storming a recovering producer de-phase "
+         "deterministically.", env="SRT_SERVE_SHUFFLE_BACKOFF_MS")
+register("serve_shuffle_jitter_seed", 0,
+         "Seed of the shuffle fetch backoff jitter (chaos determinism: "
+         "one seed yields one retry schedule).",
+         env="SRT_SERVE_SHUFFLE_JITTER_SEED")
+register("serve_shuffle_credit_bytes", 64 << 20,
+         "Credit window of the shuffle consumer: the transport reserves "
+         "min(partition bytes, this) from the executor's governed budget "
+         "around each fetch+decode, so in-flight transport memory "
+         "competes with compute under the SAME byte budget (blocking or "
+         "RetryOOM through the normal protocol instead of OOMing the "
+         "peer).", env="SRT_SERVE_SHUFFLE_CREDIT_BYTES")
+register("serve_shuffle_spool_dir", "",
+         "Same-host fast path of the shuffle transport: when set (e.g. "
+         "a directory under /dev/shm), producers additionally spool each "
+         "framed partition to '<dir>/<sid>_<map>_<part>.frame' and the "
+         "map broadcast carries the path, so same-host consumers read "
+         "shared memory instead of the socket (still CRC-verified).  "
+         "Empty (default) = socket-only.",
+         env="SRT_SERVE_SHUFFLE_SPOOL_DIR")
 register("serve_controller_freeze", False,
          "Kill switch for adaptive admission: when set, the controller "
          "immediately resets every knob to its static config value and "

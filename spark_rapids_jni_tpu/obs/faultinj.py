@@ -73,7 +73,8 @@ from spark_rapids_jni_tpu.mem.exceptions import (
 from spark_rapids_jni_tpu.obs import seam as _seam
 
 __all__ = ["FaultInjector", "install_from_env", "pressure_storm_config",
-           "chaos_kill_config", "ENV_CONFIG_PATH"]
+           "chaos_kill_config", "chaos_shuffle_config", "transport_fault",
+           "ENV_CONFIG_PATH"]
 
 ENV_CONFIG_PATH = "SRT_FAULT_INJECTOR_CONFIG_PATH"
 
@@ -91,12 +92,24 @@ _FAULTS = {
 _BEHAVIOR_KINDS = frozenset({"slow", "hang", "proc_kill"})
 _BEHAVIOR_DEFAULT_MS = {"slow": 50.0, "hang": 3_600_000.0}
 
+# transport kinds (round 13, the columnar data plane): the shuffle sender
+# consults :func:`transport_fault` per framed partition send and APPLIES
+# the verdict itself — a corrupted or truncated frame must actually cross
+# the wire (the receiver's CRC / length check is what's under test), so
+# the injector returns a verdict instead of raising.  ``peer_stall``
+# behaves like ``slow`` but lives in the shuffle category so one profile
+# can storm all three without rule-name shadowing.
+_TRANSPORT_KINDS = frozenset({"frame_corrupt", "frame_truncate",
+                              "peer_stall"})
+_BEHAVIOR_DEFAULT_MS.update({"peer_stall": 500.0})
+
 
 class _Rule:
     def __init__(self, spec: dict):
         self.percent = float(spec.get("percent", 100))
         self.kind = spec.get("injectionType", "exception")
-        if self.kind not in _FAULTS and self.kind not in _BEHAVIOR_KINDS:
+        if (self.kind not in _FAULTS and self.kind not in _BEHAVIOR_KINDS
+                and self.kind not in _TRANSPORT_KINDS):
             raise ValueError(f"unknown injectionType {self.kind!r}")
         self.duration_s = float(
             spec.get("durationMs", _BEHAVIOR_DEFAULT_MS.get(self.kind, 0.0))
@@ -115,7 +128,7 @@ class _Rule:
             return None
         if self.remaining is not None:
             self.remaining -= 1
-        if self.kind in _BEHAVIOR_KINDS:
+        if self.kind in _BEHAVIOR_KINDS or self.kind in _TRANSPORT_KINDS:
             return (self.kind, self.duration_s)
         return ("raise", _FAULTS[self.kind](name))
 
@@ -170,7 +183,7 @@ class FaultInjector:
     def _load(self, config: dict) -> None:
         rules = {}
         for cat in (_seam.OP, _seam.TRANSFER, _seam.COLLECTIVE, _seam.ALLOC,
-                    _seam.SPILL, _seam.COMPILE, _seam.SERVE):
+                    _seam.SPILL, _seam.COMPILE, _seam.SERVE, _seam.SHUFFLE):
             cat_spec = config.get(cat, {})
             rules[cat] = {name: _Rule(spec) for name, spec in cat_spec.items()}
         with self._lock:
@@ -190,21 +203,28 @@ class FaultInjector:
                 pass  # mid-write config; retry next poll
 
     # -- the seam hook -----------------------------------------------------
+    @staticmethod
+    def _match_rule(cat_rules: dict, name: str) -> Optional[_Rule]:
+        """Rule precedence for one crossing: exact name, then glob
+        patterns (the reference matches interceptionMatchPattern regexes
+        the same way), then the catch-all.  ONE definition shared by the
+        seam hook and the transport consult, so the two chaos surfaces
+        can never resolve a name differently."""
+        rule = cat_rules.get(name)
+        if rule is None:
+            rule = next(
+                (r for pat, r in cat_rules.items()
+                 if pat != "*" and pat != name
+                 and fnmatch.fnmatchcase(name, pat)),
+                None) or cat_rules.get("*")
+        return rule
+
     def _check(self, category: str, name: str) -> None:
         with self._lock:
             cat_rules = self._rules.get(category)
             if not cat_rules:
                 return
-            # precedence: exact name, then glob patterns (the reference
-            # matches interceptionMatchPattern regexes the same way),
-            # then the catch-all
-            rule = cat_rules.get(name)
-            if rule is None:
-                rule = next(
-                    (r for pat, r in cat_rules.items()
-                     if pat != "*" and pat != name
-                     and fnmatch.fnmatchcase(name, pat)),
-                    None) or cat_rules.get("*")
+            rule = self._match_rule(cat_rules, name)
             if rule is None:
                 return
             fired = rule.fire(self._rng, name)
@@ -217,9 +237,40 @@ class FaultInjector:
             # the crash-only drill: no cleanup, no exception — the process
             # vanishes mid-crossing exactly like a segfaulted executor
             os.kill(os.getpid(), signal.SIGKILL)
-        # slow / hang: stall the crossing thread (outside the lock — a
-        # hang wedges THIS thread only, other crossings keep injecting)
+        if kind in ("frame_corrupt", "frame_truncate"):
+            # transport verdicts are meaningless at a plain seam crossing
+            # (there are no bytes here to damage); only the shuffle
+            # sender's transport_fault() consult can apply them
+            return
+        # slow / hang / peer_stall: stall the crossing thread (outside the
+        # lock — a hang wedges THIS thread only, others keep injecting)
         time.sleep(payload)
+
+    def _transport_check(self, name: str):
+        """The shuffle transport's consult (serve/shuffle.py, per framed
+        partition send): returns ``("frame_corrupt" | "frame_truncate",
+        duration)`` for the SENDER to apply to the outgoing bytes, or None.
+        ``peer_stall`` stalls the serving thread here (the receiver sees a
+        peer that stops talking mid-frame) and returns None."""
+        with self._lock:
+            cat_rules = self._rules.get(_seam.SHUFFLE)
+            if not cat_rules:
+                return None
+            rule = self._match_rule(cat_rules, name)
+            if rule is None:
+                return None
+            fired = rule.fire(self._rng, name)
+        if fired is None:
+            return None
+        kind, payload = fired
+        if kind == "peer_stall":
+            time.sleep(payload)
+            return None
+        if kind in _TRANSPORT_KINDS:
+            return (kind, payload)
+        if kind == "raise":
+            raise payload
+        return None  # slow/hang/proc_kill make no sense here; ignore
 
 
 def pressure_storm_config(seed: int = 0, *, retry_pct: float = 25.0,
@@ -275,6 +326,60 @@ def chaos_kill_config(seed: int = 0, *, kill: bool = True,
         # crossing name means stacking both on handle:* would shadow
         # (review r10); dying while holding an admission slot is also
         # the nastier drill
+        cfg["alloc"] = {"reserve:*": {"percent": float(kill_pct),
+                                      "injectionType": "proc_kill",
+                                      "interceptionCount": 1}}
+    return cfg
+
+
+def transport_fault(name: str):
+    """Module-level consult for the shuffle transport: the armed
+    injector's shuffle-category verdict for ``name``, or None when no
+    injector is installed (the zero-overhead default)."""
+    inj = FaultInjector._instance
+    if inj is None:
+        return None
+    return inj._transport_check(name)
+
+
+def chaos_shuffle_config(seed: int = 0, *, kill: bool = True,
+                         corrupt_pct: float = 12.0,
+                         truncate_pct: float = 8.0,
+                         stall_pct: float = 6.0, stall_ms: float = 400.0,
+                         kill_pct: float = 5.0) -> dict:
+    """The seeded data-plane chaos profile (round 13).
+
+    Armed INSIDE each executor worker by ``serve_bench --cluster
+    --chaos-shuffle``: framed partition sends are corrupted (receiver's
+    CRC must catch and re-fetch), truncated mid-frame (length check), or
+    stalled (``peer_stall`` wedges the serving thread past the consumer's
+    I/O timeout, driving the seeded-jitter backoff path); when ``kill``
+    is armed for an incarnation, one seeded budget-reservation crossing
+    SIGKILLs the executor mid-exchange (``interceptionCount: 1`` per
+    armed incarnation, like :func:`chaos_kill_config`).  The three
+    transport rules bind DIFFERENT crossing names (``frame:*`` /
+    ``trunc:*`` / ``stall:*`` — the sender consults all three per send)
+    so none shadows another.  Deterministic per seed.
+    """
+    cfg = {
+        "seed": int(seed),
+        "shuffle": {
+            "frame:*": {"percent": float(corrupt_pct),
+                        "injectionType": "frame_corrupt",
+                        "interceptionCount": 4},
+            "trunc:*": {"percent": float(truncate_pct),
+                        "injectionType": "frame_truncate",
+                        "interceptionCount": 4},
+            "stall:*": {"percent": float(stall_pct),
+                        "injectionType": "peer_stall",
+                        "durationMs": float(stall_ms),
+                        "interceptionCount": 2},
+        },
+    }
+    if kill:
+        # die while holding an admission slot mid-exchange: the transport
+        # reservation (fetch credit) and the reduce's governed bracket
+        # both cross reserve:*, so the kill lands inside the shuffle
         cfg["alloc"] = {"reserve:*": {"percent": float(kill_pct),
                                       "injectionType": "proc_kill",
                                       "interceptionCount": 1}}

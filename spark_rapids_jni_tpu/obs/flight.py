@@ -54,6 +54,8 @@ __all__ = [
     "EV_LEASE_GRANT", "EV_LEASE_REDISPATCH", "EV_LEASE_DONE",
     "EV_WORKER_SPAWN", "EV_WORKER_DEAD",
     "EV_RAGGED_PACK", "EV_RAGGED_LAUNCH", "EV_RAGGED_SPLIT",
+    "EV_SHUFFLE_PRODUCE", "EV_SHUFFLE_FETCH", "EV_SHUFFLE_RETRY",
+    "EV_SHUFFLE_ACK",
     "EVENT_KINDS", "EVENT_PAIRS", "KIND_IDS", "DUMP_SCHEMA",
     "FlightRecorder", "record", "anomaly", "snapshot", "task_stats",
     "register_telemetry_source", "unregister_telemetry_source",
@@ -121,6 +123,26 @@ EV_RAGGED_SPLIT = "ragged_split"       # page-count halving on
 #                                        SplitAndRetryOOM (detail=
 #                                        handler:<h>:riders:<n>:pages:
 #                                        <from>-><to>, value=new depth)
+# crash-safe columnar shuffle (serve/shuffle.py, round 13): the
+# peer-to-peer data plane narrates map-side production, every framed
+# partition fetch (with its source path), every transport retry (CRC
+# mismatch, truncation, stalled peer, refused connection), and the
+# consumer acks the supervisor's partition map tracks — detail tokens
+# carry rid:/sid:/part: so flightdump --cluster can stitch partition
+# lineage across executor processes
+EV_SHUFFLE_PRODUCE = "shuffle_produce"  # map task's partitions framed +
+#                                        stored (detail=rid:<r>:sid:<s>:
+#                                        map:<m>:parts:<n>, value=bytes)
+EV_SHUFFLE_FETCH = "shuffle_fetch"      # one partition fetched + CRC-
+#                                        verified (detail=rid:<r>:sid:<s>
+#                                        :from:<k>:part:<p>:src:<how>,
+#                                        value=bytes)
+EV_SHUFFLE_RETRY = "shuffle_retry"      # fetch attempt failed, backing
+#                                        off (detail=...:reason:<why>)
+EV_SHUFFLE_ACK = "shuffle_ack"          # consumer acked a fetched
+#                                        partition into the supervisor's
+#                                        partition map (detail=rid:<r>:
+#                                        sid:<s>:from:<k>:part:<p>)
 
 # Paired kinds: a layer that emits the left side of a pair must also emit
 # the right side (module-granular balance, enforced by the analyze gate's
@@ -132,6 +154,7 @@ EVENT_PAIRS = (
     (EV_SPILL_BEGIN, EV_SPILL_END),
     (EV_DEGRADE_ENTER, EV_DEGRADE_EXIT),
     (EV_LEASE_GRANT, EV_LEASE_DONE),
+    (EV_SHUFFLE_PRODUCE, EV_SHUFFLE_ACK),
 )
 
 EVENT_KINDS = (
@@ -147,6 +170,8 @@ EVENT_KINDS = (
     EV_WORKER_SPAWN, EV_WORKER_DEAD,
     # round 12: appended (wire ids frozen in ci/flight_wire_ids.json)
     EV_RAGGED_PACK, EV_RAGGED_LAUNCH, EV_RAGGED_SPLIT,
+    # round 13: appended for the same reason
+    EV_SHUFFLE_PRODUCE, EV_SHUFFLE_FETCH, EV_SHUFFLE_RETRY, EV_SHUFFLE_ACK,
 )
 KIND_IDS = {k: i for i, k in enumerate(EVENT_KINDS)}
 

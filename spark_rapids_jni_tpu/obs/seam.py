@@ -38,7 +38,7 @@ import threading
 from typing import Callable, Optional
 
 __all__ = ["seam", "instrument", "OP", "TRANSFER", "COLLECTIVE", "ALLOC",
-           "SPILL", "COMPILE", "SERVE"]
+           "SPILL", "COMPILE", "SERVE", "SHUFFLE"]
 
 OP = "op"
 TRANSFER = "transfer"
@@ -47,6 +47,10 @@ ALLOC = "alloc"
 SPILL = "spill"
 COMPILE = "compile"
 SERVE = "serve"
+# the cross-process columnar data plane (serve/shuffle.py): every framed
+# partition send crosses this category, so chaos can corrupt, truncate, or
+# stall the transport the way libcufaultinj corrupts a UCX hand-off
+SHUFFLE = "shuffle"
 
 # registered sinks; None = inactive (checked without locks on the hot path)
 _injector: Optional[Callable[[str, str], None]] = None  # may raise

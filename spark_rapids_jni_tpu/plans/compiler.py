@@ -35,7 +35,9 @@ from spark_rapids_jni_tpu.plans.cache import CompiledPlan, plan_cache
 
 __all__ = ["compile_plan", "cached_compile", "input_signature",
            "output_names", "emitter", "DTYPES",
-           "RaggedProgram", "compile_ragged", "cached_ragged_compile"]
+           "RaggedProgram", "compile_ragged", "cached_ragged_compile",
+           "EXCHANGE_SOURCE", "split_exchange_plan",
+           "emit_exchange_partitions", "eval_post"]
 
 DTYPES = {
     "bool": jnp.bool_,
@@ -420,6 +422,124 @@ def cached_compile(plan: ir.Plan, mesh, tables) -> CompiledPlan:
     sig = input_signature(plan, tables)
     return plan_cache.get_or_compile(
         (plan, mesh, sig), lambda: compile_plan(plan, mesh, sig))
+
+
+# ------------------------------------------- cross-process exchange split
+# A plan whose Exchange runs as a REAL shuffle (serve/shuffle.py: framed
+# partition push/pull between executor processes) splits at the Exchange
+# node into two halves that reuse this compiler unchanged:
+#
+# - the **map fragment** — the Exchange's child subtree — runs eagerly
+#   per executor over its shard of the scan tables (the SAME registered
+#   emitter bodies the jitted path traces, so values are bit-identical),
+#   then rows partition by ``partition_of(key) % nparts`` and masked rows
+#   drop (exactly what the in-mesh all_to_all's validity mask does);
+# - the **reduce plan** — the original plan with the Exchange replaced by
+#   a Scan of the synthetic ``EXCHANGE_SOURCE`` table — compiles through
+#   :func:`cached_compile` as a LOCAL plan over the concatenated received
+#   partitions.  Its sinks are additive partials (psum's host analog is
+#   summation at the combiner), so ``post`` expressions move OUT of the
+#   reduce plan and evaluate once over the summed sinks (:func:`eval_post`).
+
+
+#: the synthetic scan table the reduce half reads received rows from
+EXCHANGE_SOURCE = "__exchange__"
+
+
+def split_exchange_plan(plan: ir.Plan):
+    """``(exchange_node, reduce_plan)`` for a plan with exactly ONE
+    Exchange.  The reduce plan is local (no Exchange, no mesh), reads the
+    shuffled fields from ``Scan(EXCHANGE_SOURCE, fields)``, keeps the
+    sinks, and drops ``post``/``outputs`` — partials must be summed
+    across executors BEFORE post expressions run."""
+    exchanges = ir.exchange_nodes(plan)
+    if len(exchanges) != 1:
+        raise ValueError(
+            f"plan {plan.name!r} has {len(exchanges)} Exchange nodes; the "
+            f"cross-process shuffle supports exactly one")
+    exchange = exchanges[0]
+
+    def rebuild(node):
+        if node is exchange or node == exchange:
+            return ir.Scan(EXCHANGE_SOURCE, node.fields)
+        kw = {}
+        changed = False
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            if isinstance(v, tuple) and v and all(
+                    type(item) in _EMITTERS for item in v):
+                nv = tuple(rebuild(item) for item in v)
+                changed = changed or nv != v
+                kw[f.name] = nv
+            elif type(v) in _EMITTERS:
+                nv = rebuild(v)
+                changed = changed or nv is not v
+                kw[f.name] = nv
+            else:
+                kw[f.name] = v
+        return dataclasses.replace(node, **kw) if changed else node
+
+    sinks = tuple(rebuild(s) for s in plan.sinks)
+    reduce_plan = ir.Plan(f"{plan.name}:reduce", sinks)
+    extra = [s.table for s in ir.scan_tables(reduce_plan)
+             if s.table != EXCHANGE_SOURCE]
+    if extra:
+        raise ValueError(
+            f"plan {plan.name!r} scans {extra} ABOVE its Exchange: the "
+            f"reduce half would re-read whole fact tables per executor "
+            f"and double-count — every Scan must feed the Exchange")
+    return exchange, reduce_plan
+
+
+def emit_exchange_partitions(exchange: ir.Exchange, tables,
+                             nparts: int) -> list:
+    """The map side of one executor's shard: emit the Exchange's child
+    subtree eagerly (same emitter bodies as the traced path), hash the
+    key with the SAME placement hash the in-mesh all_to_all uses, and
+    return ``nparts`` host partition tables of the exchange fields
+    (masked rows dropped — the slot-validity analog).  Partition sizes
+    are exact, so the fixed-capacity overflow retry of the in-mesh path
+    has no cross-process counterpart."""
+    import numpy as np
+
+    from spark_rapids_jni_tpu.parallel.shuffle import partition_of
+
+    inputs: Dict[str, Dict[str, object]] = {}
+    rowvalid: Dict[str, object] = {}
+    for table, fields in tables.items():
+        inputs[table] = {k: jnp.asarray(v) for k, v in fields.items()}
+        n = len(next(iter(fields.values())))
+        # analyze: ignore[governed-allocation] - the all-valid row mask
+        # of an EXACT (unpadded) shard: O(rows) bools inside the serve
+        # bracket that admitted the shuffle piece, already covered by
+        # the shard's working-set estimate like the shard columns above
+        rowvalid[table] = jnp.ones((n,), jnp.bool_)
+    rows = _emit(exchange.child, _Ctx(inputs, rowvalid, None))
+    key = _eval(exchange.key, rows.cols)
+    part = np.asarray(partition_of(key, nparts))
+    mask = np.asarray(rows.mask)
+    cols = {f: np.asarray(rows.cols[f]) for f in exchange.fields}
+    out = []
+    for p in range(nparts):
+        sel = mask & (part == p)
+        out.append({f: np.ascontiguousarray(v[sel])
+                    for f, v in cols.items()})
+    return out
+
+
+def eval_post(plan: ir.Plan, sums: Dict[str, object]) -> Dict[str, object]:
+    """Post expressions over the cross-executor SUMMED sink outputs —
+    the host twin of the traced path's psum-then-post ordering.  Returns
+    sinks + posts filtered/ordered like :func:`output_names` (minus the
+    in-mesh path's implicit ``dropped``, which exact-size framed
+    partitions cannot produce)."""
+    import numpy as np
+
+    env = dict(sums)
+    for name, expr in plan.post:
+        env[name] = np.asarray(_eval(expr, env))
+    names = [n for n in output_names(plan) if n != "dropped"]
+    return {n: env[n] for n in names}
 
 
 # ----------------------------------------------- ragged calling convention

@@ -53,8 +53,13 @@ from spark_rapids_jni_tpu.serve.supervisor import (
     Degraded,
     HandlerSpec,
     RemoteExecutorError,
+    ShuffleSpec,
     Supervisor,
 )
+
+# serve.shuffle (the peer-to-peer columnar data plane, round 13) is NOT
+# imported here: it pulls in the plan compiler (jax), and executor worker
+# processes that never serve a shuffle handler must stay cheap to spawn.
 
 __all__ = [
     "AdmissionController",
@@ -76,6 +81,7 @@ __all__ = [
     "ServeMetrics",
     "ServingEngine",
     "Session",
+    "ShuffleSpec",
     "SessionBudgetExceeded",
     "SessionRegistry",
     "Supervisor",

@@ -84,6 +84,13 @@ class Knob:
 # counters whose per-tick deltas feed decisions (sampled from ServeMetrics)
 _DELTA_COUNTERS = ("retried", "split_requeued", "rejected_full", "completed")
 
+# a cluster-pressure sample older than max(this, 4 heartbeat periods)
+# steers nothing: the supervisor broadcasts at heartbeat rate, so a few
+# missed periods means the pipe (or the supervisor) is gone and local
+# signals must govern.  Scaled by the CONFIGURED heartbeat so a slow-
+# beating deployment doesn't silently disable federated admission.
+_CLUSTER_STALE_S = 2.0
+
 
 class AdmissionController:
     """The feedback loop from flight-recorder gauges to admission knobs.
@@ -151,6 +158,12 @@ class AdmissionController:
         self._probe: Dict[str, dict] = {}  # guarded-by: _lock
         self._probe_done: Dict[str, bool] = {}  # guarded-by: _lock
         self._boosts: Dict[str, int] = {}  # guarded-by: _lock
+        # federated admission (round 13): the supervisor's cluster-wide
+        # pressure aggregate (MSG_PRESSURE via serve/rpc.py), as
+        # (pressure, stamp); stale samples (a supervisor that stopped
+        # broadcasting) age out after _CLUSTER_STALE_S so an orphaned
+        # worker falls back to steering on its local view alone
+        self._cluster: Optional[tuple] = None  # guarded-by: _lock
         self._frozen = False  # guarded-by: _lock
         self.errors = 0  # guarded-by: _lock
         self._stop = threading.Event()
@@ -266,8 +279,15 @@ class AdmissionController:
         sig = signals if signals is not None else (
             self._signal_source() if self._signal_source is not None
             else self._sample())
-        pressure = max(float(sig.get("mem_frac", 0.0)),
-                       float(sig.get("blocked_frac", 0.0)))
+        local = max(float(sig.get("mem_frac", 0.0)),
+                    float(sig.get("blocked_frac", 0.0)))
+        cluster = self._cluster_pressure()
+        # federated admission: steer on the WORST of this process's view
+        # and the supervisor's cluster aggregate — a quiet worker in an
+        # overloaded cluster tightens too; the decision ledger says which
+        # signal drove each move
+        pressure = max(local, cluster)
+        src = "cluster" if cluster > local else "local"
         with self._lock:
             ewma = (pressure if self._ewma is None
                     else self.ewma_alpha * pressure
@@ -277,8 +297,8 @@ class AdmissionController:
         overloaded = ewma >= self.band_hi
         calm = ewma <= self.band_lo and deltas.get("retried", 0) == 0 \
             and deltas.get("split_requeued", 0) == 0
-        self._steer_queue_depth(overloaded, calm)
-        self._steer_session_scale(overloaded, calm)
+        self._steer_queue_depth(overloaded, calm, src)
+        self._steer_session_scale(overloaded, calm, src)
         self._steer_presplit(dict(sig.get("class_splits", {})))
         if self.latency_probe:
             self._steer_latency_probe()
@@ -293,7 +313,29 @@ class AdmissionController:
         with self._lock:
             self._last_adj[knob] = self._tick
 
-    def _steer_queue_depth(self, overloaded: bool, calm: bool) -> None:
+    def note_cluster_pressure(self, gauges: dict) -> None:
+        """Feed the supervisor's cluster-wide pressure aggregate into the
+        next ticks (serve/rpc.py routes MSG_PRESSURE here via
+        ``ServingEngine.note_cluster_pressure``)."""
+        p = max(float(gauges.get("blocked_frac", 0.0)),
+                float(gauges.get("mem_frac", 0.0)),
+                float(gauges.get("queue_frac", 0.0)))
+        with self._lock:
+            self._cluster = (min(1.0, p), time.monotonic())
+
+    def _cluster_pressure(self) -> float:
+        from spark_rapids_jni_tpu import config
+
+        with self._lock:
+            c = self._cluster
+        stale_s = max(_CLUSTER_STALE_S,
+                      4.0 * float(config.get("serve_heartbeat_s")))
+        if c is None or time.monotonic() - c[1] > stale_s:
+            return 0.0
+        return c[0]
+
+    def _steer_queue_depth(self, overloaded: bool, calm: bool,
+                           src: str = "local") -> None:
         k = self.knobs["queue_depth"]
         if not (overloaded or calm) or not self._dwell_ok(k.name):
             return
@@ -304,11 +346,14 @@ class AdmissionController:
         self._mark_adj(k.name)
         purged = self.engine.queue.set_maxsize(new)
         reason = ("pressure_high" if overloaded else "pressure_low")
+        if src != "local":  # the ledger distinguishes cluster-driven moves
+            reason += f":{src}"
         if purged:
             reason += f":purged={purged}"
         self._adjust(k.name, old, new, reason)
 
-    def _steer_session_scale(self, overloaded: bool, calm: bool) -> None:
+    def _steer_session_scale(self, overloaded: bool, calm: bool,
+                             src: str = "local") -> None:
         k = self.knobs["session_scale"]
         if not (overloaded or calm) or not self._dwell_ok(k.name):
             return
@@ -319,8 +364,10 @@ class AdmissionController:
         self._mark_adj(k.name)
         for sess in self.engine.sessions.all_open():
             sess.set_budget_scale(new)
-        self._adjust(k.name, old, new,
-                     "pressure_high" if overloaded else "pressure_low")
+        reason = "pressure_high" if overloaded else "pressure_low"
+        if src != "local":
+            reason += f":{src}"
+        self._adjust(k.name, old, new, reason)
 
     def apply_to_new_session(self, sess) -> None:
         """Bring a just-opened session onto the CURRENT posture (the
@@ -539,10 +586,13 @@ class AdmissionController:
             frozen, tick = self._frozen, self._tick
             boosts = dict(self._boosts)
             errors = self.errors
+            cluster = self._cluster
         return {
             "frozen": frozen,
             "tick": tick,
             "pressure_ewma": round(ewma, 4) if ewma is not None else None,
+            "cluster_pressure": (round(cluster[0], 4)
+                                 if cluster is not None else None),
             "knobs": {k.name: {"value": k.value, "static": k.static,
                                "lo": k.lo, "hi": k.hi}
                       for k in self.knobs.values()},

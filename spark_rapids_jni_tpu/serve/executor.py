@@ -338,6 +338,14 @@ class ServingEngine:
             self.controller = AdmissionController(self)
             self.controller.start()
 
+    def note_cluster_pressure(self, gauges: dict) -> None:
+        """Cluster-wide pressure from the supervisor (federated
+        admission, serve/rpc.py MSG_PRESSURE): forwarded into the
+        admission controller's tick; a no-op on static engines."""
+        c = self.controller
+        if c is not None:
+            c.note_cluster_pressure(gauges)
+
     # -- registration / sessions -------------------------------------------
     def register(self, handler: QueryHandler) -> None:
         if (handler.batch is None) != (handler.unbatch is None):

@@ -137,6 +137,18 @@ SUPERVISOR_COUNTERS = (
     "workers_spawned",    # executor processes started (incl. respawns)
     "workers_dead",       # executors declared dead (crash/heartbeat/hung)
     "rejected_degraded",  # submits shed by the degradation ladder
+    # the peer-to-peer columnar data plane (serve/shuffle.py, round 13):
+    # partition-map lifecycle as the SUPERVISOR sees it (per-transport
+    # frame/byte/retry gauges live in each executor's ShuffleService
+    # telemetry source)
+    "shuffles_started",       # Exchange requests split into map children
+    "shuffles_completed",     # partition maps retired (parent terminal)
+    "shuffle_produced",       # map tasks that announced partitions
+    "shuffle_stale_produces",  # late announcements from recycled
+    #                            incarnations, dropped
+    "shuffle_acks",           # consumer partition acks recorded
+    "shuffle_revivals",       # produce-only re-runs of completed tasks
+    #                           whose executor died with the data
 )
 
 

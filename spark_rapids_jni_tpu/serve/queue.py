@@ -140,6 +140,12 @@ class Request:
     join_slot: int = 0
     session: Any = None      # set for client-facing requests (not halves):
     charge_bytes: int = 0    # session byte-budget charge to credit back
+    # cross-process shuffle lineage (serve/supervisor.py round 13): the
+    # parent of a shuffle carries its sid (map_index -1); each child is
+    # map task map_index of that sid, so lease grants keep the
+    # supervisor's partition map pointed at the current incarnation
+    shuffle_sid: Optional[int] = None
+    shuffle_map_index: int = -1
 
     def __post_init__(self):
         self.response.task_id = self.task_id

@@ -40,8 +40,11 @@ from typing import Callable, Optional
 
 __all__ = [
     "MSG_HELLO", "MSG_BEAT", "MSG_DISPATCH", "MSG_RESULT", "MSG_SHUTDOWN",
+    "MSG_SHUFFLE_PRODUCED", "MSG_SHUFFLE_ACK", "MSG_SHUFFLE_MAP",
+    "MSG_SHUFFLE_CLEANUP", "MSG_PRESSURE",
     "MESSAGE_FIELDS",
     "SafeConn", "resolve_factory", "executor_worker_main",
+    "set_shuffle_sink", "shuffle_uplink",
 ]
 
 MSG_HELLO = "hello"
@@ -49,6 +52,17 @@ MSG_BEAT = "beat"
 MSG_DISPATCH = "dispatch"
 MSG_RESULT = "result"
 MSG_SHUTDOWN = "shutdown"
+# the columnar data plane's control half (round 13, serve/shuffle.py):
+# partition DATA moves peer-to-peer over the framed socket transport; the
+# supervisor pipe only carries the partition-map bookkeeping — production
+# announcements + consumer acks up, map/cleanup broadcasts down — plus
+# the cluster-wide pressure gauge feeding each worker's admission
+# controller (the federated-admission tail of ROADMAP item 1)
+MSG_SHUFFLE_PRODUCED = "shuffle_produced"
+MSG_SHUFFLE_ACK = "shuffle_ack"
+MSG_SHUFFLE_MAP = "shuffle_map"
+MSG_SHUFFLE_CLEANUP = "shuffle_cleanup"
+MSG_PRESSURE = "pressure"
 
 # The declared wire schema: tag -> field names after the tag.  BOTH sides
 # of the pipe are checked against this table at merge time (ci/analyze
@@ -66,6 +80,22 @@ MESSAGE_FIELDS = {
                    "priority"),
     MSG_RESULT: ("rid", "status", "value", "err"),
     MSG_SHUTDOWN: ("dump_epilogue",),
+    # worker -> supervisor: map task `map_index` of shuffle `sid` framed
+    # its partitions ({part: nbytes} sizes) and serves them at `ep`
+    MSG_SHUFFLE_PRODUCED: ("worker_id", "incarnation", "sid", "map_index",
+                           "sizes", "ep"),
+    # worker -> supervisor: consumer `part` fetched + CRC-verified map
+    # task `map_index`'s partition (the partition map's ack column)
+    MSG_SHUFFLE_ACK: ("worker_id", "incarnation", "sid", "map_index",
+                      "part"),
+    # supervisor -> participants: the current partition map of one
+    # shuffle ({map_index: {state, ep, incarnation, sizes}})
+    MSG_SHUFFLE_MAP: ("sid", "nparts", "tasks"),
+    # supervisor -> participants: shuffle finished/abandoned; free stores
+    MSG_SHUFFLE_CLEANUP: ("sid",),
+    # supervisor -> workers: cluster-wide pressure aggregate (mean/max of
+    # heartbeat gauges) for the local AdmissionController's tick
+    MSG_PRESSURE: ("cluster",),
 }
 
 # RESULT statuses mirror serve.queue terminal states, plus the one
@@ -81,15 +111,48 @@ class SafeConn:
     peer is gone — by then the supervisor/worker death path owns cleanup,
     and a crashing send inside a waiter thread would just add noise.
     ``recv`` returns None on EOF for the same reason.
+
+    ``send`` is also BOUNDED-TIME: a live peer that stops draining its
+    pipe (wedged receive loop) would otherwise block the sender forever
+    while it holds the send lock — heartbeats stop, the sender looks
+    dead, and the wrong process gets recycled.  After ``send_timeout_s``
+    waiting for pipe writability the send surfaces as backpressure
+    instead: an ``EV_TASK_HUNG`` flight event plus a False return, which
+    callers already map to the unreachable-peer path.  (The guard bounds
+    the wait for buffer SPACE; a message larger than the freed buffer can
+    still block in the write itself — supervision's hung-lease bound
+    remains the backstop of last resort.)
     """
 
-    def __init__(self, conn):
+    def __init__(self, conn, send_timeout_s: Optional[float] = None):
+        if send_timeout_s is None:
+            from spark_rapids_jni_tpu import config
+
+            send_timeout_s = float(config.get("serve_send_timeout_s"))
         self._conn = conn
+        self._send_timeout_s = float(send_timeout_s)
         self._send_lock = threading.Lock()
 
     def send(self, msg: tuple) -> bool:
         try:
             with self._send_lock:
+                if self._send_timeout_s > 0:
+                    import select
+
+                    ready = select.select(
+                        [], [self._conn.fileno()], [],
+                        self._send_timeout_s)[1]
+                    if not ready:
+                        from spark_rapids_jni_tpu.obs import (
+                            flight as _flight,
+                        )
+
+                        _flight.record(
+                            _flight.EV_TASK_HUNG, -1,
+                            detail=f"pipe_send_stalled:"
+                                   f"{self._send_timeout_s:g}s:"
+                                   f"tag:{msg[0] if msg else '?'}")
+                        return False
                 self._conn.send(msg)
             return True
         # analyze: ignore[retry-protocol] - pipe serialization crosses no
@@ -111,6 +174,63 @@ class SafeConn:
             self._conn.close()
         except OSError:
             pass
+
+
+# --------------------------------------------------------------------------
+# shuffle plumbing: the worker main loop routes shuffle control messages to
+# the process's ShuffleService WITHOUT importing serve/shuffle.py (which
+# pulls in the plan compiler and jax — workers that never serve a shuffle
+# handler must stay cheap to spawn).  The service registers a sink when it
+# starts; messages arriving first are buffered and drained at registration.
+# The uplink is how the service (running in handler threads) sends
+# produced/ack announcements up the ONE supervisor pipe.
+# --------------------------------------------------------------------------
+
+_shuffle_lock = threading.Lock()
+_shuffle_sink: Optional[Callable[[tuple], None]] = None
+_shuffle_pending: list = []
+_shuffle_uplink: Optional[tuple] = None  # (send_fn, worker_id, incarnation)
+
+
+def set_shuffle_sink(fn: Optional[Callable[[tuple], None]]) -> None:
+    """Register (or clear) the process ShuffleService's message sink;
+    buffered messages drain in arrival order.  The drain AND every
+    subsequent delivery run under the one lock, so a map broadcast
+    arriving concurrently with registration can never be applied before
+    (and then overwritten by) an older buffered map."""
+    global _shuffle_sink
+    with _shuffle_lock:
+        _shuffle_sink = fn
+        pending, _shuffle_pending[:] = list(_shuffle_pending), []
+        if fn is not None:
+            for msg in pending:
+                fn(msg)
+
+
+def _route_shuffle_msg(msg: tuple) -> None:
+    # delivery stays under the lock (see set_shuffle_sink): the sink's
+    # own state has its own condition, and no sink path re-enters this
+    # lock while holding it — produce/ack read the uplink AFTER
+    # releasing the service condition
+    with _shuffle_lock:
+        if _shuffle_sink is None:
+            _shuffle_pending.append(msg)
+            del _shuffle_pending[:-256]  # bounded: maps re-broadcast
+            return
+        _shuffle_sink(msg)
+
+
+def shuffle_uplink() -> Optional[tuple]:
+    """(send_fn, worker_id, incarnation) of this executor-worker process,
+    or None outside one (standalone services skip announcements)."""
+    with _shuffle_lock:
+        return _shuffle_uplink
+
+
+def _set_shuffle_uplink(uplink: Optional[tuple]) -> None:
+    global _shuffle_uplink
+    with _shuffle_lock:
+        _shuffle_uplink = uplink
 
 
 def resolve_factory(factory) -> Callable:
@@ -198,7 +318,15 @@ def executor_worker_main(worker_id: int, incarnation: int, conn,
             }
             if not sconn.send((MSG_BEAT, worker_id, incarnation,
                                time.time(), gauges)):
-                return  # supervisor gone; main loop will see EOF too
+                # undeliverable beat: the pipe may be CLOSED (supervisor
+                # gone — the main loop's EOF owns that) or merely
+                # STALLED past the send guard's bound.  Either way the
+                # right move is to skip this beat and keep beating: a
+                # heartbeat thread that exits on one stalled send leaves
+                # a healthy worker permanently silent, and the
+                # supervisor would kill it for the supervisor's own
+                # congestion
+                continue
 
     def waiter(rid: int, resp) -> None:
         resp.wait()  # the engine guarantees a terminal state
@@ -222,6 +350,7 @@ def executor_worker_main(worker_id: int, incarnation: int, conn,
     beat_thread = threading.Thread(target=heartbeat, daemon=True,
                                    name=f"serve-worker-{worker_id}-beat")
     beat_thread.start()
+    _set_shuffle_uplink((sconn.send, worker_id, incarnation))
     sconn.send((MSG_HELLO, worker_id, incarnation, os.getpid()))
 
     try:
@@ -233,6 +362,12 @@ def executor_worker_main(worker_id: int, incarnation: int, conn,
             if tag == MSG_SHUTDOWN:
                 dump_epilogue[0] = bool(msg[1])
                 break
+            if tag == MSG_PRESSURE:
+                engine.note_cluster_pressure(dict(msg[1]))
+                continue
+            if tag == MSG_SHUFFLE_MAP or tag == MSG_SHUFFLE_CLEANUP:
+                _route_shuffle_msg(msg)
+                continue
             if tag != MSG_DISPATCH:
                 continue
             _, rid, handler, payload, deadline_rel_s, priority = msg
@@ -258,6 +393,7 @@ def executor_worker_main(worker_id: int, incarnation: int, conn,
                              name=f"serve-worker-{worker_id}-rid{rid}").start()
     finally:
         stop.set()
+        _set_shuffle_uplink(None)
         if dump_epilogue[0]:
             # end-of-run ring dump so the --cluster merge has this
             # process's timeline even when nothing anomalous happened here

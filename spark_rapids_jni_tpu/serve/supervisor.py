@@ -62,7 +62,8 @@ from spark_rapids_jni_tpu.serve.session import (
 )
 
 __all__ = [
-    "Degraded", "HandlerSpec", "RemoteExecutorError", "Supervisor",
+    "Degraded", "HandlerSpec", "ShuffleSpec", "RemoteExecutorError",
+    "Supervisor",
     "DEGRADE_LEVELS", "LEVEL_HEALTHY", "LEVEL_SHED_LOW",
     "LEVEL_CACHED_ONLY", "LEVEL_REJECT",
 ]
@@ -153,6 +154,29 @@ class HandlerSpec:
         self.fanout = int(fanout)
 
 
+class ShuffleSpec(HandlerSpec):
+    """A query class whose Exchange runs as a REAL cross-process shuffle
+    (serve/shuffle.py): the supervisor splits the payload into N map
+    shards (``split_n``), brokers the partition map while the children
+    exchange partitions peer-to-peer, and ``combine`` sums the partial
+    sink outputs (then evaluates the plan's post expressions — see
+    serve/shuffle.combine_exchange_outputs).  ``fanout`` caps N; actual
+    N = min(fanout, alive-at-dispatch), floored at 1 — a lone (or
+    not-yet-hello'd) pool serves the request as ONE shard, still through
+    the shuffle handler, partitioning to itself."""
+
+    __slots__ = ("split_n",)
+
+    def __init__(self, name: str, split_n: Callable[[Any, int], List[Any]],
+                 combine: Callable[[List[Any]], Any],
+                 nbytes_of: Callable[[Any], int] = lambda p: 0,
+                 cacheable: bool = False, fanout: int = 4):
+        super().__init__(name, nbytes_of=nbytes_of, cacheable=cacheable)
+        self.split_n = split_n
+        self.combine = combine
+        self.fanout = max(1, int(fanout))
+
+
 class _Lease:
     """One dispatched request's supervision record (lease-table entry)."""
 
@@ -169,6 +193,38 @@ class _Lease:
         self.redispatches = 0
         self.granted_ns = 0
         self.completed = False
+
+
+class _ShuffleState:
+    """The supervisor's partition map for one live shuffle: per map task,
+    which (worker, incarnation) currently owns it, whether it has
+    produced (sizes + serving endpoint), and which consumer partitions
+    acked the fetch.  Alongside the lease table it is what makes the
+    data plane crash-safe: a dead producer's un-acked partitions
+    re-produce through re-dispatch (lease live) or a produce-only
+    revival (lease already done), and every transition re-broadcasts the
+    map to the participants."""
+
+    __slots__ = ("sid", "nparts", "parent_rid", "handler", "tasks",
+                 "workers_seen")
+
+    def __init__(self, sid: int, nparts: int, parent_rid: int,
+                 handler: str):
+        self.sid = sid
+        self.nparts = nparts
+        self.parent_rid = parent_rid
+        self.handler = handler
+        # map_index -> {"rid", "data" (the shard payload, retained for
+        # revival), "worker", "inc", "state" ("pending"|"produced"),
+        # "sizes" ({part: bytes}), "ep", "acks" (set of consumer parts)}
+        self.tasks: Dict[int, dict] = {}
+        self.workers_seen: set = set()  # cleanup recipients
+
+    def wire_map(self) -> dict:
+        """The picklable per-task view broadcast to participants."""
+        return {m: {"state": t["state"], "ep": t["ep"],
+                    "incarnation": t["inc"], "sizes": dict(t["sizes"])}
+                for m, t in self.tasks.items()}
 
 
 class _ExecutorHandle:
@@ -277,6 +333,9 @@ class Supervisor:
         self._lease_max_dispatches_seen = 0  # guarded-by: _lock
         self._specs: Dict[str, HandlerSpec] = {}  # guarded-by: _lock
         self._warm: set = set()  # guarded-by: _lock
+        # live shuffles' partition maps (retired at parent completion)
+        self._shuffles: Dict[int, _ShuffleState] = {}  # guarded-by: _lock
+        self._shuffle_seq = itertools.count(1)
         self._level = LEVEL_HEALTHY  # guarded-by: _lock
         self._level_max_seen = LEVEL_HEALTHY  # guarded-by: _lock
         self._stress_ewma: Optional[float] = None  # guarded-by: _lock
@@ -427,6 +486,11 @@ class Supervisor:
         counter = {OK: "completed", TIMED_OUT: "timed_out",
                    CANCELLED: "cancelled"}.get(status, "failed")
         self.metrics.count(counter, req.session_id)
+        if req.shuffle_sid is not None and req.shuffle_map_index < 0:
+            # the shuffle's parent reached its terminal state (join
+            # complete OR terminal failure): the partition map retires
+            # and every participant frees its store
+            self._shuffle_cleanup(req.shuffle_sid)
         if req.join is not None:
             req.join.deliver(req.join_slot, status, value, error)
 
@@ -480,6 +544,11 @@ class Supervisor:
                     handle.gauges = dict(msg[4])
             elif tag == rpc.MSG_RESULT:
                 self._on_result(handle, msg[1], msg[2], msg[3], msg[4])
+            elif tag == rpc.MSG_SHUFFLE_PRODUCED:
+                self._on_shuffle_produced(handle, msg[3], msg[4], msg[5],
+                                          msg[6])
+            elif tag == rpc.MSG_SHUFFLE_ACK:
+                self._on_shuffle_ack(handle, msg[3], msg[4], msg[5])
 
     def _worker_dead(self, handle: _ExecutorHandle, reason: str) -> None:
         """Idempotent per incarnation: declare dead, SIGKILL for
@@ -521,6 +590,10 @@ class Supervisor:
                                   f"from:{handle.worker_id}."
                                   f"{handle.incarnation}:{reason}")
             self._requeue(lease.req)
+        # data-plane lineage: live shuffles that lost produced partitions
+        # with this incarnation re-point their tasks (and revive the ones
+        # whose leases already completed)
+        self._revive_shuffle_tasks(handle)
         if current and not self._stop.is_set():
             self._spawn_worker(handle.worker_id, handle.incarnation + 1)
 
@@ -569,6 +642,13 @@ class Supervisor:
             self._finish(req, ERROR,
                          error=KeyError(f"no handler {req.handler!r}"))
             return
+        if (isinstance(spec, ShuffleSpec) and req.join is None
+                and req.shuffle_sid is None and not has_lease):
+            # N map shards = live capacity (min 1: a lone executor still
+            # shuffles — to itself); children exchange peer-to-peer
+            self._shuffle_dispatch(req, spec,
+                                   max(1, min(spec.fanout, alive)))
+            return
         if (spec.fanout > 1 and spec.split is not None and req.join is None
                 and req.split_depth == 0 and not has_lease and alive > 1):
             parts = self._fanout_parts(spec, req.payload,
@@ -606,6 +686,173 @@ class Supervisor:
                                   f"fanout_from:{req.task_id}")
             self._requeue(child)
 
+    # -- the shuffle partition map (round 13) --------------------------------
+    def _shuffle_dispatch(self, req: Request, spec: ShuffleSpec,
+                          want: int) -> None:
+        """Split one Exchange-plan request into ``want`` map-task
+        children that shuffle partitions peer-to-peer; the supervisor
+        records the partition map and brokers endpoints, the children's
+        partial sinks join through ``spec.combine``."""
+        shards = list(spec.split_n(req.payload, want))
+        n = len(shards)
+        sid = next(self._shuffle_seq)
+        req.shuffle_sid = sid  # parent marker (map_index stays -1):
+        #                        completion of the join triggers cleanup
+        join = _SplitJoin(req, spec.combine, n, self._finish)
+        state = _ShuffleState(sid, n, req.task_id, req.handler)
+        children = []
+        for m, shard in enumerate(shards):
+            tid = self.sessions.next_task_id()
+            child = Request(
+                handler=req.handler,
+                payload={"sid": sid, "m": m, "nparts": n, "rid": tid,
+                         "data": shard},
+                session_id=req.session_id, priority=req.priority,
+                deadline=req.deadline, seq=next(self._seq), task_id=tid,
+                split_depth=1, no_batch=True, join=join, join_slot=m,
+                shuffle_sid=sid, shuffle_map_index=m,
+            )
+            state.tasks[m] = {"rid": tid, "data": shard, "worker": -1,
+                              "inc": -1, "state": "pending", "sizes": {},
+                              "ep": None, "acks": set()}
+            children.append(child)
+        with self._lock:
+            self._shuffles[sid] = state
+        self.metrics.count("shuffles_started", req.session_id)
+        self.metrics.count("split_requeued", req.session_id, n=n)
+        for child in children:
+            _flight.record(_flight.EV_SPLIT_RETRY, child.task_id,
+                           detail=f"rid:{child.task_id}:sid:{sid}:"
+                                  f"map:{child.shuffle_map_index}:"
+                                  f"shuffle_from:{req.task_id}")
+            self._requeue(child)
+
+    def _shuffle_task_located(self, req: Request, worker_id: int,
+                              incarnation: int) -> Optional[int]:
+        """(Caller holds ``self._lock``.)  Point the partition map's task
+        at the incarnation that just took its lease; production restarts
+        from scratch there, so the state drops back to pending.  Returns
+        the sid to re-broadcast (the old endpoint must stop being
+        consulted NOW, not at the next produce)."""
+        state = self._shuffles.get(req.shuffle_sid)
+        if state is None:
+            return None
+        task = state.tasks.get(req.shuffle_map_index)
+        if task is None or task["rid"] != req.task_id:
+            return None
+        task["worker"], task["inc"] = worker_id, incarnation
+        task["state"], task["ep"] = "pending", None
+        state.workers_seen.add(worker_id)
+        return state.sid
+
+    def _on_shuffle_produced(self, handle: _ExecutorHandle, sid: int,
+                             map_index: int, sizes: dict, ep) -> None:
+        with self._lock:
+            state = self._shuffles.get(sid)
+            task = (state.tasks.get(map_index)
+                    if state is not None else None)
+            stale = (task is None
+                     or task["worker"] != handle.worker_id
+                     or task["inc"] != handle.incarnation)
+            if not stale:
+                task["state"] = "produced"
+                task["sizes"] = {int(p): int(b) for p, b in sizes.items()}
+                task["ep"] = tuple(ep)
+        if stale:
+            # a recycled incarnation's late announcement: the current
+            # owner's (re-)produce governs — count and drop, like a
+            # duplicate result
+            self.metrics.count("shuffle_stale_produces")
+            return
+        self.metrics.count("shuffle_produced")
+        self._broadcast_shuffle(sid)
+
+    def _on_shuffle_ack(self, handle: _ExecutorHandle, sid: int,
+                        map_index: int, part: int) -> None:
+        with self._lock:
+            state = self._shuffles.get(sid)
+            task = (state.tasks.get(map_index)
+                    if state is not None else None)
+            if task is not None:
+                task["acks"].add(int(part))
+        self.metrics.count("shuffle_acks")
+
+    def _broadcast_shuffle(self, sid: int) -> None:
+        """Push one shuffle's current partition map to its participants
+        (every worker that ever held one of its tasks)."""
+        with self._lock:
+            state = self._shuffles.get(sid)
+            if state is None:
+                return
+            wire = state.wire_map()
+            nparts = state.nparts
+            conns = [h.conn for wid in state.workers_seen
+                     for h in (self._handles.get(wid),)
+                     if h is not None and h.health == _ALIVE]
+        for conn in conns:
+            conn.send((rpc.MSG_SHUFFLE_MAP, sid, nparts, wire))
+
+    def _shuffle_cleanup(self, sid: int) -> None:
+        """The shuffle's parent reached a terminal state: retire the
+        partition map and tell every participant to free its store."""
+        with self._lock:
+            state = self._shuffles.pop(sid, None)
+            if state is None:
+                return
+            conns = [h.conn for wid in state.workers_seen
+                     for h in (self._handles.get(wid),)
+                     if h is not None and h.health == _ALIVE]
+        self.metrics.count("shuffles_completed")
+        for conn in conns:
+            conn.send((rpc.MSG_SHUFFLE_CLEANUP, sid))
+
+    def _revive_shuffle_tasks(self, dead: _ExecutorHandle) -> None:
+        """Data-plane lineage recovery on worker death: any LIVE
+        shuffle's task located on the dead incarnation loses its
+        produced data with the process.  Tasks whose lease is still live
+        re-produce through the normal re-dispatch; a task whose lease
+        already completed has nobody to re-run it — so the supervisor
+        revives it as a produce-only child (``reproduce``) from the
+        retained shard, keeping the partition available for consumers
+        that have not fetched it yet."""
+        revivals = []
+        stale_sids = []
+        with self._lock:
+            for state in self._shuffles.values():
+                for m, task in state.tasks.items():
+                    if (task["worker"] != dead.worker_id
+                            or task["inc"] != dead.incarnation):
+                        continue
+                    task["worker"], task["inc"] = -1, -1
+                    task["state"], task["ep"] = "pending", None
+                    stale_sids.append(state.sid)
+                    if task["rid"] in self._leases:
+                        continue  # live lease: re-dispatch re-produces
+                    tid = self.sessions.next_task_id()
+                    task["rid"] = tid
+                    revival = Request(
+                        handler=state.handler,
+                        payload={"sid": state.sid, "m": m,
+                                 "nparts": state.nparts, "rid": tid,
+                                 "data": task["data"], "reproduce": True},
+                        session_id="shuffle-revival", priority=1,
+                        deadline=time.monotonic() + 30.0,
+                        seq=next(self._seq), task_id=tid,
+                        split_depth=1, no_batch=True,
+                        shuffle_sid=state.sid, shuffle_map_index=m,
+                    )
+                    revivals.append(revival)
+        for sid in set(stale_sids):
+            self._broadcast_shuffle(sid)
+        for revival in revivals:
+            self.metrics.count("shuffle_revivals")
+            _flight.record(_flight.EV_LEASE_REDISPATCH, revival.task_id,
+                           detail=f"rid:{revival.task_id}:"
+                                  f"sid:{revival.shuffle_sid}:"
+                                  f"map:{revival.shuffle_map_index}:"
+                                  f"reproduce")
+            self._requeue(revival)
+
     def _grant(self, req: Request) -> None:
         rid = req.task_id
         now_ns = time.monotonic_ns()
@@ -613,6 +860,7 @@ class Supervisor:
         # worker declared dead between a separate pick and record would
         # leave the lease pointing at an incarnation whose orphan scan
         # already ran — lost forever (review r10, pass 2)
+        broadcast_sid = None
         with self._lock:
             candidates = [h for h in self._handles.values()
                           if h.health == _ALIVE
@@ -635,6 +883,9 @@ class Supervisor:
                 lease.dispatches += 1
                 lease.granted_ns = now_ns
                 target.inflight.add(rid)
+                if req.shuffle_sid is not None and req.shuffle_map_index >= 0:
+                    broadcast_sid = self._shuffle_task_located(
+                        req, target.worker_id, target.incarnation)
         if target is None:
             # no live capacity right now (all dead/saturated/starting):
             # breathe, then line back up — deadline expiry in the queue
@@ -642,6 +893,10 @@ class Supervisor:
             time.sleep(min(0.05, self.heartbeat_s))
             self._requeue(req)
             return
+        if broadcast_sid is not None:
+            # a (re-)located map task's old endpoint must stop being
+            # consulted before the new incarnation's produce lands
+            self._broadcast_shuffle(broadcast_sid)
         if req.response.admitted_ns == 0:
             req.response.admitted_ns = now_ns
             self.metrics.count("admitted", req.session_id)
@@ -691,7 +946,14 @@ class Supervisor:
                      or lease.incarnation != handle.incarnation)
             if not stale:
                 handle.inflight.discard(rid)
-                if status == rpc.STATUS_BUSY:
+                # a fetch that stalled out (dead peer mid-recovery, storm
+                # of transport faults) is data-plane weather, not a
+                # handler failure: re-dispatch like BUSY, bounded by the
+                # same blast-radius cap hung leases get
+                stalled = (status == ERROR and err
+                           and err[0] == "ShuffleFetchStalled"
+                           and lease.dispatches < self.lease_max_dispatches)
+                if status == rpc.STATUS_BUSY or stalled:
                     lease.state = _QUEUED  # transition: lease leased->queued
                     if lease.redispatches == 0:
                         self._leases_redispatched += 1
@@ -706,10 +968,11 @@ class Supervisor:
             return
         req = lease.req
         if requeue:
+            why = "busy" if status == rpc.STATUS_BUSY else "fetch_stalled"
             self.metrics.count("leases_redispatched")
             _flight.record(_flight.EV_LEASE_REDISPATCH, rid,
                            detail=f"rid:{rid}:from:{handle.worker_id}."
-                                  f"{handle.incarnation}:busy")
+                                  f"{handle.incarnation}:{why}")
             self._requeue(req)
             return
         self.metrics.count("leases_completed", req.session_id)
@@ -737,6 +1000,31 @@ class Supervisor:
         while not self._stop.wait(period):
             self._health_sweep()
             self._ladder_tick()
+            self._pressure_broadcast()
+
+    def _pressure_broadcast(self) -> None:
+        """Federated admission (ROADMAP item 1's tail): aggregate the
+        workers' heartbeat gauges into ONE cluster-wide pressure view and
+        push it down to every worker's AdmissionController tick — knob
+        decisions then see the cluster, not one process (ledger reasons
+        carry a ``:cluster`` suffix when this signal drives them)."""
+        with self._lock:
+            alive = [h for h in self._handles.values()
+                     if h.health == _ALIVE]
+            gauges = [h.gauges for h in alive if h.gauges]
+            conns = [h.conn for h in alive]
+        if not gauges or not conns:
+            return
+        cluster = {
+            "blocked_frac": sum(float(g.get("blocked_frac", 0.0))
+                                for g in gauges) / len(gauges),
+            "mem_frac": max(float(g.get("mem_frac", 0.0))
+                            for g in gauges),
+            "queue_frac": self.queue.depth() / max(1, self.queue.maxsize),
+            "workers": len(gauges),
+        }
+        for conn in conns:
+            conn.send((rpc.MSG_PRESSURE, cluster))
 
     def _health_sweep(self) -> None:
         now = time.monotonic()
@@ -888,6 +1176,17 @@ class Supervisor:
                 }
                 for h in self._handles.values()
             }
+            shuffles = {
+                str(st.sid): {
+                    "nparts": st.nparts,
+                    "parent_rid": st.parent_rid,
+                    "handler": st.handler,
+                    "produced": sum(1 for t in st.tasks.values()
+                                    if t["state"] == "produced"),
+                    "acks": sum(len(t["acks"]) for t in st.tasks.values()),
+                }
+                for st in self._shuffles.values()
+            }
             ladder = {
                 "level": self._level,
                 "level_name": DEGRADE_LEVELS[self._level],
@@ -901,6 +1200,7 @@ class Supervisor:
             "workers": workers,
             "ladder": ladder,
             "leases": self.lease_stats(),
+            "shuffles": shuffles,
             "queue_depth": self.queue.depth(),
             "counters": self.metrics.snapshot()["counters"],
         }
@@ -933,6 +1233,14 @@ class Supervisor:
             orphans = [le.req for le in live]
             for le in live:
                 self._lease_done_locked(le)
+            live_sids = list(self._shuffles)
+            self._shuffles.clear()
+        # abandoned shuffles must not leak spooled frames on the shared
+        # host: broadcast their cleanup before asking workers to exit
+        for sid in live_sids:
+            for h in handles:
+                if h.conn is not None and h.health == _ALIVE:
+                    h.conn.send((rpc.MSG_SHUFFLE_CLEANUP, sid))
         for h in handles:
             if h.conn is not None:
                 h.conn.send((rpc.MSG_SHUTDOWN, self.dump_on_exit))

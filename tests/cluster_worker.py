@@ -67,3 +67,25 @@ def register_toy(engine, service_s: float = 0.0) -> None:
         raise ValueError(f"boom: {p}")
 
     engine.register(QueryHandler(name="boom", fn=run_boom))
+
+
+def register_shuffle(engine, capacity: int = 64,
+                     map_delay_s: float = 0.0) -> None:
+    """The cross-process shuffle handler (round 13): q97's Exchange plan
+    served as a real peer-to-peer shuffle piece.  Imports stay inside —
+    THIS factory pulls in jax (plan compiler), so only the shuffle
+    cluster pays the heavy spawn.  ``map_delay_s`` stalls each piece
+    BEFORE its map fragment runs, widening the mid-exchange window the
+    SIGKILL tests aim a kill into."""
+    from spark_rapids_jni_tpu.models.q97 import q97_plan
+    from spark_rapids_jni_tpu.serve.shuffle import run_shuffle_piece
+
+    plan = q97_plan(capacity)
+
+    def fn(payload, ctx):
+        if map_delay_s:
+            time.sleep(map_delay_s)
+        return run_shuffle_piece(plan, payload, ctx)
+
+    engine.register(QueryHandler(
+        name="q97_shuffle", fn=fn, nbytes_of=lambda p: 0))

@@ -1211,6 +1211,46 @@ def test_wire_suppression_honored(tmp_path):
     assert run(root, rules=["wire-protocol"]) == []
 
 
+def test_wire_second_registry_module_checked(tmp_path):
+    # round 13: the frame control protocol (columnar/frames.py) declares
+    # its own MESSAGE_FIELDS; both registries merge into one schema and
+    # construct/destructure sites check against either
+    files = dict(WIRE_PKG)
+    files["columnar/frames.py"] = """
+        FR_FETCH = "fr_fetch"
+
+        MESSAGE_FIELDS = {
+            FR_FETCH: ("sid", "part"),
+        }
+    """
+    files["serve/shuffle.py"] = """
+        from pkg.columnar.frames import FR_FETCH
+
+
+        def request(sock, sid):
+            sock.send((FR_FETCH, sid))  # 1 field, registry declares 2
+    """
+    root = write_pkg(tmp_path, files)
+    cfg = analyze.Config(rules={"wire-protocol"})
+    fs = analyze.analyze(root, cfg)
+    assert len(fs) == 1
+    assert "FR_FETCH" in fs[0].message and "1 fields" in fs[0].message
+
+
+def test_wire_duplicate_tag_across_registries_flagged(tmp_path):
+    files = dict(WIRE_PKG)
+    files["columnar/frames.py"] = """
+        FR_PING = "ping"
+
+        MESSAGE_FIELDS = {
+            FR_PING: ("sid",),
+        }
+    """
+    root = write_pkg(tmp_path, files)
+    fs = analyze.analyze(root, analyze.Config(rules={"wire-protocol"}))
+    assert len(fs) == 1 and "two wire registries" in fs[0].message
+
+
 # ---------------------------------------------------------- wire ids
 
 

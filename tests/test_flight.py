@@ -145,8 +145,12 @@ def test_event_kind_vocabulary_is_stable():
         "lease_grant", "lease_redispatch", "lease_done",
         "worker_spawn", "worker_dead")
     # round 12: the ragged batching kinds are strictly appended after
-    assert flight.EVENT_KINDS[-3:] == (
+    assert flight.EVENT_KINDS[24:27] == (
         "ragged_pack", "ragged_launch", "ragged_split")
+    # round 13: the shuffle data-plane kinds are strictly appended after
+    assert flight.EVENT_KINDS[-4:] == (
+        "shuffle_produce", "shuffle_fetch", "shuffle_retry",
+        "shuffle_ack")
     assert len(set(flight.EVENT_KINDS)) == len(flight.EVENT_KINDS)
 
 

@@ -135,6 +135,70 @@ def test_session_scale_rejects_then_recovers(gov):
         eng.shutdown()
 
 
+# -------------------------------------------- federated cluster pressure
+
+
+def test_cluster_pressure_drives_knobs_with_cluster_reason(gov):
+    """Round 13 (federated admission): a locally-calm worker in an
+    overloaded CLUSTER tightens its knobs, and the decision ledger says
+    the cluster signal drove the move (':cluster' reason suffix)."""
+    eng = _engine(gov)
+    try:
+        ctl = AdmissionController(eng, dwell_ticks=1)
+        ctl.note_cluster_pressure({"blocked_frac": 1.0, "mem_frac": 0.2})
+        for _ in range(8):
+            ctl.tick(_sig(0.0))  # local signals read fully calm
+        reasons = [e["reason"] for e in ctl.ledger]
+        assert any(r == "pressure_high:cluster" for r in reasons), reasons
+        assert eng.queue.maxsize < eng.static_queue_size
+        assert ctl.snapshot()["cluster_pressure"] == 1.0
+    finally:
+        eng.shutdown()
+
+
+def test_local_pressure_keeps_plain_reason(gov):
+    """Local overload with a calmer cluster view keeps the round-9
+    ledger vocabulary — cluster-suffixed reasons appear ONLY when the
+    cluster aggregate exceeds the local view."""
+    eng = _engine(gov)
+    try:
+        ctl = AdmissionController(eng, dwell_ticks=1)
+        ctl.note_cluster_pressure({"blocked_frac": 0.1, "mem_frac": 0.0})
+        for _ in range(8):
+            ctl.tick(_sig(1.0))
+        reasons = [e["reason"] for e in ctl.ledger]
+        assert any(r.startswith("pressure_high") for r in reasons)
+        assert not any(":cluster" in r for r in reasons), reasons
+    finally:
+        eng.shutdown()
+
+
+def test_stale_cluster_pressure_ages_out(gov, monkeypatch):
+    """A supervisor that stops broadcasting must not pin an orphaned
+    worker's posture: the cluster sample ages out and local signals
+    govern again."""
+    from spark_rapids_jni_tpu.serve import controller as ctl_mod
+
+    monkeypatch.setattr(ctl_mod, "_CLUSTER_STALE_S", 0.05)
+    eng = _engine(gov)
+    try:
+        # the bound scales with the CONFIGURED heartbeat (4 periods), so
+        # pin a fast one — a slow-beating deployment must widen it, not
+        # have federated admission silently age out every sample
+        with config.override(serve_heartbeat_s=0.01):
+            ctl = AdmissionController(eng, dwell_ticks=1)
+            ctl.note_cluster_pressure({"blocked_frac": 1.0})
+            assert ctl._cluster_pressure() == 1.0
+            time.sleep(0.1)
+            assert ctl._cluster_pressure() == 0.0
+        with config.override(serve_heartbeat_s=10.0):
+            ctl.note_cluster_pressure({"blocked_frac": 1.0})
+            time.sleep(0.1)  # well within 4 x 10s: still fresh
+            assert ctl._cluster_pressure() == 1.0
+    finally:
+        eng.shutdown()
+
+
 # ------------------------------------------------------------ kill switch
 
 

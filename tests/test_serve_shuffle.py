@@ -1,0 +1,687 @@
+"""Crash-safe columnar shuffle: the peer-to-peer data plane (round 13).
+
+What ISSUE 12's acceptance pins:
+
+- a plan's Exchange splits into map fragment + reduce plan that are
+  bit-identical to the single-process oracle (and the host oracle);
+- the framed transport detects corrupt/truncated frames by checksum and
+  re-fetches; stalled peers trip the I/O timeout into seeded-jitter
+  backoff; a partition that never appears fails ShuffleFetchStalled
+  (which the supervisor re-dispatches, not terminally);
+- the supervisor's partition map tracks producer incarnation + consumer
+  acks, re-points tasks at the incarnation holding their lease, and
+  REVIVES produce-only children when a completed task's executor dies
+  with its data;
+- a producer SIGKILLed mid-exchange recovers with exactly-once
+  completion, and the partition lineage (rid:/sid:/part: tokens) is
+  reconstructable across processes via flightdump --cluster.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.models.q97 import q97_host_oracle, q97_plan
+from spark_rapids_jni_tpu.obs import flight as _flight
+from spark_rapids_jni_tpu.obs.faultinj import FaultInjector
+from spark_rapids_jni_tpu.plans import ir
+from spark_rapids_jni_tpu.plans.compiler import (
+    EXCHANGE_SOURCE,
+    emit_exchange_partitions,
+    split_exchange_plan,
+)
+from spark_rapids_jni_tpu.serve import ShuffleSpec, Supervisor
+from spark_rapids_jni_tpu.serve.queue import ERROR, OK
+from spark_rapids_jni_tpu.serve.shuffle import (
+    ShuffleFetchStalled,
+    ShuffleService,
+    combine_exchange_outputs,
+    run_exchange_plan_local,
+    scan_table_names,
+    split_tables_n,
+)
+from spark_rapids_jni_tpu.serve.supervisor import _ExecutorHandle
+
+from spark_rapids_jni_tpu import config
+
+
+def _q97_tables(seed, n):
+    rng = np.random.RandomState(seed)
+    store = (rng.randint(1, 60, n).astype(np.int32),
+             rng.randint(1, 25, n).astype(np.int32))
+    catalog = (rng.randint(1, 60, n).astype(np.int32),
+               rng.randint(1, 25, n).astype(np.int32))
+    tables = {"store": {"cust": store[0], "item": store[1]},
+              "catalog": {"cust": catalog[0], "item": catalog[1]}}
+    return tables, q97_host_oracle(store, catalog)
+
+
+def _out3(out):
+    return (int(out["store_only"]), int(out["catalog_only"]),
+            int(out["both"]))
+
+
+# ------------------------------------------------- the compiler-side split
+
+
+def test_split_exchange_plan_shape():
+    exchange, reduce_plan = split_exchange_plan(q97_plan(64))
+    assert isinstance(exchange, ir.Exchange)
+    assert not ir.has_exchange(reduce_plan)
+    scans = ir.scan_tables(reduce_plan)
+    assert [s.table for s in scans] == [EXCHANGE_SOURCE]
+    assert scans[0].fields == exchange.fields
+
+
+def test_split_rejects_plans_without_exactly_one_exchange():
+    no_ex = ir.Plan("local", (ir.SegmentAgg(
+        ir.Scan("t", ("k", "v")), key=ir.col("k"), num_segments=4,
+        aggs=(("s", ir.col("v"), "int64"),)),))
+    with pytest.raises(ValueError, match="0 Exchange"):
+        split_exchange_plan(no_ex)
+
+
+def test_split_rejects_scans_above_the_exchange():
+    below = ir.Project(ir.Scan("t", ("k",)), (("key", ir.col("k")),))
+    ex = ir.Exchange(below, key=ir.col("key"), capacity=8,
+                     fields=("key",))
+    above = ir.Union((ex, ir.Scan("u", ("key",))), tag="tag",
+                     tag_values=(0, 1))
+    plan = ir.Plan("bad", (ir.PresenceCount(above, key="key", tag="tag"),))
+    with pytest.raises(ValueError, match="ABOVE its Exchange"):
+        split_exchange_plan(plan)
+
+
+def test_map_partitions_conserve_rows_and_follow_placement_hash():
+    from spark_rapids_jni_tpu.parallel.shuffle import partition_of
+
+    tables, _ = _q97_tables(3, 200)
+    exchange, _ = split_exchange_plan(q97_plan(64))
+    for nparts in (1, 2, 3, 5):
+        parts = emit_exchange_partitions(exchange, tables, nparts)
+        assert len(parts) == nparts
+        assert sum(len(p["key"]) for p in parts) == 400
+        for pi, part in enumerate(parts):
+            if len(part["key"]):
+                owner = np.asarray(partition_of(part["key"], nparts))
+                assert (owner == pi).all()
+
+
+def test_filter_below_exchange_drops_masked_rows():
+    scan = ir.Scan("t", ("k",))
+    filt = ir.Filter(ir.Project(scan, (("key", ir.Cast(ir.col("k"),
+                                                       "int64")),)),
+                     pred=ir.Bin("ge", ir.col("k"), ir.lit(5)))
+    ex = ir.Exchange(filt, key=ir.col("key"), capacity=8, fields=("key",))
+    exchange = ex
+    tables = {"t": {"k": np.arange(10, dtype=np.int32)}}
+    parts = emit_exchange_partitions(exchange, tables, 2)
+    got = np.sort(np.concatenate([p["key"] for p in parts]))
+    assert np.array_equal(got, np.arange(5, 10, dtype=np.int64))
+
+
+@pytest.mark.parametrize("n", [64, 300, 1000])
+def test_local_exchange_oracle_matches_host_oracle(n):
+    tables, want = _q97_tables(n, n)
+    out = run_exchange_plan_local(q97_plan(64), tables)
+    assert _out3(out) == want
+
+
+def test_combine_sums_partials_like_psum():
+    tables, want = _q97_tables(11, 500)
+    plan = q97_plan(64)
+    exchange, reduce_plan = split_exchange_plan(plan)
+    scans = scan_table_names(plan)
+    shards = split_tables_n(tables, scans, 3)
+    # simulate the cluster: every shard maps, partitions co-locate by
+    # reduce index, every reduce runs the compiled reduce plan, the
+    # combiner sums — must equal the host oracle exactly
+    from spark_rapids_jni_tpu.plans.runtime import execute_plan
+
+    parts = [emit_exchange_partitions(exchange, s, 3) for s in shards]
+    outs = []
+    for p in range(3):
+        concat = {f: np.concatenate([parts[m][p][f] for m in range(3)])
+                  for f in exchange.fields}
+        outs.append({k: np.asarray(v) for k, v in execute_plan(
+            None, reduce_plan, {EXCHANGE_SOURCE: concat}).items()})
+    combined = combine_exchange_outputs(plan)(outs)
+    assert _out3(combined) == want
+
+
+# ----------------------------------------------------- transport service
+
+
+@pytest.fixture
+def services():
+    made = []
+
+    def make(**kw):
+        svc = ShuffleService(**kw).start()
+        made.append(svc)
+        return svc
+
+    yield make
+    for svc in made:
+        svc.close()
+
+
+def _produced_map(svc, sid, nparts, sizes=None):
+    return ("shuffle_map", sid, nparts,
+            {0: {"state": "produced", "ep": svc.endpoint,
+                 "incarnation": 0, "sizes": dict(sizes or {})}})
+
+
+def test_socket_fetch_round_trip_and_gauges(services):
+    prod, cons = services(), services()
+    t = {"key": np.arange(64, dtype=np.int64),
+         "tag": (np.arange(64) % 2).astype(np.int8)}
+    sizes = prod.produce(5, 0, [t, t])
+    assert set(sizes) == {0, 1} and all(v > 0 for v in sizes.values())
+    cons.on_message(_produced_map(prod, 5, 2, sizes))
+    cols = cons.fetch(5, 0, 1, deadline=time.monotonic() + 10)
+    assert np.array_equal(cols["key"], t["key"])
+    assert cols["tag"].dtype == np.int8
+    snap = cons.snapshot()
+    assert snap["counters"]["fetched"] == 1
+    assert snap["counters"]["bytes_fetched"] > 0
+    assert prod.snapshot()["counters"]["frames_sent"] == 1
+    assert prod.snapshot()["store_partitions"] == 2
+    # advertised sizes drive the consumer's credit reservation
+    assert cons.advertised_size(5, 0, 1) == sizes[1]
+
+
+def test_local_store_fast_path(services):
+    svc = services()
+    t = {"key": np.arange(8, dtype=np.int64)}
+    svc.produce(6, 2, [t])
+    mark = len(_flight.snapshot())
+    cols = svc.fetch(6, 2, 0, deadline=time.monotonic() + 5)
+    assert np.array_equal(cols["key"], t["key"])
+    evs = [e for e in _flight.snapshot()[mark:]
+           if e["kind"] == "shuffle_fetch"]
+    assert evs and ":src:local" in evs[-1]["detail"]
+
+
+def test_fetch_waits_for_late_producer(services):
+    prod, cons = services(), services()
+    t = {"key": np.arange(16, dtype=np.int64)}
+
+    def later():
+        time.sleep(0.3)
+        sizes = prod.produce(7, 0, [t])
+        cons.on_message(_produced_map(prod, 7, 1, sizes))
+
+    threading.Thread(target=later, daemon=True).start()
+    cols = cons.fetch(7, 0, 0, deadline=time.monotonic() + 10)
+    assert np.array_equal(cols["key"], t["key"])
+    assert cons.snapshot()["counters"]["fetch_retries"] >= 1
+
+
+def test_fetch_stalls_out_with_seeded_backoff(services):
+    cons = services()
+    cons.on_message(("shuffle_map", 8, 1,
+                     {0: {"state": "pending", "ep": None,
+                          "incarnation": 0, "sizes": {}}}))
+    mark = len(_flight.snapshot())
+    t0 = time.monotonic()
+    with pytest.raises(ShuffleFetchStalled):
+        cons.fetch(8, 0, 0, deadline=time.monotonic() + 0.5)
+    assert time.monotonic() - t0 >= 0.4
+    reasons = [e["detail"].rsplit("reason:", 1)[-1]
+               for e in _flight.snapshot()[mark:]
+               if e["kind"] == "shuffle_retry"]
+    assert reasons and set(reasons) == {"pending"}
+
+
+def test_corrupt_frames_detected_and_refetched(services):
+    prod, cons = services(), services()
+    t = {"key": np.arange(256, dtype=np.int64)}
+    sizes = prod.produce(9, 0, [t])
+    cons.on_message(_produced_map(prod, 9, 1, sizes))
+    FaultInjector.install({
+        "seed": 4,
+        "shuffle": {"frame:*": {"percent": 100.0,
+                                "injectionType": "frame_corrupt",
+                                "interceptionCount": 3}},
+    })
+    try:
+        cols = cons.fetch(9, 0, 0, deadline=time.monotonic() + 30)
+    finally:
+        FaultInjector.uninstall()
+    assert np.array_equal(cols["key"], t["key"])
+    c = cons.snapshot()["counters"]
+    assert c["retry_crc"] == 3 and c["fetched"] == 1
+    assert prod.snapshot()["counters"]["faults_corrupt"] == 3
+
+
+def test_truncated_frames_detected_and_refetched(services):
+    prod, cons = services(), services()
+    t = {"key": np.arange(256, dtype=np.int64)}
+    sizes = prod.produce(10, 0, [t])
+    cons.on_message(_produced_map(prod, 10, 1, sizes))
+    FaultInjector.install({
+        "seed": 4,
+        "shuffle": {"trunc:*": {"percent": 100.0,
+                                "injectionType": "frame_truncate",
+                                "interceptionCount": 2}},
+    })
+    try:
+        cols = cons.fetch(10, 0, 0, deadline=time.monotonic() + 30)
+    finally:
+        FaultInjector.uninstall()
+    assert np.array_equal(cols["key"], t["key"])
+    c = cons.snapshot()["counters"]
+    assert c.get("retry_truncated", 0) + c.get("retry_eof", 0) >= 1
+
+
+def test_stalled_peer_trips_io_timeout_into_backoff(services):
+    prod = services(io_timeout_s=0.3)
+    cons = services(io_timeout_s=0.3)
+    t = {"key": np.arange(32, dtype=np.int64)}
+    sizes = prod.produce(11, 0, [t])
+    cons.on_message(_produced_map(prod, 11, 1, sizes))
+    FaultInjector.install({
+        "seed": 4,
+        "shuffle": {"stall:*": {"percent": 100.0,
+                                "injectionType": "peer_stall",
+                                "durationMs": 800.0,
+                                "interceptionCount": 1}},
+    })
+    try:
+        cols = cons.fetch(11, 0, 0, deadline=time.monotonic() + 30)
+    finally:
+        FaultInjector.uninstall()
+    assert np.array_equal(cols["key"], t["key"])
+    assert cons.snapshot()["counters"].get("retry_stall", 0) >= 1
+
+
+def test_spool_fast_path_same_host(services, tmp_path):
+    spool = str(tmp_path / "spool")
+    prod = services(spool_dir=spool)
+    cons = services(spool_dir=spool)
+    t = {"key": np.arange(64, dtype=np.int64)}
+    sizes = prod.produce(12, 0, [t])
+    cons.on_message(_produced_map(prod, 12, 1, sizes))
+    mark = len(_flight.snapshot())
+    cols = cons.fetch(12, 0, 0, deadline=time.monotonic() + 10)
+    assert np.array_equal(cols["key"], t["key"])
+    evs = [e for e in _flight.snapshot()[mark:]
+           if e["kind"] == "shuffle_fetch"]
+    assert evs and ":src:spool" in evs[-1]["detail"]
+    assert os.path.exists(os.path.join(spool, "12_0_0.frame"))
+    prod.cleanup(12)
+    assert not os.path.exists(os.path.join(spool, "12_0_0.frame"))
+
+
+def test_cleanup_frees_store_and_nacks_gone(services):
+    prod, cons = services(), services()
+    t = {"key": np.arange(8, dtype=np.int64)}
+    sizes = prod.produce(13, 0, [t])
+    cons.on_message(_produced_map(prod, 13, 1, sizes))
+    prod.cleanup(13)
+    assert prod.snapshot()["store_partitions"] == 0
+    with pytest.raises(ShuffleFetchStalled, match="gone"):
+        cons.fetch(13, 0, 0, deadline=time.monotonic() + 0.4)
+
+
+# --------------------------------------------- supervisor partition map
+
+
+@pytest.fixture
+def sup_unit():
+    plan = q97_plan(64)
+    scans = scan_table_names(plan)
+    sup = Supervisor(workers=2, factory=None, start=False)
+    sup.register(ShuffleSpec(
+        "q97_shuffle",
+        split_n=lambda p, n: split_tables_n(p, scans, n),
+        combine=combine_exchange_outputs(plan),
+        nbytes_of=lambda p: 0, fanout=2))
+    yield sup
+    sup.shutdown(drain=False, timeout=5)
+
+
+class _RecConn:
+    def __init__(self):
+        self.sent = []
+
+    def send(self, msg):
+        self.sent.append(msg)
+        return True
+
+    def close(self):
+        pass
+
+
+def _alive_handles(sup, n=2):
+    handles = []
+    for wid in range(n):
+        h = _ExecutorHandle(wid, 0, proc=None, conn=_RecConn())
+        h.health = "alive"
+        with sup._lock:
+            sup._handles[wid] = h
+        handles.append(h)
+    return handles
+
+
+def _submit_shuffle(sup, n_rows=120):
+    tables, want = _q97_tables(21, n_rows)
+    s = sup.open_session("t", priority=1)
+    resp = sup.submit(s, "q97_shuffle", tables)
+    return resp, want
+
+
+def test_shuffle_dispatch_builds_partition_map(sup_unit):
+    sup = sup_unit
+    _alive_handles(sup)
+    resp, _want = _submit_shuffle(sup)
+    req = sup.queue.pop(timeout=1)
+    sup._route(req)
+    assert sup.queue.depth() == 2  # two map children queued
+    with sup._lock:
+        (state,) = sup._shuffles.values()
+    assert state.nparts == 2 and state.handler == "q97_shuffle"
+    assert {t["state"] for t in state.tasks.values()} == {"pending"}
+    # route the children: leases grant, tasks point at their workers,
+    # and every participant got a map broadcast
+    for _ in range(2):
+        child = sup.queue.pop(timeout=1)
+        assert child.payload["nparts"] == 2
+        assert child.payload["rid"] == child.task_id
+        sup._route(child)
+        sup.queue.task_done()
+    with sup._lock:
+        located = {t["worker"] for t in state.tasks.values()}
+    assert located == {0, 1}  # least-loaded spread across both
+    maps = [m for h in sup._handles.values()
+            for m in h.conn.sent if m[0] == "shuffle_map"]
+    assert maps and maps[-1][2] == 2
+
+
+def test_produced_and_acks_land_in_partition_map(sup_unit):
+    sup = sup_unit
+    handles = _alive_handles(sup)
+    resp, _ = _submit_shuffle(sup)
+    req = sup.queue.pop(timeout=1)
+    sup._route(req)
+    for _ in range(2):
+        child = sup.queue.pop(timeout=1)
+        sup._route(child)
+        sup.queue.task_done()
+    with sup._lock:
+        (state,) = sup._shuffles.values()
+        m0_worker = state.tasks[0]["worker"]
+    h = handles[m0_worker]
+    sup._on_shuffle_produced(h, state.sid, 0, {0: 100, 1: 120},
+                             ("127.0.0.1", 9999))
+    with sup._lock:
+        assert state.tasks[0]["state"] == "produced"
+        assert state.tasks[0]["sizes"] == {0: 100, 1: 120}
+    sup._on_shuffle_ack(h, state.sid, 0, 1)
+    with sup._lock:
+        assert state.tasks[0]["acks"] == {1}
+    snap = sup.snapshot()["shuffles"][str(state.sid)]
+    assert snap["produced"] == 1 and snap["acks"] == 1
+    # a recycled incarnation's late announcement is dropped
+    stale = _ExecutorHandle(m0_worker, 99, proc=None, conn=_RecConn())
+    sup._on_shuffle_produced(stale, state.sid, 0, {0: 1}, ("x", 1))
+    assert sup.metrics.get("shuffle_stale_produces") == 1
+
+
+def test_dead_producer_with_completed_lease_is_revived(sup_unit):
+    """The lineage hole the revival path closes: a map task whose lease
+    already completed but whose executor then died took its produced
+    partitions with it — a produce-only child re-creates them from the
+    retained shard."""
+    sup = sup_unit
+    handles = _alive_handles(sup)
+    resp, _ = _submit_shuffle(sup)
+    req = sup.queue.pop(timeout=1)
+    sup._route(req)
+    children = []
+    for _ in range(2):
+        child = sup.queue.pop(timeout=1)
+        sup._route(child)
+        sup.queue.task_done()
+        children.append(child)
+    with sup._lock:
+        (state,) = sup._shuffles.values()
+        m0 = next(m for m, t in state.tasks.items() if t["worker"] == 0)
+        old_rid = state.tasks[m0]["rid"]
+    # complete task m0's lease (worker 0 answered), then kill worker 0
+    sup._on_result(handles[0], old_rid, OK, {"store_only": np.int64(0)},
+                   None)
+    handles[0].proc = type("P", (), {
+        "pid": 0, "kill": lambda s: None,
+        "is_alive": lambda s: False,
+        "join": lambda s, timeout=None: None})()
+    sup._stop.set()  # unit test: the dead path must not spawn a REAL
+    #                  replacement process (factory=None would crash it)
+    sup._worker_dead(handles[0], "proc_exit")
+    assert sup.metrics.get("shuffle_revivals") == 1
+    revival = sup.queue.pop(timeout=1)
+    assert revival.payload.get("reproduce") is True
+    assert revival.payload["m"] == m0
+    assert revival.shuffle_sid == state.sid
+    with sup._lock:
+        assert state.tasks[m0]["rid"] == revival.task_id
+        assert state.tasks[m0]["state"] == "pending"
+
+
+def test_stalled_fetch_redispatches_not_terminal(sup_unit):
+    sup = sup_unit
+    _alive_handles(sup)
+    resp, _ = _submit_shuffle(sup)
+    req = sup.queue.pop(timeout=1)
+    sup._route(req)
+    child = sup.queue.pop(timeout=1)
+    sup._route(child)
+    sup.queue.task_done()
+    with sup._lock:
+        lease = sup._leases[child.task_id]
+    h = sup._handles[lease.worker_id]
+    before = sup.queue.depth()
+    sup._on_result(h, child.task_id, ERROR, None,
+                   ("ShuffleFetchStalled", "partition unavailable"))
+    assert child.response.status == "pending"  # NOT terminal
+    assert sup.queue.depth() == before + 1     # re-queued
+    # ... but the blast-radius cap still binds: at the dispatch limit
+    # the same error becomes terminal
+    redisp = sup.queue.pop(timeout=1)
+    sup._route(redisp)
+    sup.queue.task_done()
+    with sup._lock:
+        lease = sup._leases[child.task_id]
+        lease.dispatches = sup.lease_max_dispatches
+    h2 = sup._handles[lease.worker_id]
+    sup._on_result(h2, child.task_id, ERROR, None,
+                   ("ShuffleFetchStalled", "still unavailable"))
+    assert child.response.status == ERROR
+
+
+def test_parent_completion_retires_map_and_broadcasts_cleanup(sup_unit):
+    sup = sup_unit
+    handles = _alive_handles(sup)
+    resp, _ = _submit_shuffle(sup)
+    req = sup.queue.pop(timeout=1)
+    sup._route(req)
+    for _ in range(2):
+        child = sup.queue.pop(timeout=1)
+        sup._route(child)
+        sup.queue.task_done()
+    with sup._lock:
+        (state,) = sup._shuffles.values()
+    zero = {"store_only": np.int64(0), "catalog_only": np.int64(0),
+            "both": np.int64(0)}
+    for m, task in sorted(state.tasks.items()):
+        sup._on_result(handles[task["worker"]], task["rid"], OK, zero,
+                       None)
+    assert resp.wait(timeout=5)
+    with sup._lock:
+        assert not sup._shuffles
+    cleanups = [m for h in handles for m in h.conn.sent
+                if m[0] == "shuffle_cleanup"]
+    assert cleanups and cleanups[0][1] == state.sid
+    assert sup.metrics.get("shuffles_completed") == 1
+
+
+def test_safeconn_send_times_out_as_backpressure():
+    """Satellite: a peer that stops draining its pipe surfaces as an
+    EV_TASK_HUNG flight event + failed send, never an indefinite block
+    holding the send lock."""
+    import multiprocessing
+
+    from spark_rapids_jni_tpu.serve.rpc import SafeConn
+
+    a, b = multiprocessing.Pipe()
+    conn = SafeConn(a, send_timeout_s=0.3)
+    # small messages: pipe writes under PIPE_BUF are atomic, so
+    # "writable" from the guard's select always means the whole send
+    # fits — the pipe fills to a clean not-writable state
+    payload = ("beat", b"x" * 64)
+    mark = len(_flight.snapshot())
+    sent, t0 = 0, time.monotonic()
+    while time.monotonic() - t0 < 20.0:
+        if not conn.send(payload):
+            break
+        sent += 1
+    else:
+        pytest.fail("send never surfaced backpressure on a full pipe")
+    assert sent >= 1  # the pipe took SOMETHING before filling
+    hung = [e for e in _flight.snapshot()[mark:]
+            if e["kind"] == "task_hung"
+            and "pipe_send_stalled" in e["detail"]]
+    assert hung, "stalled send must record EV_TASK_HUNG"
+    b.close()
+    a.close()
+
+
+# ------------------------------------------------------- process tests
+
+
+def _wait_alive(sup, n, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        snap = sup.snapshot()["workers"]
+        if sum(1 for w in snap.values() if w["state"] == "alive") >= n:
+            return snap
+        time.sleep(0.05)
+    raise AssertionError(f"cluster never reached {n} alive workers")
+
+
+def _shuffle_cluster(dump_dir="", map_delay_s=0.0, workers=2):
+    plan = q97_plan(64)
+    scans = scan_table_names(plan)
+    worker_flags = {"serve_shuffle_fetch_timeout_s": 20.0}
+    if dump_dir:
+        worker_flags["flight_dump_dir"] = dump_dir
+    sup = Supervisor(
+        workers=workers, factory="cluster_worker:register_shuffle",
+        factory_kwargs={"map_delay_s": map_delay_s},
+        worker_cfg={"workers": 4, "queue_size": 32},
+        worker_flags=worker_flags,
+        queue_size=32, default_deadline_s=120.0, lease_hang_s=60.0,
+        dump_on_exit=bool(dump_dir))
+    sup.register(ShuffleSpec(
+        "q97_shuffle",
+        split_n=lambda p, n: split_tables_n(p, scans, n),
+        combine=combine_exchange_outputs(plan),
+        nbytes_of=lambda p: 0, fanout=workers))
+    return sup
+
+
+@pytest.fixture(scope="module")
+def shuffle_cluster():
+    sup = _shuffle_cluster()
+    yield sup
+    sup.shutdown(drain=False, timeout=15)
+
+
+def test_exchange_plan_spans_processes_bit_identical(shuffle_cluster):
+    """The tentpole's headline: a plan containing an Exchange executes
+    across >= 2 executor PROCESSES with the reduce output bit-identical
+    to the single-process oracle (and the host oracle)."""
+    sup = shuffle_cluster
+    _wait_alive(sup, 2)
+    s = sup.open_session(priority=1)
+    for seed, n in ((1, 200), (2, 555), (3, 1024)):
+        tables, want = _q97_tables(seed, n)
+        out = sup.submit(s, "q97_shuffle", tables).result(timeout=180)
+        assert _out3(out) == want
+        local = run_exchange_plan_local(q97_plan(64), tables)
+        assert _out3(out) == _out3(local)  # bit-identical to the oracle
+    snap = sup.snapshot()
+    assert snap["counters"]["shuffles_started"] >= 3
+    assert snap["counters"]["shuffle_produced"] >= 6
+    assert snap["counters"]["shuffle_acks"] >= 12
+    sup.close_session(s)
+
+
+def test_producer_sigkill_mid_exchange_recovers_with_lineage(tmp_path):
+    """Satellite: a shuffle child's producer SIGKILLed mid-exchange —
+    exactly-once completion, and the flight-recorder partition lineage
+    (rid:/sid:/part: tokens) reconstructable via flightdump --cluster."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import flightdump
+
+    dump_dir = str(tmp_path / "dumps")
+    config.set("flight_dump_dir", dump_dir)
+    _flight.recorder().reset_for_tests()
+    sup = _shuffle_cluster(dump_dir=dump_dir, map_delay_s=0.6)
+    try:
+        _wait_alive(sup, 2)
+        s = sup.open_session(priority=1)
+        tables, want = _q97_tables(9, 400)
+        before = sup.metrics.get("leases_redispatched")
+        resp = sup.submit(s, "q97_shuffle", tables)
+        # kill whichever executor holds a map-child lease mid-exchange
+        victim = None
+        deadline = time.monotonic() + 20
+        while victim is None and time.monotonic() < deadline:
+            snap = sup.snapshot()["workers"]
+            victim = next((w for w in snap.values()
+                           if w["inflight"] > 0 and w["pid"]), None)
+            time.sleep(0.02)
+        assert victim is not None, "no map child ever leased"
+        os.kill(victim["pid"], signal.SIGKILL)
+        out = resp.result(timeout=180)
+        assert _out3(out) == want
+        assert sup.metrics.get("leases_redispatched") >= before + 1
+        assert sup.metrics.get("workers_dead") >= 1
+        _wait_alive(sup, 2, timeout=120)
+        _flight.anomaly("cluster_epilogue", detail="supervisor")
+    finally:
+        sup.shutdown(drain=False, timeout=20)
+        config.set("flight_dump_dir", "")
+    merged = flightdump.merge_cluster(dump_dir)
+    assert merged["dumps"] >= 2 and len(merged["pids"]) >= 2
+    # partition lineage: at least one sid chain spans >= 2 processes and
+    # carries rid:/part: detail tokens on produce AND verified fetch
+    spanning = [chain for chain in merged["sids"].values()
+                if len({e["pid"] for e in chain}) >= 2]
+    assert spanning, "no cross-process shuffle chain reconstructed"
+    kinds = {e["kind"] for chain in spanning for e in chain}
+    assert "shuffle_produce" in kinds and "shuffle_fetch" in kinds
+    assert any(":part:" in e["detail"] and "rid:" in e["detail"]
+               for chain in spanning for e in chain
+               if e["kind"] == "shuffle_fetch")
+    # exactly-once: the supervisor's dump records each lease's terminal
+    # lease_done ONCE per rid (late duplicates from the recycled
+    # incarnation are dropped before they can narrate)
+    sup_pid = os.getpid()
+    for rid, chain in merged["rids"].items():
+        n = sum(1 for e in chain if e["kind"] == "lease_done"
+                and e["pid"] == sup_pid
+                and e["detail"].endswith(":ok"))
+        assert n <= 1, f"rid {rid} completed {n} times at the supervisor"
+    redis = [e for e in merged["events"]
+             if e["kind"] == "lease_redispatch"]
+    assert redis, "the kill must have re-dispatched at least one lease"

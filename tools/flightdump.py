@@ -33,6 +33,7 @@ import sys
 from typing import Dict, List
 
 _RID_RE = re.compile(r"(?:^|:)rid:(\d+)")
+_SID_RE = re.compile(r"(?:^|:)sid:(\d+)")
 
 # event kinds that terminate a blocked window for completeness checking
 _CLOSERS = ("woken", "task_killed", "deadlock_verdict")
@@ -178,12 +179,19 @@ def merge_cluster(dump_dir: str) -> dict:
             events.append(ev)
     events.sort(key=lambda e: e["wall_s"])
     rids: Dict[str, List[dict]] = {}
+    sids: Dict[str, List[dict]] = {}
     for e in events:
-        m = _RID_RE.search(str(e.get("detail", "")))
+        detail = str(e.get("detail", ""))
+        m = _RID_RE.search(detail)
         if m:
             rids.setdefault(m.group(1), []).append(e)
+        m = _SID_RE.search(detail)
+        if m:  # shuffle partition lineage (round 13): produce/fetch/
+            # retry/ack events carry sid:<shuffle>/part: tokens on both
+            # sides of the exchange, keyed here per shuffle
+            sids.setdefault(m.group(1), []).append(e)
     return {"dumps": len(paths), "pids": sorted(pids), "events": events,
-            "rids": rids}
+            "rids": rids, "sids": sids}
 
 
 def format_cluster(merged: dict, rid: str | None = None) -> str:
@@ -211,6 +219,15 @@ def format_cluster(merged: dict, rid: str | None = None) -> str:
         for e in chain:
             out.append(f"  +{e['wall_s'] - t0:9.3f} s  pid {e['pid']:<8}"
                        f"{e['kind']:<18}{e.get('detail', '')}")
+    if rid is None:
+        for s in sorted(merged.get("sids", {}), key=int):
+            chain = merged["sids"][s]
+            procs = sorted({e["pid"] for e in chain})
+            out.append(f"\nshuffle sid {s}  (processes: {procs})")
+            for e in chain:
+                out.append(f"  +{e['wall_s'] - t0:9.3f} s  "
+                           f"pid {e['pid']:<8}{e['kind']:<18}"
+                           f"{e.get('detail', '')}")
     return "\n".join(out)
 
 
@@ -243,7 +260,7 @@ def main(argv=None) -> int:
         if args.json:
             json.dump({"dumps": merged["dumps"], "pids": merged["pids"],
                        "events": merged["events"],
-                       "rids": merged["rids"]},
+                       "rids": merged["rids"], "sids": merged["sids"]},
                       sys.stdout, indent=1, sort_keys=True)
             sys.stdout.write("\n")
         else:

@@ -53,6 +53,289 @@ def cluster_worker_factory(engine, bytes_per_row: int = 1024,
         combine=lambda rs: int(sum(rs))))
 
 
+def shuffle_worker_factory(engine, capacity: int = 64) -> None:
+    """Executor-side registration for ``--cluster --chaos-shuffle``: the
+    q97 Exchange plan served as a real peer-to-peer shuffle piece
+    (serve/shuffle.py).  Resolved by name inside each worker process."""
+    from spark_rapids_jni_tpu.models.q97 import q97_plan
+    from spark_rapids_jni_tpu.serve import QueryHandler
+    from spark_rapids_jni_tpu.serve.shuffle import make_shuffle_handler
+
+    engine.register(QueryHandler(
+        name="q97_shuffle", fn=make_shuffle_handler(q97_plan(capacity)),
+        nbytes_of=lambda p: 0))
+
+
+def _shuffle_round(args, *, chaos: bool, dump_dir: str = "") -> dict:
+    """One supervised-cluster shuffle run: every request is a q97
+    Exchange plan executed as a REAL cross-process shuffle (map shards on
+    distinct executors, framed partition push/pull, reduce-side concat),
+    each answer checked against the host oracle.  ``chaos`` arms the
+    seeded data-plane storm (frame corruption, truncation, stalled
+    peers) plus one-shot mid-exchange SIGKILLs per armed incarnation."""
+    import numpy as np
+
+    from spark_rapids_jni_tpu.models.q97 import q97_host_oracle, q97_plan
+    from spark_rapids_jni_tpu.obs import flight as _flight
+    from spark_rapids_jni_tpu.obs.faultinj import chaos_shuffle_config
+    from spark_rapids_jni_tpu.serve import (
+        Backpressure,
+        Degraded,
+        RequestTimeout,
+        ShuffleSpec,
+        Supervisor,
+    )
+    from spark_rapids_jni_tpu.serve.shuffle import (
+        combine_exchange_outputs,
+        scan_table_names,
+        split_tables_n,
+    )
+
+    from spark_rapids_jni_tpu import config
+
+    if dump_dir:
+        config.set("flight_dump_dir", dump_dir)
+        _flight.recorder().reset_for_tests()
+
+    def chaos_fn(wid: int, inc: int):
+        if not chaos:
+            return None
+        # incarnation-0 executors each die at most once, mid-exchange
+        # (the kill rides the budget-reservation crossing the transport
+        # credit and the reduce bracket both take); every incarnation
+        # gets the transport weather
+        return chaos_shuffle_config(
+            seed=args.seed * 1000 + wid * 17 + inc,
+            kill=(inc == 0), kill_pct=args.kill_pct,
+            stall_ms=args.shuffle_stall_ms)
+
+    worker_flags = {
+        # stalls must trip the consumer's per-attempt I/O timeout (the
+        # seeded-jitter backoff path), and a stalled fetch must give up
+        # (re-dispatch) well before the hung-lease recycler fires
+        "serve_shuffle_io_timeout_s": args.shuffle_io_timeout_s,
+        "serve_shuffle_fetch_timeout_s": args.shuffle_fetch_timeout_s,
+    }
+    if dump_dir:
+        worker_flags["flight_dump_dir"] = dump_dir
+    plan = q97_plan(args.shuffle_capacity)
+    scans = scan_table_names(plan)
+    sup = Supervisor(
+        workers=args.cluster,
+        factory="serve_bench:shuffle_worker_factory",
+        factory_kwargs={"capacity": args.shuffle_capacity},
+        worker_cfg={"workers": max(4, args.workers),
+                    "queue_size": max(32, args.queue_size)},
+        worker_flags=worker_flags,
+        chaos=chaos_fn,
+        queue_size=args.queue_size,
+        default_deadline_s=args.deadline_s,
+        lease_hang_s=args.lease_hang_s,
+        lease_max_dispatches=6,
+        dump_on_exit=bool(dump_dir))
+    sup.register(ShuffleSpec(
+        "q97_shuffle",
+        split_n=lambda p, n: split_tables_n(p, scans, n),
+        combine=combine_exchange_outputs(plan),
+        nbytes_of=lambda p: 0, fanout=args.cluster))
+
+    # wait for live capacity so shards actually spread across executors
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline:
+        alive = sum(1 for w in sup.snapshot()["workers"].values()
+                    if w["state"] == "alive")
+        if alive >= args.cluster:
+            break
+        time.sleep(0.05)
+
+    per_client = max(1, args.requests // args.clients)
+    total = per_client * args.clients
+    lock = threading.Lock()
+    tally = {"succeeded": 0, "rejected": 0, "timed_out": 0, "errors": 0,
+             "client_retries": 0, "degraded_retries": 0, "wrong_answers": 0}
+    latencies = []
+
+    def client(ci: int) -> None:
+        rng = np.random.RandomState(args.seed * 1000 + ci)
+        sess = sup.open_session(
+            f"shuffle{ci}", priority=1 if ci % 3 == 0 else 0)
+        for ri in range(per_client):
+            n = args.shuffle_rows
+            store = (rng.randint(1, 60, n).astype(np.int32),
+                     rng.randint(1, 25, n).astype(np.int32))
+            catalog = (rng.randint(1, 60, n).astype(np.int32),
+                       rng.randint(1, 25, n).astype(np.int32))
+            payload = {"store": {"cust": store[0], "item": store[1]},
+                       "catalog": {"cust": catalog[0],
+                                   "item": catalog[1]}}
+            want = q97_host_oracle(store, catalog)
+            t0 = time.perf_counter()
+            outcome = "rejected"
+            for _ in range(args.max_retries):
+                try:
+                    resp = sup.submit(sess, "q97_shuffle", payload)
+                except Degraded as bp:
+                    with lock:
+                        tally["degraded_retries"] += 1
+                    time.sleep(min(bp.retry_after_s, 0.1))
+                    continue
+                except Backpressure as bp:
+                    with lock:
+                        tally["client_retries"] += 1
+                    time.sleep(min(bp.retry_after_s, 0.05))
+                    continue
+                try:
+                    out = resp.result(timeout=args.deadline_s + 60)
+                except RequestTimeout:
+                    outcome = "timed_out"
+                except Exception:  # noqa: BLE001 - counted, not raised
+                    outcome = "errors"
+                else:
+                    outcome = "succeeded"
+                    got = (int(out["store_only"]),
+                           int(out["catalog_only"]), int(out["both"]))
+                    if got != want:
+                        with lock:
+                            tally["wrong_answers"] += 1
+                break
+            dt = time.perf_counter() - t0
+            with lock:
+                tally[outcome] += 1
+                if outcome == "succeeded" and ri >= args.storm_warmup:
+                    latencies.append(dt)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(ci,))
+               for ci in range(args.clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    sup.wait_drained(timeout=120)
+    wall = time.perf_counter() - t0
+    snap = sup.snapshot()
+    if dump_dir:
+        _flight.anomaly("cluster_epilogue", detail="supervisor")
+    sup.shutdown()
+    accounted = (tally["succeeded"] + tally["rejected"] + tally["timed_out"]
+                 + tally["errors"])
+    lat_ms = sorted(1e3 * x for x in latencies)
+    pct = (lambda p: round(
+        lat_ms[min(len(lat_ms) - 1, int(len(lat_ms) * p / 100))], 3)
+        if lat_ms else 0.0)
+    counters = snap["counters"]
+    return {
+        "chaos": chaos,
+        "requests": total,
+        "wall_s": round(wall, 3),
+        "outcomes": tally,
+        "lost": total - accounted,
+        "zero_lost": (accounted == total and tally["errors"] == 0
+                      and tally["timed_out"] == 0
+                      and tally["wrong_answers"] == 0),
+        "oracle_identical": tally["wrong_answers"] == 0,
+        "p50_ms": pct(50),
+        "p99_ms": pct(99),
+        "workers_dead": counters.get("workers_dead", 0),
+        "respawns": counters.get("workers_spawned", 0) - args.cluster,
+        "leases": snap["leases"],
+        "shuffle_counters": {
+            k: counters.get(k, 0)
+            for k in ("shuffles_started", "shuffles_completed",
+                      "shuffle_produced", "shuffle_acks",
+                      "shuffle_revivals", "shuffle_stale_produces",
+                      "leases_redispatched", "duplicate_results")},
+        "counters": counters,
+    }
+
+
+def _run_chaos_shuffle(args) -> int:
+    """``--cluster N --chaos-shuffle``: the crash-safe data-plane
+    acceptance (round 13).  A calm round pins the latency baseline and
+    proves cross-process reduce outputs bit-identical to the host
+    oracle; the chaos round re-runs the identical workload while the
+    seeded storm corrupts/truncates frames, stalls peers, and SIGKILLs
+    executors mid-exchange.  Gates: zero lost + oracle-identical both
+    rounds, >= 2 mid-shuffle kills recovered with respawns, checksum-
+    detected corruption actually re-fetched (retry events with crc/
+    truncated reasons AND verified fetches in the merged dumps), leases
+    exactly-once, bounded p99 inflation."""
+    import tempfile
+
+    calm = _shuffle_round(args, chaos=False)
+    dump_dir = args.dump_dir or tempfile.mkdtemp(prefix="srt_shuffle_")
+    chaos = _shuffle_round(args, chaos=True, dump_dir=dump_dir)
+    merged = _verify_shuffle_dumps(dump_dir)
+    p99_bound = max(float(args.chaos_p99_bound_ms),
+                    args.p99_inflation_factor * max(calm["p99_ms"], 1.0))
+    gates = {
+        "zero_lost": calm["zero_lost"] and chaos["zero_lost"],
+        "oracle_identical": (calm["oracle_identical"]
+                             and chaos["oracle_identical"]),
+        "kills_recovered": (chaos["workers_dead"] >= 2
+                            and chaos["respawns"] >= 2),
+        "corruption_refetched": (merged["retry_integrity"] >= 1
+                                 and merged["fetches"] >= 1),
+        "leases_exactly_once": (
+            chaos["leases"]["outstanding"] == 0
+            and chaos["leases"]["completed"] == chaos["leases"]["leases"]),
+        "p99_bounded": chaos["p99_ms"] <= p99_bound,
+    }
+    rec = {
+        "name": "BENCH_serve",
+        "mode": "chaos_shuffle",
+        "seed": args.seed,
+        "cluster": args.cluster,
+        "clients": args.clients,
+        "shuffle_rows": args.shuffle_rows,
+        "calm": calm,
+        "chaos": chaos,
+        "p99_bound_ms": round(p99_bound, 3),
+        "dump_dir": dump_dir,
+        "shuffle_dumps": merged,
+        "gates": gates,
+        "zero_lost": gates["zero_lost"],
+    }
+    print(json.dumps(rec))
+    return 0 if all(gates.values()) else 1
+
+
+def _verify_shuffle_dumps(dump_dir: str) -> dict:
+    """What the merged per-process dumps prove about the data plane:
+    partition lineage (sid-keyed chains spanning processes), integrity
+    retries (crc/truncated), stall retries, verified fetches, acks."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import flightdump
+
+    merged = flightdump.merge_cluster(dump_dir)
+    kinds = {}
+    retry_integrity = retry_stall = 0
+    for e in merged["events"]:
+        kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+        if e["kind"] == "shuffle_retry":
+            reason = str(e.get("detail", "")).rsplit("reason:", 1)[-1]
+            if reason in ("crc", "truncated"):
+                retry_integrity += 1
+            elif reason in ("stall", "eof"):
+                retry_stall += 1
+    return {
+        "dumps": merged["dumps"],
+        "pids": len(merged["pids"]),
+        "sids": len(merged.get("sids", {})),
+        "cross_process_sids": sum(
+            1 for chain in merged.get("sids", {}).values()
+            if len({e["pid"] for e in chain}) > 1),
+        "produces": kinds.get("shuffle_produce", 0),
+        "fetches": kinds.get("shuffle_fetch", 0),
+        "acks": kinds.get("shuffle_ack", 0),
+        "retries": kinds.get("shuffle_retry", 0),
+        "retry_integrity": retry_integrity,
+        "retry_stall": retry_stall,
+        "worker_dead": kinds.get("worker_dead", 0),
+        "redispatches": kinds.get("lease_redispatch", 0),
+    }
+
+
 def _cluster_round(args, *, chaos: bool, dump_dir: str = "") -> dict:
     """One supervised-cluster run: N executor processes under the
     router/supervisor, closed-loop clients, optional seeded executor
@@ -786,6 +1069,33 @@ def main(argv=None) -> int:
                          "respawns, the degradation ladder stepping down "
                          "AND recovering, bounded p99 inflation, and "
                          "cross-process dump reconstruction")
+    ap.add_argument("--chaos-shuffle", action="store_true",
+                    help="with --cluster: every request is a q97 Exchange "
+                         "plan run as a REAL cross-process shuffle (framed "
+                         "partition push/pull between executors), paired "
+                         "calm/chaos rounds; the chaos round corrupts/"
+                         "truncates frames, stalls peers, and SIGKILLs "
+                         "executors mid-exchange.  Gates: zero lost + "
+                         "oracle-identical reduce outputs both rounds, "
+                         ">= 2 mid-shuffle kills recovered, checksum-"
+                         "detected corruption re-fetched, leases exactly-"
+                         "once, bounded p99")
+    ap.add_argument("--shuffle-rows", type=int, default=384,
+                    help="rows per side of each q97 shuffle request")
+    ap.add_argument("--shuffle-capacity", type=int, default=64,
+                    help="Exchange capacity of the q97 plan value (plan "
+                         "structure only: framed partitions are exact-"
+                         "size, so no overflow retry exists off-mesh)")
+    ap.add_argument("--shuffle-io-timeout-s", type=float, default=0.75,
+                    help="per-attempt socket I/O timeout of one partition "
+                         "fetch (must sit BELOW the injected stall so "
+                         "peer_stall drives the backoff path)")
+    ap.add_argument("--shuffle-fetch-timeout-s", type=float, default=8.0,
+                    help="total per-partition fetch budget before the "
+                         "piece fails ShuffleFetchStalled and re-"
+                         "dispatches (must sit below lease-hang-s)")
+    ap.add_argument("--shuffle-stall-ms", type=float, default=1500.0,
+                    help="injected peer_stall duration (chaos round)")
     ap.add_argument("--kill-pct", type=float, default=12.0,
                     help="per-crossing probability of the armed "
                          "executors' one-shot proc_kill fault")
@@ -804,6 +1114,8 @@ def main(argv=None) -> int:
                          "(default: a fresh temp dir)")
     args = ap.parse_args(argv)
 
+    if args.cluster > 0 and args.chaos_shuffle:
+        return _run_chaos_shuffle(args)
     if args.cluster > 0:
         return _run_cluster(args)
     if args.chaos_storm:
