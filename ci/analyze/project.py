@@ -73,7 +73,8 @@ class Config:
     wire_registry_modules: Tuple[str, ...] = ("serve.rpc",
                                               "columnar.frames")
     wire_scope: Tuple[str, ...] = ("serve.rpc", "serve.supervisor",
-                                   "serve.shuffle", "columnar.frames")
+                                   "serve.shuffle", "serve.telemetry",
+                                   "columnar.frames")
     wire_extra_files: Tuple[str, ...] = ("tests/cluster_worker.py",)
     # pass 8 (wire ids): the committed flight-event wire-id registry,
     # repo-root-relative; the module whose EVENT_KINDS order defines ids

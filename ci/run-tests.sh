@@ -58,9 +58,13 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu SRT_REEXECED=1 \
 # mid-request — gates on zero lost requests, exactly-once lease
 # completion, >= 2 kills with respawns, the degradation ladder stepping
 # down AND recovering, bounded p99 inflation, and the per-process flight
-# dumps merging into one cross-process timeline (flightdump --cluster)
+# dumps merging into one cross-process timeline (flightdump --cluster).
+# Round 14 adds --slo: the LIVE telemetry timeline must reconstruct
+# complete multi-process span waterfalls for >= 95% of completed
+# requests, and the seeded latency storm must drive an EV_SLO_BURN with
+# a ladder reaction and a matching EV_SLO_OK recovery
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu SRT_REEXECED=1 \
-    python tools/serve_bench.py --cluster 4 --chaos-kill --clients 8 \
+    python tools/serve_bench.py --cluster 4 --chaos-kill --slo --clients 8 \
     --requests 120 --workers 2 --queue-size 16 --seed "${KILL_SEED:-3}"
 
 # crash-safe columnar shuffle tier (round 13): every request a q97
@@ -83,6 +87,11 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu SRT_REEXECED=1 \
     python tools/serve_bench.py --ragged-storm --clients 8 --requests 160 \
     --workers 2 --queue-size 32 --ragged-rounds 2 \
     --seed "${RAGGED_SEED:-5}"
+
+# perf-trajectory report (round 14, ADVISORY — bench numbers on shared
+# CI boxes are weather, so regressions print loudly but never gate):
+# diff the two newest BENCH_r*.json snapshots stage by stage
+python tools/bench_report.py || true
 
 python -c "
 from __graft_entry__ import dryrun_multichip

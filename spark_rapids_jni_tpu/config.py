@@ -357,6 +357,60 @@ register("serve_shuffle_spool_dir", "",
          "shared memory instead of the socket (still CRC-verified).  "
          "Empty (default) = socket-only.",
          env="SRT_SERVE_SHUFFLE_SPOOL_DIR")
+register("flight_dump_rate_s", 1.0,
+         "Anomaly-dump rate limit of the flight recorder (obs/flight.py): "
+         "at most one dump artifact per reason per this many seconds "
+         "(counted as dumps_suppressed past it).  Chaos tiers tighten it "
+         "to capture every incident; fleets widen it to bound artifact "
+         "churn.  Every dump carries a paired (wall_time_s, t_ns) stamp "
+         "so cluster merges align per-process monotonic clocks exactly.",
+         env="SRT_FLIGHT_DUMP_RATE_S")
+register("serve_telemetry", True,
+         "Continuous cluster telemetry (serve/telemetry.py): executor "
+         "workers piggyback rolling flight-ring deltas + metric "
+         "snapshots onto the heartbeat cadence (MSG_TELEMETRY), the "
+         "supervisor maintains a bounded live cluster timeline served "
+         "over a local endpoint (tools/servetop.py, flightdump --live), "
+         "and serving requests root distributed spans (obs/trace.py).  "
+         "Off = rounds 1-13 behavior: dumps-only observability, no span "
+         "events in the ring (full governance-history capacity), no "
+         "exports, no endpoint.",
+         env="SRT_SERVE_TELEMETRY")
+register("serve_telemetry_s", 0.05,
+         "Minimum period between one worker's telemetry exports.  The "
+         "export rides the heartbeat thread, so the effective cadence is "
+         "max(this, serve_heartbeat_s); an undeliverable export is "
+         "SKIPPED (EV_TELEMETRY_DROP), never blocked on.",
+         env="SRT_SERVE_TELEMETRY_S")
+register("serve_telemetry_max_events", 4096,
+         "Most flight-ring events one telemetry export ships; a larger "
+         "backlog is trimmed to the newest (counted + EV_TELEMETRY_DROP) "
+         "so a post-storm export can never stall the pipe behind one "
+         "giant message.  Default matches flight_ring_size: an export "
+         "can always ship a full ring, so events are only ever lost to "
+         "ring rollover itself (a process emitting a full ring between "
+         "two beats), never to the trim.",
+         env="SRT_SERVE_TELEMETRY_MAX_EVENTS")
+register("serve_timeline_events", 65536,
+         "Bounded event capacity of the supervisor's live cluster "
+         "timeline (serve/telemetry.py ClusterTimeline): the newest N "
+         "merged cross-process events are queryable over the local "
+         "telemetry endpoint.", env="SRT_SERVE_TIMELINE_EVENTS")
+register("serve_telemetry_port", 0,
+         "TCP port of the supervisor's local telemetry endpoint "
+         "(127.0.0.1; one JSON snapshot per connection).  0 (default) "
+         "binds an ephemeral port — read it from "
+         "Supervisor.telemetry_endpoint() or the BENCH_serve record.",
+         env="SRT_SERVE_TELEMETRY_PORT")
+register("serve_slo_config", "",
+         "Declared service-level objectives as a JSON list (serve/slo.py "
+         "schema: [{\"name\", \"handler\"|\"tenant\", \"p99_ms\", "
+         "\"error_frac\", \"shed_frac\"}]).  Evaluated over multi-window "
+         "burn rates by the supervisor's monitor tick; a burning "
+         "objective emits EV_SLO_BURN, pressures the degradation ladder "
+         "and (via MSG_PRESSURE slo_frac) every worker's admission "
+         "controller, and emits EV_SLO_OK on recovery.  Empty = no SLOs.",
+         env="SRT_SERVE_SLO_CONFIG")
 register("serve_controller_freeze", False,
          "Kill switch for adaptive admission: when set, the controller "
          "immediately resets every knob to its static config value and "

@@ -16,10 +16,13 @@ structured state-transition events fed from every governance layer —
 - ``serve/executor.py`` queue rejections/timeouts, split-requeues,
   OOM-killed requests, queue-saturation detection.
 
-Events are tuples appended to a ``collections.deque(maxlen=N)`` — in
-CPython a bounded deque append is a single atomic operation under the GIL,
-so the hot recording path takes **no lock** (the only lock guards the
-small per-task stats table, touched for four event kinds only).  When the
+Events are tuples appended to a ``collections.deque(maxlen=N)``.  The
+hot recording path takes one uncontended leaf lock around the
+(sequence-allocate, append) pair — ring order and the round-14 telemetry
+cursor's seq order must agree, or a preempted recorder would land a
+lower seq after a higher one and every downstream cursor/dedup consumer
+would silently drop that event — plus the stats-table lock for four
+event kinds only.  When the
 SRTP profiler is active each event is additionally streamed into the
 capture as a STATE record (format v2, obs/profiler.py), which
 ``obs/convert.py`` renders as per-task governance tracks aligned with the
@@ -36,11 +39,12 @@ the reconstructed per-task timeline from such a dump.
 from __future__ import annotations
 
 import collections
+import itertools
 import json
 import os
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from spark_rapids_jni_tpu.obs import seam as _seam
 
@@ -56,8 +60,11 @@ __all__ = [
     "EV_RAGGED_PACK", "EV_RAGGED_LAUNCH", "EV_RAGGED_SPLIT",
     "EV_SHUFFLE_PRODUCE", "EV_SHUFFLE_FETCH", "EV_SHUFFLE_RETRY",
     "EV_SHUFFLE_ACK",
+    "EV_SPAN_OPEN", "EV_SPAN_CLOSE", "EV_SLO_BURN", "EV_SLO_OK",
+    "EV_TELEMETRY_EXPORT", "EV_TELEMETRY_DROP",
     "EVENT_KINDS", "EVENT_PAIRS", "KIND_IDS", "DUMP_SCHEMA",
-    "FlightRecorder", "record", "anomaly", "snapshot", "task_stats",
+    "FlightRecorder", "record", "anomaly", "snapshot", "snapshot_since",
+    "task_stats",
     "register_telemetry_source", "unregister_telemetry_source",
     "unified_snapshot", "recorder",
 ]
@@ -143,6 +150,27 @@ EV_SHUFFLE_ACK = "shuffle_ack"          # consumer acked a fetched
 #                                        partition into the supervisor's
 #                                        partition map (detail=rid:<r>:
 #                                        sid:<s>:from:<k>:part:<p>)
+# the live telemetry plane (round 14, obs/trace.py + serve/telemetry.py
+# + serve/slo.py): distributed request spans, continuous export, and the
+# SLO burn-rate engine all narrate into the ring like every other layer
+EV_SPAN_OPEN = "span_open"              # request phase span opened
+#                                        (detail=rid:<r>:span:<s>:parent:
+#                                        <p>:kind:<queue|dispatch|
+#                                        transport|compute|scatter>...;
+#                                        emitted ONLY by obs/trace.py)
+EV_SPAN_CLOSE = "span_close"            # span closed (same detail
+#                                        tokens, value=duration ns)
+EV_SLO_BURN = "slo_burn"                # an objective entered burn
+#                                        (detail=slo:<name>:obj:<kind>:
+#                                        burn:<x>, value=burn x1000)
+EV_SLO_OK = "slo_ok"                    # the objective recovered
+EV_TELEMETRY_EXPORT = "telemetry_export"  # a worker's export stream came
+#                                        up (first delta shipped;
+#                                        value=events in the delta)
+EV_TELEMETRY_DROP = "telemetry_drop"    # an export was skipped (stalled
+#                                        supervisor pipe) or trimmed
+#                                        (delta over the cap) — the
+#                                        worker NEVER blocks on export
 
 # Paired kinds: a layer that emits the left side of a pair must also emit
 # the right side (module-granular balance, enforced by the analyze gate's
@@ -155,6 +183,11 @@ EVENT_PAIRS = (
     (EV_DEGRADE_ENTER, EV_DEGRADE_EXIT),
     (EV_LEASE_GRANT, EV_LEASE_DONE),
     (EV_SHUFFLE_PRODUCE, EV_SHUFFLE_ACK),
+    # round 14: a module opening spans must close them, and an SLO layer
+    # that can declare burn must be able to declare recovery — both sides
+    # live in one module (obs/trace.py, serve/slo.py) by construction
+    (EV_SPAN_OPEN, EV_SPAN_CLOSE),
+    (EV_SLO_BURN, EV_SLO_OK),
 )
 
 EVENT_KINDS = (
@@ -172,6 +205,9 @@ EVENT_KINDS = (
     EV_RAGGED_PACK, EV_RAGGED_LAUNCH, EV_RAGGED_SPLIT,
     # round 13: appended for the same reason
     EV_SHUFFLE_PRODUCE, EV_SHUFFLE_FETCH, EV_SHUFFLE_RETRY, EV_SHUFFLE_ACK,
+    # round 14: appended for the same reason
+    EV_SPAN_OPEN, EV_SPAN_CLOSE, EV_SLO_BURN, EV_SLO_OK,
+    EV_TELEMETRY_EXPORT, EV_TELEMETRY_DROP,
 )
 KIND_IDS = {k: i for i, k in enumerate(EVENT_KINDS)}
 
@@ -180,9 +216,16 @@ DUMP_SCHEMA = "srt-flight-dump-v1"
 # per-task accumulators kept for at most this many distinct tasks (oldest
 # evicted); sized above any realistic live-task count, below leak territory
 _MAX_TASKS = 1024
-# one dump per (reason) per this many seconds: a retry storm must produce
-# one artifact, not thousands
-_DUMP_MIN_INTERVAL_S = 1.0
+
+
+def _dump_min_interval_s() -> float:
+    """One dump per (reason) per this many seconds — a retry storm must
+    produce one artifact, not thousands.  Config-tunable (round 14,
+    ``flight_dump_rate_s``): chaos tiers tighten it to see every
+    incident; fleets widen it to bound artifact churn."""
+    from spark_rapids_jni_tpu import config
+
+    return float(config.get("flight_dump_rate_s"))
 
 
 class FlightRecorder:
@@ -194,6 +237,17 @@ class FlightRecorder:
 
             ring_size = int(config.get("flight_ring_size"))
         self._ring: "collections.deque" = collections.deque(maxlen=ring_size)
+        # monotonically increasing per-event sequence: the telemetry
+        # exporter's cursor (serve/telemetry.py snapshot_since).  Seq
+        # allocation and the append must be ONE atomic step — a thread
+        # preempted between them would land a lower seq AFTER a higher
+        # one, and every cursor/high-water consumer downstream would
+        # silently drop that event forever — so the ring append takes a
+        # dedicated leaf lock (an uncontended CPython lock is tens of
+        # ns; the stats table below keeps its own lock, touched for four
+        # kinds only)
+        self._ev_seq = itertools.count(1)
+        self._ring_lock = threading.Lock()
         self._stats_lock = threading.Lock()
         self._tasks: "collections.OrderedDict" = collections.OrderedDict()
         self._sources: Dict[str, Callable[[], dict]] = {}
@@ -210,8 +264,11 @@ class FlightRecorder:
                value: int = 0) -> None:
         t_ns = time.monotonic_ns()
         tid = threading.get_ident() & 0xFFFFFFFF
-        # atomic bounded append: no lock on the hot path
-        self._ring.append((t_ns, kind, task_id, tid, detail, value))
+        # seq allocation + append under one leaf lock: ring order and
+        # seq order must agree (see _ring_lock above)
+        with self._ring_lock:
+            self._ring.append((next(self._ev_seq), t_ns, kind, task_id,
+                               tid, detail, value))
         if task_id >= 0 and kind in _STAT_KINDS:
             with self._stats_lock:
                 st = self._tasks.get(task_id)
@@ -241,10 +298,34 @@ class FlightRecorder:
     def snapshot(self) -> List[dict]:
         """The ring as event dicts, oldest first (a point-in-time copy)."""
         return [
-            {"t_ns": t, "kind": k, "task_id": task, "tid": tid,
+            {"seq": seq, "t_ns": t, "kind": k, "task_id": task, "tid": tid,
              "detail": d, "value": v}
-            for t, k, task, tid, d, v in list(self._ring)
+            for seq, t, k, task, tid, d, v in list(self._ring)
         ]
+
+    def snapshot_since(self, cursor: int) -> Tuple[List[dict], int]:
+        """Events with ``seq > cursor`` plus the new cursor — the rolling
+        delta the telemetry plane exports (serve/telemetry.py).  A caller
+        that falls further behind than the ring's capacity simply misses
+        the overwritten prefix: the ring is the retention bound, and the
+        gap is visible as non-contiguous ``seq`` values downstream.
+
+        O(delta), not O(ring): the scan walks backward under the ring
+        lock and stops at the cursor — per-request force-flushes must
+        not pay a full-ring copy for a handful of new events."""
+        newest: List[tuple] = []
+        with self._ring_lock:
+            for item in reversed(self._ring):
+                if item[0] <= cursor:
+                    break
+                newest.append(item)
+        newest.reverse()
+        events = [
+            {"seq": seq, "t_ns": t, "kind": k, "task_id": task, "tid": tid,
+             "detail": d, "value": v}
+            for seq, t, k, task, tid, d, v in newest
+        ]
+        return events, (events[-1]["seq"] if events else cursor)
 
     def task_stats(self) -> Dict[int, dict]:
         """Per-task accumulators (non-destructive, unlike the arbiter's
@@ -290,9 +371,10 @@ class FlightRecorder:
         self.record(EV_ANOMALY, -1, f"{reason}:{detail}" if detail
                     else reason)
         now = time.monotonic()
+        min_interval = _dump_min_interval_s()
         with self._dump_lock:
             last = self._last_dump_t.get(reason, -1e9)
-            if now - last < _DUMP_MIN_INTERVAL_S:
+            if now - last < min_interval:
                 self.dumps_suppressed += 1
                 return None
             self._last_dump_t[reason] = now
@@ -372,6 +454,10 @@ def anomaly(reason: str, detail: str = "") -> Optional[dict]:
 
 def snapshot() -> List[dict]:
     return _RECORDER.snapshot()
+
+
+def snapshot_since(cursor: int) -> Tuple[List[dict], int]:
+    return _RECORDER.snapshot_since(cursor)
 
 
 def task_stats() -> Dict[int, dict]:

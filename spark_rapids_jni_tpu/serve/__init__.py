@@ -48,6 +48,13 @@ from spark_rapids_jni_tpu.serve.session import (
     SessionBudgetExceeded,
     SessionRegistry,
 )
+from spark_rapids_jni_tpu.serve.slo import SLO, BurnRateEngine
+from spark_rapids_jni_tpu.serve.telemetry import (
+    ClusterTimeline,
+    TelemetryExporter,
+    TelemetryServer,
+    fetch_view,
+)
 from spark_rapids_jni_tpu.serve.supervisor import (
     DEGRADE_LEVELS,
     Degraded,
@@ -65,6 +72,12 @@ __all__ = [
     "AdmissionController",
     "AdmissionQueue",
     "Backpressure",
+    "BurnRateEngine",
+    "ClusterTimeline",
+    "SLO",
+    "TelemetryExporter",
+    "TelemetryServer",
+    "fetch_view",
     "DEGRADE_LEVELS",
     "Degraded",
     "HandlerSpec",

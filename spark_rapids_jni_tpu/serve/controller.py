@@ -319,7 +319,11 @@ class AdmissionController:
         ``ServingEngine.note_cluster_pressure``)."""
         p = max(float(gauges.get("blocked_frac", 0.0)),
                 float(gauges.get("mem_frac", 0.0)),
-                float(gauges.get("queue_frac", 0.0)))
+                float(gauges.get("queue_frac", 0.0)),
+                # SLO burn rides the same broadcast (round 14): a worker
+                # in a promise-burning cluster tightens its knobs even
+                # when its local resource gauges look calm
+                float(gauges.get("slo_frac", 0.0)))
         with self._lock:
             self._cluster = (min(1.0, p), time.monotonic())
 

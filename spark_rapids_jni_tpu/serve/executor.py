@@ -42,6 +42,7 @@ from spark_rapids_jni_tpu.mem.governed import (
 )
 from spark_rapids_jni_tpu.mem.governor import MemoryGovernor, OutOfBudget
 from spark_rapids_jni_tpu.obs import flight as _flight
+from spark_rapids_jni_tpu.obs import trace as _trace
 from spark_rapids_jni_tpu.obs.seam import SERVE, seam
 from spark_rapids_jni_tpu.serve.metrics import ServeMetrics
 from spark_rapids_jni_tpu.serve.queue import (
@@ -229,6 +230,12 @@ class ServingEngine:
         # one launch per tick.  Off (default) keeps the micro-batcher
         # bit-identical to round 11 — the parity oracle.
         self.serve_ragged = serve_ragged
+        # span rooting rides the telemetry-plane flag (cached: submit is
+        # the hot path): with the plane off, NO span events enter the
+        # ring and anomaly dumps keep their full round-13 governance
+        # history capacity.  A trace that already crossed the pipe is
+        # always continued — the supervisor decided for the cluster.
+        self._spans_on = bool(config.get("serve_telemetry"))
         self._ragged = None
         if serve_ragged:
             from spark_rapids_jni_tpu.serve.ragged import RaggedDispatcher
@@ -296,6 +303,12 @@ class ServingEngine:
         self._hang_factor = float(config.get("serve_hang_factor"))
         self._hang_min_s = float(config.get("serve_hang_min_s"))
         self._hang_stop = threading.Event()
+        # post-serve hook (round 14, serve/rpc.py): runs on the WORKER
+        # thread after a popped request's group fully served — by then
+        # every span-close finally block has run, so a telemetry
+        # force-flush here deterministically ships a completed request's
+        # whole story before a chaos SIGKILL can eat it
+        self.on_served: Optional[Callable[[], None]] = None
         self.metrics.set_gauge_source(self._gauges)
         self._telemetry_name = f"serve:{id(self):x}"
         # weakly referenced, like the governor/spill gauge registries: an
@@ -377,12 +390,18 @@ class ServingEngine:
     # -- the producer surface ----------------------------------------------
     def submit(self, session: Session, handler: str, payload: Any, *,
                priority: Optional[int] = None,
-               deadline_s: Optional[float] = None) -> Response:
+               deadline_s: Optional[float] = None,
+               trace: Any = None) -> Response:
         """Admit one request; returns its :class:`Response`.
 
         Raises :class:`Backpressure` (queue full — retry after the hint) or
         :class:`SessionBudgetExceeded` (the session is over its byte
         budget) — both clean rejections; the request never queues.
+
+        ``trace`` continues an upstream span context (the supervisor's
+        dispatch span, carried over MSG_DISPATCH): the worker's queue and
+        compute spans then chain under the SAME rid across processes.
+        Without it the request roots a fresh trace on its own task id.
         """
         # analyze: ignore[guarded-by] - hot-path read of a registration
         # dict that only grows at startup; a GIL-atomic get needs no lock
@@ -397,6 +416,13 @@ class ServingEngine:
             self.metrics.count("rejected_session", session.session_id)
             raise
         dl = deadline_s if deadline_s is not None else self.default_deadline_s
+        tid = self.sessions.next_task_id()
+        # span lineage: continue the supervisor's dispatch span when one
+        # crossed the pipe (same rid), else root a fresh trace here
+        # (unless the telemetry plane is off — untraced requests record
+        # no span events at all)
+        ctx = (_trace.child_of(trace) if trace is not None
+               else _trace.new_root(tid) if self._spans_on else None)
         req = Request(
             handler=handler, payload=payload,
             session_id=session.session_id,
@@ -407,14 +433,22 @@ class ServingEngine:
                       else session.priority + session.age_boost),
             deadline=(time.monotonic() + dl) if dl is not None else None,
             seq=next(self._seq),
-            task_id=self.sessions.next_task_id(),
+            task_id=tid,
+            trace=ctx,
         )
         req.charge_bytes = nbytes
         req.session = session
+        # the queue span opens BEFORE the request becomes poppable: a
+        # worker may pop and close it the instant submit returns, so
+        # opening afterwards would race (and leak an unclosed span)
+        req.qspan = _trace.open_span(ctx, _trace.SPAN_QUEUE, task_id=tid,
+                                     extra=f"handler:{handler}")
         try:
             self.queue.submit(req)
         except Backpressure:
             session.credit(nbytes)
+            _trace.close_span(req.qspan)
+            req.qspan = None
             self.metrics.count("rejected_full", session.session_id)
             _flight.record(_flight.EV_QUEUE_REJECT, req.task_id,
                            detail=f"handler:{handler}")
@@ -430,6 +464,8 @@ class ServingEngine:
             raise
         except BaseException:  # closed queue (shutdown): no charge leaks
             session.credit(nbytes)
+            _trace.close_span(req.qspan)
+            req.qspan = None
             raise
         with self._sat_lock:
             self._sat_rejects = 0
@@ -490,6 +526,8 @@ class ServingEngine:
         dropped = self.queue.close()
         for req in dropped:
             self._credit(req)
+            _trace.close_span(req.qspan)
+            req.qspan = None
             self.metrics.count("cancelled", req.session_id)
             if req.join is not None:  # cancelled halves still join (above)
                 req.join.deliver(req.join_slot, CANCELLED, None,
@@ -562,6 +600,8 @@ class ServingEngine:
     def _on_queue_timeout(self, req: Request) -> None:
         """Queue-side expiry (response already completed by the queue)."""
         self._credit(req)
+        _trace.close_span(req.qspan)
+        req.qspan = None
         self.metrics.count("timed_out", req.session_id)
         _flight.record(_flight.EV_QUEUE_TIMEOUT, req.task_id,
                        detail=f"handler:{req.handler}")
@@ -578,6 +618,10 @@ class ServingEngine:
         if not first:
             return
         self._credit(req)
+        # terminal state: no phase span may outlive the request (close is
+        # idempotent, so paths that already closed these cost nothing)
+        _trace.close_span(req.qspan)
+        req.qspan = None
         counter = {OK: "completed", TIMED_OUT: "timed_out",
                    CANCELLED: "cancelled"}.get(status, "failed")
         self.metrics.count(counter, req.session_id)
@@ -629,6 +673,16 @@ class ServingEngine:
                     self._ewma_by_handler[req.handler] = (0.8 * prev
                                                           + 0.2 * dt)
                 self.metrics.publish()
+                cb = self.on_served
+                if cb is not None:
+                    try:
+                        cb()
+                    # analyze: ignore[retry-protocol] - the post-serve
+                    # telemetry hook crosses no seam and owns no retry
+                    # context; any failure (pipe mid-death) must never
+                    # kill the pool worker
+                    except Exception:  # noqa: BLE001
+                        pass
 
     def _hang_watchdog_loop(self) -> None:
         """Sweep in-flight requests for handlers running far past their
@@ -723,6 +777,10 @@ class ServingEngine:
             self.queue.task_done(len(group))
 
     def _serve_group(self, req: Request) -> List[Request]:
+        # the queue-wait phase of the waterfall ends at the pop that led
+        # here (batch mates close theirs in the admission-stamp loop)
+        _trace.close_span(req.qspan)
+        req.qspan = None
         # analyze: ignore[guarded-by] - same lock-free registration-dict
         # read as submit(): GIL-atomic on a startup-only-growing dict
         h = self._handlers[req.handler]
@@ -744,12 +802,38 @@ class ServingEngine:
         now_ns = time.monotonic_ns()
         group = self._gather_batch(req, h)
         for r in group:
+            _trace.close_span(r.qspan)  # mates' queue wait ends here too
+            r.qspan = None
             if r.response.admitted_ns == 0:  # re-served requests (split
                 # halves got fresh responses; disbanded mates did not)
                 # keep their first admission stamp and count once
                 r.response.admitted_ns = now_ns
                 self.metrics.count("admitted", r.session_id)
                 self.metrics.record_wait(now_ns - r.response.submitted_ns)
+        # one compute span per member (mates ride the primary's launch but
+        # each request's waterfall must still show its compute phase); the
+        # primary's compute context becomes the thread's CURRENT context,
+        # so nested layers (shuffle fetches) attach transport spans under
+        # it without plumbing.  Closed on EVERY exit below — a member
+        # re-queued by the retry protocol closes this attempt's span and
+        # opens a fresh queue span in _requeue.
+        cspans = [_trace.open_span(
+            r.trace, _trace.SPAN_COMPUTE, task_id=r.task_id,
+            extra=(f"handler:{h.name}" if len(group) == 1
+                   else f"handler:{h.name}:batch:{len(group)}"))
+            for r in group]
+        if cspans[0] is not None:
+            _trace.push_current(cspans[0].ctx)
+        try:
+            return self._serve_attempt(req, h, group)
+        finally:
+            if cspans[0] is not None:
+                _trace.pop_current()
+            for cs in cspans:
+                _trace.close_span(cs)
+
+    def _serve_attempt(self, req: Request, h: QueryHandler,
+                       group: List[Request]) -> List[Request]:
         if len(group) > 1:
             self.metrics.count("batched", n=len(group))
             try:
@@ -845,38 +929,49 @@ class ServingEngine:
 
         run_ns = time.monotonic_ns() - run_t0
         if len(group) > 1:
-            try:
-                parts = h.unbatch(result, [r.payload for r in group])
-            except (RetryOOM, SplitAndRetryOOM, ShuffleCapacityExceeded):
-                # pressure inside the unbatch hook: disband and re-run each
-                # member alone (handlers are pure queries, so re-running is
-                # safe; failing them would turn recoverable pressure into
-                # lost work)
-                self.metrics.count("split_requeued", n=len(group))
-                for r in group:
-                    self._requeue(r, no_batch=True)
-                return group
-            except Exception as e:  # noqa: BLE001
-                for r in group:
-                    self._finish(r, ERROR, error=e)
-                return group
-            parts = list(parts)
-            if len(parts) != len(group):
-                # a short result would leave trailing members PENDING
-                # forever (zip truncates; popped requests have no queue-side
-                # expiry) — every member must reach a terminal state
-                e = RuntimeError(
-                    f"unbatch returned {len(parts)} results for "
-                    f"{len(group)} requests (handler={h.name})")
-                for r in group:
-                    self._finish(r, ERROR, error=e)
-                return group
-            for r, value in zip(group, parts):
-                self.metrics.record_run(run_ns, handler=h.name)
-                self._finish(r, OK, value=value)
+            with _trace.span(req.trace, _trace.SPAN_SCATTER,
+                             task_id=req.task_id,
+                             extra=f"handler:{h.name}:n:{len(group)}"):
+                return self._unbatch_finish(req, h, group, result, run_ns)
         else:
             self.metrics.record_run(run_ns, handler=h.name)
             self._finish(req, OK, value=result)
+        return group
+
+    def _unbatch_finish(self, req: Request, h: QueryHandler,
+                        group: List[Request], result: Any,
+                        run_ns: int) -> List[Request]:
+        """Redistribute a batch result to its members (the scatter phase
+        of the waterfall)."""
+        try:
+            parts = h.unbatch(result, [r.payload for r in group])
+        except (RetryOOM, SplitAndRetryOOM, ShuffleCapacityExceeded):
+            # pressure inside the unbatch hook: disband and re-run each
+            # member alone (handlers are pure queries, so re-running is
+            # safe; failing them would turn recoverable pressure into
+            # lost work)
+            self.metrics.count("split_requeued", n=len(group))
+            for r in group:
+                self._requeue(r, no_batch=True)
+            return group
+        except Exception as e:  # noqa: BLE001
+            for r in group:
+                self._finish(r, ERROR, error=e)
+            return group
+        parts = list(parts)
+        if len(parts) != len(group):
+            # a short result would leave trailing members PENDING
+            # forever (zip truncates; popped requests have no queue-side
+            # expiry) — every member must reach a terminal state
+            e = RuntimeError(
+                f"unbatch returned {len(parts)} results for "
+                f"{len(group)} requests (handler={h.name})")
+            for r in group:
+                self._finish(r, ERROR, error=e)
+            return group
+        for r, value in zip(group, parts):
+            self.metrics.record_run(run_ns, handler=h.name)
+            self._finish(r, OK, value=value)
         return group
 
     def _governed_attempt(self, h: QueryHandler, state: dict, run, on_retry):
@@ -929,6 +1024,10 @@ class ServingEngine:
                 task_id=self.sessions.next_task_id(),
                 split_depth=depth,
                 no_batch=True, join=join, join_slot=slot,
+                # children span under the parent's trace: the rid lineage
+                # survives the split, so one waterfall shows every piece
+                trace=(_trace.child_of(req.trace)
+                       if req.trace is not None else None),
             )
             for slot, part in enumerate(parts)
         ]
@@ -946,6 +1045,14 @@ class ServingEngine:
 
     def _requeue(self, req: Request, *, no_batch: bool = False) -> None:
         req.no_batch = req.no_batch or no_batch
+        # a re-queued request starts a NEW queue-wait phase (its previous
+        # queue/compute spans already closed): redispatch churn shows up
+        # as repeated queue bars in the waterfall, not a gap
+        if req.trace is not None and req.qspan is None:
+            req.qspan = _trace.open_span(req.trace, _trace.SPAN_QUEUE,
+                                         task_id=req.task_id,
+                                         extra=f"handler:{req.handler}"
+                                               f":requeue")
         try:
             self.queue.submit(req, force=True)
         # analyze: ignore[retry-protocol] - queue.submit crosses no seam
@@ -1001,6 +1108,8 @@ class ServingEngine:
                 task_id=self.sessions.next_task_id(),
                 split_depth=req.split_depth + 1,
                 no_batch=True, join=join, join_slot=slot,
+                trace=(_trace.child_of(req.trace)
+                       if req.trace is not None else None),
             )
             # the serve-level half: a fresh task carrying its parent's
             # lineage into the flight ring (the arbiter already recorded

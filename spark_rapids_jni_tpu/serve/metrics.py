@@ -245,6 +245,13 @@ class ServeMetrics:
             return {h: list(hist.counts)
                     for h, hist in self._run_by_handler.items()}
 
+    def run_latency_counts(self) -> list:
+        """The global run-latency bucket counts (a copy, sampled under
+        the lock) — the service-wide window the SLO burn-rate engine
+        (serve/slo.py) diffs for ``handler="*"`` latency objectives."""
+        with self._lock:
+            return list(self.run_latency.counts)
+
     def set_depth(self, depth: int) -> None:
         with self._lock:
             self._depth = depth
@@ -276,6 +283,11 @@ class ServeMetrics:
                 "queue_depth": self._depth,
                 "queue_wait": self.queue_wait.snapshot(),
                 "run_latency": self.run_latency.snapshot(),
+                # per-handler latency summaries ride every snapshot so
+                # the telemetry plane's per-handler dashboard columns
+                # (tools/servetop.py) need no second export path
+                "handlers": {h: hist.snapshot()
+                             for h, hist in self._run_by_handler.items()},
                 "sessions": {
                     sid: dict(c) for sid, c in self._per_session.items()
                 },

@@ -86,6 +86,10 @@ class Response:
         # this engine-local id with the supervisor's lease id in the
         # flight ring, keying the --cluster timeline merge
         self.task_id = 0
+        # the request's trace context (obs/trace.py, stamped by Request):
+        # clients holding only the Response can still find their span
+        # chain in the live timeline
+        self.trace = None
 
     def _complete(self, status: str, value: Any = None,
                   error: Optional[BaseException] = None) -> bool:
@@ -146,9 +150,18 @@ class Request:
     # supervisor's partition map pointed at the current incarnation
     shuffle_sid: Optional[int] = None
     shuffle_map_index: int = -1
+    # distributed request spans (obs/trace.py, round 14): the request's
+    # trace context (split/fan-out children carry a child context with
+    # the SAME rid lineage), plus the open phase-span handles the
+    # executor/supervisor bracket around queue wait and dispatch — the
+    # live queue -> dispatch -> compute waterfall keys off these
+    trace: Any = None            # Optional[obs.trace.TraceContext]
+    qspan: Any = None            # open queue-wait SpanHandle (or None)
+    dspan: Any = None            # open dispatch SpanHandle (supervisor)
 
     def __post_init__(self):
         self.response.task_id = self.task_id
+        self.response.trace = self.trace
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (self.deadline is not None

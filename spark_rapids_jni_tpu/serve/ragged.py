@@ -48,6 +48,7 @@ from spark_rapids_jni_tpu.mem.governed import (
 )
 from spark_rapids_jni_tpu.mem.governor import OutOfBudget
 from spark_rapids_jni_tpu.obs import flight as _flight
+from spark_rapids_jni_tpu.obs import trace as _trace
 from spark_rapids_jni_tpu.obs.seam import COLLECTIVE, SERVE, TRANSFER, seam
 from spark_rapids_jni_tpu.plans.cache import plan_cache
 from spark_rapids_jni_tpu.plans.compiler import (
@@ -245,6 +246,8 @@ class RaggedDispatcher:
         group = self.gather(req, h)
         now_ns = time.monotonic_ns()
         for r in group:
+            _trace.close_span(r.qspan)  # queue-wait ends at this tick
+            r.qspan = None
             if r.response.admitted_ns == 0:
                 r.response.admitted_ns = now_ns
                 self.engine.metrics.count("admitted", r.session_id)
@@ -257,7 +260,23 @@ class RaggedDispatcher:
         # and the split protocol could never converge under pressure
         min_pages = (self.pool_pages
                      if (req.split_depth == 0 and not req.no_batch) else 1)
-        self._run_group(group, h, depth=0, min_pages=min_pages)
+        # one compute span per rider, all covering this fused tick and
+        # tagged with the pack's primary — pack membership reconstructs
+        # from the shared token (riders of one launch share pack:<rid>)
+        cspans = [_trace.open_span(
+            r.trace, _trace.SPAN_COMPUTE, task_id=r.task_id,
+            extra=f"handler:{h.name}:pack:{req.task_id}"
+                  f":riders:{len(group)}")
+            for r in group]
+        if cspans[0] is not None:
+            _trace.push_current(cspans[0].ctx)
+        try:
+            self._run_group(group, h, depth=0, min_pages=min_pages)
+        finally:
+            if cspans[0] is not None:
+                _trace.pop_current()
+            for cs in cspans:
+                _trace.close_span(cs)
         return group
 
     def _run_group(self, group: List[Request], h, *, depth: int,
@@ -376,20 +395,24 @@ class RaggedDispatcher:
                 eng._finish(r, ERROR, error=e)
             return
         run_ns = time.monotonic_ns() - run_t0
-        for r, rows_out in zip(group, results):
-            try:
-                value = (spec.result_of(rows_out, r.payload)
-                         if spec.result_of is not None else rows_out)
-            except (RetryOOM, SplitAndRetryOOM, ShuffleCapacityExceeded) as e:
-                # result_of runs outside any bracket; a control signal
-                # here cannot be retried — terminal, never swallowed
-                eng._finish(r, ERROR, error=e)
-                continue
-            except Exception as e:  # noqa: BLE001 - per-rider failure
-                eng._finish(r, ERROR, error=e)
-                continue
-            eng.metrics.record_run(run_ns, handler=h.name)
-            eng._finish(r, OK, value=value)
+        with _trace.span(group[0].trace, _trace.SPAN_SCATTER,
+                         task_id=group[0].task_id,
+                         extra=f"handler:{h.name}:riders:{len(group)}"):
+            for r, rows_out in zip(group, results):
+                try:
+                    value = (spec.result_of(rows_out, r.payload)
+                             if spec.result_of is not None else rows_out)
+                except (RetryOOM, SplitAndRetryOOM,
+                        ShuffleCapacityExceeded) as e:
+                    # result_of runs outside any bracket; a control signal
+                    # here cannot be retried — terminal, never swallowed
+                    eng._finish(r, ERROR, error=e)
+                    continue
+                except Exception as e:  # noqa: BLE001 - per-rider failure
+                    eng._finish(r, ERROR, error=e)
+                    continue
+                eng.metrics.record_run(run_ns, handler=h.name)
+                eng._finish(r, OK, value=value)
 
     def _split_group(self, group: List[Request], h, err: BaseException, *,
                      depth: int, min_pages: int, pages_now: int) -> None:

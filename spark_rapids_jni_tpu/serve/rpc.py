@@ -41,7 +41,7 @@ from typing import Callable, Optional
 __all__ = [
     "MSG_HELLO", "MSG_BEAT", "MSG_DISPATCH", "MSG_RESULT", "MSG_SHUTDOWN",
     "MSG_SHUFFLE_PRODUCED", "MSG_SHUFFLE_ACK", "MSG_SHUFFLE_MAP",
-    "MSG_SHUFFLE_CLEANUP", "MSG_PRESSURE",
+    "MSG_SHUFFLE_CLEANUP", "MSG_PRESSURE", "MSG_TELEMETRY",
     "MESSAGE_FIELDS",
     "SafeConn", "resolve_factory", "executor_worker_main",
     "set_shuffle_sink", "shuffle_uplink",
@@ -63,6 +63,13 @@ MSG_SHUFFLE_ACK = "shuffle_ack"
 MSG_SHUFFLE_MAP = "shuffle_map"
 MSG_SHUFFLE_CLEANUP = "shuffle_cleanup"
 MSG_PRESSURE = "pressure"
+# the live telemetry plane (round 14, serve/telemetry.py): each worker
+# piggybacks rolling flight-ring deltas + a metrics snapshot onto the
+# heartbeat cadence; the supervisor merges them into the bounded cluster
+# timeline its local endpoint serves (tools/servetop.py, flightdump
+# --live).  An undeliverable export is SKIPPED, never blocked on — the
+# same discipline as the round-13 heartbeat fix.
+MSG_TELEMETRY = "telemetry"
 
 # The declared wire schema: tag -> field names after the tag.  BOTH sides
 # of the pipe are checked against this table at merge time (ci/analyze
@@ -76,8 +83,11 @@ MSG_PRESSURE = "pressure"
 MESSAGE_FIELDS = {
     MSG_HELLO: ("worker_id", "incarnation", "pid"),
     MSG_BEAT: ("worker_id", "incarnation", "wall_t", "gauges"),
+    # `trace` (round 14) is the supervisor's dispatch-span context
+    # (obs/trace.to_wire tuple or None): the worker's queue/compute spans
+    # chain under the SAME rid, so one live waterfall crosses the pipe
     MSG_DISPATCH: ("rid", "handler", "payload", "deadline_rel_s",
-                   "priority"),
+                   "priority", "trace"),
     MSG_RESULT: ("rid", "status", "value", "err"),
     MSG_SHUTDOWN: ("dump_epilogue",),
     # worker -> supervisor: map task `map_index` of shuffle `sid` framed
@@ -96,6 +106,12 @@ MESSAGE_FIELDS = {
     # supervisor -> workers: cluster-wide pressure aggregate (mean/max of
     # heartbeat gauges) for the local AdmissionController's tick
     MSG_PRESSURE: ("cluster",),
+    # worker -> supervisor: one telemetry export — flight-ring event
+    # dicts since the last export plus a ServeMetrics snapshot, stamped
+    # with a paired (wall_t, t_ns) clock so the timeline aligns this
+    # process's monotonic event times onto the cluster's wall clock
+    MSG_TELEMETRY: ("worker_id", "incarnation", "wall_t", "t_ns",
+                    "events", "metrics"),
 }
 
 # RESULT statuses mirror serve.queue terminal states, plus the one
@@ -269,6 +285,7 @@ def executor_worker_main(worker_id: int, incarnation: int, conn,
         MemoryGovernor,
     )
     from spark_rapids_jni_tpu.obs import flight as _flight
+    from spark_rapids_jni_tpu.obs import trace as _trace
     from spark_rapids_jni_tpu.serve.executor import ServingEngine
     from spark_rapids_jni_tpu.serve.queue import OK
 
@@ -297,6 +314,19 @@ def executor_worker_main(worker_id: int, incarnation: int, conn,
     sconn = SafeConn(conn)
     stop = threading.Event()
     dump_epilogue = [False]
+
+    exporter = None
+    if bool(config.get("serve_telemetry")):
+        from spark_rapids_jni_tpu.serve.telemetry import TelemetryExporter
+
+        exporter = TelemetryExporter(worker_id, incarnation,
+                                     metrics_source=engine.metrics.snapshot)
+        # force-flush on the SERVING thread after each popped group fully
+        # serves: every span-close finally has run by then, so a chaos
+        # SIGKILL landing before the next heartbeat cannot eat the story
+        # of work that already completed (deterministic ordering — no
+        # sleep-and-hope between waiter and serving threads)
+        engine.on_served = lambda: exporter.export(sconn.send, force=True)
 
     def heartbeat() -> None:
         period = float(config.get("serve_heartbeat_s"))
@@ -327,6 +357,11 @@ def executor_worker_main(worker_id: int, incarnation: int, conn,
                 # supervisor would kill it for the supervisor's own
                 # congestion
                 continue
+            if exporter is not None:
+                # continuous telemetry piggybacks the beat cadence; the
+                # exporter applies the same skip-never-block discipline
+                # (a stalled pipe costs this delta, not the thread)
+                exporter.export(sconn.send)
 
     def waiter(rid: int, resp) -> None:
         resp.wait()  # the engine guarantees a terminal state
@@ -370,11 +405,12 @@ def executor_worker_main(worker_id: int, incarnation: int, conn,
                 continue
             if tag != MSG_DISPATCH:
                 continue
-            _, rid, handler, payload, deadline_rel_s, priority = msg
+            _, rid, handler, payload, deadline_rel_s, priority, trace = msg
             try:
                 resp = engine.submit(sess, handler, payload,
                                      priority=priority,
-                                     deadline_s=deadline_rel_s)
+                                     deadline_s=deadline_rel_s,
+                                     trace=_trace.from_wire(trace))
             # analyze: ignore[retry-protocol] - submit crosses no seam
             # (admission only); failures here are flow control
             # (Backpressure -> BUSY re-queue upstream) or setup bugs

@@ -62,6 +62,7 @@ import numpy as np
 from spark_rapids_jni_tpu import config
 from spark_rapids_jni_tpu.columnar import frames
 from spark_rapids_jni_tpu.obs import flight as _flight
+from spark_rapids_jni_tpu.obs import trace
 from spark_rapids_jni_tpu.obs.faultinj import transport_fault
 from spark_rapids_jni_tpu.serve import rpc
 
@@ -645,17 +646,23 @@ def run_shuffle_piece(plan, payload: dict, ctx) -> Dict[str, np.ndarray]:
         # documented per-partition semantics): one slow-recovering
         # producer must not starve the fetches that follow it
         deadline = time.monotonic() + fetch_timeout
-        # credit-based backpressure: reserve the advertised partition
-        # bytes (clamped to the credit window) from the executor's
-        # governed budget across the in-flight fetch+decode — transport
-        # memory competes with compute through the normal protocol (a
-        # RetryOOM here re-runs the whole piece via attempt_once, like
-        # any handler-body pressure signal)
-        nbytes = min(svc.wait_advertised(sid, k, m, deadline=deadline),
-                     credit)
-        with reservation(ctx.budget, nbytes):
-            cols = svc.fetch(sid, k, m, deadline=deadline, rid=rid)
-        svc.ack(sid, k, m, rid=rid)
+        # the transport phase of this request's waterfall: one span per
+        # partition wait+fetch, nested under the executor's compute span
+        # via the thread-current context (obs/trace.py) — slow peers show
+        # up as long transport bars, not opaque compute time
+        with trace.maybe_span(trace.SPAN_TRANSPORT,
+                              extra=f"sid:{sid}:from:{k}:part:{m}"):
+            # credit-based backpressure: reserve the advertised partition
+            # bytes (clamped to the credit window) from the executor's
+            # governed budget across the in-flight fetch+decode —
+            # transport memory competes with compute through the normal
+            # protocol (a RetryOOM here re-runs the whole piece via
+            # attempt_once, like any handler-body pressure signal)
+            nbytes = min(svc.wait_advertised(sid, k, m, deadline=deadline),
+                         credit)
+            with reservation(ctx.budget, nbytes):
+                cols = svc.fetch(sid, k, m, deadline=deadline, rid=rid)
+            svc.ack(sid, k, m, rid=rid)
         received.append(cols)
     concat = {f: np.concatenate([r[f] for r in received])
               for f in exchange.fields}

@@ -42,6 +42,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from spark_rapids_jni_tpu.obs import flight as _flight
+from spark_rapids_jni_tpu.obs import trace as _trace
 from spark_rapids_jni_tpu.serve.executor import _SplitJoin, split_till
 from spark_rapids_jni_tpu.serve.metrics import ServeMetrics
 from spark_rapids_jni_tpu.serve.queue import (
@@ -276,6 +277,9 @@ class Supervisor:
                  shed_priority_min: int = 1,
                  dump_on_exit: bool = False,
                  stress_source: Optional[Callable[[], float]] = None,
+                 slos: Optional[Sequence] = None,
+                 slo_opts: Optional[dict] = None,
+                 telemetry: Optional[bool] = None,
                  start: bool = True):
         from spark_rapids_jni_tpu import config
 
@@ -348,7 +352,48 @@ class Supervisor:
         self._telemetry_name = f"supervisor:{id(self):x}"
         _flight.register_telemetry_source(self._telemetry_name,
                                           self.snapshot)
+        # the live telemetry plane (round 14, serve/telemetry.py): the
+        # bounded cluster timeline every worker's MSG_TELEMETRY deltas
+        # (and this process's own ring) merge into, served over a local
+        # endpoint for flightdump --live / servetop
+        if telemetry is None:
+            telemetry = bool(config.get("serve_telemetry"))
+        # span rooting rides the same flag: plane off = no span events,
+        # the full round-13 ring capacity for governance history
+        self._spans_on = bool(telemetry)
+        self.timeline = None
+        self._tl_server = None
+        self._tl_lock = threading.Lock()
+        self._tl_cursor = 0  # guarded-by: _tl_lock
+        if telemetry:
+            from spark_rapids_jni_tpu.serve.telemetry import ClusterTimeline
+
+            self.timeline = ClusterTimeline()
+        # the SLO burn-rate engine (serve/slo.py): declared objectives
+        # evaluated on the monitor tick; burn feeds the ladder's stress
+        # sample and the MSG_PRESSURE broadcast (slo_frac)
+        if slos is None:
+            from spark_rapids_jni_tpu.serve.slo import parse_slo_config
+
+            slos = parse_slo_config(str(config.get("serve_slo_config")))
+        self.slo = None
+        if slos:
+            from spark_rapids_jni_tpu.serve.slo import (
+                BurnRateEngine,
+                supervisor_metrics_source,
+            )
+
+            self.slo = BurnRateEngine(
+                list(slos), supervisor_metrics_source(self.metrics),
+                **(slo_opts or {}))
         if start:
+            if self.timeline is not None:
+                from spark_rapids_jni_tpu.serve.telemetry import (
+                    TelemetryServer,
+                )
+
+                self._tl_server = TelemetryServer(
+                    self._telemetry_view).start()
             for wid in range(self.nworkers):
                 self._spawn_worker(wid, 0)
             self._dispatcher = threading.Thread(
@@ -392,24 +437,38 @@ class Supervisor:
             self.metrics.count("rejected_session", session.session_id)
             raise
         dl = deadline_s if deadline_s is not None else self.default_deadline_s
+        tid = self.sessions.next_task_id()
         req = Request(
             handler=handler, payload=payload,
             session_id=session.session_id, priority=prio,
             deadline=(time.monotonic() + dl) if dl is not None else None,
-            seq=next(self._seq), task_id=self.sessions.next_task_id(),
+            seq=next(self._seq), task_id=tid,
+            # the request's trace roots HERE: rid = the supervisor lease
+            # id, the same token every cross-process chain keys on
+            trace=_trace.new_root(tid) if self._spans_on else None,
         )
         req.charge_bytes = nbytes
         req.session = session
+        # opened BEFORE the request becomes poppable (engine.submit twin):
+        # the dispatcher may grant — and close this span — the instant
+        # submit returns
+        req.qspan = _trace.open_span(req.trace, _trace.SPAN_QUEUE,
+                                     task_id=tid,
+                                     extra=f"handler:{handler}")
         try:
             self.queue.submit(req)
         except Backpressure:
             session.credit(nbytes)
+            _trace.close_span(req.qspan)
+            req.qspan = None
             self.metrics.count("rejected_full", session.session_id)
             _flight.record(_flight.EV_QUEUE_REJECT, req.task_id,
                            detail=f"handler:{handler}")
             raise
         except BaseException:  # closed queue (shutdown): no charge leaks
             session.credit(nbytes)
+            _trace.close_span(req.qspan)
+            req.qspan = None
             raise
         self.metrics.count("submitted", session.session_id)
         return req.response
@@ -466,6 +525,8 @@ class Supervisor:
 
     def _on_queue_timeout(self, req: Request) -> None:
         self._credit(req)
+        _trace.close_span(req.qspan)
+        req.qspan = None
         self.metrics.count("timed_out", req.session_id)
         _flight.record(_flight.EV_QUEUE_TIMEOUT, req.task_id,
                        detail=f"handler:{req.handler}")
@@ -483,6 +544,10 @@ class Supervisor:
         if not first:
             return
         self._credit(req)
+        # terminal: no phase span may outlive the request (idempotent)
+        _trace.close_span(req.qspan)
+        _trace.close_span(req.dspan)
+        req.qspan = req.dspan = None
         counter = {OK: "completed", TIMED_OUT: "timed_out",
                    CANCELLED: "cancelled"}.get(status, "failed")
         self.metrics.count(counter, req.session_id)
@@ -549,6 +614,15 @@ class Supervisor:
                                           msg[6])
             elif tag == rpc.MSG_SHUFFLE_ACK:
                 self._on_shuffle_ack(handle, msg[3], msg[4], msg[5])
+            elif tag == rpc.MSG_TELEMETRY:
+                # a delta racing ahead of HELLO has no pid to key on yet
+                # (worker spans can't predate the hello, so nothing of a
+                # request's waterfall is lost by dropping it)
+                if self.timeline is not None and handle.pid:
+                    self.timeline.ingest(
+                        handle.pid, msg[3], msg[4], msg[5],
+                        incarnation=msg[2], worker_id=msg[1],
+                        metrics=msg[6])
 
     def _worker_dead(self, handle: _ExecutorHandle, reason: str) -> None:
         """Idempotent per incarnation: declare dead, SIGKILL for
@@ -598,6 +672,16 @@ class Supervisor:
             self._spawn_worker(handle.worker_id, handle.incarnation + 1)
 
     def _requeue(self, req: Request) -> None:
+        # a re-dispatch ends the failed dispatch phase and starts a new
+        # queue-wait phase: redispatch churn is visible as repeated
+        # dispatch bars in the waterfall, never a gap
+        _trace.close_span(req.dspan)
+        req.dspan = None
+        if req.trace is not None and req.qspan is None:
+            req.qspan = _trace.open_span(req.trace, _trace.SPAN_QUEUE,
+                                         task_id=req.task_id,
+                                         extra=f"handler:{req.handler}"
+                                               f":requeue")
         try:
             self.queue.submit(req, force=True)
         # analyze: ignore[retry-protocol] - queue.submit crosses no seam;
@@ -680,6 +764,8 @@ class Supervisor:
                 deadline=req.deadline, seq=next(self._seq),
                 task_id=self.sessions.next_task_id(),
                 split_depth=1, no_batch=True, join=join, join_slot=slot,
+                trace=(_trace.child_of(req.trace)
+                       if req.trace is not None else None),
             )
             _flight.record(_flight.EV_SPLIT_RETRY, child.task_id,
                            detail=f"rid:{child.task_id}:"
@@ -711,6 +797,8 @@ class Supervisor:
                 deadline=req.deadline, seq=next(self._seq), task_id=tid,
                 split_depth=1, no_batch=True, join=join, join_slot=m,
                 shuffle_sid=sid, shuffle_map_index=m,
+                trace=(_trace.child_of(req.trace)
+                       if req.trace is not None else None),
             )
             state.tasks[m] = {"rid": tid, "data": shard, "worker": -1,
                               "inc": -1, "state": "pending", "sizes": {},
@@ -840,6 +928,8 @@ class Supervisor:
                         seq=next(self._seq), task_id=tid,
                         split_depth=1, no_batch=True,
                         shuffle_sid=state.sid, shuffle_map_index=m,
+                        trace=(_trace.new_root(tid) if self._spans_on
+                               else None),
                     )
                     revivals.append(revival)
         for sid in set(stale_sids):
@@ -901,6 +991,14 @@ class Supervisor:
             req.response.admitted_ns = now_ns
             self.metrics.count("admitted", req.session_id)
             self.metrics.record_wait(now_ns - req.response.submitted_ns)
+        # the queue-wait phase ends at the grant; the dispatch phase
+        # (lease outstanding on one worker) opens, and ITS context crosses
+        # the pipe so the worker's spans chain under the same rid
+        _trace.close_span(req.qspan)
+        req.qspan = None
+        req.dspan = _trace.open_span(
+            req.trace, _trace.SPAN_DISPATCH, task_id=rid,
+            extra=f"worker:{target.worker_id}:inc:{target.incarnation}")
         self.metrics.count("leases_granted", req.session_id)
         _flight.record(_flight.EV_LEASE_GRANT, rid,
                        detail=f"rid:{rid}:worker:{target.worker_id}:"
@@ -909,7 +1007,10 @@ class Supervisor:
         deadline_rel = (None if req.deadline is None
                         else max(0.05, req.deadline - time.monotonic()))
         ok = target.conn.send((rpc.MSG_DISPATCH, rid, req.handler,
-                               req.payload, deadline_rel, req.priority))
+                               req.payload, deadline_rel, req.priority,
+                               _trace.to_wire(req.dspan.ctx
+                                              if req.dspan is not None
+                                              else req.trace)))
         if not ok:
             # reclaim THIS lease explicitly: if the EOF path already ran
             # for this incarnation, _worker_dead below is a no-op and
@@ -938,6 +1039,7 @@ class Supervisor:
     def _on_result(self, handle: _ExecutorHandle, rid: int, status: str,
                    value: Any, err) -> None:
         requeue = False
+        granted_ns = 0
         with self._lock:
             lease = self._leases.get(rid)
             stale = (lease is None or lease.completed
@@ -945,6 +1047,7 @@ class Supervisor:
                      or lease.worker_id != handle.worker_id
                      or lease.incarnation != handle.incarnation)
             if not stale:
+                granted_ns = lease.granted_ns
                 handle.inflight.discard(rid)
                 # a fetch that stalled out (dead peer mid-recovery, storm
                 # of transport faults) is data-plane weather, not a
@@ -980,6 +1083,15 @@ class Supervisor:
                        detail=f"rid:{rid}:worker:{handle.worker_id}:"
                               f"{status}")
         if status == OK:
+            # END-TO-END latency as the front door promised it: submit ->
+            # result, queue wait and every re-dispatch included (the
+            # grant->result of the final attempt alone would hide exactly
+            # the storms an SLO exists to catch).  This is the per-handler
+            # distribution the burn-rate engine evaluates.
+            t0_ns = req.response.submitted_ns or granted_ns
+            if t0_ns:
+                self.metrics.record_run(
+                    time.monotonic_ns() - t0_ns, handler=req.handler)
             with self._lock:
                 self._warm.add(req.handler)
             self._finish(req, OK, value=value)
@@ -999,8 +1111,53 @@ class Supervisor:
         period = max(0.01, self.heartbeat_s)
         while not self._stop.wait(period):
             self._health_sweep()
+            if self.slo is not None:
+                self.slo.tick()
             self._ladder_tick()
             self._pressure_broadcast()
+            self._ingest_own_events()
+
+    def _ingest_own_events(self) -> None:
+        """Merge THIS process's flight-ring delta into the live timeline
+        (the supervisor's queue/dispatch spans, lease and ladder events
+        live in its own ring, not in any worker's)."""
+        if self.timeline is None:
+            return
+        import os as _os
+
+        with self._tl_lock:
+            events, self._tl_cursor = _flight.snapshot_since(
+                self._tl_cursor)
+            if events:
+                self.timeline.ingest(_os.getpid(), time.time(),
+                                     time.monotonic_ns(), events,
+                                     incarnation=0, worker_id=-1)
+
+    def _telemetry_view(self) -> dict:
+        """The JSON view the local telemetry endpoint serves (one per
+        connection): the merged cluster timeline plus everything a
+        dashboard needs to label it."""
+        from spark_rapids_jni_tpu.serve.telemetry import TIMELINE_SCHEMA
+
+        self._ingest_own_events()  # the view must include this instant
+        return {
+            "schema": TIMELINE_SCHEMA,
+            "wall_t": time.time(),
+            "timeline": self.timeline.merged(),
+            "timeline_stats": self.timeline.stats(),
+            "workers_telemetry": self.timeline.worker_metrics(),
+            "supervisor": self.snapshot(),
+            # per-tenant admission counters as the FRONT DOOR saw them
+            # (shed/reject decisions happen here, not in any worker)
+            "sessions": self.metrics.snapshot()["sessions"],
+            "slo": self.slo.snapshot() if self.slo is not None else None,
+        }
+
+    def telemetry_endpoint(self) -> Optional[tuple]:
+        """(host, port) of the live telemetry endpoint, or None when the
+        plane is disabled / the supervisor was built with start=False."""
+        return (self._tl_server.endpoint if self._tl_server is not None
+                else None)
 
     def _pressure_broadcast(self) -> None:
         """Federated admission (ROADMAP item 1's tail): aggregate the
@@ -1021,6 +1178,11 @@ class Supervisor:
             "mem_frac": max(float(g.get("mem_frac", 0.0))
                             for g in gauges),
             "queue_frac": self.queue.depth() / max(1, self.queue.maxsize),
+            # SLO burn as first-class cluster pressure: every worker's
+            # admission controller tightens when the service is burning
+            # its declared budgets, not just when memory is short
+            "slo_frac": (self.slo.pressure() if self.slo is not None
+                         else 0.0),
             "workers": len(gauges),
         }
         for conn in conns:
@@ -1073,7 +1235,10 @@ class Supervisor:
                                       f"inc:{h.incarnation}:hung_lease")
                 self._worker_dead(h, "hung_lease")
 
-    def _sample_stress(self) -> float:
+    def _sample_stress(self) -> tuple:
+        """(stress, dominant source name) — the source labels ladder
+        ledger entries so an operator can tell an SLO-driven degrade
+        from a capacity-driven one at a glance."""
         with self._lock:
             handles = list(self._handles.values())
         alive = [h for h in handles if h.health == _ALIVE]
@@ -1090,14 +1255,25 @@ class Supervisor:
             (max(float(h.gauges.get("mem_frac", 0.0)),
                  float(h.gauges.get("blocked_frac", 0.0)))
              for h in alive), default=0.0)
-        return max(dead_frac, queue_frac, min(1.0, worker_press))
+        # a burning SLO pressures the ladder exactly like missing
+        # capacity: degrade-and-shed is how a promise under burn gets
+        # its budget back (the EV_SLO_BURN -> EV_DEGRADE_ENTER chain the
+        # round-14 acceptance pins)
+        slo_press = self.slo.pressure() if self.slo is not None else 0.0
+        terms = (("capacity", dead_frac), ("queue", queue_frac),
+                 ("workers", min(1.0, worker_press)), ("slo", slo_press))
+        src, stress = max(terms, key=lambda t: t[1])
+        return stress, src
 
     def _ladder_tick(self, stress: Optional[float] = None) -> None:
         """One degradation-ladder step: EWMA the stress signal, move at
         most one level per dwell window, record every transition."""
+        src = "injected"
         if stress is None:
-            stress = (self._stress_source() if self._stress_source
-                      else self._sample_stress())
+            if self._stress_source is not None:
+                stress = self._stress_source()
+            else:
+                stress, src = self._sample_stress()
         transition = None
         with self._lock:
             self._ladder_tickno += 1
@@ -1129,6 +1305,7 @@ class Supervisor:
                 "tick": tick, "t_ns": time.monotonic_ns(),
                 "from": DEGRADE_LEVELS[level], "to": DEGRADE_LEVELS[new],
                 "level": new, "stress_ewma": round(ewma, 4),
+                "source": src,
             }
             self.ledger.append(transition)
             del self.ledger[:-256]
@@ -1196,6 +1373,7 @@ class Supervisor:
                 "ledger_tail": list(self.ledger)[-16:],
                 "transitions": len(self.ledger),
             }
+        tl = self.timeline
         return {
             "workers": workers,
             "ladder": ladder,
@@ -1203,6 +1381,12 @@ class Supervisor:
             "shuffles": shuffles,
             "queue_depth": self.queue.depth(),
             "counters": self.metrics.snapshot()["counters"],
+            "telemetry": (tl.stats() if tl is not None else None),
+            "telemetry_endpoint": (list(self._tl_server.endpoint)
+                                   if self._tl_server is not None
+                                   else None),
+            "slo_burning": (self.slo.burning()
+                            if self.slo is not None else []),
         }
 
     def wait_drained(self, timeout: float = 60.0) -> bool:
@@ -1223,6 +1407,8 @@ class Supervisor:
         dropped = self.queue.close()
         for req in dropped:
             self._credit(req)
+            _trace.close_span(req.qspan)
+            req.qspan = None
             self.metrics.count("cancelled", req.session_id)
             if req.join is not None:
                 req.join.deliver(req.join_slot, CANCELLED, None,
@@ -1258,6 +1444,8 @@ class Supervisor:
         for t in (self._dispatcher, self._monitor):
             if t is not None:
                 t.join(timeout=5.0)
+        if self._tl_server is not None:
+            self._tl_server.close()
         _flight.unregister_telemetry_source(self._telemetry_name)
 
     def __enter__(self):

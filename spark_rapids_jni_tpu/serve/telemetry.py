@@ -1,0 +1,361 @@
+"""The live cluster telemetry plane: continuous export + queryable timeline.
+
+Rounds 4-13 built deep per-process observability — the always-on flight
+ring, anomaly dumps, the ``--cluster`` dump merge — but all of it is
+POST-HOC: until something anomalous dumps, nobody can answer "where did
+request X spend its 80 ms" or "is tenant Y burning its p99 budget" while
+the cluster is running.  The reference ships an *always-on* CUPTI
+profiler for exactly this reason.  This module is the continuous analog:
+
+- :class:`TelemetryExporter` — runs in each executor worker (piggybacked
+  on the heartbeat thread, serve/rpc.py): every ``serve_telemetry_s`` it
+  ships the flight ring's rolling delta (``FlightRecorder.snapshot_since``
+  cursor) plus a ``ServeMetrics`` snapshot up the supervisor pipe as one
+  ``MSG_TELEMETRY`` message.  The export NEVER blocks the worker: an
+  undeliverable message (stalled supervisor pipe past the SafeConn send
+  guard) is skipped and counted (``EV_TELEMETRY_DROP``), mirroring the
+  round-13 heartbeat fix — a healthy worker must not wedge, or fall
+  silent, for the supervisor's own congestion.
+- :class:`ClusterTimeline` — supervisor-side bounded merge of every
+  process's exports (its own ring included): events gain ``pid`` and an
+  aligned ``wall_s`` from each export's paired (wall, monotonic) stamp —
+  the same alignment the dump merge uses — and group by ``rid:``/``sid:``
+  detail tokens, so span waterfalls (obs/trace.py) and lease chains
+  reconstruct LIVE.
+- :class:`TelemetryServer` — a local TCP endpoint (127.0.0.1, one JSON
+  snapshot per connection) serving the merged timeline + per-worker
+  metrics + supervisor/SLO state: the feed behind ``flightdump --live``
+  and ``tools/servetop.py``.
+
+Retention is bounded end to end: the worker ring bounds what a delta can
+carry, ``serve_telemetry_max_events`` bounds one message, and
+``serve_timeline_events`` bounds the supervisor's merged history.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from spark_rapids_jni_tpu.obs import flight as _flight
+from spark_rapids_jni_tpu.serve import rpc
+
+__all__ = [
+    "TelemetryExporter", "ClusterTimeline", "TelemetryServer",
+    "fetch_view", "TIMELINE_SCHEMA",
+]
+
+TIMELINE_SCHEMA = "srt-live-timeline-v1"
+
+_RID_TOKEN = "rid:"
+_SID_TOKEN = "sid:"
+
+
+class TelemetryExporter:
+    """One worker's continuous export of flight-ring deltas + metrics.
+
+    ``metrics_source`` is sampled per export (typically
+    ``engine.metrics.snapshot``); ``recorder`` defaults to the process
+    singleton.  :meth:`export` is called from the heartbeat thread with
+    the SafeConn's bounded-time ``send`` — this class adds pacing,
+    delta-cursor bookkeeping, and trim/skip accounting, and never blocks
+    beyond that send.
+    """
+
+    def __init__(self, worker_id: int, incarnation: int, *,
+                 metrics_source: Optional[Callable[[], dict]] = None,
+                 recorder: Optional["_flight.FlightRecorder"] = None,
+                 min_period_s: Optional[float] = None,
+                 max_events: Optional[int] = None):
+        from spark_rapids_jni_tpu import config
+
+        self.worker_id = int(worker_id)
+        self.incarnation = int(incarnation)
+        self._metrics_source = metrics_source
+        self._recorder = recorder if recorder is not None \
+            else _flight.recorder()
+        self.min_period_s = (float(config.get("serve_telemetry_s"))
+                             if min_period_s is None else float(min_period_s))
+        self.max_events = (int(config.get("serve_telemetry_max_events"))
+                           if max_events is None else int(max_events))
+        # shared between the heartbeat thread (periodic exports) and
+        # result-waiter threads (the force-flush that makes a completed
+        # request's spans survive a SIGKILL landing before the next
+        # beat) — one leaf lock serializes the cursor bookkeeping
+        self._lock = threading.Lock()
+        self._cursor = 0  # guarded-by: _lock
+        self._last_t = -1e9  # guarded-by: _lock
+        # after a failed send, FORCE flushes stand down until the pipe
+        # proves drained (a periodic export succeeds): each failed
+        # attempt costs the sender the SafeConn guard's full timeout, so
+        # per-request force-flushes against a stalled pipe would
+        # collapse serving throughput to one group per timeout
+        self._fail_cooldown = False  # guarded-by: _lock
+        self._announced = False  # guarded-by: _lock
+        # guarded-by: _lock
+        self.stats = {"exports": 0, "events": 0, "skipped": 0,
+                      "trimmed": 0, "paced": 0}
+
+    def export(self, send: Callable[[tuple], bool], *,
+               force: bool = False) -> bool:
+        """Ship one delta through ``send`` (bounded-time, returns False
+        when the peer is unreachable/stalled).  Returns True when there
+        was nothing to do or the delta shipped; False when it was
+        skipped — the cursor then stays put so the NEXT export retries
+        the same window (the ring is the retention bound).  ``force``
+        bypasses the pacing: result waiters flush at completion so a
+        request's spans are off-process BEFORE a kill can eat them."""
+        with self._lock:
+            return self._export_locked(send, force)
+
+    def _export_locked(self, send: Callable[[tuple], bool],
+                       force: bool) -> bool:
+        now = time.monotonic()
+        if force and self._fail_cooldown:
+            # stalled pipe: only the heartbeat-paced path keeps probing
+            self.stats["paced"] += 1
+            return True
+        if not force and now - self._last_t < self.min_period_s:
+            self.stats["paced"] += 1
+            return True
+        events, cursor = self._recorder.snapshot_since(self._cursor)
+        if not events and force:
+            return True  # a flush with nothing new costs nothing
+        if len(events) > self.max_events:
+            # ship the newest, count the trim loudly: one giant post-storm
+            # delta must not wedge the pipe behind it
+            dropped = len(events) - self.max_events
+            events = events[-self.max_events:]
+            self.stats["trimmed"] += dropped
+            _flight.record(_flight.EV_TELEMETRY_DROP, -1,
+                           detail=f"worker:{self.worker_id}:trimmed",
+                           value=dropped)
+        metrics = {}
+        if self._metrics_source is not None:
+            try:
+                metrics = dict(self._metrics_source())
+            # analyze: ignore[retry-protocol] - sampling a metrics
+            # snapshot for export: a failing sampler (engine mid-
+            # shutdown) degrades to an empty snapshot, never a wedged
+            # heartbeat thread
+            except Exception:  # noqa: BLE001
+                metrics = {}
+        ok = send((rpc.MSG_TELEMETRY, self.worker_id, self.incarnation,
+                   time.time(), time.monotonic_ns(), events, metrics))
+        if not ok:
+            # stalled/retired pipe: skip — NEVER block or exit.  The
+            # cursor stays put, so the window re-ships when the pipe
+            # drains; events older than the ring just age out.  Force
+            # flushes stand down until a paced export succeeds.
+            self._fail_cooldown = True
+            self.stats["skipped"] += 1
+            _flight.record(_flight.EV_TELEMETRY_DROP, -1,
+                           detail=f"worker:{self.worker_id}:send_failed")
+            return False
+        self._fail_cooldown = False
+        self._cursor = cursor
+        self._last_t = now
+        self.stats["exports"] += 1
+        self.stats["events"] += len(events)
+        if not self._announced:
+            self._announced = True
+            _flight.record(_flight.EV_TELEMETRY_EXPORT, -1,
+                           detail=f"worker:{self.worker_id}:"
+                                  f"inc:{self.incarnation}:up",
+                           value=len(events))
+        return True
+
+
+class ClusterTimeline:
+    """Bounded, queryable merge of every process's telemetry exports.
+
+    Events are normalized exactly like the ``flightdump --cluster`` dump
+    merge — ``pid`` attached, per-process monotonic times re-based onto
+    the wall clock via each export's stamp pair — so one reconstruction
+    grammar (rid chains, sid chains, span waterfalls) serves dumps AND
+    the live plane.  Deduplication is a per-(pid, incarnation) high-water
+    ``seq`` mark, O(1) per event.
+    """
+
+    def __init__(self, max_events: Optional[int] = None):
+        from spark_rapids_jni_tpu import config
+
+        if max_events is None:
+            max_events = int(config.get("serve_timeline_events"))
+        self._lock = threading.Lock()
+        # normalized event dicts, append-ordered  # guarded-by: _lock
+        self._events: "collections.deque" = collections.deque(
+            maxlen=max_events)
+        # (pid, incarnation) -> highest seq ingested  # guarded-by: _lock
+        self._seq_hi: Dict[tuple, int] = {}
+        # pid -> latest metrics snapshot + meta  # guarded-by: _lock
+        self._workers: Dict[int, dict] = {}
+        self.ingests = 0  # guarded-by: _lock
+        self.dropped_stale = 0  # guarded-by: _lock
+
+    def ingest(self, pid: int, wall_t: float, t_ns: int,
+               events: List[dict], *, incarnation: int = 0,
+               worker_id: int = -1,
+               metrics: Optional[dict] = None) -> int:
+        """Merge one export; returns how many events were new."""
+        added = 0
+        key = (int(pid), int(incarnation))
+        with self._lock:
+            self.ingests += 1
+            hi = self._seq_hi.get(key, 0)
+            for e in events:
+                seq = int(e.get("seq", 0))
+                if seq and seq <= hi:
+                    self.dropped_stale += 1
+                    continue
+                ev = dict(e)
+                ev["pid"] = int(pid)
+                # the stamp pair re-bases this process's monotonic clock
+                ev["wall_s"] = wall_t - (t_ns - int(e.get("t_ns", 0))) / 1e9
+                self._events.append(ev)
+                if seq:
+                    hi = seq
+                added += 1
+            self._seq_hi[key] = hi
+            if metrics is not None:
+                self._workers[int(pid)] = {
+                    "worker_id": int(worker_id),
+                    "incarnation": int(incarnation),
+                    "wall_t": wall_t,
+                    "metrics": metrics,
+                }
+        return added
+
+    def merged(self, *, since_wall_s: float = 0.0) -> dict:
+        """The cluster view in the dump-merge shape ``{pids, events,
+        rids, sids}`` — flightdump's ``format_cluster`` and the span
+        waterfall reconstruction consume either source unchanged."""
+        with self._lock:
+            events = [e for e in self._events
+                      if e["wall_s"] >= since_wall_s]
+        events.sort(key=lambda e: e["wall_s"])
+        rids: Dict[str, List[dict]] = {}
+        sids: Dict[str, List[dict]] = {}
+        for e in events:
+            detail = str(e.get("detail", ""))
+            # token scan without regex: this runs per query, over the
+            # full window — keep it a string find, not a regex walk
+            for tok, out in ((_RID_TOKEN, rids), (_SID_TOKEN, sids)):
+                i = detail.find(tok)
+                while i > 0 and detail[i - 1] != ":":
+                    i = detail.find(tok, i + 1)
+                if i < 0:
+                    continue
+                j = i + len(tok)
+                k = j
+                while k < len(detail) and detail[k].isdigit():
+                    k += 1
+                if k > j:
+                    out.setdefault(detail[j:k], []).append(e)
+        return {"pids": sorted({e["pid"] for e in events}),
+                "events": events, "rids": rids, "sids": sids}
+
+    def worker_metrics(self) -> Dict[str, dict]:
+        with self._lock:
+            return {str(pid): dict(w) for pid, w in self._workers.items()}
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"events": len(self._events),
+                    "ingests": self.ingests,
+                    "dropped_stale": self.dropped_stale,
+                    "processes": len(self._seq_hi)}
+
+
+class TelemetryServer:
+    """The supervisor's local telemetry endpoint: a 127.0.0.1 TCP
+    listener that writes one JSON view per connection and closes — no
+    protocol to version, trivially consumable from ``nc``, flightdump
+    ``--live``, and servetop.  ``view_source`` builds the payload (the
+    supervisor composes timeline + workers + ladder + SLO state)."""
+
+    def __init__(self, view_source: Callable[[], dict],
+                 port: Optional[int] = None):
+        from spark_rapids_jni_tpu import config
+
+        self._view_source = view_source
+        self._port = (int(config.get("serve_telemetry_port"))
+                      if port is None else int(port))
+        self._sock: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.endpoint: Optional[tuple] = None
+        self.served = 0
+
+    def start(self) -> "TelemetryServer":
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", self._port))
+        s.listen(16)
+        s.settimeout(0.25)
+        self._sock = s
+        self.endpoint = s.getsockname()
+        self._thread = threading.Thread(target=self._serve_loop,
+                                        daemon=True,
+                                        name="serve-telemetry-endpoint")
+        self._thread.start()
+        return self
+
+    def _serve_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # closed under us during shutdown
+            # accepted sockets do NOT inherit the listener's timeout: a
+            # consumer that connects and never reads (suspended servetop)
+            # must cost one bounded write, not wedge the endpoint thread
+            conn.settimeout(5.0)
+            try:
+                try:
+                    view = self._view_source()
+                # analyze: ignore[retry-protocol] - building the view
+                # samples live gauges mid-anything; a failure must answer
+                # the client in-band, never kill the endpoint thread
+                except Exception as e:  # noqa: BLE001
+                    view = {"schema": TIMELINE_SCHEMA,
+                            "error": repr(e)[:200]}
+                conn.sendall(json.dumps(view).encode("utf-8"))
+                self.served += 1
+            except OSError:
+                pass  # client went away mid-write: its problem
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+
+def fetch_view(host: str, port: int, timeout_s: float = 5.0) -> dict:
+    """Client half of the endpoint: one connection, one JSON view."""
+    with socket.create_connection((host, int(port)),
+                                  timeout=timeout_s) as s:
+        s.settimeout(timeout_s)
+        chunks = []
+        while True:
+            b = s.recv(1 << 16)
+            if not b:
+                break
+            chunks.append(b)
+    return json.loads(b"".join(chunks).decode("utf-8"))

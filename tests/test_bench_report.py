@@ -1,0 +1,87 @@
+"""bench_report trajectory diff (round 14 satellite).
+
+Pins: snapshot loading (incl. the truncated-tail recovery older rounds
+need), per-stage regression/improvement classification, added/removed
+stages, and the advisory-vs-gating exit codes the CI wiring relies on.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import bench_report  # noqa: E402
+
+
+def _snap(path, stages):
+    detail = {name: {"Mrows_per_s": rate, "timing": {}}
+              for name, rate in stages.items()}
+    path.write_text(json.dumps(
+        {"n": 1, "cmd": "bench", "rc": 0,
+         "tail": json.dumps({"metric": "x", "detail": detail}),
+         "parsed": None}))
+
+
+def test_load_stages_parses_tail_and_recovers_truncation(tmp_path):
+    p = tmp_path / "BENCH_r01.json"
+    _snap(p, {"q97": 10.0, "json": 0.5})
+    assert bench_report.load_stages(str(p)) == {
+        "q97": ("Mrows_per_s", 10.0), "json": ("Mrows_per_s", 0.5)}
+    # a truncated tail (older snapshots) still yields the intact stages
+    full = json.dumps({"detail": {
+        "a": {"Mrows_per_s": 1.0, "timing": {"iters": [1, 2]}},
+        "b": {"Mrows_per_s": 2.0, "timing": {"iters": [1, 2]}}}})
+    t = tmp_path / "BENCH_r02.json"
+    t.write_text(json.dumps({"tail": full[:full.index('"b"')],
+                             "parsed": None}))
+    got = bench_report.load_stages(str(t))
+    assert got.get("a") == ("Mrows_per_s", 1.0)
+
+
+def test_compare_classifies_stages():
+    prev = {"fast": ("Mrows_per_s", 10.0), "slow": ("Mrows_per_s", 4.0),
+            "gone": ("Mrows_per_s", 1.0), "flat": ("Mrows_per_s", 5.0)}
+    cur = {"fast": ("Mrows_per_s", 20.0), "slow": ("Mrows_per_s", 2.0),
+           "new": ("Grows_per_s", 1.0), "flat": ("Mrows_per_s", 5.2)}
+    rep = bench_report.compare(prev, cur, threshold_pct=20.0)
+    by = {s["stage"]: s for s in rep["stages"]}
+    assert by["fast"]["status"] == "improved"
+    assert by["slow"]["status"] == "REGRESSION"
+    assert by["gone"]["status"] == "removed"
+    assert by["new"]["status"] == "added"
+    assert by["flat"]["status"] == "ok"
+    assert rep["regressions"] == ["slow"]
+    text = bench_report.format_report(rep, "BENCH_r01.json",
+                                      "BENCH_r02.json")
+    assert "REGRESSED (1): slow" in text and "-50.0%" in text
+
+
+def test_main_advisory_vs_gating_exit_codes(tmp_path, capsys):
+    _snap(tmp_path / "BENCH_r01.json", {"q": 10.0})
+    _snap(tmp_path / "BENCH_r02.json", {"q": 1.0})
+    # advisory (the ci/run-tests.sh wiring): report, exit 0
+    assert bench_report.main(["--dir", str(tmp_path)]) == 0
+    assert "REGRESSED" in capsys.readouterr().out
+    # gating: same comparison exits non-zero
+    assert bench_report.main(["--dir", str(tmp_path), "--gate"]) == 1
+    capsys.readouterr()  # drain the gate run's report
+    # --json emits machine-readable output
+    assert bench_report.main(["--dir", str(tmp_path), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["regressions"] == ["q"]
+
+
+def test_main_needs_two_snapshots(tmp_path, capsys):
+    _snap(tmp_path / "BENCH_r01.json", {"q": 10.0})
+    assert bench_report.main(["--dir", str(tmp_path)]) == 0
+    assert "nothing to compare" in capsys.readouterr().out
+
+
+def test_round_ordering_is_numeric_not_lexical(tmp_path):
+    for r in (9, 10, 11):
+        _snap(tmp_path / f"BENCH_r{r:02d}.json", {"q": float(r)})
+    snaps = bench_report.find_snapshots(str(tmp_path))
+    assert [os.path.basename(p) for p in snaps[-2:]] == [
+        "BENCH_r10.json", "BENCH_r11.json"]

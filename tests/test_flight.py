@@ -148,9 +148,13 @@ def test_event_kind_vocabulary_is_stable():
     assert flight.EVENT_KINDS[24:27] == (
         "ragged_pack", "ragged_launch", "ragged_split")
     # round 13: the shuffle data-plane kinds are strictly appended after
-    assert flight.EVENT_KINDS[-4:] == (
+    assert flight.EVENT_KINDS[27:31] == (
         "shuffle_produce", "shuffle_fetch", "shuffle_retry",
         "shuffle_ack")
+    # round 14: the telemetry-plane kinds (spans, SLO, export) appended
+    assert flight.EVENT_KINDS[-6:] == (
+        "span_open", "span_close", "slo_burn", "slo_ok",
+        "telemetry_export", "telemetry_drop")
     assert len(set(flight.EVENT_KINDS)) == len(flight.EVENT_KINDS)
 
 

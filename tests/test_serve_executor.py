@@ -498,12 +498,12 @@ def test_hung_handler_emits_task_hung_and_anomaly_dump(gov):
         eng.submit(s, "nap", 0.0).result(timeout=30)
         rec = _flight.recorder()
         dumps_before = rec.dump_count + rec.dumps_suppressed
-        mark = len(_flight.snapshot())
+        _, mark = _flight.snapshot_since(0)  # seq cursor: rollover-proof
         r = eng.submit(s, "nap", 0.8)  # >> max(0.15, 1.0 x EWMA)
         deadline = time.monotonic() + 10
         hung = []
         while not hung and time.monotonic() < deadline:
-            hung = [e for e in _flight.snapshot()[mark:]
+            hung = [e for e in _flight.snapshot_since(mark)[0]
                     if e["kind"] == "task_hung"
                     and "handler:nap" in e["detail"]]
             time.sleep(0.02)

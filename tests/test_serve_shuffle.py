@@ -199,10 +199,10 @@ def test_local_store_fast_path(services):
     svc = services()
     t = {"key": np.arange(8, dtype=np.int64)}
     svc.produce(6, 2, [t])
-    mark = len(_flight.snapshot())
+    _, mark = _flight.snapshot_since(0)  # seq cursor: rollover-proof
     cols = svc.fetch(6, 2, 0, deadline=time.monotonic() + 5)
     assert np.array_equal(cols["key"], t["key"])
-    evs = [e for e in _flight.snapshot()[mark:]
+    evs = [e for e in _flight.snapshot_since(mark)[0]
            if e["kind"] == "shuffle_fetch"]
     assert evs and ":src:local" in evs[-1]["detail"]
 
@@ -227,13 +227,13 @@ def test_fetch_stalls_out_with_seeded_backoff(services):
     cons.on_message(("shuffle_map", 8, 1,
                      {0: {"state": "pending", "ep": None,
                           "incarnation": 0, "sizes": {}}}))
-    mark = len(_flight.snapshot())
+    _, mark = _flight.snapshot_since(0)  # seq cursor: rollover-proof
     t0 = time.monotonic()
     with pytest.raises(ShuffleFetchStalled):
         cons.fetch(8, 0, 0, deadline=time.monotonic() + 0.5)
     assert time.monotonic() - t0 >= 0.4
     reasons = [e["detail"].rsplit("reason:", 1)[-1]
-               for e in _flight.snapshot()[mark:]
+               for e in _flight.snapshot_since(mark)[0]
                if e["kind"] == "shuffle_retry"]
     assert reasons and set(reasons) == {"pending"}
 
@@ -307,10 +307,10 @@ def test_spool_fast_path_same_host(services, tmp_path):
     t = {"key": np.arange(64, dtype=np.int64)}
     sizes = prod.produce(12, 0, [t])
     cons.on_message(_produced_map(prod, 12, 1, sizes))
-    mark = len(_flight.snapshot())
+    _, mark = _flight.snapshot_since(0)  # seq cursor: rollover-proof
     cols = cons.fetch(12, 0, 0, deadline=time.monotonic() + 10)
     assert np.array_equal(cols["key"], t["key"])
-    evs = [e for e in _flight.snapshot()[mark:]
+    evs = [e for e in _flight.snapshot_since(mark)[0]
            if e["kind"] == "shuffle_fetch"]
     assert evs and ":src:spool" in evs[-1]["detail"]
     assert os.path.exists(os.path.join(spool, "12_0_0.frame"))
@@ -544,7 +544,7 @@ def test_safeconn_send_times_out_as_backpressure():
     # "writable" from the guard's select always means the whole send
     # fits — the pipe fills to a clean not-writable state
     payload = ("beat", b"x" * 64)
-    mark = len(_flight.snapshot())
+    _, mark = _flight.snapshot_since(0)  # seq cursor: rollover-proof
     sent, t0 = 0, time.monotonic()
     while time.monotonic() - t0 < 20.0:
         if not conn.send(payload):
@@ -553,7 +553,7 @@ def test_safeconn_send_times_out_as_backpressure():
     else:
         pytest.fail("send never surfaced backpressure on a full pipe")
     assert sent >= 1  # the pipe took SOMETHING before filling
-    hung = [e for e in _flight.snapshot()[mark:]
+    hung = [e for e in _flight.snapshot_since(mark)[0]
             if e["kind"] == "task_hung"
             and "pipe_send_stalled" in e["detail"]]
     assert hung, "stalled send must record EV_TASK_HUNG"

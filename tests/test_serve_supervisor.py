@@ -266,7 +266,7 @@ def test_ladder_steps_down_and_recovers_with_ledger_and_events(sup_unit):
     walks it back up — every transition a ledger entry AND an
     EV_DEGRADE_* flight event with matching direction."""
     sup = sup_unit
-    mark = len(_flight.snapshot())
+    _, mark = _flight.snapshot_since(0)  # seq cursor: rollover-proof
     _tick_until(sup, 1.0, LEVEL_REJECT)
     assert [e["to"] for e in sup.ledger] == ["shed_low", "cached_only",
                                              "reject"]
@@ -274,7 +274,7 @@ def test_ladder_steps_down_and_recovers_with_ledger_and_events(sup_unit):
     names = [e["to"] for e in sup.ledger]
     assert names == ["shed_low", "cached_only", "reject",
                      "cached_only", "shed_low", "healthy"]
-    evs = [e for e in _flight.snapshot()[mark:]
+    evs = [e for e in _flight.snapshot_since(mark)[0]
            if e["kind"] in ("degrade_enter", "degrade_exit")]
     assert [e["kind"] for e in evs] == ["degrade_enter"] * 3 + \
         ["degrade_exit"] * 3
@@ -348,11 +348,13 @@ def test_respawning_incarnation_counts_as_missing_capacity(sup_unit):
     with sup._lock:
         sup._handles[0] = h0
         sup._handles[1] = h1
-    assert sup._sample_stress() == 0.0
+    assert sup._sample_stress()[0] == 0.0
     h0.incarnation = 2  # now it is a respawn in flight
-    assert sup._sample_stress() == pytest.approx(0.5)
+    stress, src = sup._sample_stress()
+    assert stress == pytest.approx(0.5)
+    assert src == "capacity"  # the ledger label for missing executors
     h0.health = "alive"
-    assert sup._sample_stress() == 0.0
+    assert sup._sample_stress()[0] == 0.0
 
 
 def test_redispatched_fanout_request_regrants_itself_not_fanout(sup_unit):

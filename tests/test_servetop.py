@@ -1,0 +1,202 @@
+"""servetop rendering + flightdump live/merge hardening (round 14).
+
+What this file pins:
+
+- servetop renders every dashboard section from a canned endpoint view
+  (the deterministic --fixture path), including burning SLOs, handler
+  latency columns, tenant shed counts, and span waterfalls;
+- flightdump --cluster COUNTS corrupt/truncated dump inputs in the
+  merge summary instead of silently skipping them (with a truncated
+  dump in the fixture set — the regression the satellite names);
+- flightdump --live reads the same merged shape from a telemetry
+  endpoint, and --waterfall renders span bars from either source.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import flightdump  # noqa: E402
+import servetop  # noqa: E402
+
+from spark_rapids_jni_tpu.serve.telemetry import TelemetryServer  # noqa: E402
+
+
+def _canned_view() -> dict:
+    """A small but fully-populated endpoint view: one supervisor
+    (pid 100) and one worker (pid 200), one completed request rid 7
+    with a cross-process span chain, one burning SLO."""
+    def ev(pid, wall_s, kind, detail, value=0, task=7):
+        return {"pid": pid, "wall_s": wall_s, "kind": kind,
+                "detail": detail, "value": value, "task_id": task,
+                "t_ns": int(wall_s * 1e9), "tid": 1, "seq": 1}
+
+    span = "rid:7:span:{}:parent:{}:kind:{}"
+    events = [
+        ev(100, 10.00, "span_open", span.format(11, 0, "queue")),
+        ev(100, 10.02, "span_close", span.format(11, 0, "queue"),
+           value=20_000_000),
+        ev(100, 10.02, "span_open", span.format(12, 0, "dispatch")),
+        ev(200, 10.03, "span_open", span.format(13, 12, "compute")),
+        ev(200, 10.06, "span_close", span.format(13, 12, "compute"),
+           value=30_000_000),
+        ev(100, 10.07, "span_close", span.format(12, 0, "dispatch"),
+           value=50_000_000),
+        ev(100, 10.07, "lease_done", "rid:7:worker:0:ok"),
+        ev(100, 10.10, "slo_burn", "slo:svc:obj:latency:burn:3.20",
+           value=3200, task=-1),
+    ]
+    rids = {"7": [e for e in events if "rid:7" in e["detail"]]}
+    return {
+        "schema": "srt-live-timeline-v1",
+        "wall_t": 1700000000.0,
+        "timeline": {"pids": [100, 200], "events": events,
+                     "rids": rids, "sids": {}},
+        "timeline_stats": {"events": len(events), "ingests": 3,
+                           "dropped_stale": 0, "processes": 2},
+        "workers_telemetry": {
+            "200": {"worker_id": 0, "incarnation": 1, "wall_t": 10.0,
+                    "metrics": {
+                        "counters": {"completed": 41, "failed": 1},
+                        "handlers": {"q97": {"count": 41, "mean_ms": 4.0,
+                                             "p50_ms": 3.1,
+                                             "p99_ms": 48.7}},
+                    }}},
+        "supervisor": {
+            "workers": {"0": {"state": "alive", "incarnation": 1,
+                              "pid": 200, "inflight": 2,
+                              "gauges": {"mem_frac": 0.42,
+                                         "blocked_frac": 0.1}}},
+            "ladder": {"level": 1, "level_name": "shed_low",
+                       "stress_ewma": 0.61, "max_level_seen": 1,
+                       "ledger_tail": [], "transitions": 1},
+            "leases": {"leases": 44, "completed": 41, "outstanding": 3,
+                       "redispatched": 2, "max_dispatches": 2},
+            "queue_depth": 5,
+            "counters": {"submitted": 44},
+            "slo_burning": ["svc:latency"],
+        },
+        "sessions": {"acme": {"submitted": 30, "completed": 28,
+                              "timed_out": 1, "rejected_degraded": 4}},
+        "slo": {"slos": [], "burning": ["svc:latency"],
+                "objectives": [{"slo": "svc", "objective": "latency",
+                                "burning": True, "burn_fast": 3.2,
+                                "burn_slow": 1.4}],
+                "ledger_tail": []},
+    }
+
+
+def test_render_frame_shows_every_section():
+    frame = servetop.render_frame(_canned_view())
+    # header + ladder + SLO banner
+    assert "level=shed_low" in frame
+    assert "SLO BURNING: svc:latency" in frame
+    # workers table
+    assert "WORKERS" in frame and " alive " in frame and "200" in frame
+    # handlers with latency columns
+    assert "q97" in frame and "48.70" in frame
+    # tenants with shed counts
+    assert "acme" in frame and frame.index("acme") > frame.index("TENANTS")
+    # SLO table shows the burning objective's burn rates
+    assert "BURN" in frame and "3.20" in frame
+    # span waterfall: the cross-process chain renders with pids
+    assert "rid 7" in frame and "compute" in frame
+    assert "pid 200" in frame
+
+
+def test_render_frame_throughput_needs_prev_frame():
+    view = _canned_view()
+    prev = json.loads(json.dumps(view))
+    prev["wall_t"] -= 10.0
+    prev["workers_telemetry"]["200"]["metrics"]["handlers"]["q97"][
+        "count"] = 21
+    frame = servetop.render_frame(view, prev=prev)
+    assert "2.0" in frame  # (41-21)/10s
+
+
+def test_servetop_main_fixture_once(tmp_path, capsys):
+    path = tmp_path / "view.json"
+    path.write_text(json.dumps(_canned_view()))
+    assert servetop.main(["--fixture", str(path), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "WORKERS" in out and "SPANS" in out
+
+
+def test_servetop_main_requires_exactly_one_source(tmp_path):
+    with pytest.raises(SystemExit):
+        servetop.main(["--once"])
+
+
+# ------------------------------------------------------------- flightdump
+
+
+def _write_dump(path, pid, events):
+    dump = {"schema": "srt-flight-dump-v1", "reason": "test", "detail": "",
+            "pid": pid, "wall_time_s": 1000.0, "t_ns": 5_000_000_000,
+            "events": events, "tasks": {}, "telemetry": {}}
+    with open(path, "w") as f:
+        json.dump(dump, f)
+
+
+def test_merge_cluster_counts_truncated_inputs(tmp_path):
+    """The satellite regression: a dump truncated by a mid-write SIGKILL
+    is COUNTED in the merge summary, never silently absent."""
+    good = [{"seq": 1, "t_ns": 5_000_000_000, "kind": "lease_grant",
+             "task_id": 3, "tid": 1, "detail": "rid:3:worker:0",
+             "value": 0}]
+    _write_dump(tmp_path / "flight_a_100_1.json", 100, good)
+    full = json.dumps({"schema": "srt-flight-dump-v1", "pid": 200,
+                       "wall_time_s": 1000.0, "t_ns": 1,
+                       "events": good * 50})
+    (tmp_path / "flight_b_200_1.json").write_text(full[:len(full) // 2])
+    (tmp_path / "flight_c_300_1.json").write_text("")  # zero bytes
+    merged = flightdump.merge_cluster(str(tmp_path))
+    assert merged["dumps"] == 3
+    assert merged["skipped"] == 2
+    assert sorted(merged["skipped_paths"]) == [
+        "flight_b_200_1.json", "flight_c_300_1.json"]
+    assert merged["pids"] == [100]
+    text = flightdump.format_cluster(merged)
+    assert "2 input(s) skipped as corrupt/truncated" in text
+    assert "flight_b_200_1.json" in text
+
+
+def test_flightdump_live_reads_endpoint_and_renders_waterfalls(capsys):
+    view = _canned_view()
+    srv = TelemetryServer(lambda: view, port=0).start()
+    try:
+        host, port = srv.endpoint
+        assert flightdump.main([f"{host}:{port}", "--live"]) == 0
+        out = capsys.readouterr().out
+        assert "rid 7" in out and "lease_done" in out
+        assert flightdump.main([f"{host}:{port}", "--live",
+                                "--waterfall"]) == 0
+        out = capsys.readouterr().out
+        assert "span waterfalls" in out
+        assert "queue" in out and "compute" in out
+    finally:
+        srv.close()
+
+
+def test_flightdump_waterfall_from_dump_dir(tmp_path, capsys):
+    span = "rid:4:span:{}:parent:{}:kind:{}"
+    events = []
+    t = 5_000_000_000
+    for i, (kind, sk) in enumerate((("span_open", "queue"),
+                                    ("span_close", "queue"),
+                                    ("span_open", "compute"),
+                                    ("span_close", "compute"))):
+        events.append({"seq": i + 1, "t_ns": t + i * 1_000_000,
+                       "kind": kind, "task_id": 4, "tid": 1,
+                       "detail": span.format(21 + (i // 2), 0, sk),
+                       "value": 1_000_000 if kind == "span_close" else 0})
+    _write_dump(tmp_path / "flight_x_100_1.json", 100, events)
+    assert flightdump.main([str(tmp_path), "--cluster",
+                            "--waterfall"]) == 0
+    out = capsys.readouterr().out
+    assert "rid 4" in out and "complete=1" in out

@@ -1,0 +1,230 @@
+"""Distributed request spans (obs/trace.py, round 14).
+
+What this file pins:
+
+- span contexts: cluster-unique ids, child lineage keeps the rid, the
+  wire form survives the pipe and rejects garbage gracefully;
+- emission: open/close land in the flight ring with the rid:span:parent:
+  kind detail grammar, closes carry durations, double-close is a no-op;
+- reconstruction: waterfalls group by rid, chain completeness judges the
+  LAST span per kind (an attempt orphaned by a SIGKILL must not mark a
+  re-dispatched-and-completed request incomplete);
+- engine integration: a served request yields a complete queue->compute
+  waterfall; split children carry the parent's rid lineage; a request
+  expiring in the queue closes its queue span.
+"""
+
+import threading
+import time
+
+import pytest
+
+from spark_rapids_jni_tpu.mem.governor import (
+    BudgetedResource,
+    MemoryGovernor,
+)
+from spark_rapids_jni_tpu.obs import flight, trace
+from spark_rapids_jni_tpu.serve import QueryHandler, ServingEngine
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ring():
+    flight.recorder().reset_for_tests()
+    yield
+    flight.recorder().reset_for_tests()
+
+
+# ---------------------------------------------------------------- contexts
+
+
+def test_context_ids_unique_and_lineage_keeps_rid():
+    root = trace.new_root(42)
+    kids = [trace.child_of(root) for _ in range(100)]
+    assert len({c.span for c in kids}) == 100
+    assert all(c.rid == 42 and c.parent == root.span for c in kids)
+
+
+def test_wire_round_trip_and_garbage_degrades_to_none():
+    ctx = trace.new_root(7)
+    back = trace.from_wire(trace.to_wire(ctx))
+    assert (back.rid, back.span, back.parent) == (7, ctx.span, 0)
+    assert trace.to_wire(None) is None
+    for garbage in (None, "nope", (1,), (1, 2, 3, 4), ("a", "b", "c")):
+        assert trace.from_wire(garbage) is None
+
+
+# ---------------------------------------------------------------- emission
+
+
+def test_open_close_events_carry_grammar_and_duration():
+    ctx = trace.new_root(9)
+    h = trace.open_span(ctx, trace.SPAN_QUEUE, task_id=9,
+                        extra="handler:q97")
+    time.sleep(0.002)
+    trace.close_span(h)
+    trace.close_span(h)  # idempotent
+    trace.close_span(None)  # no-op
+    evs = flight.snapshot()
+    assert [e["kind"] for e in evs] == ["span_open", "span_close"]
+    for e in evs:
+        assert f"rid:9:span:{h.ctx.span}:parent:{h.ctx.parent}" \
+               in e["detail"]
+        assert ":kind:queue:handler:q97" in e["detail"]
+    assert evs[1]["value"] >= 2e6  # the close carries the duration (ns)
+
+
+def test_open_span_with_no_parent_is_free():
+    assert trace.open_span(None, trace.SPAN_QUEUE) is None
+    assert flight.snapshot() == []
+
+
+def test_span_contextmanager_sets_current_for_nested_layers():
+    ctx = trace.new_root(1)
+    assert trace.current() is None
+    with trace.span(ctx, trace.SPAN_COMPUTE) as inner:
+        assert trace.current() is inner
+        with trace.maybe_span(trace.SPAN_TRANSPORT) as t:
+            assert t is not None and t.rid == 1 and t.parent == inner.span
+    assert trace.current() is None
+    # and with NO current context, maybe_span is a silent no-op
+    with trace.maybe_span(trace.SPAN_TRANSPORT) as t:
+        assert t is None
+    kinds = [e["kind"] for e in flight.snapshot()]
+    assert kinds.count("span_open") == 2
+    assert kinds.count("span_close") == 2
+
+
+# ----------------------------------------------------------- reconstruction
+
+
+def test_waterfall_groups_by_rid_and_orders_spans():
+    a, b = trace.new_root(1), trace.new_root(2)
+    ha = trace.open_span(a, trace.SPAN_QUEUE)
+    trace.close_span(ha)
+    with trace.span(a, trace.SPAN_COMPUTE):
+        pass
+    hb = trace.open_span(b, trace.SPAN_QUEUE)
+    trace.close_span(hb)
+    falls = trace.waterfall(flight.snapshot())
+    assert set(falls) == {"1", "2"}
+    assert [s["kind"] for s in falls["1"]["spans"]] == ["queue", "compute"]
+    assert falls["1"]["complete"]
+    assert not falls["2"]["complete"]  # no compute span
+
+
+def test_chain_complete_judges_last_span_per_kind():
+    """An attempt orphaned mid-compute (SIGKILLed executor) leaves an
+    open span; the re-dispatched attempt's closed chain IS the complete
+    story."""
+    ctx = trace.new_root(5)
+    q = trace.open_span(ctx, trace.SPAN_QUEUE)
+    trace.close_span(q)
+    trace.open_span(ctx, trace.SPAN_COMPUTE)  # orphaned: never closed
+    time.sleep(0.001)
+    q2 = trace.open_span(ctx, trace.SPAN_QUEUE)  # re-queue
+    trace.close_span(q2)
+    c2 = trace.open_span(ctx, trace.SPAN_COMPUTE)  # survivor attempt
+    trace.close_span(c2)
+    rec = trace.waterfall(flight.snapshot())["5"]
+    assert rec["complete"]
+    # the reverse: last compute OPEN -> incomplete
+    flight.recorder().reset_for_tests()
+    q = trace.open_span(ctx, trace.SPAN_QUEUE)
+    trace.close_span(q)
+    trace.open_span(ctx, trace.SPAN_COMPUTE)
+    rec = trace.waterfall(flight.snapshot())["5"]
+    assert not rec["complete"]
+
+
+def test_waterfall_requires_dispatch_close_when_dispatch_present():
+    ctx = trace.new_root(3)
+    for kind in (trace.SPAN_QUEUE, trace.SPAN_COMPUTE):
+        h = trace.open_span(ctx, kind)
+        trace.close_span(h)
+    trace.open_span(ctx, trace.SPAN_DISPATCH)  # open forever
+    rec = trace.waterfall(flight.snapshot())["3"]
+    assert not rec["complete"]
+
+
+def test_format_waterfall_renders_bars_and_open_marker():
+    ctx = trace.new_root(4)
+    h = trace.open_span(ctx, trace.SPAN_QUEUE)
+    trace.close_span(h)
+    trace.open_span(ctx, trace.SPAN_COMPUTE)
+    rec = trace.waterfall(flight.snapshot())["4"]
+    text = "\n".join(trace.format_waterfall(rec))
+    assert "queue" in text and "compute" in text
+    assert "OPEN" in text  # the un-closed compute span is flagged
+
+
+# ------------------------------------------------------- engine integration
+
+
+@pytest.fixture
+def engine():
+    gov = MemoryGovernor(watchdog_period_s=0.05)
+    eng = ServingEngine(gov=gov, budget=BudgetedResource(gov, 1 << 30),
+                        workers=2, queue_size=16)
+    yield eng
+    eng.shutdown(drain=False, timeout=5)
+    gov.close()
+
+
+def test_served_request_yields_complete_waterfall(engine):
+    engine.register(QueryHandler(name="sum", fn=lambda p, ctx: sum(p),
+                                 nbytes_of=lambda p: 8 * len(p)))
+    s = engine.open_session()
+    resp = engine.submit(s, "sum", list(range(10)))
+    assert resp.result(timeout=30) == 45
+    assert resp.trace is not None and resp.trace.rid == resp.task_id
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:  # span closes land post-_finish
+        rec = trace.waterfall(flight.snapshot()).get(str(resp.task_id))
+        if rec is not None and rec["complete"]:
+            break
+        time.sleep(0.01)
+    assert rec is not None and rec["complete"]
+    kinds = [s["kind"] for s in rec["spans"]]
+    assert kinds.count("queue") == 1 and kinds.count("compute") == 1
+
+
+def test_split_children_keep_parent_rid_lineage(engine):
+    from spark_rapids_jni_tpu.mem.exceptions import SplitAndRetryOOM
+
+    fired = threading.Event()
+
+    def run(p, ctx):
+        if len(p) > 4 and not fired.is_set():
+            fired.set()
+            raise SplitAndRetryOOM("too big")
+        return sum(p)
+
+    engine.register(QueryHandler(
+        name="splitty", fn=run, nbytes_of=lambda p: 8 * len(p),
+        split=lambda p: [p[:len(p) // 2], p[len(p) // 2:]],
+        combine=sum))
+    s = engine.open_session()
+    resp = engine.submit(s, "splitty", list(range(8)))
+    assert resp.result(timeout=30) == 28
+    # every span of the split (parent + both halves) shares ONE rid
+    falls = trace.waterfall(flight.snapshot())
+    rec = falls[str(resp.task_id)]
+    kinds = [s["kind"] for s in rec["spans"]]
+    assert kinds.count("compute") >= 3  # parent attempt + two halves
+    assert rec["complete"]
+
+
+def test_queue_timeout_closes_queue_span(engine):
+    engine.register(QueryHandler(name="slow",
+                                 fn=lambda p, ctx: time.sleep(p) or p))
+    s = engine.open_session()
+    # saturate both workers, then let a third request expire in queue
+    r1 = engine.submit(s, "slow", 0.4)
+    r2 = engine.submit(s, "slow", 0.4)
+    doomed = engine.submit(s, "slow", 0.0, deadline_s=0.05)
+    r1.wait(10), r2.wait(10), doomed.wait(10)
+    if doomed.status != "timed_out":
+        pytest.skip("queue drained before the deadline on this box")
+    rec = trace.waterfall(flight.snapshot())[str(doomed.task_id)]
+    qspans = [s for s in rec["spans"] if s["kind"] == "queue"]
+    assert qspans and all(s["closed"] for s in qspans)

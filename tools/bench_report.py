@@ -1,0 +1,181 @@
+"""bench_report: perf-trajectory diff across BENCH_r*.json snapshots.
+
+The repo keeps one bench snapshot per optimization round (BENCH_r01.json
+..), but nothing ever COMPARES them — a regression lands silently and is
+discovered rounds later when someone re-reads the numbers.  This tool
+diffs the two newest snapshots stage by stage and prints per-stage
+deltas, flagging regressions beyond a threshold.
+
+Wired into ci/run-tests.sh as an ADVISORY step (non-gating: bench
+numbers on shared CI boxes are weather; the report makes the trajectory
+visible at merge time without making the gate flaky).  ``--gate`` turns
+regressions into a non-zero exit for workflows that want to enforce it.
+
+Usage::
+
+    python tools/bench_report.py                    # repo-root snapshots
+    python tools/bench_report.py --dir . --threshold 25
+    python tools/bench_report.py --json             # machine-readable
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["load_stages", "compare", "format_report", "main"]
+
+# per-stage throughput keys, preferred order (higher is better for all)
+_RATE_KEYS = ("Grows_per_s", "Mrows_per_s", "rows_per_s", "GBps")
+
+
+def _stage_rate(stage: dict) -> Optional[Tuple[str, float]]:
+    for k in _RATE_KEYS:
+        v = stage.get(k)
+        if isinstance(v, (int, float)):
+            return k, float(v)
+    return None
+
+
+def load_stages(path: str) -> Dict[str, Tuple[str, float]]:
+    """``{stage: (unit_key, rate)}`` from one BENCH_r*.json snapshot.
+
+    Snapshots store the bench's final JSON line in ``tail`` (older
+    rounds truncate it — ``parsed`` may be null); stages whose record is
+    unparseable or carries no rate key are skipped.
+    """
+    with open(path) as f:
+        rec = json.load(f)
+    detail = None
+    parsed = rec.get("parsed")
+    if isinstance(parsed, dict):
+        detail = parsed.get("detail")
+    if detail is None:
+        tail = rec.get("tail", "")
+        # the tail may hold a truncated JSON line: recover per-stage
+        # records individually instead of demanding one valid document
+        try:
+            doc = json.loads(tail)
+            detail = doc.get("detail", {})
+        except ValueError:
+            detail = {}
+            for m in re.finditer(r'"([A-Za-z0-9_]+)":\s*(\{[^{}]*'
+                                 r'(?:\{[^{}]*\}[^{}]*)*\})', tail):
+                try:
+                    detail[m.group(1)] = json.loads(m.group(2))
+                except ValueError:
+                    continue
+    out: Dict[str, Tuple[str, float]] = {}
+    for name, stage in (detail or {}).items():
+        if not isinstance(stage, dict):
+            continue
+        rate = _stage_rate(stage)
+        if rate is not None:
+            out[name] = rate
+    return out
+
+
+def find_snapshots(bench_dir: str) -> List[str]:
+    """BENCH_r*.json paths in round order (numeric, not lexical)."""
+
+    def round_of(p: str) -> int:
+        m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(p))
+        return int(m.group(1)) if m else -1
+
+    paths = [p for p in glob.glob(os.path.join(bench_dir, "BENCH_r*.json"))
+             if round_of(p) >= 0]
+    return sorted(paths, key=round_of)
+
+
+def compare(prev: Dict[str, Tuple[str, float]],
+            cur: Dict[str, Tuple[str, float]],
+            threshold_pct: float) -> dict:
+    """Stage-by-stage delta; a drop beyond ``threshold_pct`` regresses."""
+    stages = []
+    regressions = []
+    for name in sorted(set(prev) | set(cur)):
+        p, c = prev.get(name), cur.get(name)
+        if p is None or c is None:
+            stages.append({"stage": name, "status": ("added" if p is None
+                                                     else "removed"),
+                           "prev": p and p[1], "cur": c and c[1],
+                           "unit": (c or p)[0]})
+            continue
+        if p[0] != c[0] or p[1] <= 0:
+            stages.append({"stage": name, "status": "incomparable",
+                           "prev": p[1], "cur": c[1], "unit": c[0]})
+            continue
+        delta_pct = 100.0 * (c[1] - p[1]) / p[1]
+        status = "ok"
+        if delta_pct < -threshold_pct:
+            status = "REGRESSION"
+            regressions.append(name)
+        elif delta_pct > threshold_pct:
+            status = "improved"
+        stages.append({"stage": name, "status": status,
+                       "prev": p[1], "cur": c[1], "unit": p[0],
+                       "delta_pct": round(delta_pct, 1)})
+    return {"stages": stages, "regressions": regressions,
+            "threshold_pct": threshold_pct}
+
+
+def format_report(report: dict, prev_path: str, cur_path: str) -> str:
+    out = [f"bench trajectory: {os.path.basename(prev_path)} -> "
+           f"{os.path.basename(cur_path)} "
+           f"(threshold {report['threshold_pct']:g}%)"]
+    out.append(f"  {'stage':<28}{'prev':>12}{'cur':>12}{'delta':>9}  "
+               f"{'unit':<12}status")
+    for s in report["stages"]:
+        prev = "-" if s.get("prev") is None else f"{s['prev']:.3g}"
+        cur = "-" if s.get("cur") is None else f"{s['cur']:.3g}"
+        delta = (f"{s['delta_pct']:+.1f}%" if "delta_pct" in s else "")
+        out.append(f"  {s['stage']:<28}{prev:>12}{cur:>12}{delta:>9}  "
+                   f"{s['unit']:<12}{s['status']}")
+    if report["regressions"]:
+        out.append(f"  REGRESSED ({len(report['regressions'])}): "
+                   f"{', '.join(report['regressions'])}")
+    else:
+        out.append("  no regressions beyond threshold")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff the two newest BENCH_r*.json snapshots and "
+                    "flag per-stage throughput regressions")
+    ap.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)")
+    ap.add_argument("--threshold", type=float, default=20.0,
+                    help="regression threshold in percent (default 20)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the comparison as JSON")
+    ap.add_argument("--gate", action="store_true",
+                    help="exit non-zero on regressions (default: "
+                         "advisory — report and exit 0)")
+    args = ap.parse_args(argv)
+    snaps = find_snapshots(args.dir)
+    if len(snaps) < 2:
+        print(f"bench_report: need >= 2 snapshots under {args.dir}, "
+              f"found {len(snaps)} — nothing to compare")
+        return 0
+    prev_path, cur_path = snaps[-2], snaps[-1]
+    report = compare(load_stages(prev_path), load_stages(cur_path),
+                     args.threshold)
+    report["prev"] = os.path.basename(prev_path)
+    report["cur"] = os.path.basename(cur_path)
+    if args.json:
+        json.dump(report, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        print(format_report(report, prev_path, cur_path))
+    return 1 if (args.gate and report["regressions"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

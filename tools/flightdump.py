@@ -13,13 +13,24 @@ Usage::
     python tools/flightdump.py dump.json --task 7
     python tools/flightdump.py dump.json --json   # reconstructed, machine-readable
     python tools/flightdump.py dump_dir/ --cluster   # cross-process merge
+    python tools/flightdump.py 127.0.0.1:43210 --live   # the LIVE timeline
+    python tools/flightdump.py dump_dir/ --cluster --waterfall  # span bars
 
 ``--cluster`` reads EVERY dump in a directory (one per process: the
 supervisor's plus each executor worker's, round 10) and merges them into
 one cross-process timeline keyed on the supervisor's request id — lease
 events carry ``rid:<id>`` in their detail on both sides of the pipe, and
 each dump's paired (wall_time_s, t_ns) stamps align per-process monotonic
-clocks onto one wall clock.
+clocks onto one wall clock.  Inputs that fail to parse (a dump truncated
+by a mid-write SIGKILL) are counted and reported in the merge summary,
+never silently skipped.
+
+``--live`` (round 14) reads the SAME shape from a running supervisor's
+telemetry endpoint (serve/telemetry.py; the host:port is in
+``Supervisor.telemetry_endpoint()`` and every BENCH_serve record) — the
+cross-process timeline while the cluster is serving, no anomaly needed.
+``--waterfall`` renders per-request span bars (obs/trace.py) from either
+source.
 """
 
 from __future__ import annotations
@@ -31,6 +42,11 @@ import os
 import re
 import sys
 from typing import Dict, List
+
+# the round-14 --live/--waterfall modes import the package (telemetry
+# client, span reconstruction); make the tool runnable from anywhere
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 _RID_RE = re.compile(r"(?:^|:)rid:(\d+)")
 _SID_RE = re.compile(r"(?:^|:)sid:(\d+)")
@@ -154,12 +170,18 @@ def merge_cluster(dump_dir: str) -> dict:
     events: List[dict] = []
     seen = set()
     pids = set()
+    skipped: List[str] = []
     for path in paths:
         try:
             with open(path) as f:
                 dump = json.load(f)
         except (OSError, ValueError):
-            continue  # a dump truncated by a mid-write kill is expected
+            # a dump truncated by a mid-write kill is expected weather —
+            # but it must be COUNTED, not silently absent: "the merge
+            # looks complete" and "the merge lost a process" are
+            # different incidents
+            skipped.append(os.path.basename(path))
+            continue
         pid = dump.get("pid")
         if pid is None:  # pre-round-10 dump: fall back to the filename
             m = re.search(r"_(\d+)_\d+\.json$", os.path.basename(path))
@@ -190,8 +212,9 @@ def merge_cluster(dump_dir: str) -> dict:
             # retry/ack events carry sid:<shuffle>/part: tokens on both
             # sides of the exchange, keyed here per shuffle
             sids.setdefault(m.group(1), []).append(e)
-    return {"dumps": len(paths), "pids": sorted(pids), "events": events,
-            "rids": rids, "sids": sids}
+    return {"dumps": len(paths), "skipped": len(skipped),
+            "skipped_paths": skipped, "pids": sorted(pids),
+            "events": events, "rids": rids, "sids": sids}
 
 
 def format_cluster(merged: dict, rid: str | None = None) -> str:
@@ -201,6 +224,10 @@ def format_cluster(merged: dict, rid: str | None = None) -> str:
     out = [f"cluster merge: dumps={merged['dumps']} "
            f"pids={merged['pids']} events={len(events)} "
            f"rids={len(merged['rids'])}"]
+    if merged.get("skipped"):
+        out.append(f"  WARNING: {merged['skipped']} input(s) skipped as "
+                   f"corrupt/truncated: "
+                   f"{', '.join(merged.get('skipped_paths', []))}")
     t0 = events[0]["wall_s"] if events else 0.0
     spine = [e for e in events
              if e["kind"] in ("degrade_enter", "degrade_exit",
@@ -231,22 +258,87 @@ def format_cluster(merged: dict, rid: str | None = None) -> str:
     return "\n".join(out)
 
 
+def format_waterfalls(merged: dict, rid: str | None = None,
+                      top: int = 0) -> str:
+    """Per-request span waterfalls (obs/trace.py) from a merged timeline
+    — the queue -> dispatch -> (transport) -> compute phase bars."""
+    from spark_rapids_jni_tpu.obs import trace as _trace
+
+    falls = _trace.waterfall(merged["events"])
+    if not falls:
+        return "no spans in this timeline"
+    items = sorted(falls.items(), key=lambda kv: int(kv[0]))
+    if top:
+        def total_ms(rec):
+            return sum(s["dur_ms"] or 0.0 for s in rec["spans"])
+        items = sorted(items, key=lambda kv: -total_ms(kv[1]))[:top]
+    out = []
+    complete = sum(1 for _, rec in falls.items() if rec["complete"])
+    out.append(f"span waterfalls: rids={len(falls)} "
+               f"complete={complete} "
+               f"multi_pid={sum(1 for r in falls.values() if len(r['pids']) > 1)}")
+    for r, rec in items:
+        if rid is not None and r != rid:
+            continue
+        flag = "" if rec["complete"] else "  [INCOMPLETE]"
+        out.append(f"\nrid {r}  (processes: {rec['pids']}){flag}")
+        out.extend(_trace.format_waterfall(rec))
+    return "\n".join(out)
+
+
+def fetch_live(endpoint: str) -> dict:
+    """Pull the live merged timeline from a supervisor's telemetry
+    endpoint (``host:port``) — the --cluster shape, no dumps needed."""
+    from spark_rapids_jni_tpu.serve.telemetry import fetch_view
+
+    host, _, port = endpoint.rpartition(":")
+    view = fetch_view(host or "127.0.0.1", int(port))
+    if "timeline" not in view:
+        # the endpoint reports view-builder failures in-band: surface
+        # the server's error string, not a KeyError traceback
+        raise SystemExit(
+            f"flightdump: endpoint error: "
+            f"{view.get('error', 'no timeline in view')}")
+    merged = view["timeline"]
+    merged.setdefault("pids", [])
+    merged.setdefault("events", [])
+    merged.setdefault("rids", {})
+    merged.setdefault("sids", {})
+    merged["dumps"] = 0
+    merged["skipped"] = 0
+    merged["view"] = {k: view.get(k) for k in
+                      ("schema", "wall_t", "timeline_stats",
+                       "supervisor", "slo")}
+    return merged
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Reconstruct per-task timelines from a flight-recorder "
                     "anomaly dump")
     ap.add_argument("dump", help="JSON artifact written on anomaly "
-                                 "(flight_dump_dir config flag), or a "
-                                 "directory of them with --cluster")
+                                 "(flight_dump_dir config flag), a "
+                                 "directory of them with --cluster, or a "
+                                 "host:port telemetry endpoint with --live")
     ap.add_argument("--task", type=int, default=None,
                     help="show only this task's timeline")
     ap.add_argument("--cluster", action="store_true",
                     help="treat the positional as a DIRECTORY of "
                          "per-process dumps and merge them into one "
                          "cross-process timeline keyed on request id")
+    ap.add_argument("--live", action="store_true",
+                    help="treat the positional as a running supervisor's "
+                         "telemetry endpoint (host:port) and read the "
+                         "LIVE cluster timeline from it")
     ap.add_argument("--rid", default=None,
-                    help="with --cluster: show only this request id's "
-                         "cross-process chain")
+                    help="with --cluster/--live: show only this request "
+                         "id's cross-process chain")
+    ap.add_argument("--waterfall", action="store_true",
+                    help="with --cluster/--live: render per-request SPAN "
+                         "waterfalls (queue/dispatch/transport/compute "
+                         "bars, obs/trace.py) instead of event chains")
+    ap.add_argument("--top", type=int, default=0,
+                    help="with --waterfall: only the N slowest requests")
     ap.add_argument("--control", action="store_true",
                     help="show only the admission-control decision ledger "
                          "(control_* events: knob adjustments with "
@@ -255,14 +347,19 @@ def main(argv=None) -> int:
                     help="emit the reconstructed per-task timelines as JSON")
     args = ap.parse_args(argv)
 
-    if args.cluster:
-        merged = merge_cluster(args.dump)
+    if args.cluster or args.live:
+        merged = (fetch_live(args.dump) if args.live
+                  else merge_cluster(args.dump))
         if args.json:
-            json.dump({"dumps": merged["dumps"], "pids": merged["pids"],
+            json.dump({"dumps": merged.get("dumps", 0),
+                       "skipped": merged.get("skipped", 0),
+                       "pids": merged["pids"],
                        "events": merged["events"],
                        "rids": merged["rids"], "sids": merged["sids"]},
                       sys.stdout, indent=1, sort_keys=True)
             sys.stdout.write("\n")
+        elif args.waterfall:
+            print(format_waterfalls(merged, rid=args.rid, top=args.top))
         else:
             print(format_cluster(merged, rid=args.rid))
         return 0

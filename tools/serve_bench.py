@@ -373,6 +373,23 @@ def _cluster_round(args, *, chaos: bool, dump_dir: str = "") -> dict:
     worker_flags = {}
     if dump_dir:
         worker_flags["flight_dump_dir"] = dump_dir
+    # the SLO storm half of the round-14 acceptance: a tight latency
+    # objective armed for the CHAOS round only — the seeded slow faults
+    # and kill-driven redispatch latencies burn it (EV_SLO_BURN -> ladder
+    # reaction), and the post-drain quiet recovers it (EV_SLO_OK).  Short
+    # windows so a CI-sized round spans them.
+    slos = None
+    slo_opts = None
+    if chaos and args.slo:
+        from spark_rapids_jni_tpu.serve.slo import SLO
+
+        slos = [SLO(name="storm", handler="*",
+                    p99_ms=args.slo_p99_ms)]
+        # windows sized to a CI round: the kill storm spans a few
+        # seconds, so the evaluation must see it before the traffic
+        # drains (production windows are minutes — serve_slo_config)
+        slo_opts = {"fast_window_s": 0.75, "slow_window_s": 2.5,
+                    "min_samples": 4}
     sup = Supervisor(
         workers=args.cluster,
         factory="serve_bench:cluster_worker_factory",
@@ -385,6 +402,7 @@ def _cluster_round(args, *, chaos: bool, dump_dir: str = "") -> dict:
         queue_size=args.queue_size,
         default_deadline_s=args.deadline_s,
         lease_hang_s=args.lease_hang_s,
+        slos=slos, slo_opts=slo_opts,
         dump_on_exit=bool(dump_dir))
     sup.register(HandlerSpec(
         "storm",
@@ -456,6 +474,11 @@ def _cluster_round(args, *, chaos: bool, dump_dir: str = "") -> dict:
         time.sleep(0.1)
     wall = time.perf_counter() - t0
     snap = sup.snapshot()
+    # the live-plane half of the round-14 acceptance: BEFORE shutdown,
+    # read the cluster timeline off the telemetry endpoint (exactly what
+    # `flightdump --live` would) and measure span-waterfall completeness
+    # over the requests that completed OK
+    live = _verify_live_timeline(sup)
     if dump_dir:
         _flight.anomaly("cluster_epilogue", detail="supervisor")
     sup.shutdown()
@@ -483,6 +506,68 @@ def _cluster_round(args, *, chaos: bool, dump_dir: str = "") -> dict:
         "ladder": snap["ladder"],
         "final_level": snap["ladder"]["level"],
         "counters": counters,
+        "live": live,
+    }
+
+
+def _verify_live_timeline(sup) -> dict:
+    """Fetch the live timeline from a still-running supervisor and
+    summarize span-waterfall completeness + SLO evidence: the
+    `flightdump --live`-sourced reconstruction the acceptance gates on."""
+    from spark_rapids_jni_tpu.obs import trace as _trace
+    from spark_rapids_jni_tpu.serve.telemetry import fetch_view
+
+    ep = sup.telemetry_endpoint()
+    if ep is None:
+        return {"enabled": False}
+    try:
+        view = fetch_view(*ep)
+    except (OSError, ValueError) as e:
+        return {"enabled": True, "error": repr(e)[:200]}
+    if "timeline" not in view:
+        # the endpoint answers a failing view builder IN-BAND (a
+        # mid-respawn gauge race): report it as a failed gate input,
+        # never crash the bench round
+        return {"enabled": True,
+                "error": str(view.get("error", "no timeline in view"))}
+    events = view["timeline"]["events"]
+    rids = view["timeline"]["rids"]
+    falls = _trace.waterfall(events)
+    done_ok = {r for r, chain in rids.items()
+               if any(e["kind"] == "lease_done"
+                      and str(e.get("detail", "")).endswith(":ok")
+                      for e in chain)}
+    complete_multi = 0
+    incomplete = []
+    for r in done_ok:
+        rec = falls.get(r)
+        if (rec is not None and rec["complete"]
+                and len(rec["pids"]) >= 2):
+            complete_multi += 1
+        else:
+            incomplete.append({
+                "rid": r,
+                "spans": [(s["kind"], bool(s["closed"]), s.get("pid"))
+                          for s in (rec["spans"] if rec else [])],
+            })
+    kinds = {}
+    for e in events:
+        kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+    return {
+        "enabled": True,
+        "endpoint": list(ep),
+        "events": len(events),
+        "pids": len(view["timeline"]["pids"]),
+        "rids_done_ok": len(done_ok),
+        "waterfalls_complete_multi_pid": complete_multi,
+        "waterfall_frac": round(complete_multi / max(1, len(done_ok)), 4),
+        "incomplete_rids": incomplete[:8],
+        "span_opens": kinds.get("span_open", 0),
+        "span_closes": kinds.get("span_close", 0),
+        "slo_burn_events": kinds.get("slo_burn", 0),
+        "slo_ok_events": kinds.get("slo_ok", 0),
+        "telemetry_stats": view.get("timeline_stats"),
+        "slo": view.get("slo"),
     }
 
 
@@ -534,6 +619,24 @@ def _run_cluster(args) -> int:
                               and merged["degrade_exit"] >= 1
                               and merged["rids_done"] >= 1),
     }
+    live = chaos.get("live") or {}
+    if live.get("enabled"):
+        # round 14: the LIVE timeline (telemetry endpoint, no dumps)
+        # must reconstruct complete queue -> dispatch -> compute span
+        # waterfalls spanning >= 2 pids for >= 95% of the requests that
+        # completed OK — under the chaos-kill profile
+        gates["live_spans_reconstruct"] = (
+            live.get("rids_done_ok", 0) >= 1
+            and live.get("waterfall_frac", 0.0) >= 0.95)
+    if args.slo:
+        # the seeded latency storm must drive a burn the ladder reacts
+        # to, and the post-drain quiet must produce the matching
+        # recovery — both ledger-visible (EV_SLO_BURN / EV_SLO_OK in the
+        # live timeline, the ladder transitions in the supervisor ledger)
+        gates["slo_burn_and_recover"] = (
+            live.get("slo_burn_events", 0) >= 1
+            and live.get("slo_ok_events", 0) >= 1
+            and chaos["ladder"]["max_level_seen"] >= 1)
     rec.update({
         "chaos": chaos,
         "p99_bound_ms": round(p99_bound, 3),
@@ -1112,6 +1215,15 @@ def main(argv=None) -> int:
     ap.add_argument("--dump-dir", default="",
                     help="flight-dump directory for the cluster tier "
                          "(default: a fresh temp dir)")
+    ap.add_argument("--slo", action="store_true",
+                    help="with --cluster --chaos-kill: arm a tight "
+                         "service-wide p99 SLO for the chaos round — the "
+                         "latency storm must drive EV_SLO_BURN, a ladder "
+                         "reaction, and an EV_SLO_OK recovery (gated)")
+    ap.add_argument("--slo-p99-ms", type=float, default=30.0,
+                    help="the armed SLO's p99 target; must sit well "
+                         "under the chaos round's fault-inflated "
+                         "latencies so the burn is deterministic")
     args = ap.parse_args(argv)
 
     if args.cluster > 0 and args.chaos_shuffle:

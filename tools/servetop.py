@@ -1,0 +1,231 @@
+"""servetop: a top-style ops console over the live cluster telemetry plane.
+
+Connects to a running supervisor's local telemetry endpoint
+(serve/telemetry.py — ``Supervisor.telemetry_endpoint()``, also printed
+in every BENCH_serve record) and renders a refreshing dashboard:
+
+- cluster header — degradation level, stress EWMA, queue depth, lease
+  table, burning SLOs;
+- WORKERS — per executor process: health, incarnation, pid, in-flight
+  leases, memory/blocked pressure, completed/p99 from its own metrics;
+- HANDLERS — per query class across the cluster: completions,
+  throughput (vs the previous frame), p50/p99;
+- TENANTS — per session: submitted/completed/shed at the front door;
+- SLO — each declared objective's fast/slow burn rate and state;
+- SPANS — waterfalls of the slowest (and still in-flight) requests,
+  reconstructed from the live span stream (obs/trace.py).
+
+Usage::
+
+    python tools/servetop.py 127.0.0.1:43210            # refresh loop
+    python tools/servetop.py 127.0.0.1:43210 --once     # one frame
+    python tools/servetop.py --fixture timeline.json --once   # canned view
+
+``--fixture`` renders a saved endpoint view (JSON) instead of
+connecting — the deterministic path the tier-1 rendering tests drive.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from spark_rapids_jni_tpu.obs import trace as _trace  # noqa: E402
+from spark_rapids_jni_tpu.serve.telemetry import fetch_view  # noqa: E402
+
+__all__ = ["render_frame", "main"]
+
+
+def _bar(frac: float, width: int = 10) -> str:
+    frac = max(0.0, min(1.0, float(frac)))
+    n = int(round(frac * width))
+    return "#" * n + "." * (width - n)
+
+
+def _handler_table(view: dict, prev: Optional[dict],
+                   dt_s: float) -> List[str]:
+    merged: Dict[str, dict] = {}
+    prev_counts: Dict[str, int] = {}
+
+    def fold(dst: Dict[str, dict], wt: dict) -> None:
+        for h, snap in (wt.get("metrics", {}).get("handlers") or {}).items():
+            agg = dst.setdefault(h, {"count": 0, "p50_ms": 0.0,
+                                     "p99_ms": 0.0})
+            agg["count"] += int(snap.get("count", 0))
+            agg["p50_ms"] = max(agg["p50_ms"], float(snap.get("p50_ms", 0)))
+            agg["p99_ms"] = max(agg["p99_ms"], float(snap.get("p99_ms", 0)))
+
+    for wt in (view.get("workers_telemetry") or {}).values():
+        fold(merged, wt)
+    if prev:
+        pm: Dict[str, dict] = {}
+        for wt in (prev.get("workers_telemetry") or {}).values():
+            fold(pm, wt)
+        prev_counts = {h: a["count"] for h, a in pm.items()}
+    if not merged:
+        return ["  (no handler traffic yet)"]
+    out = [f"  {'handler':<18}{'done':>8}{'req/s':>8}"
+           f"{'p50 ms':>9}{'p99 ms':>9}"]
+    for h in sorted(merged):
+        agg = merged[h]
+        rate = ""
+        if prev and dt_s > 0:
+            rate = f"{(agg['count'] - prev_counts.get(h, 0)) / dt_s:.1f}"
+        out.append(f"  {h:<18}{agg['count']:>8}{rate:>8}"
+                   f"{agg['p50_ms']:>9.2f}{agg['p99_ms']:>9.2f}")
+    return out
+
+
+def _tenant_table(view: dict) -> List[str]:
+    sessions = view.get("sessions") or {}
+    if not sessions:
+        return ["  (no tenants yet)"]
+    out = [f"  {'tenant':<22}{'submitted':>10}{'completed':>10}"
+           f"{'timed_out':>10}{'shed':>7}"]
+    rows = sorted(sessions.items(),
+                  key=lambda kv: -kv[1].get("submitted", 0))[:12]
+    for sid, c in rows:
+        out.append(f"  {sid:<22}{c.get('submitted', 0):>10}"
+                   f"{c.get('completed', 0):>10}"
+                   f"{c.get('timed_out', 0):>10}"
+                   f"{c.get('rejected_degraded', 0):>7}")
+    return out
+
+
+def _slo_table(view: dict) -> List[str]:
+    slo = view.get("slo")
+    if not slo:
+        return ["  (no SLOs declared)"]
+    out = [f"  {'objective':<26}{'state':>8}{'fast burn':>11}"
+           f"{'slow burn':>11}"]
+    for o in slo.get("objectives", []):
+        state = "BURN" if o.get("burning") else "ok"
+        out.append(f"  {o['slo'] + ':' + o['objective']:<26}{state:>8}"
+                   f"{o.get('burn_fast', 0.0):>11.2f}"
+                   f"{o.get('burn_slow', 0.0):>11.2f}")
+    return out
+
+
+def _span_section(view: dict, top: int) -> List[str]:
+    events = (view.get("timeline") or {}).get("events", [])
+    falls = _trace.waterfall(events)
+    if not falls:
+        return ["  (no spans yet)"]
+
+    def score(rec):  # in-flight first, then slowest
+        open_spans = any(not s["closed"] for s in rec["spans"])
+        total = sum(s["dur_ms"] or 0.0 for s in rec["spans"])
+        return (0 if open_spans else 1, -total)
+
+    items = sorted(falls.items(), key=lambda kv: score(kv[1]))[:top]
+    complete = sum(1 for rec in falls.values() if rec["complete"])
+    out = [f"  requests traced: {len(falls)}  complete waterfalls: "
+           f"{complete}  cross-process: "
+           f"{sum(1 for r in falls.values() if len(r['pids']) > 1)}"]
+    for rid, rec in items:
+        state = ("in-flight" if any(not s["closed"] for s in rec["spans"])
+                 else "done")
+        out.append(f"  rid {rid} [{state}] pids={rec['pids']}")
+        out.extend("  " + line for line in _trace.format_waterfall(
+            rec, width=40))
+    return out
+
+
+def render_frame(view: dict, *, prev: Optional[dict] = None,
+                 top: int = 3) -> str:
+    """One dashboard frame from an endpoint view (pure: the fixture
+    tests feed canned views and assert on the output)."""
+    sup = view.get("supervisor") or {}
+    ladder = sup.get("ladder") or {}
+    leases = sup.get("leases") or {}
+    workers = sup.get("workers") or {}
+    alive = sum(1 for w in workers.values() if w.get("state") == "alive")
+    dt_s = (float(view.get("wall_t", 0.0)) - float(prev.get("wall_t", 0.0))
+            if prev else 0.0)
+    stress = ladder.get("stress_ewma")
+    burning = sup.get("slo_burning") or []
+    when = time.strftime("%H:%M:%S", time.localtime(
+        view.get("wall_t", time.time())))
+    lines = [
+        f"serve cluster @ {when}"
+        f"   level={ladder.get('level_name', '?')}"
+        f"   stress={_bar(stress or 0.0)} {stress if stress is not None else '-'}"
+        f"   queue={sup.get('queue_depth', 0)}",
+        f"workers {alive}/{len(workers)} alive   leases: "
+        f"{leases.get('completed', 0)}/{leases.get('leases', 0)} done, "
+        f"{leases.get('outstanding', 0)} in flight, "
+        f"{leases.get('redispatched', 0)} redispatched"
+        + (f"   SLO BURNING: {', '.join(burning)}" if burning else ""),
+        "",
+        "WORKERS",
+        f"  {'wid':<5}{'state':<10}{'inc':>4}{'pid':>8}{'inflight':>9}"
+        f"{'mem':>12}{'blocked':>12}",
+    ]
+    for wid in sorted(workers, key=int):
+        w = workers[wid]
+        g = w.get("gauges") or {}
+        lines.append(
+            f"  {wid:<5}{w.get('state', '?'):<10}"
+            f"{w.get('incarnation', 0):>4}{w.get('pid', 0):>8}"
+            f"{w.get('inflight', 0):>9}"
+            f"{_bar(g.get('mem_frac', 0.0)):>12}"
+            f"{_bar(g.get('blocked_frac', 0.0)):>12}")
+    lines += ["", "HANDLERS"] + _handler_table(view, prev, dt_s)
+    lines += ["", "TENANTS"] + _tenant_table(view)
+    lines += ["", "SLO"] + _slo_table(view)
+    lines += ["", "SPANS (slowest / in-flight)"] + _span_section(view, top)
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="top-style console over a serve cluster's live "
+                    "telemetry endpoint")
+    ap.add_argument("endpoint", nargs="?", default=None,
+                    help="supervisor telemetry endpoint (host:port)")
+    ap.add_argument("--fixture", default=None,
+                    help="render a saved endpoint view (JSON file) "
+                         "instead of connecting")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="refresh period in seconds")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (no screen clearing)")
+    ap.add_argument("--top", type=int, default=3,
+                    help="span waterfalls shown in the SPANS section")
+    args = ap.parse_args(argv)
+    if (args.endpoint is None) == (args.fixture is None):
+        ap.error("exactly one of <endpoint> or --fixture is required")
+
+    def get_view() -> dict:
+        if args.fixture:
+            with open(args.fixture) as f:
+                return json.load(f)
+        host, _, port = args.endpoint.rpartition(":")
+        return fetch_view(host or "127.0.0.1", int(port))
+
+    prev = None
+    while True:
+        try:
+            view = get_view()
+        except (OSError, ValueError) as e:
+            print(f"servetop: endpoint unreachable: {e}", file=sys.stderr)
+            return 1
+        frame = render_frame(view, prev=prev, top=args.top)
+        if args.once:
+            print(frame)
+            return 0
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        prev = view
+        time.sleep(max(0.1, args.interval))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
