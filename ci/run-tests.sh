@@ -78,6 +78,19 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu SRT_REEXECED=1 \
     python tools/serve_bench.py --cluster 3 --chaos-shuffle --clients 4 \
     --requests 24 --seed "${SHUFFLE_SEED:-11}"
 
+# governed result-cache tier (round 15): paired cache-off/cache-on
+# supervised rounds over an identical seeded Zipf lookup mix with
+# mid-run table-version bumps, plus the governor-pressure phase — gates
+# on zero lost + bit-identical both rounds (bit-identical == zero stale
+# serves: content differs per version), hit ratio >= 0.6, cache-on
+# >= 5x cache-off on throughput, invalidations reclaiming entries, and
+# injected pressure demoting cache residency (HBM gauges shrink,
+# EV_RCACHE_DEMOTE) without killing the live governed task
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu SRT_REEXECED=1 \
+    python tools/serve_bench.py --cache-storm --clients 16 \
+    --requests 1280 --workers 2 --queue-size 64 \
+    --seed "${CACHE_SEED:-7}"
+
 # continuous ragged batching tier (round 12): paired (micro, ragged)
 # rounds under identical seeded heterogeneous-row-count schedules plus a
 # chaos pair (pressure storm) — gates on ragged winning median rows/s,

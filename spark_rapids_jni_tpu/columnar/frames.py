@@ -40,7 +40,7 @@ import numpy as np
 
 __all__ = [
     "MAGIC", "PREFIX", "FrameError",
-    "FR_FETCH", "FR_DATA", "FR_NACK", "MESSAGE_FIELDS",
+    "FR_FETCH", "FR_DATA", "FR_NACK", "FR_RESULT", "MESSAGE_FIELDS",
     "encode_frame", "decode_frame", "frame_meta",
     "encode_table", "decode_table", "table_nbytes", "table_signature",
     "corrupt_frame", "truncate_frame",
@@ -63,10 +63,23 @@ FR_DATA = "data"     # producer -> consumer: the partition (buffers ride
 FR_NACK = "nack"     # producer -> consumer: can't serve it (reason:
 #                      "not_ready" = keep backing off, "gone" = cleaned
 #                      up or wrong incarnation — wait for a map update)
+FR_RESULT = "rcached"  # result-cache disk tier (plans/rcache.py, round
+#                      15): one cached query result at rest — the same
+#                      CRC-over-payload framing the shuffle transport
+#                      trusts, so a flipped bit in a cold cache file is
+#                      a detected drop-and-recompute, never a wrong
+#                      answer.  kind = table|array|blob; names lists the
+#                      table's columns in buffer order (empty otherwise),
+#                      shapes the original array shapes (buffers ride the
+#                      frame flattened — frame buffers are 1-D), and key
+#                      the FULL cache key's repr: the 32-bit token also
+#                      names the file, so colliding tokens share a path
+#                      and only the full key proves whose result this is
 MESSAGE_FIELDS = {
     FR_FETCH: ("sid", "map_index", "part", "consumer"),
     FR_DATA: ("sid", "map_index", "part", "columns", "rows"),
     FR_NACK: ("sid", "map_index", "part", "reason"),
+    FR_RESULT: ("token", "kind", "names", "shapes", "key"),
 }
 
 

@@ -411,6 +411,47 @@ register("serve_slo_config", "",
          "and (via MSG_PRESSURE slo_frac) every worker's admission "
          "controller, and emits EV_SLO_OK on recovery.  Empty = no SLOs.",
          env="SRT_SERVE_SLO_CONFIG")
+register("serve_result_cache", False,
+         "Governed multi-tier result cache (plans/rcache.py, round 15): "
+         "results keyed on (plan/handler, input-content CRC fingerprint, "
+         "dtype/pow2-bucket signature, named-table versions) are served "
+         "from an HBM -> host RAM -> disk store instead of recomputing.  "
+         "plans/runtime consults it before admission (a hit never enters "
+         "the governed bracket), the serving engine before the handler "
+         "bracket, and the supervisor before dispatch (a hit never costs "
+         "a lease or a pipe crossing).  HBM residency rides the live "
+         "device budget opportunistically (try_acquire + spill-handler "
+         "demotion: a RetryOOM storm squeezes the cache first).  Off "
+         "(default) = rounds 1-14 behavior, every request pays compute.",
+         env="SRT_SERVE_RESULT_CACHE")
+register("serve_result_cache_hbm_bytes", 256 << 20,
+         "Cap on result-cache bytes resident in the HBM tier (the cache "
+         "additionally never takes budget the governor can't spare right "
+         "now, and pressure demotes below this cap).",
+         env="SRT_SERVE_RESULT_CACHE_HBM_BYTES")
+register("serve_result_cache_host_bytes", 1 << 30,
+         "Cap on result-cache bytes resident in host RAM; past it, LRU "
+         "entries demote to the disk tier (serve_result_cache_dir set) "
+         "or evict.", env="SRT_SERVE_RESULT_CACHE_HOST_BYTES")
+register("serve_result_cache_dir", "",
+         "Directory of the result cache's disk tier: demoted entries "
+         "persist as CRC32-framed files (columnar/frames.py FR_RESULT) "
+         "verified on every load — a corrupt file is dropped and the "
+         "query recomputes.  Empty (default) disables the disk tier "
+         "(host-cap overflow evicts instead of demoting).",
+         env="SRT_SERVE_RESULT_CACHE_DIR")
+register("serve_result_cache_entries", 1024,
+         "Most entries the result cache holds across all tiers; past it "
+         "the overall LRU entry is dropped.",
+         env="SRT_SERVE_RESULT_CACHE_ENTRIES")
+register("serve_result_cache_advertise", 16,
+         "Hottest result-cache key tokens each executor worker "
+         "advertises in its heartbeat gauges (serve/rpc.py): the "
+         "supervisor's cached_only degradation level admits submits "
+         "whose key is advertised hot by ANY worker — under overload, "
+         "hot queries keep being served while cold ones shed.  0 "
+         "disables advertisement.",
+         env="SRT_SERVE_RESULT_CACHE_ADVERTISE")
 register("serve_controller_freeze", False,
          "Kill switch for adaptive admission: when set, the controller "
          "immediately resets every knob to its static config value and "

@@ -276,6 +276,18 @@ class BudgetedResource:
                 self.peak = self.used
             return True
 
+    def try_acquire(self, nbytes: int) -> bool:
+        """Opportunistic reservation: reserve ``nbytes`` if they fit RIGHT
+        NOW, else return False — no arbiter bracket, no blocking, no
+        Retry/Split escalation, no spill-handler consultation.  This is
+        how CACHED residency (plans/rcache.py's HBM tier) takes budget:
+        cached bytes must never park a thread or steal from live queries
+        through the retry protocol — they squat on headroom and hand it
+        back the moment pressure calls the spill handlers.  Pair every
+        success with :meth:`release` (which wakes blocked tenants, so a
+        cache demotion is immediately visible to parked live work)."""
+        return self._try_reserve(int(nbytes))
+
     def reset_peak(self) -> int:
         """Return the reservation high-water mark and restart it from the
         current level (per-query peak reporting in the NDS harness)."""

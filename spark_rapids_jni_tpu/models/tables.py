@@ -1,0 +1,117 @@
+"""Named-table version registry: the result cache's invalidation lever.
+
+Query payloads in this repo name their input tables (``store_sales``,
+``catalog`` ... — the scan-table names of every compiled plan).  The
+result cache (plans/rcache.py) fingerprints inputs by CONTENT (a CRC per
+column buffer), which makes stale serves structurally impossible — but
+content digests alone cannot *reclaim* anything: when a client declares
+"table T changed", every cached result computed over T's old content is
+dead weight that only falls out by LRU.  This registry is the missing
+declaration: a process-local monotonic version per table name.
+
+- Fingerprints embed ``version_of(name)`` per dependency, so a
+  :func:`bump` makes every older entry UNREACHABLE (keys can no longer
+  be rebuilt) the instant it returns;
+- registered listeners (the result cache) run synchronously inside
+  ``bump``, so the bumped table's entries are also RECLAIMED — their
+  bytes return to the budget before the next query admits;
+- in cluster serving the supervisor owns bumps
+  (``Supervisor.bump_table``) and broadcasts ``MSG_TABLE_BUMP`` so every
+  executor's registry converges via :func:`advance_to` (versions only
+  move forward; a late broadcast can never roll one back).
+
+Unregistered names read as version 0 — a table nobody ever bumps is
+simply a table whose cache entries live by content digest + LRU alone.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Tuple
+
+from spark_rapids_jni_tpu.obs import flight as _flight
+
+__all__ = ["version_of", "versions_of", "bump", "advance_to",
+           "snapshot", "add_listener", "remove_listener",
+           "reset_for_tests"]
+
+_lock = threading.Lock()
+_versions: Dict[str, int] = {}  # guarded-by: _lock
+# bump listeners: fn(name, new_version), called OUTSIDE the registry
+# lock (a listener that consults versions must not deadlock) but on the
+# bumping thread, so bump() returning means invalidation already ran
+_listeners: List[Callable[[str, int], None]] = []  # guarded-by: _lock
+
+
+def version_of(name: str) -> int:
+    """Current version of ``name`` (0 = never bumped)."""
+    with _lock:
+        return _versions.get(name, 0)
+
+
+def versions_of(names) -> Tuple[Tuple[str, int], ...]:
+    """(name, version) per name, input order — the dependency stamp a
+    result-cache fingerprint embeds."""
+    with _lock:
+        return tuple((n, _versions.get(n, 0)) for n in names)
+
+
+def _notify(name: str, version: int) -> None:
+    with _lock:
+        listeners = list(_listeners)
+    for fn in listeners:
+        fn(name, version)
+
+
+def bump(name: str) -> int:
+    """Advance ``name``'s version by one and run invalidation listeners;
+    returns the new version.  After this returns, no lookup anywhere in
+    this process can serve a result fingerprinted with the old version."""
+    with _lock:
+        v = _versions[name] = _versions.get(name, 0) + 1
+    _flight.record(_flight.EV_RCACHE_INVALIDATE, -1,
+                   detail=f"table:{name}:version:{v}", value=v)
+    _notify(name, v)
+    return v
+
+
+def advance_to(name: str, version: int) -> int:
+    """Converge ``name`` to at least ``version`` (cross-process bump
+    broadcasts).  Monotonic: a stale broadcast is a no-op.  Listeners run
+    only when the version actually moved."""
+    with _lock:
+        cur = _versions.get(name, 0)
+        if version <= cur:
+            return cur
+        _versions[name] = version
+    _flight.record(_flight.EV_RCACHE_INVALIDATE, -1,
+                   detail=f"table:{name}:version:{version}:broadcast",
+                   value=version)
+    _notify(name, version)
+    return version
+
+
+def snapshot() -> Dict[str, int]:
+    with _lock:
+        return dict(_versions)
+
+
+def add_listener(fn: Callable[[str, int], None]) -> None:
+    with _lock:
+        if fn not in _listeners:
+            _listeners.append(fn)
+
+
+def remove_listener(fn: Callable[[str, int], None]) -> None:
+    with _lock:
+        if fn in _listeners:
+            _listeners.remove(fn)
+
+
+def reset_for_tests() -> None:
+    with _lock:
+        _versions.clear()
+        _listeners.clear()
+
+
+_flight.register_telemetry_source("table_versions", snapshot)

@@ -62,6 +62,8 @@ __all__ = [
     "EV_SHUFFLE_ACK",
     "EV_SPAN_OPEN", "EV_SPAN_CLOSE", "EV_SLO_BURN", "EV_SLO_OK",
     "EV_TELEMETRY_EXPORT", "EV_TELEMETRY_DROP",
+    "EV_RCACHE_HIT", "EV_RCACHE_STORE", "EV_RCACHE_DEMOTE",
+    "EV_RCACHE_EVICT", "EV_RCACHE_INVALIDATE",
     "EVENT_KINDS", "EVENT_PAIRS", "KIND_IDS", "DUMP_SCHEMA",
     "FlightRecorder", "record", "anomaly", "snapshot", "snapshot_since",
     "task_stats",
@@ -171,6 +173,32 @@ EV_TELEMETRY_DROP = "telemetry_drop"    # an export was skipped (stalled
 #                                        supervisor pipe) or trimmed
 #                                        (delta over the cap) — the
 #                                        worker NEVER blocks on export
+# the governed multi-tier result cache (round 15, plans/rcache.py +
+# models/tables.py): every hit/store, every residency move down the
+# HBM -> host -> disk ladder, and every table-version invalidation
+# narrates into the ring, so "why did this query skip compute" and
+# "where did the cache's bytes go under pressure" reconstruct from the
+# same artifact as the retry storm that squeezed them
+EV_RCACHE_HIT = "rcache_hit"            # result served from the cache
+#                                        (detail=[rid:<r>:]handler:<h>:
+#                                        tier:<hbm|host|disk>:key:<tok>,
+#                                        value=result bytes)
+EV_RCACHE_STORE = "rcache_store"        # result inserted (detail=
+#                                        handler:<h>:tier:<t>:key:<tok>,
+#                                        value=result bytes)
+EV_RCACHE_DEMOTE = "rcache_demote"      # entry moved down one tier
+#                                        (detail=key:<tok>:<from>-><to>:
+#                                        reason:<pressure|cap>,
+#                                        value=bytes moved)
+EV_RCACHE_EVICT = "rcache_evict"        # entry dropped entirely (detail=
+#                                        key:<tok>:tier:<t>:reason:
+#                                        <cap|corrupt|stale>, value=bytes)
+EV_RCACHE_INVALIDATE = "rcache_invalidate"  # a table-version bump made
+#                                        entries unreachable (detail=
+#                                        table:<name>:version:<v>,
+#                                        value=new version; emitted by
+#                                        models/tables.py per bump and
+#                                        by the cache per reclaimed key)
 
 # Paired kinds: a layer that emits the left side of a pair must also emit
 # the right side (module-granular balance, enforced by the analyze gate's
@@ -208,6 +236,9 @@ EVENT_KINDS = (
     # round 14: appended for the same reason
     EV_SPAN_OPEN, EV_SPAN_CLOSE, EV_SLO_BURN, EV_SLO_OK,
     EV_TELEMETRY_EXPORT, EV_TELEMETRY_DROP,
+    # round 15: appended for the same reason
+    EV_RCACHE_HIT, EV_RCACHE_STORE, EV_RCACHE_DEMOTE,
+    EV_RCACHE_EVICT, EV_RCACHE_INVALIDATE,
 )
 KIND_IDS = {k: i for i, k in enumerate(EVENT_KINDS)}
 

@@ -48,7 +48,7 @@ from spark_rapids_jni_tpu.obs import flight as _flight
 
 __all__ = [
     "SPAN_QUEUE", "SPAN_DISPATCH", "SPAN_TRANSPORT", "SPAN_COMPUTE",
-    "SPAN_SCATTER", "SPAN_KINDS",
+    "SPAN_SCATTER", "SPAN_CACHE", "SPAN_KINDS",
     "TraceContext", "new_root", "child_of", "to_wire", "from_wire",
     "open_span", "close_span", "span", "maybe_span",
     "push_current", "pop_current", "current",
@@ -61,8 +61,12 @@ SPAN_DISPATCH = "dispatch"    # supervisor lease outstanding on one worker
 SPAN_TRANSPORT = "transport"  # shuffle partition fetch (consumer side)
 SPAN_COMPUTE = "compute"      # governed handler execution on a worker
 SPAN_SCATTER = "scatter"      # batch/ragged result redistribution
+SPAN_CACHE = "cache_hit"      # result served from the result cache
+#                               (plans/rcache.py round 15): the request
+#                               skipped dispatch/compute entirely, so a
+#                               hit's waterfall is queue -> cache_hit
 SPAN_KINDS = (SPAN_QUEUE, SPAN_DISPATCH, SPAN_TRANSPORT, SPAN_COMPUTE,
-              SPAN_SCATTER)
+              SPAN_SCATTER, SPAN_CACHE)
 
 # span ids are (pid | counter) packed so concurrently-opened spans across
 # executor processes never collide in a merged timeline; 20 pid bits
@@ -299,9 +303,14 @@ def chain_complete(rec: dict, *, require_dispatch: bool = False) -> bool:
     last: Dict[str, dict] = {}
     for s in rec["spans"]:  # spans are sorted by (t0, emission order)
         last[s["kind"]] = s
-    need = {SPAN_QUEUE, SPAN_COMPUTE}
-    if require_dispatch or SPAN_DISPATCH in last:
-        need.add(SPAN_DISPATCH)
+    if SPAN_CACHE in last and SPAN_COMPUTE not in last:
+        # a result-cache hit never dispatched or computed: its complete
+        # story is queue -> cache_hit (the round-15 short-circuit shape)
+        need = {SPAN_QUEUE, SPAN_CACHE}
+    else:
+        need = {SPAN_QUEUE, SPAN_COMPUTE}
+        if require_dispatch or SPAN_DISPATCH in last:
+            need.add(SPAN_DISPATCH)
     return all(k in last and last[k]["closed"] for k in need)
 
 

@@ -9,7 +9,11 @@
   dtype signature, pow2 batch bucket), gauges through serve/metrics and
   obs/flight;
 - :mod:`plans.runtime` — the governed bracket at plan granularity (one
-  admission, one retry/split boundary, one flight task per plan).
+  admission, one retry/split boundary, one flight task per plan);
+- :mod:`plans.rcache` — the governed multi-tier RESULT cache (round 15):
+  hot queries skip compute entirely, keyed on (plan/handler, input
+  content fingerprint, bucket signature, table versions), resident
+  HBM -> host -> disk under the same byte budgets as live queries.
 """
 
 from spark_rapids_jni_tpu.plans import ir
@@ -23,6 +27,7 @@ from spark_rapids_jni_tpu.plans.compiler import (
     input_signature,
     output_names,
 )
+from spark_rapids_jni_tpu.plans.rcache import ResultCache, result_cache
 from spark_rapids_jni_tpu.plans.runtime import (
     combine_outputs,
     execute_plan,
@@ -36,7 +41,9 @@ __all__ = [
     "ir",
     "CompiledPlan",
     "RaggedProgram",
+    "ResultCache",
     "plan_cache",
+    "result_cache",
     "cached_compile",
     "cached_ragged_compile",
     "compile_plan",

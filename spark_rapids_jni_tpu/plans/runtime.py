@@ -377,6 +377,7 @@ def run_governed_plan(
     disband into per-op launches), and partial outputs combine by
     addition.  One flight-recorder task spans the plan.
     """
+    from spark_rapids_jni_tpu import config
     from spark_rapids_jni_tpu.mem.governed import (
         default_device_budget,
         run_with_split_retry,
@@ -391,6 +392,24 @@ def run_governed_plan(
         dp = mesh.shape[DATA_AXIS]
     if budget is None:
         budget = default_device_budget()
+    # the result cache consults BEFORE admission (round 15): a hit costs
+    # a fingerprint pass over the raw host tables — never a reservation,
+    # a retry bracket, or a launch.  Fingerprinted here, before the dim
+    # upload below moves anything to the device.
+    ckey = cdeps = None
+    if config.get("serve_result_cache"):
+        from spark_rapids_jni_tpu.obs import trace as _trace
+        from spark_rapids_jni_tpu.plans.rcache import (
+            plan_result_key,
+            result_cache,
+        )
+
+        ckey, cdeps = plan_result_key(plan, dp, tables)
+        hit = result_cache.lookup(ckey)
+        if hit is not None:
+            with _trace.maybe_span(_trace.SPAN_CACHE,
+                                   extra=f"plan:{plan.name}"):
+                return hit
     scans = ir.scan_tables(plan)
     tables = _upload_dims(plan, tables, mesh)
 
@@ -432,4 +451,12 @@ def run_governed_plan(
             on_retry=on_retry,
         )
     _note_plan_run(plan.name, presplit, inline_splits[0], max_split_depth)
+    if ckey is not None:
+        from spark_rapids_jni_tpu.plans.rcache import result_cache
+
+        # put() revalidates cdeps against the live version registry: a
+        # table bumped while this plan computed drops the insert — the
+        # result is correct for the OLD content, which no future key
+        # can (or should) name
+        result_cache.put(ckey, out, cdeps, label=plan.name)
     return out

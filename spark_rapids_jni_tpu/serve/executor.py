@@ -135,6 +135,16 @@ class QueryHandler:
       pack into the fixed-size page pool and ride ONE fused launch per
       tick (used only when the engine's ``serve_ragged`` flag is on; the
       micro-batch hooks above stay the flag-off oracle);
+    - ``cache_key``/``cache_tables``: opt the handler into the governed
+      result cache (plans/rcache.py, round 15; engine flag
+      ``serve_result_cache``).  ``cache_key(payload)`` returns a
+      hashable payload identity (embed ``rcache.array_digest`` for any
+      data the payload ships — equal keys must imply bit-equal inputs)
+      or None for "this payload is uncacheable"; ``cache_tables`` is the
+      named-table dependency set (a static sequence or
+      ``fn(payload) -> names``) whose versions ride the fingerprint, so
+      a ``models/tables.bump`` makes stale entries unreachable.  A hit
+      never enters the governed bracket;
     - ``self_governed``: fn drives its own admission (the models/ runners,
       which internally run run_with_split_retry) — the executor supplies
       only the task context and skips its own reservation bracket.
@@ -149,6 +159,8 @@ class QueryHandler:
     batch: Optional[Callable[[List[Any]], Any]] = None
     unbatch: Optional[Callable[[Any, List[Any]], List[Any]]] = None
     ragged: Any = None  # Optional[serve.ragged.RaggedSpec]
+    cache_key: Optional[Callable[[Any], Any]] = None
+    cache_tables: Any = ()  # Sequence[str] | Callable[[Any], Sequence]
     self_governed: bool = False
     max_batch: int = 8
     max_grows: int = 8
@@ -241,6 +253,16 @@ class ServingEngine:
             from spark_rapids_jni_tpu.serve.ragged import RaggedDispatcher
 
             self._ragged = RaggedDispatcher(self)
+        # the governed result cache (plans/rcache.py, round 15): hits
+        # short-circuit before the handler bracket.  Binding the engine's
+        # budget gives the HBM tier its byte source AND registers the
+        # pressure demoter — cached residency competes under the SAME
+        # budget live queries admit through.
+        self._rcache_on = bool(config.get("serve_result_cache"))
+        if self._rcache_on:
+            from spark_rapids_jni_tpu.plans.rcache import result_cache
+
+            result_cache.bind_budget(self.budget)
         if micro_batch_max <= 1 and not serve_ragged:
             # a silent no-batching configuration is the misconfiguration
             # the batch-miss observability exists to surface: warn once
@@ -494,6 +516,19 @@ class ServingEngine:
         # engine can batch at all (see _warn_batching_disabled)
         g["micro_batch_disabled"] = int(
             self.micro_batch_max <= 1 and not self.serve_ragged)
+        if self._rcache_on:
+            from spark_rapids_jni_tpu.plans.rcache import result_cache
+
+            # the result cache's residency + flow as gauges: per-tier
+            # bytes/entries beside the hit/miss counters, so one snapshot
+            # answers "is the cache earning its bytes under this budget"
+            rs = result_cache.stats()
+            for k in ("entries", "hbm_bytes", "host_bytes", "disk_bytes",
+                      "hbm_entries", "host_entries", "disk_entries",
+                      "hits", "misses", "stores", "evictions",
+                      "demotes_hbm_host", "demotes_host_disk",
+                      "invalidated", "stale_puts", "corrupt_drops"):
+                g[f"rcache_{k}"] = int(rs[k])
         if self._ragged is not None:
             from spark_rapids_jni_tpu.columnar.pages import page_pool
 
@@ -784,6 +819,11 @@ class ServingEngine:
         # analyze: ignore[guarded-by] - same lock-free registration-dict
         # read as submit(): GIL-atomic on a startup-only-growing dict
         h = self._handlers[req.handler]
+        if (self._rcache_on and h.cache_key is not None
+                and req.join is None and req.split_depth == 0):
+            served = self._rcache_consult(req, h)
+            if served:
+                return [req]
         if (req.split_depth == 0 and req.join is None
                 and h.split is not None and not h.self_governed):
             depth = self.presplit_depth(req.handler)
@@ -831,6 +871,57 @@ class ServingEngine:
                 _trace.pop_current()
             for cs in cspans:
                 _trace.close_span(cs)
+
+    def _rcache_consult(self, req: Request, h: QueryHandler) -> bool:
+        """Result-cache read path of one cacheable top-level request:
+        True = served from cache (terminal, no bracket, no launch).  On
+        miss the key is stamped onto the request so the completion path
+        stores the computed result under the same fingerprint."""
+        from spark_rapids_jni_tpu.plans.rcache import (
+            request_key,
+            result_cache,
+        )
+
+        pk = h.cache_key(req.payload)
+        if pk is None:
+            return False
+        names = (h.cache_tables(req.payload) if callable(h.cache_tables)
+                 else h.cache_tables)
+        key, deps = request_key(h.name, pk, names)
+        t0_ns = time.monotonic_ns()
+        # no rid= here: engine task ids are NOT supervisor lease ids,
+        # and a bare rid: token would collide in cluster merges — the
+        # cache span opened below carries the trace's rid lineage
+        hit = result_cache.lookup(key)
+        if hit is None:
+            self.metrics.count("rcache_misses", req.session_id)
+            req.rcache_key, req.rcache_deps = key, deps
+            return False
+        now_ns = time.monotonic_ns()
+        if req.response.admitted_ns == 0:
+            req.response.admitted_ns = now_ns
+            self.metrics.count("admitted", req.session_id)
+            self.metrics.record_wait(now_ns - req.response.submitted_ns)
+        self.metrics.count("rcache_hits", req.session_id)
+        # hits land in the handler latency histograms too: the SLO and
+        # dashboard view of this class's p50/p99 must reflect that the
+        # hot tail stopped paying compute
+        self.metrics.record_run(now_ns - t0_ns, handler=h.name)
+        with _trace.span(req.trace, _trace.SPAN_CACHE,
+                         task_id=req.task_id,
+                         extra=f"handler:{h.name}"):
+            self._finish(req, OK, value=hit)
+        return True
+
+    def _rcache_store(self, req: Request, h: QueryHandler,
+                      result: Any) -> None:
+        if req.rcache_key is None:
+            return
+        from spark_rapids_jni_tpu.plans.rcache import result_cache
+
+        if result_cache.put(req.rcache_key, result, req.rcache_deps,
+                            label=h.name):
+            self.metrics.count("rcache_stores", req.session_id)
 
     def _serve_attempt(self, req: Request, h: QueryHandler,
                        group: List[Request]) -> List[Request]:
@@ -935,6 +1026,7 @@ class ServingEngine:
                 return self._unbatch_finish(req, h, group, result, run_ns)
         else:
             self.metrics.record_run(run_ns, handler=h.name)
+            self._rcache_store(req, h, result)
             self._finish(req, OK, value=result)
         return group
 

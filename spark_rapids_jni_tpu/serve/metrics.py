@@ -109,6 +109,13 @@ COUNTERS = (
     "ragged_rows",      # real rows packed across all launches
     "ragged_row_capacity",  # pool row capacity across all launches
     "ragged_splits",    # SplitAndRetryOOM page-count halvings
+    # the governed result cache (plans/rcache.py, round 15) as THIS
+    # serving tier saw it: hits short-circuit before the governed
+    # bracket (engine) or before dispatch (supervisor); per-tier byte/
+    # entry gauges ride the gauge source (rcache_* in snapshots)
+    "rcache_hits",      # requests served from the result cache
+    "rcache_misses",    # cacheable requests that paid compute
+    "rcache_stores",    # computed results inserted into the cache
 )
 
 # why a request did NOT merge into a batch (micro or ragged gather) —

@@ -158,6 +158,13 @@ class Request:
     trace: Any = None            # Optional[obs.trace.TraceContext]
     qspan: Any = None            # open queue-wait SpanHandle (or None)
     dspan: Any = None            # open dispatch SpanHandle (supervisor)
+    # result-cache lineage (plans/rcache.py, round 15): the key this
+    # request missed on at admission, stamped so the completion path
+    # stores the computed result under the SAME (content, version)
+    # fingerprint the miss was judged on — put() revalidates rcache_deps
+    # against the live registry, closing the bump-mid-flight window
+    rcache_key: Any = None
+    rcache_deps: Any = None
 
     def __post_init__(self):
         self.response.task_id = self.task_id

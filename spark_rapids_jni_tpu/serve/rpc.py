@@ -42,7 +42,7 @@ __all__ = [
     "MSG_HELLO", "MSG_BEAT", "MSG_DISPATCH", "MSG_RESULT", "MSG_SHUTDOWN",
     "MSG_SHUFFLE_PRODUCED", "MSG_SHUFFLE_ACK", "MSG_SHUFFLE_MAP",
     "MSG_SHUFFLE_CLEANUP", "MSG_PRESSURE", "MSG_TELEMETRY",
-    "MESSAGE_FIELDS",
+    "MSG_TABLE_BUMP", "MESSAGE_FIELDS",
     "SafeConn", "resolve_factory", "executor_worker_main",
     "set_shuffle_sink", "shuffle_uplink",
 ]
@@ -70,6 +70,13 @@ MSG_PRESSURE = "pressure"
 # --live).  An undeliverable export is SKIPPED, never blocked on — the
 # same discipline as the round-13 heartbeat fix.
 MSG_TELEMETRY = "telemetry"
+# the governed result cache's invalidation plane (round 15,
+# plans/rcache.py + models/tables.py): the supervisor owns table-version
+# bumps (Supervisor.bump_table) and broadcasts the new version so every
+# executor's local registry — and therefore its result-cache keys —
+# converges.  Monotonic on the receiving side (tables.advance_to): late
+# or duplicate broadcasts are no-ops, never rollbacks.
+MSG_TABLE_BUMP = "table_bump"
 
 # The declared wire schema: tag -> field names after the tag.  BOTH sides
 # of the pipe are checked against this table at merge time (ci/analyze
@@ -112,6 +119,9 @@ MESSAGE_FIELDS = {
     # process's monotonic event times onto the cluster's wall clock
     MSG_TELEMETRY: ("worker_id", "incarnation", "wall_t", "t_ns",
                     "events", "metrics"),
+    # supervisor -> workers: table `name` is now at `version` — advance
+    # the local registry (reclaiming dependent result-cache entries)
+    MSG_TABLE_BUMP: ("name", "version"),
 }
 
 # RESULT statuses mirror serve.queue terminal states, plus the one
@@ -328,6 +338,9 @@ def executor_worker_main(worker_id: int, incarnation: int, conn,
         # sleep-and-hope between waiter and serving threads)
         engine.on_served = lambda: exporter.export(sconn.send, force=True)
 
+    rcache_on = bool(config.get("serve_result_cache"))
+    rcache_hot_n = int(config.get("serve_result_cache_advertise"))
+
     def heartbeat() -> None:
         period = float(config.get("serve_heartbeat_s"))
         nworkers = max(1, len(engine._workers))
@@ -346,6 +359,22 @@ def executor_worker_main(worker_id: int, incarnation: int, conn,
                 "queue_depth": engine.queue.depth(),
                 "outstanding": engine.queue.outstanding(),
             }
+            if rcache_on:
+                from spark_rapids_jni_tpu.plans.rcache import result_cache
+
+                # key advertisement (round 15): the hottest resident
+                # tokens ride the beat so the router knows which submits
+                # will hit SOMEWHERE — the cached_only ladder level
+                # admits exactly those.  Per-tier residency rides along
+                # for servetop's per-worker CACHE column.
+                rs = result_cache.stats()
+                gauges["rcache"] = {
+                    k: rs[k] for k in
+                    ("entries", "hbm_bytes", "host_bytes", "disk_bytes",
+                     "hits", "misses", "hit_ratio")}
+                if rcache_hot_n > 0:
+                    gauges["rcache_hot"] = result_cache.hot_tokens(
+                        rcache_hot_n)
             if not sconn.send((MSG_BEAT, worker_id, incarnation,
                                time.time(), gauges)):
                 # undeliverable beat: the pipe may be CLOSED (supervisor
@@ -402,6 +431,15 @@ def executor_worker_main(worker_id: int, incarnation: int, conn,
                 continue
             if tag == MSG_SHUFFLE_MAP or tag == MSG_SHUFFLE_CLEANUP:
                 _route_shuffle_msg(msg)
+                continue
+            if tag == MSG_TABLE_BUMP:
+                # lazy: workers that never see a bump never import the
+                # models package.  advance_to runs the result cache's
+                # invalidation listener synchronously on this thread, so
+                # by the next dispatch the stale entries are gone.
+                from spark_rapids_jni_tpu.models import tables as _tables
+
+                _tables.advance_to(msg[1], msg[2])
                 continue
             if tag != MSG_DISPATCH:
                 continue

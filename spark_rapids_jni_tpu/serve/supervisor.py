@@ -133,16 +133,27 @@ class HandlerSpec:
     (``split``/``combine``, up to ``fanout`` pieces), and classify it for
     the cached-only degradation level (``cacheable`` marks classes whose
     compiled plans are expected resident; otherwise a class becomes
-    "warm" after its first completed request)."""
+    "warm" after its first completed request).
+
+    ``cache_key``/``cache_tables`` (round 15) opt the class into the
+    governed RESULT cache — same contract as
+    :class:`~spark_rapids_jni_tpu.serve.executor.QueryHandler`:
+    ``cache_key(payload)`` returns a hashable identity embedding a
+    content digest (or None = uncacheable payload), ``cache_tables`` the
+    named-table dependencies.  The supervisor then short-circuits hits
+    BEFORE dispatch — a hit never costs a lease or a pipe crossing — and
+    stores each OK result it routes."""
 
     __slots__ = ("name", "nbytes_of", "split", "combine", "cacheable",
-                 "fanout")
+                 "fanout", "cache_key", "cache_tables")
 
     def __init__(self, name: str,
                  nbytes_of: Callable[[Any], int] = lambda p: 0,
                  split: Optional[Callable[[Any], Sequence[Any]]] = None,
                  combine: Optional[Callable[[List[Any]], Any]] = None,
-                 cacheable: bool = False, fanout: int = 1):
+                 cacheable: bool = False, fanout: int = 1,
+                 cache_key: Optional[Callable[[Any], Any]] = None,
+                 cache_tables: Any = ()):
         if (split is None) != (combine is None):
             raise ValueError("split and combine must be provided together")
         if fanout > 1 and split is None:
@@ -153,6 +164,8 @@ class HandlerSpec:
         self.combine = combine
         self.cacheable = cacheable
         self.fanout = int(fanout)
+        self.cache_key = cache_key
+        self.cache_tables = cache_tables
 
 
 class ShuffleSpec(HandlerSpec):
@@ -352,6 +365,13 @@ class Supervisor:
         self._telemetry_name = f"supervisor:{id(self):x}"
         _flight.register_telemetry_source(self._telemetry_name,
                                           self.snapshot)
+        # the governed result cache (plans/rcache.py, round 15): the
+        # supervisor keeps its own process-global store (host/disk tiers
+        # — no governed compute runs here, so no budget binds) and
+        # short-circuits hits before dispatch.  Workers advertise their
+        # hottest key tokens in heartbeat gauges; the cached_only
+        # degradation level admits submits whose key is hot ANYWHERE.
+        self._rcache_on = bool(config.get("serve_result_cache"))
         # the live telemetry plane (round 14, serve/telemetry.py): the
         # bounded cluster timeline every worker's MSG_TELEMETRY deltas
         # (and this process's own ring) merge into, served over a local
@@ -429,7 +449,20 @@ class Supervisor:
         if spec is None:
             raise KeyError(f"no handler {handler!r} registered")
         prio = priority if priority is not None else session.priority
-        self._gate(session, spec, prio)
+        # the result-cache read path runs BEFORE the degradation gate:
+        # a hit is served work, not shed work — it costs no lease, no
+        # pipe crossing, no worker capacity, so even a ladder at
+        # `reject` serves it (that is what cached_only DEGRADES TO:
+        # under overload the hot tail keeps answering from memory while
+        # cold queries shed).  A hit must therefore never touch
+        # Session.note_degraded or the rejected_degraded counter.
+        ckey = cdeps = ctoken = None
+        if self._rcache_on and spec.cache_key is not None:
+            ckey, cdeps, ctoken, resp = self._rcache_submit(
+                session, spec, payload)
+            if resp is not None:
+                return resp
+        self._gate(session, spec, prio, hot_token=ctoken)
         nbytes = int(spec.nbytes_of(payload))
         try:
             session.charge(nbytes)
@@ -449,6 +482,9 @@ class Supervisor:
         )
         req.charge_bytes = nbytes
         req.session = session
+        req.rcache_key, req.rcache_deps = ckey, cdeps  # miss: store on OK
+        if ckey is not None:
+            self.metrics.count("rcache_misses", session.session_id)
         # opened BEFORE the request becomes poppable (engine.submit twin):
         # the dispatcher may grant — and close this span — the instant
         # submit returns
@@ -473,18 +509,82 @@ class Supervisor:
         self.metrics.count("submitted", session.session_id)
         return req.response
 
+    def _rcache_submit(self, session: Session, spec: HandlerSpec,
+                       payload: Any):
+        """Result-cache short-circuit of one submit.  Returns
+        ``(key, deps, token, response)``: response is non-None on a hit
+        (already terminal — the caller returns it without gating,
+        queueing, or leasing); on a miss key/deps ride the request so
+        ``_on_result`` stores the computed value, and token feeds the
+        cached_only gate's advertised-hot check."""
+        from spark_rapids_jni_tpu.plans.rcache import (
+            key_token,
+            request_key,
+            result_cache,
+        )
+
+        pk = spec.cache_key(payload)
+        if pk is None:
+            return None, None, None, None
+        names = (spec.cache_tables(payload)
+                 if callable(spec.cache_tables) else spec.cache_tables)
+        key, deps = request_key(spec.name, pk, names)
+        tid = self.sessions.next_task_id()
+        t0_ns = time.monotonic_ns()
+        hit = result_cache.lookup(key, rid=tid)
+        if hit is None:
+            return key, deps, key_token(key), None
+        req = Request(
+            handler=spec.name, payload=None, session_id=session.session_id,
+            priority=session.priority, deadline=None, seq=next(self._seq),
+            task_id=tid,
+            trace=_trace.new_root(tid) if self._spans_on else None,
+        )
+        # the waterfall of a hit: queue (instantaneous — the request was
+        # never poppable) -> cache_hit, no dispatch, no compute
+        req.qspan = _trace.open_span(req.trace, _trace.SPAN_QUEUE,
+                                     task_id=tid,
+                                     extra=f"handler:{spec.name}")
+        _trace.close_span(req.qspan)
+        req.qspan = None
+        self.metrics.count("submitted", session.session_id)
+        self.metrics.count("rcache_hits", session.session_id)
+        # end-to-end latency as the SLO engine sees it: a hit IS a
+        # served request, and its near-zero submit->result belongs in
+        # the same per-handler distribution the burn rates evaluate
+        self.metrics.record_run(time.monotonic_ns() - t0_ns,
+                                handler=spec.name)
+        with _trace.span(req.trace, _trace.SPAN_CACHE, task_id=tid,
+                         extra=f"handler:{spec.name}"):
+            self._finish(req, OK, value=hit)
+        return key, deps, None, req.response
+
+    def _advertised_hot_locked(self, token: str) -> bool:
+        """(Caller holds ``self._lock``.)  True when any live worker's
+        heartbeat advertised ``token`` among its hottest cache keys."""
+        return any(token in (h.gauges.get("rcache_hot") or ())
+                   for h in self._handles.values()
+                   if h.health == _ALIVE)
+
     def _gate(self, session: Session, spec: HandlerSpec,
-              priority: int) -> None:
+              priority: int, hot_token: Optional[str] = None) -> None:
         """The degradation ladder's admission decision for one submit."""
         with self._lock:
             level = self._level
             warm = spec.name in self._warm
+            # a key some worker advertises as hot will very likely hit
+            # that worker's cache: admitting it under cached_only costs
+            # near-zero compute, exactly the traffic the level exists
+            # to keep serving
+            hot = (hot_token is not None and level >= LEVEL_CACHED_ONLY
+                   and self._advertised_hot_locked(hot_token))
         if level == LEVEL_HEALTHY:
             return
         reason = None
         if level >= LEVEL_REJECT:
             reason = "rejecting all submits"
-        elif level >= LEVEL_CACHED_ONLY and not (spec.cacheable or warm):
+        elif level >= LEVEL_CACHED_ONLY and not (spec.cacheable or warm
+                                                 or hot):
             reason = f"only warm/cacheable classes served ({spec.name} cold)"
         elif level >= LEVEL_SHED_LOW and priority < self.shed_priority_min:
             reason = (f"shedding priority < {self.shed_priority_min} "
@@ -1094,6 +1194,17 @@ class Supervisor:
                     time.monotonic_ns() - t0_ns, handler=req.handler)
             with self._lock:
                 self._warm.add(req.handler)
+            if req.rcache_key is not None:
+                from spark_rapids_jni_tpu.plans.rcache import result_cache
+
+                # the supervisor saw this result cross anyway — caching
+                # it here is what makes the NEXT identical submit skip
+                # the lease and the pipe entirely.  put() revalidates
+                # the dependency versions stamped at submit, so a table
+                # bumped while this request was leased drops the insert.
+                if result_cache.put(req.rcache_key, value,
+                                    req.rcache_deps, label=req.handler):
+                    self.metrics.count("rcache_stores", req.session_id)
             self._finish(req, OK, value=value)
         elif status == TIMED_OUT:
             self._finish(req, TIMED_OUT, error=RequestTimeout(
@@ -1320,6 +1431,25 @@ class Supervisor:
                                   f"ewma:{transition['stress_ewma']}",
                            value=transition["level"])
 
+    # -- the result cache's cluster surface (round 15) -----------------------
+    def bump_table(self, name: str) -> int:
+        """Declare "table ``name`` changed": bump the local version
+        registry (reclaiming this process's dependent cache entries via
+        the registered listener, synchronously — no lookup after this
+        returns can serve the old version) and broadcast the new version
+        to every live executor so worker-side caches converge.  The
+        broadcast is monotonic on the worker (``tables.advance_to``), so
+        reordered or duplicate deliveries are harmless."""
+        from spark_rapids_jni_tpu.models import tables as _tables
+
+        version = _tables.bump(name)
+        with self._lock:
+            conns = [h.conn for h in self._handles.values()
+                     if h.health == _ALIVE]
+        for conn in conns:
+            conn.send((rpc.MSG_TABLE_BUMP, name, version))
+        return version
+
     # -- introspection / lifecycle ------------------------------------------
     def level(self) -> int:
         with self._lock:
@@ -1373,12 +1503,18 @@ class Supervisor:
                 "ledger_tail": list(self.ledger)[-16:],
                 "transitions": len(self.ledger),
             }
+        rcache = None
+        if self._rcache_on:
+            from spark_rapids_jni_tpu.plans.rcache import result_cache
+
+            rcache = result_cache.stats()
         tl = self.timeline
         return {
             "workers": workers,
             "ladder": ladder,
             "leases": self.lease_stats(),
             "shuffles": shuffles,
+            "rcache": rcache,
             "queue_depth": self.queue.depth(),
             "counters": self.metrics.snapshot()["counters"],
             "telemetry": (tl.stats() if tl is not None else None),

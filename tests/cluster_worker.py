@@ -89,3 +89,36 @@ def register_shuffle(engine, capacity: int = 64,
 
     engine.register(QueryHandler(
         name="q97_shuffle", fn=fn, nbytes_of=lambda p: 0))
+
+
+def register_cached(engine, service_s: float = 0.02) -> None:
+    """Result-cache cluster handlers (round 15).  ``csum`` is a
+    cacheable content-keyed sum over a named table with a service-time
+    floor (the compute a hit skips); ``tver`` reads this worker
+    process's version registry, so tests can observe MSG_TABLE_BUMP
+    convergence.  Key construction imports the models package (version
+    registry) — only cache clusters pay that spawn weight."""
+
+    def run_csum(p, ctx):
+        time.sleep(service_s)
+        return sum(p["rows"])
+
+    def csum_key(p):
+        from spark_rapids_jni_tpu.plans.rcache import array_digest
+
+        import numpy as np
+
+        return (p["table"], array_digest(np.asarray(p["rows"])))
+
+    engine.register(QueryHandler(
+        name="csum", fn=run_csum,
+        nbytes_of=lambda p: 64 * len(p["rows"]),
+        cache_key=csum_key,
+        cache_tables=lambda p: (p["table"],)))
+
+    def run_tver(p, ctx):
+        from spark_rapids_jni_tpu.models import tables as _tables
+
+        return _tables.version_of(str(p))
+
+    engine.register(QueryHandler(name="tver", fn=run_tver))

@@ -152,9 +152,13 @@ def test_event_kind_vocabulary_is_stable():
         "shuffle_produce", "shuffle_fetch", "shuffle_retry",
         "shuffle_ack")
     # round 14: the telemetry-plane kinds (spans, SLO, export) appended
-    assert flight.EVENT_KINDS[-6:] == (
+    assert flight.EVENT_KINDS[31:37] == (
         "span_open", "span_close", "slo_burn", "slo_ok",
         "telemetry_export", "telemetry_drop")
+    # round 15: the result-cache kinds are strictly appended after
+    assert flight.EVENT_KINDS[-5:] == (
+        "rcache_hit", "rcache_store", "rcache_demote",
+        "rcache_evict", "rcache_invalidate")
     assert len(set(flight.EVENT_KINDS)) == len(flight.EVENT_KINDS)
 
 

@@ -120,6 +120,7 @@ def test_frame_message_registry_covers_every_tag():
     # the wire-protocol analyze pass reads this registry; every FR_* tag
     # must have one declared row (and only the declared tags exist)
     assert set(frames.MESSAGE_FIELDS) == {
-        frames.FR_FETCH, frames.FR_DATA, frames.FR_NACK}
+        frames.FR_FETCH, frames.FR_DATA, frames.FR_NACK,
+        frames.FR_RESULT}
     assert frames.MESSAGE_FIELDS[frames.FR_DATA] == (
         "sid", "map_index", "part", "columns", "rows")

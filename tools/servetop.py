@@ -10,6 +10,8 @@ in every BENCH_serve record) and renders a refreshing dashboard:
   leases, memory/blocked pressure, completed/p99 from its own metrics;
 - HANDLERS — per query class across the cluster: completions,
   throughput (vs the previous frame), p50/p99;
+- CACHE — the result cache (plans/rcache.py): per-tier bytes/entries,
+  cumulative + windowed hit ratio, per-worker advertised residency;
 - TENANTS — per session: submitted/completed/shed at the front door;
 - SLO — each declared objective's fast/slow burn rate and state;
 - SPANS — waterfalls of the slowest (and still in-flight) requests,
@@ -99,6 +101,54 @@ def _tenant_table(view: dict) -> List[str]:
     return out
 
 
+def _cache_section(view: dict, prev: Optional[dict]) -> List[str]:
+    """Result-cache residency + flow (plans/rcache.py, round 15): the
+    supervisor's own store per tier, the windowed hit ratio vs the
+    previous frame, and each worker's advertised cache gauges."""
+    sup = view.get("supervisor") or {}
+    rc = sup.get("rcache")
+    if not rc:
+        return ["  (result cache off)"]
+
+    def mb(n) -> str:
+        return f"{float(n) / 1e6:.1f}M"
+
+    lines = [f"  {'tier':<8}{'entries':>9}{'bytes':>10}"]
+    for tier in ("hbm", "host", "disk"):
+        lines.append(f"  {tier:<8}{rc.get(tier + '_entries', 0):>9}"
+                     f"{mb(rc.get(tier + '_bytes', 0)):>10}")
+    hits, looks = rc.get("hits", 0), rc.get("lookups", 0)
+    window = ""
+    if prev:
+        prc = (prev.get("supervisor") or {}).get("rcache") or {}
+        dh = hits - prc.get("hits", 0)
+        dl = looks - prc.get("lookups", 0)
+        if dl > 0:
+            window = f"   window: {dh}/{dl} ({dh / dl:.0%})"
+    lines.append(
+        f"  hits {hits}/{looks} lookups "
+        f"(ratio {rc.get('hit_ratio', 0.0):.2f}){window}   "
+        f"stores {rc.get('stores', 0)}  demotes "
+        f"{rc.get('demotes_hbm_host', 0)}+{rc.get('demotes_host_disk', 0)}"
+        f"  evict {rc.get('evictions', 0)}  invalidated "
+        f"{rc.get('invalidated', 0)}")
+    workers = sup.get("workers") or {}
+    rows = [(wid, (w.get("gauges") or {}).get("rcache"))
+            for wid, w in sorted(workers.items(), key=lambda kv: kv[0])]
+    rows = [(wid, g) for wid, g in rows if g]
+    if rows:
+        lines.append(f"  {'worker':<8}{'entries':>9}{'hbm':>10}"
+                     f"{'host':>10}{'disk':>10}{'hit%':>7}")
+        for wid, g in rows:
+            lines.append(
+                f"  {wid:<8}{g.get('entries', 0):>9}"
+                f"{mb(g.get('hbm_bytes', 0)):>10}"
+                f"{mb(g.get('host_bytes', 0)):>10}"
+                f"{mb(g.get('disk_bytes', 0)):>10}"
+                f"{100 * float(g.get('hit_ratio', 0.0)):>6.0f}%")
+    return lines
+
+
 def _slo_table(view: dict) -> List[str]:
     slo = view.get("slo")
     if not slo:
@@ -178,6 +228,7 @@ def render_frame(view: dict, *, prev: Optional[dict] = None,
             f"{_bar(g.get('mem_frac', 0.0)):>12}"
             f"{_bar(g.get('blocked_frac', 0.0)):>12}")
     lines += ["", "HANDLERS"] + _handler_table(view, prev, dt_s)
+    lines += ["", "CACHE"] + _cache_section(view, prev)
     lines += ["", "TENANTS"] + _tenant_table(view)
     lines += ["", "SLO"] + _slo_table(view)
     lines += ["", "SPANS (slowest / in-flight)"] + _span_section(view, top)
