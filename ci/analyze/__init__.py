@@ -8,7 +8,7 @@ those *before* merge — the compile-time complement of the arbiter's
 runtime deadlock detector (native/task_arbiter.cpp), in the spirit of
 Flare's compile-time checking of Spark-native runtime contracts.
 
-Nine passes (see docs/STATIC_ANALYSIS.md for the invariants):
+Eleven passes (see docs/STATIC_ANALYSIS.md for the invariants):
 
 - ``lock-order``           cycles in the static lock-acquisition graph
 - ``unguarded-shared-state`` unlocked attribute writes in lock-owning classes
@@ -24,6 +24,11 @@ Nine passes (see docs/STATIC_ANALYSIS.md for the invariants):
   (ci/flight_wire_ids.json)
 - ``state-machine``        transition sites vs. declared transition
   tables; paired flight events balanced
+- ``resource-lifecycle``   acquired resources (budget bytes, pooled
+  pages, sockets, spans, leases) reach a release on every CFG path,
+  exception edges included (cfg.py control-flow layer)
+- ``blocking-under-lock``  blocking primitives (socket/pipe I/O, sleep,
+  unbounded waits) reachable while a lock is held
 
 Workflow:
 
@@ -37,6 +42,8 @@ Workflow:
 - ``python ci/analyze --update-baseline``  grandfather current findings
 - ``python ci/analyze --update-wire-ids``  append new flight event kinds
   to the frozen wire-id registry (append-only; refuses mutations)
+- ``python ci/analyze --explain <rule>``   a rule's invariant, rationale,
+  and minimal failing example (``all`` for every rule)
 - ``# analyze: ignore[rule-id]``           per-line suppression (on the
   statement's first line); ``# analyze: ignore`` suppresses every rule;
   ``# analyze: ignore-file[rule-id]`` anywhere in a file suppresses the

@@ -1,0 +1,394 @@
+"""Per-function control-flow graphs with explicit exception edges.
+
+The nine original passes are AST-shape checks: they see *what* a
+statement does, never *which paths reach it*.  The two bug shapes every
+recent review round kept finding — a resource acquired but not released
+on some exception path, and a blocking call made while holding a lock —
+are path properties, so this module gives the passes a path model:
+
+- one :class:`CFG` per function: statement-granular nodes linked by
+  ``norm``/``true``/``false``/``back`` edges (straight-line flow,
+  branches, loop back edges), plus a :meth:`CFG.basic_blocks` view that
+  groups maximal straight-line chains;
+- **explicit exception edges**: every statement whose evaluation can
+  raise (:func:`can_raise` — calls, attribute/subscript access,
+  arithmetic, unpacking, ``raise``/``assert``/``import``) gets an
+  ``exc`` edge to the innermost enclosing handler dispatch, and from
+  there to each ``except`` body, through every ``finally``, and finally
+  to the synthetic :attr:`CFG.raise_exit` when nothing catches it —
+  so "the function can exit holding X" is a plain reachability query;
+- ``try/finally`` duplication: the ``finally`` body is built once per
+  live continuation (fall-through, exception propagation, ``return``,
+  ``break``, ``continue``), the standard desugaring that lets a pass
+  see that a release in ``finally`` covers *all* of them;
+- ``with`` desugaring: the header node evaluates the context
+  expressions (enter); synthetic ``with_exit`` nodes model ``__exit__``
+  running on the normal path, on exception propagation out of the body,
+  and on ``return``/``break``/``continue`` — which is exactly why
+  context-manager acquisition satisfies the resource-lifecycle pass.
+
+Nodes carry their source statement (``finally`` copies share one AST
+node, distinguished by ``copy_tag``), and :func:`header_exprs` exposes
+the expressions a node actually evaluates — an ``If`` node evaluates
+its test, not its body.  Nested function/lambda bodies are opaque single
+nodes: they run later, under whatever flow state their caller
+establishes (the same rule every existing pass applies).
+
+The content-hash cache covers this module automatically: the analyzer
+fingerprint hashes every ``.py`` under ``ci/analyze/``, so editing the
+CFG builder invalidates cached findings like editing any pass.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Tuple
+
+__all__ = ["Node", "CFG", "build_cfg", "can_raise", "header_exprs",
+           "calls_in"]
+
+
+class Node:
+    """One CFG node.  ``kind`` is one of:
+
+    - ``entry`` / ``exit`` / ``raise`` — the synthetic function entry,
+      normal exit, and exceptional exit;
+    - ``stmt`` — one statement's own evaluation (headers only: an
+      ``If`` node is its test, a ``With`` node is its enters);
+    - ``dispatch`` — a ``try``'s handler-matching point (exception
+      edges from the body land here, fan out to handlers);
+    - ``with_exit`` — a ``with`` statement's ``__exit__`` on one
+      continuation (normal / exception / return / break / continue);
+    - ``join`` — a no-op merge point (loop exits, ``finally`` entries).
+    """
+
+    __slots__ = ("idx", "kind", "stmt", "succ", "copy_tag")
+
+    def __init__(self, idx: int, kind: str, stmt, copy_tag: str = ""):
+        self.idx = idx
+        self.kind = kind
+        self.stmt = stmt
+        self.copy_tag = copy_tag
+        self.succ: List[Tuple["Node", str]] = []  # (target, edge label)
+
+    @property
+    def lineno(self) -> int:
+        return getattr(self.stmt, "lineno", 0)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<Node {self.idx} {self.kind} L{self.lineno}{self.copy_tag}>"
+
+
+# expression forms whose evaluation PLAUSIBLY raises: calls, arithmetic
+# (division, dunder dispatch), await / yield re-entry (a generator can
+# have an exception thrown in at its yield — how `with`-block faults
+# reach @contextmanager bodies), and starred unpacking.  Attribute and
+# subscript loads and comparisons are deliberately NOT in the set:
+# `if spans[0] is None:` or `x = obj.field` raising is possible, but
+# counting every container index would put a phantom exception edge
+# after nearly every guard statement and drown the resource-lifecycle
+# pass in unactionable paths — calls are where exception-path leaks
+# actually happen (every historical instance was one).
+_RAISING_EXPRS = (ast.Call, ast.BinOp, ast.Await,
+                  ast.Yield, ast.YieldFrom, ast.Starred)
+
+
+def header_exprs(stmt) -> List[ast.AST]:
+    """The expressions one CFG node actually evaluates — compound
+    statements contribute only their headers (test / iter / context
+    expressions); their bodies are separate nodes."""
+    if stmt is None:
+        return []
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter, stmt.target]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        out: List[ast.AST] = []
+        for item in stmt.items:
+            out.append(item.context_expr)
+            if item.optional_vars is not None:
+                out.append(item.optional_vars)
+        return out
+    if isinstance(stmt, ast.Assign):
+        return [stmt.value] + list(stmt.targets)
+    if isinstance(stmt, ast.AugAssign):
+        return [stmt.value, stmt.target]
+    if isinstance(stmt, ast.AnnAssign):
+        return ([stmt.value, stmt.target] if stmt.value is not None
+                else [stmt.target])
+    if isinstance(stmt, ast.Expr):
+        return [stmt.value]
+    if isinstance(stmt, ast.Return):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, ast.Raise):
+        return [e for e in (stmt.exc, stmt.cause) if e is not None]
+    if isinstance(stmt, ast.Assert):
+        return [e for e in (stmt.test, stmt.msg) if e is not None]
+    if isinstance(stmt, ast.Delete):
+        return list(stmt.targets)
+    return []
+
+
+def _walk_exprs(exprs) -> Iterator[ast.AST]:
+    """Walk expression trees without descending into lambda bodies
+    (they run later, not at this node)."""
+    stack = list(exprs)
+    while stack:
+        e = stack.pop()
+        yield e
+        if isinstance(e, ast.Lambda):
+            continue  # the lambda OBJECT is built here; its body is not run
+        stack.extend(ast.iter_child_nodes(e))
+
+
+def calls_in(node: Node) -> List[ast.Call]:
+    """Every call a node's own evaluation performs (lambda bodies
+    excluded), in source order."""
+    out = [e for e in _walk_exprs(header_exprs(node.stmt))
+           if isinstance(e, ast.Call)]
+    out.sort(key=lambda c: (c.lineno, c.col_offset))
+    return out
+
+
+def can_raise(stmt) -> bool:
+    """Conservative may-raise for one statement's OWN evaluation (its
+    header only — bodies are separate nodes)."""
+    if isinstance(stmt, (ast.Raise, ast.Assert, ast.Import,
+                         ast.ImportFrom, ast.Delete)):
+        return True
+    if isinstance(stmt, (ast.Pass, ast.Break, ast.Continue, ast.Global,
+                         ast.Nonlocal, ast.FunctionDef,
+                         ast.AsyncFunctionDef, ast.ClassDef)):
+        return False
+    for e in _walk_exprs(header_exprs(stmt)):
+        if isinstance(e, _RAISING_EXPRS):
+            return True
+        # tuple/list unpack targets raise on arity/iteration mismatch
+        if isinstance(e, (ast.Tuple, ast.List)) and isinstance(
+                getattr(e, "ctx", None), ast.Store):
+            return True
+    return False
+
+
+class _Ctx:
+    """Where control transfers out of the current region land."""
+
+    __slots__ = ("exc", "ret", "brk", "cont")
+
+    def __init__(self, exc: Node, ret: Node, brk: Optional[Node],
+                 cont: Optional[Node]):
+        self.exc = exc
+        self.ret = ret
+        self.brk = brk
+        self.cont = cont
+
+    def replace(self, **kw) -> "_Ctx":
+        vals = {s: getattr(self, s) for s in self.__slots__}
+        vals.update(kw)
+        return _Ctx(**vals)
+
+
+def _catches_all(handler: ast.ExceptHandler) -> bool:
+    """Does this handler catch every exception the analysis models?
+    ``Exception`` counts: the protocol signals and resource faults this
+    layer exists for all derive from it, and treating it as partial
+    would flag every typed-cleanup idiom in the tree."""
+    t = handler.type
+    if t is None:
+        return True
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in ("BaseException",
+                                                "Exception"):
+            return True
+    return False
+
+
+class CFG:
+    """The per-function graph; build with :func:`build_cfg`."""
+
+    def __init__(self, func):
+        self.func = func
+        self.nodes: List[Node] = []
+        self.entry = self._new("entry", None)
+        self.exit = self._new("exit", None)
+        self.raise_exit = self._new("raise", None)
+        ctx = _Ctx(exc=self.raise_exit, ret=self.exit, brk=None, cont=None)
+        body = func.body if isinstance(func.body, list) else [func.body]
+        outs = self._seq(body, [(self.entry, "norm")], ctx, "")
+        for n, lbl in outs:
+            self._edge(n, self.exit, lbl)
+
+    # -- construction ------------------------------------------------------
+    def _new(self, kind: str, stmt, tag: str = "") -> Node:
+        n = Node(len(self.nodes), kind, stmt, tag)
+        self.nodes.append(n)
+        return n
+
+    @staticmethod
+    def _edge(a: Node, b: Node, label: str) -> None:
+        a.succ.append((b, label))
+
+    def _connect(self, preds, node: Node) -> None:
+        for p, lbl in preds:
+            self._edge(p, node, lbl)
+
+    def _seq(self, stmts, preds, ctx: _Ctx, tag: str):
+        for st in stmts:
+            preds = self._stmt(st, preds, ctx, tag)
+        return preds
+
+    def _stmt(self, st, preds, ctx: _Ctx, tag: str):
+        if isinstance(st, ast.Try):
+            return self._try(st, preds, ctx, tag)
+        node = self._new("stmt", st, tag)
+        self._connect(preds, node)
+        if can_raise(st):
+            self._edge(node, ctx.exc, "exc")
+        if isinstance(st, ast.Return):
+            self._edge(node, ctx.ret, "norm")
+            return []
+        if isinstance(st, ast.Raise):
+            return []  # the exc edge above is the only way out
+        if isinstance(st, ast.Break):
+            if ctx.brk is not None:
+                self._edge(node, ctx.brk, "norm")
+            return []
+        if isinstance(st, ast.Continue):
+            if ctx.cont is not None:
+                self._edge(node, ctx.cont, "back")
+            return []
+        if isinstance(st, ast.If):
+            t_out = self._seq(st.body, [(node, "true")], ctx, tag)
+            f_out = (self._seq(st.orelse, [(node, "false")], ctx, tag)
+                     if st.orelse else [(node, "false")])
+            return t_out + f_out
+        if isinstance(st, (ast.While, ast.For, ast.AsyncFor)):
+            after = self._new("join", st, tag)
+            inner = ctx.replace(brk=after, cont=node)
+            body_out = self._seq(st.body, [(node, "true")], inner, tag)
+            for n, lbl in body_out:
+                self._edge(n, node, "back")
+            infinite = (isinstance(st, ast.While)
+                        and isinstance(st.test, ast.Constant)
+                        and bool(st.test.value))
+            exits = [] if infinite else [(node, "false")]
+            if st.orelse:  # runs on normal loop exhaustion, before `after`
+                exits = self._seq(st.orelse, exits, ctx, tag)
+            self._connect(exits, after)
+            return [(after, "norm")]
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            # __exit__ runs on every continuation out of the body: one
+            # with_exit node per live continuation kind
+            w_norm = self._new("with_exit", st, tag)
+            w_exc = self._new("with_exit", st, tag + "/exc")
+            self._edge(w_exc, ctx.exc, "exc")
+            w_ret = self._new("with_exit", st, tag + "/ret")
+            self._edge(w_ret, ctx.ret, "norm")
+            w_brk = w_cont = None
+            if ctx.brk is not None:
+                w_brk = self._new("with_exit", st, tag + "/brk")
+                self._edge(w_brk, ctx.brk, "norm")
+            if ctx.cont is not None:
+                w_cont = self._new("with_exit", st, tag + "/cont")
+                self._edge(w_cont, ctx.cont, "back")
+            inner = ctx.replace(exc=w_exc, ret=w_ret, brk=w_brk,
+                                cont=w_cont)
+            body_out = self._seq(st.body, [(node, "norm")], inner, tag)
+            self._connect(body_out, w_norm)
+            return [(w_norm, "norm")]
+        return [(node, "norm")]
+
+    def _try(self, st: ast.Try, preds, ctx: _Ctx, tag: str):
+        node = self._new("stmt", st, tag)  # the `try:` header (no-op)
+        self._connect(preds, node)
+        after = self._new("join", st, tag)
+
+        def finally_copy(cont: Optional[Node], cont_label: str,
+                         sub: str) -> Optional[Node]:
+            """One duplicate of the finally body continuing to ``cont``.
+            Exceptions raised INSIDE finally propagate outward, replacing
+            any in-flight exception."""
+            if cont is None:
+                return None
+            entry = self._new("join", st, tag + sub)
+            outs = self._seq(st.finalbody, [(entry, "norm")], ctx,
+                             tag + sub)
+            for n, lbl in outs:
+                self._edge(n, cont, cont_label)
+            return entry
+
+        if st.finalbody:
+            f_exc = finally_copy(ctx.exc, "exc", "/f-exc")
+            f_ret = finally_copy(ctx.ret, "norm", "/f-ret")
+            f_brk = finally_copy(ctx.brk, "norm", "/f-brk")
+            f_cont = finally_copy(ctx.cont, "back", "/f-cont")
+            f_norm = finally_copy(after, "norm", "/f-norm")
+        else:
+            f_exc, f_ret = ctx.exc, ctx.ret
+            f_brk, f_cont = ctx.brk, ctx.cont
+            f_norm = after
+
+        outer = ctx.replace(exc=f_exc, ret=f_ret, brk=f_brk, cont=f_cont)
+        if st.handlers:
+            dispatch = self._new("dispatch", st, tag)
+            body_ctx = outer.replace(exc=dispatch)
+        else:
+            dispatch = None
+            body_ctx = outer
+        body_out = self._seq(st.body, [(node, "norm")], body_ctx, tag)
+        if st.orelse:  # runs only when the body raised nothing
+            body_out = self._seq(st.orelse, body_out, outer, tag)
+        if dispatch is not None:
+            caught_all = False
+            for h in st.handlers:
+                body_out += self._seq(h.body, [(dispatch, "exc")], outer,
+                                      tag)
+                caught_all = caught_all or _catches_all(h)
+            if not caught_all:  # unmatched exception keeps propagating
+                self._edge(dispatch, f_exc, "exc")
+        self._connect(body_out, f_norm)
+        return [(after, "norm")]
+
+    # -- views -------------------------------------------------------------
+    def preds(self):
+        """node idx -> count of incoming edges."""
+        n_in = {n.idx: 0 for n in self.nodes}
+        for n in self.nodes:
+            for s, _lbl in n.succ:
+                n_in[s.idx] += 1
+        return n_in
+
+    def basic_blocks(self) -> List[List[Node]]:
+        """Maximal straight-line chains: consecutive nodes linked by a
+        single non-``exc`` edge where the successor has exactly one
+        predecessor.  (The statement-granular nodes are the analysis
+        surface; this view exists for tests and for humans reading
+        dumps.)"""
+        n_in = self.preds()
+        blocks: List[List[Node]] = []
+        placed = set()
+        for n in self.nodes:
+            if n.idx in placed:
+                continue
+            chain = [n]
+            placed.add(n.idx)
+            cur = n
+            while True:
+                flow = [(s, lbl) for s, lbl in cur.succ if lbl != "exc"]
+                if len(flow) != 1:
+                    break
+                nxt = flow[0][0]
+                if nxt.idx in placed or n_in[nxt.idx] != 1:
+                    break
+                chain.append(nxt)
+                placed.add(nxt.idx)
+                cur = nxt
+            blocks.append(chain)
+        return blocks
+
+
+def build_cfg(func) -> CFG:
+    """CFG for one FunctionDef/AsyncFunctionDef (or Lambda)."""
+    return CFG(func)

@@ -74,11 +74,29 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule ids to run")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--explain", metavar="RULE",
+                    help="print one rule's invariant, rationale, and a "
+                    "minimal failing example ('all' for every rule)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
-        for rid, (_fn, doc) in sorted(RULES.items()):
+        for rid, (_fn, doc, _ex) in sorted(RULES.items()):
             print(f"{rid}: {doc}")
+        return 0
+
+    if args.explain:
+        from .registry import explain
+
+        if args.explain != "all" and args.explain not in RULES:
+            known = ", ".join(sorted(RULES))
+            print(f"analyze: unknown rule {args.explain!r} "
+                  f"(known: {known})")
+            return 2
+        rids = sorted(RULES) if args.explain == "all" else [args.explain]
+        for i, rid in enumerate(rids):
+            if i:
+                print("\n" + "=" * 72 + "\n")
+            print(explain(rid), end="")
         return 0
 
     fmt = args.format or ("json" if args.as_json else "text")

@@ -1,8 +1,10 @@
 """Analysis passes — importing this package registers every rule."""
 
+from . import blocking  # noqa: F401
 from . import governed  # noqa: F401
 from . import guarded  # noqa: F401
 from . import locks  # noqa: F401
+from . import resources  # noqa: F401
 from . import retry  # noqa: F401
 from . import seam  # noqa: F401
 from . import statemachine  # noqa: F401

@@ -1,0 +1,329 @@
+"""Pass 11: blocking-under-lock — no unbounded wait while holding a lock.
+
+Round 12's review found ``SafeConn.send`` able to block forever holding
+the send lock (a live peer that stops draining its pipe), and round 13's
+found the telemetry endpoint wedgeable by a consumer that connects and
+never reads.  Both are one shape: a *blocking primitive* reachable while
+a lock from the pass-1/7 lock model is held — every other thread that
+needs the lock then inherits a stall the watchdog cannot see (it parks
+in the OS, not in the arbiter).
+
+**The blocking registry** (what counts as a blocking primitive):
+
+- socket: ``recv`` / ``connect`` / ``create_connection`` / ``accept`` /
+  ``sendall``
+- pipe / stored send callables: ``.send`` / ``.recv`` /
+  ``send_bytes`` / ``recv_bytes``, and calls to a bare name ``send`` /
+  ``recv`` (the Callable params serve code threads a pipe send through)
+- ``time.sleep``
+- ``subprocess.run`` / ``communicate`` / ``check_output``
+- unbounded ``Condition``/``Event`` ``wait`` / ``wait_for`` (no timeout);
+  waiting on the held condition itself is exempt — ``wait`` releases it
+  — but any OTHER lock still held across the wait is flagged
+- unbounded ``join()`` (no timeout; constant receivers are ``str.join``)
+- queue ``get``/``put`` without a timeout, when the receiver is
+  recognizably a queue (name contains ``queue``/ends in ``_q``) — a
+  plain ``.get(key)`` is a dict
+
+Lock state is lexical ``with`` nesting over the same lock model the
+lock-order and guarded-by passes resolve (own-class ``Lock``/``RLock``/
+``Condition`` attributes, module-level locks, cross-object lock
+attributes through attribute types), and — like the guarded-by pass —
+the *held* context propagates through calls: a method that blocks makes
+every call site that invokes it **while holding a lock** a finding, with
+the blocking witness named in the message.  Propagation follows
+self-method calls and resolvable function calls; stored callbacks and
+nested defs run later and are out of scope (the pass-2 rule).
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import defaultdict
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding
+from ..project import ClassInfo, Config, ModuleInfo, Project, _in_scope
+from ..registry import rule
+
+_TIMEOUT_KWS = {"timeout", "block", "deadline", "timeout_s"}
+
+_EXAMPLE = """\
+import threading, time
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def drain(self):
+        with self._lock:
+            time.sleep(0.5)      # every other tenant of _lock stalls
+    # fix: compute under the lock, block outside it
+"""
+
+
+def _blocking_name(call: ast.Call) -> Optional[str]:
+    """The registry: a primitive name when this call can block
+    unboundedly, else None.  ``wait``/``wait_for`` receivers get the
+    held-condition exemption at the call site (see _Scan)."""
+    f = call.func
+    kws = {k.arg for k in call.keywords}
+    if isinstance(f, ast.Attribute):
+        name, recv = f.attr, f.value
+    elif isinstance(f, ast.Name):
+        name, recv = f.id, None
+    else:
+        return None
+    if name == "sleep":
+        if recv is None or (isinstance(recv, ast.Name)
+                            and recv.id == "time"):
+            return "time.sleep"
+        return None
+    if name in ("sendall", "connect", "create_connection"):
+        return f"socket {name}"
+    if name in ("send", "recv", "send_bytes", "recv_bytes"):
+        return f"pipe/socket {name}"
+    if name == "accept":
+        return "socket accept"
+    if name in ("communicate", "check_output"):
+        return f"subprocess {name}"
+    if (name == "run" and isinstance(recv, ast.Name)
+            and recv.id == "subprocess"):
+        return "subprocess.run"
+    if name == "join":
+        if recv is None or isinstance(recv, ast.Constant):
+            return None  # str.join
+        if call.args or (_TIMEOUT_KWS & kws):
+            return None  # bounded
+        return "join()"
+    if name in ("wait", "wait_for"):
+        if call.args or (_TIMEOUT_KWS & kws):
+            return None  # bounded wait
+        return "wait()"
+    if name in ("get", "put"):
+        rname = (recv.attr if isinstance(recv, ast.Attribute)
+                 else recv.id if isinstance(recv, ast.Name) else "")
+        rl = rname.lower()
+        if "queue" not in rl and rl != "q" and not rl.endswith("_q"):
+            return None
+        if _TIMEOUT_KWS & kws:
+            return None
+        if name == "get" and call.args:
+            return None  # dict.get(key[, default])
+        return f"queue.{name}"
+    return None
+
+
+class _Scan(ast.NodeVisitor):
+    """One function body: blocking sites + outgoing calls, each with the
+    lexically-held lock set."""
+
+    def __init__(self, analysis: "_Analysis", mod: ModuleInfo,
+                 ci: Optional[ClassInfo], funckey: str,
+                 env: Dict[str, str]):
+        self.a = analysis
+        self.mod = mod
+        self.ci = ci
+        self.funckey = funckey
+        self.env = env
+        self.held: List[str] = []  # lock keys, lexical
+        # (line, primitive, frozenset(held))
+        self.blocks: List[Tuple[int, str, frozenset]] = []
+        # (callee funckey, line, frozenset(held))
+        self.calls: List[Tuple[str, int, frozenset]] = []
+
+    # lock resolution (the pass-1 model, condensed) ------------------------
+    def _lock_of(self, expr) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            if expr.id in self.mod.module_locks:
+                return f"{self.mod.modid}.{expr.id}"
+            imp = self.mod.imports.get(expr.id)
+            if imp and imp[0] == "obj":
+                src = self.a.project.modules.get(imp[1])
+                if src and imp[2] in src.module_locks:
+                    return f"{imp[1]}.{imp[2]}"
+            return None
+        if isinstance(expr, ast.Attribute):
+            owner = self._class_of(expr.value)
+            if owner is not None:
+                ci = self.a.project.classes.get(owner)
+                if ci and expr.attr in ci.lock_attrs:
+                    return f"{owner}.{expr.attr}"
+        return None
+
+    def _class_of(self, expr) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            if expr.id in self.env:
+                return self.env[expr.id]
+            r = self.a.project.resolve(self.mod, expr)
+            if r and r[0] == "class":
+                return r[1]
+            return None
+        if isinstance(expr, ast.Attribute):
+            owner = self._class_of(expr.value)
+            if owner:
+                ci = self.a.project.classes.get(owner)
+                if ci and expr.attr in ci.attr_types:
+                    return ci.attr_types[expr.attr]
+        return None
+
+    def _callee_keys(self, call: ast.Call) -> List[str]:
+        p = self.a.project
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            owner = self._class_of(f.value)
+            if owner:
+                ci = p.classes.get(owner)
+                if ci and f.attr in ci.methods:
+                    return [f"{owner}.{f.attr}"]
+                return []
+            r = p.resolve(self.mod, f)
+            if r and r[0] == "func":
+                return [r[1]]
+            return []
+        if isinstance(f, ast.Name):
+            r = p.resolve(self.mod, f)
+            if r and r[0] == "func":
+                return [r[1]]
+            if r and r[0] == "class":
+                ci = p.classes.get(r[1])
+                if ci and "__init__" in ci.methods:
+                    return [f"{r[1]}.__init__"]
+        return []
+
+    # visiting -------------------------------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            lk = self._lock_of(item.context_expr)
+            if lk is not None:
+                acquired.append(lk)
+            else:
+                self.visit(item.context_expr)
+        self.held.extend(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node: ast.Call) -> None:
+        prim = _blocking_name(node)
+        if prim is not None:
+            held = set(self.held)
+            if prim == "wait()" and isinstance(node.func, ast.Attribute):
+                lk = self._lock_of(node.func.value)
+                if lk is not None:
+                    held.discard(lk)  # waiting RELEASES that condition
+            self.blocks.append((node.lineno, prim, frozenset(held)))
+        for key in self._callee_keys(node):
+            self.calls.append((key, node.lineno, frozenset(self.held)))
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node) -> None:
+        pass  # nested defs run later, under their caller's lock state
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node) -> None:
+        pass
+
+    def visit_ClassDef(self, node) -> None:
+        pass
+
+
+class _Analysis:
+    def __init__(self, project: Project):
+        self.project = project
+
+
+@rule("blocking-under-lock",
+      "blocking primitives (socket/pipe I/O, sleep, unbounded waits and "
+      "joins, queue ops) reachable while a lock is held",
+      example=_EXAMPLE)
+def check_blocking_under_lock(project: Project,
+                              config: Config) -> List[Finding]:
+    a = _Analysis(project)
+    scans: Dict[str, _Scan] = {}
+    mods: Dict[str, ModuleInfo] = {}
+
+    def scan_module(modid: str, mod: ModuleInfo) -> None:
+        items: List[tuple] = []
+        for qual, fnode in mod.functions.items():
+            items.append((None, f"{modid}.{qual}", fnode))
+        for ci in mod.classes.values():
+            seen = set()
+            for mname, meth in ci.methods.items():
+                if id(meth) in seen:
+                    continue
+                seen.add(id(meth))
+                items.append((ci, f"{ci.key}.{mname}", meth))
+        for ci, funckey, fnode in items:
+            env = project._param_env(mod, ci, fnode)
+            sc = _Scan(a, mod, ci, funckey, env)
+            for stmt in fnode.body if hasattr(fnode, "body") else []:
+                sc.visit(stmt)
+            scans[funckey] = sc
+            mods[funckey] = mod
+
+    # scan EVERY module (a serve method may call into obs/ helpers that
+    # block); report only inside the configured scope
+    for modid, mod in project.modules.items():
+        scan_module(modid, mod)
+
+    # may-block fixed point with a witness primitive per function
+    witness: Dict[str, str] = {}
+    for key, sc in scans.items():
+        if sc.blocks:
+            witness[key] = sc.blocks[0][1]
+    calls_from: Dict[str, Set[str]] = defaultdict(set)
+    for key, sc in scans.items():
+        for callee, _line, _held in sc.calls:
+            calls_from[key].add(callee)
+    changed = True
+    while changed:
+        changed = False
+        for key, callees in calls_from.items():
+            if key in witness:
+                continue
+            for c in callees:
+                if c in witness:
+                    witness[key] = witness[c]
+                    changed = True
+                    break
+
+    findings: List[Finding] = []
+    reported: Set[tuple] = set()
+    for key in sorted(scans):
+        mod = mods[key]
+        if not _in_scope(mod.modid, config.blocking_scope):
+            continue
+        sc = scans[key]
+        qual = key.split(".", 1)[1] if "." in key else key
+        for line, prim, held in sc.blocks:
+            if not held or mod.suppressed("blocking-under-lock", line):
+                continue
+            locks = ", ".join(sorted(held))
+            if (mod.relpath, line, prim) in reported:
+                continue
+            reported.add((mod.relpath, line, prim))
+            findings.append(Finding(
+                "blocking-under-lock", mod.relpath, line,
+                f"{qual} blocks on {prim} while holding {locks}"))
+        for callee, line, held in sc.calls:
+            if not held or callee not in witness:
+                continue
+            if mod.suppressed("blocking-under-lock", line):
+                continue
+            cq = callee.rsplit(".", 1)[-1]
+            locks = ", ".join(sorted(held))
+            rkey = (mod.relpath, line, callee)
+            if rkey in reported:
+                continue
+            reported.add(rkey)
+            findings.append(Finding(
+                "blocking-under-lock", mod.relpath, line,
+                f"{qual} calls {cq}() while holding {locks}; {cq} can "
+                f"block on {witness[callee]}"))
+    return findings

@@ -23,8 +23,19 @@ def _alloc_call_name(node: ast.Call) -> Optional[str]:
     return None
 
 
+_EXAMPLE = """\
+import jax.numpy as jnp
+
+def build_table(n):
+    return jnp.zeros((n, 128))   # device HBM with no budget reservation
+    # fix: run under `with reservation(budget, nbytes):` or as a
+    # governed attempt_once/handler callback
+"""
+
+
 @rule("governed-allocation",
-      "raw device allocation in ops/models/serve outside a governor bracket")
+      "raw device allocation in ops/models/serve outside a governor bracket",
+      example=_EXAMPLE)
 def check_governed_allocation(project: Project,
                               config: Config) -> List[Finding]:
     # 1. index every function (incl. nested + lambdas) with parent links
@@ -99,8 +110,28 @@ def check_governed_allocation(project: Project,
     # trace time: the same seeding rule as `with seam(COMPILE)` bodies
     # and jit/shard_map callback arguments.  Seeds, not baseline entries:
     # new emitters are covered automatically, with no grandfathering.
+    def _jit_decorator(dec) -> bool:
+        """``@jax.jit`` / ``@jit`` / ``@functools.partial(jax.jit, ...)``
+        — the decorated body is traced device code: its allocations
+        materialize at the launch, inside the CALLER's bracket (the same
+        rule as jit(f)/shard_map(f) call arguments)."""
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = (target.attr if isinstance(target, ast.Attribute)
+                else getattr(target, "id", None))
+        if name in ("jit", "pjit"):
+            return True
+        if name == "partial" and isinstance(dec, ast.Call) and dec.args:
+            first = dec.args[0]
+            fname = (first.attr if isinstance(first, ast.Attribute)
+                     else getattr(first, "id", None))
+            return fname in ("jit", "pjit")
+        return False
+
     for fid, (mod, node, _qual) in funcs.items():
         for dec in getattr(node, "decorator_list", ()):
+            if _jit_decorator(dec):
+                governed.add(fid)
+                continue
             target = dec.func if isinstance(dec, ast.Call) else dec
             dec_name = None
             if isinstance(target, (ast.Name, ast.Attribute)):
@@ -212,8 +243,29 @@ def check_governed_allocation(project: Project,
                         governed |= expr_func_ids(mod, node.args[1],
                                                   local_defs)
 
+    # module-level dispatch tables: `_KERNELS = {"xx4": (_xx4_kernel, 2)}`
+    # — a governed function that references the table name reaches every
+    # function stored in it (the pallas launch scaffold's shape)
+    container_funcs: Dict[tuple, Set[str]] = {}
+    for mod in project.modules.values():
+        for node in mod.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            refs: Set[str] = set()
+            for ref in ast.walk(node.value):
+                if isinstance(ref, (ast.Name, ast.Attribute)):
+                    r = project.resolve(mod, ref)
+                    if r and r[0] == "func":
+                        refs.add(r[1])
+            if not refs:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    container_funcs[(mod.modid, t.id)] = refs
+
     # 3. propagate: a function referenced by name from a governed function
-    #    is governed (jit wrappers, partials, helpers, cross-module calls)
+    #    is governed (jit wrappers, partials, helpers, cross-module calls,
+    #    module-level dispatch tables)
     changed = True
     while changed:
         changed = False
@@ -228,12 +280,15 @@ def check_governed_allocation(project: Project,
                     changed = True
             for sub in body:
                 for ref in ast.walk(sub):
-                    tgt = None
+                    tgts: Set[str] = set()
                     if isinstance(ref, (ast.Name, ast.Attribute)):
                         r = project.resolve(mod, ref)
                         if r and r[0] == "func":
-                            tgt = r[1]
-                    if tgt:
+                            tgts.add(r[1])
+                        elif isinstance(ref, ast.Name):
+                            tgts |= container_funcs.get(
+                                (mod.modid, ref.id), set())
+                    for tgt in tgts:
                         for tid in name_to_ids.get(tgt, ()):
                             if tid not in governed:
                                 governed.add(tid)

@@ -181,9 +181,23 @@ class _GuardedScan(ast.NodeVisitor):
         pass
 
 
+_EXAMPLE = """\
+import threading
+
+class Table:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = {}          # guarded-by: _lock
+
+    def put(self, k, v):
+        self._rows[k] = v        # write outside `with self._lock:`
+"""
+
+
 @rule("guarded-by",
       "attributes annotated `# guarded-by: <lock>` must be read/written "
-      "under that lock outside __init__")
+      "under that lock outside __init__",
+      example=_EXAMPLE)
 def check_guarded_by(project: Project, config: Config) -> List[Finding]:
     findings: List[Finding] = []
     referenced = referenced_attr_names(project)

@@ -195,8 +195,36 @@ class _LockAnalysis:
         self.wait_edges.append((held_lock, lk, mod, line))
 
 
+_LOCK_EXAMPLE = """\
+import threading
+
+class A:
+    def __init__(self, b):
+        self._lock = threading.Lock()
+        self.b = b
+
+    def fwd(self):
+        with self._lock:
+            self.b.poke()        # acquires B._lock while holding A._lock
+
+class B:
+    def __init__(self, a):
+        self._lock = threading.Lock()
+        self.a = a
+
+    def poke(self):
+        with self._lock:
+            pass
+
+    def rev(self):
+        with self._lock:
+            self.a.fwd()         # the opposite order: a cycle
+"""
+
+
 @rule("lock-order",
-      "cycles in the static lock-acquisition graph (potential deadlock)")
+      "cycles in the static lock-acquisition graph (potential deadlock)",
+      example=_LOCK_EXAMPLE)
 def check_lock_order(project: Project, config: Config) -> List[Finding]:
     a = _LockAnalysis(project)
     # walk every function/method of in-scope modules
@@ -363,9 +391,23 @@ def _tarjan(graph: Dict[str, Set[str]]) -> List[Set[str]]:
 # --------------------------------------------------------------------------
 
 
+_STATE_EXAMPLE = """\
+import threading
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+
+    def add(self, k, v):
+        self._items[k] = v       # public write outside self._lock
+"""
+
+
 @rule("unguarded-shared-state",
       "attribute writes reachable from public methods outside the owning "
-      "class's lock")
+      "class's lock",
+      example=_STATE_EXAMPLE)
 def check_unguarded_state(project: Project, config: Config) -> List[Finding]:
     findings: List[Finding] = []
     referenced_attrs = referenced_attr_names(project)

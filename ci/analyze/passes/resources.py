@@ -1,0 +1,667 @@
+"""Pass 10: resource-lifecycle — every acquire reaches a release on ALL
+CFG paths, exception edges included.
+
+The last four review rounds each found the same runtime-invisible shape:
+a resource acquired, then an exception path that exits the function
+still holding it — round 12's pooled page buffers (release not yet in
+``finally``), round 13's lease orphaned between pick and send, round
+15's opportunistic cache budget bytes leaking when ``device_put`` failed
+unexpectedly.  This pass walks the :mod:`analyze.cfg` graph from every
+acquire site and demands that each path to the normal OR exceptional
+exit passes a release, a context-manager exit, or an explicit ownership
+transfer.
+
+**Vocabulary** — built in (the repo's acquire/release pairs) plus
+annotatable:
+
+==========  ============================================  ==============
+kind        acquire                                       release
+==========  ============================================  ==============
+budget      ``try_acquire`` / ``BudgetedResource.acquire``  ``release``
+pages       ``PagePool.acquire`` (+ annotated helpers)    ``release``
+credit      ``reserve_credit``                            ``return_credit``
+lease       ``grant_lease``                               ``retire_lease``
+span        ``open_span``                                 ``close_span``
+socket      ``socket.socket`` / ``create_connection`` /   ``close``
+            ``accept``
+file        ``open``                                      ``close``
+==========  ============================================  ==============
+
+New pairs join by annotating the helper functions::
+
+    def checkout(self):      # resource: acquire conn
+        ...
+    def giveback(self, s):   # resource: release conn
+        ...
+
+(the same carrying-comment grammar as ``# guarded-by:``; a third role,
+``escape``, marks a helper whose call transfers ownership elsewhere).
+Calls to an annotated function are acquire/release/escape events of
+that kind in every caller — the interprocedural half of the pass.
+
+**What discharges an obligation** on a path:
+
+- a matching release call — for built-in names the call must mention
+  the handle (receiver or argument) or the acquire's receiver
+  expression, so two live handles of one kind are tracked separately;
+  annotated releases discharge by kind (the author declared them);
+- context-manager form: an acquire that IS a ``with`` item is satisfied
+  by construction (the CFG's ``with_exit`` desugaring runs ``__exit__``
+  on every continuation), and release-in-``finally`` covers every path
+  because the ``finally`` body is duplicated onto each continuation;
+- **escape** — returning the handle, storing it into an attribute or
+  container (``e.budget = self._budget``, ``self._leases[rid] = lease``),
+  or handing it off inside a keyword/container argument
+  (``Thread(args=(conn,))``): ownership moved, the local obligation is
+  discharged — but a transfer into an attribute demands the module
+  contain SOME release of that kind, so a store can transfer an
+  obligation without ever silencing it.
+
+Kinds whose protocols report failure in-band rather than by raising
+(``lease``, ``credit`` — SafeConn.send never raises) are checked on
+normal paths only; everything else is checked on exception paths too.
+
+Granularity (documented limits): analysis is per function — ownership
+that crosses functions must go through an annotated helper or an
+escape; acquires bound through intermediate bool flags
+(``ok = b.try_acquire(n)`` … ``if ok:``) are tracked path-insensitively
+(write the ``if b.try_acquire(n):`` form, which seeds the true branch
+only); nested defs/lambdas are separate functions.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from collections import deque
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..cfg import build_cfg, calls_in
+from ..core import Finding, carrying_matches
+from ..project import Config, ModuleInfo, Project, _in_scope
+from ..registry import rule
+
+# -- vocabulary -------------------------------------------------------------
+
+# distinctive call names -> kind (receiver class not required)
+ACQUIRE_NAMES = {
+    "try_acquire": "budget",
+    "reserve_credit": "credit",
+    "grant_lease": "lease",
+    "open_span": "span",
+    "create_connection": "socket",
+    "accept": "socket",
+    "open": "file",  # bare-name open(...) only (see _acquire_of)
+}
+# (receiver class simple name, method) -> kind, for ambiguous names
+ACQUIRE_QUALIFIED = {
+    ("PagePool", "acquire"): "pages",
+    ("BudgetedResource", "acquire"): "budget",
+}
+# release call name -> kinds it can discharge
+RELEASE_NAMES = {
+    "release": {"budget", "pages", "credit"},
+    "close": {"socket", "file"},
+    "close_span": {"span"},
+    "retire_lease": {"lease"},
+    "return_credit": {"credit"},
+}
+# protocols that report failure in-band (never raise mid-protocol):
+# normal-path obligations only
+NO_EXC_KINDS = {"lease", "credit"}
+
+_RESOURCE_RE = re.compile(
+    r"#\s*resource:\s*(acquire|release|escape)\s+([A-Za-z_][\w\-]*)")
+
+_EXAMPLE = """\
+import socket
+
+def fetch(ep, req):
+    s = socket.create_connection(ep)   # acquires 'socket'
+    s.sendall(req)                     # can raise -> exits holding s
+    data = s.recv(1 << 16)
+    s.close()                          # too late for the raise path
+    return data
+    # fix: close in `finally`, use `with`, or return/store the handle
+"""
+
+
+def annotation_map(mod: ModuleInfo) -> Dict[int, "re.Match"]:
+    cached = getattr(mod, "_resource_ann", None)
+    if cached is None:
+        cached = mod._resource_ann = carrying_matches(mod.lines,
+                                                      _RESOURCE_RE)
+    return cached
+
+
+def _func_role_map(project: Project, config: Config):
+    """(simple func name -> (role, kind)) from ``# resource:``
+    annotations on defs across in-scope modules, plus findings for
+    annotations that bind to no function definition."""
+    roles: Dict[str, Tuple[str, str]] = {}
+    findings: List[Finding] = []
+    for modid, mod in project.modules.items():
+        if not _in_scope(modid, config.resource_scope):
+            continue
+        anns = annotation_map(mod)
+        if not anns:
+            continue
+        bound: Set[int] = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            span = range(node.lineno,
+                         (node.body[0].lineno if node.body
+                          else node.lineno) + 1)
+            hit = next((i for i in span if i in anns), None)
+            if hit is None:
+                continue
+            bound.add(hit)
+            m = anns[hit]
+            roles[node.name] = (m.group(1), m.group(2))
+        for line in sorted(set(anns) - bound):
+            if mod.suppressed("resource-lifecycle", line):
+                continue
+            findings.append(Finding(
+                "resource-lifecycle", mod.relpath, line,
+                "resource annotation binds no function: '# resource: "
+                "<acquire|release|escape> <kind>' must sit on (or carry "
+                "to) a def line"))
+    return roles, findings
+
+
+# -- expression helpers -----------------------------------------------------
+
+
+def _names_in(expr) -> Set[str]:
+    out: Set[str] = set()
+    stack = [expr]
+    while stack:
+        e = stack.pop()
+        if isinstance(e, ast.Name):
+            out.add(e.id)
+        if isinstance(e, ast.Lambda):
+            continue
+        stack.extend(ast.iter_child_nodes(e))
+    return out
+
+
+def _call_name(project: Project, mod: ModuleInfo, call: ast.Call):
+    """(simple name, resolved simple name or None, receiver expr)."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        name, recv = f.attr, f.value
+    elif isinstance(f, ast.Name):
+        name, recv = f.id, None
+    else:
+        return None, None, None
+    resolved = None
+    r = project.resolve(mod, f)
+    if r and r[0] == "func":
+        resolved = r[1].rsplit(".", 1)[-1]
+    return name, resolved, recv
+
+
+class _FuncCtx:
+    """Per-function resolution context for receiver classes."""
+
+    def __init__(self, project: Project, mod: ModuleInfo, ci, env):
+        self.project = project
+        self.mod = mod
+        self.ci = ci
+        self.env = env  # name -> class key
+
+    def class_of(self, expr) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            if expr.id in self.env:
+                return self.env[expr.id]
+            r = self.project.resolve(self.mod, expr)
+            if r and r[0] == "class":
+                return r[1]
+            return None
+        if isinstance(expr, ast.Attribute):
+            owner = self.class_of(expr.value)
+            if owner:
+                ci = self.project.classes.get(owner)
+                if ci and expr.attr in ci.attr_types:
+                    return ci.attr_types[expr.attr]
+        return None
+
+    def class_simple(self, expr) -> Optional[str]:
+        key = self.class_of(expr)
+        return key.rsplit(".", 1)[-1] if key else None
+
+
+def _acquire_of(fctx: _FuncCtx, roles, call: ast.Call) -> Optional[str]:
+    name, resolved, recv = _call_name(fctx.project, fctx.mod, call)
+    if name is None:
+        return None
+    for n in (resolved, name):
+        if n and n in roles and roles[n][0] == "acquire":
+            return roles[n][1]
+    # socket.socket(...)
+    if (name == "socket" and isinstance(recv, ast.Name)
+            and recv.id == "socket"):
+        return "socket"
+    if name == "open":
+        return "file" if recv is None else None  # bare open() only
+    if recv is not None:
+        cls = fctx.class_simple(recv)
+        if cls and (cls, name) in ACQUIRE_QUALIFIED:
+            return ACQUIRE_QUALIFIED[(cls, name)]
+    if name == "acquire":  # lock.acquire etc: never a resource here
+        return None
+    return ACQUIRE_NAMES.get(name)  # "open" already returned above
+
+
+def _releases_at(fctx: _FuncCtx, roles, node, kind: str,
+                 handles: Set[str], recv_dump: Optional[str]) -> bool:
+    """Does this node's evaluation discharge the obligation by RELEASE
+    (or by an escape-annotated helper call)?"""
+    for call in calls_in(node):
+        name, resolved, recv = _call_name(fctx.project, fctx.mod, call)
+        if name is None:
+            continue
+        for n in (resolved, name):
+            if n and n in roles and roles[n][0] in ("release", "escape") \
+                    and roles[n][1] == kind:
+                return True
+        kinds = RELEASE_NAMES.get(name)
+        if recv is not None and name in ("acquire", "release"):
+            cls = fctx.class_simple(recv)
+            if cls == "PagePool" and name == "release":
+                kinds = {"pages"}
+        if not kinds or kind not in kinds:
+            continue
+        # built-in names must mention the handle / acquire receiver so
+        # two live handles of one kind stay independent
+        if not handles and recv_dump is None:
+            return True
+        mention = set()
+        exprs = ([recv] if recv is not None else []) + list(call.args) \
+            + [k.value for k in call.keywords]
+        for e in exprs:
+            mention |= _names_in(e)
+        if handles & mention:
+            return True
+        if recv_dump is not None:
+            for e in exprs:
+                if ast.dump(e) == recv_dump:
+                    return True
+    return False
+
+
+def _escape_at(node, handles: Set[str],
+               recv_dump: Optional[str]) -> Optional[str]:
+    """Ownership transfer at this node: returns the escape form
+    (``"return"`` / attribute name / ``"handoff"``) or None."""
+    st = node.stmt
+
+    def mentions(e) -> bool:
+        if e is None:
+            return False
+        if handles & _names_in(e):
+            return True
+        if recv_dump is not None:
+            for sub in ast.walk(e):
+                if ast.dump(sub) == recv_dump:
+                    return True
+        return False
+
+    if isinstance(st, ast.Return) and mentions(st.value):
+        return "return"
+    if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = (st.targets if isinstance(st, ast.Assign)
+                   else [st.target])
+        value = st.value
+        if value is not None and mentions(value):
+            for t in targets:
+                if isinstance(t, ast.Attribute):
+                    return t.attr
+                if isinstance(t, ast.Subscript):
+                    base = t.value
+                    return (base.attr if isinstance(base, ast.Attribute)
+                            else getattr(base, "id", "container"))
+    # keyword / container argument hand-off (Thread(args=(conn,)) etc.)
+    for call in calls_in(node):
+        for kw in call.keywords:
+            if mentions(kw.value):
+                return "handoff"
+        for arg in call.args:
+            if isinstance(arg, (ast.Tuple, ast.List, ast.Dict, ast.Set)) \
+                    and mentions(arg):
+                return "handoff"
+    return None
+
+
+def _alias_closure(func, seeds: Set[str]) -> Set[str]:
+    """Names transitively bound from the handle: direct renames, tuple
+    re-packs, loop variables over a handle collection (``for cs in
+    cspans:``), and single-level wrapping calls with the handle as a
+    direct positional argument (``packed = PackedPages(geom, data, ...)``)."""
+    handles = set(seeds)
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(func):
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                if isinstance(node.iter, ast.Name) \
+                        and node.iter.id in handles \
+                        and isinstance(node.target, ast.Name) \
+                        and node.target.id not in handles:
+                    handles.add(node.target.id)
+                    changed = True
+                continue
+            if not isinstance(node, ast.Assign):
+                continue
+            v = node.value
+            direct: Set[str] = set()
+            if isinstance(v, ast.Name):
+                direct.add(v.id)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for e in v.elts:
+                    if isinstance(e, ast.Name):
+                        direct.add(e.id)
+            elif isinstance(v, ast.Call):
+                for a in v.args:
+                    if isinstance(a, ast.Name):
+                        direct.add(a.id)
+            if not (direct & handles):
+                continue
+            for t in node.targets:
+                tnames = [t] if isinstance(t, ast.Name) else (
+                    [e for e in t.elts if isinstance(e, ast.Name)]
+                    if isinstance(t, (ast.Tuple, ast.List)) else [])
+                for tn in tnames:
+                    if tn.id not in handles:
+                        handles.add(tn.id)
+                        changed = True
+    return handles
+
+
+def _none_guard(test, handles: Set[str]) -> Optional[str]:
+    """For ``if`` tests that check the handle (or the acquire receiver,
+    e.g. an Optional pool) against None/falsiness, the branch label on
+    which the resource is ABSENT (no obligation): ``if h is None:`` ->
+    "true", ``if h is not None:`` / ``if h:`` -> "false".  Optional
+    acquires (``open_span`` returns None when tracing is off) would
+    otherwise flag their None-arm early return."""
+    if isinstance(test, ast.Compare) and len(test.ops) == 1 \
+            and isinstance(test.left, ast.Name) \
+            and test.left.id in handles \
+            and len(test.comparators) == 1 \
+            and isinstance(test.comparators[0], ast.Constant) \
+            and test.comparators[0].value is None:
+        if isinstance(test.ops[0], ast.Is):
+            return "true"
+        if isinstance(test.ops[0], ast.IsNot):
+            return "false"
+    if isinstance(test, ast.Name) and test.id in handles:
+        return "false"
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not) \
+            and isinstance(test.operand, ast.Name) \
+            and test.operand.id in handles:
+        return "true"
+    return None
+
+
+# -- the pass ---------------------------------------------------------------
+
+
+def _iter_functions(project: Project, mod: ModuleInfo):
+    """(qualname, func node, ClassInfo or None) for every def, nested
+    included — each is analyzed against its own CFG."""
+
+    def walk(node, prefix, ci):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield f"{prefix}{child.name}", child, ci
+                yield from walk(child, f"{prefix}{child.name}.", None)
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, f"{prefix}{child.name}.",
+                                mod.classes.get(child.name))
+            else:
+                yield from walk(child, prefix, ci)
+
+    yield from walk(mod.tree, "", None)
+
+
+@rule("resource-lifecycle",
+      "acquired resources (budget bytes, pooled pages, sockets, spans, "
+      "leases) must reach a release on every CFG path, exception edges "
+      "included",
+      example=_EXAMPLE)
+def check_resource_lifecycle(project: Project,
+                             config: Config) -> List[Finding]:
+    roles, findings = _func_role_map(project, config)
+    transfers: List[tuple] = []  # (mod, kind, attr, qual, line)
+    release_kinds: Dict[str, Set[str]] = {}  # modid -> kinds released
+
+    for modid, mod in project.modules.items():
+        if not _in_scope(modid, config.resource_scope):
+            continue
+        kinds_here: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                name, resolved, _recv = _call_name(project, mod, node)
+                for n in (resolved, name):
+                    if n and n in roles and roles[n][0] == "release":
+                        kinds_here.add(roles[n][1])
+                if name in RELEASE_NAMES:
+                    kinds_here |= RELEASE_NAMES[name]
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # DEFINING a release helper counts too: the module that
+                # owns retire_lease() is a legitimate obligation home
+                if node.name in RELEASE_NAMES:
+                    kinds_here |= RELEASE_NAMES[node.name]
+                if node.name in roles and roles[node.name][0] == "release":
+                    kinds_here.add(roles[node.name][1])
+        release_kinds[modid] = kinds_here
+
+        for qual, func, ci in _iter_functions(project, mod):
+            env = project._param_env(mod, ci, func)
+            fctx = _FuncCtx(project, mod, ci, env)
+            cfg = build_cfg(func)
+            for f in _check_function(cfg, fctx, roles, qual, transfers):
+                if not mod.suppressed("resource-lifecycle", f.line):
+                    findings.append(f)
+
+    # a transfer into an attribute moves the obligation, it must not
+    # silence it: the receiving module needs SOME release of that kind
+    for mod, kind, attr, qual, line in transfers:
+        if kind in release_kinds.get(mod.modid, ()):
+            continue
+        if mod.suppressed("resource-lifecycle", line):
+            continue
+        findings.append(Finding(
+            "resource-lifecycle", mod.relpath, line,
+            f"{qual} transfers a {kind} obligation into attribute "
+            f"{attr!r} but the module releases no {kind} anywhere — "
+            f"the transfer silences the obligation instead of moving it"))
+
+    # findings can repeat across finally-duplicated CFG copies
+    seen: Set[tuple] = set()
+    out: List[Finding] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.message)):
+        if f.key() + (f.line,) in seen:
+            continue
+        seen.add(f.key() + (f.line,))
+        out.append(f)
+    return out
+
+
+def _check_function(cfg, fctx: _FuncCtx, roles, qual: str,
+                    transfers: List[tuple]) -> List[Finding]:
+    findings: List[Finding] = []
+    mod = fctx.mod
+    for node in cfg.nodes:
+        if node.kind != "stmt":
+            continue
+        st = node.stmt
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            continue  # context-manager acquisition: satisfied by design
+        if isinstance(st, ast.Return):
+            continue  # `return acquire()` escapes immediately
+        for call in calls_in(node):
+            kind = _acquire_of(fctx, roles, call)
+            if kind is None:
+                continue
+            handles, recv_dump, start_labels, transfer_attr = \
+                _bind_acquire(st, call)
+            if transfer_attr is not None:
+                transfers.append((mod, kind, transfer_attr, qual,
+                                  node.lineno))
+                continue
+            handles = _alias_closure(cfg.func, handles) if handles \
+                else handles
+            recv_name = (call.func.value.id
+                         if isinstance(call.func, ast.Attribute)
+                         and isinstance(call.func.value, ast.Name)
+                         else None)
+            verdict = _walk_paths(cfg, fctx, roles, node, start_labels,
+                                  kind, handles, recv_dump, transfers,
+                                  qual, recv_name)
+            if verdict is None:
+                continue
+            name = (call.func.attr if isinstance(call.func, ast.Attribute)
+                    else getattr(call.func, "id", "?"))
+            where = ("an exception path" if verdict == "exception"
+                     else "a normal path")
+            findings.append(Finding(
+                "resource-lifecycle", mod.relpath, node.lineno,
+                f"{qual} acquires {kind} via {name}() but {where} can "
+                f"exit without releasing it (release in finally, use a "
+                f"context manager, or transfer ownership)"))
+    return findings
+
+
+def _bind_acquire(st, call: ast.Call):
+    """(handle names, receiver dump, start edge labels, attr transfer).
+
+    An acquire assigned to attribute/subscript targets is an immediate
+    ownership transfer; an acquire in an ``if``/``while`` test holds
+    only on the true branch; otherwise the obligation starts on every
+    non-exception out edge."""
+    handles: Set[str] = set()
+    recv_dump = None
+    if isinstance(call.func, ast.Attribute):
+        recv_dump = ast.dump(call.func.value)
+    # the acquire's own name arguments are part of the obligation's
+    # identity: id-keyed protocols release by the same key
+    # (grant_lease(rid) ... retire_lease(rid)), byte-counted ones by the
+    # same count (try_acquire(n) ... release(n))
+    for a in call.args:
+        if isinstance(a, ast.Name):
+            handles.add(a.id)
+    if isinstance(st, (ast.Assign, ast.AnnAssign)):
+        targets = st.targets if isinstance(st, ast.Assign) else [st.target]
+        for t in targets:
+            if isinstance(t, ast.Name):
+                handles.add(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    if isinstance(e, ast.Name):
+                        handles.add(e.id)
+            elif isinstance(t, (ast.Attribute, ast.Subscript)):
+                attr = (t.attr if isinstance(t, ast.Attribute) else
+                        (t.value.attr if isinstance(t.value, ast.Attribute)
+                         else getattr(t.value, "id", "container")))
+                return handles, recv_dump, None, attr
+    if isinstance(st, (ast.If, ast.While)):
+        return handles, recv_dump, ("true",), None
+    return handles, recv_dump, ("norm", "true", "false", "back"), None
+
+
+def _walk_paths(cfg, fctx: _FuncCtx, roles, start, start_labels,
+                kind: str, handles: Set[str], recv_dump,
+                transfers: List[tuple], qual: str,
+                recv_name: Optional[str] = None) -> Optional[str]:
+    """None when every path discharges; else ``"normal"`` /
+    ``"exception"`` naming the worst leaking path class."""
+    check_exc = kind not in NO_EXC_KINDS
+    guard_names = set(handles)
+    if recv_name is not None:
+        guard_names.add(recv_name)  # `if pool is not None:` guards too
+    todo = deque()
+    for succ, lbl in start.succ:
+        if lbl == "exc":
+            continue  # the acquire itself raising means no acquisition
+        if lbl in start_labels:
+            todo.append((succ, False))
+    seen: Set[tuple] = set()
+    leak: Optional[str] = None
+    while todo:
+        node, via_exc = todo.popleft()
+        key = (node.idx, via_exc)
+        if key in seen:
+            continue
+        seen.add(key)
+        if node.kind == "exit":
+            leak = "normal" if not via_exc else (leak or "exception")
+            if leak == "normal":
+                return leak
+            continue
+        if node.kind == "raise":
+            if check_exc:
+                leak = leak or "exception"
+            continue
+        skip_label = None
+        # release-in-finally satisfies the pass BY CONTRACT: the finally
+        # runs on every continuation (the CFG duplicates it onto each),
+        # so a finalbody containing a matching release discharges at
+        # entry — without this, an earlier finally statement that can
+        # itself raise (pop_current() before close_span()) would
+        # manufacture a phantom leak path through its own cleanup
+        if node.kind == "join" and isinstance(node.stmt, ast.Try) \
+                and "/f-" in node.copy_tag \
+                and _lexical_release(fctx, roles, node.stmt.finalbody,
+                                     kind, handles, recv_dump):
+            continue
+        if node.kind == "stmt":
+            if _releases_at(fctx, roles, node, kind, handles, recv_dump):
+                continue
+            st = node.stmt
+            # `for cs in cspans: close_span(cs)` — releasing each
+            # element of a handle collection discharges the collection
+            if isinstance(st, (ast.For, ast.AsyncFor)) \
+                    and isinstance(st.iter, ast.Name) \
+                    and st.iter.id in handles \
+                    and _lexical_release(fctx, roles, st.body, kind,
+                                         handles, recv_dump):
+                continue
+            esc = _escape_at(node, handles, recv_dump)
+            if esc is not None:
+                if esc not in ("return", "handoff"):
+                    transfers.append((fctx.mod, kind, esc, qual,
+                                      node.lineno))
+                continue
+            if isinstance(st, ast.If):
+                skip_label = _none_guard(st.test, guard_names)
+        for succ, lbl in node.succ:
+            if lbl == skip_label:
+                continue  # the handle is None on this branch
+            nxt_exc = via_exc or lbl == "exc"
+            if lbl == "exc" and not check_exc:
+                continue
+            todo.append((succ, nxt_exc))
+    return leak
+
+
+class _LexNode:
+    """Adapter so _releases_at can scan a raw statement lexically."""
+
+    def __init__(self, stmt):
+        self.kind = "stmt"
+        self.stmt = stmt
+
+
+def _lexical_release(fctx: _FuncCtx, roles, body, kind: str,
+                     handles: Set[str], recv_dump) -> bool:
+    for st in body:
+        for sub in ast.walk(st):
+            if isinstance(sub, ast.Expr) or isinstance(sub, ast.stmt):
+                if _releases_at(fctx, roles, _LexNode(sub), kind,
+                                handles, recv_dump):
+                    return True
+    return False

@@ -32,9 +32,20 @@ def _except_names(type_node) -> Set[str]:
     return names
 
 
+_EXAMPLE = """\
+def run_piece(fn):
+    try:
+        return fn()
+    except Exception:            # eats RetryOOM: the retry loop never
+        return None              # sees its own control signal
+    # fix: catch the signal types explicitly first, or re-raise
+"""
+
+
 @rule("retry-protocol",
       "broad except that can swallow RetryOOM/SplitAndRetryOOM/"
-      "ShuffleCapacityExceeded without re-raising")
+      "ShuffleCapacityExceeded without re-raising",
+      example=_EXAMPLE)
 def check_retry_protocol(project: Project, config: Config) -> List[Finding]:
     findings: List[Finding] = []
     for modid, mod in project.modules.items():

@@ -30,9 +30,22 @@ def _load_categories(project: Project, config: Config) -> Set[str]:
     return cats
 
 
+_SEAM_EXAMPLE = """\
+from spark_rapids_jni_tpu.obs.seam import seam
+
+def launch(step):
+    ctx = seam("op", "launch:q5")   # string-literal category + manual
+    ctx.__enter__()                 # enter/exit: unpaired under faults
+    step()
+    ctx.__exit__(None, None, None)
+    # fix: `with seam(OP, "launch:q5"):` using the registered constant
+"""
+
+
 @rule("seam-discipline",
       "obs seam crossings must be context-managed with a registered "
-      "category constant")
+      "category constant",
+      example=_SEAM_EXAMPLE)
 def check_seam_discipline(project: Project, config: Config) -> List[Finding]:
     cats = _load_categories(project, config)
     findings: List[Finding] = []
@@ -105,9 +118,20 @@ def _load_event_kinds(project: Project, config: Config) -> Set[str]:
     return kinds
 
 
+_FLIGHT_EXAMPLE = """\
+from spark_rapids_jni_tpu.obs import flight
+
+def note(task_id):
+    flight.record("my_event", task_id)   # free-form string: falls out
+    # of every dump reconstruction; fix: define EV_MY_EVENT in
+    # obs/flight.py and record with the constant
+"""
+
+
 @rule("flight-discipline",
       "flight-recorder events must be emitted with registered EV_* "
-      "event-kind constants")
+      "event-kind constants",
+      example=_FLIGHT_EXAMPLE)
 def check_flight_discipline(project: Project, config: Config) -> List[Finding]:
     """A dump consumer (tools/flightdump.py, the converter's governance
     tracks, the chaos tests' completeness checks) keys on the event-kind

@@ -399,9 +399,25 @@ def check_event_pairs(project: Project, config: Config) -> List[Finding]:
 # --------------------------------------------------------------------------
 
 
+_EXAMPLE = """\
+# state-machine: lease field=state
+TRANSITIONS = {
+    "queued": ("leased",),
+    "leased": ("queued", "done"),
+    "done": (),
+}
+
+def finish(lease):
+    lease.state = "queued"       # unguarded write: no `== state` guard
+    # and no `# transition: lease <from>-><to>` annotation declaring
+    # which edge this is
+"""
+
+
 @rule("state-machine",
       "transition sites must match the declared state-machine tables; "
-      "paired flight events must be emitted on balanced paths")
+      "paired flight events must be emitted on balanced paths",
+      example=_EXAMPLE)
 def check_state_machines(project: Project, config: Config) -> List[Finding]:
     machines, findings = load_machines(project, config)
     by_module: Dict[str, Dict[str, _Machine]] = {}

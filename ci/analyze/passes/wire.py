@@ -400,9 +400,20 @@ def update_wire_ids(root: str, config: Config) -> int:
 # --------------------------------------------------------------------------
 
 
+_EXAMPLE = """\
+MSG_DISPATCH = "dispatch"
+MESSAGE_FIELDS = {MSG_DISPATCH: ("rid", "handler", "payload")}
+
+def dispatch(conn, rid, handler):
+    conn.send((MSG_DISPATCH, rid, handler))   # 3 fields declared, 2 sent
+    # the receiver positional unpack now reads the wrong columns
+"""
+
+
 @rule("wire-protocol",
       "RPC tuple messages must match the declared MESSAGE_FIELDS schema "
-      "on both sides; flight event wire ids are frozen append-only")
+      "on both sides; flight event wire ids are frozen append-only",
+      example=_EXAMPLE)
 def check_wire_protocol(project: Project, config: Config) -> List[Finding]:
     registry, findings = load_message_registry(project, config)
     if registry:

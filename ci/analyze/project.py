@@ -80,6 +80,20 @@ class Config:
     # repo-root-relative; the module whose EVENT_KINDS order defines ids
     flight_wire_ids_path: str = "ci/flight_wire_ids.json"
     flight_module: str = "obs.flight"
+    # pass 10 (resource-lifecycle): modules whose acquire/release pairs
+    # are path-checked over the CFG layer (cfg.py); obs/ rides along for
+    # span emission and the profiler/flight file handles
+    resource_scope: Tuple[str, ...] = ("mem.", "mem", "serve.", "serve",
+                                       "plans.", "plans",
+                                       "columnar.", "columnar",
+                                       "obs.", "obs")
+    # pass 11 (blocking-under-lock): modules whose lock-held regions are
+    # checked against the blocking-primitive registry (obs/ excluded:
+    # the fault injector SLEEPS by contract, the profiler's writer queue
+    # is the unbounded-by-design hand-off)
+    blocking_scope: Tuple[str, ...] = ("mem.", "mem", "serve.", "serve",
+                                       "plans.", "plans",
+                                       "columnar.", "columnar")
     rules: Optional[Set[str]] = None  # None -> all registered
 
 

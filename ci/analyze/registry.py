@@ -2,26 +2,43 @@
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from .core import Finding
 
-__all__ = ["RULES", "rule", "run_rules"]
+__all__ = ["RULES", "rule", "run_rules", "explain"]
 
-RULES: Dict[str, tuple] = {}  # id -> (fn, short description)
+RULES: Dict[str, tuple] = {}  # id -> (fn, short description, example)
 
 
-def rule(rule_id: str, doc: str):
+def rule(rule_id: str, doc: str, example: Optional[str] = None):
     def deco(fn):
-        RULES[rule_id] = (fn, doc)
+        RULES[rule_id] = (fn, doc, example)
         return fn
 
     return deco
 
 
+def explain(rule_id: str) -> str:
+    """One rule's full story for ``--explain``: the registered one-line
+    doc, the pass module's docstring (the invariant and its rationale),
+    and a minimal failing example when the pass registered one."""
+    fn, doc, example = RULES[rule_id]
+    import sys
+
+    mod_doc = (sys.modules[fn.__module__].__doc__ or "").strip()
+    parts = [f"{rule_id}: {doc}", ""]
+    if mod_doc:
+        parts += [mod_doc, ""]
+    if example:
+        parts += ["Minimal failing example:", "",
+                  "\n".join("    " + ln for ln in example.splitlines())]
+    return "\n".join(parts).rstrip() + "\n"
+
+
 def run_rules(project, config) -> List[Finding]:
     findings = list(project.errors)
-    for rule_id, (fn, _doc) in sorted(RULES.items()):
+    for rule_id, (fn, _doc, _example) in sorted(RULES.items()):
         if config.rules is not None and rule_id not in config.rules:
             continue
         findings.extend(fn(project, config))

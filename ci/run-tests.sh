@@ -10,7 +10,11 @@ python ci/lint.py
 # protocol-aware static analysis: fails on any un-baselined finding
 # (lock-order, unguarded-shared-state, retry-protocol, governed-allocation,
 # seam-discipline, flight-discipline, guarded-by, wire-protocol incl. the
-# frozen flight wire-id registry, state-machine — docs/STATIC_ANALYSIS.md)
+# frozen flight wire-id registry, state-machine, and — round 16, on the
+# CFG layer — resource-lifecycle (every acquire reaches a release on all
+# paths incl. exception edges) and blocking-under-lock (no blocking
+# primitive while holding a lock) — docs/STATIC_ANALYSIS.md; per-rule
+# docs + minimal failing examples via `python ci/analyze --explain <rule>`
 if [[ "${QUICK:-0}" == "1" ]]; then
     # inner loop: the content-hash cache + changed-only report keep this
     # sub-second when the tree matches the last full gate run
@@ -21,12 +25,28 @@ fi
 # full gate, with an asserted runtime budget: the analyze run must stay
 # fast as the repo grows (cold, cache-less worst case included)
 t0=$(date +%s)
-python ci/analyze
+python ci/analyze --no-cache
 t1=$(date +%s)
 if (( t1 - t0 > 60 )); then
     echo "analyze: full gate took $((t1 - t0))s, budget is 60s" >&2
     exit 1
 fi
+# ... and the content-hash cache must keep the unchanged-tree rerun
+# sub-second (what the QUICK inner loop and pre-commit hooks rely on)
+python ci/analyze > /dev/null   # warm the cache the --no-cache run skipped
+python - <<'PY'
+import subprocess, sys, time
+# best-of-3: the budget pins the CACHE, not the box's load average
+times = []
+for _ in range(3):
+    t0 = time.monotonic()
+    subprocess.run([sys.executable, "ci/analyze"], check=True,
+                   stdout=subprocess.DEVNULL)
+    times.append(time.monotonic() - t0)
+dt = min(times)
+print(f"analyze: cached unchanged-tree rerun {dt:.2f}s (best of 3)")
+assert dt < 1.0, f"cached rerun took {dt:.2f}s, budget is 1s"
+PY
 
 # One fresh interpreter per test file: XLA:CPU's JIT segfaults sporadically
 # in long-lived processes that have compiled hundreds of modules (reproduced

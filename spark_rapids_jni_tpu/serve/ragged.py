@@ -268,9 +268,9 @@ class RaggedDispatcher:
             extra=f"handler:{h.name}:pack:{req.task_id}"
                   f":riders:{len(group)}")
             for r in group]
-        if cspans[0] is not None:
-            _trace.push_current(cspans[0].ctx)
         try:
+            if cspans[0] is not None:
+                _trace.push_current(cspans[0].ctx)
             self._run_group(group, h, depth=0, min_pages=min_pages)
         finally:
             if cspans[0] is not None:

@@ -179,6 +179,14 @@ class SafeConn:
                                    f"{self._send_timeout_s:g}s:"
                                    f"tag:{msg[0] if msg else '?'}")
                         return False
+                # analyze: ignore[blocking-under-lock] - the send lock
+                # EXISTS to serialize this pipe write (heartbeat thread +
+                # result waiters share one fd; interleaved pickles would
+                # corrupt the stream), and the select() guard above
+                # bounds the wait for buffer space, so this is the one
+                # place a pipe write may block while holding it.  The
+                # hung-lease supervision bound backstops the residual
+                # giant-message case (class docstring).
                 self._conn.send(msg)
             return True
         # analyze: ignore[retry-protocol] - pipe serialization crosses no

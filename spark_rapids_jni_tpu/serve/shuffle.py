@@ -148,9 +148,13 @@ class ShuffleService:
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ShuffleService":
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        s.bind(("127.0.0.1", 0))
-        s.listen(64)
+        try:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", 0))
+            s.listen(64)
+        except BaseException:
+            s.close()  # a failed bind must not leak the listener fd
+            raise
         with self._cond:
             if self._sock is not None:  # idempotent: already serving
                 s.close()
@@ -460,26 +464,33 @@ class ShuffleService:
         s = self._conn_acquire(tuple(ep))
         if s is None:
             return None, "stall"
+        # one finally owns the socket on EVERY path out of the exchange:
+        # a clean round trip returns the connection to the pool, anything
+        # else — I/O error, EOF, a damaged frame that may leave the byte
+        # stream unframeable (injected truncation closes it server-side
+        # anyway), or an unexpected fault — drops it, so no path can
+        # leak the fd or pool a poisoned stream
+        keep = False
         try:
-            s.settimeout(self.io_timeout_s)
-            s.sendall(frames.encode_frame(
-                (frames.FR_FETCH, sid, m, p, -1)))
-            raw = _read_frame_bytes(s)
-        except (OSError, socket.timeout):
-            self._conn_drop(s)
-            return None, "stall"
-        if raw is None:
-            self._conn_drop(s)
-            return None, "eof"
-        try:
-            meta, bufs = frames.decode_frame(raw)
-        except frames.FrameError as e:
-            # a damaged frame may leave the byte stream unframeable
-            # (injected truncation closes it server-side anyway): never
-            # reuse this connection
-            self._conn_drop(s)
-            return None, e.reason
-        self._conn_release(tuple(ep), s)
+            try:
+                s.settimeout(self.io_timeout_s)
+                s.sendall(frames.encode_frame(
+                    (frames.FR_FETCH, sid, m, p, -1)))
+                raw = _read_frame_bytes(s)
+            except (OSError, socket.timeout):
+                return None, "stall"
+            if raw is None:
+                return None, "eof"
+            try:
+                meta, bufs = frames.decode_frame(raw)
+            except frames.FrameError as e:
+                return None, e.reason
+            keep = True
+        finally:
+            if keep:
+                self._conn_release(tuple(ep), s)
+            else:
+                self._conn_drop(s)
         tag = meta[0]
         if tag == frames.FR_NACK:
             _, _sid, _map_index, _part, reason = meta
@@ -489,9 +500,12 @@ class ShuffleService:
         return ("socket", frames.decode_table(meta, bufs)), None
 
     def _conn_acquire(self, ep: tuple) -> Optional[socket.socket]:
+        # resource: acquire socket
         """An idle pooled connection to ``ep``, or a fresh one; a socket
         is checked out exclusively (request/response framing must never
-        interleave across handler threads)."""
+        interleave across handler threads).  Every checkout must reach
+        :meth:`_conn_release` (pool it) or :meth:`_conn_drop` (close it)
+        on all paths — the resource-lifecycle gate pins this."""
         with self._conn_lock:
             idle = self._conns.get(ep)
             if idle:
@@ -503,6 +517,7 @@ class ShuffleService:
             return None
 
     def _conn_release(self, ep: tuple, s: socket.socket) -> None:
+        # resource: release socket
         with self._conn_lock:
             idle = self._conns.setdefault(ep, [])
             if len(idle) < 2 and not self._stop.is_set():
@@ -512,6 +527,7 @@ class ShuffleService:
 
     @staticmethod
     def _conn_drop(s: socket.socket) -> None:
+        # resource: release socket
         try:
             s.close()
         except OSError:

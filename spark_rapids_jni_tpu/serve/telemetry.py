@@ -85,10 +85,22 @@ class TelemetryExporter:
         # shared between the heartbeat thread (periodic exports) and
         # result-waiter threads (the force-flush that makes a completed
         # request's spans survive a SIGKILL landing before the next
-        # beat) — one leaf lock serializes the cursor bookkeeping
+        # beat) — one leaf lock serializes the CURSOR BOOKKEEPING ONLY.
+        # The pipe send itself runs OUTSIDE the lock (round-16 fix,
+        # blocking-under-lock gate): the bounded-time SafeConn send can
+        # still cost its full timeout against a stalled supervisor, and
+        # holding the lock across it made every concurrent force-flush
+        # queue behind that stall.  `_inflight` hands the window to one
+        # sender at a time, so snapshots never overlap and the cursor
+        # stays exactly-once; a force arriving mid-send parks in
+        # `_force_pending` and the in-flight sender drains it — the
+        # completed request's spans still leave before the next beat,
+        # without a second thread ever blocking.
         self._lock = threading.Lock()
         self._cursor = 0  # guarded-by: _lock
         self._last_t = -1e9  # guarded-by: _lock
+        self._inflight = False  # guarded-by: _lock
+        self._force_pending = False  # guarded-by: _lock
         # after a failed send, FORCE flushes stand down until the pipe
         # proves drained (a periodic export succeeds): each failed
         # attempt costs the sender the SafeConn guard's full timeout, so
@@ -109,22 +121,60 @@ class TelemetryExporter:
         the same window (the ring is the retention bound).  ``force``
         bypasses the pacing: result waiters flush at completion so a
         request's spans are off-process BEFORE a kill can eat them."""
-        with self._lock:
-            return self._export_locked(send, force)
+        ok = True
+        while True:
+            with self._lock:
+                plan = self._plan_locked(force)
+            if plan is None:
+                return ok
+            events, cursor = plan
+            # the window is claimed (_inflight): the commit MUST run
+            # even if the caller-supplied send raises, or every future
+            # export would skip at the inflight check forever
+            sent = False
+            try:
+                metrics = {}
+                if self._metrics_source is not None:
+                    try:
+                        metrics = dict(self._metrics_source())
+                    # analyze: ignore[retry-protocol] - sampling a
+                    # metrics snapshot for export: a failing sampler
+                    # (engine mid-shutdown) degrades to an empty
+                    # snapshot, never a wedged heartbeat thread
+                    except Exception:  # noqa: BLE001
+                        metrics = {}
+                sent = send((rpc.MSG_TELEMETRY, self.worker_id,
+                             self.incarnation, time.time(),
+                             time.monotonic_ns(), events, metrics))
+            finally:
+                with self._lock:
+                    again = self._commit_locked(sent, cursor,
+                                                len(events))
+            ok = ok and sent
+            if not again:
+                return ok
+            force = True  # drain the force that arrived mid-send
 
-    def _export_locked(self, send: Callable[[tuple], bool],
-                       force: bool) -> bool:
+    def _plan_locked(self, force: bool):
+        """Claim the next export window, or None when there is nothing
+        to send (paced, cooled down, empty, or another sender owns the
+        pipe right now — a force then parks in ``_force_pending``)."""
+        if self._inflight:
+            if force:
+                self._force_pending = True
+            self.stats["paced"] += 1
+            return None
         now = time.monotonic()
         if force and self._fail_cooldown:
             # stalled pipe: only the heartbeat-paced path keeps probing
             self.stats["paced"] += 1
-            return True
+            return None
         if not force and now - self._last_t < self.min_period_s:
             self.stats["paced"] += 1
-            return True
+            return None
         events, cursor = self._recorder.snapshot_since(self._cursor)
         if not events and force:
-            return True  # a flush with nothing new costs nothing
+            return None  # a flush with nothing new costs nothing
         if len(events) > self.max_events:
             # ship the newest, count the trim loudly: one giant post-storm
             # delta must not wedge the pipe behind it
@@ -134,19 +184,15 @@ class TelemetryExporter:
             _flight.record(_flight.EV_TELEMETRY_DROP, -1,
                            detail=f"worker:{self.worker_id}:trimmed",
                            value=dropped)
-        metrics = {}
-        if self._metrics_source is not None:
-            try:
-                metrics = dict(self._metrics_source())
-            # analyze: ignore[retry-protocol] - sampling a metrics
-            # snapshot for export: a failing sampler (engine mid-
-            # shutdown) degrades to an empty snapshot, never a wedged
-            # heartbeat thread
-            except Exception:  # noqa: BLE001
-                metrics = {}
-        ok = send((rpc.MSG_TELEMETRY, self.worker_id, self.incarnation,
-                   time.time(), time.monotonic_ns(), events, metrics))
-        if not ok:
+        self._inflight = True
+        return events, cursor
+
+    def _commit_locked(self, sent: bool, cursor: int,
+                       n_events: int) -> bool:
+        """Settle one send; True when a parked force needs draining."""
+        self._inflight = False
+        pending, self._force_pending = self._force_pending, False
+        if not sent:
             # stalled/retired pipe: skip — NEVER block or exit.  The
             # cursor stays put, so the window re-ships when the pipe
             # drains; events older than the ring just age out.  Force
@@ -158,16 +204,16 @@ class TelemetryExporter:
             return False
         self._fail_cooldown = False
         self._cursor = cursor
-        self._last_t = now
+        self._last_t = time.monotonic()
         self.stats["exports"] += 1
-        self.stats["events"] += len(events)
+        self.stats["events"] += n_events
         if not self._announced:
             self._announced = True
             _flight.record(_flight.EV_TELEMETRY_EXPORT, -1,
                            detail=f"worker:{self.worker_id}:"
                                   f"inc:{self.incarnation}:up",
-                           value=len(events))
-        return True
+                           value=n_events)
+        return pending
 
 
 class ClusterTimeline:
@@ -293,10 +339,14 @@ class TelemetryServer:
 
     def start(self) -> "TelemetryServer":
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        s.bind(("127.0.0.1", self._port))
-        s.listen(16)
-        s.settimeout(0.25)
+        try:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", self._port))
+            s.listen(16)
+            s.settimeout(0.25)
+        except BaseException:
+            s.close()  # a failed bind (port taken) must not leak the fd
+            raise
         self._sock = s
         self.endpoint = s.getsockname()
         self._thread = threading.Thread(target=self._serve_loop,
@@ -313,11 +363,13 @@ class TelemetryServer:
                 continue
             except OSError:
                 return  # closed under us during shutdown
-            # accepted sockets do NOT inherit the listener's timeout: a
-            # consumer that connects and never reads (suspended servetop)
-            # must cost one bounded write, not wedge the endpoint thread
-            conn.settimeout(5.0)
             try:
+                # accepted sockets do NOT inherit the listener's
+                # timeout: a consumer that connects and never reads
+                # (suspended servetop) must cost one bounded write, not
+                # wedge the endpoint thread.  Inside the try so even a
+                # failing setsockopt cannot leak the accepted fd.
+                conn.settimeout(5.0)
                 try:
                     view = self._view_source()
                 # analyze: ignore[retry-protocol] - building the view

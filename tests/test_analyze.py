@@ -1841,3 +1841,537 @@ def test_lint_url_exemption_is_narrow(tmp_path):
     chatter = "x = 1  # not a url, just mentions http somewhere " + "y" * 60
     assert len(chatter) > lint.MAX_LINE
     assert lint._overlong_without_urls(chatter)
+
+
+# ---------------------------------------------- resource-lifecycle (pass 10)
+
+
+def test_resource_leak_on_exception_path_flagged(tmp_path):
+    # the round-12 review shape: acquire, a call that can raise, release
+    # only on the straight-line path
+    root = write_pkg(tmp_path, {"serve/conn.py": """
+        import socket
+
+
+        def fetch(ep, req):
+            s = socket.create_connection(ep)
+            s.sendall(req)
+            data = s.recv(1 << 16)
+            s.close()
+            return data
+    """})
+    fs = run(root, rules=["resource-lifecycle"])
+    assert len(fs) == 1 and fs[0].rule == "resource-lifecycle"
+    assert "socket" in fs[0].message and "exception" in fs[0].message
+
+
+def test_resource_release_in_finally_clean(tmp_path):
+    root = write_pkg(tmp_path, {"serve/conn.py": """
+        import socket
+
+
+        def fetch(ep, req):
+            s = socket.create_connection(ep)
+            try:
+                s.sendall(req)
+                return s.recv(1 << 16)
+            finally:
+                s.close()
+    """})
+    assert run(root, rules=["resource-lifecycle"]) == []
+
+
+def test_resource_context_manager_clean(tmp_path):
+    root = write_pkg(tmp_path, {"serve/conn.py": """
+        import socket
+
+
+        def fetch(ep, req):
+            with socket.create_connection(ep) as s:
+                s.sendall(req)
+                return s.recv(1 << 16)
+
+
+        def read(path):
+            with open(path, "rb") as f:
+                return f.read()
+    """})
+    assert run(root, rules=["resource-lifecycle"]) == []
+
+
+def test_resource_escape_by_return_clean(tmp_path):
+    root = write_pkg(tmp_path, {"serve/conn.py": """
+        import socket
+
+
+        def checkout(ep):
+            return socket.create_connection(ep)
+
+
+        def checkout2(ep):
+            s = socket.create_connection(ep)
+            return s
+    """})
+    assert run(root, rules=["resource-lifecycle"]) == []
+
+
+def test_resource_attr_transfer_needs_module_release(tmp_path):
+    # storing the handle transfers the obligation — but only a module
+    # that releases the kind SOMEWHERE can receive it
+    silenced = write_pkg(tmp_path / "a", {"serve/conn.py": """
+        import socket
+
+
+        class Holder:
+            def start(self, ep):
+                self._sock = socket.create_connection(ep)
+    """})
+    fs = run(silenced, rules=["resource-lifecycle"])
+    assert len(fs) == 1 and "transfers" in fs[0].message
+    moved = write_pkg(tmp_path / "b", {"serve/conn.py": """
+        import socket
+
+
+        class Holder:
+            def start(self, ep):
+                self._sock = socket.create_connection(ep)
+
+            def close(self):
+                self._sock.close()
+    """})
+    assert run(moved, rules=["resource-lifecycle"]) == []
+
+
+def test_resource_conditional_try_acquire(tmp_path):
+    # `if budget.try_acquire(n):` seeds the true branch only: the false
+    # branch holds nothing, the true branch must release on every path
+    bad = write_pkg(tmp_path / "a", {"plans/c.py": """
+        class Cache:
+            def __init__(self, budget):
+                self._budget = budget
+
+            def put(self, n, v):
+                if self._budget.try_acquire(n):
+                    self._store(v)
+    """})
+    fs = run(bad, rules=["resource-lifecycle"])
+    assert len(fs) == 1 and "budget" in fs[0].message
+    good = write_pkg(tmp_path / "b", {"plans/c.py": """
+        class Cache:
+            def __init__(self, budget):
+                self._budget = budget
+
+            def put(self, n, v):
+                if self._budget.try_acquire(n):
+                    try:
+                        self._store(v)
+                    finally:
+                        self._budget.release(n)
+    """})
+    assert run(good, rules=["resource-lifecycle"]) == []
+
+
+def test_resource_annotated_pair_and_none_guard(tmp_path):
+    # `# resource:` annotations declare new acquire/release helpers; a
+    # `if s is None: return` arm carries no obligation
+    root = write_pkg(tmp_path, {"serve/pool.py": """
+        class Pool:
+            def checkout(self, ep):
+                # resource: acquire socket
+                return self._idle.pop() if self._idle else None
+
+            def giveback(self, s):
+                # resource: release socket
+                self._idle.append(s)
+
+            def fetch(self, ep, req):
+                s = self.checkout(ep)
+                if s is None:
+                    return None
+                try:
+                    s.sendall(req)
+                    return s.recv(1 << 16)
+                finally:
+                    self.giveback(s)
+
+            def fetch_leaky(self, ep, req):
+                s = self.checkout(ep)
+                if s is None:
+                    return None
+                s.sendall(req)
+                data = s.recv(1 << 16)
+                self.giveback(s)
+                return data
+    """})
+    fs = run(root, rules=["resource-lifecycle"])
+    assert len(fs) == 1
+    assert "fetch_leaky" in fs[0].message
+
+
+def test_resource_dangling_annotation_flagged(tmp_path):
+    root = write_pkg(tmp_path, {"serve/pool.py": """
+        X = 1
+
+        # resource: acquire socket
+
+        Y = 2
+    """})
+    fs = run(root, rules=["resource-lifecycle"])
+    assert len(fs) == 1 and "binds no function" in fs[0].message
+
+
+def test_resource_suppression_and_baseline(tmp_path):
+    src = """
+        import socket
+
+
+        def fetch(ep, req):
+            # analyze: ignore[resource-lifecycle] - test fixture
+            s = socket.create_connection(ep)
+            s.sendall(req)
+            return s.recv(1 << 16)
+    """
+    root = write_pkg(tmp_path / "a", {"serve/conn.py": src})
+    assert run(root, rules=["resource-lifecycle"]) == []
+    # baseline machinery is shared: the un-suppressed twin is absorbed
+    leaky = write_pkg(tmp_path / "b", {"serve/conn.py":
+                                       src.replace("# analyze: ignore["
+                                                   "resource-lifecycle]"
+                                                   " - test fixture",
+                                                   "")})
+    fs = run(leaky, rules=["resource-lifecycle"])
+    assert len(fs) == 1
+    bl_path = str(tmp_path / "bl.json")
+    analyze.Baseline.write(bl_path, fs)
+    new, n_base, n_stale = analyze.Baseline(bl_path).split(fs)
+    assert new == [] and n_base == 1 and n_stale == 0
+
+
+# ---------------------------------------------- blocking-under-lock (pass 11)
+
+
+def test_blocking_sleep_under_lock_flagged(tmp_path):
+    root = write_pkg(tmp_path, {"serve/p.py": """
+        import threading
+        import time
+
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def drain(self):
+                with self._lock:
+                    time.sleep(0.5)
+
+            def drain_ok(self):
+                with self._lock:
+                    n = 1
+                time.sleep(0.5)
+                return n
+    """})
+    fs = run(root, rules=["blocking-under-lock"])
+    assert len(fs) == 1
+    assert "time.sleep" in fs[0].message and "Pool._lock" in fs[0].message
+
+
+def test_blocking_propagates_through_self_calls(tmp_path):
+    root = write_pkg(tmp_path, {"serve/p.py": """
+        import threading
+        import time
+
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _flush(self):
+                time.sleep(0.5)
+
+            def pump(self):
+                with self._lock:
+                    self._flush()
+    """})
+    fs = run(root, rules=["blocking-under-lock"])
+    assert len(fs) == 1
+    assert "_flush" in fs[0].message and "time.sleep" in fs[0].message
+
+
+def test_blocking_bounded_calls_clean(tmp_path):
+    root = write_pkg(tmp_path, {"serve/p.py": """
+        import threading
+
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def tidy(self, t, q, d, k):
+                with self._lock:
+                    t.join(0.5)
+                    q.get(timeout=1.0)
+                    q.put(1, timeout=1.0)
+                    v = d.get(k)
+                    label = ", ".join(["a", "b"])
+                return v, label
+    """})
+    assert run(root, rules=["blocking-under-lock"]) == []
+
+
+def test_blocking_wait_on_own_condition_exempt(tmp_path):
+    root = write_pkg(tmp_path, {"serve/p.py": """
+        import threading
+
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition()
+
+            def waiter(self):
+                with self._cond:
+                    self._cond.wait()
+
+            def bad(self):
+                with self._lock:
+                    with self._cond:
+                        self._cond.wait()
+    """})
+    fs = run(root, rules=["blocking-under-lock"])
+    assert len(fs) == 1
+    assert "Pool.bad" in fs[0].message or "bad" in fs[0].message
+    assert "Pool._lock" in fs[0].message
+
+
+def test_blocking_queue_receiver_heuristic(tmp_path):
+    root = write_pkg(tmp_path, {"serve/p.py": """
+        import threading
+
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def pump(self):
+                with self._lock:
+                    return self._queue.get()
+
+            def lookup(self, k):
+                with self._lock:
+                    return self._cache.get(k)
+    """})
+    fs = run(root, rules=["blocking-under-lock"])
+    assert len(fs) == 1 and "queue.get" in fs[0].message
+
+
+def test_blocking_suppression(tmp_path):
+    root = write_pkg(tmp_path, {"serve/p.py": """
+        import threading
+        import time
+
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def drain(self):
+                with self._lock:
+                    # analyze: ignore[blocking-under-lock] - fixture
+                    time.sleep(0.5)
+    """})
+    assert run(root, rules=["blocking-under-lock"]) == []
+
+
+# ---------------------- mutation gate: the three historical bug shapes
+
+
+def test_tamper_pr11_finally_release_shape(tmp_path):
+    """Round 12's pooled page buffers: release must sit in finally; the
+    pre-review form (release after the launch) leaks on a fault."""
+    fixed = write_pkg(tmp_path / "a", {"columnar/pg.py": """
+        def pack_ragged(rows, page_rows, pool):
+            # resource: acquire pages
+            return pool.acquire(page_rows)
+
+
+        def tick(pool, rows, launch):
+            packed = pack_ragged(rows, 256, pool)
+            try:
+                return launch(packed)
+            finally:
+                pool.release(packed)
+    """})
+    assert run(fixed, rules=["resource-lifecycle"]) == []
+    tampered = write_pkg(tmp_path / "b", {"columnar/pg.py": """
+        def pack_ragged(rows, page_rows, pool):
+            # resource: acquire pages
+            return pool.acquire(page_rows)
+
+
+        def tick(pool, rows, launch):
+            packed = pack_ragged(rows, 256, pool)
+            out = launch(packed)
+            pool.release(packed)
+            return out
+    """})
+    fs = run(tampered, rules=["resource-lifecycle"])
+    assert len(fs) == 1
+    assert "pages" in fs[0].message and "exception" in fs[0].message
+
+
+def test_tamper_pr12_send_under_lock_shape(tmp_path):
+    """Round 13's SafeConn wedge: a pipe send while holding the send
+    lock blocks every other sender behind a stalled peer."""
+    fixed = write_pkg(tmp_path / "a", {"serve/sc.py": """
+        import threading
+
+
+        class SafeConn:
+            def __init__(self, conn):
+                self._conn = conn
+                self._send_lock = threading.Lock()
+                self._pending = []
+
+            def send(self, msg):
+                with self._send_lock:
+                    self._pending.append(msg)
+                return True
+    """})
+    assert run(fixed, rules=["blocking-under-lock"]) == []
+    tampered = write_pkg(tmp_path / "b", {"serve/sc.py": """
+        import threading
+
+
+        class SafeConn:
+            def __init__(self, conn):
+                self._conn = conn
+                self._send_lock = threading.Lock()
+
+            def send(self, msg):
+                with self._send_lock:
+                    self._conn.send(msg)
+                return True
+    """})
+    fs = run(tampered, rules=["blocking-under-lock"])
+    assert len(fs) == 1
+    assert "send" in fs[0].message and "_send_lock" in fs[0].message
+
+
+def test_tamper_pr12_pick_vs_send_lease_orphan_shape(tmp_path):
+    """Round 13's orphaned lease: a failed send must reclaim the lease
+    it just granted — returning without retiring strands it forever."""
+    fixed = write_pkg(tmp_path / "a", {"serve/sup.py": """
+        class Router:
+            def __init__(self):
+                self._live = {}
+
+            def grant_lease(self, rid):
+                return object()
+
+            def retire_lease(self, rid):
+                pass
+
+            def dispatch(self, rid, conn, msg):
+                lease = self.grant_lease(rid)
+                ok = conn.send(msg)
+                if not ok:
+                    self.retire_lease(rid)
+                    return False
+                self._live[rid] = lease
+                return True
+    """})
+    assert run(fixed, rules=["resource-lifecycle"]) == []
+    tampered = write_pkg(tmp_path / "b", {"serve/sup.py": """
+        class Router:
+            def __init__(self):
+                self._live = {}
+
+            def grant_lease(self, rid):
+                return object()
+
+            def retire_lease(self, rid):
+                pass
+
+            def dispatch(self, rid, conn, msg):
+                lease = self.grant_lease(rid)
+                ok = conn.send(msg)
+                if not ok:
+                    return False
+                self._live[rid] = lease
+                return True
+    """})
+    fs = run(tampered, rules=["resource-lifecycle"])
+    assert len(fs) == 1
+    assert "lease" in fs[0].message and "normal" in fs[0].message
+
+
+# ----------------------------------------------------------- the CFG layer
+
+
+def test_cfg_shapes():
+    import ast as _ast
+
+    sys.path.insert(0, os.path.join(REPO_ROOT, "ci"))
+    from analyze.cfg import build_cfg, can_raise
+
+    tree = _ast.parse(textwrap.dedent("""
+        def f(x):
+            a = g(x)
+            try:
+                b = h(a)
+            finally:
+                r(a)
+            with cm(a) as s:
+                use(s)
+            return b
+    """))
+    cfg = build_cfg(tree.body[0])
+    kinds = {n.kind for n in cfg.nodes}
+    assert {"entry", "exit", "raise", "stmt", "with_exit"} <= kinds
+    # the finally body is duplicated per continuation: >= 2 copies of
+    # the release statement, with distinct copy tags
+    rels = [n for n in cfg.nodes
+            if n.kind == "stmt" and n.lineno == 7]  # the r(a) release
+    assert len(rels) >= 2
+    assert len({n.copy_tag for n in rels}) == len(rels)
+    # calls raise; constant assignments do not
+    assert can_raise(_ast.parse("a = g(x)").body[0])
+    assert not can_raise(_ast.parse("a = True").body[0])
+    # every exception edge eventually reaches the raise exit
+    raising = [n for n in cfg.nodes
+               for s, lbl in n.succ if lbl == "exc"]
+    assert raising
+    blocks = cfg.basic_blocks()
+    assert sum(len(b) for b in blocks) == len(cfg.nodes)
+
+
+# ------------------------------------------------------------- --explain
+
+
+def test_every_rule_has_doc_and_example():
+    for rid, (fn, doc, example) in analyze.RULES.items():
+        assert doc, rid
+        assert example and example.strip(), f"{rid} has no example"
+
+
+def test_cli_explain(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "ci", "analyze"),
+         "--explain", "resource-lifecycle"],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "resource-lifecycle:" in proc.stdout
+    assert "Minimal failing example" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "ci", "analyze"),
+         "--explain", "all"],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert proc.returncode == 0
+    assert proc.stdout.count("Minimal failing example") == len(
+        analyze.RULES)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "ci", "analyze"),
+         "--explain", "bogus-rule"],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert proc.returncode == 2
+    assert "unknown rule" in proc.stdout

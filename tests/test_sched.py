@@ -264,3 +264,96 @@ def test_queue_shrink_purge_vs_pop_is_loss_free(order):
     q.task_done()
     assert q.outstanding() == 0
     assert q.depth() == 0
+
+
+# ------------------------------------- telemetry exporter send-outside-lock
+
+
+def _mk_exporter_and_recorder(n_events):
+    from spark_rapids_jni_tpu.obs import flight
+    from spark_rapids_jni_tpu.serve.telemetry import TelemetryExporter
+
+    rec = flight.FlightRecorder(ring_size=256)
+    for i in range(n_events):
+        rec.record(flight.EV_TASK_DONE, i)
+    ex = TelemetryExporter(0, 0, recorder=rec, min_period_s=0.0,
+                           max_events=256)
+    return ex, rec
+
+
+@pytest.mark.parametrize("order", [["beat", "force"], ["force", "beat"]])
+def test_telemetry_export_exactly_once_under_interleaving(order):
+    """Round-16 regression (blocking-under-lock pass finding): the
+    exporter used to hold its leaf lock ACROSS the pipe send, so a
+    force-flush racing a paced export queued behind the whole send.  Now
+    the lock covers cursor bookkeeping only; under BOTH adversarial
+    lock-acquisition orderings every ring event still ships exactly
+    once and no delta window is ever snapshotted twice."""
+    from spark_rapids_jni_tpu.obs import flight
+
+    ex, rec = _mk_exporter_and_recorder(8)
+    sched = Interleaver(order * 6)
+    ex._lock = sched.wrap_lock(ex._lock)
+    sent = []
+    sent_lock = threading.Lock()
+
+    def send(msg):
+        with sent_lock:
+            sent.append(msg)
+        return True
+
+    errors = sched.run({
+        "beat": lambda: ex.export(send),
+        "force": lambda: ex.export(send, force=True),
+    })
+    assert errors == {}
+    seqs = [e["seq"] for msg in sent for e in msg[5]]
+    assert sorted(seqs) == sorted(set(seqs)), "an event shipped twice"
+    # whatever the ordering, the union is the full ring
+    assert len(set(seqs)) == 8
+    with ex._lock._lock if hasattr(ex._lock, "_lock") else ex._lock:
+        assert ex._inflight is False and ex._force_pending is False
+
+
+def test_telemetry_force_flush_skips_while_send_inflight():
+    """The bug shape itself: a sender stalled INSIDE the pipe send must
+    not make a concurrent force-flush block on the exporter lock.  The
+    force returns immediately (parking its request), and the stalled
+    sender drains the parked force after its send completes — all
+    events still delivered exactly once."""
+    ex, rec = _mk_exporter_and_recorder(4)
+    from spark_rapids_jni_tpu.obs import flight
+
+    in_send = threading.Event()
+    release_send = threading.Event()
+    sent = []
+    sent_lock = threading.Lock()
+
+    def slow_send(msg):
+        with sent_lock:
+            sent.append(msg)
+        in_send.set()
+        assert release_send.wait(5.0)
+        return True
+
+    def fast_send(msg):  # pragma: no cover - must never be used
+        raise AssertionError("force flush must skip, not send")
+
+    t = threading.Thread(target=lambda: ex.export(slow_send), daemon=True)
+    t.start()
+    assert in_send.wait(5.0)
+    # the beat thread is parked INSIDE its send.  Old code: this call
+    # blocks until release_send fires.  New code: returns immediately.
+    t0 = time.monotonic()
+    assert ex.export(fast_send, force=True) is True
+    assert time.monotonic() - t0 < 1.0, "force flush blocked on the send"
+    # new work arrives while the send is stalled
+    rec.record(flight.EV_TASK_DONE, 99)
+    release_send.set()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    # the parked force was drained by the in-flight sender: both the
+    # original window and the late event shipped, exactly once each
+    seqs = [e["seq"] for msg in sent for e in msg[5]]
+    assert sorted(seqs) == sorted(set(seqs))
+    assert len(set(seqs)) == 5
