@@ -700,6 +700,90 @@ def main(argv=None):
 
     _stage(detail, "q3_star_join", _q3, nbytes=int(min(n, 1 << 22) * 8))
 
+    # ---- config 6: the order-sensitive tier (round 16) --------------------
+    no = min(n, 1 << 20)
+
+    def _sort_1m():
+        from spark_rapids_jni_tpu.plans import ir as _ir
+        from spark_rapids_jni_tpu.plans.ir import col
+        from spark_rapids_jni_tpu.plans.runtime import run_governed_plan
+
+        plan = _ir.Plan("bench_sort", (_ir.Sort(
+            _ir.Scan("t", ("k", "sid")),
+            keys=((col("k"), True), (col("sid"), True)),
+            fields=("k", "sid")),))
+        tables = {"t": {
+            "k": rng.randint(-(2**62), 2**62, no).astype(np.int64),
+            "sid": np.arange(no, dtype=np.int64)}}
+        span = _plan_cache_span()
+        dt = _time(lambda: int(run_governed_plan(None, plan, tables)
+                               ["rows"]), max(iters // 8, 2))
+        phases, cache = span()
+        return {"Mrows_per_s": round(no / dt / 1e6, 2), "rows": no,
+                "phases_s": phases, "plan_cache": cache}
+
+    _stage(detail, "sort_1m", _sort_1m, nbytes=int(no * 16 * 3))
+
+    def _window_rank():
+        from spark_rapids_jni_tpu.models.q67 import (
+            make_q67_tables,
+            q67_plan,
+        )
+        from spark_rapids_jni_tpu.serve.shuffle import run_range_plan_local
+
+        tables = make_q67_tables(no, 128, 16, seed=42)
+        plan = q67_plan(10, 128)
+        span = _plan_cache_span()
+        dt = _time(lambda: int(run_range_plan_local(plan, tables)
+                               ["rows"]), max(iters // 8, 2))
+        phases, cache = span()
+        return {"Mrows_per_s": round(no / dt / 1e6, 2), "rows": no,
+                "phases_s": phases, "plan_cache": cache}
+
+    _stage(detail, "window_rank", _window_rank, nbytes=int(no * 24 * 3))
+
+    def _topk():
+        from spark_rapids_jni_tpu.models.q67 import (
+            naive_sort_limit_plan,
+            topk_sales_plan,
+        )
+        from spark_rapids_jni_tpu.plans.compiler import (
+            emit_range_partitions,
+            split_exchange_plan,
+        )
+        from spark_rapids_jni_tpu.serve.shuffle import (
+            range_split_n,
+            run_range_plan_local,
+        )
+
+        k, nshards = 64, 4
+        tables = {"store_sales": {
+            "price": rng.randint(0, 1 << 40, no).astype(np.int64),
+            "sid": np.arange(no, dtype=np.int64)}}
+        plan = topk_sales_plan(k)
+        dt = _time(lambda: int(run_range_plan_local(plan, tables)
+                               ["rows"]), max(iters // 8, 2))
+
+        def shuffle_bytes(p):
+            # what would cross the wire on a 4-shard cluster: every map
+            # shard's emitted range partitions, summed
+            ex, _reduce = split_exchange_plan(p)
+            total = 0
+            for s in range_split_n(p, tables, nshards):
+                for part in emit_range_partitions(
+                        ex, s["tables"], nshards, s["splitters"]):
+                    total += sum(v.nbytes for v in part.values())
+            return total
+
+        bp = shuffle_bytes(plan)
+        bn = shuffle_bytes(naive_sort_limit_plan(k))
+        return {"Mrows_per_s": round(no / dt / 1e6, 2), "rows": no,
+                "k": k, "map_shards": nshards,
+                "shuffle_bytes_pushdown": bp, "shuffle_bytes_naive": bn,
+                "byte_reduction_x": round(bn / max(bp, 1), 1)}
+
+    _stage(detail, "topk", _topk, nbytes=int(no * 16 * 3))
+
     # cumulative plan-cache gauges across every plan-compiled stage: a
     # second same-shape execution must be a hit (hits > 0, misses stable)
     from spark_rapids_jni_tpu.plans import plan_cache as _plan_cache

@@ -188,13 +188,15 @@ register("json_overlap_bytes", 64 << 20,
          "before any scalar sync, so one tunnel round-trip serves the "
          "group. 1 = serial per-bucket syncs.",
          env="SRT_JSON_OVERLAP_BYTES")
-register("hash_backend", "xla",
-         "Backend for murmur3 fixed-width column contributions: 'xla' "
-         "(fused elementwise ops) or 'pallas' (VMEM-blocked kernels, "
-         "ops/hash_pallas.py; interpret-mode off-TPU). Default measured "
-         "on the v5e (round 5): XLA wins at bench size (78.2 vs 43.0 "
-         "Grows/s at 2^24; bench A/B in PERF_CAPTURE.jsonl), pallas "
-         "leads in a mid-size window (2^22) — see docs/PERF.md.",
+register("hash_backend", "auto",
+         "Backend for murmur3/xxhash64 column contributions: 'xla' "
+         "(fused elementwise ops), 'pallas' (VMEM-blocked kernels, "
+         "ops/hash_pallas.py; interpret-mode off-TPU), or 'auto' — "
+         "kind-adaptive dispatch (round 16): byte/string inputs always "
+         "take the XLA scan (pallas measured 0.37x on strings, BENCH_r07 "
+         "A/B), fixed-width inputs take pallas only on a real TPU "
+         "backend. Explicit values force every kind; v5e A/B history in "
+         "PERF_CAPTURE.jsonl and docs/PERF.md.",
          env="SRT_HASH_BACKEND")
 register("partition_hash", "murmur3",
          "Internal shuffle-placement hash (parallel/shuffle.partition_of, "

@@ -93,7 +93,7 @@ def _mm_fmix(h, length):
 
 def _mm_hash_int(v_i32, h):
     """Spark Murmur3.hashInt: one mix round + fmix(4)."""
-    if _pallas_backend():
+    if _pallas_backend(n=v_i32.size):
         from spark_rapids_jni_tpu.ops.hash_pallas import mm_hash_int_pallas
 
         return mm_hash_int_pallas(v_i32, h)
@@ -101,7 +101,7 @@ def _mm_hash_int(v_i32, h):
 
 
 def _mm_hash_long(v_i64, h):
-    if _pallas_backend():
+    if _pallas_backend(n=v_i64.size):
         from spark_rapids_jni_tpu.ops.hash_pallas import mm_hash_long_pallas
 
         return mm_hash_long_pallas(v_i64, h)
@@ -113,10 +113,36 @@ def _mm_hash_long(v_i64, h):
     return _mm_fmix(h, _U32(8))
 
 
-def _pallas_backend() -> bool:
+#: the size window where the pallas fixed-width kernels measured ahead of
+#: XLA on the v5e (pallas leads at 2^22; XLA wins at 2^24 — 78.2 vs 43.0
+#: Grows/s — and small sizes are launch-overhead-bound)
+_PALLAS_AUTO_MIN = 1 << 21
+_PALLAS_AUTO_MAX = 1 << 23
+
+
+def _pallas_backend(kind: str = "fixed", n: int | None = None) -> bool:
+    """Backend choice for one hash input: ``kind`` ("fixed" or "bytes")
+    and row count ``n`` (None = unknown, treated as in-window).
+
+    Explicit ``hash_backend='xla'|'pallas'`` forces every kind (the A/B
+    bench and the pallas parity tests depend on that).  ``'auto'`` is
+    adaptive, the same shape as get_json_object's device-render auto:
+
+    - byte/string inputs ALWAYS take the fused XLA scan — the pallas
+      word kernel measured 0.37x on strings (BENCH_r07 murmur3_strings
+      A/B), its VMEM win lost to the word-gather layout cost;
+    - fixed-width inputs take pallas only on a real TPU backend
+      (interpret mode off-TPU is pure overhead) and only in the
+      measured mid-size window where it actually led.
+    """
     from spark_rapids_jni_tpu import config
 
-    return config.get("hash_backend") == "pallas"
+    v = config.get("hash_backend")
+    if v == "auto":
+        if kind != "fixed" or jax.default_backend() != "tpu":
+            return False
+        return n is None or _PALLAS_AUTO_MIN <= n <= _PALLAS_AUTO_MAX
+    return v == "pallas"
 
 
 def _mm_bytes_words(padded: jnp.ndarray):
@@ -158,7 +184,7 @@ def _mm_hash_bytes(padded: jnp.ndarray, lens: jnp.ndarray, h):
     executor grew without bound); under the cached jit it compiles once
     per byte-matrix geometry.
     """
-    if _pallas_backend():
+    if _pallas_backend("bytes"):
         lens = lens.astype(jnp.int32)
         nwords = lens // 4
         words, padded = _mm_bytes_words(padded)
@@ -219,7 +245,7 @@ def _xx_finalize(h):
 
 
 def _xx_hash_fixed4(v_u32, seed):
-    if _pallas_backend():
+    if _pallas_backend(n=v_u32.size):
         from spark_rapids_jni_tpu.ops.hash_pallas import xx_hash_fixed4_pallas
 
         return xx_hash_fixed4_pallas(v_u32, seed)
@@ -228,7 +254,7 @@ def _xx_hash_fixed4(v_u32, seed):
 
 
 def _xx_hash_fixed8(v_u64, seed):
-    if _pallas_backend():
+    if _pallas_backend(n=v_u64.size):
         from spark_rapids_jni_tpu.ops.hash_pallas import xx_hash_fixed8_pallas
 
         return xx_hash_fixed8_pallas(v_u64, seed)
@@ -504,9 +530,11 @@ def _hash_list(col: ListColumn, h, *, mm: bool):
         # static widths): the scan body closes over bucket arrays, so an
         # eager per-call trace would leak a trace-cache entry per call
         # (same soak finding as _mm_hash_bytes)
-        backend = _pallas_backend()  # part of each cache key: the traced
-        # program bakes the backend choice, so a config.override must not
-        # silently reuse the other backend's executable
+        # part of each cache key: the traced program bakes the backend
+        # choice, so a config.override must not silently reuse the other
+        # backend's executable; kind follows the child being hashed
+        backend = _pallas_backend(
+            "bytes" if isinstance(child, StringColumn) else "fixed", n=nb)
         if isinstance(child, StringColumn):
             w_child = max(int(row_max_leaf[rows_np[:n_real]].max()), 1)
             hb = _list_scan_string_jit(mm, w, w_child, backend)(

@@ -37,7 +37,8 @@ __all__ = ["compile_plan", "cached_compile", "input_signature",
            "output_names", "emitter", "DTYPES",
            "RaggedProgram", "compile_ragged", "cached_ragged_compile",
            "EXCHANGE_SOURCE", "split_exchange_plan",
-           "emit_exchange_partitions", "eval_post"]
+           "emit_exchange_partitions", "emit_range_partitions",
+           "sample_range_splitters", "eval_post"]
 
 DTYPES = {
     "bool": jnp.bool_,
@@ -245,6 +246,96 @@ def _emit_exchange(node: ir.Exchange, ctx: _Ctx) -> _Rows:
     return _Rows(dict(ex.columns), ex.valid)
 
 
+@emitter(ir.RangeExchange)
+def _emit_range_exchange(node: ir.RangeExchange, ctx: _Ctx):
+    # registration keeps the split/rebuild machinery node-aware; there is
+    # deliberately no traced body — psum cannot merge ordered row vectors,
+    # so a range shuffle only exists on the cross-process plane
+    raise ValueError(
+        "RangeExchange has no in-process emitter: split the plan "
+        "(split_exchange_plan) and run it on the serve shuffle plane, or "
+        "through its single-process oracle (serve.shuffle."
+        "run_range_plan_local)")
+
+
+def _order_env(keys, cols, mask):
+    """(permutation, sorted per-key ranks) for ``(expr, ascending)`` sort
+    keys over a row environment — the shared front half of every
+    order-sensitive emitter."""
+    from spark_rapids_jni_tpu.plans import window as win
+
+    ranks = [win.sort_rank(jnp.asarray(_eval(e, cols)), asc)
+             for e, asc in keys]
+    order = win.order_permutation(ranks, mask)
+    return order, [r[order] for r in ranks]
+
+
+def _gather_cols(cols, order):
+    return {k: jnp.asarray(v)[order] if jnp.ndim(v) else v
+            for k, v in cols.items()}
+
+
+@emitter(ir.Window)
+def _emit_window(node: ir.Window, ctx: _Ctx) -> _Rows:
+    from spark_rapids_jni_tpu.plans import window as win
+
+    rows = _emit(node.child, ctx)
+    pkeys = tuple((e, True) for e in node.partition_by)
+    order, sranks = _order_env(pkeys + node.order_by, rows.cols, rows.mask)
+    cols = _gather_cols(rows.cols, order)
+    mask = rows.mask[order]
+    np_keys = len(node.partition_by)
+    run_start = win.run_boundaries(sranks[:np_keys], mask)
+    ochange = win.change_points(sranks[np_keys:]) if node.order_by else (
+        jnp.zeros_like(run_start))
+    for f in node.funcs:
+        if f.kind == "row_number":
+            out = win.row_number(run_start)
+        elif f.kind == "rank":
+            out = win.rank(run_start, ochange)
+        elif f.kind == "dense_rank":
+            out = win.dense_rank(run_start, ochange)
+        else:
+            v = jnp.asarray(_eval(f.arg, cols)).astype(DTYPES[f.dtype])
+            # invalid rows sort last and open their own run
+            # (run_boundaries), so their garbage can never reach a valid
+            # segment; zeroing keeps even the masked outputs finite
+            v = jnp.where(mask, v, jnp.zeros((), v.dtype))
+            if f.kind == "sum":
+                out = win.framed_sum(v, run_start, f.preceding)
+            else:
+                out = win.framed_minmax(v, run_start, f.kind, f.preceding)
+        cols[f.name] = out.astype(DTYPES[f.dtype]) if f.kind in (
+            "rank", "dense_rank", "row_number") else out
+    return _Rows(cols, mask)
+
+
+def _order_sink_outputs(node, ctx: _Ctx, k=None) -> Dict[str, object]:
+    """Shared Sort/TopK sink body: order rows (invalid last), emit the
+    named field vectors plus the implicit valid-``rows`` count; TopK
+    additionally slices the first ``min(k, n)`` rows (static shapes)."""
+    rows = _emit(node.child, ctx)
+    order, _ranks = _order_env(node.keys, rows.cols, rows.mask)
+    cols = _gather_cols(rows.cols, order)
+    nvalid = jnp.sum(rows.mask.astype(jnp.int64))
+    out = {}
+    for f in node.fields:
+        v = cols[f]
+        out[f] = v[:min(int(k), v.shape[0])] if k is not None else v
+    out["rows"] = jnp.minimum(nvalid, k) if k is not None else nvalid
+    return out
+
+
+@emitter(ir.Sort)
+def _emit_sort(node: ir.Sort, ctx: _Ctx) -> Dict[str, object]:
+    return _order_sink_outputs(node, ctx)
+
+
+@emitter(ir.TopK)
+def _emit_topk(node: ir.TopK, ctx: _Ctx) -> Dict[str, object]:
+    return _order_sink_outputs(node, ctx, k=int(node.k))
+
+
 @emitter(ir.PresenceCount)
 def _emit_presence_count(node: ir.PresenceCount,
                          ctx: _Ctx) -> Dict[str, object]:
@@ -266,11 +357,16 @@ def output_names(plan: ir.Plan) -> Tuple[str, ...]:
     order, then the implicit ``dropped`` (plans with an Exchange), then
     post outputs — filtered/ordered by ``plan.outputs`` when set."""
     names: List[str] = []
+    ir.order_sink(plan)  # validates order sinks don't mix with others
     for sink in plan.sinks:
         if isinstance(sink, ir.SegmentAgg):
             names.extend(name for name, _e, _d in sink.aggs)
         elif isinstance(sink, ir.PresenceCount):
             names.extend(sink.names)
+        elif isinstance(sink, (ir.Sort, ir.TopK)):
+            # ordered field vectors plus the implicit valid-row count
+            names.extend(sink.fields)
+            names.append("rows")
         else:
             raise TypeError(f"not a sink node: {sink!r}")
     if ir.has_exchange(plan):
@@ -331,6 +427,17 @@ def compile_plan(plan: ir.Plan, mesh, signature: Tuple) -> CompiledPlan:
     if local and ir.has_exchange(plan):
         raise ValueError(
             f"plan {plan.name!r} contains an Exchange: mesh required")
+    if ir.range_exchange_nodes(plan):
+        raise ValueError(
+            f"plan {plan.name!r} contains a RangeExchange: it only runs "
+            f"split across the serve shuffle plane (split_exchange_plan)")
+    if not local and ir.order_sink(plan) is not None:
+        # the mesh path psums every sink output over the data axis —
+        # correct for additive partials, destruction for ordered row
+        # vectors; distribution happens via the range shuffle instead
+        raise ValueError(
+            f"plan {plan.name!r} has an order-sensitive sink: compile "
+            f"locally (per shuffle partition), not under a mesh")
 
     def body(*flat):
         inputs: Dict[str, Dict[str, object]] = {}
@@ -448,11 +555,13 @@ EXCHANGE_SOURCE = "__exchange__"
 
 def split_exchange_plan(plan: ir.Plan):
     """``(exchange_node, reduce_plan)`` for a plan with exactly ONE
-    Exchange.  The reduce plan is local (no Exchange, no mesh), reads the
-    shuffled fields from ``Scan(EXCHANGE_SOURCE, fields)``, keeps the
-    sinks, and drops ``post``/``outputs`` — partials must be summed
-    across executors BEFORE post expressions run."""
-    exchanges = ir.exchange_nodes(plan)
+    Exchange or RangeExchange.  The reduce plan is local (no Exchange,
+    no mesh), reads the shuffled fields from
+    ``Scan(EXCHANGE_SOURCE, fields)``, keeps the sinks, and drops
+    ``post``/``outputs`` — partials must be combined across executors
+    (summed, or order-concatenated for a range shuffle) BEFORE post
+    expressions run."""
+    exchanges = ir.exchange_nodes(plan) + ir.range_exchange_nodes(plan)
     if len(exchanges) != 1:
         raise ValueError(
             f"plan {plan.name!r} has {len(exchanges)} Exchange nodes; the "
@@ -504,6 +613,24 @@ def emit_exchange_partitions(exchange: ir.Exchange, tables,
 
     from spark_rapids_jni_tpu.parallel.shuffle import partition_of
 
+    rows = _emit_host_rows(exchange, tables)
+    key = _eval(exchange.key, rows.cols)
+    part = np.asarray(partition_of(key, nparts))
+    mask = np.asarray(rows.mask)
+    cols = {f: np.asarray(rows.cols[f]) for f in exchange.fields}
+    out = []
+    for p in range(nparts):
+        sel = mask & (part == p)
+        out.append({f: np.ascontiguousarray(v[sel])
+                    for f, v in cols.items()})
+    return out
+
+
+def _emit_host_rows(exchange, tables) -> _Rows:
+    """Eagerly emit an exchange node's child subtree over host shard
+    tables (same emitter bodies as the traced path, so values are
+    bit-identical) — the shared map-side front half of the hash and
+    range partition emitters."""
     inputs: Dict[str, Dict[str, object]] = {}
     rowvalid: Dict[str, object] = {}
     for table, fields in tables.items():
@@ -514,15 +641,73 @@ def emit_exchange_partitions(exchange: ir.Exchange, tables,
         # bracket that admitted the shuffle piece, already covered by
         # the shard's working-set estimate like the shard columns above
         rowvalid[table] = jnp.ones((n,), jnp.bool_)
-    rows = _emit(exchange.child, _Ctx(inputs, rowvalid, None))
-    key = _eval(exchange.key, rows.cols)
-    part = np.asarray(partition_of(key, nparts))
+    return _emit(exchange.child, _Ctx(inputs, rowvalid, None))
+
+
+def _host_rank_cols(exchange: "ir.RangeExchange", rows: _Rows) -> list:
+    """The host uint64 rank columns of a range exchange's sort keys —
+    the SAME canonical transform the traced order emitters apply, so
+    partition placement and device order can never disagree."""
+    import numpy as np
+
+    from spark_rapids_jni_tpu.plans import window as win
+
+    return [win.sort_rank_np(np.asarray(_eval(e, rows.cols)), asc)
+            for e, asc in exchange.keys]
+
+
+def sample_range_splitters(exchange: "ir.RangeExchange", tables,
+                           nparts: int, sample_cap: int = 4096) -> list:
+    """Driver-side splitter choice for one range shuffle: emit the map
+    fragment over the full input ONCE, sample the valid rows' composite
+    sort ranks evenly, take quantile boundaries.  Every map shard must
+    ride with the SAME splitters (they define the global partition
+    order), so this runs once at dispatch, not per shard."""
+    import numpy as np
+
+    from spark_rapids_jni_tpu.plans import window as win
+
+    rows = _emit_host_rows(exchange, tables)
+    ranks = _host_rank_cols(exchange, rows)
+    return win.choose_splitters(ranks, np.asarray(rows.mask), nparts,
+                                sample_cap=sample_cap)
+
+
+def emit_range_partitions(exchange: "ir.RangeExchange", tables,
+                          nparts: int, splitters) -> list:
+    """The map side of one executor's shard of a RANGE shuffle: emit the
+    child subtree eagerly, rank rows by the exchange's sort keys (the
+    canonical uint64 transform), and bucket them against the dispatch-
+    time ``splitters`` — partition ``p``'s every row orders before
+    partition ``p+1``'s, so the reduce side's per-partition sorted
+    outputs concatenate into global order with no merge.
+
+    With ``exchange.limit`` set (partial top-k pushdown), only this
+    shard's first ``limit`` ordered VALID rows are partitioned at all:
+    the global top-k is a subset of the per-shard top-k's, so at most
+    ``limit * shards`` rows cross the wire instead of every row."""
+    import numpy as np
+
+    from spark_rapids_jni_tpu.plans import window as win
+
+    if len(splitters) != nparts - 1:
+        raise ValueError(
+            f"range shuffle wants {nparts - 1} splitters, got "
+            f"{len(splitters)}")
+    rows = _emit_host_rows(exchange, tables)
+    ranks = _host_rank_cols(exchange, rows)
     mask = np.asarray(rows.mask)
-    cols = {f: np.asarray(rows.cols[f]) for f in exchange.fields}
+    sel = np.flatnonzero(mask)
+    # valid rows in key order (np.lexsort: last key is primary)
+    sel = sel[np.lexsort(tuple(reversed([r[sel] for r in ranks])))]
+    if exchange.limit is not None:
+        sel = sel[:int(exchange.limit)]
+    part = win.range_partition([r[sel] for r in ranks], splitters)
+    cols = {f: np.asarray(rows.cols[f])[sel] for f in exchange.fields}
     out = []
     for p in range(nparts):
-        sel = mask & (part == p)
-        out.append({f: np.ascontiguousarray(v[sel])
+        take = part == p
+        out.append({f: np.ascontiguousarray(v[take])
                     for f, v in cols.items()})
     return out
 

@@ -25,7 +25,11 @@ Two layers:
   :class:`SegmentAgg` (masked segment sums into a dense group space),
   :class:`Union` (tagged row concat), :class:`Exchange` (the all_to_all
   hash shuffle), :class:`PresenceCount` (q97's sort-merge presence
-  counting).
+  counting) — and the order-sensitive tier: :class:`RangeExchange` (the
+  cross-process range shuffle a distributed sort rides),
+  :class:`Window` (rank/dense_rank/row_number and framed sum/min/max
+  over sorted runs, plans/window.py), and the :class:`Sort`/:class:`TopK`
+  sinks that emit globally ordered row vectors.
 
 A :class:`Plan` bundles sink nodes (aggregate producers) with post
 expressions over their outputs; the compiler traces all of it into ONE
@@ -46,7 +50,9 @@ __all__ = [
     "Expr", "Col", "Lit", "Bin", "Unary", "Cast",
     "Node", "Scan", "Dim", "Filter", "Project", "GatherJoin",
     "SemiJoinWindow", "SegmentAgg", "Union", "Exchange", "PresenceCount",
+    "RangeExchange", "WinFunc", "Window", "Sort", "TopK",
     "Plan", "col", "lit", "band_all", "plan_signature",
+    "order_sink", "range_exchange_nodes", "has_any_exchange",
 ]
 
 
@@ -229,6 +235,98 @@ class Exchange:
 
 
 @dataclasses.dataclass(frozen=True)
+class RangeExchange:
+    """The cross-process RANGE shuffle (serve/shuffle.py): co-locate rows
+    into CONTIGUOUS key ranges so partition ``p``'s every row orders
+    before partition ``p+1``'s — the shape that makes a distributed sort
+    a per-shard sort plus an ordered concatenation (the classic
+    sample -> splitters -> shuffle-by-range plan Flare compiles).
+
+    ``keys`` are ``(expr, ascending)`` sort keys; splitters are NOT plan
+    structure — they are sampled from the data at dispatch time and ride
+    the shard payloads, so one compiled reduce program serves every
+    dataset.  ``limit`` pushes a partial top-k below the shuffle: each
+    map shard sends only its ``limit`` first-ordered rows, so at most
+    ``limit * shards`` rows cross the wire.
+
+    Cross-process only: there is no in-mesh emitter (psum cannot merge
+    ordered row vectors) — compile_plan refuses a plan containing one;
+    execution goes through split_exchange_plan + the serve shuffle
+    plane (or its single-process oracle)."""
+
+    child: "Node"
+    keys: Tuple[Tuple[Expr, bool], ...]  # (key expr, ascending)
+    fields: Tuple[str, ...]
+    limit: _U[int, None] = None
+
+
+WINDOW_FUNCS = ("rank", "dense_rank", "row_number", "sum", "min", "max")
+
+
+@dataclasses.dataclass(frozen=True)
+class WinFunc:
+    """One window function column: ``rank``/``dense_rank``/``row_number``
+    need no argument; ``sum``/``min``/``max`` aggregate ``arg`` over the
+    ROWS frame ``[current - preceding, current]`` (``preceding=None`` =
+    UNBOUNDED PRECEDING) within the partition, in order."""
+
+    name: str
+    kind: str  # one of WINDOW_FUNCS
+    arg: _U[Col, Lit, Bin, Unary, Cast, None] = None
+    dtype: str = "int64"
+    preceding: _U[int, None] = None
+
+    def __post_init__(self):
+        if self.kind not in WINDOW_FUNCS:
+            raise ValueError(f"unknown window function {self.kind!r}")
+        if self.kind in ("sum", "min", "max") and self.arg is None:
+            raise ValueError(f"window {self.kind} requires an arg expr")
+        if self.preceding is not None and self.kind in (
+                "rank", "dense_rank", "row_number"):
+            raise ValueError(f"window {self.kind} takes no frame")
+
+
+@dataclasses.dataclass(frozen=True)
+class Window:
+    """Window functions over sorted runs: rows reorder by
+    ``(partition_by, order_by)`` (invalid rows last), every run of equal
+    partition keys becomes one segment, and each :class:`WinFunc` appends
+    a column computed by segment-scan primitives (plans/window.py).
+    Downstream nodes (Filter on a rank, a Sort sink) see the reordered
+    row environment."""
+
+    child: "Node"
+    partition_by: Tuple[Expr, ...]
+    order_by: Tuple[Tuple[Expr, bool], ...]  # (expr, ascending)
+    funcs: Tuple[WinFunc, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Sort:
+    """Order-sensitive SINK: emit ``fields`` as row vectors ordered by
+    ``keys`` (invalid rows sort last and are excluded from the implicit
+    ``rows`` count output).  Local-compile only — a distributed sort is
+    a RangeExchange below this sink plus an ordered concatenation of the
+    per-partition results."""
+
+    child: "Node"
+    keys: Tuple[Tuple[Expr, bool], ...]
+    fields: Tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class TopK:
+    """Order-sensitive SINK: the first ``k`` rows by ``keys``.  Output
+    vectors are ``min(k, padded_rows)`` long; ``rows`` counts the valid
+    ones (``K > total rows`` simply returns them all)."""
+
+    child: "Node"
+    keys: Tuple[Tuple[Expr, bool], ...]
+    k: int
+    fields: Tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
 class PresenceCount:
     """q97's sort-merge presence counting over co-located tagged rows:
     for every distinct valid key, which sources appear?  Emits the three
@@ -241,7 +339,14 @@ class PresenceCount:
 
 
 Node = _U[Scan, Dim, Filter, Project, GatherJoin, SemiJoinWindow,
-          SegmentAgg, Union, Exchange, PresenceCount]
+          SegmentAgg, Union, Exchange, PresenceCount,
+          RangeExchange, Window, Sort, TopK]
+
+#: the concrete node classes _walk recurses into (single source of truth
+#: — a node type missing here is invisible to scan/dim/exchange discovery)
+NODE_TYPES = (Scan, Dim, Filter, Project, GatherJoin, SemiJoinWindow,
+              SegmentAgg, Union, Exchange, PresenceCount,
+              RangeExchange, Window, Sort, TopK)
 
 
 # ---------------------------------------------------------------------- plan
@@ -265,16 +370,12 @@ def _walk(node) -> list:
     out = [node]
     for f in dataclasses.fields(node):
         v = getattr(node, f.name)
-        if dataclasses.is_dataclass(v) and isinstance(
-                v, (Scan, Dim, Filter, Project, GatherJoin, SemiJoinWindow,
-                    SegmentAgg, Union, Exchange, PresenceCount)):
+        if dataclasses.is_dataclass(v) and isinstance(v, NODE_TYPES):
             out.extend(_walk(v))
         elif isinstance(v, tuple):
             for item in v:
                 if dataclasses.is_dataclass(item) and isinstance(
-                        item, (Scan, Dim, Filter, Project, GatherJoin,
-                               SemiJoinWindow, SegmentAgg, Union, Exchange,
-                               PresenceCount)):
+                        item, NODE_TYPES):
                     out.extend(_walk(item))
     return out
 
@@ -324,6 +425,34 @@ def exchange_nodes(plan: Plan) -> Tuple[Exchange, ...]:
 
 def has_exchange(plan: Plan) -> bool:
     return bool(exchange_nodes(plan))
+
+
+@functools.lru_cache(maxsize=256)
+def range_exchange_nodes(plan: Plan) -> Tuple[RangeExchange, ...]:
+    """Every RangeExchange in the plan, preorder.  Cached (hot path)."""
+    return tuple(n for n in walk(plan) if isinstance(n, RangeExchange))
+
+
+def has_any_exchange(plan: Plan) -> bool:
+    """Hash OR range exchange: either makes the plan non-local (the hash
+    kind needs a mesh, the range kind needs the cross-process split)."""
+    return bool(exchange_nodes(plan)) or bool(range_exchange_nodes(plan))
+
+
+@functools.lru_cache(maxsize=256)
+def order_sink(plan: Plan):
+    """The plan's Sort/TopK sink, or None.  Ordered row output cannot
+    coexist with additive sinks (they combine by summation, ordered rows
+    by concatenation — one plan, one combine discipline), so mixing or
+    repeating order sinks is a structural error."""
+    order = [s for s in plan.sinks if isinstance(s, (Sort, TopK))]
+    if not order:
+        return None
+    if len(order) > 1 or len(plan.sinks) > 1:
+        raise ValueError(
+            f"plan {plan.name!r} mixes an order-sensitive sink with other "
+            f"sinks; a Sort/TopK sink must be the plan's only sink")
+    return order[0]
 
 
 @functools.lru_cache(maxsize=256)

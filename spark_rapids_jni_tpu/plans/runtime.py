@@ -412,6 +412,12 @@ def run_governed_plan(
                 return hit
     scans = ir.scan_tables(plan)
     tables = _upload_dims(plan, tables, mesh)
+    if ir.order_sink(plan) is not None and split is None and combine is None:
+        # ordered row vectors do not combine by addition, and a row-
+        # halved re-execution would need a merge step the default path
+        # doesn't have: under pressure an order plan retries at full
+        # size (RetryOOM) but never silently splits into wrong answers
+        max_split_depth = 0
 
     # plan-granularity adaptive presplit: this request class's recent
     # retry history decides whether to skip the full-size attempt (0 under

@@ -1,0 +1,269 @@
+"""Order-sensitive execution primitives: sort keys, sorted runs, and
+segment-scan window functions.
+
+Everything order-related reduces to ONE canonical transform:
+:func:`sort_rank` maps a column to uint64 ranks whose unsigned ascending
+order IS the column's SQL order (descending keys bit-flip, floats use
+the IEEE total order with Spark's NaN/±0.0 canonicalization: -0.0 == 0.0
+and every NaN is one largest value).  Rank vectors are what everything
+downstream consumes — the traced Sort/Window/TopK emitters lexsort them
+(compiler.py), and the HOST side samples them to choose range splitters
+and assign shuffle partitions (:func:`choose_splitters` /
+:func:`range_partition`), so the device order and the cross-process
+partition order can never disagree.
+
+Window functions run on sorted runs, the q97 ``_count_runs`` idiom
+generalized: equal-partition-key rows form segments (run boundaries from
+rank change points), and rank/dense_rank/row_number plus running
+sum/min/max with ROWS-frame semantics all come from segment scans —
+``cummax`` over start indices, segmented ``associative_scan``, and
+cumsum differences.  Static shapes throughout (XLA-friendly: no dynamic
+grouping), invalid rows sort last and form their own runs so they can
+never contaminate a valid segment's aggregate.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "sort_rank", "sort_rank_np", "order_permutation", "run_boundaries",
+    "change_points", "segment_start_indices", "row_number", "rank",
+    "dense_rank", "framed_sum", "framed_minmax",
+    "choose_splitters", "range_partition",
+]
+
+_SIGN = np.uint64(1) << np.uint64(63)
+#: one canonical quiet-NaN bit pattern (Spark: all NaNs equal, largest)
+_CANON_NAN = np.int64(0x7FF8000000000000)
+
+
+# ------------------------------------------------------------- sort ranks
+
+
+def sort_rank(x, ascending: bool = True):
+    """uint64 ranks whose unsigned ascending order is ``x``'s sort order.
+
+    - ints/bool: sign-bias to uint64 (order-preserving);
+    - floats: widen to float64, canonicalize ``-0.0 -> +0.0`` and every
+      NaN to one quiet-NaN pattern (NaN == NaN, NaN largest — Spark's
+      ordering), then the IEEE total-order transform;
+    - ``ascending=False`` bit-flips, so a descending key is just another
+      ascending uint64 — lexsort and splitters never special-case
+      direction.
+    """
+    x = jnp.asarray(x)
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        f = x.astype(jnp.float64)
+        f = jnp.where(f == 0.0, 0.0, f)  # -0.0 and +0.0 are one value
+        bits = jax.lax.bitcast_convert_type(f, jnp.int64)
+        bits = jnp.where(jnp.isnan(f), jnp.int64(_CANON_NAN), bits)
+        u = jnp.where(bits < 0,
+                      ~bits.astype(jnp.uint64),
+                      bits.astype(jnp.uint64) | jnp.uint64(_SIGN))
+    elif x.dtype == jnp.bool_:
+        u = x.astype(jnp.uint64)
+    else:
+        u = x.astype(jnp.int64).astype(jnp.uint64) ^ jnp.uint64(_SIGN)
+    return u if ascending else ~u
+
+
+def sort_rank_np(x: np.ndarray, ascending: bool = True) -> np.ndarray:
+    """Host twin of :func:`sort_rank`, bit-identical — splitter choice
+    and range partitioning happen on numpy shards, and the partition a
+    row lands in must agree exactly with the order the traced reduce
+    side sorts it into."""
+    x = np.asarray(x)
+    if np.issubdtype(x.dtype, np.floating):
+        f = x.astype(np.float64)
+        f = np.where(f == 0.0, 0.0, f)
+        bits = f.view(np.int64).copy()
+        bits[np.isnan(f)] = _CANON_NAN
+        u = np.where(bits < 0,
+                     ~bits.view(np.uint64),
+                     bits.view(np.uint64) | _SIGN)
+    elif x.dtype == np.bool_:
+        u = x.astype(np.uint64)
+    else:
+        u = x.astype(np.int64).view(np.uint64) ^ _SIGN
+    return u if ascending else ~u
+
+
+def order_permutation(ranks: Sequence, valid):
+    """The gather permutation sorting rows by ``ranks`` (major key
+    first), valid rows before invalid — the multi-key generalization of
+    q97's sentinel argsort.  jnp.lexsort is stable, so equal-key rows
+    keep their input order."""
+    invalid = (~valid).astype(jnp.uint8)
+    return jnp.lexsort(tuple(reversed(list(ranks))) + (invalid,))
+
+
+# ------------------------------------------------------------ sorted runs
+
+
+def change_points(ranks: Sequence):
+    """Row i differs from row i-1 in ANY rank column (row 0 is True) —
+    the run-start primitive over already-sorted rank columns."""
+    out = None
+    for r in ranks:
+        prev = jnp.concatenate([~r[:1], r[:-1]])
+        c = r != prev
+        out = c if out is None else (out | c)
+    n = out.shape[0]
+    return out.at[0].set(True) if n else out
+
+
+def run_boundaries(part_ranks: Sequence, valid):
+    """Run starts over sorted partition-key ranks, with the validity
+    flag as an extra key: the first invalid row (they sort last) always
+    opens a new run, so invalid garbage can never extend a valid
+    segment."""
+    return change_points(list(part_ranks) + [valid.astype(jnp.uint8)])
+
+
+def segment_start_indices(run_start):
+    """For every row, the index of its run's first row (monotone cummax
+    over start positions — run_start[0] is True by construction)."""
+    n = run_start.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int64)
+    return jax.lax.cummax(jnp.where(run_start, idx, jnp.int64(0)))
+
+
+# ------------------------------------------------------ window functions
+
+
+def row_number(run_start):
+    """1-based position within the run."""
+    n = run_start.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int64)
+    return idx - segment_start_indices(run_start) + 1
+
+
+def rank(run_start, order_change):
+    """SQL rank: 1 + number of rows strictly before this row's tie
+    group.  Depends only on key VALUES (ties share a rank), never on the
+    within-tie order — what keeps ranked outputs deterministic under a
+    stable-but-arbitrary tie order."""
+    n = run_start.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int64)
+    change = run_start | order_change
+    group_start = jax.lax.cummax(jnp.where(change, idx, jnp.int64(0)))
+    return group_start - segment_start_indices(run_start) + 1
+
+
+def dense_rank(run_start, order_change):
+    """SQL dense_rank: 1 + number of DISTINCT order keys before this
+    row's within its run."""
+    change = run_start | order_change
+    c = jnp.cumsum(change.astype(jnp.int64))
+    seg0 = segment_start_indices(run_start)
+    return c - c[seg0] + 1
+
+
+def framed_sum(v, run_start, preceding: Optional[int] = None):
+    """Running sum over the ROWS frame ``[i - preceding, i]`` within the
+    run (``preceding=None`` = UNBOUNDED PRECEDING), via cumsum
+    differences clamped at the segment start — exact for int dtypes."""
+    n = v.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int64)
+    seg0 = segment_start_indices(run_start)
+    cs = jnp.cumsum(v)
+    if preceding is None:
+        lo = seg0
+    else:
+        lo = jnp.maximum(seg0, idx - int(preceding))
+    base = jnp.where(lo > 0, cs[jnp.maximum(lo - 1, 0)],
+                     jnp.zeros((), v.dtype))
+    return cs - base
+
+
+def _seg_scan(v, run_start, op):
+    """Segmented inclusive scan: the classic (flag, value) associative
+    combine — a start flag resets the accumulation."""
+    def combine(a, b):
+        fa, va = a
+        fb, vb = b
+        return fa | fb, jnp.where(fb, vb, op(va, vb))
+
+    _f, out = jax.lax.associative_scan(combine, (run_start, v))
+    return out
+
+
+def framed_minmax(v, run_start, kind: str, preceding: Optional[int] = None):
+    """Running min/max over the ROWS frame ``[i - preceding, i]`` within
+    the run.  Unbounded frames use one segmented associative scan;
+    bounded frames unroll ``preceding`` identity-filled shifts (static,
+    small — the plan value bakes the frame in)."""
+    op = jnp.minimum if kind == "min" else jnp.maximum
+    if preceding is None:
+        return _seg_scan(v, run_start, op)
+    ident = (jnp.iinfo(v.dtype).max if kind == "min"
+             else jnp.iinfo(v.dtype).min) if jnp.issubdtype(
+                 v.dtype, jnp.integer) else (
+                     jnp.inf if kind == "min" else -jnp.inf)
+    n = v.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int64)
+    seg0 = segment_start_indices(run_start)
+    out = v
+    # a shift of >= n rows contributes only identity — cap the unroll so
+    # frames wider than the batch stay shape-correct
+    for j in range(1, min(int(preceding), max(n - 1, 0)) + 1):
+        shifted = jnp.concatenate([jnp.full((j,), ident, v.dtype), v[:-j]])
+        out = op(out, jnp.where(idx - j >= seg0, shifted,
+                                jnp.asarray(ident, v.dtype)))
+    return out
+
+
+# ------------------------------------------- host-side range partitioning
+
+
+def choose_splitters(rank_cols: Sequence[np.ndarray], valid: np.ndarray,
+                     nparts: int, sample_cap: int = 4096
+                     ) -> List[Tuple[int, ...]]:
+    """``nparts - 1`` composite-rank splitters from an even row sample:
+    sort the sampled rank tuples lexicographically and take the
+    quantile boundaries.  Returned as tuples of python ints (payload-
+    serializable; every map shard must receive the SAME splitters).
+
+    Degenerate inputs degrade safely: heavy skew yields duplicate
+    splitters (equal keys all land in one partition — imbalanced but
+    correct), and an empty sample yields all-zero splitters (every row
+    ranks after them, partition ``nparts - 1`` takes the lot)."""
+    valid = np.asarray(valid, bool)
+    sel = np.flatnonzero(valid)
+    if sel.size > sample_cap:
+        sel = sel[np.linspace(0, sel.size - 1, sample_cap).astype(np.int64)]
+    if sel.size == 0:
+        return [tuple(0 for _ in rank_cols) for _ in range(nparts - 1)]
+    sample = [np.asarray(r)[sel] for r in rank_cols]
+    order = np.lexsort(tuple(reversed(sample)))
+    n = sel.size
+    out = []
+    for p in range(1, nparts):
+        at = order[min(n - 1, n * p // nparts)]
+        out.append(tuple(int(r[at]) for r in sample))
+    return out
+
+
+def range_partition(rank_cols: Sequence[np.ndarray],
+                    splitters: Sequence[Tuple[int, ...]]) -> np.ndarray:
+    """Partition index per row: how many splitters order strictly before
+    the row's composite rank (rows equal to splitter ``p`` stay in
+    partition ``p``).  Concatenating partitions in index order therefore
+    yields globally sorted rows — the merge-free distributed sort."""
+    n = len(np.asarray(rank_cols[0]))
+    part = np.zeros(n, np.int64)
+    for s in splitters:
+        gt = np.zeros(n, bool)
+        eq = np.ones(n, bool)
+        for rc, sv in zip(rank_cols, s):
+            rc = np.asarray(rc)
+            sv = np.uint64(sv)
+            gt |= eq & (rc > sv)
+            eq &= rc == sv
+        part += gt
+    return part

@@ -72,6 +72,9 @@ __all__ = [
     "make_shuffle_handler", "run_shuffle_piece",
     "run_exchange_plan_local", "combine_exchange_outputs",
     "split_tables_n", "scan_table_names",
+    "range_split_n", "make_range_split", "run_range_shuffle_piece",
+    "make_range_shuffle_handler", "combine_ordered_outputs",
+    "run_range_plan_local",
 ]
 
 class ShuffleFetchStalled(RuntimeError):
@@ -633,7 +636,6 @@ def run_shuffle_piece(plan, payload: dict, ctx) -> Dict[str, np.ndarray]:
     <shard tables>, "reproduce": bool}`` (built by the supervisor's
     shuffle dispatch).  Returns the PARTIAL sink outputs (summed by the
     supervisor's combine), or a marker dict for produce-only revivals."""
-    from spark_rapids_jni_tpu.mem.governed import reservation
     from spark_rapids_jni_tpu.plans import ir
     from spark_rapids_jni_tpu.plans.compiler import (
         EXCHANGE_SOURCE,
@@ -653,6 +655,25 @@ def run_shuffle_piece(plan, payload: dict, ctx) -> Dict[str, np.ndarray]:
     svc.produce(sid, m, parts, rid=rid)
     if payload.get("reproduce"):
         return {"reproduced": np.int64(m)}
+
+    received = _fetch_all_partitions(svc, sid, m, nparts, rid, ctx)
+    concat = {f: np.concatenate([r[f] for r in received])
+              for f in exchange.fields}
+    reduce_tables: Dict[str, Any] = {EXCHANGE_SOURCE: concat}
+    for dim in ir.dim_tables(reduce_plan):
+        reduce_tables[dim.table] = tables[dim.table]
+    out = run_governed_plan(None, reduce_plan, reduce_tables,
+                            budget=ctx.budget, task_id=ctx.task_id,
+                            manage_task=False)
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+def _fetch_all_partitions(svc, sid: int, m: int, nparts: int, rid: int,
+                          ctx) -> List[Dict[str, np.ndarray]]:
+    """Pull this consumer's partition ``m`` from every map task, in map
+    order (the concat order correctness depends on), budget-reserved and
+    acked — the shared fetch half of the hash and range shuffle pieces."""
+    from spark_rapids_jni_tpu.mem.governed import reservation
 
     credit = int(config.get("serve_shuffle_credit_bytes"))
     fetch_timeout = float(config.get("serve_shuffle_fetch_timeout_s"))
@@ -680,15 +701,7 @@ def run_shuffle_piece(plan, payload: dict, ctx) -> Dict[str, np.ndarray]:
                 cols = svc.fetch(sid, k, m, deadline=deadline, rid=rid)
             svc.ack(sid, k, m, rid=rid)
         received.append(cols)
-    concat = {f: np.concatenate([r[f] for r in received])
-              for f in exchange.fields}
-    reduce_tables: Dict[str, Any] = {EXCHANGE_SOURCE: concat}
-    for dim in ir.dim_tables(reduce_plan):
-        reduce_tables[dim.table] = tables[dim.table]
-    out = run_governed_plan(None, reduce_plan, reduce_tables,
-                            budget=ctx.budget, task_id=ctx.task_id,
-                            manage_task=False)
-    return {k: np.asarray(v) for k, v in out.items()}
+    return received
 
 
 def make_shuffle_handler(plan) -> Callable:
@@ -748,3 +761,161 @@ def run_exchange_plan_local(plan, tables) -> Dict[str, np.ndarray]:
     out = execute_plan(None, reduce_plan, reduce_tables)
     return {k: np.asarray(v)
             for k, v in eval_post(plan, out).items()}
+
+
+# --------------------------------------------------------------------------
+# the range shuffle: distributed sort / window / top-k (round 16)
+# --------------------------------------------------------------------------
+# Same plane, different partitioner and combiner: a RangeExchange plan
+# splits at the exchange like a hash plan, but partitions by RANGE
+# against splitters sampled ONCE at dispatch (they define the global
+# order, so every shard must agree), the reduce plan's Sort/TopK sink
+# orders each partition locally, and the supervisor's combine
+# CONCATENATES the per-partition results in partition order instead of
+# summing — partition p's every row orders before partition p+1's, so
+# the concat IS the merge.  Crash safety is inherited unchanged: splitters
+# ride the shard payloads the supervisor retains, so a re-dispatched or
+# revived map task re-produces bit-identical partitions.
+
+
+def range_split_n(plan, tables: Dict[str, Dict[str, np.ndarray]],
+                  n: int, sample_cap: int = 4096) -> List[dict]:
+    """ShuffleSpec.split_n for a RangeExchange plan: choose splitters
+    once from the WHOLE input (sampled), then chunk the scan tables into
+    ``n`` contiguous row shards, each carrying the same splitters."""
+    from spark_rapids_jni_tpu.plans.compiler import (
+        sample_range_splitters,
+        split_exchange_plan,
+    )
+
+    exchange, _reduce = split_exchange_plan(plan)
+    splitters = sample_range_splitters(exchange, tables, n,
+                                       sample_cap=sample_cap)
+    shards = split_tables_n(tables, scan_table_names(plan), n)
+    return [{"tables": s, "splitters": splitters} for s in shards]
+
+
+def make_range_split(plan, sample_cap: int = 4096) -> Callable:
+    def split_n(tables, n):
+        return range_split_n(plan, tables, n, sample_cap=sample_cap)
+
+    return split_n
+
+
+def run_range_shuffle_piece(plan, payload: dict, ctx
+                            ) -> Dict[str, np.ndarray]:
+    """One RANGE-shuffle child: map (rank + splitter bucketing, partial
+    top-k below the wire) -> produce -> fetch/ack -> ordered local
+    reduce.  ``payload["data"]`` is ``{"tables": <shard>, "splitters":
+    <dispatch-time splitters>}``.  Returns the sink's ordered field
+    vectors sliced to the valid ``rows`` — partition-exact, so the
+    supervisor combine concatenates without trimming."""
+    from spark_rapids_jni_tpu.plans import ir
+    from spark_rapids_jni_tpu.plans.compiler import (
+        EXCHANGE_SOURCE,
+        emit_range_partitions,
+        split_exchange_plan,
+    )
+    from spark_rapids_jni_tpu.plans.runtime import run_governed_plan
+
+    sid = int(payload["sid"])
+    m = int(payload["m"])
+    nparts = int(payload["nparts"])
+    rid = int(payload.get("rid", -1))
+    shard = payload["data"]
+    tables = shard["tables"]
+    splitters = [tuple(s) for s in shard["splitters"]]
+    svc = service()
+    exchange, reduce_plan = split_exchange_plan(plan)
+    parts = emit_range_partitions(exchange, tables, nparts, splitters)
+    svc.produce(sid, m, parts, rid=rid)
+    if payload.get("reproduce"):
+        return {"reproduced": np.int64(m)}
+
+    received = _fetch_all_partitions(svc, sid, m, nparts, rid, ctx)
+    concat = {f: np.concatenate([r[f] for r in received])
+              for f in exchange.fields}
+    reduce_tables: Dict[str, Any] = {EXCHANGE_SOURCE: concat}
+    for dim in ir.dim_tables(reduce_plan):
+        reduce_tables[dim.table] = tables[dim.table]
+    out = run_governed_plan(None, reduce_plan, reduce_tables,
+                            budget=ctx.budget, task_id=ctx.task_id,
+                            manage_task=False)
+    return _slice_order_output(reduce_plan, out)
+
+
+def _slice_order_output(reduce_plan, out) -> Dict[str, np.ndarray]:
+    """Trim an order sink's padded output vectors to the valid ``rows``
+    prefix (invalid rows sort last by construction) — exact-size rows
+    are what cross the wire and what the ordered concat combiner glues."""
+    from spark_rapids_jni_tpu.plans import ir
+
+    sink = ir.order_sink(reduce_plan)
+    rows = int(out["rows"])
+    sliced = {f: np.asarray(out[f])[:rows] for f in sink.fields}
+    sliced["rows"] = np.int64(rows)
+    return sliced
+
+
+def make_range_shuffle_handler(plan) -> Callable:
+    """The executor-side ``QueryHandler.fn`` for one RangeExchange plan."""
+
+    def fn(payload, ctx):
+        return run_range_shuffle_piece(plan, payload, ctx)
+
+    return fn
+
+
+def combine_ordered_outputs(plan) -> Callable:
+    """The supervisor-side join combiner of a range shuffle: children
+    arrive in PARTITION order (_SplitJoin slots are indexed by map
+    index), each already sorted within its key range, so the global
+    result is a plain concatenation — plus the TopK truncation, since
+    k rows per partition can still be nparts*k rows total.  Revival
+    children's marker results are skipped."""
+    from spark_rapids_jni_tpu.plans import ir
+
+    sink = ir.order_sink(plan)
+    if sink is None:
+        raise ValueError(
+            f"plan {plan.name!r} has no Sort/TopK sink: use "
+            f"combine_exchange_outputs for additive plans")
+
+    def combine(outs: List[Dict[str, np.ndarray]]):
+        parts = [o for o in outs
+                 if o is not None and not ("reproduced" in o and len(o) == 1)]
+        cat = {f: np.concatenate([np.asarray(p[f]) for p in parts])
+               for f in sink.fields}
+        rows = sum(int(p["rows"]) for p in parts)
+        if isinstance(sink, ir.TopK):
+            k = int(sink.k)
+            cat = {f: v[:k] for f, v in cat.items()}
+            rows = min(rows, k)
+        cat["rows"] = np.int64(rows)
+        return cat
+
+    return combine
+
+
+def run_range_plan_local(plan, tables) -> Dict[str, np.ndarray]:
+    """The single-process oracle of the range shuffle: one shard, one
+    partition, no splitters, no transport — map emit, identity
+    'shuffle', the same compiled reduce plan, sliced to valid rows.
+    Cluster outputs must be BIT-IDENTICAL to this, including row order —
+    the first workload where shuffle crash-recovery decides answer
+    correctness, not just answer totals."""
+    from spark_rapids_jni_tpu.plans import ir
+    from spark_rapids_jni_tpu.plans.compiler import (
+        EXCHANGE_SOURCE,
+        emit_range_partitions,
+        split_exchange_plan,
+    )
+    from spark_rapids_jni_tpu.plans.runtime import execute_plan
+
+    exchange, reduce_plan = split_exchange_plan(plan)
+    (part0,) = emit_range_partitions(exchange, tables, 1, ())
+    reduce_tables: Dict[str, Any] = {EXCHANGE_SOURCE: part0}
+    for dim in ir.dim_tables(reduce_plan):
+        reduce_tables[dim.table] = tables[dim.table]
+    out = execute_plan(None, reduce_plan, reduce_tables)
+    return _slice_order_output(reduce_plan, out)

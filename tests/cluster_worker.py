@@ -91,6 +91,36 @@ def register_shuffle(engine, capacity: int = 64,
         name="q97_shuffle", fn=fn, nbytes_of=lambda p: 0))
 
 
+def register_order_shuffle(engine, k: int = 3, n_items: int = 40,
+                           map_delay_s: float = 0.0) -> None:
+    """The range-shuffle handlers (round 16): q67 (windowed rank) and the
+    global top-k plan served as real range-partitioned shuffle pieces.
+    Imports stay inside (jax via the plan compiler).  ``map_delay_s``
+    stalls each piece BEFORE its map fragment runs, widening the
+    mid-range-shuffle window the sort-chaos SIGKILL test aims into."""
+    from spark_rapids_jni_tpu.models.q64 import q64_plan
+    from spark_rapids_jni_tpu.models.q67 import q67_plan, topk_sales_plan
+    from spark_rapids_jni_tpu.serve.shuffle import run_range_shuffle_piece
+
+    def make(plan):
+        def fn(payload, ctx):
+            if map_delay_s:
+                time.sleep(map_delay_s)
+            return run_range_shuffle_piece(plan, payload, ctx)
+
+        return fn
+
+    engine.register(QueryHandler(
+        name="q67_shuffle", fn=make(q67_plan(k, n_items)),
+        nbytes_of=lambda p: 0))
+    engine.register(QueryHandler(
+        name="q64_shuffle", fn=make(q64_plan(k, n_items, 25, 2)),
+        nbytes_of=lambda p: 0))
+    engine.register(QueryHandler(
+        name="topk_shuffle", fn=make(topk_sales_plan(k)),
+        nbytes_of=lambda p: 0))
+
+
 def register_cached(engine, service_s: float = 0.02) -> None:
     """Result-cache cluster handlers (round 15).  ``csum`` is a
     cacheable content-keyed sum over a named table with a service-time

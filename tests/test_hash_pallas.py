@@ -209,3 +209,58 @@ def test_backend_flag_routes_xxhash64_columns():
     with config.override(hash_backend="pallas"):
         got = xxhash64(cols, seed=42).to_list()
     assert got == want
+
+
+def test_auto_backend_is_kind_and_size_adaptive(monkeypatch):
+    """The 'auto' default (round 16): strings/bytes NEVER take the pallas
+    word kernel (measured 0.37x, BENCH_r07), fixed-width takes it only on
+    a real TPU backend inside the measured mid-size window; explicit
+    values force every kind."""
+    import jax
+
+    from spark_rapids_jni_tpu.ops import hashing
+
+    with config.override(hash_backend="auto"):
+        # strings/bytes: never, regardless of backend
+        assert not hashing._pallas_backend("bytes")
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        assert not hashing._pallas_backend("bytes")
+        # fixed on 'tpu': only inside the measured window
+        assert hashing._pallas_backend("fixed", hashing._PALLAS_AUTO_MIN)
+        assert hashing._pallas_backend("fixed", 1 << 22)
+        assert not hashing._pallas_backend("fixed", 1 << 24)
+        assert not hashing._pallas_backend("fixed", 1 << 10)
+        # unknown size: treated as in-window
+        assert hashing._pallas_backend("fixed")
+        # fixed off-TPU: interpret mode is pure overhead
+        monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+        assert not hashing._pallas_backend("fixed", 1 << 22)
+    # explicit values force every kind on any backend
+    with config.override(hash_backend="pallas"):
+        assert hashing._pallas_backend("bytes")
+        assert hashing._pallas_backend("fixed", 1 << 24)
+    with config.override(hash_backend="xla"):
+        assert not hashing._pallas_backend("fixed", 1 << 22)
+
+
+def test_auto_never_routes_strings_through_pallas(monkeypatch):
+    """End to end: hashing a string column under 'auto' must not touch
+    the pallas bytes kernel even when the backend claims to be a TPU."""
+    import jax
+
+    from spark_rapids_jni_tpu.columnar.column import strings_from_bytes
+    from spark_rapids_jni_tpu.ops import hashing
+
+    def _boom(*a, **k):
+        raise AssertionError("pallas bytes kernel reached under auto")
+
+    import spark_rapids_jni_tpu.ops.hash_pallas as hp
+
+    monkeypatch.setattr(hp, "mm_bytes_words_pallas", _boom)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    scol = strings_from_bytes([b"spark", b"", b"rapids-jni", b"x" * 40])
+    with config.override(hash_backend="auto"):
+        got = murmur_hash32([scol], seed=42).to_list()
+    with config.override(hash_backend="xla"):
+        want = murmur_hash32([scol], seed=42).to_list()
+    assert got == want

@@ -1,0 +1,358 @@
+"""The order-sensitive operator tier (round 16): Sort/Window/TopK plans
+over a range-partitioned distributed sort.
+
+What ISSUE 16's acceptance pins:
+
+- q67 (windowed rank per category) and q64 (framed running aggregates)
+  compile as range-exchange plans whose output is BIT-identical —
+  values AND row order — to the pure-numpy unfused oracles;
+- the multi-shard path (map emit -> range partitions -> per-partition
+  reduce -> ordered concat) equals the single-process oracle exactly,
+  for any shard/partition split, because splitter ordering makes the
+  concatenation merge-free;
+- ``RangeExchange.limit`` pushes the partial top-k below the wire: the
+  bytes crossing the shuffle are measured and MUST be a fraction of the
+  naive sort-then-limit plan's, with identical answers;
+- the chaos tier: a map-side producer SIGKILLed mid-range-shuffle
+  recovers with the ordered result still bit-identical to the oracle;
+- order-sensitive plans refuse the paths that would corrupt them:
+  in-process RangeExchange compilation, mesh lowering, and governed
+  row-splitting with the additive combiner.
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.models.q64 import (
+    make_q64_tables,
+    q64_oracle,
+    q64_plan,
+)
+from spark_rapids_jni_tpu.models.q67 import (
+    make_q67_tables,
+    naive_sort_limit_plan,
+    q67_oracle,
+    q67_plan,
+    topk_oracle,
+    topk_sales_plan,
+)
+from spark_rapids_jni_tpu.plans import ir
+from spark_rapids_jni_tpu.plans.compiler import (
+    EXCHANGE_SOURCE,
+    compile_plan,
+    emit_range_partitions,
+    sample_range_splitters,
+    split_exchange_plan,
+)
+from spark_rapids_jni_tpu.plans.ir import WinFunc, col
+from spark_rapids_jni_tpu.serve import ShuffleSpec, Supervisor
+from spark_rapids_jni_tpu.serve.shuffle import (
+    combine_ordered_outputs,
+    make_range_split,
+    run_range_plan_local,
+)
+
+jax = pytest.importorskip("jax")
+
+
+def _eq(got, want):
+    assert set(got) == set(want)
+    for k in want:
+        assert np.array_equal(np.asarray(got[k]), np.asarray(want[k])), k
+
+
+# ------------------------------------------------------------ local parity
+
+
+@pytest.mark.parametrize("seed,rows,k", [(1, 5000, 3), (2, 900, 5),
+                                         (3, 64, 2)])
+def test_q67_local_matches_numpy_oracle_bit_identical(seed, rows, k):
+    tables = make_q67_tables(rows, 40, 5, seed=seed)
+    _eq(run_range_plan_local(q67_plan(k, 40), tables),
+        q67_oracle(tables, k))
+
+
+@pytest.mark.parametrize("seed,rows,k,band0", [(2, 4000, 4, 2),
+                                               (5, 1200, 3, 0)])
+def test_q64_local_matches_numpy_oracle_bit_identical(seed, rows, k,
+                                                      band0):
+    tables = make_q64_tables(rows, 30, 25, seed=seed)
+    _eq(run_range_plan_local(q64_plan(k, 30, 25, band0), tables),
+        q64_oracle(tables, k, band0))
+
+
+@pytest.mark.parametrize("k", [1, 7, 100])
+def test_topk_local_matches_oracle_including_k_beyond_rows(k):
+    tables = make_q67_tables(60, 40, 5, seed=4)
+    _eq(run_range_plan_local(topk_sales_plan(k), tables),
+        topk_oracle(tables, k))
+
+
+def test_empty_input_yields_zero_rows():
+    tables = {"store_sales": {
+        "price": np.zeros(0, np.int64), "sid": np.zeros(0, np.int64)}}
+    out = run_range_plan_local(topk_sales_plan(3), tables)
+    assert int(out["rows"]) == 0 and len(out["price"]) == 0
+
+
+# ----------------------------------------------- multi-shard simulation
+
+
+def _run_multiparts(plan, tables, nshards, nparts):
+    """The cluster dance, in-process: split the fact into map shards,
+    emit each shard's range partitions against SHARED splitters, regroup
+    by partition index, reduce each partition with the compiled plan,
+    ordered-concat.  Returns (result, bytes crossing the 'wire')."""
+    from spark_rapids_jni_tpu.plans.runtime import execute_plan
+    from spark_rapids_jni_tpu.serve.shuffle import (
+        _slice_order_output,
+        range_split_n,
+    )
+
+    shards = range_split_n(plan, tables, nshards)
+    exchange, reduce_plan = split_exchange_plan(plan)
+    splitters = sample_range_splitters(exchange, tables, nparts)
+    byshard = [emit_range_partitions(exchange, s["tables"], nparts,
+                                     splitters) for s in shards]
+    outs, nbytes = [], 0
+    for p in range(nparts):
+        concat = {f: np.concatenate([byshard[m][p][f]
+                                     for m in range(nshards)])
+                  for f in exchange.fields}
+        nbytes += sum(v.nbytes for v in concat.values())
+        rt = {EXCHANGE_SOURCE: concat}
+        for dim in ir.dim_tables(reduce_plan):
+            rt[dim.table] = tables[dim.table]
+        outs.append(_slice_order_output(
+            reduce_plan, execute_plan(None, reduce_plan, rt)))
+    return combine_ordered_outputs(plan)(outs), nbytes
+
+
+@pytest.mark.parametrize("nshards,nparts", [(1, 1), (2, 3), (4, 4),
+                                            (3, 2)])
+def test_q67_multi_shard_ordered_concat_is_merge_free(nshards, nparts):
+    tables = make_q67_tables(5000, 40, 5, seed=1)
+    plan = q67_plan(3, 40)
+    got, _ = _run_multiparts(plan, tables, nshards, nparts)
+    _eq(got, q67_oracle(tables, 3))
+    _eq(got, run_range_plan_local(plan, tables))
+
+
+@pytest.mark.parametrize("nshards,nparts", [(2, 2), (3, 4)])
+def test_q64_multi_shard_framed_aggs_survive_the_split(nshards, nparts):
+    tables = make_q64_tables(4000, 30, 25, seed=2)
+    plan = q64_plan(4, 30, 25, 2)
+    got, _ = _run_multiparts(plan, tables, nshards, nparts)
+    _eq(got, q64_oracle(tables, 4, 2))
+
+
+def test_skewed_categories_empty_partitions_still_exact():
+    """90% of rows in one category: some range partitions end up empty,
+    the dominant category's partition carries almost everything — the
+    ordered concat must not care."""
+    tables = make_q67_tables(3000, 40, 5, seed=7)
+    item = tables["item"]
+    item["category"] = np.where(np.arange(40) < 36, 0,
+                                item["category"]).astype(np.int64)
+    got, _ = _run_multiparts(q67_plan(3, 40), tables, 3, 6)
+    _eq(got, q67_oracle(tables, 3))
+
+
+def test_topk_limit_pushdown_cuts_shuffle_bytes_measurably():
+    """The satellite with teeth: the SAME answer, but the limit-pushdown
+    plan ships at most nshards*k rows while the naive sort-then-limit
+    plan ships all of them."""
+    tables = make_q67_tables(20000, 40, 5, seed=3)
+    k, nshards, nparts = 7, 4, 4
+    want = topk_oracle(tables, k)
+    got_p, bytes_push = _run_multiparts(topk_sales_plan(k), tables,
+                                        nshards, nparts)
+    got_n, bytes_naive = _run_multiparts(naive_sort_limit_plan(k), tables,
+                                         nshards, nparts)
+    _eq(got_p, want)
+    _eq(got_n, want)
+    row_bytes = 16  # price + sid, int64 each
+    assert bytes_push <= nshards * k * row_bytes
+    assert bytes_naive >= 20000 * row_bytes
+    assert bytes_push * 20 < bytes_naive  # >= 95% reduction at this shape
+
+
+# ------------------------------------------------- the refusal boundaries
+
+
+def _sig_for(plan):
+    from spark_rapids_jni_tpu.plans.compiler import _arg_layout
+
+    return (None,) * len(_arg_layout(plan))
+
+
+def test_range_exchange_refuses_in_process_compilation():
+    plan = q67_plan(3, 40)
+    with pytest.raises(ValueError, match="RangeExchange"):
+        compile_plan(plan, None, _sig_for(plan))
+
+
+def test_order_sink_refuses_mesh_lowering():
+    plan = ir.Plan("local_sort", (ir.Sort(
+        ir.Scan("t", ("k",)), keys=((col("k"), True),), fields=("k",)),))
+    with pytest.raises(ValueError, match="order-sensitive"):
+        compile_plan(plan, object(), _sig_for(plan))
+
+
+def test_local_window_plan_without_exchange_compiles_and_runs():
+    """Sort/Window plans with no RangeExchange are plain local plans —
+    the governed runner serves them whole (split depth forced to 0)."""
+    from spark_rapids_jni_tpu.plans.runtime import run_governed_plan
+
+    scan = ir.Scan("t", ("g", "v", "sid"))
+    win_node = ir.Window(
+        scan, partition_by=(col("g"),),
+        order_by=((col("v"), False), (col("sid"), True)),
+        funcs=(WinFunc("rn", "row_number", dtype="int32"),
+               WinFunc("rs", "sum", arg=col("v"), dtype="int64")))
+    sink = ir.Sort(win_node, keys=((col("g"), True), (col("rn"), True)),
+                   fields=("g", "v", "sid", "rn", "rs"))
+    plan = ir.Plan("local_window", (sink,))
+    rng = np.random.RandomState(9)
+    tables = {"t": {"g": rng.randint(0, 4, 500).astype(np.int64),
+                    "v": rng.randint(-100, 100, 500).astype(np.int64),
+                    "sid": np.arange(500, dtype=np.int64)}}
+    out = run_governed_plan(None, plan, tables)
+    n = int(out["rows"])
+    g = np.asarray(out["g"])[:n]
+    v = np.asarray(out["v"])[:n]
+    sid = np.asarray(out["sid"])[:n]
+    rn = np.asarray(out["rn"])[:n]
+    rs = np.asarray(out["rs"])[:n]
+    order = np.lexsort((tables["t"]["sid"], -tables["t"]["v"],
+                        tables["t"]["g"]))
+    assert n == 500
+    assert np.array_equal(g, tables["t"]["g"][order])
+    assert np.array_equal(sid, tables["t"]["sid"][order])
+    start = 0
+    for i in range(1, n + 1):
+        if i == n or g[i] != g[start]:
+            assert np.array_equal(rn[start:i],
+                                  np.arange(1, i - start + 1))
+            assert np.array_equal(rs[start:i], np.cumsum(v[start:i]))
+            start = i
+
+
+def test_filter_above_window_filters_on_window_output():
+    """QUALIFY semantics: the rank filter sits ABOVE the Window, so rank
+    is computed over ALL rows and the cut happens after."""
+    tables = make_q67_tables(400, 40, 5, seed=6)
+    out1 = run_range_plan_local(q67_plan(1, 40), tables)
+    # every surviving row is rank 1 (possibly several per category: ties)
+    assert (np.asarray(out1["rk"]) == 1).all()
+    want = q67_oracle(tables, 1)
+    _eq(out1, want)
+
+
+# --------------------------------------------------------- cluster tests
+
+
+def _wait_alive(sup, n, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        snap = sup.snapshot()["workers"]
+        if sum(1 for w in snap.values() if w["state"] == "alive") >= n:
+            return snap
+        time.sleep(0.05)
+    raise AssertionError(f"cluster never reached {n} alive workers")
+
+
+def _order_cluster(map_delay_s=0.0, workers=2, k=3, n_items=40):
+    sup = Supervisor(
+        workers=workers, factory="cluster_worker:register_order_shuffle",
+        factory_kwargs={"k": k, "n_items": n_items,
+                        "map_delay_s": map_delay_s},
+        worker_cfg={"workers": 4, "queue_size": 32},
+        worker_flags={"serve_shuffle_fetch_timeout_s": 20.0},
+        queue_size=32, default_deadline_s=120.0, lease_hang_s=60.0)
+    q67 = q67_plan(k, n_items)
+    q64 = q64_plan(k, n_items, 25, 2)
+    topk = topk_sales_plan(k)
+    sup.register(ShuffleSpec(
+        "q67_shuffle", split_n=make_range_split(q67),
+        combine=combine_ordered_outputs(q67),
+        nbytes_of=lambda p: 0, fanout=workers))
+    sup.register(ShuffleSpec(
+        "q64_shuffle", split_n=make_range_split(q64),
+        combine=combine_ordered_outputs(q64),
+        nbytes_of=lambda p: 0, fanout=workers))
+    sup.register(ShuffleSpec(
+        "topk_shuffle", split_n=make_range_split(topk),
+        combine=combine_ordered_outputs(topk),
+        nbytes_of=lambda p: 0, fanout=workers))
+    return sup
+
+
+@pytest.fixture(scope="module")
+def order_cluster():
+    sup = _order_cluster()
+    yield sup
+    sup.shutdown(drain=False, timeout=15)
+
+
+def test_range_shuffle_spans_processes_bit_identical(order_cluster):
+    """The tentpole's headline: an ORDER-SENSITIVE plan executes across
+    >= 2 executor processes with the row stream bit-identical — values
+    and order — to the single-process oracle."""
+    sup = order_cluster
+    _wait_alive(sup, 2)
+    s = sup.open_session(priority=1)
+    for seed, rows in ((1, 600), (2, 1500)):
+        tables = make_q67_tables(rows, 40, 5, seed=seed)
+        out = sup.submit(s, "q67_shuffle", tables).result(timeout=180)
+        _eq(out, q67_oracle(tables, 3))
+        _eq(out, run_range_plan_local(q67_plan(3, 40), tables))
+        tout = sup.submit(s, "topk_shuffle", tables).result(timeout=180)
+        _eq(tout, topk_oracle(tables, 3))
+    q64t = make_q64_tables(1200, 40, 25, seed=3)
+    q64out = sup.submit(s, "q64_shuffle", q64t).result(timeout=180)
+    _eq(q64out, q64_oracle(q64t, 3, 2))
+    _eq(q64out, run_range_plan_local(q64_plan(3, 40, 25, 2), q64t))
+    assert sup.snapshot()["counters"]["shuffles_started"] >= 5
+    sup.close_session(s)
+
+
+def test_producer_sigkill_mid_range_shuffle_recovers_ordered(tmp_path):
+    """The sort-chaos satellite: SIGKILL a map-side producer while BOTH
+    q67 and q64 range shuffles are inflight — each recovered result must
+    be bit-identical INCLUDING row order to its single-process oracle,
+    proving splitters ride the retained shard payloads (revival re-emits
+    identical partitions)."""
+    sup = _order_cluster(map_delay_s=0.6)
+    try:
+        _wait_alive(sup, 2)
+        s = sup.open_session(priority=1)
+        tables = make_q67_tables(800, 40, 5, seed=9)
+        q64t = make_q64_tables(700, 40, 25, seed=9)
+        before = sup.metrics.get("leases_redispatched")
+        resp = sup.submit(s, "q67_shuffle", tables)
+        resp64 = sup.submit(s, "q64_shuffle", q64t)
+        victim = None
+        deadline = time.monotonic() + 20
+        while victim is None and time.monotonic() < deadline:
+            snap = sup.snapshot()["workers"]
+            victim = next((w for w in snap.values()
+                           if w["inflight"] > 0 and w["pid"]), None)
+            time.sleep(0.02)
+        assert victim is not None, "no map child ever leased"
+        os.kill(victim["pid"], signal.SIGKILL)
+        out = resp.result(timeout=180)
+        _eq(out, q67_oracle(tables, 3))
+        _eq(out, run_range_plan_local(q67_plan(3, 40), tables))
+        out64 = resp64.result(timeout=180)
+        _eq(out64, q64_oracle(q64t, 3, 2))
+        _eq(out64, run_range_plan_local(q64_plan(3, 40, 25, 2), q64t))
+        assert sup.metrics.get("leases_redispatched") >= before + 1
+        assert sup.metrics.get("workers_dead") >= 1
+        _wait_alive(sup, 2, timeout=120)
+    finally:
+        sup.shutdown(drain=False, timeout=20)

@@ -189,6 +189,12 @@ def test_socket_fetch_round_trip_and_gauges(services):
     snap = cons.snapshot()
     assert snap["counters"]["fetched"] == 1
     assert snap["counters"]["bytes_fetched"] > 0
+    # the producer's server thread counts AFTER sendall returns, which
+    # can land a beat behind the consumer's decode on a loaded box
+    deadline = time.monotonic() + 5
+    while (prod.snapshot()["counters"].get("frames_sent") != 1
+           and time.monotonic() < deadline):
+        time.sleep(0.01)
     assert prod.snapshot()["counters"]["frames_sent"] == 1
     assert prod.snapshot()["store_partitions"] == 2
     # advertised sizes drive the consumer's credit reservation

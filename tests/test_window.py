@@ -1,0 +1,281 @@
+"""Window primitives + range-splitter edge cases (round 16 satellites).
+
+The order-sensitive tier lives or dies on two invariants:
+
+- :func:`sort_rank` (device) and :func:`sort_rank_np` (host) are the
+  SAME total order — bit for bit, including NaN, signed zeros and
+  descending — so the host-side partition placement can never disagree
+  with the device-side sort;
+- the splitter chooser degrades safely at the edges: heavy key skew,
+  empty inputs, empty shards, K larger than the row count.
+"""
+
+import numpy as np
+import pytest
+
+import spark_rapids_jni_tpu.plans.window as win
+from spark_rapids_jni_tpu.plans import ir
+from spark_rapids_jni_tpu.plans.ir import WinFunc, col
+
+jax = pytest.importorskip("jax")
+jnp = jax.numpy
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+# ------------------------------------------------------------- sort_rank
+
+
+@pytest.mark.parametrize("dtype", [np.int8, np.int16, np.int32, np.int64,
+                                   np.uint32, np.float32, np.float64])
+@pytest.mark.parametrize("ascending", [True, False])
+def test_sort_rank_orders_like_numpy_sort(dtype, ascending):
+    rng = np.random.RandomState(7)
+    if np.issubdtype(dtype, np.floating):
+        x = rng.randn(257).astype(dtype) * 100
+    else:
+        info = np.iinfo(dtype)
+        x = rng.randint(info.min, int(info.max) + 1,
+                        257).astype(dtype)
+    r = _np(win.sort_rank(jnp.asarray(x), ascending))
+    assert r.dtype == np.uint64
+    order = np.argsort(r, kind="stable")
+    want = np.sort(x)
+    if not ascending:
+        want = want[::-1]
+    assert np.array_equal(x[order], want)
+
+
+@pytest.mark.parametrize("ascending", [True, False])
+def test_sort_rank_np_is_the_device_twin(ascending):
+    rng = np.random.RandomState(11)
+    for x in (rng.randn(128).astype(np.float64),
+              rng.randn(128).astype(np.float32),
+              rng.randint(-2**62, 2**62, 128).astype(np.int64),
+              rng.randint(0, 2**32, 128).astype(np.uint32)):
+        dev = _np(win.sort_rank(jnp.asarray(x), ascending))
+        host = win.sort_rank_np(x, ascending)
+        assert np.array_equal(dev, host), x.dtype
+
+
+def test_sort_rank_float_special_values_total_order():
+    """Spark float ordering: -inf < ... < -0.0 == +0.0 < ... < +inf < NaN,
+    with every NaN bit pattern equal (canonicalised)."""
+    x = np.array([np.nan, np.inf, 1.5, 0.0, -0.0, -1.5, -np.inf,
+                  np.float64(np.nan)], np.float64)
+    # a second, different NaN payload must rank identically
+    weird_nan = np.frombuffer(
+        np.uint64(0x7FF0000000000001).tobytes(), np.float64)[0]
+    x = np.concatenate([x, [weird_nan]])
+    r = win.sort_rank_np(x, True)
+    assert np.array_equal(r, _np(win.sort_rank(jnp.asarray(x), True)))
+    # NaNs (indices 0, 7, 8) all equal and strictly largest
+    assert r[0] == r[7] == r[8]
+    assert (r[0] > np.delete(r, [0, 7, 8])).all()
+    # signed zeros equal
+    assert r[3] == r[4]
+    # the rest is the usual numeric order
+    assert r[6] < r[5] < r[3] < r[2] < r[1] < r[0]
+    # descending is the exact bitwise complement order
+    rd = win.sort_rank_np(x, False)
+    assert (np.argsort(rd, kind="stable")
+            == np.argsort(~r, kind="stable")).all()
+
+
+# ---------------------------------------------------- run/rank primitives
+
+
+def _runs(part, valid):
+    pr = [win.sort_rank(jnp.asarray(part), True)]
+    return win.run_boundaries(pr, jnp.asarray(valid))
+
+
+def test_run_boundaries_and_row_number():
+    part = np.array([3, 3, 3, 7, 7, 9], np.int64)
+    valid = np.ones(6, bool)
+    rs = _np(_runs(part, valid))
+    assert np.array_equal(rs, [1, 0, 0, 1, 0, 1])
+    assert np.array_equal(_np(win.row_number(jnp.asarray(rs.astype(bool)))),
+                          [1, 2, 3, 1, 2, 1])
+
+
+def test_invalid_rows_open_their_own_runs():
+    part = np.array([3, 3, 3, 3], np.int64)
+    valid = np.array([True, True, False, False])
+    rs = _np(_runs(part, valid))
+    # row 2 starts a new run: garbage can never join a valid segment
+    assert rs[2]
+
+
+def test_rank_and_dense_rank_tie_semantics():
+    # one partition, order values with ties: 9 9 7 7 7 4
+    ovals = np.array([9, 9, 7, 7, 7, 4], np.int64)
+    run_start = jnp.asarray(np.array([1, 0, 0, 0, 0, 0], bool))
+    ochange = win.change_points([win.sort_rank(jnp.asarray(ovals), False)])
+    assert np.array_equal(_np(win.rank(run_start, ochange)),
+                          [1, 1, 3, 3, 3, 6])
+    assert np.array_equal(_np(win.dense_rank(run_start, ochange)),
+                          [1, 1, 2, 2, 2, 3])
+
+
+def test_rank_resets_across_runs():
+    ovals = np.array([9, 9, 9, 9], np.int64)
+    run_start = jnp.asarray(np.array([1, 0, 1, 0], bool))
+    ochange = win.change_points([win.sort_rank(jnp.asarray(ovals), False)])
+    assert np.array_equal(_np(win.rank(run_start, ochange)), [1, 1, 1, 1])
+    assert np.array_equal(_np(win.dense_rank(run_start, ochange)),
+                          [1, 1, 1, 1])
+
+
+@pytest.mark.parametrize("preceding", [None, 0, 1, 3, 10])
+def test_framed_sum_matches_window_slices(preceding):
+    rng = np.random.RandomState(5)
+    v = rng.randint(-50, 50, 40).astype(np.int64)
+    starts = np.zeros(40, bool)
+    starts[[0, 7, 8, 30]] = True
+    got = _np(win.framed_sum(jnp.asarray(v), jnp.asarray(starts),
+                             preceding=preceding))
+    seg = np.cumsum(starts) - 1
+    for i in range(40):
+        s = int(np.flatnonzero(starts[:i + 1])[-1])
+        lo = s if preceding is None else max(s, i - preceding)
+        assert got[i] == v[lo:i + 1].sum(), (i, preceding)
+    assert seg.max() == 3
+
+
+@pytest.mark.parametrize("kind", ["min", "max"])
+@pytest.mark.parametrize("preceding", [None, 0, 2, 64])
+def test_framed_minmax_matches_window_slices(kind, preceding):
+    rng = np.random.RandomState(6)
+    v = rng.randint(-1000, 1000, 50).astype(np.int64)
+    starts = np.zeros(50, bool)
+    starts[[0, 1, 17, 44]] = True
+    got = _np(win.framed_minmax(jnp.asarray(v), jnp.asarray(starts), kind,
+                                preceding=preceding))
+    ref = np.min if kind == "min" else np.max
+    for i in range(50):
+        s = int(np.flatnonzero(starts[:i + 1])[-1])
+        lo = s if preceding is None else max(s, i - preceding)
+        assert got[i] == ref(v[lo:i + 1]), (i, kind, preceding)
+
+
+def test_order_permutation_stable_and_invalid_last():
+    keys = np.array([5, 1, 5, 1, 5], np.int64)
+    valid = np.array([True, True, False, True, True])
+    perm = _np(win.order_permutation(
+        [win.sort_rank(jnp.asarray(keys), True)], jnp.asarray(valid)))
+    # valid rows in key order (stable within ties), invalid row last
+    assert np.array_equal(perm, [1, 3, 0, 4, 2])
+
+
+# --------------------------------------------------------- the splitters
+
+
+def _ranks_of(x):
+    return [win.sort_rank_np(np.asarray(x, np.int64), True)]
+
+
+def test_choose_splitters_balances_uniform_keys():
+    rng = np.random.RandomState(3)
+    keys = rng.randint(0, 1000, 5000)
+    rk = _ranks_of(keys)
+    valid = np.ones(5000, bool)
+    spl = win.choose_splitters(rk, valid, 4)
+    assert len(spl) == 3
+    parts = win.range_partition(rk, spl)
+    counts = np.bincount(parts, minlength=4)
+    assert (counts > 500).all()  # no empty / starved partition
+
+
+def test_range_partition_concat_is_globally_sorted():
+    rng = np.random.RandomState(4)
+    keys = rng.randint(-500, 500, 2000).astype(np.int64)
+    rk = _ranks_of(keys)
+    spl = win.choose_splitters(rk, np.ones(2000, bool), 5)
+    parts = win.range_partition(rk, spl)
+    chunks = [np.sort(keys[parts == p]) for p in range(5)]
+    assert np.array_equal(np.concatenate(chunks), np.sort(keys))
+
+
+def test_heavy_skew_duplicate_splitters_still_partition_correctly():
+    """One key value holds 90% of the rows — duplicated splitters are
+    fine as long as equal keys land on ONE partition and the concat
+    stays sorted."""
+    keys = np.concatenate([np.full(9000, 42, np.int64),
+                           np.arange(1000, dtype=np.int64)])
+    rk = _ranks_of(keys)
+    spl = win.choose_splitters(rk, np.ones(len(keys), bool), 8)
+    parts = win.range_partition(rk, spl)
+    # all rows with the dominant key share one partition
+    assert len(np.unique(parts[keys == 42])) == 1
+    chunks = [np.sort(keys[parts == p]) for p in range(8)]
+    assert np.array_equal(np.concatenate(chunks), np.sort(keys))
+
+
+def test_empty_and_all_invalid_inputs_yield_usable_splitters():
+    rk = _ranks_of(np.zeros(0, np.int64))
+    spl = win.choose_splitters(rk, np.zeros(0, bool), 3)
+    assert len(spl) == 2
+    parts = win.range_partition(rk, spl)
+    assert parts.shape == (0,)
+    # all-invalid: same degenerate path
+    rk = _ranks_of(np.arange(10))
+    spl = win.choose_splitters(rk, np.zeros(10, bool), 3)
+    assert len(spl) == 2
+
+
+def test_float_keys_nan_and_signed_zero_partition_consistently():
+    keys = np.array([np.nan, -0.0, 0.0, -np.inf, np.inf, 3.5, np.nan],
+                    np.float64)
+    rk = [win.sort_rank_np(keys, True)]
+    spl = win.choose_splitters(rk, np.ones(7, bool), 3)
+    parts = win.range_partition(rk, spl)
+    # equal keys (both NaNs; both zeros) must co-locate
+    assert parts[0] == parts[6]
+    assert parts[1] == parts[2]
+    # device ranks agree, so device-side sorting inside a partition can
+    # never move a row across the host-chosen boundary
+    dev = _np(win.sort_rank(jnp.asarray(keys), True))
+    assert np.array_equal(dev, rk[0])
+
+
+def test_multi_key_splitters_lexicographic():
+    rng = np.random.RandomState(8)
+    a = rng.randint(0, 4, 3000).astype(np.int64)
+    b = rng.randint(0, 1000, 3000).astype(np.int64)
+    rk = [win.sort_rank_np(a, True), win.sort_rank_np(b, False)]
+    spl = win.choose_splitters(rk, np.ones(3000, bool), 4)
+    parts = win.range_partition(rk, spl)
+    # concat in partition order must equal the global lexsort order
+    order = np.lexsort((win.sort_rank_np(b, False), a))
+    got = np.concatenate([np.flatnonzero(parts == p)[np.lexsort(
+        (win.sort_rank_np(b[parts == p], False), a[parts == p]))]
+        for p in range(4)])
+    assert np.array_equal(a[got], a[order])
+    assert np.array_equal(b[got], b[order])
+
+
+# --------------------------------------------------------- IR validation
+
+
+def test_winfunc_validation():
+    with pytest.raises(ValueError, match="requires an arg"):
+        WinFunc("s", "sum")
+    with pytest.raises(ValueError, match="takes no frame"):
+        WinFunc("r", "rank", preceding=2)
+    with pytest.raises(ValueError, match="unknown window"):
+        WinFunc("x", "median", arg=col("v"))
+
+
+def test_order_sink_helper_finds_and_validates():
+    scan = ir.Scan("t", ("k", "v"))
+    sink = ir.Sort(scan, keys=((col("k"), True),), fields=("k", "v"))
+    plan = ir.Plan("p", (sink,))
+    assert ir.order_sink(plan) is sink
+    agg = ir.SegmentAgg(scan, key=col("k"), num_segments=4,
+                        aggs=(("s", col("v"), "int64"),))
+    assert ir.order_sink(ir.Plan("q", (agg,))) is None
+    with pytest.raises(ValueError, match="only sink"):
+        ir.order_sink(ir.Plan("r", (sink, agg)))
