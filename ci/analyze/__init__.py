@@ -8,7 +8,7 @@ those *before* merge — the compile-time complement of the arbiter's
 runtime deadlock detector (native/task_arbiter.cpp), in the spirit of
 Flare's compile-time checking of Spark-native runtime contracts.
 
-Eleven passes (see docs/STATIC_ANALYSIS.md for the invariants):
+Thirteen passes (see docs/STATIC_ANALYSIS.md for the invariants):
 
 - ``lock-order``           cycles in the static lock-acquisition graph
 - ``unguarded-shared-state`` unlocked attribute writes in lock-owning classes
@@ -29,6 +29,12 @@ Eleven passes (see docs/STATIC_ANALYSIS.md for the invariants):
   exception edges included (cfg.py control-flow layer)
 - ``blocking-under-lock``  blocking primitives (socket/pipe I/O, sleep,
   unbounded waits) reachable while a lock is held
+- ``protocol-model``       bounded exploration of the declared
+  supervisor/worker/shuffle machines (analyze/model/): exactly-once
+  completion, no orphan leases, stale-incarnation drops, balanced
+  event pairs — mutation-gated against the historical protocol bugs
+- ``twin-drift``           ``# twin:`` host/device function pairs must
+  keep structurally equivalent bodies modulo jnp/np idiom
 
 Workflow:
 

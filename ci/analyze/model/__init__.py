@@ -1,0 +1,37 @@
+"""Merge-time protocol model checking (analyze pass 12, `protocol-model`).
+
+Passes 8-9 force the code to *declare* its protocol: `MESSAGE_FIELDS`
+registries (serve/rpc.py, columnar/frames.py), `# state-machine:`
+transition tables (lease, worker, ladder, response, shuffle_task,
+rcache_tier), and `EVENT_PAIRS` open/close obligations (obs/flight.py).
+This package *executes* those declarations:
+
+- :mod:`extract` compiles the declared artifacts into a checkable
+  protocol (transition relations, typed channel alphabets, event
+  obligations) and cross-checks every artifact an environment model
+  binds — a table the code stopped declaring, a message tag the model
+  still sends, an undeclared edge the model exercises: all findings.
+- :mod:`lease` and :mod:`shuffle` are hand-written environment models
+  (~one screen each) binding the machines to channel semantics:
+  dispatch/result/hello FIFOs, SIGKILL + respawn with incarnation bump,
+  pipe EOF, duplicate and late delivery, shuffle
+  produce/ack/map-rebroadcast/cleanup.
+- :mod:`explore` is the bounded BFS explorer: canonicalized states with
+  symmetry reduction over worker and request ids, invariants checked on
+  every state and at quiescence, counterexamples reconstructed as
+  message-interleaving traces in the flight-event vocabulary.
+
+The three historical protocol bugs (CHANGES.md PRs 9/10/12) are kept as
+model *mutations* (`fanout_regrant`, `pick_vs_send`, `stale_produce`);
+the pass re-runs the checker against each on every gate and fails if a
+mutation stops producing a counterexample — the checker proves its own
+teeth before vouching for the fixed model.
+"""
+
+from .explore import Result, Violation, explore  # noqa: F401
+from .extract import Protocol, load_protocol  # noqa: F401
+from .lease import LeaseModel  # noqa: F401
+from .shuffle import ShuffleModel  # noqa: F401
+
+__all__ = ["Result", "Violation", "explore", "Protocol", "load_protocol",
+           "LeaseModel", "ShuffleModel"]

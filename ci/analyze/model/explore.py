@@ -1,0 +1,112 @@
+"""Bounded explicit-state exploration: BFS over canonicalized states.
+
+The explorer is deliberately generic — a model is any object with:
+
+- ``initial()``            -> hashable state
+- ``actions(state)``       -> iterable of ``(label, next_state)``
+- ``check(state)``         -> list of ``(invariant, message)`` violations
+- ``at_quiescence(state)`` -> violations checked when no action is
+  enabled (terminal states of the exploration, e.g. "every request
+  reached exactly one terminal completion")
+- ``canon(state)``         -> canonical representative (symmetry
+  reduction; identity when the model has none)
+
+BFS guarantees the first violation found has a shortest trace, so
+counterexamples read as the minimal message interleaving that breaks the
+invariant.  States are explored *canonicalized* — successor states are
+folded through ``canon`` before insertion, which is what keeps the
+2-worker x 3-request lease space in the tens of thousands instead of
+the millions.
+
+``max_states`` is a hard bound, not a hint: a model whose reachable set
+outgrows it reports ``complete=False`` and the pass turns that into a
+finding, so model growth can never silently blow the gate's time budget.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import List, Tuple
+
+__all__ = ["Violation", "Result", "explore"]
+
+
+class Violation:
+    """One invariant violation with its message-interleaving trace."""
+
+    __slots__ = ("invariant", "message", "trace")
+
+    def __init__(self, invariant: str, message: str,
+                 trace: Tuple[str, ...]):
+        self.invariant = invariant
+        self.message = message
+        self.trace = trace
+
+    def format(self) -> str:
+        steps = "\n".join(f"  {i + 1}. {s}" for i, s in enumerate(
+            self.trace)) or "  (initial state)"
+        return (f"invariant '{self.invariant}' violated: {self.message}\n"
+                f"{steps}")
+
+
+class Result:
+    __slots__ = ("states", "quiescent", "violations", "complete")
+
+    def __init__(self, states: int, quiescent: int,
+                 violations: List[Violation], complete: bool):
+        self.states = states  # canonical states explored
+        self.quiescent = quiescent  # states with no enabled action
+        self.violations = violations
+        self.complete = complete  # reached fixpoint under max_states
+
+
+def _trace(parents: dict, key) -> Tuple[str, ...]:
+    steps: List[str] = []
+    while True:
+        parent, label = parents[key]
+        if parent is None:
+            break
+        steps.append(label)
+        key = parent
+    return tuple(reversed(steps))
+
+
+def explore(model, max_states: int = 400_000,
+            stop_at_first: bool = True,
+            max_violations: int = 8) -> Result:
+    """Exhaust the model's reachable canonical states (or ``max_states``)."""
+    init = model.canon(model.initial())
+    parents = {init: (None, None)}
+    frontier = deque([init])
+    violations: List[Violation] = []
+    quiescent = 0
+
+    def violate(key, found) -> bool:
+        for invariant, message in found:
+            violations.append(Violation(invariant, message,
+                                        _trace(parents, key)))
+            if stop_at_first or len(violations) >= max_violations:
+                return True
+        return False
+
+    if violate(init, model.check(init)):
+        return Result(1, 0, violations, True)
+    while frontier:
+        state = frontier.popleft()
+        enabled = False
+        for label, nxt in model.actions(state):
+            enabled = True
+            key = model.canon(nxt)
+            if key in parents:
+                continue
+            parents[key] = (state, label)
+            if violate(key, model.check(key)):
+                return Result(len(parents), quiescent, violations, True)
+            if len(parents) >= max_states:
+                return Result(len(parents), quiescent, violations, False)
+            frontier.append(key)
+        if not enabled:
+            quiescent += 1
+            if violate(state, model.at_quiescence(state)):
+                return Result(len(parents), quiescent, violations, True)
+    return Result(len(parents), quiescent, violations, True)

@@ -1,0 +1,172 @@
+"""Extractor: compile declared protocol artifacts into a checkable model.
+
+Nothing here invents protocol facts — everything is read from what the
+code already declares under passes 8-9:
+
+- ``# state-machine:`` tables (statemachine.load_machines) become the
+  per-entity transition relations the environment models must move
+  within;
+- ``MESSAGE_FIELDS`` registries (wire.load_message_registry) become the
+  typed per-channel FIFO alphabets — a model may only put declared tags
+  with declared fields on a channel;
+- ``EVENT_PAIRS`` (statemachine.load_event_pairs) become the open/close
+  obligations the explorer checks at quiescence.
+
+``validate_binding`` is the drift tripwire in both directions: an
+environment model that exercises an undeclared edge, sends an undeclared
+tag/field, or tracks an undeclared obligation is a finding (the model
+went stale), and a model that binds a machine the code no longer
+declares is a finding too (the code dropped its contract).  The static
+graph checks (``check_machine_graphs``) prove the pure-table properties
+that need no exploration: the degradation ladder has no absorbing
+degraded state, every declared response terminal is reachable from
+pending, and the rcache tier walk has a terminal residency.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding
+from ..passes.statemachine import load_event_pairs, load_machines
+from ..passes.wire import load_message_registry
+
+__all__ = ["Protocol", "load_protocol", "validate_binding",
+           "check_machine_graphs", "RULE"]
+
+RULE = "protocol-model"
+
+
+class Protocol:
+    """The compiled protocol: machines by name, tag alphabet, pairs."""
+
+    def __init__(self, machines: Dict[str, object],
+                 tags: Dict[str, tuple],
+                 pairs: List[Tuple[str, str]]):
+        self.machines = machines  # name -> statemachine._Machine
+        self.tags = tags  # tag value -> (tag_name, (field, ...))
+        self.pairs = pairs  # [(EV_OPEN, EV_CLOSE) constant names]
+
+    def anchor(self, machine: Optional[str] = None) -> Tuple[object, int]:
+        """(module, line) to pin a finding to: the named machine's
+        declaration, falling back to the lease table."""
+        m = self.machines.get(machine) if machine else None
+        if m is None:
+            m = self.machines["lease"]
+        return m.mod, m.line
+
+
+def load_protocol(project, config) -> Protocol:
+    """Compile the declared artifacts.  Malformed declarations are pass
+    8/9's findings — here they are simply absent from the model."""
+    machines, _ = load_machines(project, config)
+    by_name: Dict[str, object] = {}
+    for m in machines:
+        by_name.setdefault(m.name, m)
+    registry, _ = load_message_registry(project, config)
+    return Protocol(by_name, registry, load_event_pairs(project, config))
+
+
+def _finding(proto: Protocol, machine: Optional[str], msg: str,
+             findings: List[Finding]) -> None:
+    mod, line = proto.anchor(machine)
+    if not mod.suppressed(RULE, line):
+        findings.append(Finding(RULE, mod.relpath, line, msg))
+
+
+def validate_binding(proto: Protocol, model) -> List[Finding]:
+    """Every artifact ``model`` binds must be declared by the code."""
+    findings: List[Finding] = []
+    for name in sorted(model.EDGES_USED):
+        mach = proto.machines.get(name)
+        if mach is None:
+            _finding(proto, None,
+                     f"environment model '{model.name}' binds state "
+                     f"machine {name!r} but no `# state-machine: {name}` "
+                     f"table is declared", findings)
+            continue
+        for a, b in sorted(model.EDGES_USED[name], key=str):
+            if (a, b) not in mach.edges:
+                _finding(proto, name,
+                         f"environment model '{model.name}' exercises "
+                         f"transition {a!r} -> {b!r} of machine {name!r} "
+                         f"but the declared table has no such edge",
+                         findings)
+    for tag in sorted(model.TAGS_USED):
+        entry = proto.tags.get(tag)
+        if entry is None:
+            _finding(proto, None,
+                     f"environment model '{model.name}' sends message "
+                     f"tag {tag!r} but no MESSAGE_FIELDS registry "
+                     f"declares it", findings)
+            continue
+        missing = [f for f in model.TAGS_USED[tag] if f not in entry[1]]
+        if missing:
+            _finding(proto, None,
+                     f"environment model '{model.name}' populates "
+                     f"field(s) {', '.join(repr(f) for f in missing)} of "
+                     f"message {tag!r} but MESSAGE_FIELDS declares only "
+                     f"({', '.join(entry[1])})", findings)
+    declared_pairs = {tuple(p) for p in proto.pairs}
+    for a, b in model.PAIRS_USED:
+        if (a, b) not in declared_pairs:
+            _finding(proto, None,
+                     f"environment model '{model.name}' tracks the "
+                     f"obligation {a} -> {b} but EVENT_PAIRS does not "
+                     f"declare that pair", findings)
+    return findings
+
+
+def _reaches(src, dst, edges: Set[Tuple[object, object]]) -> bool:
+    seen, frontier = {src}, [src]
+    while frontier:
+        s = frontier.pop()
+        if s == dst:
+            return True
+        for a, b in edges:
+            if a == s and b not in seen:
+                seen.add(b)
+                frontier.append(b)
+    return False
+
+
+def check_machine_graphs(proto: Protocol) -> List[Finding]:
+    """Pure-table properties needing no exploration."""
+    findings: List[Finding] = []
+    ladder = proto.machines.get("ladder")
+    if ladder is None:
+        _finding(proto, None,
+                 "protocol model expects a degradation-ladder table "
+                 "(`# state-machine: ladder`) but none is declared",
+                 findings)
+    else:
+        healthy = min(ladder.states)
+        for s in sorted(ladder.states, key=str):
+            if not _reaches(s, healthy, ladder.edges):
+                _finding(proto, "ladder",
+                         f"ladder level {s!r} cannot reach the healthy "
+                         f"level {healthy!r}: an absorbing degraded "
+                         f"state — the cluster would never recover",
+                         findings)
+    resp = proto.machines.get("response")
+    if resp is None:
+        _finding(proto, None,
+                 "protocol model expects a response-lifecycle table "
+                 "(`# state-machine: response`) but none is declared",
+                 findings)
+    else:
+        for s in sorted(resp.states, key=str):
+            if s != "pending" and ("pending", s) not in resp.edges:
+                _finding(proto, "response",
+                         f"response terminal {s!r} is not reachable "
+                         f"from 'pending': dead vocabulary or a missing "
+                         f"edge", findings)
+    rcache = proto.machines.get("rcache_tier")
+    if rcache is not None and not any(
+            all(a != s for a, _b in rcache.edges)
+            for s in rcache.states):
+        _finding(proto, "rcache_tier",
+                 "rcache_tier declares no terminal residency (every "
+                 "tier has outgoing demotions): entries could demote "
+                 "forever", findings)
+    return findings

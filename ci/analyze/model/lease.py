@@ -1,0 +1,292 @@
+"""Environment model: the supervisor/executor lease protocol.
+
+One screen of transition rules binding the declared machines (``lease``
+queued/leased/done, ``worker`` starting/alive/dead, ``response``
+pending->terminal) to the channel semantics the supervisor actually
+lives under: per-incarnation dispatch/result FIFOs, SIGKILL dropping a
+worker's unread input, death *detection* (pipe EOF / heartbeat loss) as
+a separate later event, respawn with an incarnation bump, and late
+results from a dead incarnation still sitting in the pipe — the
+duplicate/stale deliveries `_on_result` must drop.
+
+Faithful abstractions of serve/supervisor.py behavior:
+
+- ``grant`` picks a target, records the lease, and sends MSG_DISPATCH as
+  one atomic step (the round-10 fix); a send onto a killed-but-
+  undetected worker's pipe fails, which reclaims the lease and declares
+  the worker dead immediately (SafeConn's False return path).
+- ``detect`` (pipe EOF) is idempotent per incarnation: it re-queues
+  exactly the leases recorded against the dead incarnation, then
+  respawns the slot at ``incarnation + 1`` (hello in flight).
+- a result whose (worker, incarnation) does not match the lease is
+  dropped — never completed.
+
+Mutations re-introduce the historical bugs for the checker's own
+mutation gate (see package docstring): ``fanout_regrant`` (PR 9: a
+re-dispatched fanout-capable request fans out instead of re-granting,
+orphaning its lease) and ``pick_vs_send`` (PR 10: target pick and lease
+record in separate critical sections, letting a kill interleave).
+"""
+
+from __future__ import annotations
+
+from itertools import permutations
+from typing import Iterator, Optional, Tuple
+
+__all__ = ["LeaseModel", "LEASE_MUTATIONS"]
+
+_QUEUED, _LEASED, _DONE = "queued", "leased", "done"
+_STARTING, _ALIVE, _DEAD = "starting", "alive", "dead"
+
+LEASE_MUTATIONS = ("fanout_regrant", "pick_vs_send")
+
+# state layout (all tuples, hashable):
+#   workers: per slot (inc, health, live, down, up)
+#     down: ((rid, inc), ...)                  supervisor -> worker
+#     up:   (("hello", inc) | ("result", rid, status, inc), ...)
+#   leases:  per rid (state, worker, inc, redispatched)
+#   resp:    per rid completion count (capped at 2)
+#   kills, busy: remaining environment budgets
+#   pending: ((rid, worker, inc), ...)         pick_vs_send only
+#   fanned:  per rid bool                      fanout_regrant only
+
+
+class LeaseModel:
+    name = "lease"
+    # every move the model performs, cross-checked against the declared
+    # tables by extract.validate_binding — table drift breaks the gate
+    EDGES_USED = {
+        "lease": {(_QUEUED, _LEASED), (_LEASED, _QUEUED), (_LEASED, _DONE)},
+        "worker": {(_STARTING, _ALIVE), (_STARTING, _DEAD), (_ALIVE, _DEAD)},
+        "response": {("pending", "ok")},
+    }
+    TAGS_USED = {
+        "hello": ("worker_id", "incarnation"),
+        "dispatch": ("rid",),
+        "result": ("rid", "status"),
+    }
+    PAIRS_USED = (("EV_LEASE_GRANT", "EV_LEASE_DONE"),)
+
+    def __init__(self, workers: int = 2, requests: int = 3,
+                 kills: int = 2, busy: int = 1,
+                 mutation: Optional[str] = None, symmetry: bool = True):
+        self.W, self.R = workers, requests
+        self.kills, self.busy = kills, busy
+        assert mutation in (None,) + LEASE_MUTATIONS
+        self.mutation = mutation
+        # per permutation: (slot order, rid order, inverse maps as tuples)
+        self._perms = ([(wp, rp,
+                         tuple(wp.index(w) for w in range(workers)),
+                         tuple(rp.index(r) for r in range(requests)))
+                        for wp in permutations(range(workers))
+                        for rp in permutations(range(requests))]
+                       if symmetry else [])
+
+    def initial(self):
+        workers = ((0, _ALIVE, True, (), ()),) * self.W
+        leases = ((_QUEUED, -1, -1, False),) * self.R
+        return (workers, leases, (0,) * self.R, self.kills, self.busy,
+                (), (False,) * self.R)
+
+    # -- actions ------------------------------------------------------------
+    def actions(self, s) -> Iterator[Tuple[str, tuple]]:
+        workers, leases, resp, kills, busy, pending, fanned = s
+        for rid, l in enumerate(leases):
+            if l[0] != _QUEUED or fanned[rid] or any(
+                    p[0] == rid for p in pending):
+                continue
+            if self.mutation == "fanout_regrant" and l[3]:
+                # PR 9 bug: the re-dispatch takes the fanout path —
+                # children complete the response, the lease is never
+                # re-granted and never reaches done
+                yield (f"re-grant rid={rid}: fanout children complete the "
+                       f"response, lease left {l[0]!r} (mutation)",
+                       (workers, leases, _bump(resp, rid), kills, busy,
+                        pending, _set(fanned, rid, True)))
+                continue
+            for w, ws in enumerate(workers):
+                if ws[1] != _ALIVE:
+                    continue
+                if self.mutation == "pick_vs_send":
+                    # PR 10 bug: target picked in one critical section,
+                    # lease recorded + sent in a later one
+                    yield (f"pick target rid={rid} -> w{w}@i{ws[0]} "
+                           f"(no lease recorded yet; mutation)",
+                           (workers, leases, resp, kills, busy,
+                            pending + ((rid, w, ws[0]),), fanned))
+                elif ws[2]:
+                    nl = _set(leases, rid, (_LEASED, w, ws[0], l[3]))
+                    nw = _set(workers, w, ws[:3] + (
+                        ws[3] + ((rid, ws[0]),), ws[4]))
+                    yield (f"MSG_DISPATCH rid={rid} -> w{w}@i{ws[0]} "
+                           f"[EV_LEASE_GRANT] (lease queued->leased)",
+                           (nw, nl, resp, kills, busy, pending, fanned))
+                else:
+                    # send onto a killed pipe fails: reclaim + declare dead
+                    nl = _set(leases, rid, (_QUEUED, -1, -1, True))
+                    yield (f"MSG_DISPATCH rid={rid} -> w{w}@i{ws[0]} send "
+                           f"fails (broken pipe): lease reclaimed "
+                           f"leased->queued, w{w} declared dead",
+                           self._detect(
+                               (workers, nl, resp, kills, busy, pending,
+                                fanned), w)[1])
+        for i, (rid, w, inc) in enumerate(pending):  # pick_vs_send commit
+            ws = workers[w]
+            nl = _set(leases, rid, (_LEASED, w, inc, leases[rid][3]))
+            nw = (_set(workers, w, ws[:3] + (ws[3] + ((rid, inc),), ws[4]))
+                  if ws[0] == inc and ws[2] else workers)
+            yield (f"record lease rid={rid} on picked w{w}@i{inc} + "
+                   f"MSG_DISPATCH [EV_LEASE_GRANT] (mutation: target "
+                   f"snapshot may be stale)",
+                   (nw, nl, resp, kills, busy,
+                    pending[:i] + pending[i + 1:], fanned))
+        for w, ws in enumerate(workers):
+            if ws[2] and ws[3]:  # worker consumes one dispatch
+                (rid, minc), rest = ws[3][0], ws[3][1:]
+                if minc != ws[0]:
+                    yield (f"w{w} drops dispatch rid={rid} for stale i{minc}",
+                           (_set(workers, w, ws[:3] + (rest, ws[4])),) + s[1:])
+                    continue
+                ok = ws[:3] + (rest, ws[4] + (("result", rid, "ok", ws[0]),))
+                yield (f"w{w}@i{ws[0]} computes rid={rid}, MSG_RESULT ok "
+                       f"enqueued", (_set(workers, w, ok),) + s[1:])
+                if busy > 0:
+                    bz = ws[:3] + (rest,
+                                   ws[4] + (("result", rid, "busy", ws[0]),))
+                    yield (f"w{w}@i{ws[0]} rejects rid={rid} "
+                           f"(Backpressure), MSG_RESULT busy enqueued",
+                           (_set(workers, w, bz), leases, resp, kills,
+                            busy - 1, pending, fanned))
+            if ws[4]:  # supervisor delivers one up-message
+                yield self._deliver(s, w)
+        if kills > 0:
+            for w, ws in enumerate(workers):
+                if ws[2]:
+                    nw = _set(workers, w, (ws[0], ws[1], False, (), ws[4]))
+                    yield (f"SIGKILL w{w}@i{ws[0]} (unread dispatches lost, "
+                           f"sent results still in the pipe)",
+                           (nw, leases, resp, kills - 1, busy, pending,
+                            fanned))
+        for w, ws in enumerate(workers):
+            if not ws[2]:
+                yield self._detect(s, w)
+
+    def _deliver(self, s, w) -> Tuple[str, tuple]:
+        workers, leases, resp, kills, busy, pending, fanned = s
+        ws = workers[w]
+        msg, rest = ws[4][0], ws[4][1:]
+        nw = _set(workers, w, ws[:4] + (rest,))
+        ns = (nw, leases, resp, kills, busy, pending, fanned)
+        if msg[0] == "hello":
+            if msg[1] == ws[0] and ws[1] == _STARTING:
+                nw = _set(workers, w, (ws[0], _ALIVE, ws[2], ws[3], rest))
+                return (f"MSG_HELLO w{w}@i{msg[1]} [EV_WORKER_SPAWN] "
+                        f"(worker starting->alive)",
+                        (nw,) + ns[1:])
+            return f"stale MSG_HELLO w{w}@i{msg[1]} dropped", ns
+        _, rid, st, minc = msg
+        l = leases[rid]
+        if l[0] == _LEASED and l[1] == w and l[2] == minc:
+            if st == "ok":
+                nl = _set(leases, rid, (_DONE, -1, -1, l[3]))
+                return (f"MSG_RESULT rid={rid} ok from w{w}@i{minc} "
+                        f"[EV_LEASE_DONE] (lease leased->done, response "
+                        f"pending->ok)",
+                        (nw, nl, _bump(resp, rid), kills, busy, pending,
+                         fanned))
+            nl = _set(leases, rid, (_QUEUED, -1, -1, True))
+            return (f"MSG_RESULT rid={rid} busy from w{w}@i{minc} "
+                    f"[EV_LEASE_REDISPATCH] (lease leased->queued)",
+                    (nw, nl, resp, kills, busy, pending, fanned))
+        return (f"MSG_RESULT rid={rid} {st} from w{w}@i{minc}: stale "
+                f"incarnation — dropped (duplicate_results)", ns)
+
+    def _detect(self, s, w) -> Tuple[str, tuple]:
+        workers, leases, resp, kills, busy, pending, fanned = s
+        ws = workers[w]
+        requeued = [rid for rid, l in enumerate(leases)
+                    if l[0] == _LEASED and l[1] == w and l[2] == ws[0]]
+        nl = leases
+        for rid in requeued:
+            nl = _set(nl, rid, (_QUEUED, -1, -1, True))
+        nw = _set(workers, w, (ws[0] + 1, _STARTING, True, (),
+                               ws[4] + (("hello", ws[0] + 1),)))
+        rq = (f", requeue rid={requeued} [EV_LEASE_REDISPATCH] "
+              f"(lease leased->queued)" if requeued else "")
+        return (f"pipe EOF w{w}@i{ws[0]} [EV_WORKER_DEAD] (worker "
+                f"alive->dead){rq}; respawn w{w}@i{ws[0] + 1} "
+                f"[EV_WORKER_SPAWN]",
+                (nw, nl, resp, kills, busy, pending, fanned))
+
+    # -- invariants ---------------------------------------------------------
+    def check(self, s):
+        workers, leases, resp = s[0], s[1], s[2]
+        out = []
+        for rid, l in enumerate(leases):
+            if l[0] == _LEASED and l[2] < workers[l[1]][0]:
+                out.append((
+                    "no-orphan-lease",
+                    f"lease rid={rid} is LEASED on dead incarnation "
+                    f"w{l[1]}@i{l[2]} (slot already respawned at "
+                    f"i{workers[l[1]][0]}) and rid={rid} is not queued — "
+                    f"the orphan shape: nothing will ever complete it"))
+        for rid, c in enumerate(resp):
+            if c > 1:
+                out.append((
+                    "exactly-once-completion",
+                    f"request rid={rid} completed {c} times (response "
+                    f"pending->terminal must happen exactly once)"))
+        return out
+
+    def at_quiescence(self, s):
+        leases, resp = s[1], s[2]
+        out = []
+        for rid, c in enumerate(resp):
+            if c == 0:
+                out.append((
+                    "exactly-once-completion",
+                    f"request rid={rid} never reached a terminal "
+                    f"completion (response still pending at quiescence)"))
+        for rid, l in enumerate(leases):
+            if l[0] != _DONE:
+                out.append((
+                    "event-pairs",
+                    f"EV_LEASE_GRANT for rid={rid} never balanced by "
+                    f"EV_LEASE_DONE at quiescence (lease stuck "
+                    f"{l[0]!r})"))
+        return out
+
+    # -- symmetry reduction -------------------------------------------------
+    def canon(self, s):
+        if not self._perms:
+            return s
+        best = s
+        for wp, rp, wmap, rmap in self._perms:
+            t = self._remap(s, wp, rp, wmap, rmap)
+            if t < best:
+                best = t
+        return best
+
+    def _remap(self, s, wp, rp, wmap, rmap):
+        workers, leases, resp, kills, busy, pending, fanned = s
+        nworkers = tuple(
+            (ws[0], ws[1], ws[2],
+             tuple((rmap[r], i) for r, i in ws[3]),
+             tuple(m if m[0] == "hello" else
+                   (m[0], rmap[m[1]], m[2], m[3]) for m in ws[4]))
+            for ws in (workers[old] for old in wp))
+        nleases = tuple(
+            (l[0], wmap[l[1]] if l[1] >= 0 else -1, l[2], l[3])
+            for l in (leases[old] for old in rp))
+        return (nworkers, nleases, tuple(resp[old] for old in rp), kills,
+                busy, tuple(sorted((rmap[r], wmap[w], i)
+                                   for r, w, i in pending)),
+                tuple(fanned[old] for old in rp))
+
+
+def _set(tup, i, v):
+    return tup[:i] + (v,) + tup[i + 1:]
+
+
+def _bump(resp, rid):
+    return _set(resp, rid, min(resp[rid] + 1, 2))

@@ -1,0 +1,211 @@
+"""Environment model: the shuffle partition-map protocol.
+
+One screen of transition rules binding the ``shuffle_task`` machine
+(pending/produced, declared in serve/shuffle.py) and the ``worker``
+machine to the data-plane channel semantics of serve/supervisor.py +
+serve/shuffle.py: map tasks produce partitions and announce them up the
+supervisor pipe (``MSG_SHUFFLE_PRODUCED``, possibly duplicated), the
+supervisor records them into the partition map and rebroadcasts
+(``MSG_SHUFFLE_MAP``), consumers fetch + ack (``MSG_SHUFFLE_ACK``), and
+``MSG_SHUFFLE_CLEANUP`` closes the shuffle once the parent join
+completes.  SIGKILL + respawn re-points a dead incarnation's tasks back
+to pending (revival / produce-only re-dispatch), while the dead
+incarnation's announcements may still be sitting in the pipe — the late
+deliveries ``_on_shuffle_produced`` must drop by (worker, incarnation)
+comparison.
+
+The ``stale_produce`` mutation re-introduces the PR 12 bug: accepting a
+produce announcement without the incarnation check records a partition
+against an endpoint that died with its process — consumers retry a
+vanished address forever.
+"""
+
+from __future__ import annotations
+
+from itertools import permutations
+from typing import Iterator, Optional, Tuple
+
+__all__ = ["ShuffleModel", "SHUFFLE_MUTATIONS"]
+
+_PENDING, _PRODUCED = "pending", "produced"
+_STARTING, _ALIVE, _DEAD = "starting", "alive", "dead"
+
+SHUFFLE_MUTATIONS = ("stale_produce",)
+
+# state layout:
+#   workers: per slot (inc, live, chan)
+#     chan: (("produced", task, inc), ...)     worker -> supervisor
+#   tasks:   per map task (owner, owner_inc, state, ep_inc, acks)
+#     acks: sorted tuple of consumer ids that fetched + acked
+#   kills:   remaining SIGKILL budget
+#   cleaned: MSG_SHUFFLE_CLEANUP broadcast (parent join completed)
+
+
+class ShuffleModel:
+    name = "shuffle"
+    EDGES_USED = {
+        "shuffle_task": {(_PENDING, _PRODUCED), (_PRODUCED, _PENDING)},
+        "worker": {(_ALIVE, _DEAD)},
+    }
+    TAGS_USED = {
+        "shuffle_produced": ("worker_id", "incarnation", "sid", "map_index"),
+        "shuffle_ack": ("sid", "map_index"),
+        "shuffle_map": ("sid", "tasks"),
+        "shuffle_cleanup": ("sid",),
+    }
+    PAIRS_USED = (("EV_SHUFFLE_PRODUCE", "EV_SHUFFLE_ACK"),)
+
+    def __init__(self, workers: int = 2, tasks: int = 2, kills: int = 2,
+                 mutation: Optional[str] = None, symmetry: bool = True):
+        self.W, self.T = workers, tasks
+        self.C = tasks  # one consumer (reduce partition) per map task
+        self.kills = kills
+        assert mutation in (None,) + SHUFFLE_MUTATIONS
+        self.mutation = mutation
+        # worker-slot symmetry only: tasks start pinned to distinct slots
+        self._perms = (list(permutations(range(workers)))
+                       if symmetry else [])
+
+    def initial(self):
+        workers = ((0, True, ()),) * self.W
+        tasks = tuple((t % self.W, 0, _PENDING, -1, ())
+                      for t in range(self.T))
+        return (workers, tasks, self.kills, False)
+
+    # -- actions ------------------------------------------------------------
+    def actions(self, s) -> Iterator[Tuple[str, tuple]]:
+        workers, tasks, kills, cleaned = s
+        for t, tk in enumerate(tasks):
+            o, oinc, st, ep, acks = tk
+            ws = workers[o]
+            if (st == _PENDING and ws[1] and ws[0] == oinc
+                    and sum(1 for m in ws[2] if m[1] == t) < 2):
+                # < 2: allow one duplicate announcement in flight
+                nw = _set(workers, o, (ws[0], ws[1],
+                                       ws[2] + (("produced", t, oinc),)))
+                yield (f"MSG_SHUFFLE_PRODUCED map={t} from w{o}@i{oinc} "
+                       f"[EV_SHUFFLE_PRODUCE]",
+                       (nw, tasks, kills, cleaned))
+            if st == _PRODUCED and workers[o][1] and workers[o][0] == ep:
+                for c in range(self.C):
+                    if c not in acks:
+                        ntk = (o, oinc, st, ep,
+                               tuple(sorted(acks + (c,))))
+                        yield (f"consumer {c} fetches map={t} from "
+                               f"w{o}@i{ep} + MSG_SHUFFLE_ACK "
+                               f"[EV_SHUFFLE_ACK]",
+                               (workers, _set(tasks, t, ntk), kills,
+                                cleaned))
+        for w, ws in enumerate(workers):
+            if ws[2]:
+                yield self._deliver(s, w)
+        if kills > 0:
+            for w, ws in enumerate(workers):
+                if ws[1]:
+                    nw = _set(workers, w, (ws[0], False, ws[2]))
+                    yield (f"SIGKILL w{w}@i{ws[0]} (store lost; sent "
+                           f"announcements still in the pipe)",
+                           (nw, tasks, kills - 1, cleaned))
+        for w, ws in enumerate(workers):
+            if not ws[1]:
+                repoint = [t for t, tk in enumerate(tasks)
+                           if tk[0] == w and tk[1] == ws[0]]
+                ntasks = tasks
+                for t in repoint:
+                    ntasks = _set(ntasks, t,
+                                  (w, ws[0] + 1, _PENDING, -1,
+                                   tasks[t][4]))
+                nw = _set(workers, w, (ws[0] + 1, True, ws[2]))
+                yield (f"pipe EOF w{w}@i{ws[0]} [EV_WORKER_DEAD] (worker "
+                       f"alive->dead); respawn w{w}@i{ws[0] + 1}, "
+                       f"MSG_SHUFFLE_MAP rebroadcast: map={repoint} "
+                       f"re-pointed (shuffle_task produced->pending, "
+                       f"revival re-dispatch)",
+                       (nw, ntasks, kills, cleaned))
+        if (not cleaned
+                and all(tk[2] == _PRODUCED and len(tk[4]) == self.C
+                        for tk in tasks)):
+            yield ("parent join complete: MSG_SHUFFLE_CLEANUP sid=0 "
+                   "broadcast, stores freed",
+                   (workers, tasks, kills, True))
+
+    def _deliver(self, s, w) -> Tuple[str, tuple]:
+        workers, tasks, kills, cleaned = s
+        ws = workers[w]
+        (_, t, minc), rest = ws[2][0], ws[2][1:]
+        nw = _set(workers, w, (ws[0], ws[1], rest))
+        tk = tasks[t]
+        if tk[0] == w and tk[1] == minc and tk[2] == _PENDING:
+            ntk = (tk[0], tk[1], _PRODUCED, minc, tk[4])
+            return (f"supervisor records map={t} produced by w{w}@i{minc} "
+                    f"(shuffle_task pending->produced), MSG_SHUFFLE_MAP "
+                    f"rebroadcast",
+                    (nw, _set(tasks, t, ntk), kills, cleaned))
+        if tk[2] == _PRODUCED and tk[3] == minc:
+            return (f"duplicate MSG_SHUFFLE_PRODUCED map={t} from "
+                    f"w{w}@i{minc}: ignored (already recorded)",
+                    (nw, tasks, kills, cleaned))
+        if self.mutation == "stale_produce" and tk[2] == _PENDING:
+            # PR 12 bug: no (worker, incarnation) comparison — the late
+            # announcement from the dead incarnation is recorded
+            ntk = (tk[0], tk[1], _PRODUCED, minc, tk[4])
+            return (f"stale MSG_SHUFFLE_PRODUCED map={t} from w{w}@i{minc} "
+                    f"ACCEPTED (mutation: incarnation check skipped)",
+                    (nw, _set(tasks, t, ntk), kills, cleaned))
+        return (f"stale MSG_SHUFFLE_PRODUCED map={t} from w{w}@i{minc}: "
+                f"dropped (incarnation mismatch)",
+                (nw, tasks, kills, cleaned))
+
+    # -- invariants ---------------------------------------------------------
+    def check(self, s):
+        workers, tasks = s[0], s[1]
+        out = []
+        for t, tk in enumerate(tasks):
+            if tk[2] == _PRODUCED and tk[3] != workers[tk[0]][0]:
+                out.append((
+                    "stale-drop",
+                    f"partition map={t} recorded as produced by "
+                    f"w{tk[0]}@i{tk[3]} but that incarnation is dead "
+                    f"(slot respawned at i{workers[tk[0]][0]}) — "
+                    f"consumers would fetch a vanished endpoint forever"))
+        return out
+
+    def at_quiescence(self, s):
+        tasks, cleaned = s[1], s[3]
+        out = []
+        for t, tk in enumerate(tasks):
+            if tk[2] != _PRODUCED or len(tk[4]) < self.C:
+                out.append((
+                    "event-pairs",
+                    f"EV_SHUFFLE_PRODUCE for map={t} never balanced by "
+                    f"EV_SHUFFLE_ACK from every consumer at quiescence "
+                    f"(state {tk[2]!r}, {len(tk[4])}/{self.C} acks)"))
+        if not out and not cleaned:
+            out.append((
+                "event-pairs",
+                "every partition produced and acked but "
+                "MSG_SHUFFLE_CLEANUP never sent: stores leak at "
+                "quiescence"))
+        return out
+
+    # -- symmetry reduction -------------------------------------------------
+    def canon(self, s):
+        if not self._perms:
+            return s
+        best = s
+        for wp in self._perms:
+            t = self._remap(s, wp)
+            if t < best:
+                best = t
+        return best
+
+    def _remap(self, s, wp):
+        workers, tasks, kills, cleaned = s
+        wmap = {old: new for new, old in enumerate(wp)}
+        nworkers = tuple(workers[old] for old in wp)
+        ntasks = tuple((wmap[tk[0]],) + tk[1:] for tk in tasks)
+        return (nworkers, ntasks, kills, cleaned)
+
+
+def _set(tup, i, v):
+    return tup[:i] + (v,) + tup[i + 1:]

@@ -4,8 +4,10 @@ from . import blocking  # noqa: F401
 from . import governed  # noqa: F401
 from . import guarded  # noqa: F401
 from . import locks  # noqa: F401
+from . import protomodel  # noqa: F401
 from . import resources  # noqa: F401
 from . import retry  # noqa: F401
 from . import seam  # noqa: F401
 from . import statemachine  # noqa: F401
+from . import twindrift  # noqa: F401
 from . import wire  # noqa: F401

@@ -1,0 +1,144 @@
+"""Pass 12: protocol-model — exhaustively explore the declared machines.
+
+Passes 8-9 force the supervisor/worker/shuffle protocol to be *declared*:
+``# state-machine:`` transition tables, ``MESSAGE_FIELDS`` channel
+alphabets, ``EVENT_PAIRS`` open/close obligations.  Those passes check
+each write site and emit line locally; none of them can see a bug that
+only appears as an *interleaving* — a SIGKILL landing between "pick a
+worker" and "send the dispatch", a late shuffle announcement from a dead
+incarnation arriving after its slot respawned.  All three of the
+cluster's historical protocol bugs were exactly that shape.
+
+This pass compiles the declared artifacts into two small environment
+models (``analyze.model.lease``, ``analyze.model.shuffle``) and runs a
+bounded BFS over every reachable interleaving (symmetry-reduced over
+worker slots and request ids), checking:
+
+- exactly-once terminal completion per request;
+- no lease LEASED against a dead incarnation while its queue is empty
+  (the orphan shape behind the round-9/10 hangs);
+- stale-incarnation messages are always dropped, never recorded;
+- the degradation ladder has no absorbing degraded state;
+- every EVENT_PAIRS open has its close by quiescence.
+
+A violation is a finding whose message is the shortest message
+interleaving that breaks the invariant, in the flight-event vocabulary.
+Binding drift is also a finding in both directions: a model exercising
+an edge/tag/pair the code no longer declares, or binding a machine that
+was deleted.
+
+Mutation gates keep the checker honest: the three historical bugs are
+retained as model mutations (``fanout_regrant``, ``pick_vs_send``,
+``stale_produce``) and each must still produce a counterexample on every
+run — a checker that stops catching the bugs it was built from has lost
+its teeth, and that is itself a finding.
+
+The pass engages only when the repo declares both a ``lease`` and a
+``worker`` machine — the models are meaningless without the tables they
+bind.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core import Finding
+from ..project import Config, Project
+from ..registry import rule
+from ..model import LeaseModel, ShuffleModel, explore
+from ..model.extract import (RULE, Protocol, check_machine_graphs,
+                             load_protocol, validate_binding)
+from ..model.lease import LEASE_MUTATIONS
+from ..model.shuffle import SHUFFLE_MUTATIONS
+
+# mutation-gate bounds: small enough to stay milliseconds, large enough
+# that every historical-bug mutation reaches its counterexample
+_GATE_LEASE = (2, 2, 1, 1)
+_GATE_SHUFFLE = (2, 2, 2)
+
+
+def _violation_findings(proto: Protocol, model, result,
+                        findings: List[Finding]) -> None:
+    mod, line = proto.anchor()
+    for v in result.violations:
+        if not mod.suppressed(RULE, line):
+            findings.append(Finding(
+                RULE, mod.relpath, line,
+                f"model '{model.name}' invariant '{v.invariant}' "
+                f"violated: {v.message} ; trace: "
+                + " ; ".join(v.trace or ("(initial state)",))))
+    if not result.complete and not mod.suppressed(RULE, line):
+        findings.append(Finding(
+            RULE, mod.relpath, line,
+            f"model '{model.name}' exploration hit the "
+            f"model_max_states ceiling before fixpoint — shrink the "
+            f"bounds or raise the ceiling deliberately"))
+
+
+def _mutation_gates(proto: Protocol, config: Config,
+                    findings: List[Finding]) -> None:
+    mod, line = proto.anchor()
+    gates = ([(LeaseModel, _GATE_LEASE, m) for m in LEASE_MUTATIONS]
+             + [(ShuffleModel, _GATE_SHUFFLE, m)
+                for m in SHUFFLE_MUTATIONS])
+    for cls, bounds, mutation in gates:
+        result = explore(cls(*bounds, mutation=mutation),
+                         max_states=config.model_max_states)
+        if not result.violations and not mod.suppressed(RULE, line):
+            findings.append(Finding(
+                RULE, mod.relpath, line,
+                f"mutation gate lost its teeth: model mutation "
+                f"{mutation!r} (a historical protocol bug) no longer "
+                f"produces a counterexample — the checker would not "
+                f"catch that bug today"))
+
+
+_EXAMPLE = """\
+# serve/supervisor.py declares the tables the models bind:
+#
+#   # state-machine: lease field=state
+#   _LEASE_TRANSITIONS = {"queued": ("leased",), "leased": (), ...}
+#
+# A table missing the edge the runtime needs is a binding finding:
+#
+#   environment model 'lease' exercises transition 'leased' ->
+#   'queued' of machine 'lease' but the declared table has no such edge
+#
+# and a real protocol bug surfaces as the shortest interleaving:
+#
+#   model 'lease' invariant 'no-orphan-lease' violated: request 0
+#   LEASED against w0@i0 but slot 0 is at i1 ... ; trace:
+#   MSG_DISPATCH rid=0 -> w0@i0 [EV_LEASE_GRANT] ; SIGKILL w0@i0 ; ...
+"""
+
+
+@rule(RULE,
+      "bounded exploration of the declared supervisor/worker/shuffle "
+      "machines: exactly-once completion, no orphan leases, stale "
+      "drops, balanced event pairs; mutation-gated against the three "
+      "historical protocol bugs",
+      example=_EXAMPLE)
+def check_protocol_model(project: Project, config: Config
+                         ) -> List[Finding]:
+    proto = load_protocol(project, config)
+    if "lease" not in proto.machines or "worker" not in proto.machines:
+        return []  # nothing declared to bind the models to
+    findings: List[Finding] = []
+    findings.extend(check_machine_graphs(proto))
+    lease = LeaseModel(*config.model_lease_bounds)
+    shuffle = ShuffleModel(*config.model_shuffle_bounds)
+    bound = len(findings)
+    for model in (lease, shuffle):
+        findings.extend(validate_binding(proto, model))
+    if len(findings) > bound:
+        # the models are stale against the declarations: exploring them
+        # would only report violations of a protocol the code no longer
+        # has — fix the binding first
+        return findings
+    for model in (lease, shuffle):
+        _violation_findings(
+            proto, model,
+            explore(model, max_states=config.model_max_states),
+            findings)
+    _mutation_gates(proto, config, findings)
+    return findings

@@ -1,0 +1,234 @@
+"""Pass 13: twin-drift — host/device twin functions must stay in step.
+
+Several kernels exist twice by design: a traced jax/jnp body for the
+accelerator path and a numpy body for host-side work (splitter choice,
+range partitioning, the CPU tokenizer backend).  The pair's contract is
+bit-identical output — ``sort_rank`` / ``sort_rank_np`` decides which
+shard a row lands in AND how the traced reduce side orders it, so a fix
+applied to one body and not the other is a silent cross-backend
+divergence no unit test on either body alone can see.
+
+A ``# twin: <name>`` annotation above each member binds the pair::
+
+    # twin: sort_rank
+    def sort_rank(x, ascending=True): ...
+
+    # twin: sort_rank
+    def sort_rank_np(x, ascending=True): ...
+
+The pass checks, project-wide:
+
+- every twin group has exactly two members (a dangling annotation —
+  one member deleted or renamed — is a finding at the survivor);
+- the two bodies agree *structurally modulo backend idiom*: each body
+  is summarized as {assigned name -> normalized right-hand sides}
+  (plus a ``return`` pseudo-name), where normalization rewrites the
+  jnp/jax spellings into the numpy ones (``jnp.where`` -> ``np.where``,
+  ``.astype(t)`` / ``.view(t)`` / ``.copy()`` / dtype-constructor calls
+  unwrap to their argument, ``jax.lax.bitcast_convert_type(x, t)`` ->
+  ``x``).  A name computed by BOTH bodies from comparable elementwise
+  expressions must agree on at least one normalized form; an empty
+  intersection is drift.  Expressions that keep any non-elementwise
+  call after normalization (scatter idioms, closures, ``nonzero``) are
+  backend-specific by nature and stay out of the comparison.
+
+The comparison is deliberately shallow — it cannot prove equivalence,
+only catch the common drift shape: someone edits a constant, a guard,
+or a ``where`` arm in one body.  That is exactly the class the round-17
+twins have repeatedly needed review vigilance for.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from collections import defaultdict
+from typing import Dict, List, Set, Tuple
+
+from ..core import Finding, carrying_matches
+from ..project import Config, ModuleInfo, Project
+from ..registry import rule
+
+RULE = "twin-drift"
+
+_TWIN_RE = re.compile(r"#\s*twin:\s*([\w.-]+)")
+
+# numpy functions considered comparable across backends: elementwise /
+# shape-preserving ops both spellings share.  Anything else left in a
+# normalized expression makes it backend-specific (opaque) and drops it
+# from the comparison.
+_ELEMENTWISE = frozenset({
+    "where", "isnan", "isfinite", "isinf", "sum", "cumsum", "minimum",
+    "maximum", "clip", "abs", "sign", "sqrt", "exp", "log",
+    "logical_and", "logical_or", "logical_not", "logical_xor",
+    "arange", "zeros_like", "ones_like", "full_like", "issubdtype",
+})
+
+_DTYPES = frozenset({
+    "uint8", "uint16", "uint32", "uint64", "int8", "int16", "int32",
+    "int64", "float16", "float32", "float64", "bool_",
+})
+
+# local dtype-constructor aliases (`_I32 = jnp.int32` style)
+_DTYPE_ALIAS_RE = re.compile(r"^_[IUFB]\d*$|^_BOOL$")
+
+_UNWRAP_METHODS = frozenset({"astype", "view", "copy"})
+_UNWRAP_FUNCS = frozenset({"asarray", "bitcast_convert_type"})
+
+
+def _root(node: ast.AST):
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+class _Norm(ast.NodeTransformer):
+    """Rewrite jnp/jax spellings to the numpy ones and unwrap pure
+    dtype-plumbing so the two backends' idioms compare equal."""
+
+    def visit_Name(self, node: ast.Name):
+        if node.id == "jnp":
+            return ast.copy_location(
+                ast.Name(id="np", ctx=node.ctx), node)
+        return node
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr in _UNWRAP_METHODS
+                and not (isinstance(f.value, ast.Name)
+                         and f.value.id in ("np", "jnp", "jax"))):
+            # x.astype(t) / x.view(t) / x.copy() -> x
+            return self.visit(f.value)
+        if isinstance(f, ast.Attribute) and f.attr in _UNWRAP_FUNCS \
+                and node.args:
+            # np.asarray(x) / jax.lax.bitcast_convert_type(x, t) -> x
+            return self.visit(node.args[0])
+        if isinstance(f, ast.Attribute) and f.attr in _DTYPES \
+                and len(node.args) == 1:
+            # np.int64(c) -> c  (a dtype cast of a scalar)
+            return self.visit(node.args[0])
+        if isinstance(f, ast.Name) and _DTYPE_ALIAS_RE.match(f.id) \
+                and len(node.args) == 1:
+            # _I32(c) -> c  (local dtype alias)
+            return self.visit(node.args[0])
+        return self.generic_visit(node)
+
+
+def _comparable(node: ast.AST) -> bool:
+    """True when every call left after normalization is an elementwise
+    np.<fn> — i.e. the expression means the same thing on both
+    backends."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            f = sub.func
+            if not (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "np"
+                    and f.attr in _ELEMENTWISE):
+                return False
+    return True
+
+
+def _summarize(fn: ast.AST) -> Dict[str, Set[str]]:
+    """{assigned name (or 'return') -> normalized comparable RHS forms}."""
+    out: Dict[str, Set[str]] = defaultdict(set)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            key, value = node.targets[0].id, node.value
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name) \
+                and node.value is not None:
+            key, value = node.target.id, node.value
+        elif isinstance(node, ast.Return) and node.value is not None:
+            key, value = "return", node.value
+        else:
+            continue
+        norm = _Norm().visit(ast.parse(ast.unparse(value), mode="eval")
+                             .body)
+        if isinstance(norm, (ast.Name, ast.Constant)):
+            continue  # renames and literals carry no structure
+        if not _comparable(norm):
+            continue  # backend-specific idiom: out of scope
+        out[key].add(ast.unparse(norm))
+    return out
+
+
+def _twin_defs(mod: ModuleInfo) -> Tuple[List[Tuple[str, ast.AST, int]],
+                                         List[int]]:
+    """-> ([(twin name, function def, line)], [dangling comment lines])."""
+    matches = carrying_matches(mod.lines, _TWIN_RE)
+    anchors: Dict[int, ast.AST] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            anchors[node.lineno] = node
+            for dec in node.decorator_list:
+                anchors[dec.lineno] = node
+    defs: List[Tuple[str, ast.AST, int]] = []
+    dangling: List[int] = []
+    for line, m in sorted(matches.items()):
+        fn = anchors.get(line)
+        if fn is None:
+            dangling.append(line)
+        else:
+            defs.append((m.group(1), fn, line))
+    return defs, dangling
+
+
+_EXAMPLE = """\
+# twin: biased_rank
+def biased_rank(x):
+    u = jnp.where(x < 0, ~x.astype(jnp.uint64), x.astype(jnp.uint64))
+    return u
+
+# twin: biased_rank
+def biased_rank_np(x):
+    u = np.where(x <= 0, ~x.view(np.uint64), x.view(np.uint64))
+    return u       # `<` became `<=` in one body only: drift
+"""
+
+
+@rule(RULE,
+      "host/device twin functions (`# twin: <name>` pairs) must keep "
+      "structurally equivalent bodies modulo jnp/np idiom; dangling "
+      "annotations are findings",
+      example=_EXAMPLE)
+def check_twin_drift(project: Project, config: Config) -> List[Finding]:
+    findings: List[Finding] = []
+    groups: Dict[str, List[Tuple[ModuleInfo, ast.AST, int]]] = \
+        defaultdict(list)
+    for mod in project.modules.values():
+        defs, dangling = _twin_defs(mod)
+        for line in dangling:
+            if not mod.suppressed(RULE, line):
+                findings.append(Finding(
+                    RULE, mod.relpath, line,
+                    "dangling `# twin:` annotation: no function "
+                    "definition binds it (member deleted or renamed?)"))
+        for name, fn, line in defs:
+            groups[name].append((mod, fn, line))
+    for name in sorted(groups):
+        members = groups[name]
+        if len(members) != 2:
+            for mod, fn, line in members:
+                if not mod.suppressed(RULE, line):
+                    findings.append(Finding(
+                        RULE, mod.relpath, line,
+                        f"twin group {name!r} has {len(members)} "
+                        f"member(s); exactly 2 required (the jnp body "
+                        f"and its np twin)"))
+            continue
+        (mod_a, fn_a, _), (mod_b, fn_b, line_b) = members
+        summary_a, summary_b = _summarize(fn_a), _summarize(fn_b)
+        for key in sorted(set(summary_a) & set(summary_b)):
+            forms_a, forms_b = summary_a[key], summary_b[key]
+            if forms_a and forms_b and not (forms_a & forms_b):
+                if not mod_b.suppressed(RULE, line_b):
+                    findings.append(Finding(
+                        RULE, mod_b.relpath, line_b,
+                        f"twin {name!r} drift on {key!r}: "
+                        f"{fn_a.name} computes "
+                        f"{' | '.join(sorted(forms_a))} but "
+                        f"{fn_b.name} computes "
+                        f"{' | '.join(sorted(forms_b))}"))
+    return findings

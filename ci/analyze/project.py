@@ -94,6 +94,13 @@ class Config:
     blocking_scope: Tuple[str, ...] = ("mem.", "mem", "serve.", "serve",
                                        "plans.", "plans",
                                        "columnar.", "columnar")
+    # pass 12 (protocol-model): exploration bounds for the environment
+    # models — lease (workers, requests, kills, busy-budget) and shuffle
+    # (workers, map tasks, kills) — and the hard state-count ceiling that
+    # keeps model growth from silently blowing the gate's time budget
+    model_lease_bounds: Tuple[int, int, int, int] = (2, 3, 2, 1)
+    model_shuffle_bounds: Tuple[int, int, int] = (2, 2, 2)
+    model_max_states: int = 400_000
     rules: Optional[Set[str]] = None  # None -> all registered
 
 

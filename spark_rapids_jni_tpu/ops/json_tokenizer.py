@@ -459,6 +459,7 @@ def _scan_bytes(bytes_mat: jnp.ndarray, lens: jnp.ndarray):
             st_after.astype(_I32))
 
 
+# twin: compact_tokens
 @functools.partial(jax.jit, static_argnums=(4,))
 def _compact_tokens(token_start, kind_b, end_b, counts, T: int):
     """Phase 5: scatter token-start bytes into dense [n, T] token arrays."""
@@ -483,6 +484,7 @@ def _compact_tokens(token_start, kind_b, end_b, counts, T: int):
     return tok_kind, tok_start, tok_end
 
 
+# twin: compact_tokens
 def _compact_tokens_np(token_start, kind_b, end_b, T: int):
     """Numpy twin of :func:`_compact_tokens`: scatters only the ~nnz token
     starts instead of every byte (CPU backend; outputs are identical)."""
@@ -508,6 +510,7 @@ def _compact_and_grammar(token_start, kind_b, end_b, counts, T: int):
     return _grammar_scan(tok_kind, tok_start, tok_end, counts)
 
 
+# twin: grammar_scan
 def _grammar_scan(kind, start, end, counts):
     """Lockstep grammar validation + match computation + separator drop."""
     n, T = kind.shape
@@ -684,6 +687,7 @@ def _grammar_scan(kind, start, end, counts):
     return kind2, start2, end2, match2, n_tokens, ok, trailing
 
 
+# twin: grammar_scan
 def _grammar_scan_np(kind, start, end, counts):
     """Numpy twin of :func:`_grammar_scan` for the CPU backend.
 

@@ -45,6 +45,7 @@ _CANON_NAN = np.int64(0x7FF8000000000000)
 # ------------------------------------------------------------- sort ranks
 
 
+# twin: sort_rank
 def sort_rank(x, ascending: bool = True):
     """uint64 ranks whose unsigned ascending order is ``x``'s sort order.
 
@@ -72,6 +73,7 @@ def sort_rank(x, ascending: bool = True):
     return u if ascending else ~u
 
 
+# twin: sort_rank
 def sort_rank_np(x: np.ndarray, ascending: bool = True) -> np.ndarray:
     """Host twin of :func:`sort_rank`, bit-identical — splitter choice
     and range partitioning happen on numpy shards, and the partition a

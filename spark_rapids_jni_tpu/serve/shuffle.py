@@ -77,6 +77,23 @@ __all__ = [
     "run_range_plan_local",
 ]
 
+# The per-map-task lifecycle tracked in the supervisor's partition map
+# (_ShuffleState.tasks[m]["state"]) and mirrored into every
+# participant's map view over MSG_SHUFFLE_MAP.  The state travels in
+# dict entries (it is wire-visible), so the state-machine pass has no
+# attribute sites to check — the table is declared for the
+# protocol-model pass (analyze pass 12), whose shuffle environment
+# model explores produce / duplicate / SIGKILL-revival interleavings
+# against exactly these edges.
+# state-machine: shuffle_task field=state
+_TASK_TRANSITIONS = {
+    "pending": ("produced",),   # MSG_SHUFFLE_PRODUCED recorded (owner
+    #                             incarnation matched)
+    "produced": ("pending",),   # owner died with its store: revival
+    #                             re-points the task at the respawn
+}
+
+
 class ShuffleFetchStalled(RuntimeError):
     """A consumer exhausted ``serve_shuffle_fetch_timeout_s`` waiting for
     one partition.  The supervisor treats this error type as

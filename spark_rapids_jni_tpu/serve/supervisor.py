@@ -103,8 +103,11 @@ _WORKER_TRANSITIONS = {
     _ALIVE: (_DEAD,),            # crash-only: never coaxed back
     _DEAD: (),                   # terminal per incarnation
 }
-# state-machine: ladder field=_level  (the degradation ladder moves one
-# level at a time, both directions — adjacency IS the declared edge set)
+# The degradation ladder moves one level at a time, both directions —
+# adjacency IS the declared edge set.  (The marker must sit directly
+# above the table for the pass-9 loader to bind it — the protocol-model
+# pass caught this declaration dangling two lines up.)
+# state-machine: ladder field=_level
 _LADDER_TRANSITIONS = {
     LEVEL_HEALTHY: (LEVEL_SHED_LOW,),
     LEVEL_SHED_LOW: (LEVEL_HEALTHY, LEVEL_CACHED_ONLY),

@@ -58,6 +58,46 @@ def test_compare_classifies_stages():
     assert "REGRESSED (1): slow" in text and "-50.0%" in text
 
 
+def test_compare_noise_floor_classifies_untouched_drops():
+    prev = {"touched": ("Mrows_per_s", 10.0),
+            "weather": ("Mrows_per_s", 10.0),
+            "cliff": ("Mrows_per_s", 10.0)}
+    cur = {"touched": ("Mrows_per_s", 5.0),    # -50%, in the diff
+           "weather": ("Mrows_per_s", 5.0),    # -50%, untouched: noise
+           "cliff": ("Mrows_per_s", 1.0)}      # -90%, past the floor
+    rep = bench_report.compare(prev, cur, threshold_pct=20.0,
+                               touched=frozenset({"touched"}),
+                               noise_floor_pct=80.0)
+    by = {s["stage"]: s for s in rep["stages"]}
+    assert by["touched"]["status"] == "REGRESSION"
+    assert by["weather"]["status"] == "noise"
+    assert by["cliff"]["status"] == "REGRESSION"
+    assert rep["regressions"] == ["cliff", "touched"]
+    assert rep["noise"] == ["weather"]
+    text = bench_report.format_report(rep, "BENCH_r01.json",
+                                      "BENCH_r02.json")
+    assert "noise (1" in text and "weather" in text
+    # noise_floor_pct=None keeps the pre-noise-floor behavior
+    rep = bench_report.compare(prev, cur, threshold_pct=20.0)
+    assert len(rep["regressions"]) == 3
+
+
+def test_main_noise_floor_and_touched_flags(tmp_path, capsys):
+    _snap(tmp_path / "BENCH_r01.json", {"a": 10.0, "b": 10.0})
+    _snap(tmp_path / "BENCH_r02.json", {"a": 6.0, "b": 6.0})
+    # -40% on both; default floor (80) classifies both as noise
+    assert bench_report.main(["--dir", str(tmp_path), "--gate"]) == 0
+    assert "noise (2" in capsys.readouterr().out
+    # naming a stage as touched restores the regression gate for it
+    assert bench_report.main(["--dir", str(tmp_path), "--gate",
+                              "--touched", "a"]) == 1
+    assert "REGRESSED (1): a" in capsys.readouterr().out
+    # --noise-floor 0 disables the floor entirely
+    assert bench_report.main(["--dir", str(tmp_path), "--gate",
+                              "--noise-floor", "0"]) == 1
+    assert "REGRESSED (2)" in capsys.readouterr().out
+
+
 def test_main_advisory_vs_gating_exit_codes(tmp_path, capsys):
     _snap(tmp_path / "BENCH_r01.json", {"q": 10.0})
     _snap(tmp_path / "BENCH_r02.json", {"q": 1.0})

@@ -95,10 +95,23 @@ def find_snapshots(bench_dir: str) -> List[str]:
 
 def compare(prev: Dict[str, Tuple[str, float]],
             cur: Dict[str, Tuple[str, float]],
-            threshold_pct: float) -> dict:
-    """Stage-by-stage delta; a drop beyond ``threshold_pct`` regresses."""
+            threshold_pct: float,
+            touched: frozenset = frozenset(),
+            noise_floor_pct: Optional[float] = None) -> dict:
+    """Stage-by-stage delta; a drop beyond ``threshold_pct`` regresses.
+
+    Noise floor: bench snapshots come from shared CI boxes where the
+    numbers are weather — a 50% swing on a stage the diffed range never
+    touched is machine load, not a regression.  A drop on a stage NOT
+    in ``touched`` whose magnitude stays below ``noise_floor_pct``
+    classifies as ``noise`` instead of ``REGRESSION``; touched stages
+    (and swings that clear the floor anywhere) still regress.  With
+    ``noise_floor_pct=None`` every drop beyond the threshold regresses,
+    the pre-noise-floor behavior.
+    """
     stages = []
     regressions = []
+    noise = []
     for name in sorted(set(prev) | set(cur)):
         p, c = prev.get(name), cur.get(name)
         if p is None or c is None:
@@ -114,15 +127,22 @@ def compare(prev: Dict[str, Tuple[str, float]],
         delta_pct = 100.0 * (c[1] - p[1]) / p[1]
         status = "ok"
         if delta_pct < -threshold_pct:
-            status = "REGRESSION"
-            regressions.append(name)
+            if (noise_floor_pct is not None and name not in touched
+                    and abs(delta_pct) < noise_floor_pct):
+                status = "noise"
+                noise.append(name)
+            else:
+                status = "REGRESSION"
+                regressions.append(name)
         elif delta_pct > threshold_pct:
             status = "improved"
         stages.append({"stage": name, "status": status,
                        "prev": p[1], "cur": c[1], "unit": p[0],
                        "delta_pct": round(delta_pct, 1)})
     return {"stages": stages, "regressions": regressions,
-            "threshold_pct": threshold_pct}
+            "noise": noise, "threshold_pct": threshold_pct,
+            "noise_floor_pct": noise_floor_pct,
+            "touched": sorted(touched)}
 
 
 def format_report(report: dict, prev_path: str, cur_path: str) -> str:
@@ -137,6 +157,10 @@ def format_report(report: dict, prev_path: str, cur_path: str) -> str:
         delta = (f"{s['delta_pct']:+.1f}%" if "delta_pct" in s else "")
         out.append(f"  {s['stage']:<28}{prev:>12}{cur:>12}{delta:>9}  "
                    f"{s['unit']:<12}{s['status']}")
+    if report.get("noise"):
+        out.append(f"  noise ({len(report['noise'])}, untouched stages "
+                   f"below the {report['noise_floor_pct']:g}% floor): "
+                   f"{', '.join(report['noise'])}")
     if report["regressions"]:
         out.append(f"  REGRESSED ({len(report['regressions'])}): "
                    f"{', '.join(report['regressions'])}")
@@ -154,6 +178,15 @@ def main(argv=None) -> int:
         help="directory holding BENCH_r*.json (default: repo root)")
     ap.add_argument("--threshold", type=float, default=20.0,
                     help="regression threshold in percent (default 20)")
+    ap.add_argument("--noise-floor", type=float, default=80.0,
+                    help="drops below this percent on stages not named "
+                         "by --touched classify as noise, not "
+                         "REGRESSION (shared-CI weather; default 80, "
+                         "0 disables)")
+    ap.add_argument("--touched", default="",
+                    help="comma-separated stage names the diffed range "
+                         "actually touched: these stages always regress "
+                         "past the threshold, never classify as noise")
     ap.add_argument("--json", action="store_true",
                     help="emit the comparison as JSON")
     ap.add_argument("--gate", action="store_true",
@@ -166,8 +199,12 @@ def main(argv=None) -> int:
               f"found {len(snaps)} — nothing to compare")
         return 0
     prev_path, cur_path = snaps[-2], snaps[-1]
+    touched = frozenset(s.strip() for s in args.touched.split(",")
+                        if s.strip())
     report = compare(load_stages(prev_path), load_stages(cur_path),
-                     args.threshold)
+                     args.threshold, touched=touched,
+                     noise_floor_pct=(args.noise_floor
+                                      if args.noise_floor > 0 else None))
     report["prev"] = os.path.basename(prev_path)
     report["cur"] = os.path.basename(cur_path)
     if args.json:
