@@ -121,6 +121,23 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu SRT_REEXECED=1 \
     --workers 2 --queue-size 32 --ragged-rounds 2 \
     --seed "${RAGGED_SEED:-5}"
 
+# optimizer + adaptive-execution tier (round 19): three phases.
+# (1) paired optimizer-off/on rounds over identical seeded query mixes
+# (4 spellings of each logical query) — gates on bit-identical results
+# vs the unrewritten oracle, zero lost, optimizer winning median p99,
+# and canonicalization proving cross-query result-cache sharing
+# (optimizer-on misses == one warm compile per logical query).
+# (2) skewed Exchange round with adaptive reduce — measured partition
+# bytes must change the reduce-side partition count/strategy at runtime
+# (EV_ADAPT_EXCHANGE from merged flight dumps), oracle-identical.
+# (3) hedge-under-chaos: seeded rare 2s stragglers + SIGKILL faults —
+# speculative hedges must recover >= 1 straggler (hedge win) while
+# kills re-dispatch, with exactly-once lease completion and zero lost
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu SRT_REEXECED=1 \
+    python tools/serve_bench.py --optimizer-storm --clients 4 \
+    --requests 24 --workers 2 --queue-size 16 --cluster 3 \
+    --seed "${OPT_SEED:-7}"
+
 # perf-trajectory report (round 14, ADVISORY — bench numbers on shared
 # CI boxes are weather, so regressions print loudly but never gate):
 # diff the two newest BENCH_r*.json snapshots stage by stage

@@ -461,3 +461,71 @@ register("serve_controller_freeze", False,
          "serve_adaptive=False while the controller thread keeps "
          "heartbeating (so un-freezing resumes without a restart).",
          env="SRT_SERVE_CONTROLLER_FREEZE")
+register("plan_optimizer", False,
+         "Stats-driven plan rewriter (plans/optimizer.py, round 19): "
+         "run_governed_plan rewrites every plan to a bounded fixed point "
+         "— filter pushdown below GatherJoin/Exchange, filter/project "
+         "fusion, join reordering seeded from the table-stats registry "
+         "(models/tables.py record_stats/observe_tables) — before the "
+         "result-cache key is computed, so equivalent queries "
+         "canonicalize to ONE cache entry.  Every rewrite is an exact "
+         "algebraic identity of the compiler's masked-row semantics "
+         "(bit-identical outputs; fuzzed in tests/test_optimizer.py).  "
+         "Each applied rule emits EV_PLAN_REWRITE.  Off (default) = "
+         "plans compile exactly as written, round-18 behavior.",
+         env="SRT_PLAN_OPTIMIZER")
+register("serve_adaptive_exchange", False,
+         "Adaptive Exchange execution (serve/shuffle.py, round 19): map "
+         "tasks over-partition by serve_adaptive_overpartition, and every "
+         "consumer waits for the broadcast shuffle map to show ALL map "
+         "sides produced, then greedily groups contiguous partitions by "
+         "their MEASURED bytes (targeting serve_adaptive_part_bytes per "
+         "reduce; one group = broadcast-style single reduce, fewer groups "
+         "than partitions = coalesce) — partition count and join strategy "
+         "become runtime decisions driven by real sizes instead of "
+         "plan-time guesses.  Exact for the integer additive sinks these "
+         "plans aggregate (regrouping reorders rows, never sums).  Each "
+         "reduce emits EV_ADAPT_EXCHANGE with its strategy.  Off "
+         "(default) = one reduce per plan-time partition, round-18 "
+         "behavior.", env="SRT_SERVE_ADAPTIVE_EXCHANGE")
+register("serve_adaptive_overpartition", 4,
+         "Over-partitioning factor for adaptive exchanges: map sides "
+         "emit fanout x this many hash partitions, giving the runtime "
+         "grouping step fine-grained units to pack into right-sized "
+         "reduces.  Ignored unless serve_adaptive_exchange is set.",
+         env="SRT_SERVE_ADAPTIVE_OVERPARTITION")
+register("serve_adaptive_part_bytes", 1 << 20,
+         "Target measured bytes per adaptive reduce group: the greedy "
+         "packer closes a group once it holds at least this many bytes "
+         "(total bytes below it collapse to a single broadcast-style "
+         "reduce).  Ignored unless serve_adaptive_exchange is set.",
+         env="SRT_SERVE_ADAPTIVE_PART_BYTES")
+register("serve_hedge", False,
+         "Speculative hedging (serve/supervisor.py, round 19): the "
+         "health sweep launches ONE duplicate dispatch of a lease that "
+         "has sat past serve_hedge_factor x its handler's windowed p99 "
+         "on a second ALIVE worker; the first result completes the "
+         "lease and the loser is dropped by the existing "
+         "incarnation-checked duplicate-drop path (exactly-once stands).  "
+         "Bounded: hedges_launched never exceeds serve_hedge_budget_frac "
+         "of leases granted, shuffle children are never hedged, and one "
+         "hedge max per lease.  Emits EV_HEDGE_LAUNCH / EV_HEDGE_WIN / "
+         "EV_HEDGE_LOSE.  Off (default) = stragglers wait for the hang "
+         "sweep, round-18 behavior.", env="SRT_SERVE_HEDGE")
+register("serve_hedge_factor", 3.0,
+         "A lease hedges once its age exceeds this many times its "
+         "handler's windowed p99 latency (serve/metrics.py "
+         "handler_latency_counts diffed over serve_hedge_window_s).",
+         env="SRT_SERVE_HEDGE_FACTOR")
+register("serve_hedge_budget_frac", 0.05,
+         "Hedge budget: hedges_launched stays at or below this fraction "
+         "of leases granted (checked at launch time) — hedging is a "
+         "tail-latency tool, never a 2x-dispatch storm.",
+         env="SRT_SERVE_HEDGE_BUDGET_FRAC")
+register("serve_hedge_min_samples", 8,
+         "Windowed completions a handler needs before its p99 is "
+         "trusted to trigger hedges — below it, no hedge (a cold "
+         "handler's p99 is noise).", env="SRT_SERVE_HEDGE_MIN_SAMPLES")
+register("serve_hedge_window_s", 5.0,
+         "Width of the sliding latency window the hedge trigger's p99 "
+         "is computed over.", env="SRT_SERVE_HEDGE_WINDOW_S")

@@ -22,21 +22,37 @@ declaration: a process-local monotonic version per table name.
 
 Unregistered names read as version 0 — a table nobody ever bumps is
 simply a table whose cache entries live by content digest + LRU alone.
+
+Round 19 extends the registry with per-table STATISTICS recorded at
+upload (:func:`record_stats` / :func:`observe_tables`): row counts and a
+content fingerprint, versioned with the table.  These are the
+cost-model seeds the plan optimizer (plans/optimizer.py) reorders joins
+by — a dim table's row count decides which gather applies first, and
+the fingerprint lets a reader tell whether stats describe the content
+currently registered or a previous version.  Stats for a version other
+than the current one are dropped on read (a bump makes stale stats
+unreachable exactly like it makes cache entries unreachable).
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, List, Tuple
+import zlib
+from typing import Callable, Dict, List, Optional, Tuple
 
 from spark_rapids_jni_tpu.obs import flight as _flight
 
 __all__ = ["version_of", "versions_of", "bump", "advance_to",
            "snapshot", "add_listener", "remove_listener",
+           "record_stats", "observe_tables", "stats_of",
+           "stats_snapshot",
            "reset_for_tests"]
 
 _lock = threading.Lock()
 _versions: Dict[str, int] = {}  # guarded-by: _lock
+# name -> {"rows": int, "fingerprint": int, "version": int} recorded at
+# upload; read by the optimizer's join-reorder rule  # guarded-by: _lock
+_stats: Dict[str, dict] = {}
 # bump listeners: fn(name, new_version), called OUTSIDE the registry
 # lock (a listener that consults versions must not deadlock) but on the
 # bumping thread, so bump() returning means invalidation already ran
@@ -96,6 +112,59 @@ def snapshot() -> Dict[str, int]:
         return dict(_versions)
 
 
+# --------------------------------------------------------------------------
+# per-table statistics (round 19): the optimizer's cost-model seeds
+# --------------------------------------------------------------------------
+
+
+def record_stats(name: str, *, rows: int, fingerprint: int = 0) -> None:
+    """Record ``name``'s row count + content fingerprint AT UPLOAD,
+    stamped with the current version — the registry's answer to "how big
+    is this table right now".  Idempotent for identical content."""
+    with _lock:
+        _stats[name] = {"rows": int(rows),
+                        "fingerprint": int(fingerprint),
+                        "version": _versions.get(name, 0)}
+
+
+def observe_tables(tables: Dict[str, Dict[str, "object"]]) -> None:
+    """Record stats for every table in a ``{name: {field: array}}``
+    upload payload: rows from the first column, fingerprint a CRC over
+    each column's (name, dtype, length) header — cheap enough to run per
+    upload, stable across identical uploads, and sensitive to schema or
+    cardinality drift (content CRCs stay the result cache's job)."""
+    for name, fields in tables.items():
+        if not fields:
+            continue
+        rows = len(next(iter(fields.values())))
+        fp = 0
+        for fname in sorted(fields):
+            v = fields[fname]
+            fp = zlib.crc32(
+                f"{fname}:{getattr(v, 'dtype', '')}:{len(v)}".encode(),
+                fp)
+        record_stats(name, rows=rows, fingerprint=fp)
+
+
+def stats_of(name: str) -> Optional[dict]:
+    """The stats recorded for ``name``'s CURRENT version, or None when
+    never recorded / recorded for an older version (a bump makes stale
+    stats unreachable, like cache entries)."""
+    with _lock:
+        st = _stats.get(name)
+        if st is None or st["version"] != _versions.get(name, 0):
+            return None
+        return dict(st)
+
+
+def stats_snapshot() -> Dict[str, dict]:
+    """Current-version stats per table (stale entries filtered) — the
+    telemetry view and the optimizer's bulk read."""
+    with _lock:
+        return {n: dict(st) for n, st in _stats.items()
+                if st["version"] == _versions.get(n, 0)}
+
+
 def add_listener(fn: Callable[[str, int], None]) -> None:
     with _lock:
         if fn not in _listeners:
@@ -112,6 +181,8 @@ def reset_for_tests() -> None:
     with _lock:
         _versions.clear()
         _listeners.clear()
+        _stats.clear()
 
 
 _flight.register_telemetry_source("table_versions", snapshot)
+_flight.register_telemetry_source("table_stats", stats_snapshot)

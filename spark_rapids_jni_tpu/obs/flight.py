@@ -64,6 +64,8 @@ __all__ = [
     "EV_TELEMETRY_EXPORT", "EV_TELEMETRY_DROP",
     "EV_RCACHE_HIT", "EV_RCACHE_STORE", "EV_RCACHE_DEMOTE",
     "EV_RCACHE_EVICT", "EV_RCACHE_INVALIDATE",
+    "EV_PLAN_REWRITE", "EV_ADAPT_EXCHANGE",
+    "EV_HEDGE_LAUNCH", "EV_HEDGE_WIN", "EV_HEDGE_LOSE",
     "EVENT_KINDS", "EVENT_PAIRS", "KIND_IDS", "DUMP_SCHEMA",
     "FlightRecorder", "record", "anomaly", "snapshot", "snapshot_since",
     "task_stats",
@@ -199,6 +201,33 @@ EV_RCACHE_INVALIDATE = "rcache_invalidate"  # a table-version bump made
 #                                        value=new version; emitted by
 #                                        models/tables.py per bump and
 #                                        by the cache per reclaimed key)
+# the stats-driven optimizer + adaptive execution (round 19,
+# plans/optimizer.py + serve/shuffle.py + serve/supervisor.py): every
+# plan rewrite, every runtime reduce-side Exchange decision, and every
+# speculative hedge narrates into the ring, so "why did this plan's
+# shape change" and "which dispatch was a hedge copy" reconstruct from
+# the same artifact as everything else (flightdump --control renders
+# the decision ledger)
+EV_PLAN_REWRITE = "plan_rewrite"        # optimizer applied one rewrite
+#                                        (detail=plan:<name>:rule:<rule>
+#                                        :node:<type>, value=pass no.) or
+#                                        summary (rule:done, value=total)
+EV_ADAPT_EXCHANGE = "adapt_exchange"    # reduce side picked its shape at
+#                                        runtime (detail=rid:<r>:sid:<s>:
+#                                        strategy:<broadcast|coalesce|
+#                                        shuffle>:parts:<from>-><to>,
+#                                        value=total exchange bytes)
+EV_HEDGE_LAUNCH = "hedge_launch"        # lease sat past its handler's
+#                                        windowed p99: hedge copy sent
+#                                        (detail=rid:<r>:worker:<w>:inc:
+#                                        <i>:handler:<h>, value=age_ns)
+EV_HEDGE_WIN = "hedge_win"              # the hedge copy's result
+#                                        completed the lease first
+#                                        (detail=rid:<r>:worker:<w>)
+EV_HEDGE_LOSE = "hedge_lose"            # the primary finished first (or
+#                                        the hedge aborted): hedge copy's
+#                                        result will be duplicate-dropped
+#                                        (detail=rid:<r>:reason:<why>)
 
 # Paired kinds: a layer that emits the left side of a pair must also emit
 # the right side (module-granular balance, enforced by the analyze gate's
@@ -239,6 +268,9 @@ EVENT_KINDS = (
     # round 15: appended for the same reason
     EV_RCACHE_HIT, EV_RCACHE_STORE, EV_RCACHE_DEMOTE,
     EV_RCACHE_EVICT, EV_RCACHE_INVALIDATE,
+    # round 19: appended for the same reason
+    EV_PLAN_REWRITE, EV_ADAPT_EXCHANGE,
+    EV_HEDGE_LAUNCH, EV_HEDGE_WIN, EV_HEDGE_LOSE,
 )
 KIND_IDS = {k: i for i, k in enumerate(EVENT_KINDS)}
 

@@ -392,6 +392,17 @@ def run_governed_plan(
         dp = mesh.shape[DATA_AXIS]
     if budget is None:
         budget = default_device_budget()
+    # the stats-driven rewriter runs FIRST (round 19): stats observed from
+    # this upload seed the join-reorder rule, and the CANONICALIZED plan —
+    # not the as-written one — keys the result cache below, so two queries
+    # that rewrite to the same tree share one cached entry.  Memoized per
+    # (plan, stats); off by default, so static configs never re-key.
+    if config.get("plan_optimizer"):
+        from spark_rapids_jni_tpu.models import tables as _tabreg
+        from spark_rapids_jni_tpu.plans.optimizer import optimize_plan
+
+        _tabreg.observe_tables(tables)
+        plan = optimize_plan(plan)
     # the result cache consults BEFORE admission (round 15): a hit costs
     # a fingerprint pass over the raw host tables — never a reservation,
     # a retry bracket, or a launch.  Fingerprinted here, before the dim

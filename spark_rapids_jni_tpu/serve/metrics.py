@@ -156,6 +156,11 @@ SUPERVISOR_COUNTERS = (
     "shuffle_acks",           # consumer partition acks recorded
     "shuffle_revivals",       # produce-only re-runs of completed tasks
     #                           whose executor died with the data
+    # speculative hedging (round 19): duplicate dispatches of leases
+    # sitting past their handler's windowed p99
+    "hedges_launched",    # hedge copies dispatched (<= budget frac)
+    "hedge_wins",         # hedge result completed the lease first
+    "hedge_losses",       # primary won / hedge abandoned (busy, dead)
 )
 
 

@@ -69,7 +69,7 @@ from spark_rapids_jni_tpu.serve import rpc
 __all__ = [
     "ShuffleFetchStalled", "ShuffleService", "service",
     "reset_service_for_tests",
-    "make_shuffle_handler", "run_shuffle_piece",
+    "make_shuffle_handler", "run_shuffle_piece", "plan_adaptive_groups",
     "run_exchange_plan_local", "combine_exchange_outputs",
     "split_tables_n", "scan_table_names",
     "range_split_n", "make_range_split", "run_range_shuffle_piece",
@@ -405,6 +405,33 @@ class ShuffleService:
             with self._cond:
                 self._cond.wait(min(0.05, deadline - now))
 
+    def wait_all_produced(self, sid: int, ntasks: int, *,
+                          deadline: float) -> Dict[int, Dict[int, int]]:
+        """Block until the broadcast map shows ALL ``ntasks`` map tasks
+        produced; returns the full measured size map ``{m: {p: bytes}}``
+        — what the adaptive reduce's partition-grouping step decides
+        from.  Deterministic across consumers: sizes are a pure function
+        of each shard's rows, so every participant (eventually) sees the
+        same map even across producer deaths and re-produces."""
+        while True:
+            with self._cond:
+                smap = self._maps.get(sid)
+                if smap is not None:
+                    infos = [smap["tasks"].get(t) for t in range(ntasks)]
+                    if all(i is not None and i.get("state") == "produced"
+                           for i in infos):
+                        return {t: {int(p): int(b)
+                                    for p, b in
+                                    (infos[t].get("sizes") or {}).items()}
+                                for t in range(ntasks)}
+                now = time.monotonic()
+                if now >= deadline:
+                    raise ShuffleFetchStalled(
+                        f"shuffle sid:{sid}: map tasks still unproduced "
+                        f"past the fetch deadline (adaptive exchange "
+                        f"needs every map side's sizes)")
+                self._cond.wait(min(0.05, deadline - now))
+
     # -- fetching ----------------------------------------------------------
     def fetch(self, sid: int, m: int, p: int, *,
               deadline: Optional[float] = None,
@@ -668,12 +695,53 @@ def run_shuffle_piece(plan, payload: dict, ctx) -> Dict[str, np.ndarray]:
     tables = payload["data"]
     svc = service()
     exchange, reduce_plan = split_exchange_plan(plan)
-    parts = emit_exchange_partitions(exchange, tables, nparts)
+    # adaptive exchange (round 19): over-partition the map side so the
+    # reduce side can regroup by MEASURED bytes.  The factor is config,
+    # broadcast identically to every worker — all participants (revivals
+    # included) agree on the emitted partition count with no wire change.
+    adaptive = bool(config.get("serve_adaptive_exchange"))
+    over = (max(1, int(config.get("serve_adaptive_overpartition")))
+            if adaptive else 1)
+    nemit = nparts * over
+    parts = emit_exchange_partitions(exchange, tables, nemit)
     svc.produce(sid, m, parts, rid=rid)
     if payload.get("reproduce"):
         return {"reproduced": np.int64(m)}
 
-    received = _fetch_all_partitions(svc, sid, m, nparts, rid, ctx)
+    if adaptive:
+        # every consumer waits for ALL map sides' measured sizes (the
+        # supervisor broadcasts them with the partition map), then packs
+        # partitions into at most nparts groups — the same deterministic
+        # grouping on every consumer, so each emitted partition is
+        # reduced exactly once.  Partition count and join strategy are
+        # now RUNTIME decisions: tiny totals collapse to one
+        # broadcast-style reduce, mixed sizes coalesce.  Exact for these
+        # plans' integer additive sinks (regrouping reorders rows
+        # between reduces; the sums the supervisor combines are
+        # placement-invariant).
+        deadline = time.monotonic() + float(
+            config.get("serve_shuffle_fetch_timeout_s"))
+        sizes = svc.wait_all_produced(sid, nparts, deadline=deadline)
+        totals = [sum(sizes[k].get(p, 0) for k in range(nparts))
+                  for p in range(nemit)]
+        groups = plan_adaptive_groups(
+            totals, nparts, int(config.get("serve_adaptive_part_bytes")))
+        nonempty = sum(1 for g in groups if g)
+        strategy = ("broadcast" if nonempty == 1
+                    else "coalesce" if nonempty < nemit else "shuffle")
+        _flight.record(_flight.EV_ADAPT_EXCHANGE, rid,
+                       detail=f"rid:{rid}:sid:{sid}:strategy:{strategy}:"
+                              f"parts:{nemit}->{nonempty}",
+                       value=sum(totals))
+        group = groups[m]
+        if not group:
+            # this consumer's group coalesced away: report a marker the
+            # combiner skips (like produce-only revivals) — its map-side
+            # partitions still served every non-empty group's fetches
+            return {"adaptive_empty": np.int64(m)}
+        received = _fetch_partitions(svc, sid, group, nparts, rid, ctx)
+    else:
+        received = _fetch_partitions(svc, sid, [m], nparts, rid, ctx)
     concat = {f: np.concatenate([r[f] for r in received])
               for f in exchange.fields}
     reduce_tables: Dict[str, Any] = {EXCHANGE_SOURCE: concat}
@@ -685,39 +753,77 @@ def run_shuffle_piece(plan, payload: dict, ctx) -> Dict[str, np.ndarray]:
     return {k: np.asarray(v) for k, v in out.items()}
 
 
+def plan_adaptive_groups(totals: List[int], nconsumers: int,
+                         target: int) -> List[List[int]]:
+    """Pack contiguous partition indices into at most ``nconsumers``
+    groups, closing a group once its MEASURED bytes reach ``target``.
+    Pure and deterministic — every consumer derives the identical
+    grouping from the identical broadcast sizes.  Always returns exactly
+    ``nconsumers`` groups (trailing ones may be empty); total bytes
+    under ``target`` collapse to a single broadcast-style group."""
+    groups: List[List[int]] = []
+    cur: List[int] = []
+    acc = 0
+    for p, b in enumerate(totals):
+        cur.append(p)
+        acc += int(b)
+        if acc >= target and len(groups) < nconsumers - 1:
+            groups.append(cur)
+            cur = []
+            acc = 0
+    if cur or not groups:
+        groups.append(cur)
+    while len(groups) < nconsumers:
+        groups.append([])
+    return groups
+
+
 def _fetch_all_partitions(svc, sid: int, m: int, nparts: int, rid: int,
                           ctx) -> List[Dict[str, np.ndarray]]:
-    """Pull this consumer's partition ``m`` from every map task, in map
-    order (the concat order correctness depends on), budget-reserved and
-    acked — the shared fetch half of the hash and range shuffle pieces."""
+    """Pull this consumer's partition ``m`` from every map task — the
+    static fetch half of the hash and range shuffle pieces."""
+    return _fetch_partitions(svc, sid, [m], nparts, rid, ctx)
+
+
+def _fetch_partitions(svc, sid: int, parts: List[int], ntasks: int,
+                      rid: int, ctx) -> List[Dict[str, np.ndarray]]:
+    """Pull every partition index in ``parts`` from every map task, in
+    (partition, map-task) order (the concat order correctness depends
+    on), budget-reserved and acked — the shared fetch half of the
+    static, adaptive, and range shuffle pieces."""
     from spark_rapids_jni_tpu.mem.governed import reservation
 
     credit = int(config.get("serve_shuffle_credit_bytes"))
     fetch_timeout = float(config.get("serve_shuffle_fetch_timeout_s"))
     received: List[Dict[str, np.ndarray]] = []
-    for k in range(nparts):
-        # each PARTITION gets the full fetch budget (the flag's
-        # documented per-partition semantics): one slow-recovering
-        # producer must not starve the fetches that follow it
-        deadline = time.monotonic() + fetch_timeout
-        # the transport phase of this request's waterfall: one span per
-        # partition wait+fetch, nested under the executor's compute span
-        # via the thread-current context (obs/trace.py) — slow peers show
-        # up as long transport bars, not opaque compute time
-        with trace.maybe_span(trace.SPAN_TRANSPORT,
-                              extra=f"sid:{sid}:from:{k}:part:{m}"):
-            # credit-based backpressure: reserve the advertised partition
-            # bytes (clamped to the credit window) from the executor's
-            # governed budget across the in-flight fetch+decode —
-            # transport memory competes with compute through the normal
-            # protocol (a RetryOOM here re-runs the whole piece via
-            # attempt_once, like any handler-body pressure signal)
-            nbytes = min(svc.wait_advertised(sid, k, m, deadline=deadline),
-                         credit)
-            with reservation(ctx.budget, nbytes):
-                cols = svc.fetch(sid, k, m, deadline=deadline, rid=rid)
-            svc.ack(sid, k, m, rid=rid)
-        received.append(cols)
+    for p in parts:
+        for k in range(ntasks):
+            # each PARTITION gets the full fetch budget (the flag's
+            # documented per-partition semantics): one slow-recovering
+            # producer must not starve the fetches that follow it
+            deadline = time.monotonic() + fetch_timeout
+            # the transport phase of this request's waterfall: one span
+            # per partition wait+fetch, nested under the executor's
+            # compute span via the thread-current context (obs/trace.py)
+            # — slow peers show up as long transport bars, not opaque
+            # compute time
+            with trace.maybe_span(trace.SPAN_TRANSPORT,
+                                  extra=f"sid:{sid}:from:{k}:part:{p}"):
+                # credit-based backpressure: reserve the advertised
+                # partition bytes (clamped to the credit window) from the
+                # executor's governed budget across the in-flight
+                # fetch+decode — transport memory competes with compute
+                # through the normal protocol (a RetryOOM here re-runs
+                # the whole piece via attempt_once, like any
+                # handler-body pressure signal)
+                nbytes = min(
+                    svc.wait_advertised(sid, k, p, deadline=deadline),
+                    credit)
+                with reservation(ctx.budget, nbytes):
+                    cols = svc.fetch(sid, k, p, deadline=deadline,
+                                     rid=rid)
+                svc.ack(sid, k, p, rid=rid)
+            received.append(cols)
     return received
 
 
@@ -746,7 +852,10 @@ def combine_exchange_outputs(plan) -> Callable:
 
         sums: Dict[str, np.ndarray] = {}
         for o in outs:
-            if "reproduced" in o and len(o) == 1:
+            if len(o) == 1 and ("reproduced" in o
+                                or "adaptive_empty" in o):
+                # produce-only revivals and coalesced-away adaptive
+                # consumers return markers, not partial sinks
                 continue
             for k, v in o.items():
                 sums[k] = (sums[k] + v) if k in sums else np.asarray(v)

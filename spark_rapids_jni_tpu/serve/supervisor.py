@@ -39,12 +39,13 @@ import itertools
 import multiprocessing
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from spark_rapids_jni_tpu.obs import flight as _flight
 from spark_rapids_jni_tpu.obs import trace as _trace
 from spark_rapids_jni_tpu.serve.executor import _SplitJoin, split_till
-from spark_rapids_jni_tpu.serve.metrics import ServeMetrics
+from spark_rapids_jni_tpu.serve.metrics import ServeMetrics, percentile_of_counts
 from spark_rapids_jni_tpu.serve.queue import (
     CANCELLED,
     ERROR,
@@ -96,6 +97,23 @@ _LEASE_TRANSITIONS = {
     _QUEUED: (_LEASED, _DONE),   # grant; queue-timeout/shutdown retire
     _LEASED: (_QUEUED, _DONE),   # dead/hung/busy re-dispatch; completion
     _DONE: (),                   # terminal: exactly-once, never revived
+}
+
+_H_NONE = "none"          # no hedge outstanding for this lease
+_H_LAUNCHED = "launched"  # ONE duplicate dispatch in flight
+
+# A lease's speculative-hedge lifecycle (round 19): the health sweep
+# launches at most one duplicate dispatch of a lease sitting past its
+# handler's windowed p99, and the attempt always retires back to "none"
+# — hedge result wins the lease, primary wins first (loser dropped as a
+# duplicate), hedge target says BUSY, or the hedge's worker dies.
+# Declared as its own machine (not new lease edges) so the lease
+# machine's exactly-once story is untouched: completion still flows
+# through _lease_done_locked exactly once, whoever ran the work.
+# state-machine: hedge field=hedge_state
+_HEDGE_TRANSITIONS = {
+    _H_NONE: (_H_LAUNCHED,),     # health sweep fires a hedge copy
+    _H_LAUNCHED: (_H_NONE,),     # win / primary-won / busy / dead target
 }
 # state-machine: worker field=health
 _WORKER_TRANSITIONS = {
@@ -198,7 +216,8 @@ class _Lease:
     """One dispatched request's supervision record (lease-table entry)."""
 
     __slots__ = ("rid", "req", "state", "worker_id", "incarnation",
-                 "dispatches", "redispatches", "granted_ns", "completed")
+                 "dispatches", "redispatches", "granted_ns", "completed",
+                 "hedge_state", "hedge_worker_id", "hedge_incarnation")
 
     def __init__(self, rid: int, req: Request):
         self.rid = rid
@@ -210,6 +229,13 @@ class _Lease:
         self.redispatches = 0
         self.granted_ns = 0
         self.completed = False
+        # speculative-hedge bookkeeping (round 19): which second worker
+        # holds the duplicate dispatch, incarnation-pinned like the
+        # primary so a recycled target's late answer can never match
+        # (all three fields follow the lease: guarded-by: _lock)
+        self.hedge_state = _H_NONE
+        self.hedge_worker_id = -1
+        self.hedge_incarnation = -1
 
 
 class _ShuffleState:
@@ -351,6 +377,18 @@ class Supervisor:
         self._leases_completed = 0  # guarded-by: _lock
         self._leases_redispatched = 0  # guarded-by: _lock
         self._lease_max_dispatches_seen = 0  # guarded-by: _lock
+        # speculative hedging (round 19): launched count enforces the
+        # budget (<= frac x leases granted, checked at launch)
+        self._hedge_on = bool(config.get("serve_hedge"))
+        self.hedge_factor = float(config.get("serve_hedge_factor"))
+        self.hedge_budget_frac = float(config.get("serve_hedge_budget_frac"))
+        self.hedge_min_samples = int(config.get("serve_hedge_min_samples"))
+        self.hedge_window_s = float(config.get("serve_hedge_window_s"))
+        self._hedges_launched = 0  # guarded-by: _lock
+        # sliding window of (t, handler_latency_counts()) histogram
+        # samples the hedge trigger diffs into a windowed p99; monitor
+        # thread only — never shared, never locked
+        self._hedge_lat: deque = deque()
         self._specs: Dict[str, HandlerSpec] = {}  # guarded-by: _lock
         self._warm: set = set()  # guarded-by: _lock
         # live shuffles' partition maps (retired at parent completion)
@@ -739,10 +777,12 @@ class Supervisor:
             handle.health = _DEAD
             current = self._handles.get(handle.worker_id) is handle
             orphans = []
+            dead_hedges = []
             for rid in handle.inflight:
                 lease = self._leases.get(rid)
-                if (lease is not None and not lease.completed
-                        and lease.state == _LEASED
+                if lease is None or lease.completed:
+                    continue
+                if (lease.state == _LEASED
                         and lease.worker_id == handle.worker_id
                         and lease.incarnation == handle.incarnation):
                     lease.state = _QUEUED  # transition: lease leased->queued
@@ -750,6 +790,14 @@ class Supervisor:
                         self._leases_redispatched += 1
                     lease.redispatches += 1
                     orphans.append(lease)
+                if (lease.hedge_state == _H_LAUNCHED
+                        and lease.hedge_worker_id == handle.worker_id
+                        and lease.hedge_incarnation == handle.incarnation):
+                    # the hedge copy died with its worker; the primary
+                    # (or a re-dispatch) still owns the lease — just
+                    # retire the attempt so the lease may hedge again
+                    lease.hedge_state = _H_NONE  # transition: hedge launched->none
+                    dead_hedges.append(rid)
             handle.inflight.clear()
         self.metrics.count("workers_dead")
         _flight.record(_flight.EV_WORKER_DEAD, -1,
@@ -760,6 +808,10 @@ class Supervisor:
         except (OSError, ValueError, AttributeError):
             pass
         handle.conn.close()
+        for rid in dead_hedges:
+            self.metrics.count("hedge_losses")
+            _flight.record(_flight.EV_HEDGE_LOSE, rid,
+                           detail=f"rid:{rid}:reason:{reason}")
         for lease in orphans:
             self.metrics.count("leases_redispatched")
             _flight.record(_flight.EV_LEASE_REDISPATCH, lease.rid,
@@ -1143,15 +1195,31 @@ class Supervisor:
                    value: Any, err) -> None:
         requeue = False
         granted_ns = 0
+        hedge_won = hedge_lost = hedge_shed = False
         with self._lock:
             lease = self._leases.get(rid)
-            stale = (lease is None or lease.completed
-                     or lease.state != _LEASED
-                     or lease.worker_id != handle.worker_id
-                     or lease.incarnation != handle.incarnation)
+            primary = (lease is not None and not lease.completed
+                       and lease.state == _LEASED
+                       and lease.worker_id == handle.worker_id
+                       and lease.incarnation == handle.incarnation)
+            # a hedge copy's answer is authoritative too: hedge fields
+            # are incarnation-pinned exactly like the primary's, and the
+            # check stands even if the primary died and re-queued in
+            # between (queued->done is a declared lease edge)
+            hedge = (not primary and lease is not None
+                     and not lease.completed
+                     and lease.hedge_state == _H_LAUNCHED
+                     and lease.hedge_worker_id == handle.worker_id
+                     and lease.hedge_incarnation == handle.incarnation)
+            stale = not (primary or hedge)
             if not stale:
                 granted_ns = lease.granted_ns
                 handle.inflight.discard(rid)
+                if hedge:
+                    # the hedge attempt retires whatever it brought back
+                    # (a result wins the lease below; BUSY abandons it —
+                    # the primary still owns the lease)
+                    lease.hedge_state = _H_NONE  # transition: hedge launched->none
                 # a fetch that stalled out (dead peer mid-recovery, storm
                 # of transport faults) is data-plane weather, not a
                 # handler failure: re-dispatch like BUSY, bounded by the
@@ -1160,19 +1228,48 @@ class Supervisor:
                            and err[0] == "ShuffleFetchStalled"
                            and lease.dispatches < self.lease_max_dispatches)
                 if status == rpc.STATUS_BUSY or stalled:
-                    lease.state = _QUEUED  # transition: lease leased->queued
-                    if lease.redispatches == 0:
-                        self._leases_redispatched += 1
-                    lease.redispatches += 1
-                    requeue = True
+                    if hedge:
+                        hedge_shed = True  # lease untouched: primary runs on
+                    else:
+                        lease.state = _QUEUED  # transition: lease leased->queued
+                        if lease.redispatches == 0:
+                            self._leases_redispatched += 1
+                        lease.redispatches += 1
+                        requeue = True
                 else:
+                    # first terminal result completes the lease, whoever
+                    # ran it; the loser's copy lands on the stale path
+                    hedge_won = hedge
+                    if primary and lease.hedge_state == _H_LAUNCHED:
+                        hedge_lost = True
+                        lease.hedge_state = _H_NONE  # transition: hedge launched->none
                     self._lease_done_locked(lease)
+            else:
+                # a LIVE loser (hedge raced a completed lease, or vice
+                # versa) must free its inflight slot here — unlike a
+                # recycled incarnation, no dead-worker sweep will
+                handle.inflight.discard(rid)
         if stale:
-            # a recycled worker's late answer for a re-dispatched lease:
-            # the active dispatch owns completion — count and drop
+            # a recycled worker's (or hedge loser's) late answer for an
+            # already-settled lease: the winning dispatch owns
+            # completion — count and drop
             self.metrics.count("duplicate_results")
             return
         req = lease.req
+        if hedge_shed:
+            self.metrics.count("hedge_losses")
+            why = "busy" if status == rpc.STATUS_BUSY else "fetch_stalled"
+            _flight.record(_flight.EV_HEDGE_LOSE, rid,
+                           detail=f"rid:{rid}:reason:{why}")
+            return
+        if hedge_won:
+            self.metrics.count("hedge_wins")
+            _flight.record(_flight.EV_HEDGE_WIN, rid,
+                           detail=f"rid:{rid}:worker:{handle.worker_id}")
+        elif hedge_lost:
+            self.metrics.count("hedge_losses")
+            _flight.record(_flight.EV_HEDGE_LOSE, rid,
+                           detail=f"rid:{rid}:reason:primary_won")
         if requeue:
             why = "busy" if status == rpc.STATUS_BUSY else "fetch_stalled"
             self.metrics.count("leases_redispatched")
@@ -1348,6 +1445,110 @@ class Supervisor:
                                detail=f"worker:{h.worker_id}:"
                                       f"inc:{h.incarnation}:hung_lease")
                 self._worker_dead(h, "hung_lease")
+        if self._hedge_on:
+            self._hedge_sweep(now, now_ns)
+
+    # -- speculative hedging (round 19) --------------------------------------
+    def _windowed_p99_ns(self, now: float) -> Dict[str, tuple]:
+        """handler -> (windowed completions, p99 ns): the cumulative
+        per-handler latency histograms sampled each sweep, oldest
+        in-window sample diffed away (serve/metrics.py documents exactly
+        this caller pattern).  Monitor thread only."""
+        counts = self.metrics.handler_latency_counts()
+        self._hedge_lat.append((now, counts))
+        while (len(self._hedge_lat) > 1
+               and now - self._hedge_lat[1][0] > self.hedge_window_s):
+            self._hedge_lat.popleft()
+        base = self._hedge_lat[0][1]
+        out = {}
+        for handler, cum in counts.items():
+            old = base.get(handler, ())
+            window = [c - (old[i] if i < len(old) else 0)
+                      for i, c in enumerate(cum)]
+            n = sum(window)
+            if n > 0:
+                out[handler] = (n, percentile_of_counts(window, 99.0))
+        return out
+
+    def _hedge_sweep(self, now: float, now_ns: int) -> None:
+        """Launch hedge copies for leases sitting past hedge_factor x
+        their handler's windowed p99.  Same critical-section discipline
+        as _grant: target choice and hedge bookkeeping are atomic under
+        the lock, the pipe send happens outside it."""
+        p99s = self._windowed_p99_ns(now)
+        if not p99s:
+            return
+        launches = []
+        with self._lock:
+            # the budget is strict — hedges never exceed the configured
+            # fraction of leases granted, no floor: a pool that has
+            # served too few requests to afford a hedge doesn't hedge
+            budget = int(self.hedge_budget_frac * self._leases_total)
+            for lease in self._leases.values():
+                if self._hedges_launched >= budget:
+                    break
+                if (lease.state != _LEASED or lease.completed
+                        or lease.hedge_state != _H_NONE):
+                    continue
+                if lease.req.shuffle_sid is not None:
+                    # never hedge shuffle participants: a duplicate map
+                    # task would race the partition map's (worker, inc)
+                    # ownership; stragglers there have their own
+                    # revival/re-dispatch story
+                    continue
+                stat = p99s.get(lease.req.handler)
+                if stat is None or stat[0] < self.hedge_min_samples:
+                    continue
+                age_ns = now_ns - lease.granted_ns
+                if age_ns <= int(self.hedge_factor * stat[1]):
+                    continue
+                cands = [
+                    h for h in self._handles.values()
+                    if h.health == _ALIVE
+                    and h.worker_id != lease.worker_id
+                    and len(h.inflight) < self.max_inflight_per_worker]
+                if not cands:
+                    continue
+                target = min(cands, key=lambda h: len(h.inflight))
+                lease.hedge_state = _H_LAUNCHED  # transition: hedge none->launched
+                lease.hedge_worker_id = target.worker_id
+                lease.hedge_incarnation = target.incarnation
+                lease.dispatches += 1
+                self._hedges_launched += 1
+                target.inflight.add(lease.rid)
+                launches.append((lease, target, age_ns))
+        for lease, target, age_ns in launches:
+            req = lease.req
+            self.metrics.count("hedges_launched", req.session_id)
+            _flight.record(_flight.EV_HEDGE_LAUNCH, lease.rid,
+                           detail=f"rid:{lease.rid}:"
+                                  f"worker:{target.worker_id}:"
+                                  f"inc:{target.incarnation}:"
+                                  f"handler:{req.handler}",
+                           value=age_ns)
+            deadline_rel = (None if req.deadline is None
+                            else max(0.05, req.deadline - time.monotonic()))
+            ok = target.conn.send(
+                (rpc.MSG_DISPATCH, lease.rid, req.handler, req.payload,
+                 deadline_rel, req.priority,
+                 _trace.to_wire(req.dspan.ctx if req.dspan is not None
+                                else req.trace)))
+            if not ok:
+                # reclaim THIS hedge explicitly (the _grant send-failure
+                # twin): if the EOF path already ran for the target's
+                # incarnation, _worker_dead below is a no-op
+                with self._lock:
+                    if (lease.hedge_state == _H_LAUNCHED
+                            and lease.hedge_worker_id == target.worker_id
+                            and lease.hedge_incarnation
+                            == target.incarnation):
+                        lease.hedge_state = _H_NONE  # transition: hedge launched->none
+                        target.inflight.discard(lease.rid)
+                self.metrics.count("hedge_losses")
+                _flight.record(_flight.EV_HEDGE_LOSE, lease.rid,
+                               detail=f"rid:{lease.rid}:"
+                                      f"reason:send_failed")
+                self._worker_dead(target, "send_failed")
 
     def _sample_stress(self) -> tuple:
         """(stress, dominant source name) — the source labels ladder
@@ -1466,6 +1667,7 @@ class Supervisor:
             total = self._leases_total
             completed = self._leases_completed
             redispatched = self._leases_redispatched
+            hedged = self._hedges_launched
             maxd = max([self._lease_max_dispatches_seen]
                        + [le.dispatches for le in live])
         return {
@@ -1473,6 +1675,7 @@ class Supervisor:
             "completed": completed,
             "outstanding": len(live),
             "redispatched": redispatched,
+            "hedged": hedged,
             "max_dispatches": maxd,
         }
 

@@ -156,9 +156,13 @@ def test_event_kind_vocabulary_is_stable():
         "span_open", "span_close", "slo_burn", "slo_ok",
         "telemetry_export", "telemetry_drop")
     # round 15: the result-cache kinds are strictly appended after
-    assert flight.EVENT_KINDS[-5:] == (
+    assert flight.EVENT_KINDS[37:42] == (
         "rcache_hit", "rcache_store", "rcache_demote",
         "rcache_evict", "rcache_invalidate")
+    # round 19: optimizer / adaptive-exchange / hedging kinds appended
+    assert flight.EVENT_KINDS[-5:] == (
+        "plan_rewrite", "adapt_exchange",
+        "hedge_launch", "hedge_win", "hedge_lose")
     assert len(set(flight.EVENT_KINDS)) == len(flight.EVENT_KINDS)
 
 

@@ -1,0 +1,335 @@
+"""Speculative hedging + adaptive-exchange planning (round 19) units.
+
+What the hedge acceptance pins (ISSUE 18):
+
+- a lease past hedge_factor x its handler's windowed p99 gets ONE hedge
+  copy on another ALIVE executor, bounded by the hedge budget fraction;
+- first terminal result wins the lease, whoever ran it; the loser's
+  late answer rides the existing duplicate-drop machinery (exactly-once
+  is preserved, and a LIVE loser frees its inflight slot);
+- a hedge's BUSY abandons only the attempt — the primary runs on;
+- a hedge target dying clears the hedge without re-queueing (the
+  primary still owns the lease);
+- shuffle participants are never hedged;
+- ``plan_adaptive_groups`` is pure and deterministic: every reduce-side
+  consumer derives the identical broadcast/coalesce/shuffle grouping
+  from the identical measured sizes.
+
+All unit-style (start=False): the chaos composition of hedges with
+SIGKILL re-dispatch lives in ``tools/serve_bench.py --optimizer-storm``.
+"""
+
+import pytest
+
+from spark_rapids_jni_tpu.obs import flight as _flight
+from spark_rapids_jni_tpu.serve import HandlerSpec, Supervisor
+from spark_rapids_jni_tpu.serve import rpc
+from spark_rapids_jni_tpu.serve.queue import OK, Request
+from spark_rapids_jni_tpu.serve.shuffle import plan_adaptive_groups
+from spark_rapids_jni_tpu.serve.supervisor import (
+    _ExecutorHandle,
+    _Lease,
+)
+
+
+@pytest.fixture
+def sup_unit():
+    sup = Supervisor(workers=2, factory=None, start=False)
+    sup.register(HandlerSpec("sum"))
+    yield sup
+    sup.shutdown(drain=False, timeout=5)
+
+
+class _RecConn:
+    """Fake pipe: records dispatches, always delivers."""
+
+    def __init__(self, ok=True):
+        self.sent = []
+        self.ok = ok
+
+    def send(self, msg):
+        self.sent.append(msg)
+        return self.ok
+
+    def close(self):
+        pass
+
+
+def _mk_lease(sup, rid=101, handler="sum", *, shuffle_sid=None):
+    req = Request(handler=handler, payload=[1, 2], session_id="u",
+                  priority=0, deadline=None, seq=0, task_id=rid,
+                  shuffle_sid=shuffle_sid)
+    with sup._lock:
+        lease = sup._leases[rid] = _Lease(rid, req)
+        sup._leases_total += 1
+    return lease, req
+
+
+def _alive(sup, wid, inc=0, conn=None):
+    h = _ExecutorHandle(wid, inc, proc=None, conn=conn or _RecConn())
+    h.health = "alive"
+    with sup._lock:
+        sup._handles[wid] = h
+    return h
+
+
+def _hedged(sup, lease, primary, target):
+    """Put a lease in the launched-hedge state by hand (the sweep's
+    bookkeeping, minus the timing trigger)."""
+    with sup._lock:
+        lease.state = "leased"
+        lease.worker_id = primary.worker_id
+        lease.incarnation = primary.incarnation
+        primary.inflight.add(lease.rid)
+        lease.hedge_state = "launched"  # transition: hedge none->launched
+        lease.hedge_worker_id = target.worker_id
+        lease.hedge_incarnation = target.incarnation
+        target.inflight.add(lease.rid)
+        sup._hedges_launched += 1
+
+
+# ---------------------------------------------------------- win / lose
+
+
+def test_hedge_result_wins_and_primary_duplicate_drops(sup_unit):
+    """First result completes the lease even when it's the hedge's; the
+    primary's late copy is counted and dropped, and its LIVE worker's
+    inflight slot is freed (no dead-worker sweep will do it)."""
+    sup = sup_unit
+    primary, target = _alive(sup, 0), _alive(sup, 1)
+    lease, req = _mk_lease(sup)
+    _hedged(sup, lease, primary, target)
+
+    sup._on_result(target, lease.rid, OK, 7, None)
+    assert req.response.status == OK and req.response.value == 7
+    assert lease.completed
+    assert lease.hedge_state == "none"
+    assert sup.metrics.get("hedge_wins") == 1
+    assert sup.metrics.get("leases_completed") == 1
+
+    sup._on_result(primary, lease.rid, OK, 7, None)  # the loser lands
+    assert sup.metrics.get("duplicate_results") == 1
+    assert sup.metrics.get("leases_completed") == 1  # exactly once
+    assert lease.rid not in primary.inflight  # live loser slot freed
+    wins = [e for e in _flight.snapshot() if e["kind"] == "hedge_win"]
+    assert any(e["task_id"] == lease.rid for e in wins)
+
+
+def test_primary_wins_and_hedge_loses(sup_unit):
+    sup = sup_unit
+    primary, target = _alive(sup, 0), _alive(sup, 1)
+    lease, req = _mk_lease(sup, rid=102)
+    _hedged(sup, lease, primary, target)
+
+    sup._on_result(primary, lease.rid, OK, 3, None)
+    assert req.response.status == OK and req.response.value == 3
+    assert lease.hedge_state == "none"
+    assert sup.metrics.get("hedge_losses") == 1  # primary_won
+    assert sup.metrics.get("hedge_wins") == 0
+
+    sup._on_result(target, lease.rid, OK, 3, None)  # hedge's late copy
+    assert sup.metrics.get("duplicate_results") == 1
+    assert lease.rid not in target.inflight
+    assert sup.metrics.get("leases_completed") == 1
+
+
+def test_hedge_busy_abandons_attempt_primary_runs_on(sup_unit):
+    """A BUSY from the hedge target sheds only the hedge — the lease
+    stays leased to the primary, nothing re-queues."""
+    sup = sup_unit
+    primary, target = _alive(sup, 0), _alive(sup, 1)
+    lease, req = _mk_lease(sup, rid=103)
+    _hedged(sup, lease, primary, target)
+
+    sup._on_result(target, lease.rid, rpc.STATUS_BUSY, None, None)
+    assert lease.state == "leased" and not lease.completed
+    assert lease.worker_id == primary.worker_id
+    assert lease.hedge_state == "none"
+    assert sup.queue.depth() == 0  # no requeue
+    assert sup.metrics.get("hedge_losses") == 1
+
+    sup._on_result(primary, lease.rid, OK, 3, None)  # primary finishes
+    assert req.response.status == OK
+    assert sup.metrics.get("leases_completed") == 1
+
+
+def test_dead_hedge_target_clears_state_without_requeue(sup_unit):
+    """The hedge target dying retires the attempt; the primary still
+    owns the lease, so nothing re-queues and the lease may hedge again
+    on a later sweep."""
+    sup = sup_unit
+    primary, target = _alive(sup, 0), _alive(sup, 1)
+    lease, req = _mk_lease(sup, rid=104)
+    _hedged(sup, lease, primary, target)
+
+    sup._worker_dead(target, "heartbeat_lost")
+    assert lease.hedge_state == "none"
+    assert lease.state == "leased" and not lease.completed
+    assert sup.queue.depth() == 0
+    assert sup.metrics.get("hedge_losses") == 1
+    assert sup.metrics.get("leases_redispatched") == 0
+    loses = [e for e in _flight.snapshot()
+             if e["kind"] == "hedge_lose" and e["task_id"] == lease.rid]
+    assert any("heartbeat_lost" in e["detail"] for e in loses)
+
+
+def test_primary_death_requeues_while_hedge_stays_armed(sup_unit):
+    """The primary dying re-queues the lease exactly as before hedging
+    existed; the hedge copy keeps running and may still win (its
+    acceptance check stands across the re-queue)."""
+    sup = sup_unit
+    primary, target = _alive(sup, 0), _alive(sup, 1)
+    lease, req = _mk_lease(sup, rid=105)
+    _hedged(sup, lease, primary, target)
+
+    sup._worker_dead(primary, "proc_exit")
+    assert lease.state == "queued"
+    assert lease.hedge_state == "launched"  # the hedge copy runs on
+    assert sup.queue.depth() == 1
+
+    sup._on_result(target, lease.rid, OK, 11, None)  # hedge wins anyway
+    assert req.response.status == OK and req.response.value == 11
+    assert sup.metrics.get("hedge_wins") == 1
+    assert sup.metrics.get("leases_completed") == 1
+
+
+# ---------------------------------------------------------- the sweep
+
+
+def _arm_sweep(sup, p99s):
+    """Point the sweep at a fabricated windowed-p99 table."""
+    sup._windowed_p99_ns = lambda now: p99s
+
+
+def test_hedge_sweep_launches_on_straggler_and_dispatches(sup_unit):
+    sup = sup_unit
+    sup.hedge_budget_frac = 1.0
+    sup.hedge_min_samples = 4
+    conn1 = _RecConn()
+    primary, target = _alive(sup, 0), _alive(sup, 1, conn=conn1)
+    lease, req = _mk_lease(sup, rid=106)
+    with sup._lock:
+        lease.state = "leased"
+        lease.worker_id, lease.incarnation = 0, 0
+        lease.granted_ns = 1  # leased an eternity ago
+        primary.inflight.add(lease.rid)
+    _arm_sweep(sup, {"sum": (100, 1_000)})  # p99 = 1us, n = 100
+
+    import time as _time
+    sup._hedge_sweep(_time.monotonic(), _time.monotonic_ns())
+    assert lease.hedge_state == "launched"
+    assert lease.hedge_worker_id == 1
+    assert lease.rid in target.inflight
+    assert lease.dispatches == 1
+    assert sup.metrics.get("hedges_launched") == 1
+    assert conn1.sent and conn1.sent[0][0] == rpc.MSG_DISPATCH
+    assert conn1.sent[0][1] == lease.rid
+    launches = [e for e in _flight.snapshot()
+                if e["kind"] == "hedge_launch"]
+    assert any(e["task_id"] == lease.rid and "handler:sum" in e["detail"]
+               for e in launches)
+    assert sup.lease_stats()["hedged"] == 1
+
+    # the sweep never double-hedges a lease
+    sup._hedge_sweep(_time.monotonic(), _time.monotonic_ns())
+    assert sup.metrics.get("hedges_launched") == 1
+
+
+def test_hedge_sweep_respects_budget_and_sample_floor(sup_unit):
+    sup = sup_unit
+    _alive(sup, 0), _alive(sup, 1)
+    import time as _time
+
+    # too few samples in the window: no hedge, however old the lease
+    lease, _ = _mk_lease(sup, rid=107)
+    with sup._lock:
+        lease.state = "leased"
+        lease.worker_id, lease.incarnation = 0, 0
+        lease.granted_ns = 1
+    sup.hedge_budget_frac = 1.0
+    _arm_sweep(sup, {"sum": (sup.hedge_min_samples - 1, 1_000)})
+    sup._hedge_sweep(_time.monotonic(), _time.monotonic_ns())
+    assert lease.hedge_state == "none"
+
+    # zero budget (strict fraction, no floor): no hedge either
+    _arm_sweep(sup, {"sum": (100, 1_000)})
+    sup.hedge_budget_frac = 0.0
+    sup._hedge_sweep(_time.monotonic(), _time.monotonic_ns())
+    assert lease.hedge_state == "none"
+    assert sup.metrics.get("hedges_launched") == 0
+
+
+def test_hedge_sweep_never_touches_shuffle_participants(sup_unit):
+    """A duplicate map task would race the partition map's ownership;
+    shuffle stragglers have their own revival story."""
+    sup = sup_unit
+    sup.hedge_budget_frac = 1.0
+    _alive(sup, 0), _alive(sup, 1)
+    lease, _ = _mk_lease(sup, rid=108, shuffle_sid=7)
+    with sup._lock:
+        lease.state = "leased"
+        lease.worker_id, lease.incarnation = 0, 0
+        lease.granted_ns = 1
+    _arm_sweep(sup, {"sum": (100, 1_000)})
+    import time as _time
+    sup._hedge_sweep(_time.monotonic(), _time.monotonic_ns())
+    assert lease.hedge_state == "none"
+    assert sup.metrics.get("hedges_launched") == 0
+
+
+def test_hedge_sweep_needs_a_distinct_alive_target(sup_unit):
+    """No second ALIVE worker -> no hedge (a copy on the same straggling
+    executor buys nothing)."""
+    sup = sup_unit
+    sup.hedge_budget_frac = 1.0
+    _alive(sup, 0)  # only the primary is alive
+    lease, _ = _mk_lease(sup, rid=109)
+    with sup._lock:
+        lease.state = "leased"
+        lease.worker_id, lease.incarnation = 0, 0
+        lease.granted_ns = 1
+    _arm_sweep(sup, {"sum": (100, 1_000)})
+    import time as _time
+    sup._hedge_sweep(_time.monotonic(), _time.monotonic_ns())
+    assert lease.hedge_state == "none"
+
+
+# --------------------------------------- adaptive exchange group planning
+
+
+def test_adaptive_groups_broadcast_when_total_under_target():
+    groups = plan_adaptive_groups([10, 20, 5, 0], nconsumers=4,
+                                  target=1 << 20)
+    assert groups == [[0, 1, 2, 3], [], [], []]
+
+
+def test_adaptive_groups_coalesce_packs_to_target():
+    # target 100: partitions pack contiguously until measured bytes
+    # reach it, trailing consumers idle
+    groups = plan_adaptive_groups([60, 60, 60, 60, 60, 60],
+                                  nconsumers=3, target=100)
+    assert groups == [[0, 1], [2, 3], [4, 5]]
+    groups = plan_adaptive_groups([200, 1, 1, 1], nconsumers=4,
+                                  target=100)
+    assert groups == [[0], [1, 2, 3], [], []]
+
+
+def test_adaptive_groups_exactly_nconsumers_and_cover_all():
+    totals = [7, 93, 150, 2, 2, 2, 300, 1]
+    for target in (1, 50, 100, 10_000):
+        groups = plan_adaptive_groups(totals, nconsumers=4, target=target)
+        assert len(groups) == 4
+        flat = [p for g in groups for p in g]
+        assert flat == list(range(len(totals)))  # contiguous, complete
+        # deterministic: same inputs, same plan
+        assert groups == plan_adaptive_groups(totals, 4, target)
+
+
+def test_adaptive_groups_skew_collapses_tail():
+    """One hot partition + dust: the hot one closes a group alone and
+    the dust coalesces — the strategy narration's parts:N->M story."""
+    totals = [1000, 1, 1, 1, 1, 1, 1, 1]
+    groups = plan_adaptive_groups(totals, nconsumers=8, target=500)
+    nonempty = [g for g in groups if g]
+    assert len(nonempty) == 2
+    assert nonempty[0] == [0]

@@ -95,12 +95,22 @@ def _fmt_value(e: dict) -> str:
     return f" [{v}]"
 
 
+# decision events beyond the controller's control_* family (round 19):
+# the plan optimizer's applied rules, the adaptive reduce's runtime
+# partition/strategy choices, and the hedging lifecycle — one ledger of
+# every choice the stats-driven machinery made
+_DECISION_KINDS = ("plan_rewrite", "adapt_exchange",
+                   "hedge_launch", "hedge_win", "hedge_lose")
+
+
 def control_ledger(dump: dict) -> List[dict]:
-    """The admission controller's decision ledger: every ``control_*``
-    event in capture order — the WHY behind each knob adjustment,
-    freeze transition, and pre-emptive split (serve/controller.py)."""
+    """The cluster's decision ledger: every ``control_*`` event
+    (admission-controller knob adjustments, freezes, pre-emptive splits
+    — serve/controller.py) plus the round-19 optimizer / adaptive /
+    hedging decisions, in capture order."""
     return [e for e in dump.get("events", [])
-            if str(e.get("kind", "")).startswith("control_")]
+            if str(e.get("kind", "")).startswith("control_")
+            or str(e.get("kind", "")) in _DECISION_KINDS]
 
 
 def format_control_ledger(dump: dict) -> str:
@@ -351,9 +361,10 @@ def main(argv=None) -> int:
     ap.add_argument("--top", type=int, default=0,
                     help="with --waterfall: only the N slowest requests")
     ap.add_argument("--control", action="store_true",
-                    help="show only the admission-control decision ledger "
-                         "(control_* events: knob adjustments with "
-                         "old->new:reason, freezes, pre-splits)")
+                    help="show only the decision ledger (control_* knob "
+                         "adjustments with old->new:reason, freezes, "
+                         "pre-splits, plus plan_rewrite / adapt_exchange "
+                         "/ hedge_* decisions)")
     ap.add_argument("--json", action="store_true",
                     help="emit the reconstructed per-task timelines as JSON")
     args = ap.parse_args(argv)

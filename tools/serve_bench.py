@@ -90,13 +90,18 @@ def shuffle_worker_factory(engine, capacity: int = 64) -> None:
         nbytes_of=lambda p: 0))
 
 
-def _shuffle_round(args, *, chaos: bool, dump_dir: str = "") -> dict:
+def _shuffle_round(args, *, chaos: bool, dump_dir: str = "",
+                   adaptive: bool = False, skew: bool = False) -> dict:
     """One supervised-cluster shuffle run: every request is a q97
     Exchange plan executed as a REAL cross-process shuffle (map shards on
     distinct executors, framed partition push/pull, reduce-side concat),
     each answer checked against the host oracle.  ``chaos`` arms the
     seeded data-plane storm (frame corruption, truncation, stalled
-    peers) plus one-shot mid-exchange SIGKILLs per armed incarnation."""
+    peers) plus one-shot mid-exchange SIGKILLs per armed incarnation.
+    ``adaptive`` arms the round-19 adaptive Exchange (over-partitioned
+    map emit + measured-size reduce grouping) on every worker; ``skew``
+    concentrates key mass so one partition runs hot — the shape the
+    adaptive grouping exists to absorb."""
     import numpy as np
 
     from spark_rapids_jni_tpu.models.q97 import q97_host_oracle, q97_plan
@@ -142,6 +147,12 @@ def _shuffle_round(args, *, chaos: bool, dump_dir: str = "") -> dict:
     }
     if dump_dir:
         worker_flags["flight_dump_dir"] = dump_dir
+    if adaptive:
+        worker_flags.update({
+            "serve_adaptive_exchange": True,
+            "serve_adaptive_overpartition": args.adaptive_overpartition,
+            "serve_adaptive_part_bytes": args.adaptive_part_bytes,
+        })
     plan = q97_plan(args.shuffle_capacity)
     scans = scan_table_names(plan)
     sup = Supervisor(
@@ -185,10 +196,19 @@ def _shuffle_round(args, *, chaos: bool, dump_dir: str = "") -> dict:
             f"shuffle{ci}", priority=1 if ci % 3 == 0 else 0)
         for ri in range(per_client):
             n = args.shuffle_rows
-            store = (rng.randint(1, 60, n).astype(np.int32),
-                     rng.randint(1, 25, n).astype(np.int32))
-            catalog = (rng.randint(1, 60, n).astype(np.int32),
-                       rng.randint(1, 25, n).astype(np.int32))
+            if skew:
+                # ~70% of key mass on a handful of customers: the hash
+                # partitions covering them run hot, the rest are dust
+                def keys(size):
+                    hot = rng.randint(1, 4, size).astype(np.int32)
+                    cold = rng.randint(1, 60, size).astype(np.int32)
+                    return np.where(rng.random_sample(size) < 0.7,
+                                    hot, cold).astype(np.int32)
+            else:
+                def keys(size):
+                    return rng.randint(1, 60, size).astype(np.int32)
+            store = (keys(n), rng.randint(1, 25, n).astype(np.int32))
+            catalog = (keys(n), rng.randint(1, 25, n).astype(np.int32))
             payload = {"store": {"cust": store[0], "item": store[1]},
                        "catalog": {"cust": catalog[0],
                                    "item": catalog[1]}}
@@ -250,6 +270,7 @@ def _shuffle_round(args, *, chaos: bool, dump_dir: str = "") -> dict:
     counters = snap["counters"]
     return {
         "chaos": chaos,
+        "adaptive": adaptive,
         "requests": total,
         "wall_s": round(wall, 3),
         "outcomes": tally,
@@ -1404,6 +1425,416 @@ def _run_chaos_storm(args) -> int:
     return 0 if ok else 1
 
 
+def _optimizer_variants(j: int, epoch: int, nseg: int):
+    """Four spellings of ONE logical two-join + two-predicate query —
+    join order x filter splitting — all named ``opt_q{j}`` so the
+    rewriter's canonical form keys ONE result-cache entry for all four.
+    Predicate literals embed the epoch, so epochs never share keys."""
+    from spark_rapids_jni_tpu.plans import ir
+
+    lit1 = (epoch * 17 + j) % 40
+    lit2 = 60 + (epoch * 7 + j) % 30
+
+    def build(a_first: bool, split_filters: bool):
+        node = ir.Scan("facts", ("ka", "kb", "qty"))
+        joins = [("dim_a", "w", "ka", "wa"), ("dim_b", "v", "kb", "vb")]
+        if not a_first:
+            joins.reverse()
+        for table, field, key, out in joins:
+            node = ir.GatherJoin(node, ir.Dim(table, (field,)),
+                                 ir.col(key), ir.lit(0), ((field, out),))
+        p1 = ir.Bin("gt", ir.col("qty"), ir.lit(lit1))
+        p2 = ir.Bin("ne", ir.col("qty"), ir.lit(lit2))
+        if split_filters:
+            node = ir.Filter(ir.Filter(node, p1), p2)
+        else:
+            node = ir.Filter(node, ir.Bin("and", p1, p2))
+        sink = ir.SegmentAgg(
+            node, ir.col("ka"), nseg,
+            (("s", ir.Bin("mul", ir.col("wa"), ir.col("vb")), "int64"),
+             ("c", ir.col("qty"), "int64")))
+        return ir.Plan(f"opt_q{j}", (sink,))
+
+    return [build(True, False), build(False, False),
+            build(True, True), build(False, True)]
+
+
+def _optimizer_round(args, *, optimizer_on: bool) -> dict:
+    """One in-process governed-plan round of the canonicalization
+    workload: epochs of K logical queries, each submitted in 4 different
+    spellings.  Both tiers run with the result cache ON and an identical
+    seeded schedule; the only difference is ``plan_optimizer``.  With the
+    rewriter on, every spelling canonicalizes to one tree, so the warm
+    pass's K entries serve the whole measure phase (cross-query hits);
+    off, each spelling keys separately and recomputes.  Every answer is
+    checked bit-identical against the unrewritten compiled oracle."""
+    import numpy as np
+
+    from spark_rapids_jni_tpu.models import tables as _tables
+    from spark_rapids_jni_tpu.obs import flight as _flight
+    from spark_rapids_jni_tpu.plans import execute_plan
+    from spark_rapids_jni_tpu.plans import optimizer as _opt
+    from spark_rapids_jni_tpu.plans.rcache import result_cache
+    from spark_rapids_jni_tpu.plans.runtime import run_governed_plan
+
+    from spark_rapids_jni_tpu import config
+
+    rng = np.random.RandomState(args.seed)
+    result_cache.reset_for_tests()
+    _tables.reset_for_tests()
+    _opt.reset_for_tests()
+    nseg, ndim_b = 512, 8
+    n = args.opt_rows
+    tables = {
+        "facts": {"ka": rng.randint(0, nseg, n).astype(np.int32),
+                  "kb": rng.randint(0, ndim_b, n).astype(np.int32),
+                  "qty": rng.randint(0, 100, n).astype(np.int64)},
+        "dim_a": {"w": rng.randint(1, 100, nseg).astype(np.int64)},
+        "dim_b": {"v": rng.randint(1, 100, ndim_b).astype(np.int64)},
+    }
+    K, V, R = args.opt_queries, 4, args.opt_repeats
+    tally = {"succeeded": 0, "errors": 0, "wrong_answers": 0}
+    latencies = []
+    ev0 = sum(1 for e in _flight.snapshot()
+              if e["kind"] == "plan_rewrite")
+
+    def run_checked(plan, oracle, measure: bool) -> None:
+        t0 = time.perf_counter()
+        try:
+            out = run_governed_plan(None, plan, tables)
+        except Exception:  # noqa: BLE001 - counted, not raised
+            tally["errors"] += 1
+            return
+        dt = time.perf_counter() - t0
+        tally["succeeded"] += 1
+        for k in oracle:
+            if not np.array_equal(np.asarray(out[k]),
+                                  np.asarray(oracle[k])):
+                tally["wrong_answers"] += 1
+                break
+        if measure:
+            latencies.append(dt)
+
+    t0 = time.perf_counter()
+    with config.override(serve_result_cache=True,
+                         plan_optimizer=optimizer_on):
+        for epoch in range(args.opt_epochs):
+            variants = [_optimizer_variants(j, epoch, nseg)
+                        for j in range(K)]
+            # one config-independent oracle per logical query
+            oracles = [execute_plan(None, variants[j][0], tables)
+                       for j in range(K)]
+            # warm pass (unmeasured): spelling 0 of each query seeds the
+            # cache — canonical key when the rewriter is on, verbatim off
+            for j in range(K):
+                run_checked(variants[j][0], oracles[j], measure=False)
+            # measure pass: all four spellings, seeded shuffle
+            schedule = [(j, v) for j in range(K) for v in range(V)] * R
+            rng.shuffle(schedule)
+            for j, v in schedule:
+                run_checked(variants[j][v], oracles[j], measure=True)
+    wall = time.perf_counter() - t0
+    stats = result_cache.stats()
+    rewrites = sum(1 for e in _flight.snapshot()
+                   if e["kind"] == "plan_rewrite") - ev0
+    total = args.opt_epochs * (K + K * V * R)
+    lat_ms = sorted(1e3 * x for x in latencies)
+    pct = (lambda p: round(
+        lat_ms[min(len(lat_ms) - 1, int(len(lat_ms) * p / 100))], 3)
+        if lat_ms else 0.0)
+    return {
+        "optimizer_on": optimizer_on,
+        "requests": total,
+        "wall_s": round(wall, 3),
+        "req_per_s": round(total / wall, 2) if wall else 0.0,
+        "outcomes": tally,
+        "lost": total - tally["succeeded"] - tally["errors"],
+        "zero_lost": (tally["succeeded"] == total
+                      and tally["errors"] == 0),
+        "bit_identical": tally["wrong_answers"] == 0,
+        "p50_ms": pct(50),
+        "p99_ms": pct(99),
+        "rcache": {k: stats.get(k, 0) for k in
+                   ("lookups", "hits", "misses", "stores", "hit_ratio")},
+        "rewrite_events": rewrites,
+    }
+
+
+def _hedge_chaos_phase(args) -> dict:
+    """Speculative hedging under the round-10 kill storm: seeded rare
+    extreme stragglers (faultinj ``slow``) ride alongside one-shot
+    mid-request SIGKILLs.  The sweep must hedge a straggling lease onto
+    another executor and the hedge must WIN (first-result-wins), while
+    kill-driven re-dispatch composes with hedge bookkeeping — zero lost,
+    every lease effectively once."""
+    import numpy as np
+
+    from spark_rapids_jni_tpu.obs import flight as _flight
+    from spark_rapids_jni_tpu.obs.faultinj import chaos_kill_config
+    from spark_rapids_jni_tpu.serve import (
+        Backpressure,
+        Degraded,
+        HandlerSpec,
+        RequestTimeout,
+        Supervisor,
+    )
+
+    from spark_rapids_jni_tpu import config
+
+    def chaos_fn(wid: int, inc: int):
+        # incarnation-0 executors die at most once each (kill + respawn
+        # composes with hedging); every incarnation gets the rare
+        # extreme-straggler weather the hedge sweep exists to absorb
+        return chaos_kill_config(
+            seed=args.seed * 1000 + wid * 17 + inc,
+            kill=(inc == 0), kill_pct=args.kill_pct,
+            slow_pct=args.hedge_slow_pct, slow_ms=args.hedge_slow_ms)
+
+    # hedge knobs are snapshot at construction: the override need only
+    # wrap the Supervisor() call
+    with config.override(serve_hedge=True,
+                         serve_hedge_factor=args.hedge_factor,
+                         serve_hedge_budget_frac=args.hedge_budget_frac,
+                         serve_hedge_min_samples=8,
+                         serve_hedge_window_s=5.0):
+        sup = Supervisor(
+            workers=args.opt_cluster,
+            factory="serve_bench:cluster_worker_factory",
+            factory_kwargs={"bytes_per_row": args.storm_bytes_per_row,
+                            "service_ms": args.cluster_service_ms},
+            worker_cfg={"workers": args.workers,
+                        "queue_size": max(32, args.queue_size)},
+            chaos=chaos_fn,
+            queue_size=args.queue_size,
+            default_deadline_s=args.deadline_s,
+            lease_hang_s=args.lease_hang_s)
+    sup.register(HandlerSpec(
+        "storm", nbytes_of=lambda p: args.storm_bytes_per_row * len(p)))
+
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline:
+        alive = sum(1 for w in sup.snapshot()["workers"].values()
+                    if w["state"] == "alive")
+        if alive >= args.opt_cluster:
+            break
+        time.sleep(0.05)
+
+    clients = max(2, args.clients)
+    per_client = max(1, args.hedge_requests // clients)
+    total = per_client * clients
+    lock = threading.Lock()
+    tally = {"succeeded": 0, "rejected": 0, "timed_out": 0, "errors": 0,
+             "client_retries": 0, "degraded_retries": 0, "wrong_answers": 0}
+
+    def client(ci: int) -> None:
+        rng = np.random.RandomState(args.seed * 1000 + ci)
+        sess = sup.open_session(
+            f"hedge{ci}", priority=1 if ci % 3 == 0 else 0)
+        for _ri in range(per_client):
+            payload = rng.randint(0, 1000, args.storm_rows).astype(np.int64)
+            want = int(payload.sum())
+            outcome = "rejected"
+            for _ in range(args.max_retries):
+                try:
+                    resp = sup.submit(sess, "storm", payload)
+                except Degraded as bp:
+                    with lock:
+                        tally["degraded_retries"] += 1
+                    time.sleep(min(bp.retry_after_s, 0.1))
+                    continue
+                except Backpressure as bp:
+                    with lock:
+                        tally["client_retries"] += 1
+                    time.sleep(min(bp.retry_after_s, 0.05))
+                    continue
+                try:
+                    out = resp.result(timeout=args.deadline_s + 30)
+                except RequestTimeout:
+                    outcome = "timed_out"
+                except Exception:  # noqa: BLE001 - counted, not raised
+                    outcome = "errors"
+                else:
+                    outcome = "succeeded"
+                    if out != want:
+                        with lock:
+                            tally["wrong_answers"] += 1
+                break
+            with lock:
+                tally[outcome] += 1
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(ci,))
+               for ci in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    sup.wait_drained(timeout=120)
+    wall = time.perf_counter() - t0
+    snap = sup.snapshot()
+    hedge_events = {
+        k: sum(1 for e in _flight.snapshot() if e["kind"] == k)
+        for k in ("hedge_launch", "hedge_win", "hedge_lose")}
+    sup.shutdown()
+    counters = snap["counters"]
+    leases = snap["leases"]
+    accounted = (tally["succeeded"] + tally["rejected"]
+                 + tally["timed_out"] + tally["errors"])
+    return {
+        "requests": total,
+        "wall_s": round(wall, 3),
+        "outcomes": tally,
+        "lost": total - accounted,
+        "zero_lost": (accounted == total and tally["errors"] == 0
+                      and tally["timed_out"] == 0
+                      and tally["wrong_answers"] == 0),
+        "hedges_launched": counters.get("hedges_launched", 0),
+        "hedge_wins": counters.get("hedge_wins", 0),
+        "hedge_losses": counters.get("hedge_losses", 0),
+        "hedge_events": hedge_events,
+        "workers_dead": counters.get("workers_dead", 0),
+        "duplicate_results": counters.get("duplicate_results", 0),
+        "leases": leases,
+        "exactly_once": (leases["outstanding"] == 0
+                         and leases["completed"] == leases["leases"]),
+    }
+
+
+def _run_optimizer_storm(args) -> int:
+    """``--optimizer-storm``: the round-19 acceptance tier.
+
+    Phase 1 — paired optimizer-off/on governed-plan rounds over an
+    identical seeded multi-spelling workload (>= 3 seeds): the rewriter
+    must win median p99 through cross-query result-cache hits, with
+    every answer bit-identical to the unrewritten oracle and zero lost.
+    Phase 2 — paired static/adaptive Exchange shuffle rounds on a
+    skewed q97 workload: the reduce side must demonstrably change
+    partition count/strategy (EV_ADAPT_EXCHANGE in the merged dumps)
+    with oracle-identical outputs both rounds.  Phase 3 — speculative
+    hedging under the seeded kill+straggler storm: hedges launch, a
+    hedge wins, SIGKILL re-dispatch composes, zero lost, leases
+    effectively once."""
+    import re as _re
+    import statistics
+    import tempfile
+
+    from spark_rapids_jni_tpu.obs import flight as _flight
+
+    from spark_rapids_jni_tpu import config
+
+    rounds = []
+    base_seed = args.seed
+    for i in range(max(1, args.opt_rounds)):
+        args.seed = base_seed + i
+        off = _optimizer_round(args, optimizer_on=False)
+        on = _optimizer_round(args, optimizer_on=True)
+        rounds.append({"seed": args.seed, "off": off, "on": on})
+    args.seed = base_seed
+    p99_off = statistics.median(r["off"]["p99_ms"] for r in rounds)
+    p99_on = statistics.median(r["on"]["p99_ms"] for r in rounds)
+    misses_on = sum(r["on"]["rcache"]["misses"] for r in rounds)
+    misses_off = sum(r["off"]["rcache"]["misses"] for r in rounds)
+    hits_on = sum(r["on"]["rcache"]["hits"] for r in rounds)
+    hits_off = sum(r["off"]["rcache"]["hits"] for r in rounds)
+    # with the rewriter on, ONLY the warm pass may miss: every measured
+    # request — three quarters of which are spelled differently from the
+    # entry that seeded the cache — must hit the canonical key
+    expected_warm = args.opt_rounds * args.opt_epochs * args.opt_queries
+    optimizer = {
+        "rounds": len(rounds),
+        "p99_ms_off": p99_off,
+        "p99_ms_on": p99_on,
+        "rcache_misses_off": misses_off,
+        "rcache_misses_on": misses_on,
+        "rcache_hits_off": hits_off,
+        "rcache_hits_on": hits_on,
+        "cross_query_hits": (hits_on - hits_off
+                             if misses_on == expected_warm else 0),
+        "rewrite_events": sum(r["on"]["rewrite_events"] for r in rounds),
+    }
+    opt_gates = {
+        "opt_zero_lost": all(r["off"]["zero_lost"] and r["on"]["zero_lost"]
+                             for r in rounds),
+        "opt_bit_identical": all(
+            r["off"]["bit_identical"] and r["on"]["bit_identical"]
+            for r in rounds),
+        "opt_p99_win": p99_on < p99_off,
+        "opt_cross_query_hits": (misses_on == expected_warm
+                                 and hits_on > hits_off),
+        "opt_rewrites_narrated": optimizer["rewrite_events"] > 0,
+    }
+
+    dump_dir = args.dump_dir or tempfile.mkdtemp(prefix="srt_adapt_")
+    static = _shuffle_round(args, chaos=False, skew=True)
+    adaptive = _shuffle_round(args, chaos=False, dump_dir=dump_dir,
+                              adaptive=True, skew=True)
+    config.set("flight_dump_dir", "")
+    _flight.recorder().reset_for_tests()
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import flightdump
+
+    merged = flightdump.merge_cluster(dump_dir)
+    adapt_events = [e for e in merged["events"]
+                    if e["kind"] == "adapt_exchange"]
+    strategies = {}
+    changed = 0
+    for e in adapt_events:
+        d = str(e.get("detail", ""))
+        m = _re.search(r"strategy:(\w+):parts:(\d+)->(\d+)", d)
+        if not m:
+            continue
+        strategies[m.group(1)] = strategies.get(m.group(1), 0) + 1
+        if m.group(2) != m.group(3):
+            changed += 1
+    adaptive_cmp = {
+        "p99_ms_static": static["p99_ms"],
+        "p99_ms_adaptive": adaptive["p99_ms"],
+        "adapt_events": len(adapt_events),
+        "strategy_changes": changed,
+        "strategies": strategies,
+    }
+    adapt_gates = {
+        "adapt_zero_lost": static["zero_lost"] and adaptive["zero_lost"],
+        "adapt_oracle_identical": (static["oracle_identical"]
+                                   and adaptive["oracle_identical"]),
+        # the acceptance: the reduce side demonstrably REGROUPED — the
+        # merged worker dumps carry adapt_exchange decisions whose
+        # partition count actually changed (coalesce and/or broadcast)
+        "adapt_strategy_changed": changed >= 1,
+    }
+
+    hedge = _hedge_chaos_phase(args)
+    hedge_gates = {
+        "hedge_zero_lost": hedge["zero_lost"],
+        "hedge_launched": hedge["hedges_launched"] >= 1,
+        "hedge_straggler_recovered": hedge["hedge_wins"] >= 1,
+        "hedge_exactly_once": hedge["exactly_once"],
+        "hedge_kills_composed": hedge["workers_dead"] >= 1,
+    }
+
+    gates = {}
+    gates.update(opt_gates)
+    gates.update(adapt_gates)
+    gates.update(hedge_gates)
+    rec = {
+        "name": "BENCH_serve",
+        "mode": "optimizer_storm",
+        "seed": base_seed,
+        "clients": args.clients,
+        "cluster": args.opt_cluster,
+        "optimizer": {"rounds": rounds, "comparison": optimizer},
+        "adaptive": {"static": static, "adaptive": adaptive,
+                     "comparison": adaptive_cmp, "dump_dir": dump_dir},
+        "hedge": hedge,
+        "gates": gates,
+        "zero_lost": (opt_gates["opt_zero_lost"]
+                      and adapt_gates["adapt_zero_lost"]
+                      and hedge_gates["hedge_zero_lost"]),
+    }
+    print(json.dumps(rec))
+    return 0 if all(gates.values()) else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="serving-engine load generator")
     ap.add_argument("--clients", type=int, default=32)
@@ -1581,8 +2012,58 @@ def main(argv=None) -> int:
                     help="the armed SLO's p99 target; must sit well "
                          "under the chaos round's fault-inflated "
                          "latencies so the burn is deterministic")
+    ap.add_argument("--optimizer-storm", action="store_true",
+                    help="round-19 acceptance tier: paired optimizer-"
+                         "off/on governed-plan rounds (median-p99 win "
+                         "via cross-query rcache hits, bit-identical, "
+                         "zero lost), paired static/adaptive Exchange "
+                         "rounds on a skewed shuffle (strategy change "
+                         "asserted from merged EV_ADAPT_EXCHANGE "
+                         "events), and speculative hedging under the "
+                         "seeded kill+straggler storm (hedge win, "
+                         "exactly-once)")
+    ap.add_argument("--opt-rounds", type=int, default=3,
+                    help="paired optimizer-off/on rounds (median p99 "
+                         "across rounds gates the win)")
+    ap.add_argument("--opt-epochs", type=int, default=3,
+                    help="cache-cold epochs per optimizer round (each "
+                         "epoch uses fresh predicate literals)")
+    ap.add_argument("--opt-queries", type=int, default=4,
+                    help="logical queries per epoch; each is submitted "
+                         "in 4 spellings (join order x filter split)")
+    ap.add_argument("--opt-repeats", type=int, default=2,
+                    help="measured repeats of each spelling per epoch")
+    ap.add_argument("--opt-rows", type=int, default=20000,
+                    help="fact-table rows of the optimizer workload "
+                         "(compute cost a cache hit skips)")
+    ap.add_argument("--opt-cluster", type=int, default=3,
+                    help="executor pool size of the hedge chaos phase")
+    ap.add_argument("--hedge-requests", type=int, default=400,
+                    help="total requests of the hedge chaos phase")
+    ap.add_argument("--hedge-factor", type=float, default=2.0,
+                    help="hedge trigger multiple of the windowed p99")
+    ap.add_argument("--hedge-budget-frac", type=float, default=0.1,
+                    help="hedge budget as a fraction of leases granted")
+    ap.add_argument("--hedge-slow-pct", type=float, default=0.8,
+                    help="per-crossing probability of the injected "
+                         "extreme straggler (must stay RARE so the "
+                         "windowed p99 keeps reflecting normal service "
+                         "and the straggler reads as an outlier)")
+    ap.add_argument("--hedge-slow-ms", type=float, default=2000.0,
+                    help="injected straggler stall; must dwarf "
+                         "hedge-factor x normal p99 so a launched "
+                         "hedge beats the stuck primary")
+    ap.add_argument("--adaptive-overpartition", type=int, default=4,
+                    help="map-side over-partition factor of the "
+                         "adaptive Exchange round")
+    ap.add_argument("--adaptive-part-bytes", type=int, default=4096,
+                    help="target measured bytes per reduce group "
+                         "(sized so the CI-scale skewed workload "
+                         "actually coalesces)")
     args = ap.parse_args(argv)
 
+    if args.optimizer_storm:
+        return _run_optimizer_storm(args)
     if args.cache_storm:
         return _run_cache_storm(args)
     if args.cluster > 0 and args.chaos_shuffle:
