@@ -468,16 +468,31 @@ def main(argv=None):
         return Column(jnp.asarray(fvals.view(np.int64)), None, FLOAT64)
 
     def _f2s():
+        from spark_rapids_jni_tpu.ops.float_to_string import (
+            PHASES as _f2s_phases,
+        )
+
         fcol = _fcol()
         dt = _time(lambda c: float_to_string(c).chars, max(iters // 4, 3), fcol)
-        return {"Mrows_per_s": round(ns / dt / 1e6, 2)}
+        # one instrumented call: attribute regressions to a pipeline stage
+        _f2s_phases.reset()
+        float_to_string(fcol).chars
+        phases = {k: round(v, 3) for k, v in _f2s_phases.snapshot().items()}
+        return {"Mrows_per_s": round(ns / dt / 1e6, 2), "phases_s": phases}
 
     def _s2f():
+        from spark_rapids_jni_tpu.ops.cast_string_to_float import (
+            PHASES as _s2f_phases,
+        )
+
         scol = float_to_string(_fcol())
         dt = _time(
             lambda c: string_to_float(c, ansi_mode=False, dtype=FLOAT64).data,
             max(iters // 4, 3), scol)
-        return {"Mrows_per_s": round(ns / dt / 1e6, 2)}
+        _s2f_phases.reset()
+        string_to_float(scol, ansi_mode=False, dtype=FLOAT64).data
+        phases = {k: round(v, 3) for k, v in _s2f_phases.snapshot().items()}
+        return {"Mrows_per_s": round(ns / dt / 1e6, 2), "phases_s": phases}
 
     _stage(detail, "float_to_string", _f2s, nbytes=ns * 64)
     _stage(detail, "string_to_float", _s2f, nbytes=ns * 64)
@@ -499,23 +514,41 @@ def main(argv=None):
     row_bytes = 8 + 4 + 8 + 4  # 8B-aligned JCUDF row incl. pad + validity
 
     def _rows_to():
+        from spark_rapids_jni_tpu.ops.row_conversion import (
+            PHASES as _rows_phases,
+        )
+
         cols = _cols()
         dt = _time(lambda: convert_to_rows_fixed_width_optimized(cols),
                    max(iters // 4, 3))
+        _rows_phases.reset()
+        convert_to_rows_fixed_width_optimized(cols)
+        phases = {k: round(v, 3)
+                  for k, v in _rows_phases.snapshot().items()}
         return {
             "Mrows_per_s": round(nr / dt / 1e6, 2),
             "roofline_frac": _frac((nr / dt) * 2 * row_bytes),
+            "phases_s": phases,
         }
 
     def _rows_from():
+        from spark_rapids_jni_tpu.ops.row_conversion import (
+            PHASES as _rows_phases,
+        )
+
         rows_col = convert_to_rows_fixed_width_optimized(_cols())[0]
         dtypes = [INT64, INT32, FLOAT64]
         dt = _time(
             lambda: convert_from_rows_fixed_width_optimized(rows_col, dtypes),
             max(iters // 4, 3))
+        _rows_phases.reset()
+        convert_from_rows_fixed_width_optimized(rows_col, dtypes)
+        phases = {k: round(v, 3)
+                  for k, v in _rows_phases.snapshot().items()}
         return {
             "Mrows_per_s": round(nr / dt / 1e6, 2),
             "roofline_frac": _frac((nr / dt) * 2 * row_bytes),
+            "phases_s": phases,
         }
 
     _stage(detail, "rows_to", _rows_to, nbytes=nr * row_bytes * 3)

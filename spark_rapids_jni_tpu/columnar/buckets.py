@@ -40,6 +40,8 @@ __all__ = [
     "map_buckets",
     "strings_from_buckets",
     "count_subbuckets",
+    "class_buckets",
+    "map_classes",
 ]
 
 # Narrowest bucket: one VPU lane register row.  Strings shorter than this
@@ -153,6 +155,71 @@ def count_subbuckets(
             out.append((np.sort(np.concatenate(pend)), int(w)))
             pend, pend_n = [], 0
     return out
+
+
+def class_buckets(
+    classes: np.ndarray,
+    n_classes: int,
+    round_rows: bool = True,
+) -> List[Tuple[int, np.ndarray, int]]:
+    """Group row indices by an arbitrary small class id (round 20).
+
+    The *value-class* axis of :func:`length_buckets`: where length
+    bucketing bounds how much a row's padded width costs, class
+    bucketing bounds how much *algorithm* a row pays — e.g.
+    float_to_string splits specials / simple integers / full-Ryu
+    residue so the 22-iteration shortest-search runs only on rows that
+    need it.  Same padding discipline as length_buckets: returns
+    ``[(class_id, rows, n_valid), ...]`` with ``rows`` int32 padded up
+    to a power-of-two count by repeating the last real index; empty
+    classes are omitted.
+    """
+    classes = np.asarray(classes)
+    out: List[Tuple[int, np.ndarray, int]] = []
+    for cid in range(n_classes):
+        rows_np = np.nonzero(classes == cid)[0].astype(np.int32)
+        n_valid = len(rows_np)
+        if n_valid == 0:
+            continue
+        n_rows = next_pow2(n_valid) if round_rows else n_valid
+        if n_rows > n_valid:
+            rows_np = np.concatenate(
+                [rows_np, np.full(n_rows - n_valid, rows_np[-1], np.int32)]
+            )
+        out.append((cid, rows_np, n_valid))
+    return out
+
+
+def map_classes(
+    classes: np.ndarray,
+    n_classes: int,
+    kernel: Callable,
+    out_init: Sequence[Tuple[tuple, jnp.dtype]],
+    *,
+    row_args: Sequence[jnp.ndarray] = (),
+):
+    """Run ``kernel(class_id, *row_args_for_class)`` per value class and
+    scatter each output back into full-size arrays.
+
+    The class-axis companion of :func:`map_buckets`: ``classes`` is a host
+    [n] array of small ids (bucket assignment is host metadata, exactly
+    like the offsets sync length bucketing makes), ``kernel`` returns a
+    tuple matching ``out_init`` with the class's pow2-padded row count as
+    leading dim, and the pow2-padding tail is dropped on scatter.
+    """
+    n = len(np.asarray(classes))
+    outs = [jnp.zeros((n,) + tuple(shape), dtype=dt) for shape, dt in out_init]
+    for cid, rows_np, n_valid in class_buckets(classes, n_classes):
+        rows = jnp.asarray(rows_np)
+        extra = [a[rows] for a in row_args]
+        res = kernel(cid, *extra)
+        if not isinstance(res, (tuple, list)):
+            res = (res,)
+        mask = jnp.arange(len(rows_np), dtype=jnp.int32) < n_valid
+        tgt = jnp.where(mask, rows, jnp.int32(n))
+        for i, r in enumerate(res):
+            outs[i] = outs[i].at[tgt].set(r, mode="drop")
+    return tuple(outs)
 
 
 def padded_buckets(

@@ -188,6 +188,42 @@ register("json_overlap_bytes", 64 << 20,
          "before any scalar sync, so one tunnel round-trip serves the "
          "group. 1 = serial per-bucket syncs.",
          env="SRT_JSON_OVERLAP_BYTES")
+register("float_device_render", "auto",
+         "Backend arm of ops/float_to_string.py: True = device Ryu "
+         "(the Spark-parity oracle machinery), False = the # twin: "
+         "numpy host renderer, 'auto' (default) picks by backend — "
+         "device rendering on an accelerator, the compacted host twin "
+         "on XLA:CPU (the json_device_render pattern, round 20).",
+         env="SRT_FLOAT_DEVICE_RENDER", parser=_parse_device_render)
+register("float_bucketed", True,
+         "Value-class bucketing in float_to_string (round 20): split "
+         "the column into specials / simple-integer / full-Ryu classes "
+         "(columnar/buckets.class_buckets) so the 22-iteration masked "
+         "shortest-search and 128-bit limb machinery run only on the "
+         "residue bucket, with strength-reduced one-gather emission. "
+         "Off = the monolithic whole-column oracle path.",
+         env="SRT_FLOAT_BUCKETED")
+register("cast_device_parse", "auto",
+         "Backend arm of ops/cast_string_to_float.py: True = device "
+         "lane scan + softfloat assemble (the Spark-parity oracle), "
+         "False = the twin-pinned numpy host scan + the hardware-float "
+         "_assemble oracle promoted to fast path, 'auto' (default) "
+         "picks by backend like json_device_render (round 20).",
+         env="SRT_CAST_DEVICE_PARSE", parser=_parse_device_render)
+register("rows_device_path", "auto",
+         "Backend arm of ops/row_conversion.py's cached-permutation "
+         "fast path: True = device fused gather, False = the twin-"
+         "pinned numpy host transpose, 'auto' (default) picks by backend "
+         "(round 20).", env="SRT_ROWS_DEVICE_PATH",
+         parser=_parse_device_render)
+register("rows_plan_cache", True,
+         "Cached byte-permutation row<->column plans (round 20): "
+         "precompute the (src,dst) byte permutation of the fixed "
+         "section ONCE per schema, key it in the process-global plan "
+         "cache on (schema signature, pow2 row bucket), and run each "
+         "direction as one fused gather plus the ragged string pass. "
+         "Off = the per-column Python-loop oracle paths.",
+         env="SRT_ROWS_PLAN_CACHE")
 register("hash_backend", "auto",
          "Backend for murmur3/xxhash64 column contributions: 'xla' "
          "(fused elementwise ops), 'pallas' (VMEM-blocked kernels, "

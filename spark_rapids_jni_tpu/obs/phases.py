@@ -1,0 +1,50 @@
+"""Per-op pipeline phase timing (the get_json_object tokenize/evaluate/
+render split, round 3, generalized).
+
+A hot kernel that regresses as one opaque number is hard to attribute;
+the bench snapshots therefore carry a ``phases_s`` dict per stage so a
+regression points at a pipeline phase (bucket / parse / emit, index-build
+/ gather), not just the total.  Ops instantiate one module-level
+:class:`PhaseTimes` and wrap their phases; bench.py resets, runs one
+instrumented call, and snapshots.
+
+Timings are host wall clock around the dispatch: on the host-twin arms
+they are the real phase cost; on device arms they measure enqueue +
+any host sync the phase performs (documented in docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict
+
+__all__ = ["PhaseTimes"]
+
+
+class PhaseTimes:
+    """Accumulating named phase timers (thread-safe, reset per measurement)."""
+
+    def __init__(self, *keys: str):
+        self._lock = threading.Lock()
+        self._times: Dict[str, float] = {k: 0.0 for k in keys}  # guarded-by: _lock
+
+    def reset(self) -> None:
+        with self._lock:
+            for k in self._times:
+                self._times[k] = 0.0
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._times)
+
+    @contextlib.contextmanager
+    def phase(self, key: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._times[key] = self._times.get(key, 0.0) + dt

@@ -45,6 +45,7 @@ MAX_LEN = 48  # sign + 39 digits + '.' + 'E' + sign + 3 exp digits
 # 10^k for k in [0, 39] as (hi, lo) u64 pairs (10^39 > 2^127, clamp at 39)
 _P10_HI = np.array([(10**k >> 64) & ((1 << 64) - 1) for k in range(40)], np.uint64)
 _P10_LO = np.array([10**k & ((1 << 64) - 1) for k in range(40)], np.uint64)
+_P10_SMALL = np.array([1, 10, 100], np.int32)  # exponent digit divisors
 
 
 def _digits_1919(h19, l19):
@@ -117,9 +118,11 @@ def decimal_to_string(col) -> StringColumn:
     K = jnp.where(plain, _I32(ss), nd - 1)  # fraction width
 
     # split |v| at 10^K: integer part and zero-padded fraction
+    # (reuse the tables uploaded above: eager callers pay each jnp.asarray
+    # as a fresh host->device constant transfer — round 20 audit)
     limbs = int256.from_i128(ahi.astype(jnp.int64), alo)
-    d_hi = jnp.asarray(_P10_HI)[jnp.clip(K, 0, 39)]
-    d_lo = jnp.asarray(_P10_LO)[jnp.clip(K, 0, 39)]
+    d_hi = p10_hi[jnp.clip(K, 0, 39)]
+    d_lo = p10_lo[jnp.clip(K, 0, 39)]
     q, r_hi, r_lo = int256.divide_unsigned(limbs, d_hi, d_lo)
     q_hi, q_lo = int256.to_i128(q)
     ih19, il19 = _split_1919(q_hi.astype(_U64), q_lo)
@@ -152,7 +155,7 @@ def decimal_to_string(col) -> StringColumn:
     pE = dot_pos + jnp.where(has_dot, 1 + K, 0)[:, None]
     exp_t = p - (pE + 2)
     elenC = elen[:, None]
-    p10_small = jnp.asarray(np.array([1, 10, 100], np.int32))
+    p10_small = jnp.asarray(_P10_SMALL)
     exp_digit = (
         (eabs[:, None] // p10_small[jnp.clip(elenC - 1 - exp_t, 0, 2)]) % 10
     ).astype(jnp.uint8) + jnp.uint8(ord("0"))

@@ -24,27 +24,55 @@ matrix (fixed part) plus one ragged scatter/gather for string chars — shapes
 are static per schema, so XLA fuses the whole conversion into a few kernels.
 Values are exploded to little-endian bytes with shifts, never 64-bit bitcasts
 (unimplemented in the TPU x64 rewrite).
+
+Round 20 (straggler kill): the (src, dst) byte permutation between the
+column byte lanes and the row layout depends only on the schema, so it is
+computed once per schema and cached in the process-global plan cache keyed
+on (schema signature, pow2 row bucket).  Execution is then a single fused
+permutation gather over the lane matrix (plus the one ragged string pass),
+on either arm:
+
+- host arm (CPU backend, default there): numpy byte *views* of the column
+  buffers — no shift-exploding — permuted in one fancy-index op;
+- device arm: the per-column ``.at[].set`` scatter chain collapses to one
+  ``jnp.take`` along the cached permutation.
+
+The pre-round-20 per-column scatter/gather chain is retained verbatim as
+the parity oracle behind ``rows_plan_cache=False``; arm selection follows
+``rows_device_path`` ("auto" == device iff the default backend is not CPU).
 """
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
+from spark_rapids_jni_tpu import config
 from spark_rapids_jni_tpu.columnar.column import (
     Column,
     Decimal128Column,
     ListColumn,
     StringColumn,
+    next_pow2,
     strings_from_padded,
 )
 from spark_rapids_jni_tpu.columnar.dtypes import DType, Kind, UINT8
+from spark_rapids_jni_tpu.obs.phases import PhaseTimes
+from spark_rapids_jni_tpu.plans.cache import CompiledPlan, plan_cache
 from spark_rapids_jni_tpu.utils.floatbits import bits_to_f32, f32_to_bits
 
 JCUDF_ROW_ALIGNMENT = 8
 MAX_BATCH_SIZE = (1 << 31) - 1
+
+# Sub-timings across both directions: plan (permutation lookup/build),
+# lanes (byte-lane construction / decode), gather (the fused permutation),
+# emit (batch split + ragged string pass).  Host arm: wall-clock host work;
+# device arm: dispatch time only (XLA is async).
+PHASES = PhaseTimes("plan", "lanes", "gather", "emit")
 
 
 def _round_up(x: int, align: int) -> int:
@@ -71,6 +99,155 @@ def compute_layout(dtypes: Sequence[DType]):
     validity_offset = at
     size_per_row = at + (len(dtypes) + 7) // 8
     return starts, sizes, validity_offset, size_per_row
+
+
+# ---------------------------------------------------------------------------
+# cached byte-permutation plans (round 20)
+# ---------------------------------------------------------------------------
+
+
+def _rows_device_enabled() -> bool:
+    v = config.get("rows_device_path")
+    if v == "auto":
+        return jax.default_backend() != "cpu"
+    return bool(v)
+
+
+def _row_plan_sig(dtypes: Sequence[DType]):
+    """Layout-determining schema signature: byte width per column, -1 for
+    the variable-width (string) pair slot."""
+    return tuple(
+        -1 if dt.kind == Kind.STRING else dt.fixed_width for dt in dtypes
+    )
+
+
+def _build_row_plan(sig) -> dict:
+    """Precompute the lane->row byte permutation for one schema.
+
+    The lane matrix is the per-column little-endian value bytes concatenated
+    in column order (a string column contributes its 8 pair bytes), followed
+    by the validity bytes.  ``perm[j]`` is the lane feeding row byte ``j``;
+    ``keep[j]`` is 0 on alignment gaps and row padding (forced to zero, so
+    gap bytes match the reference's zero-filled rows bit-exactly).
+    """
+    starts, sizes = [], []
+    at = 0
+    for w in sig:
+        size, align = (8, 4) if w < 0 else (w, w)
+        at = _round_up(at, align)
+        starts.append(at)
+        sizes.append(size)
+        at += size
+    validity_offset = at
+    nbytes = (len(sig) + 7) // 8
+    size_per_row = validity_offset + nbytes
+    fixed_row = _round_up(size_per_row, JCUDF_ROW_ALIGNMENT)
+    perm = np.zeros((fixed_row,), np.int64)
+    keep = np.zeros((fixed_row,), np.uint8)
+    lane = 0
+    for start, size in zip(starts, sizes):
+        perm[start : start + size] = np.arange(lane, lane + size)
+        keep[start : start + size] = 1
+        lane += size
+    perm[validity_offset:size_per_row] = np.arange(lane, lane + nbytes)
+    keep[validity_offset:size_per_row] = 1
+    lane += nbytes
+    return {
+        "starts": starts,
+        "sizes": sizes,
+        "validity_offset": validity_offset,
+        "size_per_row": size_per_row,
+        "fixed_row": fixed_row,
+        "lane_width": lane,
+        "perm": perm,
+        "keep": keep,
+        "perm_dev": jnp.asarray(perm),
+        "keep_dev": jnp.asarray(keep),
+    }
+
+
+def _get_row_plan(dtypes: Sequence[DType], n: int) -> dict:
+    sig = _row_plan_sig(dtypes)
+    key = (("rows_perm", sig), next_pow2(max(int(n), 1)))
+
+    def build() -> CompiledPlan:
+        t0 = time.perf_counter()
+        plan = _build_row_plan(sig)
+        return CompiledPlan(
+            fn=plan["perm"],
+            plan=plan,
+            mesh=None,
+            signature=key,
+            out_names=("fixed",),
+            arg_names=("lanes",),
+            aot=False,
+            trace_s=time.perf_counter() - t0,
+            compile_s=0.0,
+        )
+
+    return plan_cache.get_or_compile(key, build).plan
+
+
+# twin: rows_fixed_gather
+def _gather_fixed(lanes, perm, keep):
+    fixed = jnp.take(lanes, perm, axis=1) * keep
+    return fixed
+
+
+# twin: rows_fixed_gather
+def _gather_fixed_np(lanes, perm, keep):
+    fixed = np.take(lanes, perm, axis=1) * keep
+    return fixed
+
+
+def _np_col_lanes(col) -> np.ndarray:
+    """[n, w] little-endian value bytes of a fixed-width column, via numpy
+    buffer views (host mirror of :func:`_col_le_bytes`; bit-exact because
+    the platform is little-endian and FLOAT64 data already carries bits)."""
+    n = col.size
+    if isinstance(col, Decimal128Column):
+        lo = np.ascontiguousarray(np.asarray(col.lo)).astype(np.uint64)
+        hi = np.ascontiguousarray(np.asarray(col.hi)).astype(np.int64)
+        return np.concatenate(
+            [lo.view(np.uint8).reshape(n, 8), hi.view(np.uint8).reshape(n, 8)],
+            axis=1,
+        )
+    kind = col.dtype.kind
+    w = col.dtype.fixed_width
+    if kind == Kind.FLOAT32:
+        v = np.ascontiguousarray(np.asarray(col.data).astype(np.float32))
+        return v.view(np.uint8).reshape(n, 4)
+    if kind == Kind.BOOL:
+        return np.asarray(col.data).astype(np.uint8).reshape(n, 1)
+    v = np.asarray(col.data)
+    if v.dtype != np.int64 or not v.flags["C_CONTIGUOUS"]:
+        v = np.ascontiguousarray(v.astype(np.int64))
+    return v.view(np.uint8).reshape(n, 8)[:, :w]
+
+
+def _np_bytes_to_col(raw: np.ndarray, dt: DType, validity):
+    """[n, w] contiguous little-endian bytes -> column (host mirror of
+    :func:`_bytes_to_col` via numpy views; same sign-extension results)."""
+    if dt.kind == Kind.DECIMAL128:
+        lo = raw.view(np.uint64)[:, 0]
+        hi = raw.view(np.int64)[:, 1]
+        return Decimal128Column(jnp.asarray(hi), jnp.asarray(lo), validity, dt)
+    w = dt.fixed_width
+    if dt.kind == Kind.BOOL:
+        data = raw[:, 0] != 0
+    elif dt.kind == Kind.FLOAT32:
+        data = raw.view(np.float32)[:, 0]
+    elif dt.kind == Kind.FLOAT64:
+        data = raw.view(np.int64)[:, 0]  # bit pattern carried as int64
+    else:
+        signed = raw.view(np.dtype("<i%d" % w))[:, 0]
+        data = signed.astype(np.dtype(dt.jnp_dtype))
+    return Column(jnp.asarray(data), validity, dt)
+
+
+# ---------------------------------------------------------------------------
+# device byte codecs (shared by the oracle and the device fast arm)
+# ---------------------------------------------------------------------------
 
 
 def _col_le_bytes(col) -> jnp.ndarray:
@@ -169,12 +346,173 @@ def _batch_boundaries(row_sizes: np.ndarray, max_batch_bytes: int) -> List[int]:
     return bounds
 
 
+# ---------------------------------------------------------------------------
+# host fast arm
+# ---------------------------------------------------------------------------
+
+
+def _ragged_char_indices(base: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    """Flat char positions for per-row runs starting at ``base`` with the
+    given lengths: repeat each base over its run and add a per-run ramp."""
+    total = int(lens.sum())
+    out = np.repeat(base, lens)
+    out += np.arange(total, dtype=np.int64) - np.repeat(np.cumsum(lens) - lens, lens)
+    return out
+
+
+def _convert_to_rows_host(
+    columns: Sequence, max_batch_bytes: int
+) -> List[ListColumn]:
+    n = columns[0].size
+    dtypes = [c.dtype for c in columns]
+    with PHASES.phase("plan"):
+        plan = _get_row_plan(dtypes, n)
+    size_per_row = plan["size_per_row"]
+    fixed_row = plan["fixed_row"]
+    string_cols = [c for c in columns if c.dtype.kind == Kind.STRING]
+
+    with PHASES.phase("lanes"):
+        lanes_list: List[np.ndarray] = []
+        str_lens: List[np.ndarray] = []
+        str_starts: List[np.ndarray] = []
+        within = (
+            np.full((n,), size_per_row, dtype=np.int64) if string_cols else None
+        )
+        for col in columns:
+            if col.dtype.kind == Kind.STRING:
+                lens = np.asarray(col.lengths()).astype(np.int64)
+                str_lens.append(lens)
+                str_starts.append(within)
+                pair = np.empty((n, 2), np.uint32)
+                pair[:, 0] = within
+                pair[:, 1] = lens
+                lanes_list.append(pair.view(np.uint8))
+                within = within + lens
+            else:
+                lanes_list.append(_np_col_lanes(col))
+        vbytes = np.zeros((n, (len(columns) + 7) // 8), np.uint8)
+        for c, col in enumerate(columns):
+            valid = np.asarray(col.is_valid()).astype(np.uint8)
+            vbytes[:, c // 8] |= valid << np.uint8(c % 8)
+        lanes = np.concatenate(lanes_list + [vbytes], axis=1)
+
+    with PHASES.phase("gather"):
+        fixed = _gather_fixed_np(lanes, plan["perm"], plan["keep"])
+
+    with PHASES.phase("emit"):
+        if string_cols:
+            row_sizes = size_per_row + sum(str_lens)
+            a = JCUDF_ROW_ALIGNMENT
+            row_sizes = (row_sizes + (a - 1)) // a * a
+        else:
+            row_sizes = np.full((n,), fixed_row, dtype=np.int64)
+        bounds = _batch_boundaries(row_sizes, max_batch_bytes)
+        cum_sizes = np.concatenate([[0], np.cumsum(row_sizes)])
+        chars_np = [np.asarray(c.chars) for c in string_cols]
+        soffs_np = [np.asarray(c.offsets) for c in string_cols]
+        out: List[ListColumn] = []
+        for b0, b1 in zip(bounds[:-1], bounds[1:]):
+            offsets_np = (cum_sizes[b0 : b1 + 1] - cum_sizes[b0]).astype(np.int32)
+            total = int(offsets_np[-1])
+            if not string_cols:
+                # uniform fixed_row rows: the permuted matrix IS the batch
+                flat = np.ascontiguousarray(fixed[b0:b1]).reshape(-1)
+            else:
+                row_off = offsets_np[:-1].astype(np.int64)
+                flat = np.zeros((total,), np.uint8)
+                pos = row_off[:, None] + np.arange(size_per_row, dtype=np.int64)
+                flat[pos] = fixed[b0:b1, :size_per_row]
+                for lens, sstart, chars, soffs in zip(
+                    str_lens, str_starts, chars_np, soffs_np
+                ):
+                    lsub = lens[b0:b1]
+                    tot = int(lsub.sum())
+                    if not tot:
+                        continue
+                    idx = _ragged_char_indices(row_off + sstart[b0:b1], lsub)
+                    c0 = int(soffs[b0])
+                    flat[idx] = chars[c0 : c0 + tot]
+            out.append(
+                ListColumn(
+                    jnp.asarray(offsets_np),
+                    Column(jnp.asarray(flat), None, UINT8),
+                    None,
+                )
+            )
+        return out
+
+
+def _convert_from_rows_host(rows: ListColumn, dtypes: Sequence[DType]) -> List:
+    n = rows.size
+    with PHASES.phase("plan"):
+        plan = _get_row_plan(dtypes, n)
+    starts, sizes = plan["starts"], plan["sizes"]
+    validity_offset = plan["validity_offset"]
+    size_per_row = plan["size_per_row"]
+    fixed_row = plan["fixed_row"]
+    flat = np.asarray(rows.child.data)
+    offs = np.asarray(rows.offsets).astype(np.int64)
+    row_off = offs[:-1]
+
+    with PHASES.phase("gather"):
+        if flat.size == n * fixed_row and bool(
+            (offs == np.arange(n + 1, dtype=np.int64) * fixed_row).all()
+        ):
+            # uniform rows (fixed-width-only batch): a reshape view, no copy
+            fixed = flat.reshape(n, fixed_row)
+        else:
+            pos = row_off[:, None] + np.arange(size_per_row, dtype=np.int64)
+            fixed = flat[np.minimum(pos, max(flat.size - 1, 0))]
+
+    out: List = []
+    with PHASES.phase("lanes"):
+        for c, (dt, start, size) in enumerate(zip(dtypes, starts, sizes)):
+            vb = fixed[:, validity_offset + c // 8]
+            validity = jnp.asarray(((vb >> np.uint8(c % 8)) & np.uint8(1)) == 1)
+            if dt.kind == Kind.STRING:
+                pr = np.ascontiguousarray(fixed[:, start : start + 8]).view(
+                    np.uint32
+                )
+                soff = pr[:, 0].astype(np.int64)
+                slen = pr[:, 1].astype(np.int64)
+                tot = int(slen.sum())
+                if tot:
+                    idx = _ragged_char_indices(row_off + soff, slen)
+                    chars = flat[np.minimum(idx, flat.size - 1)]
+                else:
+                    chars = np.zeros((0,), np.uint8)
+                soffsets = np.zeros((n + 1,), np.int32)
+                soffsets[1:] = np.cumsum(slen)
+                out.append(
+                    StringColumn(
+                        jnp.asarray(chars), jnp.asarray(soffsets), validity
+                    )
+                )
+            else:
+                raw = np.ascontiguousarray(fixed[:, start : start + size])
+                out.append(_np_bytes_to_col(raw, dt, validity))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# device arm (cached single-gather fast path + pre-round-20 oracle)
+# ---------------------------------------------------------------------------
+
+
 def convert_to_rows(
     columns: Sequence, max_batch_bytes: int = MAX_BATCH_SIZE
 ) -> List[ListColumn]:
     """Table -> list of LIST<UINT8> batches in JCUDF row format."""
     if not columns:
         raise ValueError("The input table must have at least one column.")
+    if bool(config.get("rows_plan_cache")) and not _rows_device_enabled():
+        return _convert_to_rows_host(columns, max_batch_bytes)
+    return _convert_to_rows_device(columns, max_batch_bytes)
+
+
+def _convert_to_rows_device(
+    columns: Sequence, max_batch_bytes: int
+) -> List[ListColumn]:
     n = columns[0].size
     dtypes = [c.dtype for c in columns]
     starts, sizes, validity_offset, size_per_row = compute_layout(dtypes)
@@ -188,63 +526,109 @@ def convert_to_rows(
         row_sizes = np.full((n,), fixed_row, dtype=np.int64)
 
     # ---- fixed-width section as a dense [n, size_per_row] matrix ----
-    # analyze: ignore[governed-allocation] - same ungoverned row-
-    # codec debt as _validity_bytes (tracked at the site, round 16)
-    fixed = jnp.zeros((n, size_per_row), jnp.uint8)
-    # analyze: ignore[governed-allocation] - same row-codec debt
-    within_row = jnp.full((n,), size_per_row, jnp.int64) if string_cols else None
-    str_starts = []  # per string col: within-row char start offsets
-    for col, start, size in zip(columns, starts, sizes):
-        if col.dtype.kind == Kind.STRING:
-            lens = col.lengths().astype(jnp.int64)
-            str_starts.append(within_row)
-            pair = jnp.stack(
-                [within_row.astype(jnp.uint32), lens.astype(jnp.uint32)], axis=1
-            )
-            pair_bytes = jnp.stack(
-                [(pair[:, i // 4] >> jnp.uint32(8 * (i % 4))).astype(jnp.uint8) for i in range(8)],
-                axis=1,
-            )
-            fixed = fixed.at[:, start : start + 8].set(pair_bytes)
-            within_row = within_row + lens
-        else:
-            fixed = fixed.at[:, start : start + size].set(_col_le_bytes(col))
-    fixed = fixed.at[:, validity_offset:size_per_row].set(_validity_bytes(columns))
+    if bool(config.get("rows_plan_cache")):
+        # round 20: one fused permutation gather over the lane matrix
+        with PHASES.phase("plan"):
+            plan = _get_row_plan(dtypes, n)
+        with PHASES.phase("lanes"):
+            str_starts = []
+            if string_cols:
+                # exclusive running char offset per string column: spr + the
+                # cumulative lengths of the preceding string columns
+                run = jnp.cumsum(jnp.stack(str_lens, axis=0), axis=0)
+                str_starts = [
+                    run[i] - str_lens[i] + size_per_row
+                    for i in range(len(string_cols))
+                ]
+            lanes_list = []
+            si = 0
+            for col in columns:
+                if col.dtype.kind == Kind.STRING:
+                    pair = jnp.stack(
+                        [
+                            str_starts[si].astype(jnp.uint32),
+                            str_lens[si].astype(jnp.uint32),
+                        ],
+                        axis=1,
+                    )
+                    lanes_list.append(
+                        jnp.stack(
+                            [
+                                (pair[:, i // 4] >> jnp.uint32(8 * (i % 4))).astype(jnp.uint8)
+                                for i in range(8)
+                            ],
+                            axis=1,
+                        )
+                    )
+                    si += 1
+                else:
+                    lanes_list.append(_col_le_bytes(col))
+            lanes = jnp.concatenate(lanes_list + [_validity_bytes(columns)], axis=1)
+        with PHASES.phase("gather"):
+            fixed = _gather_fixed(
+                lanes, plan["perm_dev"], plan["keep_dev"]
+            )[:, :size_per_row]
+    else:
+        # oracle: per-column scatter chain (pre-round-20 byte path)
+        # analyze: ignore[governed-allocation] - same ungoverned row-
+        # codec debt as _validity_bytes (tracked at the site, round 16)
+        fixed = jnp.zeros((n, size_per_row), jnp.uint8)
+        # analyze: ignore[governed-allocation] - same row-codec debt
+        within_row = jnp.full((n,), size_per_row, jnp.int64) if string_cols else None
+        str_starts = []  # per string col: within-row char start offsets
+        for col, start, size in zip(columns, starts, sizes):
+            if col.dtype.kind == Kind.STRING:
+                lens = col.lengths().astype(jnp.int64)
+                str_starts.append(within_row)
+                pair = jnp.stack(
+                    [within_row.astype(jnp.uint32), lens.astype(jnp.uint32)], axis=1
+                )
+                pair_bytes = jnp.stack(
+                    [(pair[:, i // 4] >> jnp.uint32(8 * (i % 4)))
+                     .astype(jnp.uint8) for i in range(8)],
+                    axis=1,
+                )
+                fixed = fixed.at[:, start : start + 8].set(pair_bytes)
+                within_row = within_row + lens
+            else:
+                fixed = fixed.at[:, start : start + size].set(_col_le_bytes(col))
+        fixed = fixed.at[:, validity_offset:size_per_row].set(_validity_bytes(columns))
 
     # ---- emit batches ----
-    bounds = _batch_boundaries(row_sizes, max_batch_bytes)
-    str_lens_np = [np.asarray(c.lengths()) for c in string_cols]
-    out: List[ListColumn] = []
-    cum_sizes = np.concatenate([[0], np.cumsum(row_sizes)])
-    for b0, b1 in zip(bounds[:-1], bounds[1:]):
-        offsets_np = (cum_sizes[b0 : b1 + 1] - cum_sizes[b0]).astype(np.int32)
-        total = int(offsets_np[-1])
-        row_off = jnp.asarray(offsets_np[:-1].astype(np.int64))
-        # analyze: ignore[governed-allocation] - same row-codec debt
-        flat = jnp.zeros((max(total, 1),), jnp.uint8)
-        # scatter the fixed sections
-        pos = row_off[:, None] + jnp.arange(size_per_row, dtype=jnp.int64)[None, :]
-        flat = flat.at[pos].set(fixed[b0:b1], mode="drop")
-        # scatter string chars (column order); pad per batch so one long
-        # string elsewhere in the table doesn't inflate this batch's tile
-        for scol, lens_np, sstart in zip(string_cols, str_lens_np, str_starts):
-            batch_max = max(int(lens_np[b0:b1].max()) if b1 > b0 else 0, 1)
-            sub = StringColumn(
-                scol.chars,
-                scol.offsets[b0 : b1 + 1],
-                None,
+    with PHASES.phase("emit"):
+        bounds = _batch_boundaries(row_sizes, max_batch_bytes)
+        str_lens_np = [np.asarray(c.lengths()) for c in string_cols]
+        out: List[ListColumn] = []
+        cum_sizes = np.concatenate([[0], np.cumsum(row_sizes)])
+        for b0, b1 in zip(bounds[:-1], bounds[1:]):
+            offsets_np = (cum_sizes[b0 : b1 + 1] - cum_sizes[b0]).astype(np.int32)
+            total = int(offsets_np[-1])
+            row_off = jnp.asarray(offsets_np[:-1].astype(np.int64))
+            # analyze: ignore[governed-allocation] - same row-codec debt
+            flat = jnp.zeros((max(total, 1),), jnp.uint8)
+            # scatter the fixed sections
+            pos = row_off[:, None] + jnp.arange(size_per_row, dtype=jnp.int64)[None, :]
+            flat = flat.at[pos].set(fixed[b0:b1], mode="drop")
+            # scatter string chars (column order); pad per batch so one long
+            # string elsewhere in the table doesn't inflate this batch's tile
+            for scol, lens_np, sstart in zip(string_cols, str_lens_np, str_starts):
+                batch_max = max(int(lens_np[b0:b1].max()) if b1 > b0 else 0, 1)
+                sub = StringColumn(
+                    scol.chars,
+                    scol.offsets[b0 : b1 + 1],
+                    None,
+                )
+                padded, lens = sub.padded(batch_max)
+                lane = jnp.arange(batch_max, dtype=jnp.int64)[None, :]
+                cpos = row_off[:, None] + sstart[b0:b1, None] + lane
+                in_bounds = lane < lens[:, None].astype(jnp.int64)
+                cpos = jnp.where(in_bounds, cpos, jnp.int64(total))
+                flat = flat.at[cpos].set(padded, mode="drop")
+            out.append(
+                ListColumn(
+                    jnp.asarray(offsets_np), Column(flat[:total], None, UINT8), None
+                )
             )
-            padded, lens = sub.padded(batch_max)
-            lane = jnp.arange(batch_max, dtype=jnp.int64)[None, :]
-            cpos = row_off[:, None] + sstart[b0:b1, None] + lane
-            in_bounds = lane < lens[:, None].astype(jnp.int64)
-            cpos = jnp.where(in_bounds, cpos, jnp.int64(total))
-            flat = flat.at[cpos].set(padded, mode="drop")
-        out.append(
-            ListColumn(
-                jnp.asarray(offsets_np), Column(flat[:total], None, UINT8), None
-            )
-        )
     return out
 
 
@@ -266,15 +650,35 @@ def convert_from_rows(
     rows: ListColumn, dtypes: Sequence[DType]
 ) -> List:
     """LIST<UINT8> batch in JCUDF format -> columns of ``dtypes``."""
+    if bool(config.get("rows_plan_cache")) and not _rows_device_enabled():
+        return _convert_from_rows_host(rows, dtypes)
+    return _convert_from_rows_device(rows, dtypes)
+
+
+def _convert_from_rows_device(rows: ListColumn, dtypes: Sequence[DType]) -> List:
     starts, sizes, validity_offset, size_per_row = compute_layout(dtypes)
     n = rows.size
     flat = rows.child.data
     row_off = rows.offsets.astype(jnp.int64)[:-1]
 
+    # round 20 (plan-cached): gather the whole fixed section once, then
+    # decode columns from contiguous slices of it.  Oracle: one clipped
+    # gather per column straight from the flat buffer.
+    fixed = None
+    if bool(config.get("rows_plan_cache")):
+        with PHASES.phase("plan"):
+            _get_row_plan(dtypes, n)  # warm/validate the cached layout
+        with PHASES.phase("gather"):
+            pos = row_off[:, None] + jnp.arange(size_per_row, dtype=jnp.int64)[None, :]
+            fixed = flat[jnp.clip(pos, 0, max(flat.shape[0] - 1, 0))]
+
     # validity bits for every column
     nbytes = (len(dtypes) + 7) // 8
-    vpos = row_off[:, None] + validity_offset + jnp.arange(nbytes, dtype=jnp.int64)[None, :]
-    vbytes = flat[jnp.clip(vpos, 0, max(flat.shape[0] - 1, 0))]
+    if fixed is not None:
+        vbytes = fixed[:, validity_offset : validity_offset + nbytes]
+    else:
+        vpos = row_off[:, None] + validity_offset + jnp.arange(nbytes, dtype=jnp.int64)[None, :]
+        vbytes = flat[jnp.clip(vpos, 0, max(flat.shape[0] - 1, 0))]
 
     out = []
     for c, (dt, start, size) in enumerate(zip(dtypes, starts, sizes)):
@@ -283,8 +687,11 @@ def convert_from_rows(
         # None would force a blocking device sync per column.
         validity: Optional[jnp.ndarray] = ((vb >> np.uint8(c % 8)) & jnp.uint8(1)) == 1
         if dt.kind == Kind.STRING:
-            ppos = row_off[:, None] + start + jnp.arange(8, dtype=jnp.int64)[None, :]
-            praw = flat[ppos].astype(jnp.uint32)
+            if fixed is not None:
+                praw = fixed[:, start : start + 8].astype(jnp.uint32)
+            else:
+                ppos = row_off[:, None] + start + jnp.arange(8, dtype=jnp.int64)[None, :]
+                praw = flat[ppos].astype(jnp.uint32)
             soff = sum(praw[:, k] << jnp.uint32(8 * k) for k in range(4)).astype(jnp.int64)
             slen = sum(praw[:, 4 + k] << jnp.uint32(8 * k) for k in range(4)).astype(jnp.int32)
             max_len = max(int(jnp.max(slen)) if n else 0, 1)
@@ -295,8 +702,11 @@ def convert_from_rows(
             padded = jnp.where(in_b, flat[cpos], jnp.uint8(0))
             out.append(strings_from_padded(padded, slen, validity))
         else:
-            pos = row_off[:, None] + start + jnp.arange(size, dtype=jnp.int64)[None, :]
-            raw = flat[jnp.clip(pos, 0, max(flat.shape[0] - 1, 0))]
+            if fixed is not None:
+                raw = fixed[:, start : start + size]
+            else:
+                pos = row_off[:, None] + start + jnp.arange(size, dtype=jnp.int64)[None, :]
+                raw = flat[jnp.clip(pos, 0, max(flat.shape[0] - 1, 0))]
             out.append(_bytes_to_col(raw, dt, validity))
     return out
 

@@ -227,6 +227,7 @@ def test_no_transfer_seam_crossings_during_device_cast():
     directly, not by this counter."""
     import jax
 
+    from spark_rapids_jni_tpu import config
     from spark_rapids_jni_tpu.columnar import FLOAT64, strings_column
     from spark_rapids_jni_tpu.obs import seam
 
@@ -234,7 +235,8 @@ def test_no_transfer_seam_crossings_during_device_cast():
     crossings = []
     seam._set_injector(lambda cat, name: crossings.append((cat, name)))
     try:
-        out = string_to_float(col, ansi_mode=False, dtype=FLOAT64)
+        with config.override(cast_device_parse=True):
+            out = string_to_float(col, ansi_mode=False, dtype=FLOAT64)
         jax.block_until_ready(out.data)
     finally:
         seam._set_injector(None)

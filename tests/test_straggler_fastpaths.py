@@ -1,0 +1,453 @@
+"""Three-arm fuzz parity for the round-20 straggler fast paths.
+
+Each straggler kernel (float->string, string->float, row conversion) now has
+a host-twin fast arm next to the original device implementation, with the
+pre-round-20 monolithic pipeline kept as the Spark-parity oracle.  These
+tests pin the contract that makes the dispatch safe: on any input — however
+adversarial — every arm produces the same logical result, bit-for-bit where
+the representation is bits (row bytes, FLOAT64 bit patterns).
+
+String chars buffers are compared *logically* (clipped to ``offsets[-1]``):
+``strings_from_padded`` leaves trailing zero padding in the device arm's
+chars buffer that carries no string content.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu import config
+from spark_rapids_jni_tpu.columnar import (
+    BOOL,
+    Column,
+    Decimal128Column,
+    FLOAT32,
+    FLOAT64,
+    INT8,
+    INT32,
+    INT64,
+    StringColumn,
+    decimal,
+    strings_column,
+    strings_from_bytes,
+)
+from spark_rapids_jni_tpu.columnar.dtypes import Kind
+from spark_rapids_jni_tpu.ops import (
+    convert_from_rows,
+    convert_to_rows,
+    float_to_string,
+    string_to_float,
+)
+
+
+# ---------------------------------------------------------------------------
+# corpora
+# ---------------------------------------------------------------------------
+
+def _f64_bits_corpus():
+    """Adversarial FLOAT64 bit patterns: subnormals, +-0, exponent edges,
+    17-digit round-trip values, random bits (incl. NaN payloads)."""
+    rng = np.random.RandomState(2020)
+    vals = [
+        0.0, -0.0, 1.0, -1.0, 0.5, 1.5,
+        1e-310, -1e-310, 5e-324, -5e-324, 2.2250738585072014e-308,
+        1e291, 1e-291, 9.999999999999999e290, 1.0000000000000002e-291,
+        1e308, 1.7976931348623157e308, -1e-308,
+        1e-3, 0.001, 0.0009999999999999998, 1e7, 9999999.0, 10000000.0,
+        0.1, 0.2, 0.30000000000000004, 1 / 3,
+        123456789012345.6, 1.2345678901234567e16,
+        float("inf"), float("-inf"), float("nan"),
+    ]
+    bits = np.array([np.float64(v) for v in vals]).view(np.int64)
+    extra = rng.randint(-(2 ** 63), 2 ** 63, size=2000, dtype=np.int64)
+    # force some subnormal / max-exponent neighborhoods
+    sub = rng.randint(0, 1 << 52, size=64, dtype=np.int64)  # exp field 0
+    top = (np.int64(0x7FE) << np.int64(52)) | rng.randint(
+        0, 1 << 52, size=64, dtype=np.int64)
+    return np.concatenate([bits, extra, sub, top, -sub, top | np.int64(-2**63)])
+
+
+def _s2f_text_corpus():
+    """Adversarial parse strings: truncation (19+ digits), exponent edges,
+    whitespace/control quirks, junk, empties, nulls."""
+    rng = np.random.RandomState(2021)
+    vals = [
+        "0", "-0", "0.0", "-0.0", "1", "-1", ".5", "5.", "+3",
+        "1e291", "-1e291", "1e-291", "1e292", "1e-292", "1e308", "-1e308",
+        "1e309", "1e-309", "1e-310", "4.9e-324", "1e-324", "1e-400", "1e400",
+        "17976931348623157e292",
+        "9999999999999999999", "18446744073709551609",
+        "18446744073709551610", "-18446744073709551609",
+        "184467440737095516091234", "0.01234567890123456789",
+        "0." + "0" * 30 + "123456789012345678901234",
+        "123456789012345678.99e-10",
+        "nan", "NaN", "-nan", "inf", "-inf", "Infinity", "-Infinity",
+        "+inf", " inf", "\riNf", "infinity7", "infx",
+        "7f", "8d", "0f", "0d", "0 ", "1.3e+7f", "46037e\t", "2F.",
+        "", ".", "e", "E15", "A", "null", "na7.62", "--1", "1..2", "1e",
+        "1e+", "1e-", "1.5e3e4", "0x1p3", " " * 36 + "7d",
+        "1.1\x00", "1.2\x14", "1.6\x9f", "1.7!",
+        None, None,
+    ]
+    for _ in range(600):
+        ndig = rng.randint(1, 26)
+        digs = "".join(rng.choice(list("0123456789"), ndig))
+        point = rng.randint(0, ndig + 1)
+        s = digs[:point] + "." + digs[point:] if rng.rand() < 0.6 else digs
+        if rng.rand() < 0.6:
+            s += "e" + str(rng.choice(["", "+", "-"])) + str(rng.randint(0, 330))
+        if rng.rand() < 0.5:
+            s = "-" + s
+        vals.append(s)
+    for _ in range(200):  # pure junk
+        vals.append("".join(rng.choice(list("0123456789.eE+-fdx \t\rZ"), 10)))
+    return vals
+
+
+# ---------------------------------------------------------------------------
+# float_to_string: host twin vs bucketed device vs monolithic oracle
+# ---------------------------------------------------------------------------
+
+def _logical_strings(col: StringColumn):
+    offs = np.asarray(col.offsets)
+    chars = np.asarray(col.chars)[: int(offs[-1])].tobytes()
+    return offs.tolist(), chars, np.asarray(col.is_valid()).tolist()
+
+
+def _f2s_arms(col):
+    out = {}
+    with config.override(float_device_render=False):
+        out["host"] = _logical_strings(float_to_string(col))
+    with config.override(float_device_render=True, float_bucketed=True):
+        out["device"] = _logical_strings(float_to_string(col))
+    with config.override(float_device_render=True, float_bucketed=False):
+        out["oracle"] = _logical_strings(float_to_string(col))
+    return out
+
+
+@pytest.mark.parametrize("kind", ["f64", "f32"])
+def test_float_to_string_three_arm_parity(kind):
+    bits = _f64_bits_corpus()
+    if kind == "f64":
+        col = Column(jnp.asarray(bits), None, FLOAT64)
+    else:
+        rng = np.random.RandomState(7)
+        b32 = np.concatenate([
+            bits.view(np.uint64).astype(np.uint32).view(np.int32),
+            rng.randint(-(2 ** 31), 2 ** 31, size=512).astype(np.int32),
+            np.array([0, -2**31, 1, 0x7F800000, -8388608, 0x00000001,
+                      0x007FFFFF, 0x7F7FFFFF], dtype=np.int32),
+        ])
+        col = Column(jnp.asarray(b32.view(np.float32)), None, FLOAT32)
+    arms = _f2s_arms(col)
+    for name in ("host", "device"):
+        assert arms[name] == arms["oracle"], name
+
+
+def test_float_to_string_null_dense_and_empty():
+    rng = np.random.RandomState(3)
+    bits = _f64_bits_corpus()[:512]
+    validity = jnp.asarray(rng.rand(bits.size) > 0.9)  # 90% null
+    col = Column(jnp.asarray(bits), validity, FLOAT64)
+    arms = _f2s_arms(col)
+    assert arms["host"] == arms["oracle"]
+    assert arms["device"] == arms["oracle"]
+    empty = Column(jnp.asarray(np.empty(0, np.int64)), None, FLOAT64)
+    arms = _f2s_arms(empty)
+    assert arms["host"][1] == b"" and arms["device"][1] == b""
+
+
+def test_float_to_string_bucket_boundary_equivalence():
+    """Values straddling every classifier boundary (simple-int cutoffs,
+    sci-notation switch at 1e-3/1e7, 16/17-digit shortest output) must not
+    depend on which bucket renders them."""
+    vals = []
+    for e in (-4, -3, -2, 6, 7, 8):
+        for v in (10.0 ** e,):
+            vals += [v, np.nextafter(v, 0), np.nextafter(v, np.inf), -v]
+    vals += [9999999.999999998, 1e16 - 2, 1e16, 1.5, 2.0, 1024.0,
+             0.001953125, 123.25, -8.0, 65536.0]
+    bits = np.array(vals, dtype=np.float64).view(np.int64)
+    col = Column(jnp.asarray(bits), None, FLOAT64)
+    arms = _f2s_arms(col)
+    assert arms["host"] == arms["oracle"]
+    assert arms["device"] == arms["oracle"]
+
+
+# ---------------------------------------------------------------------------
+# string_to_float: host twin vs device pipeline
+# ---------------------------------------------------------------------------
+
+def _s2f_arms(col, dtype):
+    out = {}
+    for name, dev in (("host", False), ("device", True)):
+        with config.override(cast_device_parse=dev):
+            c = string_to_float(col, ansi_mode=False, dtype=dtype)
+        data = np.asarray(c.data)
+        if dtype.kind == Kind.FLOAT32:
+            data = data.view(np.int32)  # compare f32 bit patterns
+        out[name] = (data, np.asarray(c.is_valid()))
+    return out
+
+
+@pytest.mark.parametrize("dtype", [FLOAT64, FLOAT32])
+def test_string_to_float_two_arm_parity(dtype):
+    vals = _s2f_text_corpus()
+    col = strings_column(vals)
+    arms = _s2f_arms(col, dtype)
+    h_data, h_valid = arms["host"]
+    d_data, d_valid = arms["device"]
+    assert (h_valid == d_valid).all()
+    # NaN payloads may differ between softfloat and hardware assembly
+    fdt = np.float32 if dtype.kind == Kind.FLOAT32 else np.float64
+    nan = np.isnan(h_data.view(fdt)) & np.isnan(d_data.view(fdt))
+    bad = (h_data != d_data) & ~nan & h_valid
+    assert not bad.any(), [
+        (vals[i], hex(int(h_data[i])), hex(int(d_data[i])))
+        for i in np.nonzero(bad)[0][:8]
+    ]
+
+
+def test_string_to_float_roundtrip_corpus_parity():
+    """Rendered shortest strings of adversarial doubles re-parse identically
+    on both arms (and exactly: Ryu shortest output has <=17 digits, inside
+    the parser's exact window for most values)."""
+    bits = _f64_bits_corpus()[:1024]
+    fcol = Column(jnp.asarray(bits), None, FLOAT64)
+    with config.override(float_device_render=False):
+        scol = float_to_string(fcol)
+    arms = _s2f_arms(scol, FLOAT64)
+    assert (arms["host"][1] == arms["device"][1]).all()
+    assert (arms["host"][0] == arms["device"][0]).all()
+
+
+def test_string_to_float_null_dense_zero_row_and_ansi():
+    from spark_rapids_jni_tpu.ops.cast_string import CastException
+
+    rng = np.random.RandomState(5)
+    vals = [v if rng.rand() > 0.9 else None for v in _s2f_text_corpus()[:200]]
+    arms = _s2f_arms(strings_column(vals), FLOAT64)
+    assert (arms["host"][1] == arms["device"][1]).all()
+    arms = _s2f_arms(strings_column([]), FLOAT64)
+    assert arms["host"][0].size == 0 and arms["device"][0].size == 0
+    # ANSI raise agrees on first bad row across arms
+    col = strings_column(["1.5", "A", "also-bad"])
+    rows = []
+    for dev in (False, True):
+        with config.override(cast_device_parse=dev):
+            with pytest.raises(CastException) as ei:
+                string_to_float(col, ansi_mode=True, dtype=FLOAT64)
+            rows.append(ei.value.row_with_error)
+    assert rows == [1, 1]
+
+
+def test_scan_bucket_boundary_equivalence():
+    """Strings whose lengths straddle the pow2 bucket widths must scan to
+    identical fields whether they go through the bucketed fast scan
+    (`_scan_np` -> `_scan_rect_np` per bucket) or one monolithic rectangle
+    (`_scan_rect_np` full-width), and both must match the pinned general
+    scan twin (`_scan_padded_np`)."""
+    from spark_rapids_jni_tpu.ops.cast_string_to_float import (
+        _SCAN_FIELDS_NP,
+        _scan_np,
+        _scan_padded_np,
+        _scan_rect_np,
+    )
+
+    rng = np.random.RandomState(11)
+    vals = []
+    for width in (1, 2, 3, 4, 7, 8, 9, 15, 16, 17, 31, 32, 33):
+        for _ in range(8):
+            digs = "".join(rng.choice(list("0123456789"), width))
+            vals.append(digs[: max(1, width)])
+            vals.append(("-" + digs)[:width] if width > 1 else digs)
+            if width > 4:
+                vals.append(digs[: width - 4] + "e" + str(rng.randint(0, 99)))
+    col = strings_column(vals)
+    bucketed = _scan_np(col)
+    offs = np.asarray(col.offsets)
+    lens = np.diff(offs).astype(np.int32)
+    width = int(lens.max())
+    chars = np.asarray(col.chars)
+    padded = np.zeros((len(vals), width), np.uint8)
+    for i in range(len(vals)):
+        padded[i, : lens[i]] = chars[offs[i]: offs[i + 1]]
+    mono = _scan_rect_np(padded, lens)
+    twin = _scan_padded_np(padded, lens)
+    for k, dt in _SCAN_FIELDS_NP.items():
+        assert (bucketed[k] == mono[k].astype(dt)).all(), k
+        assert (bucketed[k] == twin[k].astype(dt)).all(), k
+
+
+# ---------------------------------------------------------------------------
+# row conversion: host twin vs cached-device vs oracle scatter chain
+# ---------------------------------------------------------------------------
+
+_ARMS = (
+    ("host", dict(rows_device_path=False, rows_plan_cache=True)),
+    ("device", dict(rows_device_path=True, rows_plan_cache=True)),
+    ("oracle", dict(rows_device_path=True, rows_plan_cache=False)),
+)
+
+
+def _mixed_schema_columns(n, seed, null_p=0.25, with_strings=True):
+    rng = np.random.RandomState(seed)
+
+    def vmask():
+        return jnp.asarray(rng.rand(n) > null_p) if null_p else None
+
+    cols = [
+        Column(jnp.asarray(rng.randint(-(2 ** 62), 2 ** 62, n,
+                                       dtype=np.int64)), vmask(), INT64),
+        Column(jnp.asarray(rng.randint(-(2 ** 31), 2 ** 31, n)
+                           .astype(np.int32)), vmask(), INT32),
+        Column(jnp.asarray(_f64_bits_corpus()[:n] if n <= 2128 else
+                           rng.randint(-(2 ** 63), 2 ** 63, n,
+                                       dtype=np.int64)), vmask(), FLOAT64),
+        Column(jnp.asarray(rng.randint(-(2 ** 31), 2 ** 31, n)
+                           .astype(np.int32).view(np.float32)),
+               vmask(), FLOAT32),
+        Column(jnp.asarray(rng.rand(n) > 0.5), vmask(), BOOL),
+        Column(jnp.asarray(rng.randint(-128, 128, n).astype(np.int8)),
+               vmask(), INT8),
+        Decimal128Column(
+            jnp.asarray(rng.randint(-(2 ** 62), 2 ** 62, n, dtype=np.int64)),
+            jnp.asarray(rng.randint(0, 2 ** 63, n, dtype=np.int64)
+                        .astype(np.uint64)),
+            vmask(), decimal(38, 4)),
+    ]
+    if with_strings:
+        pool = ["", "x", "hello", "A" * 33, "\x00\xff".encode("latin1")
+                .decode("latin1"), "né", "0" * 7]
+        vals = [pool[rng.randint(len(pool))] if rng.rand() > null_p else None
+                for _ in range(n)]
+        cols.insert(3, strings_column(vals))
+    return cols
+
+
+def _rows_bytes(batches):
+    out = []
+    for b in batches:
+        offs = np.asarray(b.offsets)
+        data = np.asarray(b.child.data)[: int(offs[-1])]
+        out.append((offs.tolist(), data.tobytes()))
+    return out
+
+
+def _col_logical(c):
+    valid = np.asarray(c.is_valid())
+    if isinstance(c, StringColumn):
+        offs = np.asarray(c.offsets)
+        return ("str", offs.tolist(),
+                np.asarray(c.chars)[: int(offs[-1])].tobytes(), valid)
+    if isinstance(c, Decimal128Column):
+        return ("d128", np.asarray(c.hi), np.asarray(c.lo), valid)
+    data = np.asarray(c.data)
+    if data.dtype == np.float32:
+        data = data.view(np.int32)  # bit compare: NaN payloads preserved
+    elif data.dtype == np.float64:
+        data = data.view(np.int64)
+    return ("col", data, valid)
+
+
+def _cols_equal(a, b):
+    for x, y in zip(a, b):
+        lx, ly = _col_logical(x), _col_logical(y)
+        assert lx[0] == ly[0]
+        assert (lx[-1] == ly[-1]).all()
+        if lx[0] == "str":
+            assert lx[1] == ly[1]  # offsets
+            assert lx[2] == ly[2]  # logical chars
+            continue
+        m = lx[-1]  # only valid rows carry defined payloads
+        for px, py in zip(lx[1:-1], ly[1:-1]):
+            assert (px[m] == py[m]).all()
+
+
+@pytest.mark.parametrize("n,seed,null_p,batch", [
+    (257, 1, 0.25, 1 << 31),
+    (1024, 2, 0.9, 1 << 31),      # null-dense
+    (1, 3, 0.0, 1 << 31),
+    (640, 4, 0.25, 600),          # forces many small batches
+])
+def test_rows_three_arm_parity_mixed_schema(n, seed, null_p, batch):
+    cols = _mixed_schema_columns(n, seed, null_p)
+    dtypes = [c.dtype for c in cols]
+    got = {}
+    for name, flags in _ARMS:
+        with config.override(**flags):
+            batches = convert_to_rows(cols, max_batch_bytes=batch)
+            got[name] = _rows_bytes(batches)
+            got[name + "_back"] = [convert_from_rows(b, dtypes)
+                                   for b in batches]
+    # TO-rows: byte-identical across all three arms
+    assert got["host"] == got["oracle"]
+    assert got["device"] == got["oracle"]
+    # FROM-rows round-trip: each batch decodes to the original slice
+    for name, _ in _ARMS:
+        starts = [0]
+        for offs, _data in got["oracle"]:
+            starts.append(starts[-1] + len(offs) - 1)
+        for bi, chunk in enumerate(got[name + "_back"]):
+            b0, b1 = starts[bi], starts[bi + 1]
+            sliced = []
+            for c in cols:
+                if isinstance(c, StringColumn):
+                    offs = np.asarray(c.offsets)
+                    chars = np.asarray(c.chars)
+                    sub = [bytes(chars[offs[i]: offs[i + 1]])
+                           for i in range(b0, b1)]
+                    s = strings_from_bytes(sub)
+                    v = (c.validity[b0:b1]
+                         if c.validity is not None else None)
+                    sliced.append(StringColumn(s.chars, s.offsets, v))
+                elif isinstance(c, Decimal128Column):
+                    v = c.validity[b0:b1] if c.validity is not None else None
+                    sliced.append(Decimal128Column(
+                        c.hi[b0:b1], c.lo[b0:b1], v, c.dtype))
+                else:
+                    v = c.validity[b0:b1] if c.validity is not None else None
+                    sliced.append(Column(c.data[b0:b1], v, c.dtype))
+            _cols_equal(chunk, sliced)
+
+
+def test_rows_validity_edge_bits_19_columns():
+    """19 columns -> 3 validity bytes; bit 7/8 boundaries must land in the
+    right byte on every arm."""
+    n = 97
+    rng = np.random.RandomState(9)
+    cols = [Column(jnp.asarray(rng.randint(-100, 100, n).astype(np.int8)),
+                   jnp.asarray((np.arange(n) + k) % (k + 2) != 0), INT8)
+            for k in range(19)]
+    got = {}
+    for name, flags in _ARMS:
+        with config.override(**flags):
+            got[name] = _rows_bytes(convert_to_rows(cols))
+    assert got["host"] == got["oracle"]
+    assert got["device"] == got["oracle"]
+
+
+def test_rows_zero_row_columns():
+    for name, flags in _ARMS:
+        with config.override(**flags):
+            out = convert_to_rows(
+                [Column(jnp.asarray(np.empty(0, np.int64)), None, INT64)])
+            assert out == [] or _rows_bytes(out) == [([0], b"")], name
+
+
+def test_rows_plan_cache_hits():
+    """Repeated conversions of one schema shape must hit the process-global
+    plan cache, not rebuild the permutation."""
+    from spark_rapids_jni_tpu.plans import plan_cache
+
+    cols = _mixed_schema_columns(128, 21, 0.0)
+    dtypes = [c.dtype for c in cols]
+    with config.override(rows_device_path=False, rows_plan_cache=True):
+        convert_to_rows(cols)  # warm (may miss)
+        before = plan_cache.stats()
+        batches = convert_to_rows(cols)
+        convert_from_rows(batches[0], dtypes)
+        after = plan_cache.stats()
+    assert after["hits"] - before["hits"] >= 2
+    assert after["misses"] == before["misses"]
