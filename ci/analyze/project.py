@@ -74,6 +74,7 @@ class Config:
                                               "columnar.frames")
     wire_scope: Tuple[str, ...] = ("serve.rpc", "serve.supervisor",
                                    "serve.shuffle", "serve.telemetry",
+                                   "serve.attribution",
                                    "columnar.frames", "plans.rcache")
     wire_extra_files: Tuple[str, ...] = ("tests/cluster_worker.py",)
     # pass 8 (wire ids): the committed flight-event wire-id registry,

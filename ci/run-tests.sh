@@ -138,6 +138,18 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu SRT_REEXECED=1 \
     --requests 24 --workers 2 --queue-size 16 --cluster 3 \
     --seed "${OPT_SEED:-7}"
 
+# tenant attribution tier (round 21): paired calm/chaos supervised
+# rounds over a Zipf(1.2) tenant mix from a 10k id universe — gates on
+# zero lost, the live endpoint's attribution section populated
+# (dominant-share tenant ranking + capacity headroom), attributed
+# compute >= 95% of worker-measured busy-ns, byte-seconds reconciling
+# with the governor gauges within 5%, and the chaos round's
+# SIGKILL+respawn leaving the reconciliation intact
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu SRT_REEXECED=1 \
+    python tools/serve_bench.py --tenant-storm --clients 8 \
+    --requests 96 --workers 2 --queue-size 64 \
+    --seed "${TENANT_SEED:-13}"
+
 # perf-trajectory report (round 14, ADVISORY — bench numbers on shared
 # CI boxes are weather, so regressions print loudly but never gate):
 # diff the two newest BENCH_r*.json snapshots stage by stage

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import time
 from typing import Any, Callable, List, Optional, Sequence
 
 from spark_rapids_jni_tpu.mem.exceptions import RetryOOM, SplitAndRetryOOM
@@ -54,6 +55,35 @@ __all__ = [
     "MaxSplitDepthExceeded",
     "ShuffleCapacityExceeded",
 ]
+
+
+class _AttribHook:
+    """Deferred binding of serve/attribution's ``note_reservation``:
+    mem/ loads during package bootstrap, long before the serve package
+    can (serve -> ragged -> columnar, which is mid-import above us), so
+    the hook resolves on the FIRST governed release instead of at import
+    and caches the bound function.  reservation() is THE single choke
+    point every governed byte passes through — metering byte·seconds
+    here covers runtime, executor, and shuffle-credit reservations
+    alike."""
+
+    __slots__ = ("_fn",)
+
+    def __init__(self):
+        self._fn = None
+
+    def note_reservation(self, nbytes: int, held_ns: int) -> None:
+        fn = self._fn
+        if fn is None:
+            from spark_rapids_jni_tpu.serve.attribution import (
+                note_reservation,
+            )
+
+            fn = self._fn = note_reservation
+        fn(nbytes, held_ns)
+
+
+_attrib = _AttribHook()
 
 
 class MaxSplitDepthExceeded(MemoryError):
@@ -104,11 +134,20 @@ def reservation(budget: BudgetedResource, nbytes: int):
     # profiler and injector both inactive this adds zero locks/formatting
     # to the admission path (incl. the up-to-500 RetryOOM retry loop)
     if _seam._profiler_range is None and _seam._injector is None:
+        t0 = 0
         budget.acquire(nbytes)
         try:
+            t0 = time.monotonic_ns()
             yield
         finally:
             budget.release(nbytes)
+            # byte·second attribution: reservation size x hold time,
+            # stamped at the choke point so every governed byte is
+            # metered exactly once (no lock on this path; the counter
+            # lock lives inside note_reservation and is uncontended)
+            if t0:
+                _attrib.note_reservation(
+                    nbytes, time.monotonic_ns() - t0)
         return
 
     from spark_rapids_jni_tpu.obs.profiler import Profiler
@@ -135,16 +174,20 @@ def reservation(budget: BudgetedResource, nbytes: int):
         if acquired:
             budget.release(nbytes)
         raise
+    t0 = 0
     try:
         # the admission counter point emits INSIDE the release bracket:
         # a profiler fault mid-emit used to leak the fresh reservation
         # (nothing released it) — the resource-lifecycle gate pins this.
         # _emit samples under the budget lock, so its ordering against
         # concurrent tenants is unchanged by sitting after the seam.
+        t0 = time.monotonic_ns()
         _emit()
         yield
     finally:
         budget.release(nbytes)
+        if t0:
+            _attrib.note_reservation(nbytes, time.monotonic_ns() - t0)
         _emit()
 
 

@@ -66,9 +66,10 @@ __all__ = [
     "EV_RCACHE_EVICT", "EV_RCACHE_INVALIDATE",
     "EV_PLAN_REWRITE", "EV_ADAPT_EXCHANGE",
     "EV_HEDGE_LAUNCH", "EV_HEDGE_WIN", "EV_HEDGE_LOSE",
+    "EV_ATTRIB",
     "EVENT_KINDS", "EVENT_PAIRS", "KIND_IDS", "DUMP_SCHEMA",
     "FlightRecorder", "record", "anomaly", "snapshot", "snapshot_since",
-    "task_stats",
+    "task_stats", "task_stat", "ring_stats",
     "register_telemetry_source", "unregister_telemetry_source",
     "unified_snapshot", "recorder",
 ]
@@ -228,6 +229,15 @@ EV_HEDGE_LOSE = "hedge_lose"            # the primary finished first (or
 #                                        the hedge aborted): hedge copy's
 #                                        result will be duplicate-dropped
 #                                        (detail=rid:<r>:reason:<why>)
+# per-request resource attribution (round 21, serve/attribution.py): one
+# event per terminal request carrying the full AttributionRecord — what
+# the supervisor's per-tenant rollup and the capacity observatory fold.
+# Detail grammar: ``rid:<r>:tenant:<t>:handler:<h>:comp:<ns>`` always,
+# then nonzero-only ``gbs:<byte_ns>:q:<ns>:blk:<ns>:tx:<bytes>:
+# res:<bytes>:hit:<n>:miss:<n>:retry:<n>:split:<n>`` tokens, and
+# ``flags:<a+b>`` (``split``/``cache``/``hedge``) last; value=comp ns.
+# Tenant and handler names must not contain ':'.
+EV_ATTRIB = "attrib"
 
 # Paired kinds: a layer that emits the left side of a pair must also emit
 # the right side (module-granular balance, enforced by the analyze gate's
@@ -271,6 +281,8 @@ EVENT_KINDS = (
     # round 19: appended for the same reason
     EV_PLAN_REWRITE, EV_ADAPT_EXCHANGE,
     EV_HEDGE_LAUNCH, EV_HEDGE_WIN, EV_HEDGE_LOSE,
+    # round 21: appended for the same reason
+    EV_ATTRIB,
 )
 KIND_IDS = {k: i for i, k in enumerate(EVENT_KINDS)}
 
@@ -311,6 +323,12 @@ class FlightRecorder:
         # kinds only)
         self._ev_seq = itertools.count(1)
         self._ring_lock = threading.Lock()
+        # wrap-around loss ledger: every append that evicted the oldest
+        # event (satellite, round 21) — completeness claims (waterfall
+        # fractions, attribution coverage) can then STATE how many
+        # events the ring dropped instead of silently presenting a
+        # truncated history as complete
+        self.ring_dropped = 0  # guarded-by: _ring_lock
         self._stats_lock = threading.Lock()
         self._tasks: "collections.OrderedDict" = collections.OrderedDict()
         self._sources: Dict[str, Callable[[], dict]] = {}
@@ -330,6 +348,9 @@ class FlightRecorder:
         # seq allocation + append under one leaf lock: ring order and
         # seq order must agree (see _ring_lock above)
         with self._ring_lock:
+            if (self._ring.maxlen is not None
+                    and len(self._ring) == self._ring.maxlen):
+                self.ring_dropped += 1
             self._ring.append((next(self._ev_seq), t_ns, kind, task_id,
                                tid, detail, value))
         if task_id >= 0 and kind in _STAT_KINDS:
@@ -396,6 +417,23 @@ class FlightRecorder:
         with self._stats_lock:
             return {task: dict(st) for task, st in self._tasks.items()}
 
+    def task_stat(self, task_id: int) -> Optional[dict]:
+        """ONE task's accumulators (or None) — O(1), unlike task_stats'
+        full-table copy: the attribution finish path samples blocked-ns
+        and retry counts per request, and must not pay _MAX_TASKS dict
+        copies on every completion."""
+        with self._stats_lock:
+            st = self._tasks.get(task_id)
+            return dict(st) if st is not None else None
+
+    def ring_stats(self) -> dict:
+        """The ring's retention ledger: capacity, occupancy, and how
+        many events wrap-around has evicted since start/reset."""
+        with self._ring_lock:
+            return {"capacity": self._ring.maxlen or 0,
+                    "events": len(self._ring),
+                    "dropped": self.ring_dropped}
+
     # -- telemetry sources -------------------------------------------------
     def register_telemetry_source(self, name: str,
                                   fn: Callable[[], dict]) -> None:
@@ -453,6 +491,7 @@ class FlightRecorder:
             "wall_time_s": time.time(),
             "t_ns": time.monotonic_ns(),
             "events": self.snapshot(),
+            "ring": self.ring_stats(),
             "tasks": {str(k): v for k, v in self.task_stats().items()},
             "telemetry": self.unified_snapshot(),
         }
@@ -482,7 +521,9 @@ class FlightRecorder:
             return ""  # an unwritable dump dir must not break governance
 
     def reset_for_tests(self) -> None:
-        self._ring.clear()
+        with self._ring_lock:
+            self._ring.clear()
+            self.ring_dropped = 0
         with self._stats_lock:
             self._tasks.clear()
         with self._dump_lock:
@@ -525,6 +566,14 @@ def snapshot_since(cursor: int) -> Tuple[List[dict], int]:
 
 def task_stats() -> Dict[int, dict]:
     return _RECORDER.task_stats()
+
+
+def task_stat(task_id: int) -> Optional[dict]:
+    return _RECORDER.task_stat(task_id)
+
+
+def ring_stats() -> dict:
+    return _RECORDER.ring_stats()
 
 
 def register_telemetry_source(name: str, fn: Callable[[], dict]) -> None:

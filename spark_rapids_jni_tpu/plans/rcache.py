@@ -55,6 +55,9 @@ import numpy as np
 
 from spark_rapids_jni_tpu.columnar import frames as _frames
 from spark_rapids_jni_tpu.obs import flight as _flight
+# per-request attribution hooks (TLS pointer mutations only — no lock,
+# no blocking — so calling them under self._lock is safe)
+from spark_rapids_jni_tpu.serve import attribution as _attrib
 
 __all__ = [
     "ResultCache", "result_cache",
@@ -282,16 +285,19 @@ class ResultCache:
             e = self._entries.get(key)
             if e is None:
                 self._stats["misses"] += 1
+                _attrib.note_cache_miss()
                 return None
             if e.deps and tuple(_tables.versions_of(
                     [t for t, _ in e.deps])) != e.deps:
                 # raced insert from before a bump: reclaim, never serve
                 self._drop_locked(e, reason="stale")
                 self._stats["misses"] += 1
+                _attrib.note_cache_miss()
                 return None
             value = self._materialize_locked(e)
             if value is None:  # corrupt disk frame: already evicted
                 self._stats["misses"] += 1
+                _attrib.note_cache_miss()
                 return None
             self._clock += 1
             e.seq = self._clock
@@ -303,6 +309,7 @@ class ResultCache:
                            detail=f"{prefix}handler:{e.label}:tier:"
                                   f"{e.tier}:key:{e.token}",
                            value=e.nbytes)
+            _attrib.note_cache_hit(e.nbytes)
             return value
 
     def _materialize_locked(self, e: _Entry) -> Optional[Any]:
@@ -395,6 +402,7 @@ class ResultCache:
                            detail=f"handler:{label}:tier:{e.tier}:"
                                   f"key:{e.token}",
                            value=nbytes)
+            _attrib.note_cache_store(nbytes)
             cap = max(1, self._cap("entries"))
             while len(self._entries) > cap:
                 lru = min(self._entries.values(), key=lambda x: x.seq)

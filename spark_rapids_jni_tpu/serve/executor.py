@@ -44,6 +44,7 @@ from spark_rapids_jni_tpu.mem.governor import MemoryGovernor, OutOfBudget
 from spark_rapids_jni_tpu.obs import flight as _flight
 from spark_rapids_jni_tpu.obs import trace as _trace
 from spark_rapids_jni_tpu.obs.seam import SERVE, seam
+from spark_rapids_jni_tpu.serve import attribution as _attrib
 from spark_rapids_jni_tpu.serve.metrics import ServeMetrics
 from spark_rapids_jni_tpu.serve.queue import (
     CANCELLED,
@@ -413,7 +414,7 @@ class ServingEngine:
     def submit(self, session: Session, handler: str, payload: Any, *,
                priority: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               trace: Any = None) -> Response:
+               trace: Any = None, tenant: Optional[str] = None) -> Response:
         """Admit one request; returns its :class:`Response`.
 
         Raises :class:`Backpressure` (queue full — retry after the hint) or
@@ -424,6 +425,12 @@ class ServingEngine:
         dispatch span, carried over MSG_DISPATCH): the worker's queue and
         compute spans then chain under the SAME rid across processes.
         Without it the request roots a fresh trace on its own task id.
+
+        ``tenant`` names the billing identity the request's attribution
+        record rolls up under (serve/attribution.py); it defaults to the
+        session id — the right answer for front-door submits, while the
+        cluster worker engines (one ``lease:wN`` session each) pass the
+        tenant the supervisor carried over MSG_DISPATCH.
         """
         # analyze: ignore[guarded-by] - hot-path read of a registration
         # dict that only grows at startup; a GIL-atomic get needs no lock
@@ -457,6 +464,7 @@ class ServingEngine:
             seq=next(self._seq),
             task_id=tid,
             trace=ctx,
+            tenant=(tenant if tenant else session.session_id),
         )
         req.charge_bytes = nbytes
         req.session = session
@@ -653,6 +661,18 @@ class ServingEngine:
         if not first:
             return
         self._credit(req)
+        rec = req.attrib
+        if rec is not None:
+            # fold the governor-side per-task accumulators (blocked
+            # time, retry/split deliveries) in at the terminal state,
+            # then emit the record as ONE EV_ATTRIB event — first-wins
+            # completion makes double emission structurally impossible
+            st = _flight.task_stat(req.task_id)
+            if st is not None:
+                rec.blocked_ns = st["blocked_ns"]
+                rec.retries = st["retries"]
+                rec.splits = st["split_retries"]
+            _attrib.emit(rec, task_id=req.task_id)
         # terminal state: no phase span may outlive the request (close is
         # idempotent, so paths that already closed these cost nothing)
         _trace.close_span(req.qspan)
@@ -811,7 +831,35 @@ class ServingEngine:
             # them to the queue's outstanding count (the drain watches it)
             self.queue.task_done(len(group))
 
+    def _attrib_rec(self, req: Request):
+        """The request's :class:`AttributionRecord`, created on first
+        serve — a re-queued half or disbanded mate keeps accumulating
+        into the SAME record across attempts, so retry churn is part of
+        its cost story.  The rid is the trace lineage's rid (the
+        supervisor lease id on cluster workers — split children carry
+        their parent's, so child costs roll up to the parent rid in the
+        supervisor's rollup), else the engine task id."""
+        rec = req.attrib
+        if rec is None:
+            rec = req.attrib = _attrib.AttributionRecord(
+                rid=(req.trace.rid if req.trace is not None
+                     else req.task_id),
+                tenant=(req.tenant or req.session_id),
+                handler=req.handler)
+            if req.split_depth > 0 or req.join is not None:
+                rec.flags.add("split")
+        return rec
+
     def _serve_group(self, req: Request) -> List[Request]:
+        # the request's attribution record becomes the thread's active
+        # meter for the whole serve scope: governed reservations, shuffle
+        # fetches, and rcache consults all land their costs on it without
+        # plumbing.  The inline presplit child recursion below nests its
+        # own record via metered's save/restore.
+        with _attrib.metered(self._attrib_rec(req)):
+            return self._serve_group_metered(req)
+
+    def _serve_group_metered(self, req: Request) -> List[Request]:
         # the queue-wait phase of the waterfall ends at the pop that led
         # here (batch mates close theirs in the admission-stamp loop)
         _trace.close_span(req.qspan)
@@ -844,12 +892,15 @@ class ServingEngine:
         for r in group:
             _trace.close_span(r.qspan)  # mates' queue wait ends here too
             r.qspan = None
+            rec = self._attrib_rec(r)  # mates meter their own queue wait
             if r.response.admitted_ns == 0:  # re-served requests (split
                 # halves got fresh responses; disbanded mates did not)
                 # keep their first admission stamp and count once
                 r.response.admitted_ns = now_ns
                 self.metrics.count("admitted", r.session_id)
-                self.metrics.record_wait(now_ns - r.response.submitted_ns)
+                wait_ns = now_ns - r.response.submitted_ns
+                self.metrics.record_wait(wait_ns)
+                rec.queue_ns += wait_ns
         # one compute span per member (mates ride the primary's launch but
         # each request's waterfall must still show its compute phase); the
         # primary's compute context becomes the thread's CURRENT context,
@@ -901,7 +952,10 @@ class ServingEngine:
         if req.response.admitted_ns == 0:
             req.response.admitted_ns = now_ns
             self.metrics.count("admitted", req.session_id)
-            self.metrics.record_wait(now_ns - req.response.submitted_ns)
+            wait_ns = now_ns - req.response.submitted_ns
+            self.metrics.record_wait(wait_ns)
+            if req.attrib is not None:
+                req.attrib.queue_ns += wait_ns
         self.metrics.count("rcache_hits", req.session_id)
         # hits land in the handler latency histograms too: the SLO and
         # dashboard view of this class's p50/p99 must reflect that the
@@ -1026,6 +1080,13 @@ class ServingEngine:
                 return self._unbatch_finish(req, h, group, result, run_ns)
         else:
             self.metrics.record_run(run_ns, handler=h.name)
+            # compute attribution at the SAME site that records run
+            # latency: the measured-busy counter and the per-request
+            # comp_ns advance together, so the completeness gate
+            # compares like against like
+            _attrib.note_busy(run_ns)
+            if req.attrib is not None:
+                req.attrib.comp_ns += run_ns
             self._rcache_store(req, h, result)
             self._finish(req, OK, value=result)
         return group
@@ -1063,6 +1124,12 @@ class ServingEngine:
             return group
         for r, value in zip(group, parts):
             self.metrics.record_run(run_ns, handler=h.name)
+            # per-member, mirroring record_run: the batch's one launch
+            # is billed to every rider, and note_busy advances the
+            # measured side identically so coverage stays 1:1
+            _attrib.note_busy(run_ns)
+            if r.attrib is not None:
+                r.attrib.comp_ns += run_ns
             self._finish(r, OK, value=value)
         return group
 
@@ -1102,7 +1169,10 @@ class ServingEngine:
         if req.response.admitted_ns == 0:
             req.response.admitted_ns = now_ns
             self.metrics.count("admitted", req.session_id)
-            self.metrics.record_wait(now_ns - req.response.submitted_ns)
+            wait_ns = now_ns - req.response.submitted_ns
+            self.metrics.record_wait(wait_ns)
+            if req.attrib is not None:
+                req.attrib.queue_ns += wait_ns
         self.metrics.count("presplit", req.session_id)
         _flight.record(_flight.EV_CONTROL_PRESPLIT, req.task_id,
                        detail=f"handler:{h.name}:pieces:{len(parts)}",
@@ -1118,8 +1188,11 @@ class ServingEngine:
                 no_batch=True, join=join, join_slot=slot,
                 # children span under the parent's trace: the rid lineage
                 # survives the split, so one waterfall shows every piece
+                # (and their attribution records keep the parent's rid +
+                # tenant — piece costs roll up to the parent request)
                 trace=(_trace.child_of(req.trace)
                        if req.trace is not None else None),
+                tenant=req.tenant,
             )
             for slot, part in enumerate(parts)
         ]
@@ -1202,6 +1275,7 @@ class ServingEngine:
                 no_batch=True, join=join, join_slot=slot,
                 trace=(_trace.child_of(req.trace)
                        if req.trace is not None else None),
+                tenant=req.tenant,
             )
             # the serve-level half: a fresh task carrying its parent's
             # lineage into the flight ring (the arbiter already recorded

@@ -144,6 +144,14 @@ class Request:
     join_slot: int = 0
     session: Any = None      # set for client-facing requests (not halves):
     charge_bytes: int = 0    # session byte-budget charge to credit back
+    # per-tenant attribution (round 21): the billing identity this
+    # request's costs roll up under — defaults to the session id at
+    # submit, crosses the pipe in MSG_DISPATCH, and lands in the
+    # worker-side EV_ATTRIB record (serve/attribution.py); `attrib` is
+    # the live AttributionRecord, created when the request first serves
+    # and emitted as EV_ATTRIB by the terminal-state owner
+    tenant: str = ""
+    attrib: Any = None
     # cross-process shuffle lineage (serve/supervisor.py round 13): the
     # parent of a shuffle carries its sid (map_index -1); each child is
     # map task map_index of that sid, so lease grants keep the

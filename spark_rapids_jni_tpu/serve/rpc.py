@@ -92,9 +92,14 @@ MESSAGE_FIELDS = {
     MSG_BEAT: ("worker_id", "incarnation", "wall_t", "gauges"),
     # `trace` (round 14) is the supervisor's dispatch-span context
     # (obs/trace.to_wire tuple or None): the worker's queue/compute spans
-    # chain under the SAME rid, so one live waterfall crosses the pipe
+    # chain under the SAME rid, so one live waterfall crosses the pipe.
+    # `tenant` (round 21) is the billing identity the request's
+    # attribution record rolls up under — the worker engines run ONE
+    # internal lease session each, so the tenant must ride the dispatch
+    # itself (hedge copies carry the same rid + tenant, which is how
+    # hedge-loser cost stays attributed)
     MSG_DISPATCH: ("rid", "handler", "payload", "deadline_rel_s",
-                   "priority", "trace"),
+                   "priority", "trace", "tenant"),
     MSG_RESULT: ("rid", "status", "value", "err"),
     MSG_SHUTDOWN: ("dump_epilogue",),
     # worker -> supervisor: map task `map_index` of shuffle `sid` framed
@@ -335,10 +340,21 @@ def executor_worker_main(worker_id: int, incarnation: int, conn,
 
     exporter = None
     if bool(config.get("serve_telemetry")):
+        from spark_rapids_jni_tpu.serve import attribution as _attrib
         from spark_rapids_jni_tpu.serve.telemetry import TelemetryExporter
 
+        def _metrics_with_attrib():
+            # the cumulative attribution reconciliation gauges ride
+            # EVERY export's metrics — including the post-result
+            # force-flush, the same message that carries the EV_ATTRIB
+            # events — so a chaos SIGKILL can't strand attributed work
+            # without the measurement it reconciles against
+            m = engine.metrics.snapshot()
+            m.setdefault("gauges", {}).update(_attrib.worker_gauges())
+            return m
+
         exporter = TelemetryExporter(worker_id, incarnation,
-                                     metrics_source=engine.metrics.snapshot)
+                                     metrics_source=_metrics_with_attrib)
         # force-flush on the SERVING thread after each popped group fully
         # serves: every span-close finally has run by then, so a chaos
         # SIGKILL landing before the next heartbeat cannot eat the story
@@ -451,12 +467,14 @@ def executor_worker_main(worker_id: int, incarnation: int, conn,
                 continue
             if tag != MSG_DISPATCH:
                 continue
-            _, rid, handler, payload, deadline_rel_s, priority, trace = msg
+            (_, rid, handler, payload, deadline_rel_s, priority, trace,
+             tenant) = msg
             try:
                 resp = engine.submit(sess, handler, payload,
                                      priority=priority,
                                      deadline_s=deadline_rel_s,
-                                     trace=_trace.from_wire(trace))
+                                     trace=_trace.from_wire(trace),
+                                     tenant=tenant)
             # analyze: ignore[retry-protocol] - submit crosses no seam
             # (admission only); failures here are flow control
             # (Backpressure -> BUSY re-queue upstream) or setup bugs

@@ -64,6 +64,7 @@ from spark_rapids_jni_tpu.columnar import frames
 from spark_rapids_jni_tpu.obs import flight as _flight
 from spark_rapids_jni_tpu.obs import trace
 from spark_rapids_jni_tpu.obs.faultinj import transport_fault
+from spark_rapids_jni_tpu.serve import attribution as _attrib
 from spark_rapids_jni_tpu.serve import rpc
 
 __all__ = [
@@ -823,6 +824,11 @@ def _fetch_partitions(svc, sid: int, parts: List[int], ntasks: int,
                     cols = svc.fetch(sid, k, p, deadline=deadline,
                                      rid=rid)
                 svc.ack(sid, k, p, rid=rid)
+                # transport-byte attribution: this thread serves the
+                # consumer request, so its active record (if any) owns
+                # the fetched bytes (the reservation above meters the
+                # matching byte·seconds automatically)
+                _attrib.note_tx(nbytes)
             received.append(cols)
     return received
 

@@ -44,6 +44,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from spark_rapids_jni_tpu.obs import flight as _flight
 from spark_rapids_jni_tpu.obs import trace as _trace
+from spark_rapids_jni_tpu.serve import attribution as _attrib
+from spark_rapids_jni_tpu.serve.attribution import AttributionRollup
 from spark_rapids_jni_tpu.serve.executor import _SplitJoin, split_till
 from spark_rapids_jni_tpu.serve.metrics import ServeMetrics, percentile_of_counts
 from spark_rapids_jni_tpu.serve.queue import (
@@ -426,10 +428,23 @@ class Supervisor:
         self._tl_server = None
         self._tl_lock = threading.Lock()
         self._tl_cursor = 0  # guarded-by: _tl_lock
+        # the attribution rollup (round 21): per-tenant dominant-resource
+        # accounting + the capacity/headroom model.  Fed post-dedup from
+        # the timeline's on_event hook, so a re-ingested delta can never
+        # double-count a request's costs; worker reconciliation gauges
+        # arrive on the MSG_TELEMETRY path below.  Capacity model:
+        # threads-per-executor from worker_cfg (the engine's pool width),
+        # governed budget per executor likewise (config default when the
+        # cfg leaves the engine to probe it).
+        self.attribution = AttributionRollup()
+        self._attrib_threads = int(self.worker_cfg.get("workers", 2))
+        self._attrib_budget = int(self.worker_cfg.get("budget_bytes")
+                                  or config.get("device_budget_bytes"))
         if telemetry:
             from spark_rapids_jni_tpu.serve.telemetry import ClusterTimeline
 
-            self.timeline = ClusterTimeline()
+            self.timeline = ClusterTimeline(
+                on_event=self.attribution.ingest_event)
         # the SLO burn-rate engine (serve/slo.py): declared objectives
         # evaluated on the monitor tick; burn feeds the ladder's stress
         # sample and the MSG_PRESSURE broadcast (slo_frac)
@@ -484,12 +499,16 @@ class Supervisor:
     # -- the producer surface -----------------------------------------------
     def submit(self, session: Session, handler: str, payload: Any, *,
                priority: Optional[int] = None,
-               deadline_s: Optional[float] = None):
+               deadline_s: Optional[float] = None,
+               tenant: Optional[str] = None):
         with self._lock:
             spec = self._specs.get(handler)
         if spec is None:
             raise KeyError(f"no handler {handler!r} registered")
         prio = priority if priority is not None else session.priority
+        # the attribution identity every cost this request causes rolls
+        # up under — explicit billing label, else the session
+        tname = tenant if tenant else session.session_id
         # the result-cache read path runs BEFORE the degradation gate:
         # a hit is served work, not shed work — it costs no lease, no
         # pipe crossing, no worker capacity, so even a ladder at
@@ -500,7 +519,7 @@ class Supervisor:
         ckey = cdeps = ctoken = None
         if self._rcache_on and spec.cache_key is not None:
             ckey, cdeps, ctoken, resp = self._rcache_submit(
-                session, spec, payload)
+                session, spec, payload, tname)
             if resp is not None:
                 return resp
         self._gate(session, spec, prio, hot_token=ctoken)
@@ -520,6 +539,7 @@ class Supervisor:
             # the request's trace roots HERE: rid = the supervisor lease
             # id, the same token every cross-process chain keys on
             trace=_trace.new_root(tid) if self._spans_on else None,
+            tenant=tname,
         )
         req.charge_bytes = nbytes
         req.session = session
@@ -551,7 +571,7 @@ class Supervisor:
         return req.response
 
     def _rcache_submit(self, session: Session, spec: HandlerSpec,
-                       payload: Any):
+                       payload: Any, tenant: str):
         """Result-cache short-circuit of one submit.  Returns
         ``(key, deps, token, response)``: response is non-None on a hit
         (already terminal — the caller returns it without gating,
@@ -572,14 +592,23 @@ class Supervisor:
         key, deps = request_key(spec.name, pk, names)
         tid = self.sessions.next_task_id()
         t0_ns = time.monotonic_ns()
-        hit = result_cache.lookup(key, rid=tid)
+        # meter the lookup so the cache hooks land residency/hit counts
+        # on an attribution record: a hit is served work and must be
+        # billed — zero compute, nonzero residency (ISSUE 20)
+        arec = _attrib.AttributionRecord(rid=tid, tenant=tenant,
+                                         handler=spec.name)
+        with _attrib.metered(arec):
+            hit = result_cache.lookup(key, rid=tid)
         if hit is None:
+            # the dispatched request re-attributes itself end to end;
+            # the probe record (one miss, no cost) is dropped
             return key, deps, key_token(key), None
         req = Request(
             handler=spec.name, payload=None, session_id=session.session_id,
             priority=session.priority, deadline=None, seq=next(self._seq),
             task_id=tid,
             trace=_trace.new_root(tid) if self._spans_on else None,
+            tenant=tenant,
         )
         # the waterfall of a hit: queue (instantaneous — the request was
         # never poppable) -> cache_hit, no dispatch, no compute
@@ -598,6 +627,7 @@ class Supervisor:
         with _trace.span(req.trace, _trace.SPAN_CACHE, task_id=tid,
                          extra=f"handler:{spec.name}"):
             self._finish(req, OK, value=hit)
+        _attrib.emit(arec, task_id=tid)
         return key, deps, None, req.response
 
     def _advertised_hot_locked(self, token: str) -> bool:
@@ -756,6 +786,12 @@ class Supervisor:
             elif tag == rpc.MSG_SHUFFLE_ACK:
                 self._on_shuffle_ack(handle, msg[3], msg[4], msg[5])
             elif tag == rpc.MSG_TELEMETRY:
+                # reconciliation gauges high-water per incarnation even
+                # when the timeline plane is off or HELLO hasn't landed
+                # — measured busy/byte·ns must survive every race the
+                # events themselves survive
+                self.attribution.note_worker_gauges(msg[1], msg[2],
+                                                    msg[6])
                 # a delta racing ahead of HELLO has no pid to key on yet
                 # (worker spans can't predate the hello, so nothing of a
                 # request's waterfall is lost by dropping it)
@@ -921,6 +957,7 @@ class Supervisor:
                 split_depth=1, no_batch=True, join=join, join_slot=slot,
                 trace=(_trace.child_of(req.trace)
                        if req.trace is not None else None),
+                tenant=req.tenant,
             )
             _flight.record(_flight.EV_SPLIT_RETRY, child.task_id,
                            detail=f"rid:{child.task_id}:"
@@ -954,6 +991,7 @@ class Supervisor:
                 shuffle_sid=sid, shuffle_map_index=m,
                 trace=(_trace.child_of(req.trace)
                        if req.trace is not None else None),
+                tenant=req.tenant,
             )
             state.tasks[m] = {"rid": tid, "data": shard, "worker": -1,
                               "inc": -1, "state": "pending", "sizes": {},
@@ -1165,7 +1203,8 @@ class Supervisor:
                                req.payload, deadline_rel, req.priority,
                                _trace.to_wire(req.dspan.ctx
                                               if req.dspan is not None
-                                              else req.trace)))
+                                              else req.trace),
+                               req.tenant))
         if not ok:
             # reclaim THIS lease explicitly: if the EOF path already ran
             # for this incarnation, _worker_dead below is a no-op and
@@ -1362,6 +1401,9 @@ class Supervisor:
             # (shed/reject decisions happen here, not in any worker)
             "sessions": self.metrics.snapshot()["sessions"],
             "slo": self.slo.snapshot() if self.slo is not None else None,
+            # per-tenant dominant-resource shares, cluster utilization,
+            # capacity headroom (round 21 — the accounting plane)
+            "attribution": self.attribution.snapshot(),
         }
 
     def telemetry_endpoint(self) -> Optional[tuple]:
@@ -1383,6 +1425,13 @@ class Supervisor:
             conns = [h.conn for h in alive]
         if not gauges or not conns:
             return
+        # refresh the fleet capacity model with the live executor count,
+        # then summarize attribution into the same broadcast: workers'
+        # admission controllers see tenant skew + headroom alongside
+        # memory/queue pressure (acting on them is the next PR)
+        self.attribution.set_capacity(
+            workers=len(alive), threads=self._attrib_threads,
+            budget_bytes=self._attrib_budget)
         cluster = {
             "blocked_frac": sum(float(g.get("blocked_frac", 0.0))
                                 for g in gauges) / len(gauges),
@@ -1396,6 +1445,7 @@ class Supervisor:
                          else 0.0),
             "workers": len(gauges),
         }
+        cluster.update(self.attribution.pressure_gauges())
         for conn in conns:
             conn.send((rpc.MSG_PRESSURE, cluster))
 
@@ -1532,7 +1582,7 @@ class Supervisor:
                 (rpc.MSG_DISPATCH, lease.rid, req.handler, req.payload,
                  deadline_rel, req.priority,
                  _trace.to_wire(req.dspan.ctx if req.dspan is not None
-                                else req.trace)))
+                                else req.trace), req.tenant))
             if not ok:
                 # reclaim THIS hedge explicitly (the _grant send-failure
                 # twin): if the EOF path already ran for the target's

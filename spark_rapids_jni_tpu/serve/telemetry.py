@@ -225,23 +225,34 @@ class ClusterTimeline:
     grammar (rid chains, sid chains, span waterfalls) serves dumps AND
     the live plane.  Deduplication is a per-(pid, incarnation) high-water
     ``seq`` mark, O(1) per event.
+
+    ``on_event`` (round 21) observes each NEW post-dedup event — the
+    attribution rollup's feed.  Hooking downstream of the seq high-water
+    is what makes a re-shipped delta (stalled pipe retry) unable to
+    double-count a request's costs; the callback fires OUTSIDE the
+    timeline lock, so consumers may take their own locks freely.
     """
 
-    def __init__(self, max_events: Optional[int] = None):
+    def __init__(self, max_events: Optional[int] = None,
+                 on_event: Optional[Callable[[dict], None]] = None):
         from spark_rapids_jni_tpu import config
 
         if max_events is None:
             max_events = int(config.get("serve_timeline_events"))
         self._lock = threading.Lock()
+        self._on_event = on_event
         # normalized event dicts, append-ordered  # guarded-by: _lock
         self._events: "collections.deque" = collections.deque(
             maxlen=max_events)
         # (pid, incarnation) -> highest seq ingested  # guarded-by: _lock
         self._seq_hi: Dict[tuple, int] = {}
+        # (pid, incarnation) -> highest wall_s emitted  # guarded-by: _lock
+        self._wall_hi: Dict[tuple, float] = {}
         # pid -> latest metrics snapshot + meta  # guarded-by: _lock
         self._workers: Dict[int, dict] = {}
         self.ingests = 0  # guarded-by: _lock
         self.dropped_stale = 0  # guarded-by: _lock
+        self.clamped = 0  # guarded-by: _lock
 
     def ingest(self, pid: int, wall_t: float, t_ns: int,
                events: List[dict], *, incarnation: int = 0,
@@ -250,9 +261,11 @@ class ClusterTimeline:
         """Merge one export; returns how many events were new."""
         added = 0
         key = (int(pid), int(incarnation))
+        fresh: List[dict] = []
         with self._lock:
             self.ingests += 1
             hi = self._seq_hi.get(key, 0)
+            wall_hi = self._wall_hi.get(key, float("-inf"))
             for e in events:
                 seq = int(e.get("seq", 0))
                 if seq and seq <= hi:
@@ -261,12 +274,25 @@ class ClusterTimeline:
                 ev = dict(e)
                 ev["pid"] = int(pid)
                 # the stamp pair re-bases this process's monotonic clock
-                ev["wall_s"] = wall_t - (t_ns - int(e.get("t_ns", 0))) / 1e9
+                ws = wall_t - (t_ns - int(e.get("t_ns", 0))) / 1e9
+                # a wall clock stepped backward between exports (NTP)
+                # would make this delta's events PREDATE ones already
+                # ingested from the same stream — the event order (seq,
+                # monotonic) is ground truth, so clamp the re-base to
+                # keep per-stream wall_s monotone and count it
+                if ws < wall_hi:
+                    ws = wall_hi
+                    self.clamped += 1
+                wall_hi = ws
+                ev["wall_s"] = ws
                 self._events.append(ev)
                 if seq:
                     hi = seq
                 added += 1
+                if self._on_event is not None:
+                    fresh.append(ev)
             self._seq_hi[key] = hi
+            self._wall_hi[key] = wall_hi
             if metrics is not None:
                 self._workers[int(pid)] = {
                     "worker_id": int(worker_id),
@@ -274,6 +300,14 @@ class ClusterTimeline:
                     "wall_t": wall_t,
                     "metrics": metrics,
                 }
+        for ev in fresh:
+            try:
+                self._on_event(ev)
+            # analyze: ignore[retry-protocol] - a consumer hook must
+            # never kill the recv thread feeding the timeline; the
+            # rollup counts its own unparsable events
+            except Exception:  # noqa: BLE001
+                pass
         return added
 
     def merged(self, *, since_wall_s: float = 0.0) -> dict:
@@ -314,6 +348,7 @@ class ClusterTimeline:
             return {"events": len(self._events),
                     "ingests": self.ingests,
                     "dropped_stale": self.dropped_stale,
+                    "clamped": self.clamped,
                     "processes": len(self._seq_hi)}
 
 

@@ -1,0 +1,419 @@
+"""Per-tenant resource attribution + capacity accounting (round 21).
+
+What this file pins:
+
+- the EV_ATTRIB wire grammar: emit/parse round-trips every cost field
+  and flag, sanitizes tenant/handler separators, and rejects foreign
+  detail strings instead of raising;
+- the metering hooks: metered() binds per-thread records re-entrantly,
+  and every note_* advances BOTH the active record and the
+  process-cumulative reconciliation gauges;
+- the rollup's accounting edge cases: split children folding into the
+  parent rid, hedge losers marked wasted order-independently, cache
+  hits carrying zero compute but nonzero residency, and a re-shipped
+  telemetry delta (timeline seq dedup) never double-counting;
+- the capacity model: dominant-resource shares, per-resource
+  utilization/headroom, and the gauge high-waters summing across
+  incarnations so reconciliation survives SIGKILL;
+- the surfaces: servetop's TENANTS/CAPACITY sections and --json
+  one-shot, flightdump --attrib, capacity_report's forecast document;
+- the ClusterTimeline negative-wall-drift clamp (the satellite
+  regression: an NTP step back must not reorder a stream's wall_s).
+"""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from spark_rapids_jni_tpu.obs import flight
+from spark_rapids_jni_tpu.serve import ClusterTimeline
+from spark_rapids_jni_tpu.serve import attribution as attrib
+from spark_rapids_jni_tpu.serve.attribution import (
+    AttributionRecord,
+    AttributionRollup,
+    metered,
+    parse_detail,
+)
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import flightdump  # noqa: E402
+import servetop  # noqa: E402
+
+
+# ------------------------------------------------------- wire grammar
+
+
+def test_emit_parse_roundtrip():
+    flight.recorder().reset_for_tests()
+    rec = AttributionRecord(rid=7, tenant="acme:eu", handler="storm")
+    rec.comp_ns = 1234
+    rec.gbs = 999
+    rec.queue_ns = 55
+    rec.blocked_ns = 44
+    rec.tx_bytes = 33
+    rec.res_bytes = 22
+    rec.hits = 2
+    rec.misses = 1
+    rec.retries = 3
+    rec.splits = 4
+    rec.flags.add("split")
+    rec.flags.add("cache")
+    attrib.emit(rec, task_id=9)
+    evs = [e for e in flight.snapshot() if e["kind"] == flight.EV_ATTRIB]
+    assert len(evs) == 1 and evs[0]["task_id"] == 9
+    out = parse_detail(evs[0]["detail"])
+    assert out is not None
+    assert out["rid"] == 7
+    # ":" in tenant would corrupt the token grammar -> sanitized
+    assert out["tenant"] == "acme_eu" and out["handler"] == "storm"
+    assert out["comp_ns"] == 1234 and out["gbs"] == 999
+    assert out["queue_ns"] == 55 and out["blocked_ns"] == 44
+    assert out["tx_bytes"] == 33 and out["res_bytes"] == 22
+    assert out["hits"] == 2 and out["misses"] == 1
+    assert out["retries"] == 3 and out["splits"] == 4
+    assert set(out["flags"]) == {"split", "cache"}
+
+
+def test_parse_detail_rejects_foreign():
+    assert parse_detail("") is None
+    assert parse_detail("rid:notanint:tenant:a:handler:b:comp:0") is None
+    # no rid token: a foreign detail that happens to tokenize
+    assert parse_detail("tenant:a:handler:b:comp:1") is None
+    # zero-cost record with empty tenant/handler round-trips as "-"
+    out = parse_detail("rid:3:tenant:-:handler:-:comp:0")
+    assert out is not None and out["tenant"] == "-"
+
+
+# ------------------------------------------------------- metering hooks
+
+
+def test_metered_hooks_advance_record_and_gauges():
+    attrib.reset_worker_counters_for_tests()
+    base = attrib.worker_gauges()
+    rec = AttributionRecord(rid=1, tenant="t", handler="h")
+    with metered(rec):
+        # note_busy feeds the MEASURED side only; comp_ns attribution
+        # happens at the executor's record_run sites
+        attrib.note_busy(500)
+        attrib.note_reservation(100, 10)
+        attrib.note_tx(64)
+        attrib.note_cache_hit(4096)
+    assert rec.gbs == 100 * 10
+    assert rec.tx_bytes == 64
+    assert rec.hits == 1 and rec.res_bytes == 4096
+    assert "cache" in rec.flags
+    g = attrib.worker_gauges()
+    assert g["attrib_busy_ns"] - base["attrib_busy_ns"] == 500
+    assert g["attrib_gov_byte_ns"] - base["attrib_gov_byte_ns"] == 1000
+    # outside any metered scope the gauges still advance (measured
+    # side of the reconciliation counts ALL busy/governed time) while
+    # per-record attribution is a no-op
+    attrib.note_busy(100)
+    attrib.note_reservation(2, 2)
+    assert attrib.worker_gauges()["attrib_busy_ns"] \
+        - g["attrib_busy_ns"] == 100
+    assert rec.gbs == 1000
+
+
+def test_metered_is_reentrant():
+    outer = AttributionRecord(rid=1, tenant="t", handler="h")
+    inner = AttributionRecord(rid=2, tenant="t", handler="h")
+    with metered(outer):
+        attrib.note_tx(10)
+        with metered(inner):
+            attrib.note_tx(5)
+        attrib.note_tx(1)
+    assert outer.tx_bytes == 11 and inner.tx_bytes == 5
+    assert attrib.active_record() is None
+
+
+# ------------------------------------------------------- rollup folding
+
+
+def _attrib_ev(detail, wall_s=1000.0):
+    return {"kind": flight.EV_ATTRIB, "detail": detail, "wall_s": wall_s}
+
+
+def test_split_children_roll_up_to_parent_rid():
+    r = AttributionRollup()
+    # parent + two split children share the trace rid; each emits its
+    # own EV_ATTRIB (different task ids, same rid token)
+    r.ingest_event(_attrib_ev(
+        "rid:5:tenant:a:handler:storm:comp:100:split:1:flags:split"))
+    r.ingest_event(_attrib_ev(
+        "rid:5:tenant:a:handler:storm:comp:40:flags:split"))
+    r.ingest_event(_attrib_ev(
+        "rid:5:tenant:a:handler:storm:comp:60:flags:split"))
+    row = r.rid_breakdown(5)
+    assert row["events"] == 3
+    assert row["comp_ns"] == 200 and row["splits"] == 1
+    assert "split" in row["flags"]
+    snap = r.snapshot()
+    assert snap["cluster"]["comp_ns"] == 200
+    assert snap["tenants"][0]["tenant"] == "a"
+    assert snap["tenants"][0]["comp_ns"] == 200
+
+
+@pytest.mark.parametrize("lose_first", [False, True])
+def test_hedge_loser_marked_wasted_order_independent(lose_first):
+    r = AttributionRollup()
+    lose = {"kind": flight.EV_HEDGE_LOSE, "detail": "rid:9:worker:1",
+            "wall_s": 1000.0}
+    cost = _attrib_ev("rid:9:tenant:a:handler:storm:comp:70")
+    if lose_first:
+        r.ingest_event(lose)
+        r.ingest_event(cost)
+    else:
+        r.ingest_event(cost)
+        r.ingest_event(lose)
+    # a second lose marker for the same rid must not double the waste
+    r.ingest_event(lose)
+    snap = r.snapshot()
+    t = snap["tenants"][0]
+    assert t["wasted_ns"] == 70 and snap["cluster"]["comp_ns"] == 70
+    assert r.rid_breakdown(9)["wasted"] is True
+
+
+def test_cache_hit_zero_compute_nonzero_residency():
+    r = AttributionRollup()
+    r.ingest_event(_attrib_ev(
+        "rid:3:tenant:a:handler:lookup:comp:0:res:4096:hit:1:flags:cache"))
+    t = r.snapshot()["tenants"][0]
+    assert t["comp_ns"] == 0 and t["res_bytes"] == 4096 and t["hits"] == 1
+    row = r.rid_breakdown(3)
+    assert row["comp_ns"] == 0 and "cache" in row["flags"]
+
+
+def test_duplicate_delta_does_not_double_count():
+    r = AttributionRollup()
+    tl = ClusterTimeline(max_events=64, on_event=r.ingest_event)
+    evs = [{"seq": 1, "t_ns": 1_000_000_000, "kind": flight.EV_ATTRIB,
+            "task_id": 4, "tid": 1,
+            "detail": "rid:4:tenant:a:handler:storm:comp:50", "value": 50}]
+    assert tl.ingest(111, 1000.0, 2_000_000_000, evs) == 1
+    # the re-shipped delta (stalled-pipe cursor hold) dedupes by seq,
+    # so the rollup fed off on_event never sees the event twice
+    assert tl.ingest(111, 1001.0, 3_000_000_000, evs) == 0
+    snap = r.snapshot()
+    assert snap["events"] == 1 and snap["requests"] == 1
+    assert snap["cluster"]["comp_ns"] == 50
+
+
+def test_unparsed_foreign_detail_is_counted_not_raised():
+    r = AttributionRollup()
+    r.ingest_event(_attrib_ev("not:a:valid:attrib:detail"))
+    snap = r.snapshot()
+    assert snap["unparsed"] == 1 and snap["events"] == 0
+
+
+# ------------------------------------------- capacity + reconciliation
+
+
+def test_dominant_share_capacity_headroom():
+    r = AttributionRollup()
+    wall = 1000.0
+    # tenant a: compute-heavy; tenant b: governed-bytes-heavy
+    r.ingest_event(_attrib_ev(
+        "rid:1:tenant:a:handler:h:comp:900:gbs:100", wall))
+    r.ingest_event(_attrib_ev(
+        "rid:2:tenant:b:handler:h:comp:100:gbs:900", wall))
+    r.set_capacity(workers=2, threads=2, budget_bytes=1 << 20)
+    snap = r.snapshot()
+    by_name = {t["tenant"]: t for t in snap["tenants"]}
+    assert by_name["a"]["dominant_resource"] == "comp_ns"
+    assert by_name["a"]["dominant_share"] == 0.9
+    assert by_name["b"]["dominant_resource"] == "gbs"
+    assert by_name["b"]["dominant_share"] == 0.9
+    cap = snap["capacity"]
+    assert cap["workers"] == 2 and cap["rates"]["comp_ns"] == 4e9
+    assert snap["utilization"]["comp_ns"] is not None
+    assert snap["headroom"]["comp_ns"] is not None
+    # queue time has no capacity rate -> no utilization claim
+    assert snap["utilization"]["queue_ns"] is None
+    g = r.pressure_gauges()
+    assert g["attrib_top_tenant"] in ("a", "b")
+    assert g["attrib_headroom_comp_frac"] is not None
+    assert snap["windows"]["10s"]["p95"]["comp_ns"] > 0
+
+
+def test_gauge_highwater_sums_across_incarnations():
+    r = AttributionRollup()
+    r.note_worker_gauges(0, 0, {"gauges": {
+        "attrib_busy_ns": 100, "attrib_gov_byte_ns": 10,
+        "ring_dropped": 0}})
+    # a SIGKILLed incarnation's successor restarts its counters at 0;
+    # summing per-incarnation high-waters keeps the dead one's last
+    # shipped measurement in the reconciliation
+    r.note_worker_gauges(0, 1, {"gauges": {
+        "attrib_busy_ns": 40, "attrib_gov_byte_ns": 4,
+        "ring_dropped": 1}})
+    # a stale re-ship can never move a high-water backward
+    r.note_worker_gauges(0, 0, {"gauges": {
+        "attrib_busy_ns": 80, "attrib_gov_byte_ns": 8,
+        "ring_dropped": 0}})
+    m = r.measured()
+    assert m["busy_ns"] == 140 and m["gov_byte_ns"] == 14
+    assert m["ring_dropped"] == 1
+    # gauge-free metrics payloads (older workers) are a no-op
+    r.note_worker_gauges(1, 0, {"queue_depth": 3})
+    assert r.measured()["busy_ns"] == 140
+
+
+def test_coverage_attributed_over_measured():
+    r = AttributionRollup()
+    r.ingest_event(_attrib_ev("rid:1:tenant:a:handler:h:comp:95"))
+    r.note_worker_gauges(0, 0, {"gauges": {
+        "attrib_busy_ns": 100, "attrib_gov_byte_ns": 0,
+        "ring_dropped": 0}})
+    assert r.snapshot()["coverage_comp"] == 0.95
+
+
+def test_flight_ring_dropped_counter():
+    rec = flight.FlightRecorder(ring_size=4)
+    for i in range(6):
+        rec.record("admitted", task_id=i)
+    stats = rec.ring_stats()
+    assert stats["capacity"] == 4 and stats["dropped"] == 2
+    assert stats["events"] == 4
+
+
+# --------------------------------------------------- timeline clamp
+
+
+def test_timeline_clamps_negative_wall_drift():
+    tl = ClusterTimeline(max_events=64)
+    ev1 = [{"seq": 1, "t_ns": 1_000_000_000, "kind": "admitted",
+            "task_id": 1, "tid": 0, "detail": "", "value": 0}]
+    tl.ingest(7, 1000.0, 2_000_000_000, ev1)   # rebases to wall 999.0
+    # the wall clock stepped back 2s (NTP) between exports: the raw
+    # rebase would land this LATER event (monotonic 3e9 > 1e9) at wall
+    # 998.0 — before the one already ingested.  The clamp pins it.
+    ev2 = [{"seq": 2, "t_ns": 3_000_000_000, "kind": "admitted",
+            "task_id": 2, "tid": 0, "detail": "", "value": 0}]
+    tl.ingest(7, 998.0, 3_000_000_000, ev2)
+    merged = tl.merged()["events"]
+    assert merged[0]["wall_s"] == pytest.approx(999.0)
+    assert merged[1]["wall_s"] == pytest.approx(999.0)
+    assert merged[1]["wall_s"] >= merged[0]["wall_s"]
+    assert tl.stats()["clamped"] == 1
+    # an independent stream (other pid) is not affected by the clamp
+    tl.ingest(8, 998.0, 3_000_000_000, [dict(ev2[0])])
+    assert tl.stats()["clamped"] == 1
+
+
+# --------------------------------------------------------- surfaces
+
+
+def _attrib_view():
+    r = AttributionRollup()
+    r.ingest_event(_attrib_ev(
+        "rid:1:tenant:acme:handler:storm:comp:5000000:gbs:1000"))
+    r.ingest_event(_attrib_ev(
+        "rid:2:tenant:beta:handler:storm:comp:1000000"))
+    r.set_capacity(workers=2, threads=2, budget_bytes=1 << 26)
+    r.note_worker_gauges(0, 0, {"gauges": {
+        "attrib_busy_ns": 6_000_000, "attrib_gov_byte_ns": 1000,
+        "ring_dropped": 0}})
+    return {"attribution": r.snapshot()}
+
+
+def test_servetop_renders_tenant_and_capacity_sections():
+    view = _attrib_view()
+    tenant_lines = "\n".join(servetop._attrib_tenant_table(view))
+    assert "acme" in tenant_lines and "beta" in tenant_lines
+    cap_lines = "\n".join(servetop._capacity_section(view))
+    assert "headroom" in cap_lines and "coverage" in cap_lines
+    # both sections degrade gracefully on a pre-round-21 view
+    assert servetop._attrib_tenant_table({})
+    assert servetop._capacity_section({})
+
+
+def test_servetop_json_one_shot(tmp_path, capsys):
+    path = tmp_path / "view.json"
+    path.write_text(json.dumps(_attrib_view()))
+    assert servetop.main(["--fixture", str(path), "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["attribution"]["tenants"][0]["tenant"] == "acme"
+
+
+def test_flightdump_attrib_report():
+    merged = {"events": [
+        _attrib_ev("rid:1:tenant:acme:handler:storm:comp:5000000"),
+        _attrib_ev("rid:2:tenant:beta:handler:storm:comp:1000000"),
+        {"kind": flight.EV_HEDGE_LOSE, "detail": "rid:2:worker:0",
+         "wall_s": 1000.0},
+    ]}
+    text = flightdump.format_attrib(merged)
+    assert "acme" in text and "beta" in text
+    assert "WASTED" in text
+    # --rid narrowing: one rid's breakdown only
+    one = flightdump.format_attrib(merged, rid="1")
+    assert "acme" in one and "beta" not in one
+    missing = flightdump.format_attrib(merged, rid="99")
+    assert "no attributed cost" in missing
+
+
+def test_capacity_report_forecast():
+    import capacity_report
+
+    at = _attrib_view()["attribution"]
+    report = capacity_report.build_report(at, source="test", top=5)
+    assert report["schema"] == capacity_report.SCHEMA
+    assert report["tenants"][0]["tenant"] == "acme"
+    fc = report["forecast"]
+    assert set(fc) == set(attrib.RESOURCES)
+    for r in attrib.RESOURCES:
+        assert "trend_per_s" in fc[r] and "projected" in fc[r]
+    comp = fc["comp_ns"]
+    # one burst lands hotter in the 10s tier than amortized over 10m:
+    # a positive trend with a finite time-to-exhaustion claim
+    assert comp["trend_per_s"] == pytest.approx(
+        (comp["demand_10s"] - comp["demand_10m"]) / 300.0, rel=1e-3)
+    assert comp["trend_per_s"] > 0 and comp["exhaustion_s"] > 0
+    # no demand at all -> no trend, no exhaustion claim
+    idle = capacity_report.build_report(
+        {"windows": {}, "headroom": {}}, source="idle")
+    assert idle["forecast"]["comp_ns"]["exhaustion_s"] is None
+
+
+# ------------------------------------------------------- end to end
+
+
+@pytest.mark.slow
+def test_supervisor_attributes_tenant_costs_end_to_end():
+    from spark_rapids_jni_tpu.serve import HandlerSpec, Supervisor
+
+    sup = Supervisor(workers=1, factory="cluster_worker:register_toy",
+                     worker_cfg={"workers": 2, "queue_size": 32},
+                     queue_size=32, default_deadline_s=30.0)
+    try:
+        sup.register(HandlerSpec(
+            "sum", nbytes_of=lambda p: 64 * len(p),
+            split=lambda p: [p[:len(p) // 2], p[len(p) // 2:]],
+            combine=sum))
+        s = sup.open_session("e2e")
+        for tenant in ("acme", "acme", "beta"):
+            assert sup.submit(s, "sum", list(range(10)),
+                              tenant=tenant).result(timeout=60) == 45
+        # attribution rides the workers' periodic telemetry deltas
+        deadline = time.monotonic() + 30
+        snap = sup.attribution.snapshot()
+        while time.monotonic() < deadline:
+            snap = sup.attribution.snapshot()
+            if snap["requests"] >= 3 and snap["measured"]["busy_ns"]:
+                break
+            time.sleep(0.2)
+        by_name = {t["tenant"]: t for t in snap["tenants"]}
+        assert by_name["acme"]["requests"] == 2
+        assert by_name["beta"]["requests"] == 1
+        assert snap["measured"]["busy_ns"] > 0
+        assert snap["coverage_comp"] is not None
+        view = sup._telemetry_view()
+        assert view["attribution"]["tenants_tracked"] >= 2
+    finally:
+        sup.shutdown(drain=False, timeout=10)

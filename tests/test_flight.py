@@ -160,9 +160,11 @@ def test_event_kind_vocabulary_is_stable():
         "rcache_hit", "rcache_store", "rcache_demote",
         "rcache_evict", "rcache_invalidate")
     # round 19: optimizer / adaptive-exchange / hedging kinds appended
-    assert flight.EVENT_KINDS[-5:] == (
+    assert flight.EVENT_KINDS[42:47] == (
         "plan_rewrite", "adapt_exchange",
         "hedge_launch", "hedge_win", "hedge_lose")
+    # round 21: the per-tenant attribution kind is strictly appended after
+    assert flight.EVENT_KINDS[47:48] == ("attrib",)
     assert len(set(flight.EVENT_KINDS)) == len(flight.EVENT_KINDS)
 
 

@@ -1,0 +1,165 @@
+"""capacity_report: machine-readable cluster capacity + forecast JSON.
+
+The operator/autoscaler half of the round-21 attribution plane: one JSON
+document answering "how much capacity does the fleet have, how much is
+demanded, by whom, and when does headroom run out at the current trend"
+— consumable by a capacity dashboard, a cron'd report, or the elastic
+fleet controller ROADMAP item 1 builds next.
+
+Sources (same addressing as flightdump):
+
+- a LIVE supervisor telemetry endpoint (``host:port``) — uses the
+  server-computed attribution section, including the worker-measured
+  reconciliation gauges;
+- a DIRECTORY of per-process flight dumps — re-folds the merged
+  timeline's attrib events through the same :class:`AttributionRollup`
+  (capacity model supplied via ``--workers/--threads/--budget-mb``,
+  since dumps don't carry the fleet shape).
+
+Usage::
+
+    python tools/capacity_report.py 127.0.0.1:43210
+    python tools/capacity_report.py dump_dir/ --workers 2 --threads 2 \
+        --budget-mb 64
+    python tools/capacity_report.py 127.0.0.1:43210 --top 5 --indent 0
+
+The forecast is deliberately simple (and labeled as such): the per-tier
+P95 demand rates give a recent (10s), medium (1m), and long (10m) view;
+the trend is their long-to-recent slope, and ``exhaustion_s`` is the
+time until demand crosses capacity IF that trend holds — a first-order
+signal for "scale soon", not an SLA.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SCHEMA = "srt-capacity-report-v1"
+
+# the trend baseline sits mid-window between the 10s and 10m tiers
+_TREND_BASELINE_S = 300.0
+_FORECAST_HORIZON_S = 600.0
+
+
+def _forecast(attribution: dict) -> dict:
+    """Per-resource demand trend + time-to-exhaustion from the windowed
+    P95 tiers (see module docstring for what this is and is not)."""
+    from spark_rapids_jni_tpu.serve.attribution import RESOURCES
+
+    windows = attribution.get("windows") or {}
+    head = attribution.get("headroom") or {}
+
+    def p95(tier: str, r: str) -> float:
+        return float(((windows.get(tier) or {}).get("p95") or {})
+                     .get(r, 0.0))
+
+    out = {}
+    for r in RESOURCES:
+        now = p95("10s", r)
+        mid = p95("1m", r)
+        long = p95("10m", r)
+        trend = (now - long) / _TREND_BASELINE_S
+        h = head.get(r)
+        exhaustion: Optional[float] = None
+        if h is not None and trend > 0:
+            exhaustion = round(h / trend, 1)
+        out[r] = {
+            "demand_10s": now,
+            "demand_1m": mid,
+            "demand_10m": long,
+            "trend_per_s": round(trend, 6),
+            "projected": round(now + trend * _FORECAST_HORIZON_S, 3),
+            "projected_horizon_s": _FORECAST_HORIZON_S,
+            "headroom": h,
+            "exhaustion_s": exhaustion,
+        }
+    return out
+
+
+def build_report(attribution: dict, *, source: str,
+                 top: int = 10) -> dict:
+    tenants = (attribution.get("tenants") or [])[:top]
+    return {
+        "schema": SCHEMA,
+        "source": source,
+        "capacity": attribution.get("capacity"),
+        "utilization": attribution.get("utilization"),
+        "headroom": attribution.get("headroom"),
+        "windows": attribution.get("windows"),
+        "forecast": _forecast(attribution),
+        "tenants": tenants,
+        "cluster": attribution.get("cluster"),
+        "measured": attribution.get("measured"),
+        "coverage_comp": attribution.get("coverage_comp"),
+        "requests": attribution.get("requests"),
+        "events": attribution.get("events"),
+    }
+
+
+def _from_live(endpoint: str) -> dict:
+    from spark_rapids_jni_tpu.serve.telemetry import fetch_view
+
+    host, _, port = endpoint.rpartition(":")
+    view = fetch_view(host or "127.0.0.1", int(port))
+    at = view.get("attribution")
+    if not at:
+        raise SystemExit(
+            f"capacity_report: endpoint served no attribution section: "
+            f"{view.get('error', 'older supervisor?')}")
+    return at
+
+
+def _from_dumps(dump_dir: str, *, workers: int, threads: int,
+                budget_bytes: int) -> dict:
+    from tools.flightdump import attrib_rollup, merge_cluster
+
+    merged = merge_cluster(dump_dir)
+    rollup = attrib_rollup(merged)
+    if workers:
+        rollup.set_capacity(workers=workers, threads=threads,
+                            budget_bytes=budget_bytes)
+    return rollup.snapshot()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="machine-readable cluster capacity/forecast JSON "
+                    "from the attribution plane")
+    ap.add_argument("source",
+                    help="a live telemetry endpoint (host:port) or a "
+                         "directory of per-process flight dumps")
+    ap.add_argument("--workers", type=int, default=0,
+                    help="dump mode: fleet executor count for the "
+                         "capacity model (omit = no capacity/headroom)")
+    ap.add_argument("--threads", type=int, default=2,
+                    help="dump mode: engine threads per executor")
+    ap.add_argument("--budget-mb", type=int, default=64,
+                    help="dump mode: governed budget per executor (MiB)")
+    ap.add_argument("--top", type=int, default=10,
+                    help="tenants included in the report")
+    ap.add_argument("--indent", type=int, default=2,
+                    help="JSON indent (0 = compact single line)")
+    args = ap.parse_args(argv)
+
+    if os.path.isdir(args.source):
+        at = _from_dumps(args.source, workers=args.workers,
+                         threads=args.threads,
+                         budget_bytes=args.budget_mb << 20)
+    else:
+        at = _from_live(args.source)
+    report = build_report(at, source=args.source, top=args.top)
+    json.dump(report, sys.stdout, sort_keys=True, default=str,
+              indent=(args.indent or None))
+    sys.stdout.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -307,6 +307,70 @@ def format_waterfalls(merged: dict, rid: str | None = None,
     return "\n".join(out)
 
 
+def attrib_rollup(merged: dict):
+    """Re-fold a merged timeline's EV_ATTRIB / EV_HEDGE_LOSE events
+    through the SAME AttributionRollup the live supervisor runs — one
+    accounting grammar for dumps and the live plane (round 21)."""
+    from spark_rapids_jni_tpu.serve import attribution as _attrib
+
+    rollup = _attrib.AttributionRollup()
+    for e in merged.get("events", []):
+        rollup.ingest_event(e)
+    return rollup
+
+
+def format_attrib(merged: dict, rid: str | None = None) -> str:
+    """Per-tenant cost rollup + per-rid breakdowns from a merged
+    timeline (``--attrib``): who spent what, request by request."""
+    rollup = attrib_rollup(merged)
+    snap = rollup.snapshot()
+    out = [f"attribution rollup: events={snap['events']} "
+           f"requests={snap['requests']} "
+           f"tenants={snap['tenants_tracked']}"
+           + (f" unparsed={snap['unparsed']}" if snap["unparsed"] else "")]
+    if rid is None:
+        cl = snap["cluster"]
+        out.append(
+            f"  cluster: comp {cl['comp_ns'] / 1e6:.1f} ms  "
+            f"governed {cl['gbs'] / 1e18:.4f} GB·s  "
+            f"queue {cl['queue_ns'] / 1e6:.1f} ms  "
+            f"tx {cl['tx_bytes'] / 1e6:.2f} MB  "
+            f"wasted {cl['wasted_ns'] / 1e6:.1f} ms")
+        out.append(f"\n  {'tenant':<22}{'dom share':>10}{'resource':>10}"
+                   f"{'reqs':>7}{'comp ms':>10}{'GB·s':>9}"
+                   f"{'queue ms':>10}{'tx MB':>8}{'wasted ms':>11}")
+        for t in snap["tenants"]:
+            out.append(
+                f"  {t['tenant']:<22}{t['dominant_share']:>10.3f}"
+                f"{t['dominant_resource']:>10}{t['requests']:>7}"
+                f"{t['comp_ns'] / 1e6:>10.1f}{t['gbs'] / 1e18:>9.4f}"
+                f"{t['queue_ns'] / 1e6:>10.1f}"
+                f"{t['tx_bytes'] / 1e6:>8.2f}"
+                f"{t['wasted_ns'] / 1e6:>11.1f}")
+    rows = rollup.rid_breakdown(int(rid)) if rid is not None \
+        else rollup.rid_breakdown()
+    if rid is not None:
+        rows = [rows] if rows is not None else []
+        if not rows:
+            out.append(f"\nrid {rid}: no attributed cost in this timeline")
+    if rows:
+        out.append("\nper-rid cost breakdown:")
+        for r in rows:
+            flags = "+".join(r.get("flags", ())) or "-"
+            out.append(
+                f"  rid {r['rid']:<8} tenant={r.get('tenant', '?'):<16} "
+                f"handler={r.get('handler', '?'):<14} "
+                f"comp={r.get('comp_ns', 0) / 1e6:.2f}ms "
+                f"gbs={r.get('gbs', 0) / 1e18:.5f} "
+                f"q={r.get('queue_ns', 0) / 1e6:.2f}ms "
+                f"blk={r.get('blocked_ns', 0) / 1e6:.2f}ms "
+                f"tx={r.get('tx_bytes', 0)} res={r.get('res_bytes', 0)} "
+                f"hit={r.get('hits', 0)} retry={r.get('retries', 0)} "
+                f"split={r.get('splits', 0)} flags={flags}"
+                + ("  WASTED" if r.get("wasted") else ""))
+    return "\n".join(out)
+
+
 def fetch_live(endpoint: str) -> dict:
     """Pull the live merged timeline from a supervisor's telemetry
     endpoint (``host:port``) — the --cluster shape, no dumps needed."""
@@ -329,7 +393,7 @@ def fetch_live(endpoint: str) -> dict:
     merged["skipped"] = 0
     merged["view"] = {k: view.get(k) for k in
                       ("schema", "wall_t", "timeline_stats",
-                       "supervisor", "slo")}
+                       "supervisor", "slo", "attribution")}
     return merged
 
 
@@ -358,6 +422,11 @@ def main(argv=None) -> int:
                     help="with --cluster/--live: render per-request SPAN "
                          "waterfalls (queue/dispatch/transport/compute "
                          "bars, obs/trace.py) instead of event chains")
+    ap.add_argument("--attrib", action="store_true",
+                    help="with --cluster/--live: per-tenant cost rollup "
+                         "+ per-rid breakdowns re-folded from the "
+                         "timeline's attrib events (--rid narrows to "
+                         "one request's costs)")
     ap.add_argument("--top", type=int, default=0,
                     help="with --waterfall: only the N slowest requests")
     ap.add_argument("--control", action="store_true",
@@ -372,6 +441,17 @@ def main(argv=None) -> int:
     if args.cluster or args.live:
         merged = (fetch_live(args.dump) if args.live
                   else merge_cluster(args.dump))
+        if args.attrib:
+            if args.json:
+                rollup = attrib_rollup(merged)
+                json.dump({"attribution": rollup.snapshot(),
+                           "rids": rollup.rid_breakdown()},
+                          sys.stdout, indent=1, sort_keys=True,
+                          default=str)
+                sys.stdout.write("\n")
+            else:
+                print(format_attrib(merged, rid=args.rid))
+            return 0
         if args.json:
             json.dump({"dumps": merged.get("dumps", 0),
                        "skipped": merged.get("skipped", 0),

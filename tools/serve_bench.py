@@ -30,6 +30,7 @@ import os
 import sys
 import threading
 import time
+from typing import Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -1015,6 +1016,265 @@ def _verify_cluster_dumps(dump_dir: str) -> dict:
         "worker_dead": kinds.get("worker_dead", 0),
         "redispatches": kinds.get("lease_redispatch", 0),
     }
+
+
+def _fetch_attribution(sup) -> Optional[dict]:
+    """Read the attribution section off the live telemetry endpoint
+    (exactly what `servetop --json` / capacity_report would see)."""
+    from spark_rapids_jni_tpu.serve.telemetry import fetch_view
+
+    ep = sup.telemetry_endpoint()
+    if ep is None:
+        return None
+    try:
+        view = fetch_view(*ep)
+    except (OSError, ValueError):
+        return None
+    return view.get("attribution")
+
+
+def _tenant_round(args, *, chaos: bool) -> dict:
+    """One attribution-plane round: the supervised-cluster storm profile
+    with every request labeled by a Zipf-drawn tenant over a >= 10k id
+    space.  After drain, the live endpoint's attribution section is
+    polled until the telemetry deltas settle, then reconciled against
+    the worker-measured gauges: attributed compute vs busy-ns coverage
+    and attributed byte-seconds vs the governor's metered byte-ns."""
+    import numpy as np
+
+    from spark_rapids_jni_tpu.obs.faultinj import chaos_kill_config
+    from spark_rapids_jni_tpu.serve import (
+        Backpressure,
+        HandlerSpec,
+        RequestTimeout,
+        Supervisor,
+    )
+
+    def chaos_fn(wid: int, inc: int):
+        if not chaos:
+            return None
+        # same arming discipline as _cluster_round: incarnation 0 dies
+        # at most once at a seeded crossing; respawns run clean, so the
+        # reconciliation gate spans a real SIGKILL + gauge re-high-water
+        return chaos_kill_config(
+            seed=args.seed * 1000 + wid * 17 + inc,
+            kill=(inc == 0), kill_pct=args.kill_pct)
+
+    sup = Supervisor(
+        workers=args.cluster,
+        factory="serve_bench:cluster_worker_factory",
+        factory_kwargs={"bytes_per_row": args.storm_bytes_per_row,
+                        "service_ms": args.cluster_service_ms},
+        worker_cfg={"workers": args.workers,
+                    "queue_size": max(32, args.queue_size)},
+        chaos=chaos_fn,
+        queue_size=args.queue_size,
+        default_deadline_s=args.deadline_s,
+        lease_hang_s=args.lease_hang_s)
+    sup.register(HandlerSpec(
+        "storm",
+        nbytes_of=lambda p: args.storm_bytes_per_row * len(p),
+        split=lambda p: [p[:len(p) // 2], p[len(p) // 2:]],
+        combine=lambda rs: int(sum(rs))))
+
+    per_client = max(1, args.requests // args.clients)
+    total = per_client * args.clients
+    lock = threading.Lock()
+    tally = {"succeeded": 0, "rejected": 0, "timed_out": 0, "errors": 0,
+             "client_retries": 0, "degraded_retries": 0,
+             "wrong_answers": 0}
+    tenant_counts: dict = {}
+
+    def client(ci: int) -> None:
+        from spark_rapids_jni_tpu.serve import Degraded
+
+        rng = np.random.RandomState(args.seed * 1000 + ci)
+        sess = sup.open_session(
+            f"tenantc{ci}", priority=1 if ci % 3 == 0 else 0)
+        for _ri in range(per_client):
+            # head-heavy Zipf tenant draw folded into the id universe:
+            # the modulo keeps the unbounded tail inside --tenant-space
+            # without flattening the hot head (rank 1 stays rank 1)
+            tid = (int(rng.zipf(args.tenant_zipf)) - 1) % args.tenant_space
+            tenant = f"t{tid}"
+            with lock:
+                tenant_counts[tenant] = tenant_counts.get(tenant, 0) + 1
+            payload = rng.randint(0, 1000, args.storm_rows).astype(np.int64)
+            want = int(payload.sum())
+            outcome = "rejected"
+            for _ in range(args.max_retries):
+                try:
+                    resp = sup.submit(sess, "storm", payload,
+                                      tenant=tenant)
+                except Degraded as bp:
+                    with lock:
+                        tally["degraded_retries"] += 1
+                    time.sleep(min(bp.retry_after_s, 0.1))
+                    continue
+                except Backpressure as bp:
+                    with lock:
+                        tally["client_retries"] += 1
+                    time.sleep(min(bp.retry_after_s, 0.05))
+                    continue
+                try:
+                    out = resp.result(timeout=args.deadline_s + 30)
+                except RequestTimeout:
+                    outcome = "timed_out"
+                except Exception:  # noqa: BLE001 - counted, not raised
+                    outcome = "errors"
+                else:
+                    outcome = "succeeded"
+                    if out != want:
+                        with lock:
+                            tally["wrong_answers"] += 1
+                break
+            with lock:
+                tally[outcome] += 1
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(ci,))
+               for ci in range(args.clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    sup.wait_drained(timeout=60)
+    recover_deadline = time.perf_counter() + 20
+    while (sup.level() != 0 and time.perf_counter() < recover_deadline):
+        time.sleep(0.1)
+    wall = time.perf_counter() - t0
+
+    # settle loop: the last EV_ATTRIB deltas and gauge high-waters ride
+    # the workers' periodic MSG_TELEMETRY flush, so poll the endpoint
+    # until the reconciliation holds (or a bounded deadline passes) and
+    # gate on the final read
+    attrib = _fetch_attribution(sup)
+    settle_deadline = time.perf_counter() + 12
+    while time.perf_counter() < settle_deadline:
+        if attrib and _attrib_reconciles(attrib):
+            break
+        time.sleep(0.4)
+        attrib = _fetch_attribution(sup) or attrib
+    snap = sup.snapshot()
+    sup.shutdown()
+
+    accounted = (tally["succeeded"] + tally["rejected"]
+                 + tally["timed_out"] + tally["errors"])
+    at = attrib or {}
+    measured = at.get("measured") or {}
+    cluster_at = at.get("cluster") or {}
+    counters = snap["counters"]
+    mgbs = measured.get("gov_byte_ns", 0)
+    return {
+        "chaos": chaos,
+        "requests": total,
+        "wall_s": round(wall, 3),
+        "outcomes": tally,
+        "lost": total - accounted,
+        "zero_lost": (accounted == total and tally["errors"] == 0
+                      and tally["wrong_answers"] == 0),
+        "workers_dead": counters.get("workers_dead", 0),
+        "respawns": counters.get("workers_spawned", 0) - args.cluster,
+        "distinct_tenants_submitted": len(tenant_counts),
+        "hottest_tenant_requests": max(tenant_counts.values(), default=0),
+        "attribution": {
+            "present": bool(at),
+            "events": at.get("events", 0),
+            "unparsed": at.get("unparsed", 0),
+            "requests": at.get("requests", 0),
+            "tenants_tracked": at.get("tenants_tracked", 0),
+            "top_tenants": [
+                {k: t.get(k) for k in ("tenant", "dominant_share",
+                                       "dominant_resource", "requests")}
+                for t in (at.get("tenants") or [])[:5]],
+            "coverage_comp": at.get("coverage_comp"),
+            "attributed_gbs": cluster_at.get("gbs", 0),
+            "measured_gov_byte_ns": mgbs,
+            "gbs_ratio": (round(cluster_at.get("gbs", 0) / mgbs, 4)
+                          if mgbs else None),
+            "measured_busy_ns": measured.get("busy_ns", 0),
+            "ring_dropped": measured.get("ring_dropped", 0),
+            "headroom": at.get("headroom"),
+            "utilization": at.get("utilization"),
+            "capacity": at.get("capacity"),
+        },
+    }
+
+
+def _attrib_reconciles(at: dict) -> bool:
+    """The round-21 reconciliation predicate: attributed compute covers
+    >= 95% of worker-measured busy-ns AND attributed byte-seconds land
+    within 5% of the governor's metered byte-ns."""
+    cov = at.get("coverage_comp")
+    measured = at.get("measured") or {}
+    mgbs = measured.get("gov_byte_ns", 0)
+    agbs = (at.get("cluster") or {}).get("gbs", 0)
+    if cov is None or not mgbs:
+        return False
+    return cov >= 0.95 and abs(agbs - mgbs) <= 0.05 * mgbs
+
+
+def _run_tenant_storm(args) -> int:
+    """``--tenant-storm``: the round-21 attribution acceptance.
+
+    Paired calm/chaos supervised-cluster rounds (2 executors by
+    default) over a Zipf(1.2) tenant mix drawn from a >= 10k id space.
+    Gates, per round: zero lost requests, the endpoint's attribution
+    section populated (tenants ranked by dominant share, capacity
+    headroom computed), per-rid attributed compute >= 95% of the
+    worker-measured busy-ns, and attributed byte-seconds reconciling
+    with the governor gauges within 5%.  The chaos round additionally
+    requires >= 1 SIGKILL with respawn — completed work's attribution
+    must survive executor death exactly like spans do."""
+    if args.cluster <= 0:
+        args.cluster = 2
+
+    calm = _tenant_round(args, chaos=False)
+    chaos = _tenant_round(args, chaos=True)
+
+    def round_gates(r: dict) -> dict:
+        at = r["attribution"]
+        return {
+            "zero_lost": r["zero_lost"],
+            "attribution_present": at["present"] and at["events"] > 0,
+            "tenants_ranked": (
+                at["tenants_tracked"] >= 1
+                and bool(at["top_tenants"])
+                and at["top_tenants"][0]["dominant_share"] > 0),
+            "headroom_computed": (
+                (at["headroom"] or {}).get("comp_ns") is not None
+                and (at["headroom"] or {}).get("gbs") is not None),
+            "comp_coverage_95": (at["coverage_comp"] is not None
+                                 and at["coverage_comp"] >= 0.95),
+            "gbs_within_5pct": (at["gbs_ratio"] is not None
+                                and abs(1.0 - at["gbs_ratio"]) <= 0.05),
+            "no_unparsed": at["unparsed"] == 0,
+        }
+
+    gates = {f"calm_{k}": v for k, v in round_gates(calm).items()}
+    gates.update({f"chaos_{k}": v for k, v in round_gates(chaos).items()})
+    gates["chaos_kills_with_respawns"] = (chaos["workers_dead"] >= 1
+                                          and chaos["respawns"] >= 1)
+    gates["zipf_head_hot"] = (
+        calm["distinct_tenants_submitted"] >= 2
+        and calm["hottest_tenant_requests"]
+        > calm["requests"] // max(1, calm["distinct_tenants_submitted"]))
+    rec = {
+        "name": "BENCH_serve",
+        "mode": "tenant_storm",
+        "seed": args.seed,
+        "cluster": args.cluster,
+        "clients": args.clients,
+        "workers_per_executor": args.workers,
+        "tenant_space": args.tenant_space,
+        "tenant_zipf": args.tenant_zipf,
+        "calm": calm,
+        "chaos": chaos,
+        "gates": gates,
+        "zero_lost": calm["zero_lost"] and chaos["zero_lost"],
+    }
+    print(json.dumps(rec))
+    return 0 if all(gates.values()) else 1
 
 
 def _ragged_round(args, *, ragged: bool, chaos: bool) -> dict:
@@ -2012,6 +2272,23 @@ def main(argv=None) -> int:
                     help="the armed SLO's p99 target; must sit well "
                          "under the chaos round's fault-inflated "
                          "latencies so the burn is deterministic")
+    ap.add_argument("--tenant-storm", action="store_true",
+                    help="round-21 acceptance tier: paired calm/chaos "
+                         "supervised-cluster rounds over a Zipf(1.2) "
+                         "tenant mix drawn from a >= 10k id space.  "
+                         "Gates: zero lost, the live endpoint's "
+                         "attribution section populated (dominant-share "
+                         "tenant ranking + capacity headroom), "
+                         "attributed compute >= 95%% of worker-measured "
+                         "busy-ns, byte-seconds reconciling with the "
+                         "governor gauges within 5%%, and the chaos "
+                         "round's SIGKILL+respawn not breaking "
+                         "reconciliation")
+    ap.add_argument("--tenant-space", type=int, default=10_000,
+                    help="tenant id universe of the Zipf draw (the "
+                         "acceptance requires >= 10k)")
+    ap.add_argument("--tenant-zipf", type=float, default=1.2,
+                    help="Zipf exponent of tenant popularity")
     ap.add_argument("--optimizer-storm", action="store_true",
                     help="round-19 acceptance tier: paired optimizer-"
                          "off/on governed-plan rounds (median-p99 win "
@@ -2062,6 +2339,8 @@ def main(argv=None) -> int:
                          "actually coalesces)")
     args = ap.parse_args(argv)
 
+    if args.tenant_storm:
+        return _run_tenant_storm(args)
     if args.optimizer_storm:
         return _run_optimizer_storm(args)
     if args.cache_storm:

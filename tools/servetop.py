@@ -149,6 +149,63 @@ def _cache_section(view: dict, prev: Optional[dict]) -> List[str]:
     return lines
 
 
+def _attrib_tenant_table(view: dict) -> List[str]:
+    """TENANTS by dominant-resource share (round 21): who is consuming
+    the cluster, by the resource each tenant uses the most of."""
+    at = view.get("attribution") or {}
+    rows = at.get("tenants") or []
+    if not rows:
+        return ["  (no attributed requests yet)"]
+    out = [f"  {'tenant':<22}{'dom share':>12}{'resource':>10}"
+           f"{'reqs':>7}{'comp ms':>10}{'gb·s':>9}{'wasted ms':>11}"]
+    for t in rows[:12]:
+        out.append(
+            f"  {t.get('tenant', '?'):<22}"
+            f"{_bar(t.get('dominant_share', 0.0)):>12}"
+            f"{t.get('dominant_resource', '?'):>10}"
+            f"{t.get('requests', 0):>7}"
+            f"{t.get('comp_ns', 0) / 1e6:>10.1f}"
+            f"{t.get('gbs', 0) / 1e18:>9.3f}"
+            f"{t.get('wasted_ns', 0) / 1e6:>11.1f}")
+    return out
+
+
+def _capacity_section(view: dict) -> List[str]:
+    """Cluster capacity vs P95 windowed demand per resource: the
+    headroom view an autoscaler (or an operator sizing one) reads."""
+    at = view.get("attribution") or {}
+    util = at.get("utilization") or {}
+    head = at.get("headroom") or {}
+    cap = at.get("capacity") or {}
+    measured = at.get("measured") or {}
+    if not cap.get("workers"):
+        return ["  (capacity model not set yet)"]
+    units = {"comp_ns": ("compute", 1e9, "core·s/s"),
+             "gbs": ("governed", 1e18, "GB·s/s"),
+             "queue_ns": ("queue", 1e9, "s/s"),
+             "tx_bytes": ("transport", 1e6, "MB/s")}
+    out = [f"  fleet: {cap.get('workers', 0)} executors x "
+           f"{cap.get('threads', 0)} threads, "
+           f"{cap.get('budget_bytes', 0) / 1e6:.0f}M governed each",
+           f"  {'resource':<11}{'util':>12}{'headroom':>14}"]
+    rates = cap.get("rates") or {}
+    for r, (label, div, suffix) in units.items():
+        u = util.get(r)
+        h = head.get(r)
+        ub = _bar(u) if u is not None else "(n/a)"
+        hs = (f"{h / div:.2f} {suffix}" if h is not None
+              else f"demand {rates.get(r, 0.0) / div:.2f}")
+        out.append(f"  {label:<11}{ub:>12}{hs:>14}")
+    cov = at.get("coverage_comp")
+    out.append(
+        f"  attribution: {at.get('events', 0)} events, "
+        f"{at.get('requests', 0)} requests, coverage "
+        + (f"{cov:.1%}" if cov is not None else "-")
+        + f"   ring_dropped {measured.get('ring_dropped', 0)}"
+        + (f"  unparsed {at['unparsed']}" if at.get("unparsed") else ""))
+    return out
+
+
 def _slo_table(view: dict) -> List[str]:
     slo = view.get("slo")
     if not slo:
@@ -230,6 +287,9 @@ def render_frame(view: dict, *, prev: Optional[dict] = None,
     lines += ["", "HANDLERS"] + _handler_table(view, prev, dt_s)
     lines += ["", "CACHE"] + _cache_section(view, prev)
     lines += ["", "TENANTS"] + _tenant_table(view)
+    lines += (["", "TENANTS (dominant-resource share)"]
+              + _attrib_tenant_table(view))
+    lines += ["", "CAPACITY"] + _capacity_section(view)
     lines += ["", "SLO"] + _slo_table(view)
     lines += ["", "SPANS (slowest / in-flight)"] + _span_section(view, top)
     return "\n".join(lines)
@@ -248,6 +308,10 @@ def main(argv=None) -> int:
                     help="refresh period in seconds")
     ap.add_argument("--once", action="store_true",
                     help="render one frame and exit (no screen clearing)")
+    ap.add_argument("--json", action="store_true",
+                    help="one-shot: emit the raw endpoint view as JSON "
+                         "and exit (machine-readable --once; same "
+                         "fixture path as the rendered frame)")
     ap.add_argument("--top", type=int, default=3,
                     help="span waterfalls shown in the SPANS section")
     args = ap.parse_args(argv)
@@ -268,6 +332,9 @@ def main(argv=None) -> int:
         except (OSError, ValueError) as e:
             print(f"servetop: endpoint unreachable: {e}", file=sys.stderr)
             return 1
+        if args.json:
+            print(json.dumps(view, indent=2, sort_keys=True, default=str))
+            return 0
         frame = render_frame(view, prev=prev, top=args.top)
         if args.once:
             print(frame)
